@@ -1,0 +1,29 @@
+"""nomad_tpu — a TPU-native cluster-scheduling framework.
+
+A brand-new workload orchestrator with the capabilities of HashiCorp Nomad
+(reference snapshot v0.6.0-dev): declarative jobs in, placed + running task
+allocations out, with a replicated control plane and an optimistically
+concurrent scheduler.  The scheduler hot path — constraint feasibility,
+bin-pack scoring, placement selection — is redesigned as batched tensor
+kernels on TPU (JAX/XLA, ``pjit``/``shard_map``) that score all pending
+task-groups against all candidate nodes in one vectorized pass, instead of
+the reference's per-node Go iterator chains (reference: scheduler/stack.go).
+
+Layers (bottom-up, mirroring SURVEY.md §1):
+  structs/   L0  data model & tensor schema contract
+  state/     L1  in-memory MVCC state store with blocking-query watchsets
+  scheduler/ L4  CPU oracle scheduler (exact reference semantics)
+  ops/       —   TPU batch kernels (feasibility, scoring, placement)
+  parallel/  —   device-mesh sharding of the score matrix (ICI/DCN)
+  server/    L2+L3  control plane: FSM/log, broker, plan queue/apply, worker
+  client/    L5  node agent / data plane
+  agent/     L6  combined agent + HTTP API
+  api/       L7  Python SDK
+  jobspec/   L7  job-file parser
+"""
+
+__version__ = "0.1.0"
+
+# Scheduler algorithm version — plans produced by a different major version
+# are rejected at plan-apply time (reference: scheduler/scheduler.go:16).
+SCHEDULER_VERSION = 1

@@ -1,0 +1,257 @@
+"""Object-mother test fixtures (reference: nomad/mock/mock.go).
+
+Used by unit tests, the scheduler harness, differential tests, and the
+benchmark cluster generators.
+"""
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from .structs import structs as s
+
+
+def node(seed: Optional[random.Random] = None) -> s.Node:
+    """A ready linux node with exec driver (mock.go:9 Node)."""
+    n = s.Node(
+        id=s.generate_uuid(),
+        datacenter="dc1",
+        name="foobar",
+        attributes={
+            "kernel.name": "linux",
+            "arch": "x86",
+            "nomad.version": "0.5.0",
+            "driver.exec": "1",
+        },
+        resources=s.Resources(
+            cpu=4000,
+            memory_mb=8192,
+            disk_mb=100 * 1024,
+            iops=150,
+            networks=[
+                s.NetworkResource(device="eth0", cidr="192.168.0.100/32", mbits=1000)
+            ],
+        ),
+        reserved=s.Resources(
+            cpu=100,
+            memory_mb=256,
+            disk_mb=4 * 1024,
+            networks=[
+                s.NetworkResource(
+                    device="eth0",
+                    ip="192.168.0.100",
+                    reserved_ports=[s.Port("main", 22)],
+                    mbits=1,
+                )
+            ],
+        ),
+        links={"consul": "foobar.dc1"},
+        meta={"pci-dss": "true", "database": "mysql", "version": "5.6"},
+        node_class="linux-medium-pci",
+        status=s.NODE_STATUS_READY,
+    )
+    n.compute_class()
+    return n
+
+
+def job() -> s.Job:
+    """A 10-count service job with one web task (mock.go:62 Job)."""
+    j = s.Job(
+        region="global",
+        id=s.generate_uuid(),
+        name="my-job",
+        type=s.JOB_TYPE_SERVICE,
+        priority=50,
+        all_at_once=False,
+        datacenters=["dc1"],
+        constraints=[s.Constraint("${attr.kernel.name}", "linux", "=")],
+        task_groups=[
+            s.TaskGroup(
+                name="web",
+                count=10,
+                ephemeral_disk=s.EphemeralDisk(size_mb=150),
+                restart_policy=s.RestartPolicy(
+                    attempts=3, interval=600.0, delay=60.0,
+                    mode=s.RESTART_POLICY_MODE_DELAY,
+                ),
+                tasks=[
+                    s.Task(
+                        name="web",
+                        driver="exec",
+                        config={"command": "/bin/date"},
+                        env={"FOO": "bar"},
+                        services=[
+                            s.Service(
+                                name="${TASK}-frontend",
+                                port_label="http",
+                                tags=["pci:${meta.pci-dss}", "datacenter:${node.datacenter}"],
+                                checks=[
+                                    s.ServiceCheck(
+                                        name="check-table",
+                                        type="script",
+                                        command="/usr/local/check-table-${meta.database}",
+                                        args=["${meta.version}"],
+                                        interval=30.0,
+                                        timeout=5.0,
+                                    )
+                                ],
+                            ),
+                            s.Service(name="${TASK}-admin", port_label="admin"),
+                        ],
+                        resources=s.Resources(
+                            cpu=500,
+                            memory_mb=256,
+                            networks=[
+                                s.NetworkResource(
+                                    mbits=50,
+                                    dynamic_ports=[s.Port("http"), s.Port("admin")],
+                                )
+                            ],
+                        ),
+                        meta={"foo": "bar"},
+                    )
+                ],
+                meta={"elb_check_type": "http"},
+            )
+        ],
+        meta={"owner": "armon"},
+        status=s.JOB_STATUS_PENDING,
+        version=0,
+        create_index=42,
+        modify_index=99,
+        job_modify_index=99,
+    )
+    j.canonicalize()
+    return j
+
+
+def system_job() -> s.Job:
+    """A system job: one alloc per feasible node (mock.go:158 SystemJob)."""
+    j = s.Job(
+        region="global",
+        id=s.generate_uuid(),
+        name="my-job",
+        type=s.JOB_TYPE_SYSTEM,
+        priority=100,
+        datacenters=["dc1"],
+        constraints=[s.Constraint("${attr.kernel.name}", "linux", "=")],
+        task_groups=[
+            s.TaskGroup(
+                name="web",
+                count=1,
+                restart_policy=s.RestartPolicy(
+                    attempts=3, interval=600.0, delay=60.0,
+                    mode=s.RESTART_POLICY_MODE_DELAY,
+                ),
+                tasks=[
+                    s.Task(
+                        name="web",
+                        driver="exec",
+                        config={"command": "/bin/date"},
+                        resources=s.Resources(
+                            cpu=500,
+                            memory_mb=256,
+                            networks=[
+                                s.NetworkResource(mbits=50, dynamic_ports=[s.Port("http")])
+                            ],
+                        ),
+                    )
+                ],
+            )
+        ],
+        meta={"owner": "armon"},
+        status=s.JOB_STATUS_PENDING,
+        create_index=42,
+        modify_index=99,
+    )
+    j.canonicalize()
+    return j
+
+
+def batch_job() -> s.Job:
+    j = job()
+    j.type = s.JOB_TYPE_BATCH
+    return j
+
+
+def periodic_job() -> s.Job:
+    """A batch job on a 30-minute cron (mock.go:219 PeriodicJob)."""
+    j = job()
+    j.type = s.JOB_TYPE_BATCH
+    j.periodic = s.PeriodicConfig(
+        enabled=True, spec_type=s.PERIODIC_SPEC_CRON, spec="*/30 * * * *"
+    )
+    j.status = s.JOB_STATUS_RUNNING
+    return j
+
+
+def eval() -> s.Evaluation:  # noqa: A001 — matches reference fixture name
+    return s.Evaluation(
+        id=s.generate_uuid(),
+        priority=50,
+        type=s.JOB_TYPE_SERVICE,
+        job_id=s.generate_uuid(),
+        status=s.EVAL_STATUS_PENDING,
+    )
+
+
+def job_summary(job_id: str) -> s.JobSummary:
+    return s.JobSummary(
+        job_id=job_id,
+        summary={"web": s.TaskGroupSummary(queued=0, starting=0)},
+    )
+
+
+def alloc() -> s.Allocation:
+    """A placed web alloc with port reservations (mock.go:255 Alloc)."""
+    j = job()
+    a = s.Allocation(
+        id=s.generate_uuid(),
+        eval_id=s.generate_uuid(),
+        node_id="12345678-abcd-efab-cdef-123456789abc",
+        task_group="web",
+        resources=s.Resources(
+            cpu=500,
+            memory_mb=256,
+            disk_mb=150,
+            networks=[
+                s.NetworkResource(
+                    device="eth0",
+                    ip="192.168.0.100",
+                    reserved_ports=[s.Port("main", 5000)],
+                    mbits=50,
+                    dynamic_ports=[s.Port("http")],
+                )
+            ],
+        ),
+        task_resources={
+            "web": s.Resources(
+                cpu=500,
+                memory_mb=256,
+                networks=[
+                    s.NetworkResource(
+                        device="eth0",
+                        ip="192.168.0.100",
+                        reserved_ports=[s.Port("main", 5000)],
+                        mbits=50,
+                        dynamic_ports=[s.Port("http")],
+                    )
+                ],
+            )
+        },
+        shared_resources=s.Resources(disk_mb=150),
+        job=j,
+        desired_status=s.ALLOC_DESIRED_STATUS_RUN,
+        client_status=s.ALLOC_CLIENT_STATUS_PENDING,
+    )
+    a.job_id = j.id
+    a.name = f"{j.name}.web[0]"
+    return a
+
+
+def plan() -> s.Plan:
+    return s.Plan(priority=50)
+
+
+def plan_result() -> s.PlanResult:
+    return s.PlanResult()

@@ -1,0 +1,68 @@
+"""L0 data model & wire types (reference: nomad/structs/)."""
+
+from .bitmap import Bitmap
+from .funcs import (
+    allocs_fit,
+    filter_terminal_allocs,
+    remove_allocs,
+    score_fit,
+)
+from .network import (
+    MAX_DYNAMIC_PORT,
+    MAX_VALID_PORT,
+    MIN_DYNAMIC_PORT,
+    NetworkIndex,
+)
+from .node_class import (
+    compute_node_class,
+    escaped_constraints,
+    is_unique_namespace,
+    unique_namespace,
+)
+from . import structs as _s
+from .structs import (  # noqa: F401
+    AllocListStub,
+    AllocMetric,
+    Allocation,
+    Constraint,
+    DesiredUpdates,
+    EphemeralDisk,
+    Evaluation,
+    Job,
+    JobChildrenSummary,
+    JobSummary,
+    LogConfig,
+    NetworkResource,
+    Node,
+    ParameterizedJobConfig,
+    PeriodicConfig,
+    Plan,
+    PlanAnnotations,
+    PlanResult,
+    Port,
+    Resources,
+    RestartPolicy,
+    Service,
+    ServiceCheck,
+    Task,
+    TaskArtifact,
+    TaskEvent,
+    TaskGroup,
+    TaskGroupSummary,
+    TaskState,
+    Template,
+    UpdateStrategy,
+    Vault,
+    generate_uuid,
+)
+
+# Re-export the string constants (statuses, types, triggers) without leaking
+# implementation imports like `time`/`uuid` into the package namespace.
+_CONST_PREFIXES = (
+    "JOB_", "NODE_", "ALLOC_", "EVAL_", "CONSTRAINT_", "TASK_", "CORE_JOB_",
+    "DEFAULT_RESOURCES_", "PERIODIC_", "RESTART_POLICY_",
+)
+for _name in dir(_s):
+    if _name.startswith(_CONST_PREFIXES):
+        globals()[_name] = getattr(_s, _name)
+del _name, _s

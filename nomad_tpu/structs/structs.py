@@ -1,0 +1,1151 @@
+"""L0 data model: the host-side dataclasses AND the device-side tensor schema
+contract for the TPU batch scheduler.
+
+Behavioral parity with the reference data model (nomad/structs/structs.go:
+Node:756, Job:1189, TaskGroup:2130, Task:2616, Allocation:3820,
+Evaluation:4244, Plan:4477, PlanResult:4581), re-designed as Python
+dataclasses.  Resource quantities are deliberately 4 scalar ints
+(cpu, memory_mb, disk_mb, iops) so they lower directly to int32 SoA tensors
+``node_res[N,4]`` / ``tg_ask[B,4]`` in nomad_tpu/ops/encode.py.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+# ---------------------------------------------------------------------------
+# Constants (reference: nomad/structs/structs.go)
+# ---------------------------------------------------------------------------
+
+# Job types (structs.go:1160-1166)
+JOB_TYPE_SERVICE = "service"
+JOB_TYPE_BATCH = "batch"
+JOB_TYPE_SYSTEM = "system"
+JOB_TYPE_CORE = "_core"
+
+# Job statuses (structs.go:1168-1177)
+JOB_STATUS_PENDING = "pending"
+JOB_STATUS_RUNNING = "running"
+JOB_STATUS_DEAD = "dead"
+
+JOB_MIN_PRIORITY = 1
+JOB_DEFAULT_PRIORITY = 50
+JOB_MAX_PRIORITY = 100
+
+# Core job IDs used by the internal GC scheduler (structs.go / core_sched.go)
+CORE_JOB_EVAL_GC = "eval-gc"
+CORE_JOB_NODE_GC = "node-gc"
+CORE_JOB_JOB_GC = "job-gc"
+CORE_JOB_FORCE_GC = "force-gc"
+
+# Node statuses (structs.go:698-707)
+NODE_STATUS_INIT = "initializing"
+NODE_STATUS_READY = "ready"
+NODE_STATUS_DOWN = "down"
+
+# Allocation desired statuses (structs.go:3806-3808)
+ALLOC_DESIRED_STATUS_RUN = "run"
+ALLOC_DESIRED_STATUS_STOP = "stop"
+ALLOC_DESIRED_STATUS_EVICT = "evict"
+
+# Allocation client statuses (structs.go:3812-3816)
+ALLOC_CLIENT_STATUS_PENDING = "pending"
+ALLOC_CLIENT_STATUS_RUNNING = "running"
+ALLOC_CLIENT_STATUS_COMPLETE = "complete"
+ALLOC_CLIENT_STATUS_FAILED = "failed"
+ALLOC_CLIENT_STATUS_LOST = "lost"
+
+# Evaluation statuses (structs.go:4230-4242)
+EVAL_STATUS_BLOCKED = "blocked"
+EVAL_STATUS_PENDING = "pending"
+EVAL_STATUS_COMPLETE = "complete"
+EVAL_STATUS_FAILED = "failed"
+EVAL_STATUS_CANCELLED = "canceled"
+
+# Evaluation trigger reasons (structs.go:4218-4228)
+EVAL_TRIGGER_JOB_REGISTER = "job-register"
+EVAL_TRIGGER_JOB_DEREGISTER = "job-deregister"
+EVAL_TRIGGER_PERIODIC_JOB = "periodic-job"
+EVAL_TRIGGER_NODE_UPDATE = "node-update"
+EVAL_TRIGGER_SCHEDULED = "scheduled"
+EVAL_TRIGGER_ROLLING_UPDATE = "rolling-update"
+EVAL_TRIGGER_MAX_PLANS = "max-plan-attempts"
+
+# Constraint operands (structs.go:3286-3294)
+CONSTRAINT_DISTINCT_PROPERTY = "distinct_property"
+CONSTRAINT_DISTINCT_HOSTS = "distinct_hosts"
+CONSTRAINT_REGEX = "regexp"
+CONSTRAINT_VERSION = "version"
+CONSTRAINT_SET_CONTAINS = "set_contains"
+
+# Task states (structs.go:2900-2910)
+TASK_STATE_PENDING = "pending"
+TASK_STATE_RUNNING = "running"
+TASK_STATE_DEAD = "dead"
+
+# Default resource values (structs.go:918-935 DefaultResources)
+DEFAULT_RESOURCES_CPU = 100
+DEFAULT_RESOURCES_MEMORY_MB = 10
+DEFAULT_RESOURCES_DISK_MB = 300
+DEFAULT_RESOURCES_IOPS = 0
+
+# Periodic spec types (structs.go:1718-1724)
+PERIODIC_SPEC_CRON = "cron"
+PERIODIC_SPEC_TEST = "_internal_test"
+
+# Restart policy modes (structs.go:1956-1963)
+RESTART_POLICY_MODE_DELAY = "delay"
+RESTART_POLICY_MODE_FAIL = "fail"
+
+
+def generate_uuid() -> str:
+    """Random UUID for IDs (reference: nomad/structs/funcs.go:158)."""
+    return str(uuid.uuid4())
+
+
+# ---------------------------------------------------------------------------
+# Resources
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Port:
+    label: str = ""
+    value: int = 0
+
+
+@dataclass
+class NetworkResource:
+    """A network interface / bandwidth+port ask (structs.go:1071-1158)."""
+
+    device: str = ""
+    cidr: str = ""
+    ip: str = ""
+    mbits: int = 0
+    reserved_ports: List[Port] = field(default_factory=list)
+    dynamic_ports: List[Port] = field(default_factory=list)
+
+    def copy(self) -> "NetworkResource":
+        return NetworkResource(
+            device=self.device,
+            cidr=self.cidr,
+            ip=self.ip,
+            mbits=self.mbits,
+            reserved_ports=[Port(p.label, p.value) for p in self.reserved_ports],
+            dynamic_ports=[Port(p.label, p.value) for p in self.dynamic_ports],
+        )
+
+    def add(self, delta: "NetworkResource") -> None:
+        self.reserved_ports.extend(Port(p.label, p.value) for p in delta.reserved_ports)
+        self.mbits += delta.mbits
+
+    def port_labels(self) -> Dict[str, int]:
+        labels: Dict[str, int] = {}
+        for p in self.reserved_ports:
+            labels[p.label] = p.value
+        for p in self.dynamic_ports:
+            labels[p.label] = p.value
+        return labels
+
+
+@dataclass
+class Resources:
+    """Resource ask/capacity.  The 4 scalar dims are the tensor schema:
+    column order (cpu, memory_mb, disk_mb, iops) is shared with
+    ops/encode.py (reference: structs.go:900-1069)."""
+
+    cpu: int = 0
+    memory_mb: int = 0
+    disk_mb: int = 0
+    iops: int = 0
+    networks: List[NetworkResource] = field(default_factory=list)
+
+    # Tensor column order contract.
+    TENSOR_DIMS = ("cpu", "memory_mb", "disk_mb", "iops")
+
+    def copy(self) -> "Resources":
+        return Resources(
+            cpu=self.cpu,
+            memory_mb=self.memory_mb,
+            disk_mb=self.disk_mb,
+            iops=self.iops,
+            networks=[n.copy() for n in self.networks],
+        )
+
+    def net_index(self, n: NetworkResource) -> int:
+        """Index of the first network with the same device — including the
+        empty device, so device-less asks merge (structs.go:1012)."""
+        for idx, existing in enumerate(self.networks):
+            if existing.device == n.device:
+                return idx
+        return -1
+
+    def superset(self, other: "Resources") -> tuple[bool, str]:
+        """Whether self >= other on every scalar dimension; returns the
+        exhausted dimension name otherwise (structs.go:1024-1040)."""
+        if self.cpu < other.cpu:
+            return False, "cpu exhausted"
+        if self.memory_mb < other.memory_mb:
+            return False, "memory exhausted"
+        if self.disk_mb < other.disk_mb:
+            return False, "disk exhausted"
+        if self.iops < other.iops:
+            return False, "iops exhausted"
+        return True, ""
+
+    def add(self, delta: Optional["Resources"]) -> None:
+        """Accumulate delta, merging networks by device (structs.go:1042)."""
+        if delta is None:
+            return
+        self.cpu += delta.cpu
+        self.memory_mb += delta.memory_mb
+        self.disk_mb += delta.disk_mb
+        self.iops += delta.iops
+        for n in delta.networks:
+            idx = self.net_index(n)
+            if idx == -1:
+                self.networks.append(n.copy())
+            else:
+                self.networks[idx].add(n)
+
+    def as_tuple(self) -> tuple[int, int, int, int]:
+        return (self.cpu, self.memory_mb, self.disk_mb, self.iops)
+
+
+# ---------------------------------------------------------------------------
+# Node
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Node:
+    """A fingerprinted client machine (structs.go:756-898)."""
+
+    id: str = ""
+    datacenter: str = "dc1"
+    name: str = ""
+    http_addr: str = ""
+    attributes: Dict[str, str] = field(default_factory=dict)
+    resources: Resources = field(default_factory=Resources)
+    reserved: Optional[Resources] = None
+    links: Dict[str, str] = field(default_factory=dict)
+    meta: Dict[str, str] = field(default_factory=dict)
+    node_class: str = ""
+    computed_class: str = ""
+    drain: bool = False
+    status: str = NODE_STATUS_INIT
+    status_description: str = ""
+    status_updated_at: float = 0.0
+    create_index: int = 0
+    modify_index: int = 0
+
+    def terminal_status(self) -> bool:
+        """Whether the node is down — allocs on it are lost (structs.go:888)."""
+        return self.status == NODE_STATUS_DOWN
+
+    def ready(self) -> bool:
+        return self.status == NODE_STATUS_READY and not self.drain
+
+    def compute_class(self) -> None:
+        from .node_class import compute_node_class
+
+        self.computed_class = compute_node_class(self)
+
+    def copy(self) -> "Node":
+        n = dataclasses.replace(self)
+        n.attributes = dict(self.attributes)
+        n.meta = dict(self.meta)
+        n.links = dict(self.links)
+        n.resources = self.resources.copy()
+        n.reserved = self.reserved.copy() if self.reserved else None
+        return n
+
+    def stat_values(self) -> Dict[str, str]:
+        return {"id": self.id, "datacenter": self.datacenter, "name": self.name,
+                "class": self.node_class, "drain": str(self.drain), "status": self.status}
+
+
+# ---------------------------------------------------------------------------
+# Job / TaskGroup / Task
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Constraint:
+    """A scheduling constraint (structs.go:3296-3349)."""
+
+    ltarget: str = ""
+    rtarget: str = ""
+    operand: str = "="
+
+    def copy(self) -> "Constraint":
+        return Constraint(self.ltarget, self.rtarget, self.operand)
+
+    def __str__(self) -> str:
+        return f"{self.ltarget} {self.operand} {self.rtarget}"
+
+
+@dataclass
+class RestartPolicy:
+    """Task restart behavior within a task group (structs.go:1965-2012)."""
+
+    attempts: int = 2
+    interval: float = 60.0  # seconds (reference uses ns durations)
+    delay: float = 15.0
+    mode: str = RESTART_POLICY_MODE_DELAY
+
+    def copy(self) -> "RestartPolicy":
+        return dataclasses.replace(self)
+
+
+@dataclass
+class EphemeralDisk:
+    """Shared task-group disk ask (structs.go:3357-3409)."""
+
+    sticky: bool = False
+    size_mb: int = 300
+    migrate: bool = False
+
+    def copy(self) -> "EphemeralDisk":
+        return dataclasses.replace(self)
+
+
+@dataclass
+class UpdateStrategy:
+    """Rolling-update policy (structs.go:1702-1716)."""
+
+    stagger: float = 0.0  # seconds between rolling batches
+    max_parallel: int = 0
+
+    def rolling(self) -> bool:
+        return self.stagger > 0 and self.max_parallel > 0
+
+    def copy(self) -> "UpdateStrategy":
+        return dataclasses.replace(self)
+
+
+@dataclass
+class PeriodicConfig:
+    """Cron-style periodic launch config (structs.go:1726-1810)."""
+
+    enabled: bool = False
+    spec: str = ""
+    spec_type: str = PERIODIC_SPEC_CRON
+    prohibit_overlap: bool = False
+
+    def copy(self) -> "PeriodicConfig":
+        return dataclasses.replace(self)
+
+    def next(self, from_time: float) -> float:
+        """Next launch time strictly after from_time, or 0 if none."""
+        if self.spec_type == PERIODIC_SPEC_CRON:
+            from ..utils.cron import cron_next
+
+            return cron_next(self.spec, from_time)
+        if self.spec_type == PERIODIC_SPEC_TEST:
+            # test spec: comma-separated unix timestamps; return the first
+            # one after from_time (structs.go PeriodicConfig.Next test path)
+            for part in self.spec.split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                t = float(part)
+                if t > from_time:
+                    return t
+            return 0.0
+        return 0.0
+
+
+@dataclass
+class ParameterizedJobConfig:
+    """Dispatchable-job config (structs.go:1860+ in later refs; minimal here)."""
+
+    payload: str = ""
+    meta_required: List[str] = field(default_factory=list)
+    meta_optional: List[str] = field(default_factory=list)
+
+    def copy(self) -> "ParameterizedJobConfig":
+        return ParameterizedJobConfig(self.payload, list(self.meta_required), list(self.meta_optional))
+
+
+@dataclass
+class LogConfig:
+    """Task log rotation config (structs.go:2540-2576)."""
+
+    max_files: int = 10
+    max_file_size_mb: int = 10
+
+    def copy(self) -> "LogConfig":
+        return dataclasses.replace(self)
+
+
+@dataclass
+class ServiceCheck:
+    """Health check for a registered service (structs.go:2250-2360)."""
+
+    name: str = ""
+    type: str = ""  # http | tcp | script
+    command: str = ""
+    args: List[str] = field(default_factory=list)
+    path: str = ""
+    protocol: str = ""
+    port_label: str = ""
+    interval: float = 10.0
+    timeout: float = 3.0
+    initial_status: str = ""
+
+    def copy(self) -> "ServiceCheck":
+        c = dataclasses.replace(self)
+        c.args = list(self.args)
+        return c
+
+
+@dataclass
+class Service:
+    """A service advertised by a task (structs.go:2362-2470)."""
+
+    name: str = ""
+    port_label: str = ""
+    tags: List[str] = field(default_factory=list)
+    checks: List[ServiceCheck] = field(default_factory=list)
+
+    def copy(self) -> "Service":
+        return Service(self.name, self.port_label, list(self.tags),
+                       [c.copy() for c in self.checks])
+
+
+@dataclass
+class TaskArtifact:
+    """Remote artifact to fetch before task start (structs.go:3196-3280)."""
+
+    getter_source: str = ""
+    getter_options: Dict[str, str] = field(default_factory=dict)
+    relative_dest: str = ""
+
+    def copy(self) -> "TaskArtifact":
+        return TaskArtifact(self.getter_source, dict(self.getter_options), self.relative_dest)
+
+
+@dataclass
+class Template:
+    """Rendered template block (structs.go:2914-3020)."""
+
+    source_path: str = ""
+    dest_path: str = ""
+    embedded_tmpl: str = ""
+    change_mode: str = "restart"  # noop | signal | restart
+    change_signal: str = ""
+    splay: float = 5.0
+    perms: str = "0644"
+
+    def copy(self) -> "Template":
+        return dataclasses.replace(self)
+
+
+@dataclass
+class Vault:
+    """Vault policy ask for a task (structs.go:4120-4180 region)."""
+
+    policies: List[str] = field(default_factory=list)
+    env: bool = True
+    change_mode: str = "restart"
+    change_signal: str = ""
+
+    def copy(self) -> "Vault":
+        v = dataclasses.replace(self)
+        v.policies = list(self.policies)
+        return v
+
+
+@dataclass
+class DispatchPayloadConfig:
+    file: str = ""
+
+    def copy(self) -> "DispatchPayloadConfig":
+        return dataclasses.replace(self)
+
+
+@dataclass
+class Task:
+    """A unit of work executed by a driver (structs.go:2616-2790)."""
+
+    name: str = ""
+    driver: str = ""
+    user: str = ""
+    config: Dict[str, Any] = field(default_factory=dict)
+    env: Dict[str, str] = field(default_factory=dict)
+    services: List[Service] = field(default_factory=list)
+    vault: Optional[Vault] = None
+    templates: List[Template] = field(default_factory=list)
+    constraints: List[Constraint] = field(default_factory=list)
+    resources: Resources = field(default_factory=Resources)
+    dispatch_payload: Optional[DispatchPayloadConfig] = None
+    meta: Dict[str, str] = field(default_factory=dict)
+    kill_timeout: float = 5.0
+    log_config: LogConfig = field(default_factory=LogConfig)
+    artifacts: List[TaskArtifact] = field(default_factory=list)
+    leader: bool = False
+
+    def copy(self) -> "Task":
+        return Task(
+            name=self.name,
+            driver=self.driver,
+            user=self.user,
+            config=dict(self.config),
+            env=dict(self.env),
+            services=[s.copy() for s in self.services],
+            vault=self.vault.copy() if self.vault else None,
+            templates=[t.copy() for t in self.templates],
+            constraints=[c.copy() for c in self.constraints],
+            resources=self.resources.copy(),
+            dispatch_payload=self.dispatch_payload.copy() if self.dispatch_payload else None,
+            meta=dict(self.meta),
+            kill_timeout=self.kill_timeout,
+            log_config=self.log_config.copy(),
+            artifacts=[a.copy() for a in self.artifacts],
+            leader=self.leader,
+        )
+
+
+@dataclass
+class TaskGroup:
+    """A colocated set of tasks; the scheduler's placement unit
+    (structs.go:2130-2248)."""
+
+    name: str = ""
+    count: int = 1
+    constraints: List[Constraint] = field(default_factory=list)
+    restart_policy: RestartPolicy = field(default_factory=RestartPolicy)
+    tasks: List[Task] = field(default_factory=list)
+    ephemeral_disk: EphemeralDisk = field(default_factory=EphemeralDisk)
+    meta: Dict[str, str] = field(default_factory=dict)
+
+    def copy(self) -> "TaskGroup":
+        return TaskGroup(
+            name=self.name,
+            count=self.count,
+            constraints=[c.copy() for c in self.constraints],
+            restart_policy=self.restart_policy.copy(),
+            tasks=[t.copy() for t in self.tasks],
+            ephemeral_disk=self.ephemeral_disk.copy(),
+            meta=dict(self.meta),
+        )
+
+    def lookup_task(self, name: str) -> Optional[Task]:
+        for t in self.tasks:
+            if t.name == name:
+                return t
+        return None
+
+
+@dataclass
+class Job:
+    """A declarative workload specification (structs.go:1189-1560)."""
+
+    region: str = "global"
+    id: str = ""
+    parent_id: str = ""
+    name: str = ""
+    type: str = JOB_TYPE_SERVICE
+    priority: int = JOB_DEFAULT_PRIORITY
+    all_at_once: bool = False
+    datacenters: List[str] = field(default_factory=list)
+    constraints: List[Constraint] = field(default_factory=list)
+    task_groups: List[TaskGroup] = field(default_factory=list)
+    update: UpdateStrategy = field(default_factory=UpdateStrategy)
+    periodic: Optional[PeriodicConfig] = None
+    parameterized_job: Optional[ParameterizedJobConfig] = None
+    payload: bytes = b""
+    meta: Dict[str, str] = field(default_factory=dict)
+    vault_token: str = ""
+    status: str = JOB_STATUS_PENDING
+    status_description: str = ""
+    stop: bool = False
+    stable: bool = False
+    version: int = 0
+    submit_time: float = 0.0
+    create_index: int = 0
+    modify_index: int = 0
+    job_modify_index: int = 0
+
+    def copy(self) -> "Job":
+        j = dataclasses.replace(self)
+        j.datacenters = list(self.datacenters)
+        j.constraints = [c.copy() for c in self.constraints]
+        j.task_groups = [tg.copy() for tg in self.task_groups]
+        j.update = self.update.copy()
+        j.periodic = self.periodic.copy() if self.periodic else None
+        j.parameterized_job = self.parameterized_job.copy() if self.parameterized_job else None
+        j.meta = dict(self.meta)
+        return j
+
+    def stopped(self) -> bool:
+        return self.stop
+
+    def is_periodic(self) -> bool:
+        return self.periodic is not None and self.periodic.enabled
+
+    def is_parameterized(self) -> bool:
+        return self.parameterized_job is not None
+
+    def lookup_task_group(self, name: str) -> Optional[TaskGroup]:
+        for tg in self.task_groups:
+            if tg.name == name:
+                return tg
+        return None
+
+    def required_signals(self) -> Dict[str, Dict[str, List[str]]]:
+        signals: Dict[str, Dict[str, List[str]]] = {}
+        for tg in self.task_groups:
+            for task in tg.tasks:
+                sigs: List[str] = []
+                if task.vault and task.vault.change_mode == "signal":
+                    sigs.append(task.vault.change_signal)
+                for tmpl in task.templates:
+                    if tmpl.change_mode == "signal":
+                        sigs.append(tmpl.change_signal)
+                if sigs:
+                    signals.setdefault(tg.name, {})[task.name] = sigs
+        return signals
+
+    def validate(self) -> List[str]:
+        """Structural validation; returns a list of problems
+        (reference behavior: structs.go:1334 Job.Validate)."""
+        problems: List[str] = []
+        if not self.region:
+            problems.append("job region is empty")
+        if not self.id:
+            problems.append("job ID is empty")
+        if not self.name:
+            problems.append("job name is empty")
+        if self.type not in (JOB_TYPE_SERVICE, JOB_TYPE_BATCH, JOB_TYPE_SYSTEM):
+            problems.append(f"job type '{self.type}' is invalid")
+        if not (JOB_MIN_PRIORITY <= self.priority <= JOB_MAX_PRIORITY):
+            problems.append(
+                f"job priority must be between [{JOB_MIN_PRIORITY}, {JOB_MAX_PRIORITY}]")
+        if not self.datacenters:
+            problems.append("job must specify at least one datacenter")
+        if not self.task_groups:
+            problems.append("job must have at least one task group")
+        seen: Dict[str, int] = {}
+        for tg in self.task_groups:
+            if not tg.name:
+                problems.append("task group name is empty")
+            if tg.name in seen:
+                problems.append(f"task group '{tg.name}' defined more than once")
+            seen[tg.name] = 1
+            if tg.count < 0:
+                problems.append(f"task group '{tg.name}' has negative count")
+            if self.type == JOB_TYPE_SYSTEM and tg.count not in (0, 1):
+                problems.append(
+                    f"system job task group '{tg.name}' should have count 1, not {tg.count}")
+            if not tg.tasks:
+                problems.append(f"task group '{tg.name}' has no tasks")
+            tseen: Dict[str, int] = {}
+            for task in tg.tasks:
+                if not task.name:
+                    problems.append(f"task name empty in group '{tg.name}'")
+                if task.name in tseen:
+                    problems.append(f"task '{task.name}' defined more than once")
+                tseen[task.name] = 1
+                if not task.driver:
+                    problems.append(f"task '{task.name}' must specify a driver")
+        if self.type == JOB_TYPE_SYSTEM and self.periodic and self.periodic.enabled:
+            problems.append("periodic is not allowed on system jobs")
+        for c in self.constraints:
+            if c.operand in (CONSTRAINT_DISTINCT_HOSTS, CONSTRAINT_DISTINCT_PROPERTY):
+                pass
+            elif not c.operand:
+                problems.append(f"constraint missing operand: {c}")
+        return problems
+
+    def canonicalize(self) -> None:
+        """Fill defaults (reference behavior: structs.go:1286 Job.Canonicalize)."""
+        if not self.name:
+            self.name = self.id
+        if not self.region:
+            self.region = "global"
+        if not self.datacenters:
+            self.datacenters = ["dc1"]
+        for tg in self.task_groups:
+            if tg.count == 0 and self.type != JOB_TYPE_SYSTEM:
+                tg.count = 1
+
+
+# ---------------------------------------------------------------------------
+# Task events / states
+# ---------------------------------------------------------------------------
+
+TASK_SETUP_FAILURE = "Setup Failure"
+TASK_DRIVER_FAILURE = "Driver Failure"
+TASK_RECEIVED = "Received"
+TASK_FAILED_VALIDATION = "Failed Validation"
+TASK_STARTED = "Started"
+TASK_TERMINATED = "Terminated"
+TASK_KILLING = "Killing"
+TASK_KILLED = "Killed"
+TASK_RESTARTING = "Restarting"
+TASK_NOT_RESTARTING = "Not Restarting"
+TASK_DOWNLOADING_ARTIFACTS = "Downloading Artifacts"
+TASK_ARTIFACT_DOWNLOAD_FAILED = "Failed Artifact Download"
+TASK_SIGNALING = "Signaling"
+TASK_RESTART_SIGNAL = "Restart Signaled"
+
+
+@dataclass
+class TaskEvent:
+    """An event in a task's lifecycle (structs.go:3030-3190)."""
+
+    type: str = ""
+    time: float = 0.0
+    message: str = ""
+    driver_error: str = ""
+    exit_code: int = 0
+    signal: int = 0
+    kill_timeout: float = 0.0
+    restart_reason: str = ""
+    failed_sibling: str = ""
+
+    def copy(self) -> "TaskEvent":
+        return dataclasses.replace(self)
+
+
+@dataclass
+class TaskState:
+    """Client-side task state (structs.go:2928-3010)."""
+
+    state: str = TASK_STATE_PENDING
+    failed: bool = False
+    started_at: float = 0.0
+    finished_at: float = 0.0
+    events: List[TaskEvent] = field(default_factory=list)
+
+    def copy(self) -> "TaskState":
+        t = dataclasses.replace(self)
+        t.events = [e.copy() for e in self.events]
+        return t
+
+    def successful(self) -> bool:
+        """Task is dead and its terminating event did not fail
+        (structs.go:2980 TaskState.Successful)."""
+        if self.state != TASK_STATE_DEAD:
+            return False
+        if not self.events:
+            return False
+        last = self.events[-1]
+        return last.type == TASK_TERMINATED and last.exit_code == 0
+
+
+# ---------------------------------------------------------------------------
+# AllocMetric — user-visible placement forensics
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class AllocMetric:
+    """Placement forensics surfaced in alloc-status; the batched TPU kernel
+    must preserve this contract via side-output counters
+    (structs.go:4074-4172)."""
+
+    nodes_evaluated: int = 0
+    nodes_filtered: int = 0
+    nodes_available: Dict[str, int] = field(default_factory=dict)
+    class_filtered: Dict[str, int] = field(default_factory=dict)
+    constraint_filtered: Dict[str, int] = field(default_factory=dict)
+    nodes_exhausted: int = 0
+    class_exhausted: Dict[str, int] = field(default_factory=dict)
+    dimension_exhausted: Dict[str, int] = field(default_factory=dict)
+    scores: Dict[str, float] = field(default_factory=dict)
+    allocation_time: float = 0.0
+    coalesced_failures: int = 0
+
+    def copy(self) -> "AllocMetric":
+        m = dataclasses.replace(self)
+        m.nodes_available = dict(self.nodes_available)
+        m.class_filtered = dict(self.class_filtered)
+        m.constraint_filtered = dict(self.constraint_filtered)
+        m.class_exhausted = dict(self.class_exhausted)
+        m.dimension_exhausted = dict(self.dimension_exhausted)
+        m.scores = dict(self.scores)
+        return m
+
+    def evaluate_node(self) -> None:
+        self.nodes_evaluated += 1
+
+    def filter_node(self, node: Optional[Node], constraint: str) -> None:
+        self.nodes_filtered += 1
+        if node is not None and node.node_class:
+            self.class_filtered[node.node_class] = self.class_filtered.get(node.node_class, 0) + 1
+        if constraint:
+            self.constraint_filtered[constraint] = self.constraint_filtered.get(constraint, 0) + 1
+
+    def exhausted_node(self, node: Optional[Node], dimension: str) -> None:
+        self.nodes_exhausted += 1
+        if node is not None and node.node_class:
+            self.class_exhausted[node.node_class] = self.class_exhausted.get(node.node_class, 0) + 1
+        if dimension:
+            self.dimension_exhausted[dimension] = self.dimension_exhausted.get(dimension, 0) + 1
+
+    def score_node(self, node: Node, name: str, score: float) -> None:
+        key = f"{node.id}.{name}"
+        self.scores[key] = self.scores.get(key, 0.0) + score
+
+
+# ---------------------------------------------------------------------------
+# Allocation
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Allocation:
+    """A placed task group on a node (structs.go:3820-4070)."""
+
+    id: str = ""
+    eval_id: str = ""
+    name: str = ""
+    node_id: str = ""
+    job_id: str = ""
+    job: Optional[Job] = None
+    task_group: str = ""
+    resources: Optional[Resources] = None
+    shared_resources: Optional[Resources] = None
+    task_resources: Dict[str, Resources] = field(default_factory=dict)
+    metrics: Optional[AllocMetric] = None
+    desired_status: str = ALLOC_DESIRED_STATUS_RUN
+    desired_description: str = ""
+    client_status: str = ALLOC_CLIENT_STATUS_PENDING
+    client_description: str = ""
+    task_states: Dict[str, TaskState] = field(default_factory=dict)
+    previous_allocation: str = ""
+    create_index: int = 0
+    modify_index: int = 0
+    alloc_modify_index: int = 0
+    create_time: float = 0.0
+
+    def copy(self) -> "Allocation":
+        a = dataclasses.replace(self)
+        a.job = self.job.copy() if self.job else None
+        a.resources = self.resources.copy() if self.resources else None
+        a.shared_resources = self.shared_resources.copy() if self.shared_resources else None
+        a.task_resources = {k: v.copy() for k, v in self.task_resources.items()}
+        a.metrics = self.metrics.copy() if self.metrics else None
+        a.task_states = {k: v.copy() for k, v in self.task_states.items()}
+        return a
+
+    def terminal_status(self) -> bool:
+        """Desired stop/evict, else terminal client status (structs.go:3945)."""
+        if self.desired_status in (ALLOC_DESIRED_STATUS_STOP, ALLOC_DESIRED_STATUS_EVICT):
+            return True
+        return self.client_status in (
+            ALLOC_CLIENT_STATUS_COMPLETE,
+            ALLOC_CLIENT_STATUS_FAILED,
+            ALLOC_CLIENT_STATUS_LOST,
+        )
+
+    def client_terminal_status(self) -> bool:
+        return self.client_status in (
+            ALLOC_CLIENT_STATUS_COMPLETE,
+            ALLOC_CLIENT_STATUS_FAILED,
+            ALLOC_CLIENT_STATUS_LOST,
+        )
+
+    def ran_successfully(self) -> bool:
+        """All task states finished successfully (structs.go:3974)."""
+        if not self.task_states:
+            return False
+        return all(ts.successful() for ts in self.task_states.values())
+
+    def stub(self) -> "AllocListStub":
+        return AllocListStub(
+            id=self.id,
+            eval_id=self.eval_id,
+            name=self.name,
+            node_id=self.node_id,
+            job_id=self.job_id,
+            task_group=self.task_group,
+            desired_status=self.desired_status,
+            desired_description=self.desired_description,
+            client_status=self.client_status,
+            client_description=self.client_description,
+            task_states={k: v.copy() for k, v in self.task_states.items()},
+            create_index=self.create_index,
+            modify_index=self.modify_index,
+            create_time=self.create_time,
+        )
+
+
+@dataclass
+class AllocListStub:
+    """Lightweight allocation view for list endpoints (structs.go:4044)."""
+
+    id: str = ""
+    eval_id: str = ""
+    name: str = ""
+    node_id: str = ""
+    job_id: str = ""
+    task_group: str = ""
+    desired_status: str = ""
+    desired_description: str = ""
+    client_status: str = ""
+    client_description: str = ""
+    task_states: Dict[str, TaskState] = field(default_factory=dict)
+    create_index: int = 0
+    modify_index: int = 0
+    create_time: float = 0.0
+
+
+# ---------------------------------------------------------------------------
+# Evaluation
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Evaluation:
+    """A scheduling work item: 'job X needs reconciling' (structs.go:4244-4475)."""
+
+    id: str = ""
+    priority: int = JOB_DEFAULT_PRIORITY
+    type: str = JOB_TYPE_SERVICE
+    triggered_by: str = ""
+    job_id: str = ""
+    job_modify_index: int = 0
+    node_id: str = ""
+    node_modify_index: int = 0
+    status: str = EVAL_STATUS_PENDING
+    status_description: str = ""
+    wait: float = 0.0  # seconds to delay before processing
+    next_eval: str = ""
+    previous_eval: str = ""
+    blocked_eval: str = ""
+    failed_tg_allocs: Dict[str, AllocMetric] = field(default_factory=dict)
+    class_eligibility: Dict[str, bool] = field(default_factory=dict)
+    escaped_computed_class: bool = False
+    annotate_plan: bool = False
+    queued_allocations: Dict[str, int] = field(default_factory=dict)
+    snapshot_index: int = 0
+    create_index: int = 0
+    modify_index: int = 0
+
+    def copy(self) -> "Evaluation":
+        e = dataclasses.replace(self)
+        e.failed_tg_allocs = {k: v.copy() for k, v in self.failed_tg_allocs.items()}
+        e.class_eligibility = dict(self.class_eligibility)
+        e.queued_allocations = dict(self.queued_allocations)
+        return e
+
+    def terminal_status(self) -> bool:
+        return self.status in (EVAL_STATUS_COMPLETE, EVAL_STATUS_FAILED, EVAL_STATUS_CANCELLED)
+
+    def should_enqueue(self) -> bool:
+        """Whether the eval belongs in the broker's ready queue (structs.go:4404)."""
+        return self.status == EVAL_STATUS_PENDING
+
+    def should_block(self) -> bool:
+        return self.status == EVAL_STATUS_BLOCKED
+
+    def make_plan(self, job: Optional[Job]) -> "Plan":
+        """Create an empty plan for this eval (structs.go:4418 MakePlan)."""
+        plan = Plan(
+            eval_id=self.id,
+            priority=self.priority,
+            job=job,
+            node_update={},
+            node_allocation={},
+        )
+        if job is not None:
+            plan.all_at_once = job.all_at_once
+        return plan
+
+    def next_rolling_eval(self, wait: float) -> "Evaluation":
+        """Follow-up eval for a rolling update (structs.go:4440)."""
+        return Evaluation(
+            id=generate_uuid(),
+            priority=self.priority,
+            type=self.type,
+            triggered_by=EVAL_TRIGGER_ROLLING_UPDATE,
+            job_id=self.job_id,
+            job_modify_index=self.job_modify_index,
+            status=EVAL_STATUS_PENDING,
+            wait=wait,
+            previous_eval=self.id,
+        )
+
+    def create_blocked_eval(self, class_eligibility: Dict[str, bool],
+                            escaped: bool) -> "Evaluation":
+        """Blocked eval to retry placement when capacity appears
+        (structs.go:4494 CreateBlockedEval)."""
+        return Evaluation(
+            id=generate_uuid(),
+            priority=self.priority,
+            type=self.type,
+            triggered_by=self.triggered_by,
+            job_id=self.job_id,
+            job_modify_index=self.job_modify_index,
+            status=EVAL_STATUS_BLOCKED,
+            previous_eval=self.id,
+            class_eligibility=class_eligibility,
+            escaped_computed_class=escaped,
+        )
+
+    def create_failed_follow_up_eval(self, wait: float) -> "Evaluation":
+        """Follow-up after hitting the delivery limit (structs.go:4460)."""
+        return Evaluation(
+            id=generate_uuid(),
+            priority=self.priority,
+            type=self.type,
+            triggered_by="failed-follow-up",
+            job_id=self.job_id,
+            job_modify_index=self.job_modify_index,
+            status=EVAL_STATUS_PENDING,
+            wait=wait,
+            previous_eval=self.id,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Plan
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Plan:
+    """The scheduler's proposed state mutation, submitted for optimistic
+    apply (structs.go:4477-4570)."""
+
+    eval_id: str = ""
+    eval_token: str = ""
+    priority: int = 0
+    all_at_once: bool = False
+    job: Optional[Job] = None
+    node_update: Dict[str, List[Allocation]] = field(default_factory=dict)
+    node_allocation: Dict[str, List[Allocation]] = field(default_factory=dict)
+    annotations: Optional["PlanAnnotations"] = None
+
+    def append_update(
+        self,
+        alloc: Allocation,
+        desired_status: str,
+        desired_description: str,
+        client_status: str = "",
+    ) -> None:
+        """Mark an existing alloc for stop/evict (structs.go:4520 AppendUpdate).
+
+        If the plan has no job (job deregistration) the alloc's job is adopted
+        so the applier can identify what is being stopped; the staged update
+        itself is normalized (job + combined resources stripped)."""
+        new_alloc = alloc.copy()
+        if self.job is None and new_alloc.job is not None:
+            self.job = new_alloc.job
+        new_alloc.job = None
+        new_alloc.resources = None
+        new_alloc.desired_status = desired_status
+        new_alloc.desired_description = desired_description
+        if client_status:
+            new_alloc.client_status = client_status
+        self.node_update.setdefault(alloc.node_id, []).append(new_alloc)
+
+    def pop_update(self, alloc: Allocation) -> None:
+        """Remove a staged eviction (used by in-place update speculation,
+        structs.go:4546 PopUpdate)."""
+        updates = self.node_update.get(alloc.node_id, [])
+        if updates and updates[-1].id == alloc.id:
+            updates.pop()
+            if not updates:
+                self.node_update.pop(alloc.node_id, None)
+
+    def append_alloc(self, alloc: Allocation) -> None:
+        self.node_allocation.setdefault(alloc.node_id, []).append(alloc)
+
+    def is_no_op(self) -> bool:
+        return not self.node_update and not self.node_allocation
+
+    def total_allocs(self) -> int:
+        return sum(len(v) for v in self.node_allocation.values()) + sum(
+            len(v) for v in self.node_update.values())
+
+
+@dataclass
+class PlanResult:
+    """The subset of a plan the leader committed (structs.go:4581-4620)."""
+
+    node_update: Dict[str, List[Allocation]] = field(default_factory=dict)
+    node_allocation: Dict[str, List[Allocation]] = field(default_factory=dict)
+    refresh_index: int = 0
+    alloc_index: int = 0
+
+    def full_commit(self, plan: Plan) -> tuple[bool, int, int]:
+        """Whether every proposed alloc was committed (structs.go:4604)."""
+        expected = 0
+        actual = 0
+        for node, allocs in plan.node_update.items():
+            expected += len(allocs)
+            actual += len(self.node_update.get(node, []))
+        for node, allocs in plan.node_allocation.items():
+            expected += len(allocs)
+            actual += len(self.node_allocation.get(node, []))
+        return actual == expected, expected, actual
+
+
+@dataclass
+class PlanAnnotations:
+    """Dry-run plan diff annotations for the plan CLI (structs.go:4625)."""
+
+    desired_tg_updates: Dict[str, "DesiredUpdates"] = field(default_factory=dict)
+
+
+@dataclass
+class DesiredUpdates:
+    ignore: int = 0
+    place: int = 0
+    migrate: int = 0
+    stop: int = 0
+    in_place_update: int = 0
+    destructive_update: int = 0
+
+
+# ---------------------------------------------------------------------------
+# Job summary
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TaskGroupSummary:
+    """Per-TG alloc status counts (structs.go:1680-1700)."""
+
+    queued: int = 0
+    complete: int = 0
+    failed: int = 0
+    running: int = 0
+    starting: int = 0
+    lost: int = 0
+
+
+@dataclass
+class JobSummary:
+    """Materialized per-job alloc summary (structs.go:1640-1678)."""
+
+    job_id: str = ""
+    summary: Dict[str, TaskGroupSummary] = field(default_factory=dict)
+    children: Optional["JobChildrenSummary"] = None
+    create_index: int = 0
+    modify_index: int = 0
+
+    def copy(self) -> "JobSummary":
+        s = dataclasses.replace(self)
+        s.summary = {k: dataclasses.replace(v) for k, v in self.summary.items()}
+        s.children = dataclasses.replace(self.children) if self.children else None
+        return s
+
+
+@dataclass
+class JobChildrenSummary:
+    pending: int = 0
+    running: int = 0
+    dead: int = 0
+
+
+def now() -> float:
+    return time.time()

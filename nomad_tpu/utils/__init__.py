@@ -1,0 +1,1 @@
+"""Shared helpers (reference: helper/)."""

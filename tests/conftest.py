@@ -1,0 +1,18 @@
+"""Test configuration.
+
+Force JAX onto a virtual 8-device CPU platform so multi-chip sharding tests
+(`shard_map` over a Mesh) run without TPU hardware, per the reference test
+strategy of simulating multi-node in-process (SURVEY.md §4 item 3).
+Must run before jax is imported anywhere.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

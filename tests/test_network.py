@@ -1,0 +1,154 @@
+"""L0 tests: NetworkIndex port/bandwidth accounting
+(reference: nomad/structs/network_test.go)."""
+import random
+
+from nomad_tpu import mock
+from nomad_tpu.structs import structs as s
+from nomad_tpu.structs.bitmap import Bitmap
+from nomad_tpu.structs.network import (
+    MAX_DYNAMIC_PORT,
+    MIN_DYNAMIC_PORT,
+    NetworkIndex,
+)
+
+
+class TestBitmap:
+    def test_set_check_clear(self):
+        b = Bitmap(256)
+        assert not b.check(42)
+        b.set(42)
+        assert b.check(42)
+        b.clear()
+        assert not b.check(42)
+
+    def test_indexes_in_range(self):
+        b = Bitmap(64)
+        b.set(5)
+        b.set(10)
+        assert b.indexes_in_range(True, 0, 63) == [5, 10]
+        free = b.indexes_in_range(False, 4, 11)
+        assert free == [4, 6, 7, 8, 9, 11]
+
+    def test_copy_independent(self):
+        b = Bitmap(64)
+        b.set(1)
+        c = b.copy()
+        c.set(2)
+        assert not b.check(2)
+        assert c.check(1)
+
+
+class TestNetworkIndex:
+    def test_set_node(self):
+        idx = NetworkIndex()
+        collide = idx.set_node(mock.node())
+        assert not collide
+        assert idx.avail_bandwidth["eth0"] == 1000
+        assert idx.used_bandwidth["eth0"] == 1
+        assert idx.used_ports["192.168.0.100"].check(22)
+
+    def test_add_reserved_collision(self):
+        idx = NetworkIndex()
+        net = s.NetworkResource(
+            device="eth0", ip="10.0.0.1",
+            reserved_ports=[s.Port("a", 8000)], mbits=10,
+        )
+        assert not idx.add_reserved(net)
+        assert idx.add_reserved(net)  # same port again → collision
+
+    def test_overcommitted(self):
+        idx = NetworkIndex()
+        idx.set_node(mock.node())
+        idx.add_reserved(s.NetworkResource(device="eth0", ip="10.0.0.1", mbits=2000))
+        assert idx.overcommitted()
+
+    def test_assign_network_reserved(self):
+        idx = NetworkIndex()
+        idx.set_node(mock.node())
+        ask = s.NetworkResource(mbits=50, reserved_ports=[s.Port("main", 8000)])
+        offer, err = idx.assign_network(ask, random.Random(1))
+        assert offer is not None, err
+        assert offer.ip == "192.168.0.100"
+        assert [p.value for p in offer.reserved_ports] == [8000]
+
+    def test_assign_network_reserved_collision(self):
+        idx = NetworkIndex()
+        idx.set_node(mock.node())
+        ask = s.NetworkResource(mbits=50, reserved_ports=[s.Port("ssh", 22)])
+        offer, err = idx.assign_network(ask, random.Random(1))
+        assert offer is None
+        assert err == "reserved port collision"
+
+    def test_assign_network_dynamic(self):
+        idx = NetworkIndex()
+        idx.set_node(mock.node())
+        ask = s.NetworkResource(mbits=50, dynamic_ports=[s.Port("http"), s.Port("admin")])
+        offer, err = idx.assign_network(ask, random.Random(1))
+        assert offer is not None, err
+        vals = [p.value for p in offer.dynamic_ports]
+        assert len(set(vals)) == 2
+        for v in vals:
+            assert MIN_DYNAMIC_PORT <= v <= MAX_DYNAMIC_PORT
+
+    def test_assign_network_bandwidth_exceeded(self):
+        idx = NetworkIndex()
+        idx.set_node(mock.node())
+        ask = s.NetworkResource(mbits=5000)
+        offer, err = idx.assign_network(ask, random.Random(1))
+        assert offer is None
+        assert err == "bandwidth exceeded"
+
+    def test_precise_fallback_when_ports_dense(self):
+        """Occupy almost the whole dynamic range; precise scan still finds
+        the free ports (network.go:288 getDynamicPortsPrecise)."""
+        idx = NetworkIndex()
+        node = mock.node()
+        idx.set_node(node)
+        used = idx.used_ports["192.168.0.100"]
+        for port in range(MIN_DYNAMIC_PORT, MAX_DYNAMIC_PORT + 1):
+            if port not in (30100, 30101):
+                used.set(port)
+        ask = s.NetworkResource(mbits=1, dynamic_ports=[s.Port("a"), s.Port("b")])
+        offer, err = idx.assign_network(ask, random.Random(1))
+        assert offer is not None, err
+        assert sorted(p.value for p in offer.dynamic_ports) == [30100, 30101]
+
+    def test_add_allocs(self):
+        idx = NetworkIndex()
+        idx.set_node(mock.node())
+        a = mock.alloc()
+        assert not idx.add_allocs([a])
+        assert idx.used_ports["192.168.0.100"].check(5000)
+
+
+class TestComputedClass:
+    def test_same_attrs_same_class(self):
+        n1, n2 = mock.node(), mock.node()
+        assert n1.computed_class == n2.computed_class
+
+    def test_unique_attrs_excluded(self):
+        n1, n2 = mock.node(), mock.node()
+        n2.attributes["unique.hostname"] = "different"
+        n2.compute_class()
+        assert n1.computed_class == n2.computed_class
+
+    def test_non_unique_attr_changes_class(self):
+        n1, n2 = mock.node(), mock.node()
+        n2.attributes["kernel.name"] = "windows"
+        n2.compute_class()
+        assert n1.computed_class != n2.computed_class
+
+    def test_meta_changes_class(self):
+        n1, n2 = mock.node(), mock.node()
+        n2.meta["database"] = "postgres"
+        n2.compute_class()
+        assert n1.computed_class != n2.computed_class
+
+    def test_escaped_constraints(self):
+        from nomad_tpu.structs.node_class import escaped_constraints
+
+        c1 = s.Constraint("${attr.kernel.name}", "linux", "=")
+        c2 = s.Constraint("${node.unique.id}", "x", "=")
+        c3 = s.Constraint("${meta.unique.foo}", "y", "=")
+        out = escaped_constraints([c1, c2, c3])
+        assert out == [c2, c3]

@@ -1,0 +1,159 @@
+"""L0 tests: fit & scoring functions (reference: nomad/structs/funcs_test.go)."""
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.structs import structs as s
+from nomad_tpu.structs.funcs import (
+    allocs_fit,
+    filter_terminal_allocs,
+    remove_allocs,
+    score_fit,
+)
+
+
+def _bare_node(cpu=2000, mem=2048, disk=10000, iops=100):
+    return s.Node(
+        id=s.generate_uuid(),
+        resources=s.Resources(cpu=cpu, memory_mb=mem, disk_mb=disk, iops=iops),
+        status=s.NODE_STATUS_READY,
+    )
+
+
+def _alloc_with(cpu, mem, disk=0, iops=0):
+    return s.Allocation(
+        id=s.generate_uuid(),
+        resources=s.Resources(cpu=cpu, memory_mb=mem, disk_mb=disk, iops=iops),
+    )
+
+
+class TestRemoveAllocs:
+    def test_removes_by_id(self):
+        a1, a2, a3 = _alloc_with(1, 1), _alloc_with(2, 2), _alloc_with(3, 3)
+        out = remove_allocs([a1, a2, a3], [a2])
+        assert [a.id for a in out] == [a1.id, a3.id]
+
+    def test_empty_remove(self):
+        a1 = _alloc_with(1, 1)
+        assert remove_allocs([a1], []) == [a1]
+
+
+class TestFilterTerminalAllocs:
+    def test_splits_terminal(self):
+        live = _alloc_with(1, 1)
+        dead = _alloc_with(2, 2)
+        dead.name = "x"
+        dead.desired_status = s.ALLOC_DESIRED_STATUS_STOP
+        out, terminal = filter_terminal_allocs([live, dead])
+        assert out == [live]
+        assert terminal["x"] is dead
+
+    def test_keeps_latest_terminal_per_name(self):
+        old = _alloc_with(1, 1)
+        old.name = "x"
+        old.desired_status = s.ALLOC_DESIRED_STATUS_STOP
+        old.create_index = 5
+        new = _alloc_with(1, 1)
+        new.name = "x"
+        new.desired_status = s.ALLOC_DESIRED_STATUS_STOP
+        new.create_index = 10
+        _, terminal = filter_terminal_allocs([old, new])
+        assert terminal["x"] is new
+
+    def test_client_status_terminal(self):
+        a = _alloc_with(1, 1)
+        a.client_status = s.ALLOC_CLIENT_STATUS_FAILED
+        out, _ = filter_terminal_allocs([a])
+        assert out == []
+
+
+class TestAllocsFit:
+    def test_fits(self):
+        node = _bare_node()
+        fit, dim, used = allocs_fit(node, [_alloc_with(1000, 1024)])
+        assert fit, dim
+        assert used.cpu == 1000
+        assert used.memory_mb == 1024
+
+    def test_cpu_exhausted(self):
+        node = _bare_node(cpu=500)
+        fit, dim, _ = allocs_fit(node, [_alloc_with(1000, 100)])
+        assert not fit
+        assert dim == "cpu exhausted"
+
+    def test_memory_exhausted(self):
+        node = _bare_node(mem=100)
+        fit, dim, _ = allocs_fit(node, [_alloc_with(100, 1000)])
+        assert not fit
+        assert dim == "memory exhausted"
+
+    def test_reserved_counts(self):
+        node = _bare_node(cpu=1000)
+        node.reserved = s.Resources(cpu=600)
+        fit, dim, _ = allocs_fit(node, [_alloc_with(500, 10)])
+        assert not fit
+        assert dim == "cpu exhausted"
+
+    def test_task_resources_summed(self):
+        node = _bare_node()
+        a = s.Allocation(
+            id=s.generate_uuid(),
+            shared_resources=s.Resources(disk_mb=100),
+            task_resources={
+                "a": s.Resources(cpu=300, memory_mb=100),
+                "b": s.Resources(cpu=400, memory_mb=200),
+            },
+        )
+        fit, _, used = allocs_fit(node, [a])
+        assert fit
+        assert used.cpu == 700
+        assert used.memory_mb == 300
+        assert used.disk_mb == 100
+
+    def test_no_resources_raises(self):
+        node = _bare_node()
+        with pytest.raises(ValueError):
+            allocs_fit(node, [s.Allocation(id="x")])
+
+    def test_mock_node_port_collision(self):
+        """Two allocs reserving the same port on the same IP collide."""
+        node = mock.node()
+        a1 = mock.alloc()
+        a2 = mock.alloc()
+        # strip combined resources so task_resources (with ports) are used
+        a1.resources = None
+        a2.resources = None
+        fit, dim, _ = allocs_fit(node, [a1, a2])
+        assert not fit
+        assert dim == "reserved port collision"
+
+
+class TestScoreFit:
+    def test_perfect_fit_scores_18(self):
+        node = _bare_node(cpu=4096, mem=8192)
+        util = s.Resources(cpu=4096, memory_mb=8192)
+        assert score_fit(node, util) == pytest.approx(18.0)
+
+    def test_empty_node_scores_0(self):
+        node = _bare_node(cpu=4096, mem=8192)
+        assert score_fit(node, s.Resources()) == pytest.approx(0.0)
+
+    def test_half_fit(self):
+        node = _bare_node(cpu=4096, mem=8192)
+        util = s.Resources(cpu=2048, memory_mb=4096)
+        # 20 - 2*10^0.5
+        assert score_fit(node, util) == pytest.approx(20.0 - 2 * 10 ** 0.5)
+
+    def test_reserved_shrinks_capacity(self):
+        node = _bare_node(cpu=2000, mem=2000)
+        node.reserved = s.Resources(cpu=1000, memory_mb=1000)
+        util = s.Resources(cpu=1000, memory_mb=1000)
+        # free fraction = 1 - 1000/1000 = 0 → perfect fit → 18
+        assert score_fit(node, util) == pytest.approx(18.0)
+
+    def test_monotonic_in_utilization(self):
+        node = _bare_node(cpu=4000, mem=4000)
+        scores = [
+            score_fit(node, s.Resources(cpu=c, memory_mb=c))
+            for c in (0, 1000, 2000, 3000, 4000)
+        ]
+        assert scores == sorted(scores)
