@@ -23,7 +23,3 @@ Layers (bottom-up, mirroring SURVEY.md §1):
 """
 
 __version__ = "0.1.0"
-
-# Scheduler algorithm version — plans produced by a different major version
-# are rejected at plan-apply time (reference: scheduler/scheduler.go:16).
-SCHEDULER_VERSION = 1

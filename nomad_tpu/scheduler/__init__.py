@@ -1,0 +1,32 @@
+"""L4 scheduler: the CPU oracle implementation of placement logic
+(reference: scheduler/).
+
+The factory registry mirrors BuiltinSchedulers (scheduler.go:21-25):
+service, batch, system — plus ``tpu-batch`` (registered by
+nomad_tpu.ops.batch_sched when imported) which drains evals into batched
+tensor kernels.
+"""
+
+from ..structs import structs as _s
+from .context import ComputedClassFeasibility, EvalContext, EvalEligibility
+from .generic import (
+    GenericScheduler,
+    new_batch_scheduler,
+    new_service_scheduler,
+)
+from .scheduler import (
+    SCHEDULER_VERSION,
+    Planner,
+    Scheduler,
+    State,
+    builtin_schedulers,
+    new_scheduler,
+    register_scheduler,
+)
+from .stack import GenericStack, SystemStack
+from .system import SystemScheduler, new_system_scheduler
+from .testing import Harness, RejectPlan
+
+register_scheduler(_s.JOB_TYPE_SERVICE, new_service_scheduler)
+register_scheduler(_s.JOB_TYPE_BATCH, new_batch_scheduler)
+register_scheduler(_s.JOB_TYPE_SYSTEM, new_system_scheduler)

@@ -1,0 +1,171 @@
+"""Ranking iterators: bin-pack scoring and job anti-affinity
+(reference: scheduler/rank.go).
+
+The TPU analogue computes ``S[tg, node] = score_fit(free_after) −
+penalty·collisions`` for the full matrix at once (nomad_tpu/ops/scoring.py);
+this module is the per-node oracle.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..structs import structs as s
+from ..structs.funcs import allocs_fit, score_fit
+from ..structs.network import NetworkIndex
+from .context import EvalContext
+
+
+class RankedNode:
+    """A node plus its accumulated score and per-task resources
+    (rank.go:12-45)."""
+
+    __slots__ = ("node", "score", "task_resources", "proposed")
+
+    def __init__(self, node: s.Node):
+        self.node = node
+        self.score = 0.0
+        self.task_resources: Dict[str, s.Resources] = {}
+        self.proposed: Optional[List[s.Allocation]] = None
+
+    def __repr__(self) -> str:
+        return f"<Node: {self.node.id} Score: {self.score:.3f}>"
+
+    def proposed_allocs(self, ctx: EvalContext) -> List[s.Allocation]:
+        if self.proposed is None:
+            self.proposed = ctx.proposed_allocs(self.node.id)
+        return self.proposed
+
+    def set_task_resources(self, task: s.Task, resources: s.Resources) -> None:
+        self.task_resources[task.name] = resources
+
+
+class FeasibleRankIterator:
+    """Upgrades a feasible iterator into the ranking chain (rank.go:60)."""
+
+    def __init__(self, ctx: EvalContext, source):
+        self.ctx = ctx
+        self.source = source
+
+    def next_option(self) -> Optional[RankedNode]:
+        option = self.source.next_option()
+        if option is None:
+            return None
+        return RankedNode(option)
+
+    def reset(self) -> None:
+        self.source.reset()
+
+
+class StaticRankIterator:
+    """Yields a fixed list of ranked nodes; used in tests (rank.go:91)."""
+
+    def __init__(self, ctx: EvalContext, nodes: List[RankedNode]):
+        self.ctx = ctx
+        self.nodes = nodes
+        self.offset = 0
+        self.seen = 0
+
+    def next_option(self) -> Optional[RankedNode]:
+        n = len(self.nodes)
+        if self.offset == n or self.seen == n:
+            if self.seen != n:
+                self.offset = 0
+            else:
+                return None
+        option = self.nodes[self.offset]
+        self.offset += 1
+        self.seen += 1
+        return option
+
+    def reset(self) -> None:
+        self.seen = 0
+
+
+class BinPackIterator:
+    """Scores nodes by best-fit bin packing after assigning task networks
+    (rank.go:130-240)."""
+
+    def __init__(self, ctx: EvalContext, source, evict: bool, priority: int):
+        self.ctx = ctx
+        self.source = source
+        self.evict = evict  # reserved; eviction unimplemented in reference too
+        self.priority = priority
+        self.task_group: Optional[s.TaskGroup] = None
+
+    def set_priority(self, priority: int) -> None:
+        self.priority = priority
+
+    def set_task_group(self, tg: s.TaskGroup) -> None:
+        self.task_group = tg
+
+    def next_option(self) -> Optional[RankedNode]:
+        while True:
+            option = self.source.next_option()
+            if option is None:
+                return None
+
+            proposed = option.proposed_allocs(self.ctx)
+
+            net_idx = NetworkIndex()
+            net_idx.set_node(option.node)
+            net_idx.add_allocs(proposed)
+
+            total = s.Resources(disk_mb=self.task_group.ephemeral_disk.size_mb)
+            network_ok = True
+            for task in self.task_group.tasks:
+                task_resources = task.resources.copy()
+                if task_resources.networks:
+                    ask = task_resources.networks[0]
+                    offer, err = net_idx.assign_network(ask, self.ctx.rng)
+                    if offer is None:
+                        self.ctx.metrics.exhausted_node(option.node, f"network: {err}")
+                        network_ok = False
+                        break
+                    net_idx.add_reserved(offer)
+                    task_resources.networks = [offer]
+                option.set_task_resources(task, task_resources)
+                total.add(task_resources)
+            if not network_ok:
+                continue
+
+            candidate = proposed + [s.Allocation(id="_binpack_probe", resources=total)]
+            fit, dim, util = allocs_fit(option.node, candidate, net_idx)
+            if not fit:
+                self.ctx.metrics.exhausted_node(option.node, dim)
+                continue
+
+            fitness = score_fit(option.node, util)
+            option.score += fitness
+            self.ctx.metrics.score_node(option.node, "binpack", fitness)
+            return option
+
+    def reset(self) -> None:
+        self.source.reset()
+
+
+class JobAntiAffinityIterator:
+    """Penalizes nodes already running allocs of this job (rank.go:247-306)."""
+
+    def __init__(self, ctx: EvalContext, source, penalty: float, job_id: str):
+        self.ctx = ctx
+        self.source = source
+        self.penalty = penalty
+        self.job_id = job_id
+
+    def set_job(self, job_id: str) -> None:
+        self.job_id = job_id
+
+    def next_option(self) -> Optional[RankedNode]:
+        option = self.source.next_option()
+        if option is None:
+            return None
+        proposed = option.proposed_allocs(self.ctx)
+        collisions = sum(1 for alloc in proposed if alloc.job_id == self.job_id)
+        if collisions > 0:
+            penalty = -1.0 * collisions * self.penalty
+            option.score += penalty
+            self.ctx.metrics.score_node(option.node, "job-anti-affinity", penalty)
+        return option
+
+    def reset(self) -> None:
+        self.source.reset()

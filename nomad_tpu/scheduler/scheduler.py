@@ -1,0 +1,78 @@
+"""L4 scheduler interfaces and factory.
+
+Behavioral parity with reference scheduler/scheduler.go:16-104: a factory
+registry keyed by eval type, plus the State and Planner interfaces that keep
+the scheduler plumbing-free (it sees only an immutable state snapshot and a
+planner to submit plans through).
+
+This package is the **CPU oracle**: an exact re-implementation of the
+reference's placement semantics used (a) standalone for small clusters and
+(b) as the differential-test oracle for the TPU batch scheduler in
+nomad_tpu/ops/.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Optional, Protocol, Tuple
+
+from ..structs import structs as s
+
+# Identifies the version of the scheduling algorithm; plans from a different
+# major version are rejected at apply time (scheduler.go:16).
+SCHEDULER_VERSION = 1
+
+
+class State(Protocol):
+    """The immutable world view the scheduler works from
+    (scheduler.go:63-82)."""
+
+    def nodes(self, ws) -> List[s.Node]: ...
+
+    def node_by_id(self, ws, node_id: str) -> Optional[s.Node]: ...
+
+    def allocs_by_job(self, ws, job_id: str, all_allocs: bool = False) -> List[s.Allocation]: ...
+
+    def allocs_by_node(self, ws, node_id: str) -> List[s.Allocation]: ...
+
+    def allocs_by_node_terminal(self, ws, node_id: str, terminal: bool) -> List[s.Allocation]: ...
+
+    def job_by_id(self, ws, job_id: str) -> Optional[s.Job]: ...
+
+
+class Planner(Protocol):
+    """How the scheduler submits its decisions (scheduler.go:84-104)."""
+
+    def submit_plan(self, plan: s.Plan) -> Tuple[Optional[s.PlanResult], Optional[State]]:
+        """Returns (result, refreshed_state_or_None)."""
+        ...
+
+    def update_eval(self, ev: s.Evaluation) -> None: ...
+
+    def create_eval(self, ev: s.Evaluation) -> None: ...
+
+    def reblock_eval(self, ev: s.Evaluation) -> None: ...
+
+
+class Scheduler(Protocol):
+    def process(self, ev: s.Evaluation) -> None: ...
+
+
+SchedulerFactory = Callable[[logging.Logger, State, Planner], Scheduler]
+
+_BUILTIN: Dict[str, SchedulerFactory] = {}
+
+
+def register_scheduler(name: str, factory: SchedulerFactory) -> None:
+    _BUILTIN[name] = factory
+
+
+def new_scheduler(name: str, logger: logging.Logger, state: State, planner: Planner) -> Scheduler:
+    """Instantiate a scheduler by eval type (scheduler.go:42 NewScheduler)."""
+    factory = _BUILTIN.get(name)
+    if factory is None:
+        raise ValueError(f"unknown scheduler {name!r}")
+    return factory(logger, state, planner)
+
+
+def builtin_schedulers() -> List[str]:
+    return list(_BUILTIN)

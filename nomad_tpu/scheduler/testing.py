@@ -1,0 +1,126 @@
+"""Scheduler test harness: a real StateStore plus a fake Planner that
+captures plans/evals and applies plans at synthetic raft indexes
+(reference: scheduler/testing.go:41-218).
+
+This is exactly the oracle interface the TPU batch kernel is
+differential-tested against (SURVEY.md §4)."""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, List, Optional, Tuple
+
+from ..state import StateStore
+from ..structs import structs as s
+
+
+class RejectPlan:
+    """A planner that rejects every plan and forces a state refresh,
+    exercising the refresh/retry path (testing.go:16)."""
+
+    def __init__(self, harness: "Harness"):
+        self.harness = harness
+
+    def submit_plan(self, plan: s.Plan):
+        result = s.PlanResult()
+        result.refresh_index = self.harness.next_index()
+        return result, self.harness.state
+
+    def update_eval(self, ev: s.Evaluation) -> None:
+        pass
+
+    def create_eval(self, ev: s.Evaluation) -> None:
+        pass
+
+    def reblock_eval(self, ev: s.Evaluation) -> None:
+        pass
+
+
+class Harness:
+    """Lightweight harness implementing the Planner interface."""
+
+    def __init__(self, state: Optional[StateStore] = None):
+        self.state = state or StateStore()
+        self.planner = None  # optional custom planner
+        self._plan_lock = threading.Lock()
+        self.plans: List[s.Plan] = []
+        self.evals: List[s.Evaluation] = []
+        self.create_evals: List[s.Evaluation] = []
+        self.reblock_evals: List[s.Evaluation] = []
+        self._next_index = 1
+        self._index_lock = threading.Lock()
+        self.logger = logging.getLogger("nomad_tpu.scheduler.harness")
+
+    # -- Planner interface -------------------------------------------------
+
+    def submit_plan(self, plan: s.Plan) -> Tuple[s.PlanResult, Optional[StateStore]]:
+        with self._plan_lock:
+            self.plans.append(plan)
+            if self.planner is not None:
+                return self.planner.submit_plan(plan)
+
+            index = self.next_index()
+            result = s.PlanResult(
+                node_update=plan.node_update,
+                node_allocation=plan.node_allocation,
+                alloc_index=index,
+            )
+
+            allocs: List[s.Allocation] = []
+            for update_list in plan.node_update.values():
+                allocs.extend(update_list)
+            for alloc_list in plan.node_allocation.values():
+                allocs.extend(alloc_list)
+
+            if plan.job is not None:
+                for alloc in allocs:
+                    if alloc.job is None:
+                        alloc.job = plan.job
+
+            self.state.upsert_allocs(index, allocs)
+            return result, None
+
+    def update_eval(self, ev: s.Evaluation) -> None:
+        with self._plan_lock:
+            self.evals.append(ev)
+            if self.planner is not None:
+                self.planner.update_eval(ev)
+
+    def create_eval(self, ev: s.Evaluation) -> None:
+        with self._plan_lock:
+            self.create_evals.append(ev)
+            if self.planner is not None:
+                self.planner.create_eval(ev)
+
+    def reblock_eval(self, ev: s.Evaluation) -> None:
+        with self._plan_lock:
+            old = self.state.eval_by_id(None, ev.id)
+            if old is None:
+                raise ValueError("evaluation does not exist to be reblocked")
+            if old.status != s.EVAL_STATUS_BLOCKED:
+                raise ValueError(
+                    f"evaluation {old.id!r} is not already in a blocked state")
+            self.reblock_evals.append(ev)
+
+    # -- helpers -----------------------------------------------------------
+
+    def next_index(self) -> int:
+        with self._index_lock:
+            idx = self._next_index
+            self._next_index += 1
+            return idx
+
+    def snapshot(self):
+        return self.state.snapshot()
+
+    def scheduler(self, factory: Callable):
+        return factory(self.logger, self.snapshot(), self)
+
+    def process(self, factory: Callable, ev: s.Evaluation) -> None:
+        sched = self.scheduler(factory)
+        sched.process(ev)
+
+    def assert_eval_status(self, status: str) -> None:
+        assert len(self.evals) == 1, f"expected exactly one eval update: {self.evals}"
+        assert self.evals[0].status == status, (
+            f"expected status {status}, got {self.evals[0].status}")

@@ -1,0 +1,10 @@
+"""L1 state store (reference: nomad/state/)."""
+
+from .state_store import (
+    JOB_TRACKED_VERSIONS,
+    PeriodicLaunch,
+    StateSnapshot,
+    StateStore,
+    VaultAccessor,
+    WatchSet,
+)

@@ -1,0 +1,917 @@
+"""L1 state store: the in-memory MVCC database behind the control plane.
+
+Behavioral parity with the reference StateStore over go-memdb
+(nomad/state/state_store.go:55-1880, schema nomad/state/schema.go:45-422):
+every table tracks a raft index, readers take snapshots, blocking queries
+wait on watchsets, and `upsert_plan_results` is how committed plans land.
+
+Design departure for the TPU build: instead of radix-tree MVCC we keep plain
+dict tables plus explicit secondary indexes, and `snapshot()` produces an
+O(tables) shallow-copied view — objects are treated as immutable once
+inserted (every write path inserts fresh copies), which gives the scheduler
+the same isolated world-view the reference gets from memdb.  The
+scheduler-visible subset (nodes, jobs, allocs-by-node/job, evals) is the
+sync boundary that ops/encode.py mirrors into device tensors.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..structs import structs as s
+from ..structs.funcs import filter_terminal_allocs
+
+# Number of historical job versions retained (reference: structs.go
+# JobTrackedVersions = 6).
+JOB_TRACKED_VERSIONS = 6
+
+
+@dataclass
+class PeriodicLaunch:
+    """Last launch time of a periodic job (reference: structs.go:4200 region)."""
+
+    id: str = ""
+    launch: float = 0.0
+    create_index: int = 0
+    modify_index: int = 0
+
+
+@dataclass
+class VaultAccessor:
+    """A derived Vault token accessor (reference: structs.go VaultAccessor)."""
+
+    accessor: str = ""
+    alloc_id: str = ""
+    node_id: str = ""
+    task: str = ""
+    creation_ttl: int = 0
+    create_index: int = 0
+
+
+class WatchSet:
+    """Collects watch subscriptions during a query; `watch` blocks until any
+    watched table changes (reference: go-memdb WatchSet + state/notify.go).
+
+    The granularity is per-table: any write to a watched table wakes the
+    watcher, which then re-runs its query and compares indexes — the same
+    re-run loop blockingRPC uses (nomad/rpc.go:340).  Each watch set owns an
+    Event registered with every watched store so a write to *any* of them
+    wakes the waiter.
+    """
+
+    def __init__(self) -> None:
+        self._entries: List[Tuple["StateStore", str, int]] = []
+        self._event = threading.Event()
+
+    def add(self, store: "StateStore", table: str) -> None:
+        self._entries.append((store, table, store.table_index(table)))
+        store._register_watcher(self._event)
+
+    def watch(self, timeout: Optional[float] = None) -> bool:
+        """Block until any watched table advances; True on timeout."""
+        if not self._entries:
+            return True
+        import time as _time
+
+        end = None if timeout is None else _time.monotonic() + timeout
+        try:
+            while True:
+                for st, table, idx in self._entries:
+                    if st.table_index(table) > idx:
+                        return False
+                remaining = None if end is None else end - _time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return True
+                self._event.clear()
+                # Re-register in case a store's notify cleared us out.
+                for st, _, _ in self._entries:
+                    st._register_watcher(self._event)
+                # Re-check after registration to close the race with a write
+                # that landed between the index check and registration.
+                if any(st.table_index(table) > idx for st, table, idx in self._entries):
+                    return False
+                self._event.wait(remaining)
+        finally:
+            for st, _, _ in self._entries:
+                st._unregister_watcher(self._event)
+
+
+class StateStore:
+    """The authoritative in-memory database of cluster state."""
+
+    TABLES = (
+        "nodes",
+        "jobs",
+        "job_summary",
+        "evals",
+        "allocs",
+        "periodic_launch",
+        "vault_accessors",
+    )
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._watchers: Set[threading.Event] = set()
+        self.nodes_table: Dict[str, s.Node] = {}
+        self.jobs_table: Dict[str, s.Job] = {}
+        self.job_versions: Dict[str, List[s.Job]] = {}
+        self.job_summary_table: Dict[str, s.JobSummary] = {}
+        self.evals_table: Dict[str, s.Evaluation] = {}
+        self.allocs_table: Dict[str, s.Allocation] = {}
+        self.periodic_launch_table: Dict[str, PeriodicLaunch] = {}
+        self.vault_accessors_table: Dict[str, VaultAccessor] = {}
+        self._indexes: Dict[str, int] = {}
+        # Secondary indexes (reference: schema.go secondary memdb indexes)
+        self._allocs_by_node: Dict[str, Set[str]] = defaultdict(set)
+        self._allocs_by_job: Dict[str, Set[str]] = defaultdict(set)
+        self._allocs_by_eval: Dict[str, Set[str]] = defaultdict(set)
+        self._evals_by_job: Dict[str, Set[str]] = defaultdict(set)
+        self._vault_by_alloc: Dict[str, Set[str]] = defaultdict(set)
+        self._vault_by_node: Dict[str, Set[str]] = defaultdict(set)
+
+    # -- snapshot ----------------------------------------------------------
+
+    def snapshot(self) -> "StateSnapshot":
+        """An immutable point-in-time view (state_store.go:55)."""
+        with self._lock:
+            snap = StateSnapshot.__new__(StateSnapshot)
+            snap._lock = threading.RLock()
+            snap._cond = threading.Condition(snap._lock)
+            snap._watchers = set()
+            snap.nodes_table = dict(self.nodes_table)
+            snap.jobs_table = dict(self.jobs_table)
+            snap.job_versions = {k: list(v) for k, v in self.job_versions.items()}
+            snap.job_summary_table = dict(self.job_summary_table)
+            snap.evals_table = dict(self.evals_table)
+            snap.allocs_table = dict(self.allocs_table)
+            snap.periodic_launch_table = dict(self.periodic_launch_table)
+            snap.vault_accessors_table = dict(self.vault_accessors_table)
+            snap._indexes = dict(self._indexes)
+            snap._allocs_by_node = defaultdict(set, {k: set(v) for k, v in self._allocs_by_node.items()})
+            snap._allocs_by_job = defaultdict(set, {k: set(v) for k, v in self._allocs_by_job.items()})
+            snap._allocs_by_eval = defaultdict(set, {k: set(v) for k, v in self._allocs_by_eval.items()})
+            snap._evals_by_job = defaultdict(set, {k: set(v) for k, v in self._evals_by_job.items()})
+            snap._vault_by_alloc = defaultdict(set, {k: set(v) for k, v in self._vault_by_alloc.items()})
+            snap._vault_by_node = defaultdict(set, {k: set(v) for k, v in self._vault_by_node.items()})
+            return snap
+
+    # -- index bookkeeping -------------------------------------------------
+
+    def _bump(self, table: str, index: int) -> None:
+        self._indexes[table] = index
+
+    def table_index(self, table: str) -> int:
+        with self._lock:
+            return self._indexes.get(table, 0)
+
+    def latest_index(self) -> int:
+        with self._lock:
+            return max(self._indexes.values(), default=0)
+
+    def _notify(self) -> None:
+        with self._cond:
+            self._cond.notify_all()
+            watchers, self._watchers = self._watchers, set()
+        for event in watchers:
+            event.set()
+
+    def _register_watcher(self, event: threading.Event) -> None:
+        with self._lock:
+            self._watchers.add(event)
+
+    def _unregister_watcher(self, event: threading.Event) -> None:
+        with self._lock:
+            self._watchers.discard(event)
+
+    # -- nodes -------------------------------------------------------------
+
+    def upsert_node(self, index: int, node: s.Node) -> None:
+        """(state_store.go:413) — preserves create_index on update."""
+        with self._lock:
+            existing = self.nodes_table.get(node.id)
+            node = node.copy()
+            if existing is not None:
+                node.create_index = existing.create_index
+            else:
+                node.create_index = index
+            node.modify_index = index
+            self.nodes_table[node.id] = node
+            self._bump("nodes", index)
+        self._notify()
+
+    def delete_node(self, index: int, node_id: str) -> None:
+        with self._lock:
+            if node_id not in self.nodes_table:
+                raise KeyError(f"node not found: {node_id}")
+            del self.nodes_table[node_id]
+            self._bump("nodes", index)
+        self._notify()
+
+    def update_node_status(self, index: int, node_id: str, status: str) -> None:
+        """(state_store.go:473)."""
+        with self._lock:
+            existing = self.nodes_table.get(node_id)
+            if existing is None:
+                raise KeyError(f"node not found: {node_id}")
+            node = existing.copy()
+            node.status = status
+            node.modify_index = index
+            self.nodes_table[node_id] = node
+            self._bump("nodes", index)
+        self._notify()
+
+    def update_node_drain(self, index: int, node_id: str, drain: bool) -> None:
+        """(state_store.go:508)."""
+        with self._lock:
+            existing = self.nodes_table.get(node_id)
+            if existing is None:
+                raise KeyError(f"node not found: {node_id}")
+            node = existing.copy()
+            node.drain = drain
+            node.modify_index = index
+            self.nodes_table[node_id] = node
+            self._bump("nodes", index)
+        self._notify()
+
+    def node_by_id(self, ws: Optional[WatchSet], node_id: str) -> Optional[s.Node]:
+        if ws is not None:
+            ws.add(self, "nodes")
+        with self._lock:
+            return self.nodes_table.get(node_id)
+
+    def nodes(self, ws: Optional[WatchSet] = None) -> List[s.Node]:
+        if ws is not None:
+            ws.add(self, "nodes")
+        with self._lock:
+            return list(self.nodes_table.values())
+
+    def nodes_by_id_prefix(self, ws: Optional[WatchSet], prefix: str) -> List[s.Node]:
+        if ws is not None:
+            ws.add(self, "nodes")
+        with self._lock:
+            return [n for nid, n in self.nodes_table.items() if nid.startswith(prefix)]
+
+    # -- jobs --------------------------------------------------------------
+
+    def upsert_job(self, index: int, job: s.Job) -> None:
+        """(state_store.go:585) — bumps version on change, keeps bounded
+        version history, maintains the job summary."""
+        with self._lock:
+            job = job.copy()
+            existing = self.jobs_table.get(job.id)
+            if existing is not None:
+                job.create_index = existing.create_index
+                job.modify_index = index
+                job.job_modify_index = index
+                job.version = existing.version + 1
+            else:
+                job.create_index = index
+                job.modify_index = index
+                job.job_modify_index = index
+                job.version = 0
+            job.status = self._get_job_status(job, eval_delete=False)
+
+            self._update_summary_with_job(index, job)
+            self._upsert_job_version(index, job)
+            self.jobs_table[job.id] = job
+            self._bump("jobs", index)
+        self._notify()
+
+    def _upsert_job_version(self, index: int, job: s.Job) -> None:
+        history = self.job_versions.setdefault(job.id, [])
+        history.insert(0, job)
+        history.sort(key=lambda j: -j.version)
+        del history[JOB_TRACKED_VERSIONS:]
+
+    def delete_job(self, index: int, job_id: str) -> None:
+        """(state_store.go:653) — removes job, versions, summary."""
+        with self._lock:
+            if job_id not in self.jobs_table:
+                raise KeyError(f"job not found: {job_id}")
+            del self.jobs_table[job_id]
+            self.job_versions.pop(job_id, None)
+            self.job_summary_table.pop(job_id, None)
+            self.periodic_launch_table.pop(job_id, None)
+            self._bump("jobs", index)
+            self._bump("job_summary", index)
+        self._notify()
+
+    def job_by_id(self, ws: Optional[WatchSet], job_id: str) -> Optional[s.Job]:
+        if ws is not None:
+            ws.add(self, "jobs")
+        with self._lock:
+            return self.jobs_table.get(job_id)
+
+    def jobs(self, ws: Optional[WatchSet] = None) -> List[s.Job]:
+        if ws is not None:
+            ws.add(self, "jobs")
+        with self._lock:
+            return list(self.jobs_table.values())
+
+    def jobs_by_id_prefix(self, ws: Optional[WatchSet], prefix: str) -> List[s.Job]:
+        if ws is not None:
+            ws.add(self, "jobs")
+        with self._lock:
+            return [j for jid, j in self.jobs_table.items() if jid.startswith(prefix)]
+
+    def jobs_by_periodic(self, ws: Optional[WatchSet], periodic: bool) -> List[s.Job]:
+        if ws is not None:
+            ws.add(self, "jobs")
+        with self._lock:
+            return [j for j in self.jobs_table.values() if j.is_periodic() == periodic]
+
+    def jobs_by_scheduler(self, ws: Optional[WatchSet], sched_type: str) -> List[s.Job]:
+        if ws is not None:
+            ws.add(self, "jobs")
+        with self._lock:
+            return [j for j in self.jobs_table.values() if j.type == sched_type]
+
+    def jobs_by_gc(self, ws: Optional[WatchSet], gc: bool) -> List[s.Job]:
+        if ws is not None:
+            ws.add(self, "jobs")
+        with self._lock:
+            out = []
+            for j in self.jobs_table.values():
+                # batch jobs (and parameterized/periodic children) are GC-able
+                gcable = j.type == s.JOB_TYPE_BATCH or j.parent_id != ""
+                if gcable == gc:
+                    out.append(j)
+            return out
+
+    def job_versions_by_id(self, ws: Optional[WatchSet], job_id: str) -> List[s.Job]:
+        if ws is not None:
+            ws.add(self, "jobs")
+        with self._lock:
+            return list(self.job_versions.get(job_id, []))
+
+    def job_by_id_and_version(
+        self, ws: Optional[WatchSet], job_id: str, version: int
+    ) -> Optional[s.Job]:
+        for j in self.job_versions_by_id(ws, job_id):
+            if j.version == version:
+                return j
+        return None
+
+    # -- job summaries -----------------------------------------------------
+
+    def upsert_job_summary(self, index: int, summary: s.JobSummary) -> None:
+        with self._lock:
+            summary = summary.copy()
+            summary.modify_index = index
+            if summary.create_index == 0:
+                summary.create_index = index
+            self.job_summary_table[summary.job_id] = summary
+            self._bump("job_summary", index)
+        self._notify()
+
+    def delete_job_summary(self, index: int, job_id: str) -> None:
+        with self._lock:
+            self.job_summary_table.pop(job_id, None)
+            self._bump("job_summary", index)
+        self._notify()
+
+    def job_summary_by_id(self, ws: Optional[WatchSet], job_id: str) -> Optional[s.JobSummary]:
+        if ws is not None:
+            ws.add(self, "job_summary")
+        with self._lock:
+            return self.job_summary_table.get(job_id)
+
+    def job_summaries(self, ws: Optional[WatchSet] = None) -> List[s.JobSummary]:
+        if ws is not None:
+            ws.add(self, "job_summary")
+        with self._lock:
+            return list(self.job_summary_table.values())
+
+    def _update_summary_with_job(self, index: int, job: s.Job) -> None:
+        """Create/extend the summary when a job is upserted
+        (state_store.go:2159)."""
+        summary = self.job_summary_table.get(job.id)
+        if summary is None:
+            summary = s.JobSummary(job_id=job.id, create_index=index)
+        else:
+            summary = summary.copy()
+        changed = False
+        for tg in job.task_groups:
+            if tg.name not in summary.summary:
+                summary.summary[tg.name] = s.TaskGroupSummary()
+                changed = True
+        if changed or summary.modify_index == 0:
+            summary.modify_index = index
+            self.job_summary_table[job.id] = summary
+            self._bump("job_summary", index)
+
+    # -- periodic launches -------------------------------------------------
+
+    def upsert_periodic_launch(self, index: int, launch: PeriodicLaunch) -> None:
+        with self._lock:
+            existing = self.periodic_launch_table.get(launch.id)
+            launch = PeriodicLaunch(launch.id, launch.launch,
+                                    existing.create_index if existing else index, index)
+            self.periodic_launch_table[launch.id] = launch
+            self._bump("periodic_launch", index)
+        self._notify()
+
+    def delete_periodic_launch(self, index: int, job_id: str) -> None:
+        with self._lock:
+            self.periodic_launch_table.pop(job_id, None)
+            self._bump("periodic_launch", index)
+        self._notify()
+
+    def periodic_launch_by_id(self, ws: Optional[WatchSet], job_id: str) -> Optional[PeriodicLaunch]:
+        if ws is not None:
+            ws.add(self, "periodic_launch")
+        with self._lock:
+            return self.periodic_launch_table.get(job_id)
+
+    def periodic_launches(self, ws: Optional[WatchSet] = None) -> List[PeriodicLaunch]:
+        if ws is not None:
+            ws.add(self, "periodic_launch")
+        with self._lock:
+            return list(self.periodic_launch_table.values())
+
+    # -- evals -------------------------------------------------------------
+
+    def upsert_evals(self, index: int, evals: List[s.Evaluation]) -> None:
+        """(state_store.go:1123) — also syncs queued counts into summaries
+        and cancels blocked evals obsoleted by a successful one."""
+        with self._lock:
+            jobs: Dict[str, str] = {}
+            for ev in evals:
+                self._nested_upsert_eval(index, ev)
+                jobs.setdefault(ev.job_id, "")
+            self._set_job_statuses(index, jobs, eval_delete=False)
+            self._bump("evals", index)
+        self._notify()
+
+    def _nested_upsert_eval(self, index: int, ev: s.Evaluation) -> None:
+        ev = ev.copy()
+        existing = self.evals_table.get(ev.id)
+        if existing is not None:
+            ev.create_index = existing.create_index
+        else:
+            ev.create_index = index
+        ev.modify_index = index
+
+        summary = self.job_summary_table.get(ev.job_id)
+        if summary is not None and ev.queued_allocations:
+            summary = summary.copy()
+            changed = False
+            for tg, num in ev.queued_allocations.items():
+                tgs = summary.summary.get(tg)
+                if tgs is not None and tgs.queued != num:
+                    tgs.queued = num
+                    changed = True
+            if changed:
+                summary.modify_index = index
+                self.job_summary_table[ev.job_id] = summary
+                self._bump("job_summary", index)
+
+        # A successful eval cancels the job's blocked evals.
+        if ev.status == s.EVAL_STATUS_COMPLETE and not ev.failed_tg_allocs:
+            for eid in list(self._evals_by_job.get(ev.job_id, ())):
+                blocked = self.evals_table.get(eid)
+                if blocked is not None and blocked.status == s.EVAL_STATUS_BLOCKED:
+                    cancelled = blocked.copy()
+                    cancelled.status = s.EVAL_STATUS_CANCELLED
+                    cancelled.status_description = f"evaluation {ev.id!r} successful"
+                    cancelled.modify_index = index
+                    self.evals_table[eid] = cancelled
+
+        self.evals_table[ev.id] = ev
+        self._evals_by_job[ev.job_id].add(ev.id)
+
+    def delete_eval(self, index: int, eval_ids: List[str], alloc_ids: List[str]) -> None:
+        """(state_store.go:1235) — GC path for evals + their allocs."""
+        with self._lock:
+            jobs: Dict[str, str] = {}
+            for eid in eval_ids:
+                ev = self.evals_table.pop(eid, None)
+                if ev is None:
+                    continue
+                self._evals_by_job[ev.job_id].discard(eid)
+                jobs.setdefault(ev.job_id, "")
+            for aid in alloc_ids:
+                self._remove_alloc(aid)
+            self._bump("evals", index)
+            self._bump("allocs", index)
+            self._set_job_statuses(index, jobs, eval_delete=True)
+        self._notify()
+
+    def eval_by_id(self, ws: Optional[WatchSet], eval_id: str) -> Optional[s.Evaluation]:
+        if ws is not None:
+            ws.add(self, "evals")
+        with self._lock:
+            return self.evals_table.get(eval_id)
+
+    def evals_by_id_prefix(self, ws: Optional[WatchSet], prefix: str) -> List[s.Evaluation]:
+        if ws is not None:
+            ws.add(self, "evals")
+        with self._lock:
+            return [e for eid, e in self.evals_table.items() if eid.startswith(prefix)]
+
+    def evals_by_job(self, ws: Optional[WatchSet], job_id: str) -> List[s.Evaluation]:
+        if ws is not None:
+            ws.add(self, "evals")
+        with self._lock:
+            return [self.evals_table[eid] for eid in self._evals_by_job.get(job_id, ())
+                    if eid in self.evals_table]
+
+    def evals(self, ws: Optional[WatchSet] = None) -> List[s.Evaluation]:
+        if ws is not None:
+            ws.add(self, "evals")
+        with self._lock:
+            return list(self.evals_table.values())
+
+    # -- allocs ------------------------------------------------------------
+
+    def upsert_allocs(self, index: int, allocs: List[s.Allocation]) -> None:
+        """(state_store.go:1435)."""
+        with self._lock:
+            self._upsert_allocs_impl(index, allocs)
+        self._notify()
+
+    def _upsert_allocs_impl(self, index: int, allocs: List[s.Allocation]) -> None:
+        jobs: Dict[str, str] = {}
+        for alloc in allocs:
+            alloc = alloc.copy()
+            existing = self.allocs_table.get(alloc.id)
+            if existing is None:
+                alloc.create_index = index
+                alloc.modify_index = index
+                alloc.alloc_modify_index = index
+            else:
+                alloc.create_index = existing.create_index
+                alloc.modify_index = index
+                alloc.alloc_modify_index = index
+                # The client is the authority on these fields — keep them
+                # (state_store.go:1472).
+                alloc.client_status = existing.client_status
+                alloc.client_description = existing.client_description
+                alloc.task_states = existing.task_states
+            self._update_summary_with_alloc(index, alloc, existing)
+            if alloc.job is None and existing is not None:
+                alloc.job = existing.job
+            self.allocs_table[alloc.id] = alloc
+            self._allocs_by_node[alloc.node_id].add(alloc.id)
+            self._allocs_by_job[alloc.job_id].add(alloc.id)
+            self._allocs_by_eval[alloc.eval_id].add(alloc.id)
+
+            if alloc.job is not None:
+                forced = ""
+                if not alloc.terminal_status():
+                    forced = s.JOB_STATUS_RUNNING
+                jobs[alloc.job_id] = jobs.get(alloc.job_id) or forced
+        self._set_job_statuses(index, jobs, eval_delete=False)
+        self._bump("allocs", index)
+
+    def update_allocs_from_client(self, index: int, allocs: List[s.Allocation]) -> None:
+        """Merge client-authoritative fields (state_store.go:1367)."""
+        with self._lock:
+            for client_alloc in allocs:
+                existing = self.allocs_table.get(client_alloc.id)
+                if existing is None:
+                    continue
+                updated = existing.copy()
+                updated.client_status = client_alloc.client_status
+                updated.client_description = client_alloc.client_description
+                updated.task_states = {
+                    k: v.copy() for k, v in client_alloc.task_states.items()
+                }
+                updated.modify_index = index
+                self._update_summary_with_alloc(index, updated, existing)
+                self.allocs_table[client_alloc.id] = updated
+                forced = "" if updated.terminal_status() else s.JOB_STATUS_RUNNING
+                self._set_job_statuses(index, {existing.job_id: forced}, eval_delete=False)
+            self._bump("allocs", index)
+        self._notify()
+
+    def _remove_alloc(self, alloc_id: str) -> None:
+        alloc = self.allocs_table.pop(alloc_id, None)
+        if alloc is None:
+            return
+        self._allocs_by_node[alloc.node_id].discard(alloc_id)
+        self._allocs_by_job[alloc.job_id].discard(alloc_id)
+        self._allocs_by_eval[alloc.eval_id].discard(alloc_id)
+
+    def alloc_by_id(self, ws: Optional[WatchSet], alloc_id: str) -> Optional[s.Allocation]:
+        if ws is not None:
+            ws.add(self, "allocs")
+        with self._lock:
+            return self.allocs_table.get(alloc_id)
+
+    def allocs_by_id_prefix(self, ws: Optional[WatchSet], prefix: str) -> List[s.Allocation]:
+        if ws is not None:
+            ws.add(self, "allocs")
+        with self._lock:
+            return [a for aid, a in self.allocs_table.items() if aid.startswith(prefix)]
+
+    def allocs_by_node(self, ws: Optional[WatchSet], node_id: str) -> List[s.Allocation]:
+        if ws is not None:
+            ws.add(self, "allocs")
+        with self._lock:
+            return [self.allocs_table[aid] for aid in self._allocs_by_node.get(node_id, ())
+                    if aid in self.allocs_table]
+
+    def allocs_by_node_terminal(
+        self, ws: Optional[WatchSet], node_id: str, terminal: bool
+    ) -> List[s.Allocation]:
+        """(state_store.go:1592) — the scheduler's ProposedAllocs source."""
+        return [a for a in self.allocs_by_node(ws, node_id)
+                if a.terminal_status() == terminal]
+
+    def allocs_by_job(self, ws: Optional[WatchSet], job_id: str, all_allocs: bool = False) -> List[s.Allocation]:
+        """(state_store.go:1615).  When all_allocs is False, allocs from a
+        previous incarnation of a re-registered job are filtered to the
+        summary's create_index."""
+        if ws is not None:
+            ws.add(self, "allocs")
+        with self._lock:
+            out = [self.allocs_table[aid] for aid in self._allocs_by_job.get(job_id, ())
+                   if aid in self.allocs_table]
+            if all_allocs:
+                return out
+            summary = self.job_summary_table.get(job_id)
+            if summary is None:
+                return out
+            return [a for a in out
+                    if a.job is None or a.job.create_index == summary.create_index]
+
+    def allocs_by_eval(self, ws: Optional[WatchSet], eval_id: str) -> List[s.Allocation]:
+        if ws is not None:
+            ws.add(self, "allocs")
+        with self._lock:
+            return [self.allocs_table[aid] for aid in self._allocs_by_eval.get(eval_id, ())
+                    if aid in self.allocs_table]
+
+    def allocs(self, ws: Optional[WatchSet] = None) -> List[s.Allocation]:
+        if ws is not None:
+            ws.add(self, "allocs")
+        with self._lock:
+            return list(self.allocs_table.values())
+
+    # -- vault accessors ---------------------------------------------------
+
+    def upsert_vault_accessors(self, index: int, accessors: List[VaultAccessor]) -> None:
+        with self._lock:
+            for acc in accessors:
+                acc.create_index = index
+                self.vault_accessors_table[acc.accessor] = acc
+                self._vault_by_alloc[acc.alloc_id].add(acc.accessor)
+                self._vault_by_node[acc.node_id].add(acc.accessor)
+            self._bump("vault_accessors", index)
+        self._notify()
+
+    def delete_vault_accessors(self, index: int, accessors: List[VaultAccessor]) -> None:
+        with self._lock:
+            for acc in accessors:
+                stored = self.vault_accessors_table.pop(acc.accessor, None)
+                if stored is not None:
+                    self._vault_by_alloc[stored.alloc_id].discard(acc.accessor)
+                    self._vault_by_node[stored.node_id].discard(acc.accessor)
+            self._bump("vault_accessors", index)
+        self._notify()
+
+    def vault_accessor(self, ws: Optional[WatchSet], accessor: str) -> Optional[VaultAccessor]:
+        if ws is not None:
+            ws.add(self, "vault_accessors")
+        with self._lock:
+            return self.vault_accessors_table.get(accessor)
+
+    def vault_accessors_by_alloc(self, ws: Optional[WatchSet], alloc_id: str) -> List[VaultAccessor]:
+        if ws is not None:
+            ws.add(self, "vault_accessors")
+        with self._lock:
+            return [self.vault_accessors_table[a] for a in self._vault_by_alloc.get(alloc_id, ())
+                    if a in self.vault_accessors_table]
+
+    def vault_accessors_by_node(self, ws: Optional[WatchSet], node_id: str) -> List[VaultAccessor]:
+        if ws is not None:
+            ws.add(self, "vault_accessors")
+        with self._lock:
+            return [self.vault_accessors_table[a] for a in self._vault_by_node.get(node_id, ())
+                    if a in self.vault_accessors_table]
+
+    # -- plan application --------------------------------------------------
+
+    def upsert_plan_results(self, index: int, job: Optional[s.Job],
+                            allocs: List[s.Allocation]) -> None:
+        """Apply a committed plan: denormalize the job onto allocs, rebuild
+        combined resources, and upsert (state_store.go:89)."""
+        with self._lock:
+            for alloc in allocs:
+                if alloc.job is None and not alloc.terminal_status():
+                    alloc.job = job
+                if alloc.resources is None:
+                    total = s.Resources()
+                    for task_res in alloc.task_resources.values():
+                        total.add(task_res)
+                    total.add(alloc.shared_resources)
+                    alloc.resources = total
+            self._upsert_allocs_impl(index, allocs)
+        self._notify()
+
+    # -- job status machinery ---------------------------------------------
+
+    def _set_job_statuses(self, index: int, jobs: Dict[str, str], eval_delete: bool) -> None:
+        """(state_store.go:1968)."""
+        for job_id, forced in jobs.items():
+            job = self.jobs_table.get(job_id)
+            if job is None:
+                continue
+            self._set_job_status(index, job, eval_delete, forced)
+
+    def _set_job_status(self, index: int, job: s.Job, eval_delete: bool, forced: str) -> None:
+        """(state_store.go:1993)."""
+        old_status = job.status if index != job.create_index else ""
+        new_status = forced or self._get_job_status(job, eval_delete)
+        if old_status == new_status:
+            return
+        updated = job.copy()
+        updated.status = new_status
+        updated.modify_index = index
+        self.jobs_table[job.id] = updated
+        self._bump("jobs", index)
+
+        # Roll the transition into the parent's children summary.
+        if updated.parent_id:
+            psummary = self.job_summary_table.get(updated.parent_id)
+            if psummary is not None:
+                psummary = psummary.copy()
+                if psummary.children is None:
+                    psummary.children = s.JobChildrenSummary()
+                ch = psummary.children
+                deltas = {s.JOB_STATUS_PENDING: "pending",
+                          s.JOB_STATUS_RUNNING: "running",
+                          s.JOB_STATUS_DEAD: "dead"}
+                if old_status in deltas:
+                    setattr(ch, deltas[old_status], getattr(ch, deltas[old_status]) - 1)
+                if new_status in deltas:
+                    setattr(ch, deltas[new_status], getattr(ch, deltas[new_status]) + 1)
+                psummary.modify_index = index
+                self.job_summary_table[updated.parent_id] = psummary
+                self._bump("job_summary", index)
+
+    def _get_job_status(self, job: s.Job, eval_delete: bool) -> str:
+        """(state_store.go:2092)."""
+        has_alloc = False
+        for aid in self._allocs_by_job.get(job.id, ()):
+            alloc = self.allocs_table.get(aid)
+            if alloc is None:
+                continue
+            has_alloc = True
+            if not alloc.terminal_status():
+                return s.JOB_STATUS_RUNNING
+
+        has_eval = False
+        for eid in self._evals_by_job.get(job.id, ()):
+            ev = self.evals_table.get(eid)
+            if ev is None:
+                continue
+            has_eval = True
+            if not ev.terminal_status():
+                return s.JOB_STATUS_PENDING
+
+        if job.type == s.JOB_TYPE_SYSTEM:
+            return s.JOB_STATUS_DEAD if job.stop else s.JOB_STATUS_RUNNING
+
+        if eval_delete or has_eval or has_alloc:
+            return s.JOB_STATUS_DEAD
+
+        if job.is_periodic() or job.is_parameterized():
+            return s.JOB_STATUS_DEAD if job.stop else s.JOB_STATUS_RUNNING
+
+        return s.JOB_STATUS_PENDING
+
+    def _update_summary_with_alloc(
+        self, index: int, alloc: s.Allocation, existing: Optional[s.Allocation]
+    ) -> None:
+        """(state_store.go:2296)."""
+        if alloc.job is None:
+            return
+        summary = self.job_summary_table.get(alloc.job_id)
+        if summary is None:
+            return
+        if summary.create_index != alloc.job.create_index:
+            return
+        tgs = summary.summary.get(alloc.task_group)
+        if tgs is None:
+            return
+        summary = summary.copy()
+        tgs = summary.summary[alloc.task_group]
+
+        changed = False
+        if existing is None:
+            if alloc.client_status == s.ALLOC_CLIENT_STATUS_PENDING:
+                tgs.starting += 1
+                if tgs.queued > 0:
+                    tgs.queued -= 1
+                changed = True
+        elif existing.client_status != alloc.client_status:
+            inc = {
+                s.ALLOC_CLIENT_STATUS_RUNNING: "running",
+                s.ALLOC_CLIENT_STATUS_FAILED: "failed",
+                s.ALLOC_CLIENT_STATUS_PENDING: "starting",
+                s.ALLOC_CLIENT_STATUS_COMPLETE: "complete",
+                s.ALLOC_CLIENT_STATUS_LOST: "lost",
+            }
+            dec = {
+                s.ALLOC_CLIENT_STATUS_RUNNING: "running",
+                s.ALLOC_CLIENT_STATUS_PENDING: "starting",
+                s.ALLOC_CLIENT_STATUS_LOST: "lost",
+            }
+            if alloc.client_status in inc:
+                f = inc[alloc.client_status]
+                setattr(tgs, f, getattr(tgs, f) + 1)
+            if existing.client_status in dec:
+                f = dec[existing.client_status]
+                setattr(tgs, f, getattr(tgs, f) - 1)
+            changed = True
+
+        if changed:
+            summary.modify_index = index
+            self.job_summary_table[alloc.job_id] = summary
+            self._bump("job_summary", index)
+
+    # -- reconcile / maintenance ------------------------------------------
+
+    def reconcile_job_summaries(self, index: int) -> None:
+        """Rebuild all summaries from allocs (state_store.go:1883)."""
+        with self._lock:
+            for job in list(self.jobs_table.values()):
+                summary = s.JobSummary(job_id=job.id, create_index=job.create_index,
+                                       modify_index=index)
+                for tg in job.task_groups:
+                    summary.summary[tg.name] = s.TaskGroupSummary()
+                for aid in self._allocs_by_job.get(job.id, ()):
+                    alloc = self.allocs_table.get(aid)
+                    if alloc is None or alloc.task_group not in summary.summary:
+                        continue
+                    tgs = summary.summary[alloc.task_group]
+                    cs = alloc.client_status
+                    if cs == s.ALLOC_CLIENT_STATUS_FAILED:
+                        tgs.failed += 1
+                    elif cs == s.ALLOC_CLIENT_STATUS_LOST:
+                        tgs.lost += 1
+                    elif cs == s.ALLOC_CLIENT_STATUS_COMPLETE:
+                        tgs.complete += 1
+                    elif cs == s.ALLOC_CLIENT_STATUS_RUNNING:
+                        tgs.running += 1
+                    elif cs == s.ALLOC_CLIENT_STATUS_PENDING:
+                        tgs.starting += 1
+                self.job_summary_table[job.id] = summary
+            self._bump("job_summary", index)
+        self._notify()
+
+    # -- persistence (FSM snapshot support) --------------------------------
+
+    def persist(self) -> bytes:
+        """Serialize all tables for an FSM snapshot (fsm.go:568 Snapshot)."""
+        with self._lock:
+            payload = {
+                "nodes": self.nodes_table,
+                "jobs": self.jobs_table,
+                "job_versions": self.job_versions,
+                "job_summary": self.job_summary_table,
+                "evals": self.evals_table,
+                "allocs": self.allocs_table,
+                "periodic_launch": self.periodic_launch_table,
+                "vault_accessors": self.vault_accessors_table,
+                "indexes": self._indexes,
+            }
+            return pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+
+    @classmethod
+    def restore(cls, blob: bytes) -> "StateStore":
+        """Rebuild a store (and its secondary indexes) from a snapshot
+        (fsm.go:582 Restore)."""
+        payload = pickle.loads(blob)
+        store = cls()
+        store.nodes_table = payload["nodes"]
+        store.jobs_table = payload["jobs"]
+        store.job_versions = payload["job_versions"]
+        store.job_summary_table = payload["job_summary"]
+        store.evals_table = payload["evals"]
+        store.allocs_table = payload["allocs"]
+        store.periodic_launch_table = payload["periodic_launch"]
+        store.vault_accessors_table = payload["vault_accessors"]
+        store._indexes = payload["indexes"]
+        for ev in store.evals_table.values():
+            store._evals_by_job[ev.job_id].add(ev.id)
+        for alloc in store.allocs_table.values():
+            store._allocs_by_node[alloc.node_id].add(alloc.id)
+            store._allocs_by_job[alloc.job_id].add(alloc.id)
+            store._allocs_by_eval[alloc.eval_id].add(alloc.id)
+        for acc in store.vault_accessors_table.values():
+            store._vault_by_alloc[acc.alloc_id].add(acc.accessor)
+            store._vault_by_node[acc.node_id].add(acc.accessor)
+        return store
+
+
+class StateSnapshot(StateStore):
+    """A point-in-time view; writes to a snapshot do not affect the parent
+    store.  The plan applier uses this for optimistic local application
+    (plan_apply.go:166)."""

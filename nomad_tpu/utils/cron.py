@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import calendar
 import time
-from typing import List, Set
+from typing import List, Optional, Set
 
 _SHORTCUTS = {
     "@yearly": "0 0 1 1 *",
@@ -24,8 +24,7 @@ _SHORTCUTS = {
 _RANGES = [(0, 59), (0, 23), (1, 31), (1, 12), (0, 6)]
 
 _MONTH_NAMES = {name.lower(): i for i, name in enumerate(calendar.month_abbr) if name}
-_DOW_NAMES = {name.lower(): i for i, name in enumerate(calendar.day_abbr)}
-# cron day-of-week: 0=Sunday; python day_abbr: Mon..Sun
+# cron day-of-week convention: 0=Sunday
 _DOW_NAMES = {"sun": 0, "mon": 1, "tue": 2, "wed": 3, "thu": 4, "fri": 5, "sat": 6}
 
 
@@ -74,9 +73,17 @@ class CronExpr:
         spec = spec.strip()
         spec = _SHORTCUTS.get(spec, spec)
         fields = spec.split()
-        if len(fields) == 6:
-            # seconds-resolution spec: ignore the seconds field (fire at :00)
+        # Field-count conventions follow gorhill/cronexpr (used by the
+        # reference): 5 = standard; 6 = standard + trailing year;
+        # 7 = leading seconds + standard + year (seconds are floored to :00).
+        self.years: Optional[Set[int]] = None
+        if len(fields) == 7:
             fields = fields[1:]
+        if len(fields) == 6:
+            year_field = fields[5]
+            if year_field not in ("*", "?"):
+                self.years = _parse_field(year_field, 1970, 2099)
+            fields = fields[:5]
         if len(fields) != 5:
             raise CronParseError(f"expected 5 cron fields, got {len(fields)}")
         self.minutes = _parse_field(fields[0], *_RANGES[0])
@@ -103,6 +110,11 @@ class CronExpr:
         limit = int(after) + 4 * 366 * 86400
         while t < limit:
             tm = time.localtime(t)
+            if self.years is not None and tm.tm_year not in self.years:
+                if all(tm.tm_year > y for y in self.years):
+                    return 0.0
+                t = int(time.mktime((tm.tm_year + 1, 1, 1, 0, 0, 0, 0, 1, -1)))
+                continue
             if tm.tm_mon not in self.months:
                 # jump to the 1st of next month
                 year, month = tm.tm_year, tm.tm_mon + 1

@@ -1,0 +1,174 @@
+"""Feasibility checker unit tests (reference: scheduler/feasible_test.go)."""
+import logging
+import random
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler.context import EvalContext
+from nomad_tpu.scheduler.feasible import (
+    ConstraintChecker,
+    DriverChecker,
+    StaticIterator,
+    check_constraint,
+    resolve_constraint_target,
+)
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import structs as s
+
+
+def ctx():
+    store = StateStore()
+    plan = s.Plan()
+    return EvalContext(store, plan, logging.getLogger("test"), rng=random.Random(1))
+
+
+class TestResolveTarget:
+    def test_literal(self):
+        node = mock.node()
+        assert resolve_constraint_target("linux", node) == ("linux", True)
+
+    def test_node_interpolations(self):
+        node = mock.node()
+        assert resolve_constraint_target("${node.unique.id}", node) == (node.id, True)
+        assert resolve_constraint_target("${node.datacenter}", node) == ("dc1", True)
+        assert resolve_constraint_target("${node.unique.name}", node) == ("foobar", True)
+        assert resolve_constraint_target("${node.class}", node) == ("linux-medium-pci", True)
+
+    def test_attr_meta(self):
+        node = mock.node()
+        assert resolve_constraint_target("${attr.kernel.name}", node) == ("linux", True)
+        assert resolve_constraint_target("${meta.pci-dss}", node) == ("true", True)
+        assert resolve_constraint_target("${attr.nope}", node) == (None, False)
+        assert resolve_constraint_target("${meta.nope}", node) == (None, False)
+
+    def test_unknown_interpolation(self):
+        node = mock.node()
+        assert resolve_constraint_target("${env.whatever}", node) == (None, False)
+
+
+class TestCheckConstraint:
+    def test_equality(self):
+        c = ctx()
+        assert check_constraint(c, "=", "a", "a")
+        assert check_constraint(c, "==", "a", "a")
+        assert check_constraint(c, "is", "a", "a")
+        assert not check_constraint(c, "=", "a", "b")
+        assert check_constraint(c, "!=", "a", "b")
+        assert check_constraint(c, "not", "a", "b")
+
+    def test_lexical(self):
+        c = ctx()
+        assert check_constraint(c, "<", "abc", "abd")
+        assert check_constraint(c, "<=", "abc", "abc")
+        assert check_constraint(c, ">", "b", "a")
+        assert check_constraint(c, ">=", "b", "b")
+        assert not check_constraint(c, "<", "b", "a")
+        # non-strings fail
+        assert not check_constraint(c, "<", None, "a")
+
+    def test_version(self):
+        c = ctx()
+        assert check_constraint(c, s.CONSTRAINT_VERSION, "0.5.0", ">= 0.4, < 0.6")
+        assert check_constraint(c, s.CONSTRAINT_VERSION, "1.2.3", "~> 1.2")
+        assert not check_constraint(c, s.CONSTRAINT_VERSION, "2.0", "~> 1.2")
+        assert not check_constraint(c, s.CONSTRAINT_VERSION, "garbage", ">= 1.0")
+        assert not check_constraint(c, s.CONSTRAINT_VERSION, "1.0", "garbage >=")
+
+    def test_regexp(self):
+        c = ctx()
+        assert check_constraint(c, s.CONSTRAINT_REGEX, "linux-4.9", r"^linux-\d")
+        assert not check_constraint(c, s.CONSTRAINT_REGEX, "windows", r"^linux")
+        assert not check_constraint(c, s.CONSTRAINT_REGEX, "x", "[invalid(")
+        # cache reuse: second call hits the cache
+        assert check_constraint(c, s.CONSTRAINT_REGEX, "linux-5", r"^linux-\d")
+        assert len(c.cache.re_cache) == 3
+
+    def test_set_contains(self):
+        c = ctx()
+        assert check_constraint(c, s.CONSTRAINT_SET_CONTAINS, "a,b,c", "a,c")
+        assert check_constraint(c, s.CONSTRAINT_SET_CONTAINS, "a, b, c ", "b")
+        assert not check_constraint(c, s.CONSTRAINT_SET_CONTAINS, "a,b", "a,d")
+
+    def test_distinct_operands_pass_through(self):
+        c = ctx()
+        assert check_constraint(c, s.CONSTRAINT_DISTINCT_HOSTS, None, None)
+        assert check_constraint(c, s.CONSTRAINT_DISTINCT_PROPERTY, "x", "y")
+
+    def test_unknown_operand(self):
+        assert not check_constraint(ctx(), "@@", "a", "a")
+
+
+class TestDriverChecker:
+    def test_has_driver(self):
+        c = ctx()
+        checker = DriverChecker(c, {"exec"})
+        assert checker.feasible(mock.node())
+
+    def test_missing_driver(self):
+        c = ctx()
+        checker = DriverChecker(c, {"docker"})
+        node = mock.node()
+        assert not checker.feasible(node)
+        assert c.metrics.nodes_filtered == 1
+        assert c.metrics.constraint_filtered["missing drivers"] == 1
+
+    def test_disabled_driver(self):
+        c = ctx()
+        node = mock.node()
+        node.attributes["driver.docker"] = "0"
+        checker = DriverChecker(c, {"docker"})
+        assert not checker.feasible(node)
+
+    def test_invalid_driver_value(self):
+        c = ctx()
+        node = mock.node()
+        node.attributes["driver.docker"] = "yes-ish"
+        checker = DriverChecker(c, {"docker"})
+        assert not checker.feasible(node)
+
+
+class TestConstraintChecker:
+    def test_passes_all(self):
+        c = ctx()
+        checker = ConstraintChecker(c, [
+            s.Constraint("${attr.kernel.name}", "linux", "="),
+            s.Constraint("${node.datacenter}", "dc1", "="),
+        ])
+        assert checker.feasible(mock.node())
+
+    def test_fails_and_records_metric(self):
+        c = ctx()
+        constraint = s.Constraint("${attr.kernel.name}", "windows", "=")
+        checker = ConstraintChecker(c, [constraint])
+        assert not checker.feasible(mock.node())
+        assert c.metrics.constraint_filtered[str(constraint)] == 1
+
+    def test_missing_target_fails(self):
+        c = ctx()
+        checker = ConstraintChecker(c, [s.Constraint("${attr.gone}", "x", "!=")])
+        assert not checker.feasible(mock.node())
+
+
+class TestStaticIterator:
+    def test_yields_all_then_none(self):
+        c = ctx()
+        nodes = [mock.node() for _ in range(3)]
+        it = StaticIterator(c, nodes)
+        seen = []
+        while True:
+            n = it.next_option()
+            if n is None:
+                break
+            seen.append(n)
+        assert seen == nodes
+        assert c.metrics.nodes_evaluated == 3
+
+    def test_reset_wraps_offset(self):
+        c = ctx()
+        nodes = [mock.node() for _ in range(3)]
+        it = StaticIterator(c, nodes)
+        first = it.next_option()
+        it.reset()
+        # after reset, continues from offset then wraps to serve all 3
+        got = [it.next_option() for _ in range(3)]
+        assert None not in got
+        assert {n.id for n in got} == {n.id for n in nodes}

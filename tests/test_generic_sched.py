@@ -1,0 +1,373 @@
+"""Oracle scheduler tests (reference: scheduler/generic_sched_test.go)."""
+import random
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler import (
+    Harness,
+    RejectPlan,
+    new_batch_scheduler,
+    new_service_scheduler,
+)
+from nomad_tpu.scheduler.generic import GenericScheduler
+from nomad_tpu.structs import structs as s
+
+
+def make_harness(num_nodes=10):
+    h = Harness()
+    nodes = []
+    for _ in range(num_nodes):
+        n = mock.node()
+        h.state.upsert_node(h.next_index(), n)
+        nodes.append(n)
+    return h, nodes
+
+
+def register_eval(job):
+    return s.Evaluation(
+        id=s.generate_uuid(),
+        priority=job.priority,
+        triggered_by=s.EVAL_TRIGGER_JOB_REGISTER,
+        job_id=job.id,
+        status=s.EVAL_STATUS_PENDING,
+        type=job.type,
+    )
+
+
+def test_service_register_places_all():
+    h, _ = make_harness()
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+    ev = register_eval(job)
+    h.process(new_service_scheduler, ev)
+
+    assert len(h.plans) == 1
+    plan = h.plans[0]
+    placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+    assert len(placed) == 10
+    # every alloc carries task resources + shared disk
+    for a in placed:
+        assert a.task_resources["web"].cpu == 500
+        assert a.shared_resources.disk_mb == 150
+        assert a.metrics is not None
+    # allocs landed in state
+    out = h.state.allocs_by_job(None, job.id, True)
+    assert len(out) == 10
+    h.assert_eval_status(s.EVAL_STATUS_COMPLETE)
+    assert h.evals[0].queued_allocations == {"web": 0}
+
+
+def test_service_register_no_nodes_blocked():
+    h = Harness()
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+    ev = register_eval(job)
+    h.process(new_service_scheduler, ev)
+
+    # no plan submitted, blocked eval created with failed TG metrics
+    assert h.plans == []
+    assert len(h.create_evals) == 1
+    blocked = h.create_evals[0]
+    assert blocked.status == s.EVAL_STATUS_BLOCKED
+    assert blocked.previous_eval == ev.id
+    update = h.evals[0]
+    assert update.status == s.EVAL_STATUS_COMPLETE
+    assert "web" in update.failed_tg_allocs
+    assert update.failed_tg_allocs["web"].nodes_evaluated == 0
+    assert update.blocked_eval == blocked.id
+
+
+def test_service_register_infeasible_constraint_class_filtered():
+    h, _ = make_harness(3)
+    job = mock.job()
+    job.constraints = [s.Constraint("${attr.kernel.name}", "windows", "=")]
+    h.state.upsert_job(h.next_index(), job)
+    ev = register_eval(job)
+    h.process(new_service_scheduler, ev)
+    update = h.evals[0]
+    metric = update.failed_tg_allocs["web"]
+    # 3 nodes evaluated but only 1 full check thanks to computed-class cache
+    assert metric.nodes_filtered == 3
+    assert metric.coalesced_failures == 9
+    blocked = h.create_evals[0]
+    assert not blocked.escaped_computed_class
+    assert blocked.class_eligibility  # classes recorded as ineligible
+    assert all(v is False for v in blocked.class_eligibility.values())
+
+
+def test_register_existing_allocs_ignored():
+    h, _ = make_harness()
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+    ev = register_eval(job)
+    h.process(new_service_scheduler, ev)
+    assert len(h.plans) == 1
+
+    # Second eval for the same job version: everything ignored, no-op
+    h2 = Harness(h.state)
+    ev2 = register_eval(job)
+    h2.process(new_service_scheduler, ev2)
+    assert h2.plans == []
+    h2.assert_eval_status(s.EVAL_STATUS_COMPLETE)
+
+
+def test_job_update_destructive_evicts_and_places():
+    h, _ = make_harness()
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+    h.process(new_service_scheduler, register_eval(job))
+
+    # register new version with a changed task config (destructive)
+    job2 = h.state.job_by_id(None, job.id).copy()
+    job2.task_groups[0].tasks[0].config = {"command": "/bin/other"}
+    h.state.upsert_job(h.next_index(), job2)
+
+    h2 = Harness(h.state)
+    h2._next_index = h._next_index
+    h2.process(new_service_scheduler, register_eval(job2))
+    assert len(h2.plans) == 1
+    plan = h2.plans[0]
+    stopped = [a for allocs in plan.node_update.values() for a in allocs]
+    placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+    assert len(stopped) == 10
+    assert len(placed) == 10
+    for a in stopped:
+        assert a.desired_status == s.ALLOC_DESIRED_STATUS_STOP
+
+
+def test_job_update_inplace_when_tasks_unchanged():
+    h, _ = make_harness()
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+    h.process(new_service_scheduler, register_eval(job))
+
+    # bump priority only — in-place update
+    job2 = h.state.job_by_id(None, job.id).copy()
+    job2.priority = 80
+    h.state.upsert_job(h.next_index(), job2)
+
+    h2 = Harness(h.state)
+    h2._next_index = h._next_index
+    h2.process(new_service_scheduler, register_eval(job2))
+    assert len(h2.plans) == 1
+    plan = h2.plans[0]
+    stopped = [a for allocs in plan.node_update.values() for a in allocs]
+    placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+    assert stopped == []          # nothing evicted
+    assert len(placed) == 10      # all updated in place
+    # in-place updates keep their node and previous ID
+    originals = {a.id: a for a in h.state.allocs_by_job(None, job.id, True)}
+    for a in placed:
+        assert a.id in originals
+        assert a.node_id == originals[a.id].node_id
+
+
+def test_rolling_update_limit():
+    h, _ = make_harness()
+    job = mock.job()
+    job.update = s.UpdateStrategy(stagger=30.0, max_parallel=3)
+    h.state.upsert_job(h.next_index(), job)
+    h.process(new_service_scheduler, register_eval(job))
+
+    job2 = h.state.job_by_id(None, job.id).copy()
+    job2.task_groups[0].tasks[0].config = {"command": "/bin/other"}
+    job2.update = s.UpdateStrategy(stagger=30.0, max_parallel=3)
+    h.state.upsert_job(h.next_index(), job2)
+
+    h2 = Harness(h.state)
+    h2._next_index = h._next_index
+    h2.process(new_service_scheduler, register_eval(job2))
+    plan = h2.plans[0]
+    stopped = [a for allocs in plan.node_update.values() for a in allocs]
+    assert len(stopped) == 3  # rolling limit
+    # follow-up rolling eval created
+    rolling = [e for e in h2.create_evals
+               if e.triggered_by == s.EVAL_TRIGGER_ROLLING_UPDATE]
+    assert len(rolling) == 1
+    assert rolling[0].wait == 30.0
+
+
+def test_node_down_marks_lost_and_replaces():
+    h, nodes = make_harness(2)
+    job = mock.job()
+    job.task_groups[0].count = 2
+    h.state.upsert_job(h.next_index(), job)
+    h.process(new_service_scheduler, register_eval(job))
+
+    # take one node down
+    victim_allocs = [a for a in h.state.allocs_by_job(None, job.id, True)]
+    victim_node = victim_allocs[0].node_id
+    h.state.update_node_status(h.next_index(), victim_node, s.NODE_STATUS_DOWN)
+
+    h2 = Harness(h.state)
+    h2._next_index = h._next_index
+    ev = register_eval(job)
+    ev.triggered_by = s.EVAL_TRIGGER_NODE_UPDATE
+    h2.process(new_service_scheduler, ev)
+    plan = h2.plans[0]
+    lost = [a for allocs in plan.node_update.values() for a in allocs]
+    assert lost, "expected lost allocs"
+    for a in lost:
+        assert a.client_status == s.ALLOC_CLIENT_STATUS_LOST
+        assert a.desired_status == s.ALLOC_DESIRED_STATUS_STOP
+
+
+def test_node_drain_migrates():
+    h, nodes = make_harness(3)
+    job = mock.job()
+    job.task_groups[0].count = 3
+    h.state.upsert_job(h.next_index(), job)
+    h.process(new_service_scheduler, register_eval(job))
+
+    allocs = h.state.allocs_by_job(None, job.id, True)
+    drain_node = allocs[0].node_id
+    h.state.update_node_drain(h.next_index(), drain_node, True)
+
+    h2 = Harness(h.state)
+    h2._next_index = h._next_index
+    ev = register_eval(job)
+    ev.triggered_by = s.EVAL_TRIGGER_NODE_UPDATE
+    h2.process(new_service_scheduler, ev)
+    plan = h2.plans[0]
+    stopped = [a for allocs_ in plan.node_update.values() for a in allocs_]
+    n_on_drained = len([a for a in allocs if a.node_id == drain_node])
+    assert len(stopped) == n_on_drained
+    # migrated placements must avoid the draining node
+    placed = [a for allocs_ in plan.node_allocation.values() for a in allocs_]
+    assert len(placed) == n_on_drained
+    for a in placed:
+        assert a.node_id != drain_node
+
+
+def test_job_deregister_stops_all():
+    h, _ = make_harness()
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+    h.process(new_service_scheduler, register_eval(job))
+
+    stopped_job = h.state.job_by_id(None, job.id).copy()
+    stopped_job.stop = True
+    h.state.upsert_job(h.next_index(), stopped_job)
+
+    h2 = Harness(h.state)
+    h2._next_index = h._next_index
+    ev = register_eval(job)
+    ev.triggered_by = s.EVAL_TRIGGER_JOB_DEREGISTER
+    h2.process(new_service_scheduler, ev)
+    plan = h2.plans[0]
+    stopped = [a for allocs in plan.node_update.values() for a in allocs]
+    assert len(stopped) == 10
+    placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+    assert placed == []
+
+
+def test_distinct_hosts_limits_one_per_node():
+    h, _ = make_harness(5)
+    job = mock.job()
+    job.constraints.append(s.Constraint(operand=s.CONSTRAINT_DISTINCT_HOSTS))
+    job.task_groups[0].count = 5
+    h.state.upsert_job(h.next_index(), job)
+    h.process(new_service_scheduler, register_eval(job))
+    plan = h.plans[0]
+    placed_nodes = [nid for nid, allocs in plan.node_allocation.items() for _ in allocs]
+    assert len(placed_nodes) == 5
+    assert len(set(placed_nodes)) == 5  # all on distinct hosts
+
+
+def test_distinct_hosts_infeasible_when_count_exceeds_nodes():
+    h, _ = make_harness(3)
+    job = mock.job()
+    job.constraints.append(s.Constraint(operand=s.CONSTRAINT_DISTINCT_HOSTS))
+    job.task_groups[0].count = 5
+    h.state.upsert_job(h.next_index(), job)
+    h.process(new_service_scheduler, register_eval(job))
+    placed = [a for allocs in h.plans[0].node_allocation.values() for a in allocs]
+    assert len(placed) == 3
+    update = h.evals[0]
+    assert update.failed_tg_allocs["web"].coalesced_failures == 1  # 2 failures coalesced
+
+
+def test_reject_plan_creates_blocked_max_plans():
+    h, _ = make_harness(2)
+    h.planner = RejectPlan(h)
+    job = mock.job()
+    h.state.upsert_job(h.next_index(), job)
+    ev = register_eval(job)
+    h.process(new_service_scheduler, ev)
+
+    # all attempts rejected → failed status + blocked eval with max-plans
+    blocked = [e for e in h.create_evals if e.triggered_by == s.EVAL_TRIGGER_MAX_PLANS]
+    assert len(blocked) == 1
+    update = h.evals[-1]
+    assert update.status == s.EVAL_STATUS_FAILED
+
+
+def test_batch_ignores_successful_terminal():
+    h, _ = make_harness(2)
+    job = mock.batch_job()
+    job.task_groups[0].count = 1
+    h.state.upsert_job(h.next_index(), job)
+    h.process(new_batch_scheduler, register_eval(job))
+    allocs = h.state.allocs_by_job(None, job.id, True)
+    assert len(allocs) == 1
+
+    # mark it complete + successful
+    done = allocs[0].copy()
+    done.client_status = s.ALLOC_CLIENT_STATUS_COMPLETE
+    done.task_states = {
+        "web": s.TaskState(state=s.TASK_STATE_DEAD, events=[
+            s.TaskEvent(type=s.TASK_TERMINATED, exit_code=0)])
+    }
+    h.state.update_allocs_from_client(h.next_index(), [done])
+
+    h2 = Harness(h.state)
+    h2._next_index = h._next_index
+    h2.process(new_batch_scheduler, register_eval(job))
+    # completed batch alloc must NOT be replaced
+    assert h2.plans == []
+
+
+def test_batch_failed_is_replaced():
+    h, _ = make_harness(2)
+    job = mock.batch_job()
+    job.task_groups[0].count = 1
+    h.state.upsert_job(h.next_index(), job)
+    h.process(new_batch_scheduler, register_eval(job))
+    allocs = h.state.allocs_by_job(None, job.id, True)
+
+    failed = allocs[0].copy()
+    failed.client_status = s.ALLOC_CLIENT_STATUS_FAILED
+    h.state.update_allocs_from_client(h.next_index(), [failed])
+
+    h2 = Harness(h.state)
+    h2._next_index = h._next_index
+    h2.process(new_batch_scheduler, register_eval(job))
+    placed = [a for allocs_ in h2.plans[0].node_allocation.values() for a in allocs_]
+    assert len(placed) == 1
+    assert placed[0].previous_allocation == failed.id
+
+
+def test_anti_affinity_spreads_allocs():
+    h, _ = make_harness(10)
+    job = mock.job()
+    job.task_groups[0].count = 10
+    h.state.upsert_job(h.next_index(), job)
+    h.process(new_service_scheduler, register_eval(job))
+    placed_per_node = {nid: len(allocs)
+                      for nid, allocs in h.plans[0].node_allocation.items()}
+    # with anti-affinity and 10 nodes x 10 allocs, no node should be heavily
+    # stacked (each collision costs 20 points vs binpack's max 18)
+    assert max(placed_per_node.values()) <= 3
+
+
+def test_plan_annotations():
+    h, _ = make_harness(2)
+    job = mock.job()
+    job.task_groups[0].count = 2
+    h.state.upsert_job(h.next_index(), job)
+    ev = register_eval(job)
+    ev.annotate_plan = True
+    h.process(new_service_scheduler, ev)
+    plan = h.plans[0]
+    assert plan.annotations is not None
+    desired = plan.annotations.desired_tg_updates["web"]
+    assert desired.place == 2

@@ -1,0 +1,278 @@
+"""L1 tests: state store (reference: nomad/state/state_store_test.go)."""
+import threading
+import time
+
+from nomad_tpu import mock
+from nomad_tpu.state import PeriodicLaunch, StateStore, WatchSet
+from nomad_tpu.structs import structs as s
+
+
+def test_upsert_node_indexes():
+    store = StateStore()
+    n = mock.node()
+    store.upsert_node(1000, n)
+    out = store.node_by_id(None, n.id)
+    assert out.create_index == 1000
+    assert out.modify_index == 1000
+    # update preserves create index
+    n2 = out.copy()
+    n2.name = "renamed"
+    store.upsert_node(1001, n2)
+    out = store.node_by_id(None, n.id)
+    assert out.create_index == 1000
+    assert out.modify_index == 1001
+    assert store.table_index("nodes") == 1001
+
+
+def test_node_status_and_drain():
+    store = StateStore()
+    n = mock.node()
+    store.upsert_node(1, n)
+    store.update_node_status(2, n.id, s.NODE_STATUS_DOWN)
+    assert store.node_by_id(None, n.id).status == s.NODE_STATUS_DOWN
+    store.update_node_drain(3, n.id, True)
+    assert store.node_by_id(None, n.id).drain
+
+
+def test_upsert_job_versions_and_summary():
+    store = StateStore()
+    j = mock.job()
+    store.upsert_job(1000, j)
+    out = store.job_by_id(None, j.id)
+    assert out.version == 0
+    assert out.status == s.JOB_STATUS_PENDING
+    summary = store.job_summary_by_id(None, j.id)
+    assert "web" in summary.summary
+    # re-register bumps version, keeps create index
+    j2 = out.copy()
+    j2.priority = 70
+    store.upsert_job(1001, j2)
+    out2 = store.job_by_id(None, j.id)
+    assert out2.version == 1
+    assert out2.create_index == 1000
+    versions = store.job_versions_by_id(None, j.id)
+    assert [v.version for v in versions] == [1, 0]
+    assert store.job_by_id_and_version(None, j.id, 0).priority == 50
+
+
+def test_delete_job():
+    store = StateStore()
+    j = mock.job()
+    store.upsert_job(1, j)
+    store.delete_job(2, j.id)
+    assert store.job_by_id(None, j.id) is None
+    assert store.job_summary_by_id(None, j.id) is None
+
+
+def test_upsert_evals_sets_job_pending_and_queued():
+    store = StateStore()
+    j = mock.job()
+    store.upsert_job(1, j)
+    ev = mock.eval()
+    ev.job_id = j.id
+    ev.queued_allocations = {"web": 4}
+    store.upsert_evals(2, [ev])
+    assert store.eval_by_id(None, ev.id).create_index == 2
+    assert store.job_by_id(None, j.id).status == s.JOB_STATUS_PENDING
+    assert store.job_summary_by_id(None, j.id).summary["web"].queued == 4
+    assert store.evals_by_job(None, j.id)[0].id == ev.id
+
+
+def test_successful_eval_cancels_blocked():
+    store = StateStore()
+    j = mock.job()
+    store.upsert_job(1, j)
+    blocked = mock.eval()
+    blocked.job_id = j.id
+    blocked.status = s.EVAL_STATUS_BLOCKED
+    store.upsert_evals(2, [blocked])
+    done = mock.eval()
+    done.job_id = j.id
+    done.status = s.EVAL_STATUS_COMPLETE
+    store.upsert_evals(3, [done])
+    assert store.eval_by_id(None, blocked.id).status == s.EVAL_STATUS_CANCELLED
+
+
+def test_upsert_allocs_and_queries():
+    store = StateStore()
+    j = mock.job()
+    store.upsert_job(1, j)
+    a = mock.alloc()
+    a.job = store.job_by_id(None, j.id)
+    a.job_id = j.id
+    store.upsert_allocs(2, [a])
+    assert store.alloc_by_id(None, a.id).create_index == 2
+    assert [x.id for x in store.allocs_by_node(None, a.node_id)] == [a.id]
+    assert [x.id for x in store.allocs_by_job(None, j.id)] == [a.id]
+    assert [x.id for x in store.allocs_by_eval(None, a.eval_id)] == [a.id]
+    # non-terminal alloc → job running
+    assert store.job_by_id(None, j.id).status == s.JOB_STATUS_RUNNING
+    # terminal filter
+    assert store.allocs_by_node_terminal(None, a.node_id, True) == []
+    assert len(store.allocs_by_node_terminal(None, a.node_id, False)) == 1
+
+
+def test_update_allocs_from_client_summary_transitions():
+    store = StateStore()
+    j = mock.job()
+    store.upsert_job(1, j)
+    a = mock.alloc()
+    a.job = store.job_by_id(None, j.id)
+    a.job_id = j.id
+    store.upsert_allocs(2, [a])
+    summary = store.job_summary_by_id(None, j.id)
+    assert summary.summary["web"].starting == 1
+
+    update = a.copy()
+    update.client_status = s.ALLOC_CLIENT_STATUS_RUNNING
+    store.update_allocs_from_client(3, [update])
+    stored = store.alloc_by_id(None, a.id)
+    assert stored.client_status == s.ALLOC_CLIENT_STATUS_RUNNING
+    summary = store.job_summary_by_id(None, j.id)
+    assert summary.summary["web"].running == 1
+    assert summary.summary["web"].starting == 0
+
+    update2 = stored.copy()
+    update2.client_status = s.ALLOC_CLIENT_STATUS_COMPLETE
+    store.update_allocs_from_client(4, [update2])
+    summary = store.job_summary_by_id(None, j.id)
+    assert summary.summary["web"].complete == 1
+    assert summary.summary["web"].running == 0
+
+
+def test_upsert_allocs_preserves_client_fields():
+    store = StateStore()
+    j = mock.job()
+    store.upsert_job(1, j)
+    a = mock.alloc()
+    a.job = store.job_by_id(None, j.id)
+    a.job_id = j.id
+    store.upsert_allocs(2, [a])
+    upd = a.copy()
+    upd.client_status = s.ALLOC_CLIENT_STATUS_RUNNING
+    store.update_allocs_from_client(3, [upd])
+    # server-side re-upsert (e.g. desired status change) must not clobber
+    # the client-authoritative status
+    server_view = a.copy()
+    server_view.desired_status = s.ALLOC_DESIRED_STATUS_STOP
+    store.upsert_allocs(4, [server_view])
+    stored = store.alloc_by_id(None, a.id)
+    assert stored.desired_status == s.ALLOC_DESIRED_STATUS_STOP
+    assert stored.client_status == s.ALLOC_CLIENT_STATUS_RUNNING
+
+
+def test_snapshot_isolation():
+    store = StateStore()
+    n = mock.node()
+    store.upsert_node(1, n)
+    snap = store.snapshot()
+    n2 = mock.node()
+    store.upsert_node(2, n2)
+    assert len(store.nodes(None)) == 2
+    assert len(snap.nodes(None)) == 1
+    # writes to the snapshot stay local (plan-apply optimistic application)
+    snap.upsert_node(3, mock.node())
+    assert len(snap.nodes(None)) == 2
+    assert len(store.nodes(None)) == 2
+    assert store.latest_index() == 2
+
+
+def test_upsert_plan_results_builds_resources():
+    store = StateStore()
+    j = mock.job()
+    store.upsert_job(1, j)
+    a = mock.alloc()
+    a.job = None
+    a.job_id = j.id
+    a.resources = None  # plan allocs carry only task resources
+    store.upsert_plan_results(2, store.job_by_id(None, j.id), [a])
+    stored = store.alloc_by_id(None, a.id)
+    assert stored.job is not None
+    assert stored.resources.cpu == 500
+    assert stored.resources.disk_mb == 150  # shared resources folded in
+
+
+def test_periodic_launch_table():
+    store = StateStore()
+    launch = PeriodicLaunch(id="job1", launch=12345.0)
+    store.upsert_periodic_launch(5, launch)
+    out = store.periodic_launch_by_id(None, "job1")
+    assert out.launch == 12345.0
+    assert out.create_index == 5
+    store.delete_periodic_launch(6, "job1")
+    assert store.periodic_launch_by_id(None, "job1") is None
+
+
+def test_delete_eval_and_allocs():
+    store = StateStore()
+    j = mock.job()
+    store.upsert_job(1, j)
+    ev = mock.eval()
+    ev.job_id = j.id
+    store.upsert_evals(2, [ev])
+    a = mock.alloc()
+    a.job_id = j.id
+    a.eval_id = ev.id
+    store.upsert_allocs(3, [a])
+    store.delete_eval(4, [ev.id], [a.id])
+    assert store.eval_by_id(None, ev.id) is None
+    assert store.alloc_by_id(None, a.id) is None
+    # eval_delete=True with no remaining evals/allocs → job dead
+    assert store.job_by_id(None, j.id).status == s.JOB_STATUS_DEAD
+
+
+def test_persist_restore_roundtrip():
+    store = StateStore()
+    j = mock.job()
+    store.upsert_job(1, j)
+    n = mock.node()
+    store.upsert_node(2, n)
+    a = mock.alloc()
+    a.job_id = j.id
+    store.upsert_allocs(3, [a])
+    blob = store.persist()
+    restored = StateStore.restore(blob)
+    assert restored.job_by_id(None, j.id).id == j.id
+    assert restored.node_by_id(None, n.id).id == n.id
+    assert [x.id for x in restored.allocs_by_job(None, j.id, all_allocs=True)] == [a.id]
+    assert restored.latest_index() == 3
+
+
+def test_blocking_watchset():
+    store = StateStore()
+    ws = WatchSet()
+    store.nodes(ws)
+    fired = []
+
+    def waiter():
+        timed_out = ws.watch(timeout=5.0)
+        fired.append(timed_out)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    store.upsert_node(1, mock.node())
+    t.join(timeout=5.0)
+    assert fired == [False]  # woke due to write, not timeout
+
+
+def test_watchset_timeout():
+    store = StateStore()
+    ws = WatchSet()
+    store.jobs(ws)
+    assert ws.watch(timeout=0.05) is True
+
+
+def test_reconcile_job_summaries():
+    store = StateStore()
+    j = mock.job()
+    store.upsert_job(1, j)
+    a = mock.alloc()
+    a.job = store.job_by_id(None, j.id)
+    a.job_id = j.id
+    a.client_status = s.ALLOC_CLIENT_STATUS_RUNNING
+    store.upsert_allocs(2, [a])
+    # clobber summary then rebuild
+    store.job_summary_table[j.id] = s.JobSummary(job_id=j.id)
+    store.reconcile_job_summaries(3)
+    assert store.job_summary_by_id(None, j.id).summary["web"].running == 1

@@ -1,0 +1,106 @@
+"""System scheduler tests (reference: scheduler/system_sched_test.go)."""
+from nomad_tpu import mock
+from nomad_tpu.scheduler import Harness, new_system_scheduler
+from nomad_tpu.structs import structs as s
+
+
+def make_harness(num_nodes=10):
+    h = Harness()
+    nodes = []
+    for _ in range(num_nodes):
+        n = mock.node()
+        h.state.upsert_node(h.next_index(), n)
+        nodes.append(n)
+    return h, nodes
+
+
+def sys_eval(job, triggered_by=s.EVAL_TRIGGER_JOB_REGISTER):
+    return s.Evaluation(
+        id=s.generate_uuid(),
+        priority=job.priority,
+        triggered_by=triggered_by,
+        job_id=job.id,
+        status=s.EVAL_STATUS_PENDING,
+        type=s.JOB_TYPE_SYSTEM,
+    )
+
+
+def test_system_places_on_every_node():
+    h, nodes = make_harness(10)
+    job = mock.system_job()
+    h.state.upsert_job(h.next_index(), job)
+    h.process(new_system_scheduler, sys_eval(job))
+    plan = h.plans[0]
+    placed = [a for allocs in plan.node_allocation.values() for a in allocs]
+    assert len(placed) == 10
+    assert set(plan.node_allocation) == {n.id for n in nodes}
+    h.assert_eval_status(s.EVAL_STATUS_COMPLETE)
+
+
+def test_system_skips_infeasible_nodes():
+    h, nodes = make_harness(5)
+    # two nodes lack the exec driver
+    for n in nodes[:2]:
+        stored = h.state.node_by_id(None, n.id).copy()
+        del stored.attributes["driver.exec"]
+        stored.compute_class()
+        h.state.upsert_node(h.next_index(), stored)
+    job = mock.system_job()
+    h.state.upsert_job(h.next_index(), job)
+    h.process(new_system_scheduler, sys_eval(job))
+    placed = [a for allocs in h.plans[0].node_allocation.values() for a in allocs]
+    assert len(placed) == 3
+    # filtered nodes don't count as queued failures
+    assert h.evals[0].queued_allocations == {"web": 0}
+
+
+def test_system_new_node_gets_alloc():
+    h, _ = make_harness(3)
+    job = mock.system_job()
+    h.state.upsert_job(h.next_index(), job)
+    h.process(new_system_scheduler, sys_eval(job))
+
+    new_node = mock.node()
+    h.state.upsert_node(h.next_index(), new_node)
+    h2 = Harness(h.state)
+    h2._next_index = h._next_index
+    h2.process(new_system_scheduler, sys_eval(job, s.EVAL_TRIGGER_NODE_UPDATE))
+    placed = [a for allocs in h2.plans[0].node_allocation.values() for a in allocs]
+    assert len(placed) == 1
+    assert placed[0].node_id == new_node.id
+
+
+def test_system_down_node_stops_alloc():
+    h, nodes = make_harness(3)
+    job = mock.system_job()
+    h.state.upsert_job(h.next_index(), job)
+    h.process(new_system_scheduler, sys_eval(job))
+
+    down = nodes[0]
+    h.state.update_node_status(h.next_index(), down.id, s.NODE_STATUS_DOWN)
+    h2 = Harness(h.state)
+    h2._next_index = h._next_index
+    h2.process(new_system_scheduler, sys_eval(job, s.EVAL_TRIGGER_NODE_UPDATE))
+    plan = h2.plans[0]
+    stopped = [a for allocs in plan.node_update.values() for a in allocs]
+    assert len(stopped) == 1
+    assert stopped[0].node_id == down.id
+    assert stopped[0].client_status == s.ALLOC_CLIENT_STATUS_LOST
+    # system jobs never migrate — no replacement placement on live nodes
+    assert plan.node_allocation == {}
+
+
+def test_system_deregister_stops_all():
+    h, _ = make_harness(3)
+    job = mock.system_job()
+    h.state.upsert_job(h.next_index(), job)
+    h.process(new_system_scheduler, sys_eval(job))
+
+    stopped_job = h.state.job_by_id(None, job.id).copy()
+    stopped_job.stop = True
+    h.state.upsert_job(h.next_index(), stopped_job)
+    h2 = Harness(h.state)
+    h2._next_index = h._next_index
+    h2.process(new_system_scheduler, sys_eval(job, s.EVAL_TRIGGER_JOB_DEREGISTER))
+    stopped = [a for allocs in h2.plans[0].node_update.values() for a in allocs]
+    assert len(stopped) == 3
