@@ -1,0 +1,170 @@
+"""Headline benchmark: batched TPU scheduling throughput vs the CPU oracle.
+
+Config (b) from BASELINE.json: 10k nodes × 100k task-groups, CPU+mem-only
+bin-pack.  The CPU oracle (our faithful GenericScheduler implementation) is
+timed on a placement subsample to establish the baseline rate — the
+reference publishes no absolute numbers (BASELINE.md), so phase-0 is to
+measure the oracle ourselves.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+plus human-readable detail on stderr.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+N_NODES = 10_000
+N_JOBS = 100
+COUNT_PER_JOB = 1_000          # 100k task-groups total
+ORACLE_SAMPLE_JOBS = 2         # oracle baseline sample: 2 jobs x 100 count
+ORACLE_COUNT_PER_JOB = 100
+
+
+def log(*args):
+    print(*args, file=sys.stderr, flush=True)
+
+
+def build_cluster(h, n_nodes):
+    from nomad_tpu import mock
+
+    base = mock.node()
+    for i in range(n_nodes):
+        node = base.copy()
+        node.id = f"node-{i:06d}"
+        node.name = f"node-{i:06d}"
+        node.resources.networks = []
+        if node.reserved:
+            node.reserved.networks = []
+        node.computed_class = base.computed_class or "v1:bench"
+        h.state.upsert_node(h.next_index(), node)
+
+
+def make_job(count):
+    from nomad_tpu import mock
+
+    job = mock.job()
+    job.task_groups[0].count = count
+    for tg in job.task_groups:
+        for t in tg.tasks:
+            t.resources.networks = []
+    return job
+
+
+def reg_eval(job):
+    from nomad_tpu.structs import structs as s
+
+    return s.Evaluation(
+        id=s.generate_uuid(), priority=job.priority, type=job.type,
+        triggered_by=s.EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
+        status=s.EVAL_STATUS_PENDING)
+
+
+def bench_oracle() -> float:
+    """Placements/sec of the CPU oracle on a subsample."""
+    from nomad_tpu.scheduler import Harness, new_service_scheduler
+
+    h = Harness()
+    build_cluster(h, N_NODES)
+    jobs = [make_job(ORACLE_COUNT_PER_JOB) for _ in range(ORACLE_SAMPLE_JOBS)]
+    for j in jobs:
+        h.state.upsert_job(h.next_index(), j)
+    evals = [reg_eval(j) for j in jobs]
+
+    t0 = time.monotonic()
+    for ev in evals:
+        h.process(new_service_scheduler, ev)
+    elapsed = time.monotonic() - t0
+    placed = sum(
+        len(h.state.allocs_by_job(None, j.id, True)) for j in jobs)
+    rate = placed / elapsed
+    log(f"oracle: {placed} placements in {elapsed:.2f}s → {rate:.0f} tg/s")
+    return rate
+
+
+def bench_tpu() -> tuple[float, int, dict]:
+    """Task-groups/sec of the batched device path on the full config."""
+    import jax
+
+    from nomad_tpu.scheduler import Harness, new_scheduler
+    from nomad_tpu.ops import batch_sched  # noqa: F401 — registers factory
+
+    log(f"devices: {jax.devices()}")
+    h = Harness()
+    build_cluster(h, N_NODES)
+    jobs = [make_job(COUNT_PER_JOB) for _ in range(N_JOBS)]
+    for j in jobs:
+        h.state.upsert_job(h.next_index(), j)
+    evals = [reg_eval(j) for j in jobs]
+
+    sched = new_scheduler("tpu-batch", h.logger, h.snapshot(), h)
+
+    # Warm-up compile on the same shapes (first XLA compile is slow and is
+    # not the steady-state number; recompiles are avoided by padding).
+    warm = new_scheduler("tpu-batch", h.logger, h.snapshot(), Null_planner())
+    t0 = time.monotonic()
+    warm.schedule_batch([evals[0]])
+    log(f"warm-up (compile) pass: {time.monotonic() - t0:.2f}s")
+
+    t0 = time.monotonic()
+    stats = sched.schedule_batch(evals)
+    elapsed = time.monotonic() - t0
+
+    placed = sum(len(h.state.allocs_by_job(None, j.id, True)) for j in jobs)
+    total_asks = stats.num_asks
+    rate = total_asks / elapsed
+    log(f"tpu-batch: {stats!r}")
+    log(f"tpu-batch: {placed} placed of {total_asks} asks in {elapsed:.2f}s "
+        f"→ {rate:.0f} tg/s")
+    detail = {
+        "placed": placed,
+        "asks": total_asks,
+        "elapsed_s": round(elapsed, 3),
+        "device_s": round(stats.device_seconds, 3),
+        "encode_s": round(stats.encode_seconds, 3),
+        "rounds": stats.rounds,
+        "platform": str(jax.devices()[0].platform),
+    }
+    return rate, placed, detail
+
+
+class Null_planner:
+    """Swallows plans during warm-up so state is untouched."""
+
+    def submit_plan(self, plan):
+        from nomad_tpu.structs import structs as s
+
+        return s.PlanResult(node_update=plan.node_update,
+                            node_allocation=plan.node_allocation), None
+
+    def update_eval(self, ev):
+        pass
+
+    def create_eval(self, ev):
+        pass
+
+    def reblock_eval(self, ev):
+        pass
+
+
+def main():
+    oracle_rate = bench_oracle()
+    tpu_rate, placed, detail = bench_tpu()
+    vs = tpu_rate / oracle_rate if oracle_rate > 0 else 0.0
+    out = {
+        "metric": "scheduled_taskgroups_per_sec (10k nodes x 100k tgs, cpu+mem binpack)",
+        "value": round(tpu_rate, 1),
+        "unit": "taskgroups/s",
+        "vs_baseline": round(vs, 2),
+        "detail": detail,
+    }
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

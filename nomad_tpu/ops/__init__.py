@@ -1,0 +1,23 @@
+"""TPU batch-scheduling kernels and the 'tpu-batch' scheduler.
+
+Importing this package registers the 'tpu-batch' factory with the
+scheduler registry.
+"""
+
+from .batch_sched import BatchStats, TPUBatchScheduler, new_tpu_batch_scheduler
+from .encode import (
+    ClusterTensors,
+    PlacementSpec,
+    SpecTensors,
+    build_spec,
+    collect_attr_targets,
+    encode_cluster,
+    encode_specs,
+    finalize_codebooks,
+)
+from .kernels import (
+    PlacementResult,
+    batch_allocs_fit,
+    feasibility_matrix,
+    placement_rounds,
+)

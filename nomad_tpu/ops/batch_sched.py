@@ -1,0 +1,342 @@
+"""The TPU batch scheduler: drains evaluations into fixed-size batches and
+scores all pending task groups against all candidate nodes in one vectorized
+pass (BASELINE.json north star).
+
+Architecture (SURVEY.md §2.9 'batching replaces concurrency'):
+
+- Host side reuses the oracle's reconciliation exactly — diffAllocs, stop/
+  migrate/lost handling, in-place updates (generic_sched.go:350) — so every
+  semantic except the placement inner loop is shared code with the CPU
+  oracle.
+- The placement inner loop (generic_sched.go:434 computePlacements ×
+  stack.Select) is replaced: all (job, tg) placement asks across the whole
+  eval batch are deduped into PlacementSpecs, encoded to SoA tensors, and
+  placed by ops/kernels.py in one device invocation.
+- Results flow back through the normal Plan/submit path unchanged, keeping
+  the plan-apply optimistic-concurrency contract (plan_apply.go:42).
+
+The per-JobID serialization invariant (eval_broker.go:56) is preserved by
+construction: a batch never contains two evals for the same job (the broker
+already guarantees at most one outstanding eval per job).
+"""
+from __future__ import annotations
+
+import logging
+import random
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..scheduler.generic import GenericScheduler
+from ..scheduler.scheduler import register_scheduler
+from ..scheduler.util import AllocTuple, ready_nodes_in_dcs, set_status
+from ..structs import structs as s
+from . import encode
+from .kernels import feasibility_matrix, placement_rounds
+
+logger = logging.getLogger("nomad_tpu.ops.batch_sched")
+
+
+class _CollectingScheduler(GenericScheduler):
+    """A GenericScheduler whose placement loop *collects* asks instead of
+    selecting nodes — everything else (diff, stops, in-place updates,
+    rolling limits, blocked evals) is inherited oracle behavior."""
+
+    def __init__(self, logger_, state, planner, batch: bool):
+        super().__init__(logger_, state, planner, batch)
+        self.pending_place: List[AllocTuple] = []
+        self.nodes_by_dc: Dict[str, int] = {}
+
+    def _compute_placements(self, place: List[AllocTuple]) -> None:
+        _, by_dc = ready_nodes_in_dcs(self.state, self.job.datacenters)
+        self.nodes_by_dc = by_dc
+        self.pending_place = list(place)
+
+
+class TPUBatchScheduler:
+    """Factory-registered 'tpu-batch' scheduler.
+
+    process(eval) handles one eval (worker compatibility);
+    schedule_batch(evals) is the high-throughput entry the batch worker
+    drains the broker into.
+    """
+
+    def __init__(self, logger_: logging.Logger, state, planner):
+        self.logger = logger_
+        self.state = state
+        self.planner = planner
+
+    # -- single-eval compatibility ----------------------------------------
+
+    def process(self, ev: s.Evaluation) -> None:
+        self.schedule_batch([ev])
+
+    # -- batch path --------------------------------------------------------
+
+    def schedule_batch(self, evals: List[s.Evaluation]) -> "BatchStats":
+        """Run the host phase for every eval, one device placement pass for
+        all of them, then finalize plans/statuses per eval."""
+        stats = BatchStats()
+        t0 = time.monotonic()
+
+        # Phase 1: host reconciliation per eval (shared oracle code).
+        scheds: List[Tuple[s.Evaluation, _CollectingScheduler]] = []
+        for ev in evals:
+            sched = _CollectingScheduler(
+                self.logger, self.state, self.planner,
+                batch=(ev.type == s.JOB_TYPE_BATCH))
+            sched.eval = ev
+            sched.job = self.state.job_by_id(None, ev.job_id)
+            sched.plan = ev.make_plan(sched.job)
+            from ..scheduler.context import EvalContext
+
+            sched.ctx = EvalContext(self.state, sched.plan, self.logger)
+            from ..scheduler.stack import GenericStack
+
+            sched.stack = GenericStack(sched.batch, sched.ctx)
+            if sched.job is not None and not sched.job.stopped():
+                sched.stack.set_job(sched.job)
+            sched._compute_job_allocs()
+            scheds.append((ev, sched))
+
+        # Phase 2: dedup placement asks into specs.
+        specs: Dict[Tuple[str, str], encode.PlacementSpec] = {}
+        for ev, sched in scheds:
+            for tup in sched.pending_place:
+                key = (sched.job.id, tup.task_group.name)
+                spec = specs.get(key)
+                if spec is None:
+                    spec = encode.build_spec(sched.job, tup.task_group, sched.batch)
+                    specs[key] = spec
+                spec.names.append(tup.name)
+                spec.prev_alloc_ids.append(tup.alloc.id if tup.alloc else None)
+                spec.eval_ids.append(ev.id)
+
+        spec_list = sorted(specs.values(), key=lambda sp: -sp.priority)
+        stats.num_specs = len(spec_list)
+        stats.num_asks = sum(sp.count for sp in spec_list)
+
+        assignments: Dict[Tuple[str, str], List[Tuple[str, int]]] = {}
+        unplaced: Dict[Tuple[str, str], int] = {}
+        per_spec_metrics: Dict[Tuple[str, str], s.AllocMetric] = {}
+
+        if spec_list:
+            assignments, unplaced, per_spec_metrics, kstats = self._place_on_device(
+                spec_list)
+            stats.device_seconds = kstats["device_seconds"]
+            stats.encode_seconds = kstats["encode_seconds"]
+            stats.rounds = kstats["rounds"]
+
+        # Phase 3: materialize allocs into each eval's plan and submit.
+        for ev, sched in scheds:
+            self._finalize(ev, sched, assignments, unplaced, per_spec_metrics)
+
+        stats.total_seconds = time.monotonic() - t0
+        stats.num_evals = len(evals)
+        return stats
+
+    # -- device pass -------------------------------------------------------
+
+    def _place_on_device(self, spec_list: List[encode.PlacementSpec]):
+        t0 = time.monotonic()
+        # All DCs across the batch: nodes are encoded once.
+        all_nodes = [n for n in self.state.nodes(None)]
+
+        attr_targets, literals = encode.collect_attr_targets(spec_list)
+        allocs_by_node: Dict[str, List[s.Allocation]] = defaultdict(list)
+        for alloc in self.state.allocs(None):
+            if not alloc.terminal_status():
+                allocs_by_node[alloc.node_id].append(alloc)
+
+        ct = encode.encode_cluster(all_nodes, attr_targets, allocs_by_node)
+        encode.finalize_codebooks(ct, literals)
+        st = encode.encode_specs(spec_list, ct, all_nodes)
+
+        # Existing per-(job, node) alloc counts for anti-affinity/distinct.
+        j_rows = len(st.job_ids)
+        job_counts = np.zeros((max(1, j_rows), ct.n_pad), dtype=np.int32)
+        node_index = {nid: i for i, nid in enumerate(ct.node_ids)}
+        for j, job_id in enumerate(st.job_ids):
+            for alloc in self.state.allocs_by_job(None, job_id, False):
+                if alloc.terminal_status():
+                    continue
+                idx = node_index.get(alloc.node_id)
+                if idx is not None:
+                    job_counts[j, idx] += 1
+
+        encode_seconds = time.monotonic() - t0
+        t1 = time.monotonic()
+
+        feas = feasibility_matrix(
+            jax.numpy.asarray(ct.attr_values),
+            jax.numpy.asarray(ct.eligible),
+            jax.numpy.asarray(ct.dc_code),
+            jax.numpy.asarray(st.constraint_attr),
+            jax.numpy.asarray(st.constraint_op),
+            jax.numpy.asarray(st.constraint_rhs),
+            jax.numpy.asarray(st.dc_mask),
+            jax.numpy.asarray(st.precomp),
+        )
+        result = placement_rounds(
+            feas,
+            jax.numpy.asarray(ct.used.astype(np.int32)),
+            jax.numpy.asarray(ct.capacity.astype(np.int32)),
+            jax.numpy.asarray(ct.score_denom),
+            jax.numpy.asarray(st.ask.astype(np.int32)),
+            jax.numpy.asarray(st.count),
+            jax.numpy.asarray(st.penalty),
+            jax.numpy.asarray(st.distinct_hosts),
+            jax.numpy.asarray(st.job_index),
+            jax.numpy.asarray(job_counts),
+            jax.random.PRNGKey(int.from_bytes(s.generate_uuid()[:8].encode(), "big") & 0x7FFFFFFF),
+        )
+        placements = np.asarray(jax.device_get(result.placements))
+        unplaced_arr = np.asarray(jax.device_get(result.unplaced))
+        feas_np = np.asarray(jax.device_get(feas))
+        rounds = int(jax.device_get(result.rounds))
+        device_seconds = time.monotonic() - t1
+
+        assignments: Dict[Tuple[str, str], List[Tuple[str, int]]] = {}
+        unplaced: Dict[Tuple[str, str], int] = {}
+        metrics: Dict[Tuple[str, str], s.AllocMetric] = {}
+        for u, sp in enumerate(spec_list):
+            key = (sp.job.id, sp.tg.name)
+            nz = np.nonzero(placements[u])[0]
+            assignments[key] = [(ct.node_ids[i], int(placements[u, i]))
+                                for i in nz if i < ct.n_real]
+            unplaced[key] = int(unplaced_arr[u])
+
+            # AllocMetric parity from kernel side-outputs
+            # (structs.go:4074 contract).
+            m = s.AllocMetric()
+            m.nodes_evaluated = ct.n_real
+            n_feasible = int(feas_np[u, :ct.n_real].sum())
+            m.nodes_filtered = ct.n_real - n_feasible
+            if unplaced[key] > 0:
+                m.nodes_exhausted = n_feasible - len(assignments[key])
+                m.dimension_exhausted["resources exhausted"] = m.nodes_exhausted
+                m.coalesced_failures = unplaced[key] - 1
+            metrics[key] = m
+
+        kstats = {
+            "device_seconds": device_seconds,
+            "encode_seconds": encode_seconds,
+            "rounds": rounds,
+        }
+        return assignments, unplaced, metrics, kstats
+
+    # -- finalize ----------------------------------------------------------
+
+    def _finalize(self, ev, sched, assignments, unplaced, per_spec_metrics) -> None:
+        """Expand per-spec (node, count) assignments into this eval's plan,
+        then submit + set status, mirroring generic_sched.go:104 Process."""
+        # Walk this eval's pending placements and pop assignment slots.
+        cursor: Dict[Tuple[str, str], int] = {}
+        expanded: Dict[Tuple[str, str], List[str]] = {}
+        for key, node_counts in assignments.items():
+            slots: List[str] = []
+            for node_id, cnt in node_counts:
+                slots.extend([node_id] * cnt)
+            expanded[key] = slots
+
+        for tup in sched.pending_place:
+            key = (sched.job.id, tup.task_group.name)
+            slots = expanded.get(key, [])
+            i = cursor.get(key, 0)
+            metric = per_spec_metrics.get(key, s.AllocMetric())
+            metric.nodes_available = sched.nodes_by_dc
+            if i < len(slots):
+                cursor[key] = i + 1
+                node_id = slots[i]
+                alloc = s.Allocation(
+                    id=s.generate_uuid(),
+                    eval_id=ev.id,
+                    name=tup.name,
+                    job_id=sched.job.id,
+                    task_group=tup.task_group.name,
+                    metrics=metric.copy(),
+                    node_id=node_id,
+                    task_resources={
+                        t.name: t.resources.copy() for t in tup.task_group.tasks},
+                    desired_status=s.ALLOC_DESIRED_STATUS_RUN,
+                    client_status=s.ALLOC_CLIENT_STATUS_PENDING,
+                    shared_resources=s.Resources(
+                        disk_mb=tup.task_group.ephemeral_disk.size_mb),
+                )
+                if tup.alloc is not None and tup.alloc.id:
+                    alloc.previous_allocation = tup.alloc.id
+                sched.plan.append_alloc(alloc)
+            else:
+                if sched.failed_tg_allocs is None:
+                    sched.failed_tg_allocs = {}
+                sched.failed_tg_allocs[tup.task_group.name] = metric
+
+        # Blocked eval for failures (generic_sched.go:218-227).
+        if (ev.status != s.EVAL_STATUS_BLOCKED and sched.failed_tg_allocs
+                and sched.blocked is None):
+            sched._create_blocked_eval(plan_failure=False)
+
+        if sched.plan.is_no_op() and not ev.annotate_plan:
+            set_status(self.logger, self.planner, ev, sched.next_eval,
+                       sched.blocked, sched.failed_tg_allocs,
+                       s.EVAL_STATUS_COMPLETE, "", sched.queued_allocs)
+            return
+
+        result, new_state = self.planner.submit_plan(sched.plan)
+        from ..scheduler.util import adjust_queued_allocations
+
+        adjust_queued_allocations(self.logger, result, sched.queued_allocs)
+
+        if new_state is not None or (
+                result is not None and not result.full_commit(sched.plan)[0]):
+            # Conflict: fall back to the oracle for this eval — the batch
+            # optimism is reconciled exactly as Nomad reconciles optimistic
+            # concurrency, by refresh-and-retry (plan_apply.go:27-41).
+            self.logger.info("batch plan conflict for eval %s; oracle retry", ev.id)
+            retry_state = new_state if new_state is not None else self.state
+            oracle = GenericScheduler(self.logger, retry_state, self.planner,
+                                      batch=(ev.type == s.JOB_TYPE_BATCH))
+            oracle.process(ev)
+            return
+
+        if ev.status == s.EVAL_STATUS_BLOCKED and sched.failed_tg_allocs:
+            e = sched.ctx.eligibility()
+            new_eval = ev.copy()
+            new_eval.escaped_computed_class = e.has_escaped()
+            new_eval.class_eligibility = e.get_classes()
+            self.planner.reblock_eval(new_eval)
+            return
+
+        set_status(self.logger, self.planner, ev, sched.next_eval, sched.blocked,
+                   sched.failed_tg_allocs, s.EVAL_STATUS_COMPLETE, "",
+                   sched.queued_allocs)
+
+
+class BatchStats:
+    """Instrumentation for one batch pass (telemetry parity: the
+    nomad.worker.invoke_scheduler metrics family)."""
+
+    def __init__(self) -> None:
+        self.num_evals = 0
+        self.num_specs = 0
+        self.num_asks = 0
+        self.encode_seconds = 0.0
+        self.device_seconds = 0.0
+        self.total_seconds = 0.0
+        self.rounds = 0
+
+    def __repr__(self) -> str:
+        return (f"BatchStats(evals={self.num_evals} specs={self.num_specs} "
+                f"asks={self.num_asks} encode={self.encode_seconds:.3f}s "
+                f"device={self.device_seconds:.3f}s total={self.total_seconds:.3f}s "
+                f"rounds={self.rounds})")
+
+
+def new_tpu_batch_scheduler(logger_, state, planner) -> TPUBatchScheduler:
+    return TPUBatchScheduler(logger_, state, planner)
+
+
+register_scheduler("tpu-batch", new_tpu_batch_scheduler)

@@ -1,0 +1,444 @@
+"""Tensor encoding: lowers the scheduler-visible state into SoA device
+tensors (SURVEY.md §7 step 1).
+
+Reference semantics being encoded:
+- node capacity / usage / score denominators — nomad/structs/funcs.go:60,123
+- attribute constraint targets — scheduler/feasible.go:397-458
+- computed-class dedup for non-vectorizable ops — scheduler/feasible.go:597,
+  scheduler/context.go:46 (EvalCache) — version/regex/set_contains checks are
+  evaluated host-side once per (constraint, computed-class) and shipped as
+  boolean rows, exactly the caching structure the reference uses.
+
+Ordered interning: each attribute key gets its own codebook whose codes are
+assigned in sorted-value order, so lexical <,<=,>,>= lower to integer
+compares on device.
+"""
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..structs import structs as s
+from ..scheduler.context import EvalContext
+from ..scheduler.feasible import (
+    check_constraint,
+    resolve_constraint_target,
+    _parse_bool,
+)
+from ..scheduler.util import task_group_constraints
+
+logger = logging.getLogger("nomad_tpu.ops.encode")
+
+# Constraint op codes on device (order matters: see ops/kernels.py).
+OP_TRUE = 0       # padding / pass-through
+OP_EQ = 1
+OP_NE = 2
+OP_LT = 3
+OP_LE = 4
+OP_GT = 5
+OP_GE = 6
+OP_PRECOMP = 7    # gather from the host-precomputed boolean row
+
+# Sentinel for "value missing on node" — any comparison with it fails.
+MISSING = np.int32(-1)
+# Sentinel rhs for "literal not representable": EQ always false, NE true.
+UNKNOWN_RHS = np.int32(-2)
+
+RES_DIMS = 4  # cpu, memory_mb, disk_mb, iops — structs.Resources.TENSOR_DIMS
+
+
+def _res_vec(r: Optional[s.Resources]) -> np.ndarray:
+    if r is None:
+        return np.zeros(RES_DIMS, dtype=np.int64)
+    return np.array([r.cpu, r.memory_mb, r.disk_mb, r.iops], dtype=np.int64)
+
+
+def round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+@dataclass
+class ClusterTensors:
+    """Device view of the node fleet.
+
+    All arrays are padded to ``n_pad`` (multiple of 128 — TPU lane width);
+    padding rows are marked ineligible.
+    """
+
+    node_ids: List[str]                 # dense index → node id (host only)
+    n_real: int
+    n_pad: int
+    capacity: np.ndarray                # [n_pad, 4] int32 — node.resources
+    used: np.ndarray                    # [n_pad, 4] int32 — reserved + live allocs
+    score_denom: np.ndarray             # [n_pad, 2] float32 — (cpu, mem) minus reserved
+    eligible: np.ndarray                # [n_pad] bool — ready & not draining
+    dc_code: np.ndarray                 # [n_pad] int32
+    class_code: np.ndarray              # [n_pad] int32
+    attr_values: np.ndarray             # [n_pad, n_attrs] int32 ordered codes
+    attr_index: Dict[str, int]          # target string → column
+    dc_codebook: Dict[str, int]
+    value_codebooks: Dict[str, Dict[str, int]]
+    job_count_rows: Dict[str, np.ndarray] = field(default_factory=dict)
+
+
+def encode_cluster(
+    nodes: Sequence[s.Node],
+    attr_targets: Sequence[str],
+    allocs_by_node: Optional[Dict[str, List[s.Allocation]]] = None,
+    node_pad_multiple: int = 128,
+) -> ClusterTensors:
+    """Build the cluster-side tensors.
+
+    attr_targets: every ``${...}``/literal LTarget referenced by any
+    vectorizable constraint in the batch; each becomes one int32 column.
+    """
+    n_real = len(nodes)
+    n_pad = max(node_pad_multiple, round_up(n_real, node_pad_multiple))
+
+    capacity = np.zeros((n_pad, RES_DIMS), dtype=np.int64)
+    used = np.zeros((n_pad, RES_DIMS), dtype=np.int64)
+    score_denom = np.ones((n_pad, 2), dtype=np.float32)
+    eligible = np.zeros(n_pad, dtype=bool)
+    dc_code = np.full(n_pad, MISSING, dtype=np.int32)
+    class_code = np.full(n_pad, MISSING, dtype=np.int32)
+
+    dc_codebook: Dict[str, int] = {}
+    class_codebook: Dict[str, int] = {}
+    node_ids: List[str] = []
+
+    for i, node in enumerate(nodes):
+        node_ids.append(node.id)
+        capacity[i] = _res_vec(node.resources)
+        reserved = _res_vec(node.reserved)
+        used[i] = reserved
+        if allocs_by_node:
+            for alloc in allocs_by_node.get(node.id, []):
+                if alloc.resources is not None:
+                    used[i] += _res_vec(alloc.resources)
+                else:
+                    used[i] += _res_vec(alloc.shared_resources)
+                    for tr in alloc.task_resources.values():
+                        used[i] += _res_vec(tr)
+        denom_cpu = float(capacity[i][0] - reserved[0])
+        denom_mem = float(capacity[i][1] - reserved[1])
+        score_denom[i] = (denom_cpu, denom_mem)
+        eligible[i] = node.ready()
+        dc_code[i] = dc_codebook.setdefault(node.datacenter, len(dc_codebook))
+        class_code[i] = class_codebook.setdefault(node.computed_class, len(class_codebook))
+
+    # Ordered value codebooks per attribute target: collect node values, sort,
+    # assign ranks — integer compare ≡ lexical compare.
+    attr_index = {t: j for j, t in enumerate(attr_targets)}
+    value_sets: Dict[str, Set[str]] = {t: set() for t in attr_targets}
+    resolved: List[Dict[str, Optional[str]]] = []
+    for node in nodes:
+        row: Dict[str, Optional[str]] = {}
+        for t in attr_targets:
+            val, ok = resolve_constraint_target(t, node)
+            if ok and isinstance(val, str):
+                row[t] = val
+                value_sets[t].add(val)
+            else:
+                row[t] = None
+        resolved.append(row)
+
+    value_codebooks: Dict[str, Dict[str, int]] = {
+        t: {} for t in attr_targets
+    }
+    attr_values = np.full((n_pad, max(1, len(attr_targets))), MISSING, dtype=np.int32)
+    # NOTE: codes are finalized in finalize_codebooks() once constraint
+    # literals are known; store raw values for now.
+    return_raw = resolved
+
+    ct = ClusterTensors(
+        node_ids=node_ids,
+        n_real=n_real,
+        n_pad=n_pad,
+        capacity=capacity,
+        used=used,
+        score_denom=score_denom,
+        eligible=eligible,
+        dc_code=dc_code,
+        class_code=class_code,
+        attr_values=attr_values,
+        attr_index=attr_index,
+        dc_codebook=dc_codebook,
+        value_codebooks=value_codebooks,
+    )
+    ct._raw_rows = return_raw          # type: ignore[attr-defined]
+    ct._value_sets = value_sets        # type: ignore[attr-defined]
+    ct._class_codebook = class_codebook  # type: ignore[attr-defined]
+    return ct
+
+
+def finalize_codebooks(ct: ClusterTensors, literals: Dict[str, Set[str]]) -> None:
+    """Merge constraint literals into the per-target value sets, assign
+    ordered codes, and fill the attr matrix."""
+    for target, vals in literals.items():
+        if target in ct._value_sets:  # type: ignore[attr-defined]
+            ct._value_sets[target].update(vals)  # type: ignore[attr-defined]
+    for target, vals in ct._value_sets.items():  # type: ignore[attr-defined]
+        ct.value_codebooks[target] = {v: i for i, v in enumerate(sorted(vals))}
+    for i, row in enumerate(ct._raw_rows):  # type: ignore[attr-defined]
+        for target, j in ct.attr_index.items():
+            val = row[target]
+            if val is not None:
+                ct.attr_values[i, j] = ct.value_codebooks[target][val]
+
+
+# Operand → op-code for the vectorizable subset (feasible.go:433-458).
+_VECTOR_OPS = {
+    "=": OP_EQ, "==": OP_EQ, "is": OP_EQ,
+    "!=": OP_NE, "not": OP_NE,
+    "<": OP_LT, "<=": OP_LE, ">": OP_GT, ">=": OP_GE,
+}
+
+
+@dataclass
+class PlacementSpec:
+    """One unique (job, task group) placement spec with its expansion count —
+    the reference's materializeTaskGroups dedup (util.go:22) turned into the
+    batch axis."""
+
+    job: s.Job
+    tg: s.TaskGroup
+    names: List[str]                    # alloc names to materialize, len=count
+    prev_alloc_ids: List[Optional[str]]
+    eval_ids: List[str]                 # parallel to names: owning eval
+    ask: np.ndarray = None              # [4] int64
+    priority: int = 50
+    anti_affinity_penalty: float = 20.0
+    distinct_hosts: bool = False
+    drivers: Set[str] = field(default_factory=set)
+    constraints: List[s.Constraint] = field(default_factory=list)
+    datacenters: List[str] = field(default_factory=list)
+
+    @property
+    def count(self) -> int:
+        return len(self.names)
+
+
+def build_spec(job: s.Job, tg: s.TaskGroup, batch_penalty: bool) -> PlacementSpec:
+    tup = task_group_constraints(tg)
+    all_constraints = list(job.constraints) + list(tup.constraints)
+    spec = PlacementSpec(
+        job=job,
+        tg=tg,
+        names=[],
+        prev_alloc_ids=[],
+        eval_ids=[],
+        ask=_res_vec(tup.size),
+        priority=job.priority,
+        anti_affinity_penalty=10.0 if batch_penalty else 20.0,
+        distinct_hosts=any(
+            c.operand == s.CONSTRAINT_DISTINCT_HOSTS for c in all_constraints),
+        drivers=tup.drivers,
+        constraints=all_constraints,
+        datacenters=list(job.datacenters),
+    )
+    return spec
+
+
+@dataclass
+class SpecTensors:
+    """Device view of the unique placement specs, padded to ``u_pad``."""
+
+    specs: List[PlacementSpec]
+    u_real: int
+    u_pad: int
+    ask: np.ndarray              # [u_pad, 4] int32
+    count: np.ndarray            # [u_pad] int32
+    priority: np.ndarray         # [u_pad] int32
+    penalty: np.ndarray          # [u_pad] float32
+    distinct_hosts: np.ndarray   # [u_pad] bool
+    dc_mask: np.ndarray          # [u_pad, n_dcs] bool
+    constraint_attr: np.ndarray  # [u_pad, k_max] int32 column index
+    constraint_op: np.ndarray    # [u_pad, k_max] int32 op code
+    constraint_rhs: np.ndarray   # [u_pad, k_max] int32 rhs code
+    precomp: np.ndarray          # [u_pad, n_pad] bool — non-vectorizable ANDs
+    job_index: np.ndarray        # [u_pad] int32 — same-job specs share a row
+    job_ids: List[str]
+
+
+def encode_specs(
+    specs: List[PlacementSpec],
+    ct: ClusterTensors,
+    nodes: Sequence[s.Node],
+    spec_pad_multiple: int = 8,
+) -> SpecTensors:
+    """Lower specs to tensors; split constraints into vectorizable triples
+    and host-precomputed boolean rows (cached per computed class, mirroring
+    EvalCache / FeasibilityWrapper semantics)."""
+    u_real = len(specs)
+    u_pad = max(spec_pad_multiple, round_up(u_real, spec_pad_multiple))
+    k_max = max(
+        [1] + [len(sp.constraints) + len(sp.drivers) for sp in specs])
+
+    ask = np.zeros((u_pad, RES_DIMS), dtype=np.int64)
+    count = np.zeros(u_pad, dtype=np.int32)
+    priority = np.zeros(u_pad, dtype=np.int32)
+    penalty = np.zeros(u_pad, dtype=np.float32)
+    distinct = np.zeros(u_pad, dtype=bool)
+    n_dcs = max(1, len(ct.dc_codebook))
+    dc_mask = np.zeros((u_pad, n_dcs), dtype=bool)
+    c_attr = np.zeros((u_pad, k_max), dtype=np.int32)
+    c_op = np.zeros((u_pad, k_max), dtype=np.int32)   # OP_TRUE padding
+    c_rhs = np.zeros((u_pad, k_max), dtype=np.int32)
+    precomp = np.ones((u_pad, ct.n_pad), dtype=bool)
+
+    job_ids: List[str] = []
+    job_row: Dict[str, int] = {}
+    job_index = np.zeros(u_pad, dtype=np.int32)
+
+    # Class-level cache for non-vectorizable checks: (constraint-key, class)
+    class_cache: Dict[Tuple[str, str, str, int], bool] = {}
+    eval_ctx = EvalContext(state=None, plan=s.Plan())  # caches only
+
+    for u, sp in enumerate(specs):
+        ask[u] = sp.ask
+        count[u] = sp.count
+        priority[u] = sp.priority
+        penalty[u] = sp.anti_affinity_penalty
+        distinct[u] = sp.distinct_hosts
+        for dc in sp.datacenters:
+            code = ct.dc_codebook.get(dc)
+            if code is not None:
+                dc_mask[u, code] = True
+        job_index[u] = job_row.setdefault(sp.job.id, len(job_row))
+
+        k = 0
+        # Drivers lower to EQ checks on interned "driver.X" columns when the
+        # column exists; otherwise to precomp rows.
+        for driver in sorted(sp.drivers):
+            target = "${attr.driver." + driver + "}"
+            col = ct.attr_index.get(target)
+            if col is None:
+                precomp[u, :ct.n_real] &= _driver_row(nodes, driver)
+                continue
+            # truthy values per strconv.ParseBool; precompute truth set codes
+            truthy = {
+                code for val, code in ct.value_codebooks[target].items()
+                if _parse_bool(val)
+            }
+            if len(truthy) == 1:
+                c_attr[u, k] = col
+                c_op[u, k] = OP_EQ
+                c_rhs[u, k] = next(iter(truthy))
+                k += 1
+            else:
+                precomp[u, :ct.n_real] &= _driver_row(nodes, driver)
+
+        for con in sp.constraints:
+            if con.operand in (s.CONSTRAINT_DISTINCT_HOSTS,
+                               s.CONSTRAINT_DISTINCT_PROPERTY):
+                continue
+            op_code = _VECTOR_OPS.get(con.operand)
+            col = ct.attr_index.get(con.ltarget)
+            rhs_literal = not con.rtarget.startswith("${")
+            if op_code is not None and col is not None and rhs_literal:
+                code = ct.value_codebooks[con.ltarget].get(con.rtarget, None)
+                c_attr[u, k] = col
+                c_op[u, k] = op_code
+                c_rhs[u, k] = UNKNOWN_RHS if code is None else code
+                k += 1
+            else:
+                # Host-evaluated per computed class (or per node if escaped):
+                # the same caching the reference does (feasible.go:597).
+                precomp[u, :ct.n_real] &= _constraint_row(
+                    nodes, con, ct, class_cache, eval_ctx)
+
+    st = SpecTensors(
+        specs=specs,
+        u_real=u_real,
+        u_pad=u_pad,
+        ask=ask,
+        count=count,
+        priority=priority,
+        penalty=penalty,
+        distinct_hosts=distinct,
+        dc_mask=dc_mask,
+        constraint_attr=c_attr,
+        constraint_op=c_op,
+        constraint_rhs=c_rhs,
+        precomp=precomp,
+        job_index=job_index,
+        job_ids=list(job_row),
+    )
+    return st
+
+
+def _driver_row(nodes: Sequence[s.Node], driver: str) -> np.ndarray:
+    out = np.zeros(len(nodes), dtype=bool)
+    key = f"driver.{driver}"
+    for i, node in enumerate(nodes):
+        val = node.attributes.get(key)
+        out[i] = bool(val is not None and _parse_bool(val))
+    return out
+
+
+def _escapes_class(constraint: s.Constraint) -> bool:
+    from ..structs.node_class import _target_escapes
+
+    return _target_escapes(constraint.ltarget) or _target_escapes(constraint.rtarget)
+
+
+def _constraint_row(
+    nodes: Sequence[s.Node],
+    con: s.Constraint,
+    ct: ClusterTensors,
+    class_cache: Dict,
+    eval_ctx: EvalContext,
+) -> np.ndarray:
+    """Evaluate one non-vectorizable constraint host-side, caching per
+    computed class unless the constraint escapes class semantics."""
+    out = np.zeros(len(nodes), dtype=bool)
+    escaped = _escapes_class(con)
+    for i, node in enumerate(nodes):
+        if not escaped and node.computed_class:
+            key = (con.ltarget, con.operand, con.rtarget, ct.class_code[i].item())
+            if key in class_cache:
+                out[i] = class_cache[key]
+                continue
+        ok = _check_on_node(eval_ctx, con, node)
+        out[i] = ok
+        if not escaped and node.computed_class:
+            class_cache[key] = ok
+    return out
+
+
+def _check_on_node(eval_ctx: EvalContext, con: s.Constraint, node: s.Node) -> bool:
+    lval, lok = resolve_constraint_target(con.ltarget, node)
+    if not lok:
+        return False
+    rval, rok = resolve_constraint_target(con.rtarget, node)
+    if not rok:
+        return False
+    return check_constraint(eval_ctx, con.operand, lval, rval)
+
+
+def collect_attr_targets(specs: List[PlacementSpec]) -> Tuple[List[str], Dict[str, Set[str]]]:
+    """The set of constraint LTargets that lower to int compares, plus the
+    literal RHS values to merge into each codebook."""
+    targets: List[str] = []
+    literals: Dict[str, Set[str]] = {}
+    seen: Set[str] = set()
+    for sp in specs:
+        for driver in sp.drivers:
+            t = "${attr.driver." + driver + "}"
+            if t not in seen:
+                seen.add(t)
+                targets.append(t)
+                literals.setdefault(t, set())
+        for con in sp.constraints:
+            if con.operand not in _VECTOR_OPS:
+                continue
+            if con.rtarget.startswith("${"):
+                continue
+            if con.ltarget not in seen:
+                seen.add(con.ltarget)
+                targets.append(con.ltarget)
+            literals.setdefault(con.ltarget, set()).add(con.rtarget)
+    return targets, literals

@@ -1,0 +1,10 @@
+"""Device-mesh parallelism for the batch scheduler."""
+
+from .sharded import (
+    BATCH_AXIS,
+    NODE_AXIS,
+    commit_candidates,
+    make_node_mesh,
+    sharded_candidate_scores,
+    sharded_schedule_step,
+)

@@ -1,0 +1,158 @@
+"""Device-mesh scale-out of the batch scheduler (SURVEY.md §2.9).
+
+The scaling axis of this workload is nodes × task-groups, and the node axis
+is embarrassingly shardable: each device scores its node shard, reduces to a
+local top-k per spec, and the k·D candidates are all-gathered over ICI —
+the moral equivalent of sequence parallelism for this workload.  The
+sequential commit loop then runs on the merged candidate set (U × k·D ≪
+U × N), preserving capacity feedback.
+
+Multi-slice (DCN) is the analogue of the reference's multi-region
+federation (nomad/rpc.go:263 forwardRegion): each slice owns a region's
+nodes; cross-slice placement goes through region forwarding, not through
+the mesh — so this module only ever shards within a slice.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.kernels import _score_fit
+
+NEG_INF = -1e30
+
+# Mesh axis names: 'nodes' shards the node dimension of the score matrix
+# (intra-slice, rides ICI); 'batch' is reserved for sharding the spec axis
+# across data-parallel replicas.
+NODE_AXIS = "nodes"
+BATCH_AXIS = "batch"
+
+
+def make_node_mesh(devices=None) -> Mesh:
+    """A 1-D mesh over the node axis."""
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.array(devices), (NODE_AXIS,))
+
+
+def _local_topk_scores(
+    feas_local: jnp.ndarray,     # [U, N_local] bool
+    used_local: jnp.ndarray,     # [N_local, 4] int32
+    capacity_local: jnp.ndarray, # [N_local, 4] int32
+    denom_local: jnp.ndarray,    # [N_local, 2] float32
+    ask: jnp.ndarray,            # [U, 4] int32 (replicated)
+    k: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-shard scoring + top-k: the FLOPs-heavy part of the scheduler.
+
+    Returns (scores[U, k], local_idx[U, k]).
+    """
+    u = ask.shape[0]
+
+    def score_one(u_idx):
+        cap_left = capacity_local - used_local
+        fits = jnp.all(ask[u_idx][None, :] <= cap_left, axis=1)
+        ok = feas_local[u_idx] & fits
+        score = _score_fit(used_local, ask[u_idx], denom_local)
+        scored = jnp.where(ok, score, NEG_INF)
+        return lax.top_k(scored, k)
+
+    scores, idx = jax.vmap(score_one)(jnp.arange(u))
+    return scores, idx
+
+
+def sharded_candidate_scores(
+    mesh: Mesh,
+    feas: jax.Array,       # [U, N] bool  — sharded on N
+    used: jax.Array,       # [N, 4] int32 — sharded on N
+    capacity: jax.Array,   # [N, 4] int32 — sharded on N
+    denom: jax.Array,      # [N, 2] f32   — sharded on N
+    ask: jax.Array,        # [U, 4] int32 — replicated
+    k: int = 64,
+) -> Tuple[jax.Array, jax.Array]:
+    """Score all (spec, node) pairs across the mesh and return the global
+    top-(k·D) candidates per spec as (scores[U, k*D], node_idx[U, k*D]).
+
+    XLA inserts the all-gather over ICI; node indices are translated from
+    shard-local to global inside the mapped function.
+    """
+    n_per_shard = used.shape[0] // mesh.devices.size
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(None, NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS),
+                  P(NODE_AXIS), P(None)),
+        out_specs=(P(None, NODE_AXIS), P(None, NODE_AXIS)),
+    )
+    def _shard_fn(feas_l, used_l, cap_l, denom_l, ask_r):
+        scores, local_idx = _local_topk_scores(
+            feas_l, used_l, cap_l, denom_l, ask_r, k)
+        shard = lax.axis_index(NODE_AXIS)
+        global_idx = local_idx + shard * n_per_shard
+        return scores, global_idx
+
+    # out_specs concatenate along the (sharded) second axis: result is the
+    # gathered [U, k*D] candidate table, replicated to every device by the
+    # final all-gather below.
+    scores, idx = _shard_fn(feas, used, capacity, denom, ask)
+    return scores, idx
+
+
+def commit_candidates(
+    cand_scores: jnp.ndarray,   # [U, C] float32 — gathered candidates
+    cand_idx: jnp.ndarray,      # [U, C] int32 — global node ids
+    used: jnp.ndarray,          # [N, 4] int32
+    capacity: jnp.ndarray,      # [N, 4] int32
+    ask: jnp.ndarray,           # [U, 4] int32
+    count: jnp.ndarray,         # [U] int32
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Sequential commit over the candidate subset: for each spec, greedily
+    take its best remaining candidates under capacity (one alloc per
+    candidate slot).  Returns (placements[U, N] int32, used_after)."""
+    u_pad, c = cand_scores.shape
+    n_pad = used.shape[0]
+
+    def place_spec(carry, u_idx):
+        used_c, placements = carry
+        nodes = cand_idx[u_idx]                       # [C]
+        cap_left = capacity[nodes] - used_c[nodes]    # [C, 4]
+        fits = jnp.all(ask[u_idx][None, :] <= cap_left, axis=1)
+        ok = fits & (cand_scores[u_idx] > NEG_INF / 2)
+        # rank candidates by score, take top remaining count
+        order = jnp.argsort(-jnp.where(ok, cand_scores[u_idx], NEG_INF))
+        ranks = jnp.zeros(c, dtype=jnp.int32).at[order].set(
+            jnp.arange(c, dtype=jnp.int32))
+        take = ok & (ranks < count[u_idx])
+        sel = take.astype(jnp.int32)
+        used_c = used_c.at[nodes].add(sel[:, None] * ask[u_idx][None, :])
+        placements = placements.at[u_idx, nodes].add(sel)
+        return (used_c, placements), jnp.sum(sel)
+
+    placements0 = jnp.zeros((u_pad, n_pad), dtype=jnp.int32)
+    (used_after, placements), _ = lax.scan(
+        place_spec, (used, placements0), jnp.arange(u_pad))
+    return placements, used_after
+
+
+def sharded_schedule_step(
+    mesh: Mesh,
+    feas: jax.Array,
+    used: jax.Array,
+    capacity: jax.Array,
+    denom: jax.Array,
+    ask: jax.Array,
+    count: jax.Array,
+    k: int = 64,
+) -> Tuple[jax.Array, jax.Array]:
+    """One full scheduling step over the mesh: sharded scoring + top-k
+    gather + candidate commit.  This is the framework's 'training step' —
+    the function dryrun_multichip jits over an N-device mesh."""
+    cand_scores, cand_idx = sharded_candidate_scores(
+        mesh, feas, used, capacity, denom, ask, k=k)
+    return commit_candidates(cand_scores, cand_idx, used, capacity, ask, count)

@@ -125,7 +125,6 @@ class GenericScheduler:
     def _process(self) -> bool:
         """(generic_sched.go:184)."""
         self.job = self.state.job_by_id(None, self.eval.job_id)
-        num_tg = 0 if self.job is None or self.job.stopped() else len(self.job.task_groups)
         self.queued_allocs = {}
 
         self.plan = self.eval.make_plan(self.job)

@@ -6,23 +6,26 @@ every table tracks a raft index, readers take snapshots, blocking queries
 wait on watchsets, and `upsert_plan_results` is how committed plans land.
 
 Design departure for the TPU build: instead of radix-tree MVCC we keep plain
-dict tables plus explicit secondary indexes, and `snapshot()` produces an
-O(tables) shallow-copied view — objects are treated as immutable once
-inserted (every write path inserts fresh copies), which gives the scheduler
-the same isolated world-view the reference gets from memdb.  The
+dict tables plus explicit secondary indexes; `snapshot()` shallow-copies the
+tables and element-copies the secondary-index sets (O(rows), acceptable for
+the per-batch snapshot cadence of the batch scheduler; copy-on-write sets
+are the planned optimization if per-eval snapshots become hot).  Objects are
+treated as immutable once inserted (every write path inserts fresh copies),
+which gives the scheduler the same isolated world-view the reference gets
+from memdb.  The
 scheduler-visible subset (nodes, jobs, allocs-by-node/job, evals) is the
 sync boundary that ops/encode.py mirrors into device tensors.
 """
 from __future__ import annotations
 
+import dataclasses
 import pickle
 import threading
 from collections import defaultdict
 from dataclasses import dataclass, field
-from typing import Dict, Iterable, List, Optional, Set, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from ..structs import structs as s
-from ..structs.funcs import filter_terminal_allocs
 
 # Number of historical job versions retained (reference: structs.go
 # JobTrackedVersions = 6).
@@ -658,7 +661,7 @@ class StateStore:
     def upsert_vault_accessors(self, index: int, accessors: List[VaultAccessor]) -> None:
         with self._lock:
             for acc in accessors:
-                acc.create_index = index
+                acc = dataclasses.replace(acc, create_index=index)
                 self.vault_accessors_table[acc.accessor] = acc
                 self._vault_by_alloc[acc.alloc_id].add(acc.accessor)
                 self._vault_by_node[acc.node_id].add(acc.accessor)

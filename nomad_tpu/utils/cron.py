@@ -108,6 +108,10 @@ class CronExpr:
         0.0 if none within ~4 years."""
         t = int(after) - (int(after) % 60) + 60
         limit = int(after) + 4 * 366 * 86400
+        if self.years:
+            # An explicit year field may point far ahead; search to its end.
+            horizon = int(time.mktime((max(self.years) + 1, 1, 1, 0, 0, 0, 0, 1, -1)))
+            limit = max(limit, horizon)
         while t < limit:
             tm = time.localtime(t)
             if self.years is not None and tm.tm_year not in self.years:

@@ -1,0 +1,87 @@
+"""Multi-chip sharding tests on the virtual 8-device CPU mesh
+(SURVEY.md §4 item 3: multi-node without a real cluster)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nomad_tpu.parallel import (
+    make_node_mesh,
+    sharded_candidate_scores,
+    sharded_schedule_step,
+)
+from nomad_tpu.ops.kernels import _score_fit
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) == 8, "conftest must force 8 virtual devices"
+    return make_node_mesh()
+
+
+def _mk_problem(n=256, u=4, seed=0):
+    rng = np.random.default_rng(seed)
+    capacity = np.tile(np.array([4000, 8192, 102400, 150], dtype=np.int32), (n, 1))
+    used = np.zeros((n, 4), dtype=np.int32)
+    used[:, 0] = rng.integers(0, 2000, n)
+    used[:, 1] = rng.integers(0, 4096, n)
+    denom = capacity[:, :2].astype(np.float32)
+    feas = rng.random((u, n)) < 0.8
+    ask = np.tile(np.array([500, 256, 150, 0], dtype=np.int32), (u, 1))
+    count = np.full(u, 20, dtype=np.int32)
+    return feas, used, capacity, denom, ask, count
+
+
+def test_sharded_scores_match_single_device(mesh):
+    feas, used, capacity, denom, ask, count = _mk_problem()
+    k = 16
+    scores, idx = sharded_candidate_scores(
+        mesh, jnp.asarray(feas), jnp.asarray(used), jnp.asarray(capacity),
+        jnp.asarray(denom), jnp.asarray(ask), k=k)
+    scores, idx = np.asarray(scores), np.asarray(idx)
+    assert scores.shape == (4, k * 8)
+    # Every candidate's score must equal the single-device score at that node.
+    for u_i in range(4):
+        full = np.asarray(_score_fit(
+            jnp.asarray(used), jnp.asarray(ask[u_i]), jnp.asarray(denom)))
+        cap_left = capacity - used
+        fits = np.all(ask[u_i][None, :] <= cap_left, axis=1)
+        ok = feas[u_i] & fits
+        for c in range(k * 8):
+            n_idx = idx[u_i, c]
+            if scores[u_i, c] > -1e29:
+                assert ok[n_idx]
+                assert scores[u_i, c] == pytest.approx(full[n_idx], abs=1e-4)
+
+
+def test_sharded_topk_contains_global_best(mesh):
+    feas, used, capacity, denom, ask, count = _mk_problem(seed=3)
+    scores, idx = sharded_candidate_scores(
+        mesh, jnp.asarray(feas), jnp.asarray(used), jnp.asarray(capacity),
+        jnp.asarray(denom), jnp.asarray(ask), k=16)
+    scores, idx = np.asarray(scores), np.asarray(idx)
+    for u_i in range(4):
+        full = np.asarray(_score_fit(
+            jnp.asarray(used), jnp.asarray(ask[u_i]), jnp.asarray(denom)))
+        cap_left = capacity - used
+        fits = np.all(ask[u_i][None, :] <= cap_left, axis=1)
+        ok = feas[u_i] & fits
+        masked = np.where(ok, full, -np.inf)
+        best_node = int(np.argmax(masked))
+        assert best_node in idx[u_i], "global best node missing from candidates"
+
+
+def test_sharded_schedule_step_end_to_end(mesh):
+    feas, used, capacity, denom, ask, count = _mk_problem(seed=5)
+    placements, used_after = sharded_schedule_step(
+        mesh, jnp.asarray(feas), jnp.asarray(used), jnp.asarray(capacity),
+        jnp.asarray(denom), jnp.asarray(ask), jnp.asarray(count), k=16)
+    placements = np.asarray(placements)
+    used_after = np.asarray(used_after)
+    # all counts placed (capacity is ample)
+    assert placements.sum() == count.sum()
+    # no overcommit on any node/dim
+    assert np.all(used_after <= capacity)
+    # placements only on feasible nodes
+    for u_i in range(4):
+        assert np.all(feas[u_i][placements[u_i] > 0])

@@ -1,0 +1,318 @@
+"""Differential tests: TPU batch scheduler vs CPU oracle
+(SURVEY.md §4 item 5 — Go-oracle-vs-kernel on randomized cluster states).
+
+Runs on the virtual CPU backend (conftest sets JAX_PLATFORMS=cpu)."""
+import random
+
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.ops import batch_sched  # registers 'tpu-batch'
+from nomad_tpu.ops import encode
+from nomad_tpu.ops.kernels import batch_allocs_fit, feasibility_matrix, placement_rounds
+from nomad_tpu.scheduler import Harness, new_scheduler, new_service_scheduler
+from nomad_tpu.structs import structs as s
+from nomad_tpu.structs.funcs import allocs_fit, score_fit
+
+import jax
+import jax.numpy as jnp
+
+
+def reg_eval(job):
+    return s.Evaluation(
+        id=s.generate_uuid(), priority=job.priority, type=job.type,
+        triggered_by=s.EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
+        status=s.EVAL_STATUS_PENDING)
+
+
+def strip_networks(job):
+    """Network offers stay host-side; the device kernel handles the 4 scalar
+    dims. Bench/differential jobs use scalar resources only (configs (b))."""
+    for tg in job.task_groups:
+        for t in tg.tasks:
+            t.resources.networks = []
+    return job
+
+
+def make_cluster(h, n, seed=0, hetero=False):
+    rng = random.Random(seed)
+    nodes = []
+    for i in range(n):
+        node = mock.node()
+        node.resources.networks = []
+        node.reserved.networks = []
+        if hetero:
+            node.resources.cpu = rng.choice([2000, 4000, 8000])
+            node.resources.memory_mb = rng.choice([4096, 8192, 16384])
+        if hetero and rng.random() < 0.3:
+            node.attributes["kernel.name"] = "windows"
+        node.compute_class()
+        h.state.upsert_node(h.next_index(), node)
+        nodes.append(node)
+    return nodes
+
+
+class TestFeasibilityKernel:
+    def _encode(self, nodes, specs):
+        targets, literals = encode.collect_attr_targets(specs)
+        ct = encode.encode_cluster(nodes, targets)
+        encode.finalize_codebooks(ct, literals)
+        st = encode.encode_specs(specs, ct, nodes)
+        return ct, st
+
+    def _feas(self, ct, st):
+        return np.asarray(feasibility_matrix(
+            jnp.asarray(ct.attr_values), jnp.asarray(ct.eligible),
+            jnp.asarray(ct.dc_code), jnp.asarray(st.constraint_attr),
+            jnp.asarray(st.constraint_op), jnp.asarray(st.constraint_rhs),
+            jnp.asarray(st.dc_mask), jnp.asarray(st.precomp)))
+
+    def test_matches_oracle_on_random_constraints(self):
+        rng = random.Random(42)
+        nodes = []
+        for i in range(64):
+            n = mock.node()
+            n.attributes["kernel.name"] = rng.choice(["linux", "windows", "darwin"])
+            n.attributes["cpu.arch"] = rng.choice(["amd64", "arm64"])
+            n.attributes["os.version"] = rng.choice(["14.04", "16.04", "18.04"])
+            n.meta["rack"] = f"r{rng.randrange(8)}"
+            n.datacenter = rng.choice(["dc1", "dc2"])
+            n.compute_class()
+            nodes.append(n)
+
+        constraint_pool = [
+            s.Constraint("${attr.kernel.name}", "linux", "="),
+            s.Constraint("${attr.kernel.name}", "windows", "!="),
+            s.Constraint("${attr.cpu.arch}", "amd64", "="),
+            s.Constraint("${attr.os.version}", "16.04", ">="),
+            s.Constraint("${attr.os.version}", "18.04", "<"),
+            s.Constraint("${meta.rack}", "r4", "<="),
+            s.Constraint("${attr.nomad.version}", ">= 0.4", s.CONSTRAINT_VERSION),
+            s.Constraint("${attr.kernel.name}", "lin.*", s.CONSTRAINT_REGEX),
+            s.Constraint("${meta.rack}", "r1,r2,r3", s.CONSTRAINT_SET_CONTAINS),
+            s.Constraint("${meta.missing-key}", "x", "="),
+        ]
+
+        specs = []
+        for i in range(12):
+            job = mock.job()
+            strip_networks(job)
+            job.datacenters = rng.choice([["dc1"], ["dc2"], ["dc1", "dc2"]])
+            job.constraints = rng.sample(constraint_pool, rng.randrange(0, 4))
+            tg = job.task_groups[0]
+            tg.constraints = rng.sample(constraint_pool, rng.randrange(0, 2))
+            specs.append(encode.build_spec(job, tg, batch_penalty=False))
+
+        ct, st = self._encode(nodes, specs)
+        feas = self._feas(ct, st)
+
+        # Oracle: evaluate each (spec, node) with the scalar checkers.
+        from nomad_tpu.scheduler.context import EvalContext
+        from nomad_tpu.scheduler.feasible import check_constraint, resolve_constraint_target
+
+        ctx = EvalContext(None, s.Plan())
+        for u, sp in enumerate(specs):
+            for i, node in enumerate(nodes):
+                expect = node.ready() and node.datacenter in sp.datacenters
+                if expect:
+                    for driver in sp.drivers:
+                        val = node.attributes.get(f"driver.{driver}")
+                        if val is None or val not in ("1", "true", "True", "t", "T", "TRUE"):
+                            expect = False
+                if expect:
+                    for con in sp.constraints:
+                        if con.operand in (s.CONSTRAINT_DISTINCT_HOSTS,
+                                           s.CONSTRAINT_DISTINCT_PROPERTY):
+                            continue
+                        lval, lok = resolve_constraint_target(con.ltarget, node)
+                        rval, rok = resolve_constraint_target(con.rtarget, node)
+                        if not (lok and rok and check_constraint(
+                                ctx, con.operand, lval, rval)):
+                            expect = False
+                            break
+                assert feas[u, i] == expect, (
+                    f"spec {u} node {i}: kernel={feas[u, i]} oracle={expect} "
+                    f"constraints={[str(c) for c in sp.constraints]} "
+                    f"dcs={sp.datacenters} node_dc={node.datacenter}")
+
+    def test_padding_rows_infeasible(self):
+        nodes = [mock.node() for _ in range(3)]
+        job = strip_networks(mock.job())
+        specs = [encode.build_spec(job, job.task_groups[0], False)]
+        ct, st = self._encode(nodes, specs)
+        feas = self._feas(ct, st)
+        assert feas[:, ct.n_real:].sum() == 0
+
+
+class TestScoreParity:
+    def test_device_score_matches_scalar(self):
+        """score_fit on device must match the scalar oracle bit-for-bit-ish."""
+        from nomad_tpu.ops.kernels import _score_fit
+
+        rng = random.Random(7)
+        for _ in range(50):
+            cap_cpu, cap_mem = rng.randrange(1000, 8000), rng.randrange(1024, 16384)
+            res_cpu, res_mem = rng.randrange(0, 400), rng.randrange(0, 512)
+            used_cpu = rng.randrange(0, cap_cpu)
+            used_mem = rng.randrange(0, cap_mem)
+            ask_cpu, ask_mem = rng.randrange(0, 500), rng.randrange(0, 512)
+
+            node = s.Node(resources=s.Resources(cpu=cap_cpu, memory_mb=cap_mem),
+                          reserved=s.Resources(cpu=res_cpu, memory_mb=res_mem))
+            util = s.Resources(cpu=used_cpu + ask_cpu + res_cpu,
+                               memory_mb=used_mem + ask_mem + res_mem)
+            expect = score_fit(node, util)
+
+            used = jnp.asarray([[used_cpu + res_cpu, used_mem + res_mem, 0, 0]],
+                               dtype=jnp.int32)
+            denom = jnp.asarray([[cap_cpu - res_cpu, cap_mem - res_mem]],
+                                dtype=jnp.float32)
+            ask = jnp.asarray([ask_cpu, ask_mem, 0, 0], dtype=jnp.int32)
+            got = float(_score_fit(used, ask, denom)[0])
+            assert got == pytest.approx(expect, abs=1e-3), (
+                f"cap=({cap_cpu},{cap_mem}) used=({used_cpu},{used_mem}) "
+                f"ask=({ask_cpu},{ask_mem})")
+
+
+class TestBatchSchedulerDifferential:
+    def test_places_all_when_capacity_sufficient(self):
+        h = Harness()
+        make_cluster(h, 20)
+        job = strip_networks(mock.job())
+        job.task_groups[0].count = 40
+        h.state.upsert_job(h.next_index(), job)
+        ev = reg_eval(job)
+        sched = new_scheduler("tpu-batch", h.logger, h.snapshot(), h)
+        sched.process(ev)
+
+        allocs = h.state.allocs_by_job(None, job.id, True)
+        assert len(allocs) == 40
+        h.assert_eval_status(s.EVAL_STATUS_COMPLETE)
+
+        # No node overcommitted: verify with the scalar oracle's allocs_fit.
+        by_node = {}
+        for a in allocs:
+            by_node.setdefault(a.node_id, []).append(a)
+        for node_id, node_allocs in by_node.items():
+            node = h.state.node_by_id(None, node_id)
+            fit, dim, _ = allocs_fit(node, node_allocs)
+            assert fit, f"node {node_id} overcommitted: {dim}"
+
+    def test_binpack_score_vs_oracle(self):
+        """Aggregate bin-pack quality must be >= oracle - 0.5%
+        (BASELINE.md regression budget)."""
+
+        def run(factory_name, seed):
+            h = Harness()
+            make_cluster(h, 30, seed=seed, hetero=True)
+            total_score = 0.0
+            jobs = []
+            for i in range(10):
+                job = strip_networks(mock.job())
+                job.task_groups[0].count = 8
+                job.constraints = [s.Constraint("${attr.kernel.name}", "linux", "=")]
+                h.state.upsert_job(h.next_index(), job)
+                jobs.append(job)
+            evals = [reg_eval(j) for j in jobs]
+            if factory_name == "tpu-batch":
+                sched = new_scheduler("tpu-batch", h.logger, h.snapshot(), h)
+                sched.schedule_batch(evals)
+            else:
+                for ev in evals:
+                    h.process(new_service_scheduler, ev)
+            # Bin-pack quality = per-alloc final-state score (an alloc on a
+            # tightly packed node scores high); this is the quantity the
+            # reference's ScoreFit maximizes per placement.  Also count the
+            # nodes touched — denser packing uses fewer.
+            placed = 0
+            weighted_score = 0.0
+            nodes_used = 0
+            for node in h.state.nodes(None):
+                allocs = h.state.allocs_by_node_terminal(None, node.id, False)
+                if not allocs:
+                    continue
+                fit, dim, util = allocs_fit(node, allocs)
+                assert fit, f"overcommit: {dim}"
+                weighted_score += score_fit(node, util) * len(allocs)
+                placed += len(allocs)
+                nodes_used += 1
+            return placed, weighted_score / placed, nodes_used
+
+        placed_oracle, score_oracle, nodes_oracle = run("oracle", seed=3)
+        placed_tpu, score_tpu, nodes_tpu = run("tpu-batch", seed=3)
+        assert placed_tpu == placed_oracle == 80
+        # The kernel scans ALL nodes (the oracle samples log2 N candidates),
+        # so per-alloc bin-pack score must not regress beyond the 0.5%
+        # budget — in practice it improves.
+        assert score_tpu >= score_oracle * 0.995, (
+            f"binpack regression: tpu={score_tpu:.3f} oracle={score_oracle:.3f}")
+        assert nodes_tpu <= nodes_oracle, (
+            f"packing regression: tpu used {nodes_tpu} nodes, oracle {nodes_oracle}")
+
+    def test_blocked_eval_on_exhaustion(self):
+        h = Harness()
+        n = mock.node()
+        n.resources = s.Resources(cpu=1100, memory_mb=1024, disk_mb=20000, iops=100)
+        n.reserved = None
+        n.resources.networks = []
+        n.compute_class()
+        h.state.upsert_node(h.next_index(), n)
+        job = strip_networks(mock.job())
+        job.task_groups[0].count = 5  # only 2 fit (500 cpu each)
+        h.state.upsert_job(h.next_index(), job)
+        ev = reg_eval(job)
+        sched = new_scheduler("tpu-batch", h.logger, h.snapshot(), h)
+        sched.process(ev)
+
+        allocs = h.state.allocs_by_job(None, job.id, True)
+        assert len(allocs) == 2
+        assert len(h.create_evals) == 1
+        assert h.create_evals[0].status == s.EVAL_STATUS_BLOCKED
+        update = h.evals[0]
+        assert "web" in update.failed_tg_allocs
+        m = update.failed_tg_allocs["web"]
+        assert m.coalesced_failures == 2  # 3 unplaced, 1 recorded + 2 coalesced
+
+    def test_distinct_hosts_on_device(self):
+        h = Harness()
+        make_cluster(h, 5)
+        job = strip_networks(mock.job())
+        job.constraints.append(s.Constraint(operand=s.CONSTRAINT_DISTINCT_HOSTS))
+        job.task_groups[0].count = 5
+        h.state.upsert_job(h.next_index(), job)
+        sched = new_scheduler("tpu-batch", h.logger, h.snapshot(), h)
+        sched.process(reg_eval(job))
+        allocs = h.state.allocs_by_job(None, job.id, True)
+        assert len(allocs) == 5
+        assert len({a.node_id for a in allocs}) == 5
+
+    def test_multi_eval_batch(self):
+        """One device pass serves many evals; per-job serialization holds."""
+        h = Harness()
+        make_cluster(h, 10)
+        jobs = []
+        for _ in range(5):
+            job = strip_networks(mock.job())
+            job.task_groups[0].count = 4
+            h.state.upsert_job(h.next_index(), job)
+            jobs.append(job)
+        evals = [reg_eval(j) for j in jobs]
+        sched = new_scheduler("tpu-batch", h.logger, h.snapshot(), h)
+        stats = sched.schedule_batch(evals)
+        assert stats.num_evals == 5
+        assert stats.num_asks == 20
+        for job in jobs:
+            assert len(h.state.allocs_by_job(None, job.id, True)) == 4
+        # every eval got a status update
+        assert len(h.evals) == 5
+        assert all(e.status == s.EVAL_STATUS_COMPLETE for e in h.evals)
+
+
+class TestBatchAllocsFit:
+    def test_matches_scalar(self):
+        cap = jnp.asarray([[1000, 1000, 1000, 100], [500, 500, 500, 50]], dtype=jnp.int32)
+        used = jnp.asarray([[900, 1000, 10, 0], [501, 0, 0, 0]], dtype=jnp.int32)
+        fit, dim = batch_allocs_fit(cap, used)
+        assert fit.tolist() == [True, False]
+        assert dim.tolist() == [-1, 0]  # cpu is dim 0
