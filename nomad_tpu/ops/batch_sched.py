@@ -22,7 +22,6 @@ already guarantees at most one outstanding eval per job).
 from __future__ import annotations
 
 import logging
-import random
 import time
 from collections import defaultdict
 from typing import Dict, List, Optional, Tuple
@@ -278,6 +277,12 @@ class TPUBatchScheduler:
         if (ev.status != s.EVAL_STATUS_BLOCKED and sched.failed_tg_allocs
                 and sched.blocked is None):
             sched._create_blocked_eval(plan_failure=False)
+
+        # Rolling-update limit reached: spawn the follow-up eval
+        # (generic_sched.go:232-240).
+        if sched.limit_reached and sched.next_eval is None:
+            sched.next_eval = ev.next_rolling_eval(sched.job.update.stagger)
+            self.planner.create_eval(sched.next_eval)
 
         if sched.plan.is_no_op() and not ev.annotate_plan:
             set_status(self.logger, self.planner, ev, sched.next_eval,

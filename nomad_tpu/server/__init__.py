@@ -1,0 +1,13 @@
+"""L2+L3 control plane (reference: nomad/)."""
+
+from .blocked_evals import BlockedEvals
+from .core_sched import CoreScheduler
+from .eval_broker import FAILED_QUEUE, EvalBroker, EvalBrokerError
+from .fsm import FSM, MessageType, TimeTable
+from .heartbeat import HeartbeatTimers
+from .periodic import PeriodicDispatch, derive_job
+from .plan_apply import PlanApplier
+from .plan_queue import PlanFuture, PlanQueue
+from .raft import FileLog, InmemLog, NotLeaderError, RaftLog
+from .server import Server, ServerConfig
+from .worker import BatchWorker, Worker, WorkerPlanner

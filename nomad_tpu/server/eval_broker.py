@@ -1,0 +1,375 @@
+"""EvalBroker: leader-only priority queue of evaluations with
+at-least-once delivery (reference: nomad/eval_broker.go:43-769).
+
+Semantics preserved: per-scheduler-type ready heaps, per-JobID
+serialization (jobEvals + blocked), unack map with Nack timers, delivery
+limit → failed queue, wait/delay timers, compounding Nack re-enqueue
+delay, requeue-on-ack for reblocked evals.
+
+For the TPU build this is also where batching happens: dequeue_batch()
+drains up to B ready evals of one scheduler type in one call — preserving
+the per-job invariant because ready never holds two evals of one job.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..structs import structs as s
+
+FAILED_QUEUE = "_failed"
+
+
+class EvalBrokerError(Exception):
+    pass
+
+
+ERR_NOT_OUTSTANDING = "evaluation is not outstanding"
+ERR_TOKEN_MISMATCH = "evaluation token does not match"
+ERR_NACK_TIMEOUT_REACHED = "evaluation nack timeout reached"
+
+
+@dataclass(order=True)
+class _HeapEntry:
+    # min-heap: higher priority first, then older create index, then seq.
+    sort_key: Tuple[int, int, int]
+    eval: s.Evaluation = field(compare=False)
+
+
+class _Unack:
+    __slots__ = ("eval", "token", "timer", "fired", "paused")
+
+    def __init__(self, ev: s.Evaluation, token: str, timer: Optional[threading.Timer]):
+        self.eval = ev
+        self.token = token
+        self.timer = timer
+        self.fired = False
+        self.paused = False
+
+
+class EvalBroker:
+    def __init__(
+        self,
+        nack_timeout: float = 60.0,
+        initial_nack_delay: float = 1.0,
+        subsequent_nack_delay: float = 20.0,
+        delivery_limit: int = 3,
+    ):
+        if nack_timeout < 0:
+            raise ValueError("timeout cannot be negative")
+        self.nack_timeout = nack_timeout
+        self.initial_nack_delay = initial_nack_delay
+        self.subsequent_nack_delay = subsequent_nack_delay
+        self.delivery_limit = delivery_limit
+
+        self._l = threading.RLock()
+        self._cond = threading.Condition(self._l)
+        self._enabled = False
+        self._seq = itertools.count()
+
+        self.evals: Dict[str, int] = {}            # id → delivery attempts
+        self.job_evals: Dict[str, str] = {}        # job id → queued eval id
+        self.blocked: Dict[str, List[_HeapEntry]] = {}
+        self.ready: Dict[str, List[_HeapEntry]] = {}
+        self.unack: Dict[str, _Unack] = {}
+        self.requeue: Dict[str, s.Evaluation] = {}  # token → eval
+        self.time_wait: Dict[str, threading.Timer] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def enabled(self) -> bool:
+        with self._l:
+            return self._enabled
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._l:
+            self._enabled = enabled
+        if not enabled:
+            self.flush()
+
+    # -- enqueue -----------------------------------------------------------
+
+    def enqueue(self, ev: s.Evaluation) -> None:
+        with self._l:
+            self._process_enqueue(ev, "")
+
+    def enqueue_all(self, evals: Dict[str, Tuple[s.Evaluation, str]] | List) -> None:
+        """Enqueue many evals; each may carry a token from a reblock
+        (eval_broker.go:169 EnqueueAll)."""
+        with self._l:
+            if isinstance(evals, dict):
+                items = list(evals.values())
+            else:
+                items = [(e, "") if not isinstance(e, tuple) else e for e in evals]
+            for ev, token in items:
+                self._process_enqueue(ev, token)
+
+    def _process_enqueue(self, ev: s.Evaluation, token: str) -> None:
+        if ev.id in self.evals:
+            if token == "":
+                return
+            # Reblock from the owning scheduler: requeue once acked.
+            unack = self.unack.get(ev.id)
+            if unack is not None and unack.token == token:
+                self.requeue[token] = ev
+            return
+        elif self._enabled:
+            self.evals[ev.id] = 0
+
+        if ev.wait > 0:
+            self._process_waiting_enqueue(ev)
+            return
+        self._enqueue_locked(ev, ev.type)
+
+    def _process_waiting_enqueue(self, ev: s.Evaluation) -> None:
+        timer = threading.Timer(ev.wait, self._enqueue_waiting, args=(ev,))
+        timer.daemon = True
+        self.time_wait[ev.id] = timer
+        timer.start()
+
+    def _enqueue_waiting(self, ev: s.Evaluation) -> None:
+        with self._l:
+            self.time_wait.pop(ev.id, None)
+            self._enqueue_locked(ev, ev.type)
+
+    def _enqueue_locked(self, ev: s.Evaluation, queue: str) -> None:
+        if not self._enabled:
+            return
+        pending_eval = self.job_evals.get(ev.job_id, "")
+        if not pending_eval:
+            self.job_evals[ev.job_id] = ev.id
+        elif pending_eval != ev.id:
+            heapq.heappush(self.blocked.setdefault(ev.job_id, []),
+                           self._entry(ev))
+            return
+
+        heapq.heappush(self.ready.setdefault(queue, []), self._entry(ev))
+        self._cond.notify_all()
+
+    def _entry(self, ev: s.Evaluation) -> _HeapEntry:
+        return _HeapEntry((-ev.priority, ev.create_index, next(self._seq)), ev)
+
+    # -- dequeue -----------------------------------------------------------
+
+    def dequeue(
+        self, schedulers: List[str], timeout: Optional[float] = None
+    ) -> Tuple[Optional[s.Evaluation], str]:
+        """Blocking dequeue of the highest-priority ready eval
+        (eval_broker.go:279)."""
+        import time as _time
+
+        deadline = None if timeout is None or timeout == 0 else _time.monotonic() + timeout
+        with self._l:
+            while True:
+                ev, token = self._scan(schedulers)
+                if ev is not None:
+                    return ev, token
+                if timeout == 0:
+                    return None, ""
+                remaining = None if deadline is None else deadline - _time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None, ""
+                self._cond.wait(remaining if remaining is not None else 1.0)
+
+    def dequeue_batch(
+        self, schedulers: List[str], max_batch: int, timeout: Optional[float] = None
+    ) -> List[Tuple[s.Evaluation, str]]:
+        """Drain up to max_batch ready evals in one call — the batch
+        assembler feeding the TPU kernel (SURVEY.md §2.9)."""
+        out: List[Tuple[s.Evaluation, str]] = []
+        ev, token = self.dequeue(schedulers, timeout)
+        if ev is None:
+            return out
+        out.append((ev, token))
+        with self._l:
+            while len(out) < max_batch:
+                ev, token = self._scan(schedulers)
+                if ev is None:
+                    break
+                out.append((ev, token))
+        return out
+
+    def _scan(self, schedulers: List[str]) -> Tuple[Optional[s.Evaluation], str]:
+        if not self._enabled:
+            raise EvalBrokerError("eval broker disabled")
+        eligible: List[str] = []
+        eligible_priority = 0
+        for sched in schedulers:
+            heap = self.ready.get(sched)
+            if not heap:
+                continue
+            priority = heap[0].eval.priority
+            if not eligible or priority > eligible_priority:
+                eligible = [sched]
+                eligible_priority = priority
+            elif priority == eligible_priority:
+                eligible.append(sched)
+        if not eligible:
+            return None, ""
+        sched = eligible[0] if len(eligible) == 1 else random.choice(eligible)
+        return self._dequeue_for_sched(sched)
+
+    def _dequeue_for_sched(self, sched: str) -> Tuple[s.Evaluation, str]:
+        heap = self.ready[sched]
+        ev = heapq.heappop(heap).eval
+        token = s.generate_uuid()
+
+        timer: Optional[threading.Timer] = None
+        if self.nack_timeout > 0:
+            timer = threading.Timer(self.nack_timeout, self._nack_timeout_fire,
+                                    args=(ev.id, token))
+            timer.daemon = True
+        unack = _Unack(ev, token, timer)
+        self.unack[ev.id] = unack
+        if timer is not None:
+            timer.start()
+        self.evals[ev.id] = self.evals.get(ev.id, 0) + 1
+        return ev, token
+
+    def _nack_timeout_fire(self, eval_id: str, token: str) -> None:
+        with self._l:
+            unack = self.unack.get(eval_id)
+            if unack is None or unack.token != token:
+                return
+            unack.fired = True
+        try:
+            self.nack(eval_id, token)
+        except EvalBrokerError:
+            pass
+
+    # -- outstanding / ack / nack -----------------------------------------
+
+    def outstanding(self, eval_id: str) -> Tuple[str, bool]:
+        with self._l:
+            unack = self.unack.get(eval_id)
+            if unack is None:
+                return "", False
+            return unack.token, True
+
+    def outstanding_reset(self, eval_id: str, token: str) -> None:
+        with self._l:
+            unack = self._get_unack(eval_id, token)
+            if unack.fired:
+                raise EvalBrokerError(ERR_NACK_TIMEOUT_REACHED)
+            if unack.timer is not None:
+                unack.timer.cancel()
+                unack.timer = threading.Timer(
+                    self.nack_timeout, self._nack_timeout_fire,
+                    args=(eval_id, token))
+                unack.timer.daemon = True
+                unack.timer.start()
+
+    def _get_unack(self, eval_id: str, token: str) -> _Unack:
+        unack = self.unack.get(eval_id)
+        if unack is None:
+            raise EvalBrokerError(ERR_NOT_OUTSTANDING)
+        if unack.token != token:
+            raise EvalBrokerError(ERR_TOKEN_MISMATCH)
+        return unack
+
+    def ack(self, eval_id: str, token: str) -> None:
+        """(eval_broker.go:481): release the job serialization slot, promote
+        a blocked same-job eval, and process any requeue."""
+        with self._l:
+            try:
+                unack = self._get_unack(eval_id, token)
+                if unack.fired:
+                    raise EvalBrokerError("Evaluation ID Ack'd after Nack timer expiration")
+                if unack.timer is not None:
+                    unack.timer.cancel()
+                job_id = unack.eval.job_id
+
+                del self.unack[eval_id]
+                self.evals.pop(eval_id, None)
+                self.job_evals.pop(job_id, None)
+
+                blocked = self.blocked.get(job_id)
+                if blocked:
+                    ev = heapq.heappop(blocked).eval
+                    if not blocked:
+                        del self.blocked[job_id]
+                    self._enqueue_locked(ev, ev.type)
+
+                requeued = self.requeue.pop(token, None)
+                if requeued is not None:
+                    self._process_enqueue(requeued, "")
+            finally:
+                self.requeue.pop(token, None)
+
+    def nack(self, eval_id: str, token: str) -> None:
+        """(eval_broker.go:540): redeliver with compounding delay, or shunt
+        to the failed queue at the delivery limit."""
+        with self._l:
+            self.requeue.pop(token, None)
+            unack = self._get_unack(eval_id, token)
+            if unack.timer is not None:
+                unack.timer.cancel()
+            del self.unack[eval_id]
+
+            dequeues = self.evals.get(eval_id, 0)
+            if dequeues >= self.delivery_limit:
+                self._enqueue_locked(unack.eval, FAILED_QUEUE)
+            else:
+                ev = unack.eval
+                ev.wait = self._nack_reenqueue_delay(dequeues)
+                if ev.wait > 0:
+                    self._process_waiting_enqueue(ev)
+                else:
+                    self._enqueue_locked(ev, ev.type)
+
+    def _nack_reenqueue_delay(self, prev_dequeues: int) -> float:
+        if prev_dequeues <= 0:
+            return 0.0
+        if prev_dequeues == 1:
+            return self.initial_nack_delay
+        return (prev_dequeues - 1) * self.subsequent_nack_delay
+
+    def pause_nack_timeout(self, eval_id: str, token: str) -> None:
+        with self._l:
+            unack = self._get_unack(eval_id, token)
+            if unack.fired:
+                raise EvalBrokerError(ERR_NACK_TIMEOUT_REACHED)
+            if unack.timer is not None:
+                unack.timer.cancel()
+            unack.paused = True
+
+    def resume_nack_timeout(self, eval_id: str, token: str) -> None:
+        with self._l:
+            unack = self._get_unack(eval_id, token)
+            unack.paused = False
+            unack.timer = threading.Timer(
+                self.nack_timeout, self._nack_timeout_fire, args=(eval_id, token))
+            unack.timer.daemon = True
+            unack.timer.start()
+
+    # -- maintenance -------------------------------------------------------
+
+    def flush(self) -> None:
+        with self._l:
+            for unack in self.unack.values():
+                if unack.timer is not None:
+                    unack.timer.cancel()
+            for timer in self.time_wait.values():
+                timer.cancel()
+            self.evals = {}
+            self.job_evals = {}
+            self.blocked = {}
+            self.ready = {}
+            self.unack = {}
+            self.requeue = {}
+            self.time_wait = {}
+            self._cond.notify_all()
+
+    def stats(self) -> Dict[str, int]:
+        with self._l:
+            return {
+                "total_ready": sum(len(h) for h in self.ready.values()),
+                "total_unacked": len(self.unack),
+                "total_blocked": sum(len(h) for h in self.blocked.values()),
+                "total_waiting": len(self.time_wait),
+                "by_scheduler": {k: len(h) for k, h in self.ready.items()},
+            }

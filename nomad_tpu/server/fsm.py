@@ -1,0 +1,245 @@
+"""Replicated-log state machine (reference: nomad/fsm.go:115-600).
+
+Decodes log entries and dispatches them to the StateStore; emits
+blocked-eval unblocks on capacity changes and feeds the eval broker /
+periodic dispatcher on the leader — the same side-channel hooks
+nomadFSM.Apply performs.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from enum import IntEnum
+from typing import Callable, Dict, List, Optional
+
+from ..state import PeriodicLaunch, StateStore, VaultAccessor
+from ..structs import structs as s
+
+
+class MessageType(IntEnum):
+    """Log message types (reference: structs.go:43-56)."""
+
+    NODE_REGISTER = 0
+    NODE_DEREGISTER = 1
+    NODE_UPDATE_STATUS = 2
+    NODE_UPDATE_DRAIN = 3
+    JOB_REGISTER = 4
+    JOB_DEREGISTER = 5
+    EVAL_UPDATE = 6
+    EVAL_DELETE = 7
+    ALLOC_UPDATE = 8
+    ALLOC_CLIENT_UPDATE = 9
+    RECONCILE_JOB_SUMMARIES = 10
+    VAULT_ACCESSOR_REGISTER = 11
+    VAULT_ACCESSOR_DEREGISTER = 12
+    APPLY_PLAN_RESULTS = 13
+    PERIODIC_LAUNCH_UPSERT = 14
+    PERIODIC_LAUNCH_DELETE = 15
+
+
+class FSM:
+    """Applies committed log entries to the state store."""
+
+    def __init__(
+        self,
+        state: Optional[StateStore] = None,
+        logger: Optional[logging.Logger] = None,
+        on_eval_update: Optional[Callable[[s.Evaluation], None]] = None,
+        on_unblock: Optional[Callable[[str, int], None]] = None,
+        on_job_register: Optional[Callable[[s.Job], None]] = None,
+        on_job_deregister: Optional[Callable[[str], None]] = None,
+    ):
+        self.state = state or StateStore()
+        self.logger = logger or logging.getLogger("nomad_tpu.fsm")
+        # Leader-side hooks (enabled only on the leader, fsm.go:58-66).
+        self.on_eval_update = on_eval_update
+        self.on_unblock = on_unblock
+        self.on_job_register = on_job_register
+        self.on_job_deregister = on_job_deregister
+
+    # -- apply -------------------------------------------------------------
+
+    def apply(self, index: int, msg_type: MessageType, payload: dict):
+        """(fsm.go:115 Apply / :132-158 dispatch)."""
+        handler = self._DISPATCH.get(MessageType(msg_type))
+        if handler is None:
+            raise ValueError(f"failed to apply request: unknown type {msg_type}")
+        return handler(self, index, payload)
+
+    # -- node --------------------------------------------------------------
+
+    def _apply_node_register(self, index: int, req: dict):
+        node: s.Node = req["node"]
+        if not node.computed_class:
+            node.compute_class()
+        self.state.upsert_node(index, node)
+        # Re-registration of a down node restores capacity (fsm.go:182-188).
+        if self.on_unblock and node.computed_class:
+            self.on_unblock(node.computed_class, index)
+
+    def _apply_node_deregister(self, index: int, req: dict):
+        self.state.delete_node(index, req["node_id"])
+
+    def _apply_node_update_status(self, index: int, req: dict):
+        self.state.update_node_status(index, req["node_id"], req["status"])
+        if req["status"] == s.NODE_STATUS_READY and self.on_unblock:
+            node = self.state.node_by_id(None, req["node_id"])
+            if node is not None and node.computed_class:
+                self.on_unblock(node.computed_class, index)
+
+    def _apply_node_update_drain(self, index: int, req: dict):
+        self.state.update_node_drain(index, req["node_id"], req["drain"])
+
+    # -- job ---------------------------------------------------------------
+
+    def _apply_job_register(self, index: int, req: dict):
+        job: s.Job = req["job"]
+        self.state.upsert_job(index, job)
+        if self.on_job_register is not None:
+            self.on_job_register(job)
+
+    def _apply_job_deregister(self, index: int, req: dict):
+        job_id = req["job_id"]
+        purge = req.get("purge", True)
+        if purge:
+            try:
+                self.state.delete_job(index, job_id)
+            except KeyError:
+                pass
+        else:
+            job = self.state.job_by_id(None, job_id)
+            if job is not None:
+                stopped = job.copy()
+                stopped.stop = True
+                self.state.upsert_job(index, stopped)
+        if self.on_job_deregister is not None:
+            self.on_job_deregister(job_id)
+
+    # -- evals -------------------------------------------------------------
+
+    def _apply_eval_update(self, index: int, req: dict):
+        evals: List[s.Evaluation] = req["evals"]
+        self.state.upsert_evals(index, evals)
+        if self.on_eval_update is not None:
+            for ev in evals:
+                self.on_eval_update(ev)
+
+    def _apply_eval_delete(self, index: int, req: dict):
+        self.state.delete_eval(index, req.get("evals", []), req.get("allocs", []))
+
+    # -- allocs ------------------------------------------------------------
+
+    def _apply_alloc_update(self, index: int, req: dict):
+        allocs: List[s.Allocation] = req["allocs"]
+        job = req.get("job")
+        for alloc in allocs:
+            if alloc.job is None and not alloc.terminal_status():
+                alloc.job = job
+            if alloc.resources is None and alloc.task_resources:
+                total = s.Resources()
+                for tr in alloc.task_resources.values():
+                    total.add(tr)
+                total.add(alloc.shared_resources)
+                alloc.resources = total
+        self.state.upsert_allocs(index, allocs)
+
+    def _apply_alloc_client_update(self, index: int, req: dict):
+        allocs: List[s.Allocation] = req["allocs"]
+        self.state.update_allocs_from_client(index, allocs)
+        # Unblock on terminal client updates: capacity freed
+        # (fsm.go:465-units).
+        if self.on_unblock:
+            for alloc in allocs:
+                if alloc.client_terminal_status():
+                    existing = self.state.alloc_by_id(None, alloc.id)
+                    if existing is None:
+                        continue
+                    node = self.state.node_by_id(None, existing.node_id)
+                    if node is not None and node.computed_class:
+                        self.on_unblock(node.computed_class, index)
+
+    # -- plan results ------------------------------------------------------
+
+    def _apply_plan_results(self, index: int, req: dict):
+        self.state.upsert_plan_results(index, req.get("job"), req["allocs"])
+
+    # -- summaries / vault / periodic --------------------------------------
+
+    def _apply_reconcile_summaries(self, index: int, req: dict):
+        self.state.reconcile_job_summaries(index)
+
+    def _apply_vault_register(self, index: int, req: dict):
+        accessors: List[VaultAccessor] = req["accessors"]
+        self.state.upsert_vault_accessors(index, accessors)
+
+    def _apply_vault_deregister(self, index: int, req: dict):
+        self.state.delete_vault_accessors(index, req["accessors"])
+
+    def _apply_periodic_launch_upsert(self, index: int, req: dict):
+        self.state.upsert_periodic_launch(
+            index, PeriodicLaunch(id=req["job_id"], launch=req["launch"]))
+
+    def _apply_periodic_launch_delete(self, index: int, req: dict):
+        self.state.delete_periodic_launch(index, req["job_id"])
+
+    # -- snapshot / restore ------------------------------------------------
+
+    def snapshot(self) -> bytes:
+        """(fsm.go:568)."""
+        return self.state.persist()
+
+    def restore(self, blob: bytes) -> None:
+        """(fsm.go:582) — replaces the state store wholesale."""
+        self.state = StateStore.restore(blob)
+
+    _DISPATCH: Dict[MessageType, Callable] = {
+        MessageType.NODE_REGISTER: _apply_node_register,
+        MessageType.NODE_DEREGISTER: _apply_node_deregister,
+        MessageType.NODE_UPDATE_STATUS: _apply_node_update_status,
+        MessageType.NODE_UPDATE_DRAIN: _apply_node_update_drain,
+        MessageType.JOB_REGISTER: _apply_job_register,
+        MessageType.JOB_DEREGISTER: _apply_job_deregister,
+        MessageType.EVAL_UPDATE: _apply_eval_update,
+        MessageType.EVAL_DELETE: _apply_eval_delete,
+        MessageType.ALLOC_UPDATE: _apply_alloc_update,
+        MessageType.ALLOC_CLIENT_UPDATE: _apply_alloc_client_update,
+        MessageType.RECONCILE_JOB_SUMMARIES: _apply_reconcile_summaries,
+        MessageType.VAULT_ACCESSOR_REGISTER: _apply_vault_register,
+        MessageType.VAULT_ACCESSOR_DEREGISTER: _apply_vault_deregister,
+        MessageType.APPLY_PLAN_RESULTS: _apply_plan_results,
+        MessageType.PERIODIC_LAUNCH_UPSERT: _apply_periodic_launch_upsert,
+        MessageType.PERIODIC_LAUNCH_DELETE: _apply_periodic_launch_delete,
+    }
+
+
+class TimeTable:
+    """Index ↔ wall-clock mapping used by GC thresholds
+    (reference: nomad/timetable.go:14-109)."""
+
+    def __init__(self, granularity: float = 1.0, limit: float = 72 * 3600.0):
+        self.granularity = granularity
+        self.limit = limit
+        self._table: List[tuple] = []  # (index, unix_time), newest first
+
+    def witness(self, index: int, when: Optional[float] = None) -> None:
+        when = when if when is not None else time.time()
+        if self._table and when - self._table[0][1] < self.granularity:
+            return
+        self._table.insert(0, (index, when))
+        # Trim entries beyond the horizon.
+        cutoff = when - self.limit
+        while self._table and self._table[-1][1] < cutoff:
+            self._table.pop()
+
+    def nearest_index(self, when: float) -> int:
+        """Largest index with time <= when."""
+        for index, t in self._table:
+            if t <= when:
+                return index
+        return 0
+
+    def nearest_time(self, index: int) -> float:
+        for idx, t in self._table:
+            if idx <= index:
+                return t
+        return 0.0

@@ -1,0 +1,81 @@
+"""Leader-side node heartbeat TTL tracking
+(reference: nomad/heartbeat.go:15-137).
+
+TTL scales with fleet size: ttl = max(min_heartbeat_ttl,
+nodes / max_heartbeats_per_second) + grace (config.go:185-197,264-266).
+Expiry transitions the node to down through the log, which fans out
+node-update evals via the server hook.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, Optional
+
+MIN_HEARTBEAT_TTL = 10.0
+MAX_HEARTBEATS_PER_SECOND = 50.0
+HEARTBEAT_GRACE = 10.0
+
+
+class HeartbeatTimers:
+    def __init__(
+        self,
+        on_expire: Callable[[str], None],
+        min_ttl: float = MIN_HEARTBEAT_TTL,
+        max_per_second: float = MAX_HEARTBEATS_PER_SECOND,
+        grace: float = HEARTBEAT_GRACE,
+        logger: Optional[logging.Logger] = None,
+    ):
+        self.on_expire = on_expire
+        self.min_ttl = min_ttl
+        self.max_per_second = max_per_second
+        self.grace = grace
+        self.logger = logger or logging.getLogger("nomad_tpu.heartbeat")
+        self._l = threading.Lock()
+        self._timers: Dict[str, threading.Timer] = {}
+        self._enabled = False
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._l:
+            self._enabled = enabled
+            if not enabled:
+                for timer in self._timers.values():
+                    timer.cancel()
+                self._timers = {}
+
+    def reset_heartbeat_timer(self, node_id: str) -> float:
+        """(heartbeat.go:40 resetHeartbeatTimer) — returns the TTL granted."""
+        with self._l:
+            if not self._enabled:
+                return self.min_ttl
+            ttl = max(self.min_ttl, len(self._timers) / self.max_per_second)
+            existing = self._timers.get(node_id)
+            if existing is not None:
+                existing.cancel()
+            timer = threading.Timer(ttl + self.grace, self._invalidate, args=(node_id,))
+            timer.daemon = True
+            self._timers[node_id] = timer
+            timer.start()
+            return ttl
+
+    def _invalidate(self, node_id: str) -> None:
+        """(heartbeat.go:86 invalidateHeartbeat)."""
+        with self._l:
+            self._timers.pop(node_id, None)
+            if not self._enabled:
+                return
+        self.logger.warning("node %s heartbeat missed; marking down", node_id)
+        try:
+            self.on_expire(node_id)
+        except Exception:
+            self.logger.exception("heartbeat invalidation for %s failed", node_id)
+
+    def clear_heartbeat_timer(self, node_id: str) -> None:
+        with self._l:
+            timer = self._timers.pop(node_id, None)
+            if timer is not None:
+                timer.cancel()
+
+    def active(self) -> int:
+        with self._l:
+            return len(self._timers)

@@ -1,0 +1,92 @@
+"""PlanQueue: leader-only priority queue of submitted plans with futures
+(reference: nomad/plan_queue.go:29-180)."""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from ..structs import structs as s
+
+
+class PlanFuture:
+    """Future for a submitted plan's result."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result: Optional[s.PlanResult] = None
+        self._error: Optional[Exception] = None
+
+    def respond(self, result: Optional[s.PlanResult], error: Optional[Exception]):
+        self._result = result
+        self._error = error
+        self._event.set()
+        return self
+
+    def wait(self, timeout: Optional[float] = None) -> s.PlanResult:
+        if not self._event.wait(timeout):
+            raise TimeoutError("plan future timed out")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+@dataclass(order=True)
+class _PendingPlan:
+    sort_key: Tuple[int, int, int]
+    plan: s.Plan = field(compare=False)
+    future: PlanFuture = field(compare=False)
+
+
+class PlanQueue:
+    def __init__(self):
+        self._l = threading.Lock()
+        self._cond = threading.Condition(self._l)
+        self._enabled = False
+        self._heap: List[_PendingPlan] = []
+        self._seq = itertools.count()
+
+    def enabled(self) -> bool:
+        with self._l:
+            return self._enabled
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._l:
+            self._enabled = enabled
+            if not enabled:
+                self._heap = []
+            self._cond.notify_all()
+
+    def enqueue(self, plan: s.Plan) -> PlanFuture:
+        """(plan_queue.go:95)."""
+        future = PlanFuture()
+        with self._l:
+            if not self._enabled:
+                raise RuntimeError("plan queue is disabled")
+            heapq.heappush(
+                self._heap,
+                _PendingPlan((-plan.priority, 0, next(self._seq)), plan, future))
+            self._cond.notify_all()
+        return future
+
+    def dequeue(self, timeout: Optional[float] = None) -> Optional[Tuple[s.Plan, PlanFuture]]:
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        with self._l:
+            while True:
+                if not self._enabled:
+                    return None
+                if self._heap:
+                    pending = heapq.heappop(self._heap)
+                    return pending.plan, pending.future
+                remaining = None if deadline is None else deadline - _time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cond.wait(remaining if remaining is not None else 1.0)
+
+    def depth(self) -> int:
+        with self._l:
+            return len(self._heap)

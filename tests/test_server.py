@@ -1,0 +1,321 @@
+"""Server integration tests: the full control-plane pipeline in-process
+(reference: nomad/worker_test.go, plan_apply_test.go, leader_test.go,
+eval_broker_test.go — in-process servers, SURVEY.md §4 item 3)."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import (
+    EvalBroker,
+    EvalBrokerError,
+    MessageType,
+    Server,
+    ServerConfig,
+)
+from nomad_tpu.structs import structs as s
+
+
+def wait_until(predicate, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+@pytest.fixture()
+def server():
+    srv = Server(ServerConfig(num_schedulers=1))
+    srv.start()
+    yield srv
+    srv.shutdown()
+
+
+def make_node():
+    n = mock.node()
+    n.resources.networks = []
+    n.reserved.networks = []
+    return n
+
+
+def make_job(count=3):
+    j = mock.job()
+    j.task_groups[0].count = count
+    for t in j.task_groups[0].tasks:
+        t.resources.networks = []
+    return j
+
+
+class TestEndToEnd:
+    def test_register_job_runs_through_pipeline(self, server):
+        for _ in range(3):
+            server.node_register(make_node())
+        job = make_job(3)
+        index, eval_id = server.job_register(job)
+        assert eval_id
+
+        assert wait_until(
+            lambda: len(server.state.allocs_by_job(None, job.id, True)) == 3)
+        ev = server.state.eval_by_id(None, eval_id)
+        assert ev.status == s.EVAL_STATUS_COMPLETE
+        # allocs have create_time stamped by plan apply
+        for a in server.state.allocs_by_job(None, job.id, True):
+            assert a.create_time > 0
+
+    def test_capacity_exhaustion_blocks_then_unblocks(self, server):
+        node = make_node()
+        node.resources.cpu = 1100  # fits 2 x 500 after 100 reserved
+        server.node_register(node)
+        job = make_job(4)
+        _, eval_id = server.job_register(job)
+
+        assert wait_until(
+            lambda: len(server.state.allocs_by_job(None, job.id, True)) == 2)
+        # blocked eval tracked
+        assert wait_until(
+            lambda: server.blocked_evals.stats()["total_blocked"] == 1)
+
+        # new capacity arrives → unblock → remaining 2 placed
+        server.node_register(make_node())
+        assert wait_until(
+            lambda: len([
+                a for a in server.state.allocs_by_job(None, job.id, True)
+                if a.desired_status == s.ALLOC_DESIRED_STATUS_RUN]) == 4,
+            timeout=15.0)
+
+    def test_node_down_triggers_replacement(self, server):
+        n1, n2 = make_node(), make_node()
+        server.node_register(n1)
+        server.node_register(n2)
+        job = make_job(2)
+        server.job_register(job)
+        assert wait_until(
+            lambda: len(server.state.allocs_by_job(None, job.id, True)) == 2)
+
+        victims = [a for a in server.state.allocs_by_job(None, job.id, True)
+                   if a.node_id == n1.id]
+        server.node_update_status(n1.id, s.NODE_STATUS_DOWN)
+
+        def replaced():
+            allocs = server.state.allocs_by_job(None, job.id, True)
+            live = [a for a in allocs
+                    if a.desired_status == s.ALLOC_DESIRED_STATUS_RUN
+                    and a.node_id == n2.id]
+            lost = [a for a in allocs if a.client_status == s.ALLOC_CLIENT_STATUS_LOST]
+            return len(live) == 2 and len(lost) == len(victims)
+
+        assert wait_until(replaced)
+
+    def test_heartbeat_expiry_marks_node_down(self):
+        srv = Server(ServerConfig(num_schedulers=1, min_heartbeat_ttl=0.3,
+                                  max_heartbeats_per_second=1000.0))
+        srv.heartbeat.grace = 0.2
+        srv.start()
+        try:
+            node = make_node()
+            srv.node_register(node)
+            srv.node_update_status(node.id, s.NODE_STATUS_READY)
+            # stop heartbeating: TTL 0.3 + grace 0.2 → down within ~1s
+            assert wait_until(
+                lambda: srv.state.node_by_id(None, node.id).status == s.NODE_STATUS_DOWN,
+                timeout=5.0)
+        finally:
+            srv.shutdown()
+
+    def test_job_deregister_stops_allocs(self, server):
+        server.node_register(make_node())
+        job = make_job(2)
+        server.job_register(job)
+        assert wait_until(
+            lambda: len(server.state.allocs_by_job(None, job.id, True)) == 2)
+        server.job_deregister(job.id, purge=False)
+        assert wait_until(
+            lambda: all(a.desired_status == s.ALLOC_DESIRED_STATUS_STOP
+                        for a in server.state.allocs_by_job(None, job.id, True)))
+
+    def test_system_job_on_all_nodes(self, server):
+        nodes = [make_node() for _ in range(3)]
+        for n in nodes:
+            server.node_register(n)
+            server.node_update_status(n.id, s.NODE_STATUS_READY)
+        job = mock.system_job()
+        for t in job.task_groups[0].tasks:
+            t.resources.networks = []
+        server.job_register(job)
+        assert wait_until(
+            lambda: len(server.state.allocs_by_job(None, job.id, True)) == 3)
+        placed_nodes = {a.node_id for a in server.state.allocs_by_job(None, job.id, True)}
+        assert placed_nodes == {n.id for n in nodes}
+
+    def test_periodic_job_dispatches_child(self, server):
+        job = mock.job()
+        for t in job.task_groups[0].tasks:
+            t.resources.networks = []
+        job.type = s.JOB_TYPE_BATCH
+        # test spec: launch once, just in the future
+        launch_at = time.time() + 0.5
+        job.periodic = s.PeriodicConfig(
+            enabled=True, spec_type=s.PERIODIC_SPEC_TEST, spec=str(launch_at))
+        server.node_register(make_node())
+        index, eval_id = server.job_register(job)
+        assert eval_id == ""  # periodic jobs get no immediate eval
+
+        def child_exists():
+            return any(j.parent_id == job.id for j in server.state.jobs(None))
+
+        assert wait_until(child_exists, timeout=10.0)
+        launch = server.state.periodic_launch_by_id(None, job.id)
+        assert launch is not None
+
+    def test_force_gc_removes_terminal_evals(self, server):
+        server.node_register(make_node())
+        job = make_job(1)
+        _, eval_id = server.job_register(job)
+        assert wait_until(
+            lambda: server.state.eval_by_id(None, eval_id) is not None and
+            server.state.eval_by_id(None, eval_id).status == s.EVAL_STATUS_COMPLETE)
+        # mark the allocs client-terminal so the eval becomes GC-able
+        allocs = server.state.allocs_by_job(None, job.id, True)
+        for a in allocs:
+            done = a.copy()
+            done.client_status = s.ALLOC_CLIENT_STATUS_COMPLETE
+            server.node_update_allocs([done])
+        server.system_gc()
+        assert wait_until(
+            lambda: server.state.eval_by_id(None, eval_id) is None, timeout=10.0)
+
+
+class TestEvalBroker:
+    def make_eval(self, job_id=None, priority=50):
+        ev = mock.eval()
+        ev.priority = priority
+        if job_id:
+            ev.job_id = job_id
+        return ev
+
+    def test_enqueue_dequeue_ack(self):
+        b = EvalBroker(nack_timeout=5.0)
+        b.set_enabled(True)
+        ev = self.make_eval()
+        b.enqueue(ev)
+        out, token = b.dequeue([s.JOB_TYPE_SERVICE], 1.0)
+        assert out.id == ev.id
+        assert token
+        assert b.outstanding(ev.id) == (token, True)
+        b.ack(ev.id, token)
+        assert b.outstanding(ev.id) == ("", False)
+        assert b.stats()["total_ready"] == 0
+
+    def test_priority_order(self):
+        b = EvalBroker()
+        b.set_enabled(True)
+        low = self.make_eval(priority=20)
+        high = self.make_eval(priority=90)
+        b.enqueue(low)
+        b.enqueue(high)
+        out, t1 = b.dequeue([s.JOB_TYPE_SERVICE], 1.0)
+        assert out.id == high.id
+        b.ack(high.id, t1)
+        out2, _ = b.dequeue([s.JOB_TYPE_SERVICE], 1.0)
+        assert out2.id == low.id
+
+    def test_per_job_serialization(self):
+        b = EvalBroker()
+        b.set_enabled(True)
+        ev1 = self.make_eval(job_id="same-job")
+        ev2 = self.make_eval(job_id="same-job")
+        b.enqueue(ev1)
+        b.enqueue(ev2)
+        out1, t1 = b.dequeue([s.JOB_TYPE_SERVICE], 1.0)
+        # second eval for the job is blocked until ack
+        out2, _ = b.dequeue([s.JOB_TYPE_SERVICE], 0)
+        assert out2 is None
+        b.ack(out1.id, t1)
+        out3, _ = b.dequeue([s.JOB_TYPE_SERVICE], 1.0)
+        assert out3.id == ev2.id
+
+    def test_nack_redelivers_then_fails(self):
+        b = EvalBroker(nack_timeout=5.0, initial_nack_delay=0.0,
+                       subsequent_nack_delay=0.0, delivery_limit=2)
+        b.set_enabled(True)
+        ev = self.make_eval()
+        b.enqueue(ev)
+        out, token = b.dequeue([s.JOB_TYPE_SERVICE], 1.0)
+        b.nack(ev.id, token)
+        out, token2 = b.dequeue([s.JOB_TYPE_SERVICE], 1.0)
+        assert out.id == ev.id
+        b.nack(ev.id, token2)
+        # delivery limit hit → failed queue only
+        out_none, _ = b.dequeue([s.JOB_TYPE_SERVICE], 0)
+        assert out_none is None
+        failed, _ = b.dequeue(["_failed"], 1.0)
+        assert failed.id == ev.id
+
+    def test_token_fencing(self):
+        b = EvalBroker()
+        b.set_enabled(True)
+        ev = self.make_eval()
+        b.enqueue(ev)
+        out, token = b.dequeue([s.JOB_TYPE_SERVICE], 1.0)
+        with pytest.raises(EvalBrokerError):
+            b.ack(ev.id, "wrong-token")
+        b.ack(ev.id, token)
+
+    def test_wait_delay(self):
+        b = EvalBroker()
+        b.set_enabled(True)
+        ev = self.make_eval()
+        ev.wait = 0.2
+        b.enqueue(ev)
+        out, _ = b.dequeue([s.JOB_TYPE_SERVICE], 0)
+        assert out is None
+        time.sleep(0.3)
+        out, _ = b.dequeue([s.JOB_TYPE_SERVICE], 1.0)
+        assert out.id == ev.id
+
+    def test_dequeue_batch_drains(self):
+        b = EvalBroker()
+        b.set_enabled(True)
+        evals = [self.make_eval() for _ in range(5)]
+        for ev in evals:
+            b.enqueue(ev)
+        batch = b.dequeue_batch([s.JOB_TYPE_SERVICE], 10, 1.0)
+        assert len(batch) == 5
+        for ev, token in batch:
+            b.ack(ev.id, token)
+
+
+class TestRaftPersistence:
+    def test_wal_replay_and_snapshot(self, tmp_path):
+        cfg = ServerConfig(data_dir=str(tmp_path / "raft"))
+        srv = Server(cfg)
+        srv.start()
+        try:
+            srv.node_register(make_node())
+            job = make_job(2)
+            srv.job_register(job)
+            assert wait_until(
+                lambda: len(srv.state.allocs_by_job(None, job.id, True)) == 2)
+            applied = srv.raft.applied_index()
+        finally:
+            srv.shutdown()
+
+        # restart: WAL replay restores everything
+        srv2 = Server(ServerConfig(data_dir=str(tmp_path / "raft")))
+        try:
+            assert srv2.raft.applied_index() == applied
+            assert len(srv2.state.allocs_by_job(None, job.id, True)) == 2
+            assert len(srv2.state.nodes(None)) == 1
+            # snapshot + truncate, then restart again
+            srv2.raft.snapshot()
+        finally:
+            srv2.raft.close()
+
+        srv3 = Server(ServerConfig(data_dir=str(tmp_path / "raft")))
+        try:
+            assert srv3.raft.applied_index() == applied
+            assert len(srv3.state.allocs_by_job(None, job.id, True)) == 2
+        finally:
+            srv3.raft.close()
