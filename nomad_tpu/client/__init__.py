@@ -1,0 +1,17 @@
+"""L5 data plane: node agent, alloc/task runners, drivers, fingerprints
+(reference: client/)."""
+
+from .alloc_runner import AllocRunner, get_client_status
+from .client import Client
+from .config import ClientConfig
+from .restarts import RestartTracker
+from .task_runner import TaskRunner
+
+__all__ = [
+    "AllocRunner",
+    "Client",
+    "ClientConfig",
+    "RestartTracker",
+    "TaskRunner",
+    "get_client_status",
+]

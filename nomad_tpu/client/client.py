@@ -1,0 +1,322 @@
+"""Client: the node agent — fingerprints the host, registers the node,
+heartbeats, long-polls its allocation set, diffs it against running
+AllocRunners, and batches alloc status updates back to the servers
+(reference: client/client.go:99-2461).
+
+The server connection is abstracted behind the duck-typed RPC surface
+(node_register / node_update_status / node_update_allocs /
+node_get_client_allocs) so the same Client runs against an in-process
+Server (dev/test, like the reference's dev agent) or a remote RPC proxy
+(agent networking layer).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..structs import structs as s
+from .alloc_runner import AllocRunner
+from .config import ClientConfig
+from .fingerprint import fingerprint_node
+from .gc import AllocGarbageCollector
+from .state import StateDB
+from .stats import HostStatsCollector, ServerList
+
+# Import for driver-registry side effects (BuiltinDrivers registration).
+from .driver import mock_driver as _mock_driver  # noqa: F401
+from .driver import exec_drivers as _exec_drivers  # noqa: F401
+from .driver.driver import BUILTIN_DRIVERS, DriverContext, new_driver
+
+# Status-sync batching interval (client.go:76-78 allocSyncIntv = 200ms).
+ALLOC_SYNC_INTERVAL = 0.2
+REGISTER_RETRY_INTERVAL = 15.0
+INITIAL_HEARTBEAT_STAGGER = 10.0
+
+
+class Client:
+    def __init__(self, config: Optional[ClientConfig] = None,
+                 rpc=None,
+                 logger: Optional[logging.Logger] = None):
+        self.config = config or ClientConfig()
+        self.rpc = rpc
+        self.logger = logger or logging.getLogger("nomad_tpu.client")
+
+        if not self.config.alloc_dir:
+            self.config.alloc_dir = tempfile.mkdtemp(prefix="nomad-tpu-alloc-")
+        self.state_db: Optional[StateDB] = None
+        if self.config.state_dir:
+            self.state_db = StateDB(self.config.state_dir)
+
+        self.node = self._setup_node()
+        self._fingerprint()
+        self._setup_drivers()
+
+        self.alloc_runners: Dict[str, AllocRunner] = {}
+        self._alloc_lock = threading.Lock()
+        self._alloc_updates: Dict[str, s.Allocation] = {}
+        self._alloc_updates_lock = threading.Lock()
+        self.garbage_collector = AllocGarbageCollector(
+            self.config, stats_path=self.config.alloc_dir, logger=self.logger)
+        self.host_stats = HostStatsCollector(self.config.alloc_dir)
+        self.servers = ServerList(self.config.servers)
+
+        self.heartbeat_ttl = 10.0
+        self._registered = threading.Event()
+        self._shutdown = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._latest_alloc_index = 0
+
+        # Restore persisted alloc runners before any server traffic, like
+        # NewClient → restoreState (client.go:335).
+        if self.state_db is not None:
+            self._restore_state()
+
+    # -- node construction -------------------------------------------------
+    def _setup_node(self) -> s.Node:
+        """(client.go:253 setupNode)."""
+        node = s.Node(
+            id=s.generate_uuid(),
+            datacenter=self.config.datacenter,
+            name=self.config.node_name or os.uname().nodename,
+            node_class=self.config.node_class,
+            attributes={},
+            meta=dict(self.config.meta),
+            resources=s.Resources(),
+            reserved=self.config.reserved or s.Resources(),
+            status=s.NODE_STATUS_INIT,
+        )
+        return node
+
+    def _fingerprint(self) -> None:
+        applied = fingerprint_node(self.config, self.node)
+        if self.config.cpu_total_compute:
+            self.node.resources.cpu = self.config.cpu_total_compute
+            self.node.attributes["cpu.totalcompute"] = str(
+                self.config.cpu_total_compute)
+        self.logger.info("client: fingerprints applied: %s", ",".join(applied))
+
+    def _setup_drivers(self) -> None:
+        """Driver availability scan (client.go:969 setupDrivers)."""
+        avail = []
+        for name in BUILTIN_DRIVERS:
+            ctx = DriverContext(driver_name=name, alloc_id="",
+                                config=self.config, node=self.node)
+            try:
+                d = new_driver(name, ctx)
+                if d.fingerprint(self.node):
+                    avail.append(name)
+            except Exception:
+                continue
+        self.node.compute_class()
+        self.logger.info("client: available drivers: %s", ",".join(avail))
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        for target in (self._register_and_heartbeat, self._watch_allocations,
+                       self._alloc_sync_loop):
+            t = threading.Thread(target=target, daemon=True,
+                                 name=f"client-{target.__name__}")
+            t.start()
+            self._threads.append(t)
+        self.garbage_collector.run()
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        self.garbage_collector.stop()
+        with self._alloc_lock:
+            runners = list(self.alloc_runners.values())
+        for r in runners:
+            r.save_state()
+
+    # -- registration + heartbeat (client.go:1031) -------------------------
+    def _try_register(self) -> bool:
+        try:
+            _index, ttl = self.rpc.node_register(self.node.copy())
+            self.heartbeat_ttl = ttl or self.heartbeat_ttl
+            self.node.status = s.NODE_STATUS_READY
+            self._registered.set()
+            return True
+        except Exception as e:
+            self.logger.warning("client: registration failed: %s", e)
+            return False
+
+    def _register_and_heartbeat(self) -> None:
+        while not self._shutdown.is_set():
+            if self._try_register():
+                break
+            if self._shutdown.wait(REGISTER_RETRY_INTERVAL):
+                return
+        # Heartbeat at TTL/2-ish like the reference's jittered resend
+        while not self._shutdown.is_set():
+            wait = max(0.5, self.heartbeat_ttl / 2.0)
+            if self._shutdown.wait(wait):
+                return
+            try:
+                _index, ttl = self.rpc.node_update_status(
+                    self.node.id, s.NODE_STATUS_READY)
+                if ttl:
+                    self.heartbeat_ttl = ttl
+            except Exception as e:
+                # The server may have forgotten us (restart with lost state,
+                # node GC) — fall back to re-registration like
+                # client.go:1127 (retryRegisterNode on heartbeat failure).
+                self.logger.warning(
+                    "client: heartbeat failed, re-registering: %s", e)
+                self._try_register()
+
+    # -- allocation watching (client.go:1364 watchAllocations) -------------
+    def _watch_allocations(self) -> None:
+        self._registered.wait()
+        while not self._shutdown.is_set():
+            try:
+                allocs, index = self.rpc.node_get_client_allocs(
+                    self.node.id, min_index=self._latest_alloc_index,
+                    max_wait=5.0)
+            except Exception as e:
+                self.logger.warning("client: alloc watch failed: %s", e)
+                if self._shutdown.wait(1.0):
+                    return
+                continue
+            if index <= self._latest_alloc_index:
+                continue
+            self._latest_alloc_index = index
+            self._run_allocs(allocs)
+
+    def _run_allocs(self, server_allocs: List[s.Allocation]) -> None:
+        """Diff desired vs running (client.go:1559 runAllocs)."""
+        by_id = {a.id: a for a in server_allocs}
+        with self._alloc_lock:
+            existing = dict(self.alloc_runners)
+
+        # removals: the server no longer knows the alloc
+        for alloc_id, runner in existing.items():
+            if alloc_id not in by_id:
+                self._remove_alloc(alloc_id, runner)
+
+        for alloc_id, alloc in by_id.items():
+            runner = existing.get(alloc_id)
+            if runner is None:
+                if not alloc.terminal_status():
+                    self._add_alloc(alloc)
+            elif alloc.alloc_modify_index > runner.alloc.alloc_modify_index:
+                runner.update(alloc)
+
+    def _add_alloc(self, alloc: s.Allocation) -> None:
+        """(client.go:1812 addAlloc) + sticky-disk chaining."""
+        tg = alloc.job.lookup_task_group(alloc.task_group) if alloc.job else None
+        prev_dir = None
+        if (alloc.previous_allocation and tg is not None
+                and tg.ephemeral_disk is not None and tg.ephemeral_disk.sticky):
+            with self._alloc_lock:
+                prev = self.alloc_runners.get(alloc.previous_allocation)
+            if prev is not None:
+                prev_dir = prev.alloc_dir
+
+        self.garbage_collector.make_room_for(
+            tg.ephemeral_disk.size_mb if tg and tg.ephemeral_disk else 0,
+            total_live_allocs=len(self.alloc_runners))
+
+        runner = AllocRunner(
+            config=self.config,
+            alloc=alloc,
+            updater=self._alloc_status_update,
+            node=self.node,
+            state_db=self.state_db,
+            prev_alloc_dir=prev_dir,
+            logger=self.logger,
+        )
+        # Block start on the previous alloc reaching a terminal state
+        # (sticky disk / in-place upgrade ordering, client.go:1654).
+        if alloc.previous_allocation:
+            with self._alloc_lock:
+                prev = self.alloc_runners.get(alloc.previous_allocation)
+            if prev is not None and not prev.done.is_set():
+                runner.waiting_on_previous.clear()
+                threading.Thread(
+                    target=lambda: (prev.done.wait(),
+                                    runner.waiting_on_previous.set()),
+                    daemon=True).start()
+        with self._alloc_lock:
+            self.alloc_runners[alloc.id] = runner
+        runner.run()
+
+    def _remove_alloc(self, alloc_id: str, runner: AllocRunner) -> None:
+        with self._alloc_lock:
+            self.alloc_runners.pop(alloc_id, None)
+        runner.destroy()
+        self.garbage_collector.mark_for_collection(runner)
+
+    # -- status sync (client.go:1305 allocSync) ----------------------------
+    def _alloc_status_update(self, alloc: s.Allocation) -> None:
+        with self._alloc_updates_lock:
+            self._alloc_updates[alloc.id] = alloc
+        if alloc.terminal_status():
+            with self._alloc_lock:
+                runner = self.alloc_runners.get(alloc.id)
+            if runner is not None:
+                self.garbage_collector.mark_for_collection(runner)
+
+    def _alloc_sync_loop(self) -> None:
+        while not self._shutdown.wait(ALLOC_SYNC_INTERVAL):
+            with self._alloc_updates_lock:
+                if not self._alloc_updates:
+                    continue
+                batch = list(self._alloc_updates.values())
+                self._alloc_updates = {}
+            try:
+                self.rpc.node_update_allocs(batch)
+            except Exception as e:
+                self.logger.warning("client: alloc sync failed: %s", e)
+                with self._alloc_updates_lock:
+                    for a in batch:
+                        self._alloc_updates.setdefault(a.id, a)
+
+    # -- restore (client.go:335 restoreState) ------------------------------
+    def _restore_state(self) -> None:
+        for alloc_id in self.state_db.list_alloc_runners():
+            state = self.state_db.get_alloc_runner(alloc_id)
+            if not state:
+                continue
+            alloc = state.get("alloc")
+            if alloc is None:
+                continue
+            runner = AllocRunner(
+                config=self.config, alloc=alloc,
+                updater=self._alloc_status_update, node=self.node,
+                state_db=self.state_db, logger=self.logger)
+            runner.task_states = dict(state.get("task_states", {}))
+            with self._alloc_lock:
+                self.alloc_runners[alloc_id] = runner
+            if not alloc.terminal_status():
+                runner.run()
+            else:
+                runner.done.set()
+                self.garbage_collector.mark_for_collection(runner)
+
+    # -- introspection (client HTTP endpoints) -----------------------------
+    def get_alloc_runner(self, alloc_id: str) -> Optional[AllocRunner]:
+        with self._alloc_lock:
+            return self.alloc_runners.get(alloc_id)
+
+    def get_client_alloc(self, alloc_id: str) -> Optional[s.Allocation]:
+        runner = self.get_alloc_runner(alloc_id)
+        return runner.current_alloc() if runner else None
+
+    def stats(self) -> Dict:
+        with self._alloc_lock:
+            n = len(self.alloc_runners)
+        return {
+            "node_id": self.node.id,
+            "known_servers": self.servers.all(),
+            "num_allocations": n,
+            "last_heartbeat_ttl": self.heartbeat_ttl,
+            "host_stats": self.host_stats.collect(),
+        }
+
+    def num_allocs(self) -> int:
+        with self._alloc_lock:
+            return len(self.alloc_runners)

@@ -1,0 +1,42 @@
+"""Client configuration (reference: client/config/config.go)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..structs import structs as s
+
+
+@dataclass
+class ClientConfig:
+    state_dir: str = ""                 # "" → no persistence (dev mode)
+    alloc_dir: str = ""                 # "" → tmp dir
+    region: str = "global"
+    datacenter: str = "dc1"
+    node_name: str = ""
+    node_class: str = ""
+    network_interface: str = ""
+    network_speed: int = 0
+    cpu_total_compute: int = 0
+    max_kill_timeout: float = 30.0
+    meta: Dict[str, str] = field(default_factory=dict)
+    options: Dict[str, str] = field(default_factory=dict)
+    reserved: Optional[s.Resources] = None
+    servers: List[str] = field(default_factory=list)
+    # GC knobs (client/config/config.go:180-204)
+    gc_interval: float = 60.0
+    gc_disk_usage_threshold: float = 80.0
+    gc_inode_usage_threshold: float = 70.0
+    gc_max_allocs: int = 50
+    gc_parallel_destroys: int = 2
+    # Dev-mode shortcuts
+    dev_mode: bool = False
+
+    def read_option(self, key: str, default: str = "") -> str:
+        return self.options.get(key, default)
+
+    def read_bool_option(self, key: str, default: bool = False) -> bool:
+        v = self.options.get(key)
+        if v is None:
+            return default
+        return str(v).lower() in ("1", "true", "yes")

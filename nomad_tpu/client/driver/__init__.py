@@ -1,0 +1,33 @@
+"""Driver framework + builtin drivers (reference: client/driver/)."""
+
+from .driver import (
+    BUILTIN_DRIVERS,
+    Driver,
+    DriverAbilities,
+    DriverContext,
+    DriverError,
+    DriverHandle,
+    ExecContext,
+    RecoverableError,
+    StartResponse,
+    WaitResult,
+    new_driver,
+    register_driver,
+    validate_driver_config,
+)
+
+__all__ = [
+    "BUILTIN_DRIVERS",
+    "Driver",
+    "DriverAbilities",
+    "DriverContext",
+    "DriverError",
+    "DriverHandle",
+    "ExecContext",
+    "RecoverableError",
+    "StartResponse",
+    "WaitResult",
+    "new_driver",
+    "register_driver",
+    "validate_driver_config",
+]

@@ -1,0 +1,273 @@
+"""Universal executor: runs task processes with stdout/stderr log
+rotation, pid tracking, resource stats, and graceful shutdown
+(reference: client/driver/executor/executor.go:50-726,
+client/driver/logging/rotator.go).
+
+The reference runs this as a go-plugin *subprocess* so tasks survive agent
+restarts; here tasks are direct children detached into their own session
+(``start_new_session``), and re-attach after agent restart is done by pid
+(`attach`), which covers the same restart-survival contract without a
+plugin RPC layer.
+"""
+from __future__ import annotations
+
+import os
+import resource
+import signal
+import subprocess
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .driver import WaitResult
+
+
+class LogRotator:
+    """Size-based rotating file writer
+    (reference: client/driver/logging/rotator.go).
+
+    Files are named ``<task>.<stream>.<n>`` under the log dir; at most
+    ``max_files`` are kept.
+    """
+
+    def __init__(self, log_dir: str, base_name: str,
+                 max_files: int = 10, file_size_mb: int = 10):
+        self.log_dir = log_dir
+        self.base_name = base_name
+        self.max_files = max(1, max_files)
+        self.max_bytes = file_size_mb * 1024 * 1024
+        self._idx = self._initial_index()
+        self._fh = None
+        self._written = 0
+        self._lock = threading.Lock()
+
+    def _path(self, idx: int) -> str:
+        return os.path.join(self.log_dir, f"{self.base_name}.{idx}")
+
+    def _initial_index(self) -> int:
+        try:
+            existing = [
+                int(f.rsplit(".", 1)[1])
+                for f in os.listdir(self.log_dir)
+                if f.startswith(self.base_name + ".") and f.rsplit(".", 1)[1].isdigit()
+            ]
+        except OSError:
+            existing = []
+        return max(existing, default=0)
+
+    def _open(self) -> None:
+        path = self._path(self._idx)
+        self._fh = open(path, "ab")
+        self._written = self._fh.tell()
+
+    def write(self, data: bytes) -> None:
+        with self._lock:
+            if self._fh is None:
+                self._open()
+            if self._written + len(data) > self.max_bytes:
+                self._fh.close()
+                self._idx += 1
+                self._open()
+                self._purge()
+            self._fh.write(data)
+            self._fh.flush()
+            self._written += len(data)
+
+    def _purge(self) -> None:
+        lo = self._idx - self.max_files + 1
+        for f in os.listdir(self.log_dir):
+            if f.startswith(self.base_name + "."):
+                tail = f.rsplit(".", 1)[1]
+                if tail.isdigit() and int(tail) < lo:
+                    try:
+                        os.unlink(os.path.join(self.log_dir, f))
+                    except OSError:
+                        pass
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh:
+                self._fh.close()
+                self._fh = None
+
+
+@dataclass
+class ExecCommand:
+    """(executor.go ExecCommand)."""
+
+    cmd: str
+    args: List[str] = field(default_factory=list)
+    env: Dict[str, str] = field(default_factory=dict)
+    cwd: str = ""
+    task_name: str = "task"
+    log_dir: str = ""
+    max_log_files: int = 10
+    max_log_file_size_mb: int = 10
+    cpu_limit: int = 0        # MHz ask — advisory (no cgroups here)
+    memory_limit_mb: int = 0  # enforced via RLIMIT_AS when >0
+    user: str = ""
+
+
+class Executor:
+    """Runs one task process (reference: executor.go:50 UniversalExecutor)."""
+
+    def __init__(self, command: ExecCommand):
+        self.command = command
+        self.proc: Optional[subprocess.Popen] = None
+        self.pid = 0
+        self.start_time = 0.0
+        self.exited = threading.Event()
+        self.result: Optional[WaitResult] = None
+        self._out_rot: Optional[LogRotator] = None
+        self._err_rot: Optional[LogRotator] = None
+        self._pumps: List[threading.Thread] = []
+
+    # -- lifecycle ---------------------------------------------------------
+    def launch(self) -> int:
+        c = self.command
+        stdout = stderr = subprocess.DEVNULL
+        if c.log_dir:
+            os.makedirs(c.log_dir, exist_ok=True)
+            self._out_rot = LogRotator(c.log_dir, f"{c.task_name}.stdout",
+                                       c.max_log_files, c.max_log_file_size_mb)
+            self._err_rot = LogRotator(c.log_dir, f"{c.task_name}.stderr",
+                                       c.max_log_files, c.max_log_file_size_mb)
+            stdout = stderr = subprocess.PIPE
+
+        def preexec():
+            if c.memory_limit_mb > 0:
+                lim = c.memory_limit_mb * 1024 * 1024
+                try:
+                    resource.setrlimit(resource.RLIMIT_AS, (lim, lim))
+                except (ValueError, OSError):
+                    pass
+
+        self.proc = subprocess.Popen(
+            [c.cmd] + list(c.args),
+            env=c.env or None,
+            cwd=c.cwd or None,
+            stdout=stdout,
+            stderr=stderr,
+            start_new_session=True,
+            preexec_fn=preexec,
+        )
+        self.pid = self.proc.pid
+        self.start_time = time.time()
+        if self._out_rot:
+            self._pumps = [
+                threading.Thread(target=self._pump, args=(self.proc.stdout, self._out_rot),
+                                 daemon=True),
+                threading.Thread(target=self._pump, args=(self.proc.stderr, self._err_rot),
+                                 daemon=True),
+            ]
+            for t in self._pumps:
+                t.start()
+        threading.Thread(target=self._wait, daemon=True).start()
+        return self.pid
+
+    @staticmethod
+    def _pump(stream, rot: LogRotator) -> None:
+        try:
+            for chunk in iter(lambda: stream.read(8192), b""):
+                rot.write(chunk)
+        except (OSError, ValueError):
+            pass
+        finally:
+            rot.close()
+
+    def _wait(self) -> None:
+        rc = self.proc.wait()
+        for t in self._pumps:
+            t.join(timeout=2.0)
+        if rc < 0:
+            self.result = WaitResult(exit_code=0, signal=-rc)
+        else:
+            self.result = WaitResult(exit_code=rc)
+        self.exited.set()
+
+    # -- control -----------------------------------------------------------
+    def shutdown(self, grace: float = 5.0) -> None:
+        """SIGINT → grace → SIGKILL the whole process group
+        (executor.go Exit/ShutDown)."""
+        if self.proc is None or self.result is not None:
+            return
+        try:
+            os.killpg(self.pid, signal.SIGINT)
+        except (ProcessLookupError, PermissionError, OSError):
+            return
+        if not self.exited.wait(grace):
+            try:
+                os.killpg(self.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError, OSError):
+                pass
+
+    def send_signal(self, sig: int) -> None:
+        if self.proc is not None and self.result is None:
+            os.kill(self.pid, sig)
+
+    def stats(self) -> Dict:
+        """Resource usage snapshot (executor.go:643 collectPids/stats)."""
+        try:
+            with open(f"/proc/{self.pid}/stat", "rb") as f:
+                parts = f.read().split()
+            utime, stime = int(parts[13]), int(parts[14])
+            rss_pages = int(parts[23])
+            hz = os.sysconf("SC_CLK_TCK")
+            page = os.sysconf("SC_PAGE_SIZE")
+            return {
+                "pid": self.pid,
+                "cpu_seconds": (utime + stime) / hz,
+                "rss_bytes": rss_pages * page,
+                "uptime": time.time() - self.start_time,
+            }
+        except (OSError, IndexError, ValueError):
+            return {"pid": self.pid}
+
+
+def attach(pid: int) -> Optional["AttachedExecutor"]:
+    """Re-attach to a still-running task process after agent restart
+    (reference: executor plugin re-connect, task_runner.go:279)."""
+    try:
+        os.kill(pid, 0)
+    except (ProcessLookupError, PermissionError):
+        return None
+    return AttachedExecutor(pid)
+
+
+class AttachedExecutor(Executor):
+    """Executor recovered by pid: can signal/kill/poll but not re-collect
+    the exit code (the reaper lost it across the restart) — reports exit 0
+    when the pid disappears, like the reference's best-effort re-attach."""
+
+    def __init__(self, pid: int):
+        super().__init__(ExecCommand(cmd=""))
+        self.pid = pid
+        self.start_time = time.time()
+        threading.Thread(target=self._poll, daemon=True).start()
+
+    def _poll(self) -> None:
+        while True:
+            try:
+                os.kill(self.pid, 0)
+            except (ProcessLookupError, PermissionError):
+                self.result = WaitResult(exit_code=0)
+                self.exited.set()
+                return
+            time.sleep(1.0)
+
+    def shutdown(self, grace: float = 5.0) -> None:
+        if self.result is not None:
+            return
+        try:
+            os.killpg(self.pid, signal.SIGINT)
+        except (ProcessLookupError, PermissionError, OSError):
+            try:
+                os.kill(self.pid, signal.SIGINT)
+            except (ProcessLookupError, PermissionError, OSError):
+                return
+        if not self.exited.wait(grace):
+            try:
+                os.killpg(self.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError, OSError):
+                pass

@@ -1,0 +1,78 @@
+"""Artifact fetcher (reference: client/getter/getter.go:36-127, which
+wraps go-getter).
+
+Supports ``file://`` paths, plain local paths, and ``http(s)://`` URLs,
+with optional sha256/md5 checksum verification via the same
+``checksum=<type>:<hex>`` option go-getter uses.  Source strings are
+env-interpolated before fetch (getter.go GetArtifact).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import urllib.parse
+import urllib.request
+from typing import Optional
+
+from ..structs import structs as s
+from .driver.env import TaskEnv
+
+
+class ArtifactError(Exception):
+    pass
+
+
+def get_artifact(task_env: TaskEnv, artifact: s.TaskArtifact, task_dir: str) -> str:
+    source = task_env.replace_env(artifact.getter_source or "")
+    if not source:
+        raise ArtifactError("artifact source empty")
+    rel_dest = task_env.replace_env(artifact.relative_dest or "local/")
+    dest_dir = os.path.join(task_dir, rel_dest.lstrip("/"))
+    os.makedirs(dest_dir, exist_ok=True)
+
+    parsed = urllib.parse.urlparse(source)
+    name = os.path.basename(parsed.path) or "artifact"
+    dest = os.path.join(dest_dir, name)
+
+    if parsed.scheme in ("", "file"):
+        src_path = parsed.path if parsed.scheme == "file" else source
+        if not os.path.exists(src_path):
+            raise ArtifactError(f"artifact not found: {src_path}")
+        if os.path.isdir(src_path):
+            shutil.copytree(src_path, dest, dirs_exist_ok=True)
+        else:
+            shutil.copy2(src_path, dest)
+    elif parsed.scheme in ("http", "https"):
+        try:
+            with urllib.request.urlopen(source, timeout=30) as resp, \
+                    open(dest, "wb") as out:
+                shutil.copyfileobj(resp, out)
+        except OSError as e:
+            raise ArtifactError(f"failed to fetch {source}: {e}") from e
+    else:
+        raise ArtifactError(f"unsupported artifact scheme {parsed.scheme!r}")
+
+    _verify_checksum(artifact, task_env, dest)
+    return dest
+
+
+def _verify_checksum(artifact: s.TaskArtifact, task_env: TaskEnv, path: str) -> None:
+    opts = artifact.getter_options or {}
+    spec = task_env.replace_env(opts.get("checksum", "") or "")
+    if not spec or os.path.isdir(path):
+        return
+    try:
+        algo, want = spec.split(":", 1)
+    except ValueError:
+        raise ArtifactError(f"bad checksum spec {spec!r}")
+    try:
+        h = hashlib.new(algo)
+    except ValueError:
+        raise ArtifactError(f"unsupported checksum algo {algo!r}")
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 16), b""):
+            h.update(chunk)
+    if h.hexdigest() != want.lower():
+        raise ArtifactError(
+            f"checksum mismatch for {path}: got {h.hexdigest()}, want {want}")

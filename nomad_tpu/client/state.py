@@ -1,0 +1,55 @@
+"""Client state persistence: alloc/task-runner state checkpointed to
+disk so a restarted agent re-attaches to its work
+(reference: client/client.go:357 bolt state.db,
+alloc_runner.go:322 saveAllocRunnerState).
+
+The reference uses boltdb; here each alloc's state is one pickle file
+under ``<state_dir>/allocs/<alloc_id>`` written atomically (tmp+rename),
+giving the same crash-safety contract (a partially written state file is
+never observed).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from typing import Dict, List, Optional
+
+
+class StateDB:
+    def __init__(self, state_dir: str):
+        self.dir = os.path.join(state_dir, "allocs")
+        os.makedirs(self.dir, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def _path(self, alloc_id: str) -> str:
+        return os.path.join(self.dir, alloc_id)
+
+    def put_alloc_runner(self, alloc_id: str, state: Dict) -> None:
+        path = self._path(alloc_id)
+        tmp = path + ".tmp"
+        with self._lock:
+            with open(tmp, "wb") as f:
+                pickle.dump(state, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+
+    def get_alloc_runner(self, alloc_id: str) -> Optional[Dict]:
+        try:
+            with open(self._path(alloc_id), "rb") as f:
+                return pickle.load(f)
+        except (OSError, pickle.UnpicklingError, EOFError):
+            return None
+
+    def list_alloc_runners(self) -> List[str]:
+        try:
+            return [f for f in os.listdir(self.dir) if not f.endswith(".tmp")]
+        except OSError:
+            return []
+
+    def delete_alloc_runner(self, alloc_id: str) -> None:
+        try:
+            os.unlink(self._path(alloc_id))
+        except OSError:
+            pass

@@ -27,7 +27,11 @@ class PeriodicDispatch:
         self._cond = threading.Condition(self._l)
         self._enabled = False
         self.tracked: Dict[str, s.Job] = {}
-        self._heap: List[Tuple[float, str]] = []
+        # Heap entries carry the tracking generation at push time; a stale
+        # generation means the job was re-added/removed since, and the entry
+        # is a tombstone — prevents duplicate dispatch chains on job update.
+        self._generation: Dict[str, int] = {}
+        self._heap: List[Tuple[float, str, int]] = []
         self._thread: Optional[threading.Thread] = None
 
     def set_enabled(self, enabled: bool) -> None:
@@ -53,14 +57,18 @@ class PeriodicDispatch:
                 self.remove(job.id)
                 return
             self.tracked[job.id] = job
+            gen = self._generation.get(job.id, 0) + 1
+            self._generation[job.id] = gen
             nxt = job.periodic.next(time.time())
             if nxt > 0:
-                heapq.heappush(self._heap, (nxt, job.id))
+                heapq.heappush(self._heap, (nxt, job.id, gen))
             self._cond.notify_all()
 
     def remove(self, job_id: str) -> None:
         with self._l:
             self.tracked.pop(job_id, None)
+            # Bump the generation so in-flight heap entries tombstone.
+            self._generation[job_id] = self._generation.get(job_id, 0) + 1
             self._cond.notify_all()
 
     def force_run(self, job_id: str) -> Optional[s.Job]:
@@ -78,14 +86,14 @@ class PeriodicDispatch:
                     return
                 now = time.time()
                 while self._heap and self._heap[0][0] <= now:
-                    launch_time, job_id = heapq.heappop(self._heap)
+                    launch_time, job_id, gen = heapq.heappop(self._heap)
                     job = self.tracked.get(job_id)
-                    if job is None:
-                        continue
+                    if job is None or gen != self._generation.get(job_id):
+                        continue  # tombstoned by a re-add/remove
                     # re-arm before dispatch so a slow dispatch can't skip
                     nxt = job.periodic.next(launch_time)
                     if nxt > 0:
-                        heapq.heappush(self._heap, (nxt, job_id))
+                        heapq.heappush(self._heap, (nxt, job_id, gen))
                     self._do_dispatch(job, launch_time)
                 wait = 0.5
                 if self._heap:

@@ -146,20 +146,37 @@ class FileLog(RaftLog):
 
         if not os.path.exists(self.wal_path):
             return
+        good_offset = 0
+        torn = False
+        wal_size = os.path.getsize(self.wal_path)
         with open(self.wal_path, "rb") as fh:
             while True:
                 header = fh.read(_LEN.size)
                 if len(header) < _LEN.size:
+                    torn = len(header) > 0
                     break
                 (length,) = _LEN.unpack(header)
+                if length > wal_size - fh.tell():
+                    # length prefix runs past EOF — torn tail (don't even
+                    # attempt the read: a garbage prefix can claim GBs)
+                    torn = True
+                    break
                 blob = fh.read(length)
                 if len(blob) < length:
+                    torn = True
                     break  # torn tail write — discard
                 index, msg_type, payload = pickle.loads(blob)
+                good_offset = fh.tell()
                 if index <= snap_idx:
                     continue
                 self.fsm.apply(index, MessageType(msg_type), payload)
                 self._last_index = index
+        # Truncate the torn tail so subsequent appends follow the last good
+        # record — otherwise new fsynced entries land after garbage and are
+        # unreachable on the next replay (silent loss).
+        if torn:
+            with open(self.wal_path, "r+b") as fh:
+                fh.truncate(good_offset)
 
     # -- persistence -------------------------------------------------------
 

@@ -98,12 +98,14 @@ class Server:
                 worker: Worker = BatchWorker(
                     self.eval_broker, self.plan_queue, self.raft,
                     blocked_evals=self.blocked_evals, logger=self.logger,
+                    time_table=self.time_table,
                     max_batch=self.config.batch_size)
             else:
                 worker = Worker(
                     self.eval_broker, self.plan_queue, self.raft,
                     schedulers=self.config.enabled_schedulers,
-                    blocked_evals=self.blocked_evals, logger=self.logger)
+                    blocked_evals=self.blocked_evals, logger=self.logger,
+                    time_table=self.time_table)
             self.workers.append(worker)
         self.raft.notify_leadership(self._leadership_changed)
         for worker in self.workers:
@@ -265,8 +267,17 @@ class Server:
         """Register the derived child job + record the launch
         (periodic.go:435 createEval)."""
         if parent.periodic and parent.periodic.prohibit_overlap:
-            for ev in self.state.evals_by_job(None, parent.id):
-                if not ev.terminal_status():
+            # A previous launch is still active if any derived child job
+            # (id prefix "<parent>/periodic-") has a live eval or alloc
+            # (periodic.go shouldDispatch via RunningChildren).
+            from .periodic import PERIODIC_LAUNCH_SUFFIX
+            prefix = parent.id + PERIODIC_LAUNCH_SUFFIX
+            for child in self.state.jobs_by_id_prefix(None, prefix):
+                if any(not ev.terminal_status()
+                       for ev in self.state.evals_by_job(None, child.id)):
+                    return
+                if any(not a.terminal_status()
+                       for a in self.state.allocs_by_job(None, child.id)):
                     return
         self.job_register(derived)
         self.raft.apply(MessageType.PERIODIC_LAUNCH_UPSERT,
@@ -354,9 +365,7 @@ class Server:
             triggered_by=s.EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
             job_modify_index=index, status=s.EVAL_STATUS_PENDING,
             annotate_plan=True)
-        sched = new_scheduler(
-            job.type if job.type != s.JOB_TYPE_SYSTEM else s.JOB_TYPE_SYSTEM,
-            self.logger, snap.snapshot(), harness)
+        sched = new_scheduler(job.type, self.logger, snap.snapshot(), harness)
         sched.process(ev)
         return harness.plans[0] if harness.plans else ev.make_plan(job)
 
@@ -463,6 +472,26 @@ class Server:
 
     def node_get_allocs(self, node_id: str) -> List[s.Allocation]:
         return self.state.allocs_by_node(None, node_id)
+
+    def node_get_client_allocs(self, node_id: str, min_index: int = 0,
+                               max_wait: float = 0.0) -> Tuple[List[s.Allocation], int]:
+        """Blocking-query variant the client's watchAllocations long-polls
+        (node_endpoint.go:585 GetClientAllocs + rpc.go:340 blockingRPC):
+        waits until the allocs table passes min_index or max_wait elapses,
+        then returns (allocs, index)."""
+        from ..state.state_store import WatchSet
+        deadline = time.time() + max_wait
+        while True:
+            ws = WatchSet()
+            allocs = self.state.allocs_by_node(ws, node_id)
+            index = max(self.state.table_index("allocs"),
+                        self.state.table_index("nodes"))
+            if index > min_index or max_wait <= 0:
+                return allocs, index
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                return allocs, index
+            ws.watch(timeout=min(remaining, 1.0))
 
     def node_update_allocs(self, allocs: List[s.Allocation]) -> int:
         """Client alloc status sync (node_endpoint.go:657 UpdateAlloc)."""

@@ -92,11 +92,13 @@ class Worker:
         schedulers: Optional[List[str]] = None,
         blocked_evals=None,
         logger: Optional[logging.Logger] = None,
+        time_table=None,
     ):
         self.broker = broker
         self.plan_queue = plan_queue
         self.raft = raft
         self.blocked_evals = blocked_evals
+        self.time_table = time_table
         self.schedulers = schedulers or [
             s.JOB_TYPE_SERVICE, s.JOB_TYPE_BATCH, s.JOB_TYPE_SYSTEM, s.JOB_TYPE_CORE]
         self.logger = logger or logging.getLogger("nomad_tpu.worker")
@@ -180,7 +182,8 @@ class Worker:
         if ev.type == s.JOB_TYPE_CORE:
             from .core_sched import CoreScheduler
 
-            CoreScheduler(self.logger, snap, planner, self.raft).process(ev)
+            CoreScheduler(self.logger, snap, planner, self.raft,
+                          time_table=self.time_table).process(ev)
             return
         sched = new_scheduler(sched_name, self.logger, snap, planner)
         sched.process(ev)
@@ -212,8 +215,8 @@ class BatchWorker(Worker):
                 continue
             if batch:
                 self.process_batch(batch)
-                continue
-            # Fall back to single processing for other types.
+            # Always also poll system/core (zero timeout) so a sustained
+            # service/batch stream cannot starve them.
             try:
                 ev, token = self.broker.dequeue(
                     [s.JOB_TYPE_SYSTEM, s.JOB_TYPE_CORE], 0)

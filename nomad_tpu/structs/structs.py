@@ -693,6 +693,7 @@ TASK_DOWNLOADING_ARTIFACTS = "Downloading Artifacts"
 TASK_ARTIFACT_DOWNLOAD_FAILED = "Failed Artifact Download"
 TASK_SIGNALING = "Signaling"
 TASK_RESTART_SIGNAL = "Restart Signaled"
+TASK_SIBLING_FAILED = "Sibling task failed"
 
 
 @dataclass
@@ -708,6 +709,11 @@ class TaskEvent:
     kill_timeout: float = 0.0
     restart_reason: str = ""
     failed_sibling: str = ""
+    # Marks the event as failing the task (structs.go TaskEvent.FailsTask);
+    # alloc_runner folds it into TaskState.failed.
+    failed: bool = False
+    # Delay before a restart is attempted (structs.go TaskEvent.StartDelay).
+    start_delay: float = 0.0
 
     def copy(self) -> "TaskEvent":
         return dataclasses.replace(self)

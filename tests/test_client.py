@@ -1,0 +1,488 @@
+"""Client runtime tests: restart tracker, task env, drivers, task/alloc
+runners, allocdir, getter, GC (reference: client/*_test.go)."""
+import os
+import signal
+import sys
+import tempfile
+import time
+
+import pytest
+
+from nomad_tpu.structs import structs as s
+from nomad_tpu import mock
+from nomad_tpu.client import (
+    AllocRunner,
+    ClientConfig,
+    RestartTracker,
+    TaskRunner,
+    get_client_status,
+)
+from nomad_tpu.client.allocdir import AllocDir
+from nomad_tpu.client.driver import env as envmod
+from nomad_tpu.client.driver.driver import (
+    DriverError,
+    RecoverableError,
+    WaitResult,
+)
+from nomad_tpu.client.gc import AllocGarbageCollector
+from nomad_tpu.client.getter import ArtifactError, get_artifact
+from nomad_tpu.client.restarts import (
+    REASON_NO_RESTARTS_ALLOWED,
+    REASON_UNRECOVERABLE,
+    REASON_WITHIN_POLICY,
+)
+
+
+def wait_until(pred, timeout=10.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# RestartTracker (client/restarts_test.go)
+
+
+def policy(attempts=2, interval=60.0, delay=0.01, mode=s.RESTART_POLICY_MODE_DELAY):
+    return s.RestartPolicy(attempts=attempts, interval=interval, delay=delay,
+                           mode=mode)
+
+
+class TestRestartTracker:
+    def test_service_restarts_on_success(self):
+        rt = RestartTracker(policy(), s.JOB_TYPE_SERVICE)
+        rt.set_wait_result(WaitResult(exit_code=0))
+        state, _ = rt.get_state()
+        assert state == s.TASK_RESTARTING
+        assert rt.get_reason() == REASON_WITHIN_POLICY
+
+    def test_batch_terminates_on_success(self):
+        rt = RestartTracker(policy(), s.JOB_TYPE_BATCH)
+        rt.set_wait_result(WaitResult(exit_code=0))
+        state, _ = rt.get_state()
+        assert state == s.TASK_TERMINATED
+
+    def test_zero_attempts(self):
+        rt = RestartTracker(policy(attempts=0), s.JOB_TYPE_SERVICE)
+        rt.set_wait_result(WaitResult(exit_code=1))
+        state, _ = rt.get_state()
+        assert state == s.TASK_NOT_RESTARTING
+        assert rt.get_reason() == REASON_NO_RESTARTS_ALLOWED
+
+    def test_fail_mode_exhausts(self):
+        rt = RestartTracker(policy(attempts=1, mode=s.RESTART_POLICY_MODE_FAIL),
+                            s.JOB_TYPE_SERVICE)
+        rt.set_wait_result(WaitResult(exit_code=1))
+        assert rt.get_state()[0] == s.TASK_RESTARTING
+        rt.set_wait_result(WaitResult(exit_code=1))
+        assert rt.get_state()[0] == s.TASK_NOT_RESTARTING
+
+    def test_delay_mode_waits_out_interval(self):
+        rt = RestartTracker(policy(attempts=1, interval=5.0), s.JOB_TYPE_SERVICE)
+        rt.set_wait_result(WaitResult(exit_code=1))
+        rt.get_state()
+        rt.set_wait_result(WaitResult(exit_code=1))
+        state, delay = rt.get_state()
+        assert state == s.TASK_RESTARTING
+        assert delay > 1.0  # remainder of the 5s interval
+
+    def test_unrecoverable_start_error(self):
+        rt = RestartTracker(policy(), s.JOB_TYPE_SERVICE)
+        rt.set_start_error(DriverError("bad config"))
+        state, _ = rt.get_state()
+        assert state == s.TASK_NOT_RESTARTING
+        assert rt.get_reason() == REASON_UNRECOVERABLE
+
+    def test_recoverable_start_error_restarts(self):
+        rt = RestartTracker(policy(), s.JOB_TYPE_SERVICE)
+        rt.set_start_error(RecoverableError("transient"))
+        state, _ = rt.get_state()
+        assert state == s.TASK_RESTARTING
+
+    def test_restart_triggered(self):
+        rt = RestartTracker(policy(attempts=0), s.JOB_TYPE_SERVICE)
+        rt.set_restart_triggered()
+        state, delay = rt.get_state()
+        assert state == s.TASK_RESTARTING and delay == 0.0
+
+    def test_interval_reset(self):
+        rt = RestartTracker(policy(attempts=1, interval=0.05), s.JOB_TYPE_SERVICE)
+        rt.set_wait_result(WaitResult(exit_code=1))
+        assert rt.get_state()[0] == s.TASK_RESTARTING
+        time.sleep(0.06)
+        rt.set_wait_result(WaitResult(exit_code=1))
+        assert rt.get_state()[0] == s.TASK_RESTARTING  # budget reset
+
+
+# ---------------------------------------------------------------------------
+# Task env builder (client/driver/env/env_test.go)
+
+
+class TestTaskEnv:
+    def build_env(self):
+        alloc = mock.alloc()
+        task = alloc.job.task_groups[0].tasks[0]
+        task.env = {"CUSTOM": "x-${NOMAD_TASK_NAME}", "NODE_DC": "${node.datacenter}"}
+        node = mock.node()
+        b = envmod.Builder()
+        b.set_task(task).set_alloc(alloc).set_node(node).set_region("global")
+        b.set_dirs("/a/alloc", "/a/web/local", "/a/web/secrets")
+        return b.build(), alloc, task, node
+
+    def test_standard_vars(self):
+        env, alloc, task, node = self.build_env()
+        m = env.env()
+        assert m["NOMAD_ALLOC_DIR"] == "/a/alloc"
+        assert m["NOMAD_TASK_DIR"] == "/a/web/local"
+        assert m["NOMAD_SECRETS_DIR"] == "/a/web/secrets"
+        assert m["NOMAD_ALLOC_ID"] == alloc.id
+        assert m["NOMAD_TASK_NAME"] == task.name
+        assert m["NOMAD_JOB_NAME"] == alloc.job.name
+        assert m["NOMAD_DC"] == node.datacenter
+        assert m["NOMAD_REGION"] == "global"
+        assert m["NOMAD_CPU_LIMIT"] == str(task.resources.cpu)
+        assert m["NOMAD_MEMORY_LIMIT"] == str(task.resources.memory_mb)
+
+    def test_task_env_interpolation(self):
+        env, _, task, node = self.build_env()
+        m = env.env()
+        assert m["CUSTOM"] == f"x-{task.name}"
+        assert m["NODE_DC"] == node.datacenter
+
+    def test_replace_env(self):
+        env, _, _, node = self.build_env()
+        assert env.replace_env("${node.datacenter}-suffix") == \
+            f"{node.datacenter}-suffix"
+        assert env.replace_env("${missing.var}") == ""
+
+    def test_alloc_index(self):
+        env, alloc, _, _ = self.build_env()
+        # mock alloc name is "web[0]"-ish; index parsed from the name
+        if "[" in alloc.name:
+            want = alloc.name.rsplit("[", 1)[1].rstrip("]")
+            assert env.env()["NOMAD_ALLOC_INDEX"] == want
+
+    def test_port_env(self):
+        alloc = mock.alloc()
+        task = alloc.job.task_groups[0].tasks[0]
+        res = (alloc.task_resources or {}).get(task.name)
+        if res is None or not res.networks:
+            pytest.skip("mock alloc has no task networks")
+        b = envmod.Builder()
+        b.set_task(task).set_alloc(alloc)
+        m = b.build().env()
+        net = res.networks[0]
+        for label, port in net.port_labels().items():
+            assert m[f"NOMAD_PORT_{label}"] == str(port)
+            assert m[f"NOMAD_ADDR_{label}"] == f"{net.ip}:{port}"
+
+
+# ---------------------------------------------------------------------------
+# Alloc dir
+
+
+class TestAllocDir:
+    def test_build_layout(self, tmp_path):
+        ad = AllocDir(str(tmp_path / "a1"))
+        ad.build()
+        td = ad.new_task_dir("web")
+        td.build()
+        assert os.path.isdir(os.path.join(ad.shared_dir, "data"))
+        assert os.path.isdir(os.path.join(ad.shared_dir, "logs"))
+        assert os.path.isdir(td.local_dir)
+        assert os.path.isdir(td.secrets_dir)
+
+    def test_move_sticky(self, tmp_path):
+        old = AllocDir(str(tmp_path / "old"))
+        old.build()
+        old.new_task_dir("web").build()
+        with open(os.path.join(old.shared_dir, "data", "state.bin"), "w") as f:
+            f.write("persisted")
+        with open(os.path.join(old.task_dirs["web"].local_dir, "cache"), "w") as f:
+            f.write("warm")
+
+        new = AllocDir(str(tmp_path / "new"))
+        new.build()
+        new.new_task_dir("web").build()
+        new.move(old, ["web"])
+        assert open(os.path.join(new.shared_dir, "data", "state.bin")).read() \
+            == "persisted"
+        assert open(os.path.join(new.task_dirs["web"].local_dir, "cache")).read() \
+            == "warm"
+
+    def test_snapshot_restore(self, tmp_path):
+        src = AllocDir(str(tmp_path / "src"))
+        src.build()
+        src.new_task_dir("web").build()
+        with open(os.path.join(src.shared_dir, "data", "f"), "w") as f:
+            f.write("snap")
+        blob = src.snapshot()
+
+        dst = AllocDir(str(tmp_path / "dst"))
+        dst.build()
+        dst.new_task_dir("web").build()
+        dst.restore_snapshot(blob)
+        assert open(os.path.join(dst.shared_dir, "data", "f")).read() == "snap"
+
+    def test_path_escape_rejected(self, tmp_path):
+        ad = AllocDir(str(tmp_path / "a"))
+        ad.build()
+        with pytest.raises(PermissionError):
+            ad.read_at("../../etc/passwd", 0, 10)
+
+
+# ---------------------------------------------------------------------------
+# Artifact getter
+
+
+class TestGetter:
+    def test_file_artifact(self, tmp_path):
+        src = tmp_path / "artifact.txt"
+        src.write_text("payload")
+        task_dir = tmp_path / "task"
+        task_dir.mkdir()
+        art = s.TaskArtifact(getter_source=f"file://{src}", relative_dest="local/")
+        env = envmod.TaskEnv()
+        dest = get_artifact(env, art, str(task_dir))
+        assert open(dest).read() == "payload"
+
+    def test_checksum_mismatch(self, tmp_path):
+        src = tmp_path / "artifact.txt"
+        src.write_text("payload")
+        task_dir = tmp_path / "task"
+        task_dir.mkdir()
+        art = s.TaskArtifact(getter_source=str(src), relative_dest="local/",
+                             getter_options={"checksum": "sha256:" + "0" * 64})
+        with pytest.raises(ArtifactError):
+            get_artifact(envmod.TaskEnv(), art, str(task_dir))
+
+    def test_interpolated_source(self, tmp_path):
+        src = tmp_path / "artifact.txt"
+        src.write_text("x")
+        task_dir = tmp_path / "task"
+        task_dir.mkdir()
+        env = envmod.TaskEnv(env_map={"SRC": str(src)})
+        art = s.TaskArtifact(getter_source="${SRC}", relative_dest="local/")
+        assert os.path.exists(get_artifact(env, art, str(task_dir)))
+
+
+# ---------------------------------------------------------------------------
+# Task runner + mock driver (client/task_runner_test.go)
+
+
+def make_task_runner(tmp_path, config_overrides=None, job_type=s.JOB_TYPE_BATCH,
+                     restart=None):
+    alloc = mock.alloc()
+    alloc.job.type = job_type
+    tg = alloc.job.task_groups[0]
+    tg.restart_policy = restart or s.RestartPolicy(
+        attempts=0, mode=s.RESTART_POLICY_MODE_FAIL)
+    task = tg.tasks[0]
+    task.driver = "mock_driver"
+    task.config = dict(config_overrides or {"run_for": "50ms"})
+
+    ad = AllocDir(str(tmp_path / alloc.id))
+    ad.build()
+    td = ad.new_task_dir(task.name)
+    td.build()
+
+    updates = []
+
+    def updater(name, state, event):
+        updates.append((name, state, event))
+
+    cfg = ClientConfig(alloc_dir=str(tmp_path))
+    tr = TaskRunner(config=cfg, alloc=alloc, task=task, task_dir=td,
+                    updater=updater, node=mock.node())
+    return tr, updates
+
+
+class TestTaskRunner:
+    def test_simple_run_to_completion(self, tmp_path):
+        tr, updates = make_task_runner(tmp_path)
+        tr.run()
+        assert tr.done.wait(5.0)
+        states = [u[1] for u in updates if u[1]]
+        assert states[0] == s.TASK_STATE_PENDING
+        assert s.TASK_STATE_RUNNING in states
+        assert states[-1] == s.TASK_STATE_DEAD
+        events = [u[2].type for u in updates if u[2] is not None]
+        assert s.TASK_RECEIVED in events
+        assert s.TASK_STARTED in events
+        assert s.TASK_TERMINATED in events
+
+    def test_failed_exit_marks_failed(self, tmp_path):
+        tr, updates = make_task_runner(
+            tmp_path, {"run_for": "10ms", "exit_code": 1})
+        tr.run()
+        assert tr.done.wait(5.0)
+        events = [u[2] for u in updates if u[2] is not None]
+        assert any(e.type == s.TASK_NOT_RESTARTING and e.failed for e in events)
+
+    def test_start_error(self, tmp_path):
+        tr, updates = make_task_runner(tmp_path, {"start_error": "boom"})
+        tr.run()
+        assert tr.done.wait(5.0)
+        events = [u[2].type for u in updates if u[2] is not None]
+        assert s.TASK_DRIVER_FAILURE in events
+
+    def test_restart_within_policy(self, tmp_path):
+        tr, updates = make_task_runner(
+            tmp_path, {"run_for": "10ms", "exit_code": 1},
+            restart=s.RestartPolicy(attempts=1, interval=60.0, delay=0.01,
+                                    mode=s.RESTART_POLICY_MODE_FAIL))
+        tr.run()
+        assert tr.done.wait(5.0)
+        events = [u[2].type for u in updates if u[2] is not None]
+        assert events.count(s.TASK_STARTED) == 2
+        assert s.TASK_RESTARTING in events
+
+    def test_destroy_kills(self, tmp_path):
+        tr, updates = make_task_runner(tmp_path, {"run_for": "60s"})
+        tr.run()
+        assert wait_until(lambda: any(
+            u[2] is not None and u[2].type == s.TASK_STARTED for u in updates))
+        tr.destroy(s.TaskEvent(type=s.TASK_KILLED))
+        assert tr.done.wait(5.0)
+        events = [u[2].type for u in updates if u[2] is not None]
+        assert s.TASK_KILLED in events
+
+
+# ---------------------------------------------------------------------------
+# Raw exec driver — real process
+
+@pytest.mark.skipif(sys.platform != "linux", reason="linux-only")
+class TestRawExec:
+    def test_real_process(self, tmp_path):
+        alloc = mock.alloc()
+        alloc.job.type = s.JOB_TYPE_BATCH
+        tg = alloc.job.task_groups[0]
+        tg.restart_policy = s.RestartPolicy(attempts=0,
+                                            mode=s.RESTART_POLICY_MODE_FAIL)
+        task = tg.tasks[0]
+        task.driver = "raw_exec"
+        task.config = {
+            "command": sys.executable,
+            "args": ["-c", "print('hello from ${NOMAD_TASK_NAME}')"],
+        }
+        ad = AllocDir(str(tmp_path / alloc.id))
+        ad.build()
+        td = ad.new_task_dir(task.name)
+        td.build()
+
+        updates = []
+        cfg = ClientConfig(alloc_dir=str(tmp_path),
+                           options={"driver.raw_exec.enable": "1"})
+        tr = TaskRunner(config=cfg, alloc=alloc, task=task, task_dir=td,
+                        updater=lambda n, st, ev: updates.append((n, st, ev)),
+                        node=mock.node())
+        tr.run()
+        assert tr.done.wait(10.0)
+        events = [u[2] for u in updates if u[2] is not None]
+        term = [e for e in events if e.type == s.TASK_TERMINATED]
+        assert term and term[0].exit_code == 0
+        # stdout landed in the log dir with rotation naming
+        logs = os.listdir(td.log_dir)
+        stdout_logs = [f for f in logs if ".stdout." in f]
+        assert stdout_logs
+        content = open(os.path.join(td.log_dir, stdout_logs[0])).read()
+        assert f"hello from {task.name}" in content
+
+
+# ---------------------------------------------------------------------------
+# Alloc runner (client/alloc_runner_test.go)
+
+
+def make_alloc_runner(tmp_path, task_configs, job_type=s.JOB_TYPE_BATCH):
+    """task_configs: dict task_name → mock driver config."""
+    alloc = mock.alloc()
+    alloc.job.type = job_type
+    tg = alloc.job.task_groups[0]
+    tg.restart_policy = s.RestartPolicy(attempts=0,
+                                        mode=s.RESTART_POLICY_MODE_FAIL)
+    base_task = tg.tasks[0]
+    tg.tasks = []
+    for name, cfg in task_configs.items():
+        t = base_task.copy()
+        t.name = name
+        t.driver = "mock_driver"
+        t.config = cfg
+        tg.tasks.append(t)
+
+    updates = []
+    cfg = ClientConfig(alloc_dir=str(tmp_path))
+    ar = AllocRunner(config=cfg, alloc=alloc,
+                     updater=lambda a: updates.append(a), node=mock.node())
+    return ar, updates
+
+
+class TestAllocRunner:
+    def test_single_task_complete(self, tmp_path):
+        ar, updates = make_alloc_runner(tmp_path, {"web": {"run_for": "50ms"}})
+        ar.run()
+        assert ar.wait(5.0)
+        assert wait_until(lambda: updates and updates[-1].client_status ==
+                          s.ALLOC_CLIENT_STATUS_COMPLETE)
+
+    def test_multi_task_running(self, tmp_path):
+        ar, updates = make_alloc_runner(
+            tmp_path, {"a": {"run_for": "30s"}, "b": {"run_for": "30s"}})
+        ar.run()
+        assert wait_until(lambda: updates and updates[-1].client_status ==
+                          s.ALLOC_CLIENT_STATUS_RUNNING)
+        ar.destroy()
+        assert ar.wait(5.0)
+
+    def test_failed_task_fails_alloc_and_kills_sibling(self, tmp_path):
+        ar, updates = make_alloc_runner(
+            tmp_path,
+            {"bad": {"run_for": "10ms", "exit_code": 1},
+             "good": {"run_for": "60s"}})
+        ar.run()
+        assert ar.wait(10.0)
+        assert wait_until(lambda: updates and updates[-1].client_status ==
+                          s.ALLOC_CLIENT_STATUS_FAILED)
+        final = updates[-1]
+        sibling_events = [e.type for e in final.task_states["good"].events]
+        assert s.TASK_SIBLING_FAILED in sibling_events
+
+    def test_get_client_status(self):
+        ts = {"a": s.TaskState(state=s.TASK_STATE_RUNNING)}
+        assert get_client_status(ts) == s.ALLOC_CLIENT_STATUS_RUNNING
+        ts["b"] = s.TaskState(state=s.TASK_STATE_DEAD, failed=True)
+        assert get_client_status(ts) == s.ALLOC_CLIENT_STATUS_FAILED
+        assert get_client_status(
+            {"a": s.TaskState(state=s.TASK_STATE_DEAD)}) == \
+            s.ALLOC_CLIENT_STATUS_COMPLETE
+
+
+# ---------------------------------------------------------------------------
+# GC
+
+
+class TestGC:
+    def _terminal_runner(self, tmp_path, name):
+        ar, _ = make_alloc_runner(tmp_path / name, {"t": {"run_for": "1ms"}})
+        ar.run()
+        ar.wait(5.0)
+        return ar
+
+    def test_make_room_for_evicts(self, tmp_path):
+        cfg = ClientConfig(alloc_dir=str(tmp_path), gc_max_allocs=2)
+        gc = AllocGarbageCollector(cfg, stats_path=str(tmp_path))
+        r1 = self._terminal_runner(tmp_path, "a1")
+        gc.mark_for_collection(r1)
+        assert gc.count() == 1
+        gc.make_room_for(0, total_live_allocs=2)
+        assert gc.count() == 0
+
+    def test_collect_all(self, tmp_path):
+        cfg = ClientConfig(alloc_dir=str(tmp_path))
+        gc = AllocGarbageCollector(cfg, stats_path=str(tmp_path))
+        for n in ("a", "b"):
+            gc.mark_for_collection(self._terminal_runner(tmp_path, n))
+        assert gc.collect_all() == 2
+        assert gc.count() == 0

@@ -1,0 +1,154 @@
+"""End-to-end data-plane integration: a real Client against an
+in-process Server — register, schedule, run via mock driver, sync
+status back, node failure handling
+(reference: client/client_test.go against TestServer, SURVEY.md §4
+item 4)."""
+import time
+
+import pytest
+
+from nomad_tpu.structs import structs as s
+from nomad_tpu import mock
+from nomad_tpu.client import Client, ClientConfig
+from nomad_tpu.server.server import Server, ServerConfig
+
+
+def wait_until(pred, timeout=15.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def server():
+    srv = Server(ServerConfig(num_schedulers=1))
+    srv.start()
+    yield srv
+    srv.shutdown()
+
+
+@pytest.fixture
+def client(server, tmp_path):
+    cfg = ClientConfig(alloc_dir=str(tmp_path / "allocs"),
+                       state_dir=str(tmp_path / "state"))
+    c = Client(cfg, rpc=server)
+    c.start()
+    yield c
+    c.shutdown()
+
+
+def mock_driver_job(run_for="30s", count=1, job_type=s.JOB_TYPE_SERVICE,
+                    **config):
+    job = mock.job()
+    job.type = job_type
+    tg = job.task_groups[0]
+    tg.count = count
+    tg.restart_policy = s.RestartPolicy(attempts=0,
+                                        mode=s.RESTART_POLICY_MODE_FAIL)
+    for t in tg.tasks:
+        t.driver = "mock_driver"
+        t.config = {"run_for": run_for, **config}
+        t.resources.networks = []
+        t.services = []
+    return job
+
+
+class TestClientRegistration:
+    def test_node_registers_and_heartbeats(self, server, client):
+        assert wait_until(lambda: server.node_get(client.node.id) is not None)
+        node = server.node_get(client.node.id)
+        assert node.status in (s.NODE_STATUS_INIT, s.NODE_STATUS_READY)
+        assert wait_until(
+            lambda: server.node_get(client.node.id).status == s.NODE_STATUS_READY)
+        # fingerprinted facts made it to the server
+        assert node.attributes.get("cpu.arch")
+        assert node.attributes.get("driver.mock_driver") == "1"
+        assert node.resources.cpu > 0
+
+    def test_client_stats(self, server, client):
+        stats = client.stats()
+        assert stats["node_id"] == client.node.id
+        assert "host_stats" in stats
+
+
+class TestEndToEndPlacement:
+    def test_job_runs_on_client(self, server, client):
+        wait_until(lambda: server.node_get(client.node.id) is not None and
+                   server.node_get(client.node.id).status == s.NODE_STATUS_READY)
+        job = mock_driver_job(run_for="30s")
+        server.job_register(job)
+
+        # scheduler places onto our node; client picks it up and runs it
+        assert wait_until(
+            lambda: any(a.client_status == s.ALLOC_CLIENT_STATUS_RUNNING
+                        for a in server.job_allocations(job.id)))
+        allocs = server.job_allocations(job.id)
+        assert allocs[0].node_id == client.node.id
+        assert client.num_allocs() == 1
+
+        # task states synced upstream
+        a = server.job_allocations(job.id)[0]
+        assert a.task_states and all(
+            ts.state == s.TASK_STATE_RUNNING for ts in a.task_states.values())
+
+    def test_batch_job_completes(self, server, client):
+        wait_until(lambda: server.node_get(client.node.id) is not None and
+                   server.node_get(client.node.id).status == s.NODE_STATUS_READY)
+        job = mock_driver_job(run_for="100ms", job_type=s.JOB_TYPE_BATCH)
+        server.job_register(job)
+        assert wait_until(
+            lambda: any(a.client_status == s.ALLOC_CLIENT_STATUS_COMPLETE
+                        for a in server.job_allocations(job.id)))
+
+    def test_job_stop_kills_alloc(self, server, client):
+        wait_until(lambda: server.node_get(client.node.id) is not None and
+                   server.node_get(client.node.id).status == s.NODE_STATUS_READY)
+        job = mock_driver_job(run_for="60s")
+        server.job_register(job)
+        assert wait_until(
+            lambda: any(a.client_status == s.ALLOC_CLIENT_STATUS_RUNNING
+                        for a in server.job_allocations(job.id)))
+
+        server.job_deregister(job.id, purge=False)
+        assert wait_until(
+            lambda: all(a.client_terminal_status()
+                        for a in server.job_allocations(job.id)))
+
+    def test_failed_alloc_reported(self, server, client):
+        wait_until(lambda: server.node_get(client.node.id) is not None and
+                   server.node_get(client.node.id).status == s.NODE_STATUS_READY)
+        job = mock_driver_job(run_for="10ms", job_type=s.JOB_TYPE_BATCH,
+                              exit_code=1)
+        server.job_register(job)
+        assert wait_until(
+            lambda: any(a.client_status == s.ALLOC_CLIENT_STATUS_FAILED
+                        for a in server.job_allocations(job.id)))
+
+
+class TestClientRestore:
+    def test_state_restored_after_restart(self, server, tmp_path):
+        cfg = ClientConfig(alloc_dir=str(tmp_path / "allocs"),
+                           state_dir=str(tmp_path / "state"))
+        c1 = Client(cfg, rpc=server)
+        c1.start()
+        try:
+            wait_until(lambda: server.node_get(c1.node.id) is not None and
+                       server.node_get(c1.node.id).status == s.NODE_STATUS_READY)
+            job = mock_driver_job(run_for="60s")
+            server.job_register(job)
+            assert wait_until(lambda: c1.num_allocs() == 1)
+            assert wait_until(
+                lambda: any(a.client_status == s.ALLOC_CLIENT_STATUS_RUNNING
+                            for a in server.job_allocations(job.id)))
+        finally:
+            c1.shutdown()
+
+        # New client instance with same state dir restores the alloc runner
+        c2 = Client(cfg, rpc=server)
+        try:
+            assert c2.num_allocs() == 1
+        finally:
+            c2.shutdown()
