@@ -1,0 +1,7 @@
+"""HCL job-file parsing (reference: jobspec/ package)."""
+
+from .hcl import Block, Entry, HCLError, parse_hcl
+from .parse import ParseError, parse, parse_duration, parse_file
+
+__all__ = ["Block", "Entry", "HCLError", "parse_hcl", "ParseError", "parse",
+           "parse_duration", "parse_file"]

@@ -347,11 +347,15 @@ class Server:
     def job_evaluations(self, job_id: str) -> List[s.Evaluation]:
         return self.state.evals_by_job(None, job_id)
 
-    def job_plan(self, job: s.Job, diff: bool = True) -> s.Plan:
-        """Dry-run scheduling (job_endpoint.go:~490 Plan): run the
-        scheduler synchronously against a snapshot with a no-op planner."""
+    def job_plan(self, job: s.Job, diff: bool = True) -> s.JobPlanResponse:
+        """Dry-run scheduling (job_endpoint.go:~490 Plan): run the scheduler
+        synchronously against a snapshot with a no-op planner, returning the
+        annotated job diff + placement forensics (nothing is committed)."""
         from ..scheduler import Harness, new_scheduler
+        from ..scheduler.annotate import annotate
+        from ..structs.diff import job_diff
 
+        old_job = self.state.job_by_id(None, job.id)
         job = job.copy()
         job.canonicalize()
         snap = self.state.snapshot()
@@ -367,7 +371,23 @@ class Server:
             annotate_plan=True)
         sched = new_scheduler(job.type, self.logger, snap.snapshot(), harness)
         sched.process(ev)
-        return harness.plans[0] if harness.plans else ev.make_plan(job)
+        plan = harness.plans[0] if harness.plans else ev.make_plan(job)
+
+        # The scheduler records placement forensics on a *copy* of the eval
+        # handed to Planner.UpdateEval (scheduler/util.go setStatus) — read
+        # the updated eval from the harness, like job_endpoint.go Plan does.
+        updated = next((e for e in reversed(harness.evals) if e.id == ev.id), ev)
+        resp = s.JobPlanResponse(
+            annotations=plan.annotations,
+            failed_tg_allocs=dict(updated.failed_tg_allocs),
+            job_modify_index=old_job.job_modify_index if old_job else 0,
+            created_evals=list(harness.create_evals))
+        if diff:
+            resp.diff = job_diff(old_job, job)
+            annotate(resp.diff, plan.annotations)
+        if job.is_periodic():
+            resp.next_periodic_launch = job.periodic.next(s.now())
+        return resp
 
     def periodic_force(self, job_id: str) -> Optional[s.Job]:
         return self.periodic.force_run(job_id)

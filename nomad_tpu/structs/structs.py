@@ -1112,6 +1112,19 @@ class DesiredUpdates:
     destructive_update: int = 0
 
 
+@dataclass
+class JobPlanResponse:
+    """Dry-run result returned by Job.Plan (structs.go JobPlanResponse):
+    the annotated diff plus placement forensics, no state mutated."""
+
+    annotations: Optional[PlanAnnotations] = None
+    failed_tg_allocs: Dict[str, AllocMetric] = field(default_factory=dict)
+    job_modify_index: int = 0
+    created_evals: List["Evaluation"] = field(default_factory=list)
+    diff: Optional[object] = None  # structs.diff.JobDiff
+    next_periodic_launch: float = 0.0
+
+
 # ---------------------------------------------------------------------------
 # Job summary
 # ---------------------------------------------------------------------------

@@ -1,0 +1,254 @@
+"""Job diff + plan annotation tests (reference: nomad/structs/diff_test.go,
+scheduler/annotate_test.go)."""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server, ServerConfig
+
+
+@pytest.fixture()
+def server():
+    srv = Server(ServerConfig(num_schedulers=1))
+    srv.start()
+    yield srv
+    srv.shutdown()
+from nomad_tpu.scheduler.annotate import (
+    ANNOTATION_FORCES_CREATE, ANNOTATION_FORCES_DESTROY,
+    ANNOTATION_FORCES_DESTRUCTIVE_UPDATE, ANNOTATION_FORCES_INPLACE_UPDATE,
+    UPDATE_TYPE_CREATE, UPDATE_TYPE_DESTROY, annotate)
+from nomad_tpu.structs import structs as s
+from nomad_tpu.structs.diff import (DIFF_TYPE_ADDED, DIFF_TYPE_DELETED,
+                                    DIFF_TYPE_EDITED, DIFF_TYPE_NONE,
+                                    go_name, job_diff, task_diff,
+                                    task_group_diff)
+
+
+def test_go_name():
+    assert go_name("kill_timeout") == "KillTimeout"
+    assert go_name("count") == "Count"
+    assert go_name("memory_mb") == "MemoryMB"
+    assert go_name("cpu") == "CPU"
+
+
+def test_identical_jobs_no_diff():
+    job = mock.job()
+    d = job_diff(job, job.copy())
+    assert d.type == DIFF_TYPE_NONE
+    assert not d.fields
+    assert not d.task_groups
+
+
+def test_job_added_and_deleted():
+    job = mock.job()
+    assert job_diff(None, job).type == DIFF_TYPE_ADDED
+    assert job_diff(job, None).type == DIFF_TYPE_DELETED
+
+
+def test_job_different_ids_error():
+    a, b = mock.job(), mock.job()
+    try:
+        job_diff(a, b)
+        assert False, "expected ValueError"
+    except ValueError:
+        pass
+
+
+def test_primitive_field_edit():
+    old = mock.job()
+    new = old.copy()
+    new.priority = old.priority + 10
+    d = job_diff(old, new)
+    assert d.type == DIFF_TYPE_EDITED
+    f = next(f for f in d.fields if f.name == "Priority")
+    assert f.type == DIFF_TYPE_EDITED
+    assert f.old == str(old.priority)
+    assert f.new == str(new.priority)
+
+
+def test_datacenters_set_diff():
+    old = mock.job()
+    old.datacenters = ["dc1", "dc2"]
+    new = old.copy()
+    new.datacenters = ["dc1", "dc3"]
+    d = job_diff(old, new)
+    dcs = [f for f in d.fields if f.name == "Datacenters"]
+    types = sorted(f.type for f in dcs)
+    assert types == [DIFF_TYPE_ADDED, DIFF_TYPE_DELETED]
+
+
+def test_constraint_added():
+    old = mock.job()
+    new = old.copy()
+    new.constraints = list(new.constraints) + [
+        s.Constraint(ltarget="${attr.kernel.name}", rtarget="linux",
+                     operand="=")]
+    d = job_diff(old, new)
+    cons = [o for o in d.objects if o.name == "Constraint"]
+    assert any(o.type == DIFF_TYPE_ADDED for o in cons)
+
+
+def test_task_group_count_change():
+    old = mock.job()
+    new = old.copy()
+    new.task_groups[0].count = old.task_groups[0].count + 2
+    d = job_diff(old, new)
+    assert len(d.task_groups) == 1
+    tg = d.task_groups[0]
+    assert tg.type == DIFF_TYPE_EDITED
+    f = next(f for f in tg.fields if f.name == "Count")
+    assert f.type == DIFF_TYPE_EDITED
+
+
+def test_task_group_added_removed():
+    old = mock.job()
+    new = old.copy()
+    extra = old.task_groups[0].copy()
+    extra.name = "extra"
+    new.task_groups.append(extra)
+    d = job_diff(old, new)
+    assert any(tg.type == DIFF_TYPE_ADDED and tg.name == "extra"
+               for tg in d.task_groups)
+    d2 = job_diff(new, old)
+    assert any(tg.type == DIFF_TYPE_DELETED and tg.name == "extra"
+               for tg in d2.task_groups)
+
+
+def test_task_env_and_config_diff():
+    old = mock.job()
+    new = old.copy()
+    t = new.task_groups[0].tasks[0]
+    t.env = dict(t.env)
+    t.env["NEW_VAR"] = "x"
+    t.config = dict(t.config)
+    t.config["command"] = "/bin/other"
+    d = job_diff(old, new)
+    td = d.task_groups[0].tasks[0]
+    assert td.type == DIFF_TYPE_EDITED
+    assert any(f.name == "Env[NEW_VAR]" and f.type == DIFF_TYPE_ADDED
+               for f in td.fields)
+    cfg = next(o for o in td.objects if o.name == "Config")
+    assert any(f.name == "Config[command]" for f in cfg.fields)
+
+
+def test_task_resources_diff():
+    old = mock.job()
+    new = old.copy()
+    new.task_groups[0].tasks[0].resources = \
+        old.task_groups[0].tasks[0].resources.copy()
+    new.task_groups[0].tasks[0].resources.cpu += 100
+    d = job_diff(old, new)
+    td = d.task_groups[0].tasks[0]
+    res = next(o for o in td.objects if o.name == "Resources")
+    assert res.type == DIFF_TYPE_EDITED
+    assert any(f.name == "CPU" for f in res.fields)
+
+
+# -- annotate ---------------------------------------------------------------
+
+
+def test_annotate_count_change():
+    old = mock.job()
+    new = old.copy()
+    new.task_groups[0].count = old.task_groups[0].count + 3
+    d = job_diff(old, new)
+    annotate(d, None)
+    f = next(f for f in d.task_groups[0].fields if f.name == "Count")
+    assert ANNOTATION_FORCES_CREATE in f.annotations
+
+    d2 = job_diff(new, old)
+    annotate(d2, None)
+    f2 = next(f for f in d2.task_groups[0].fields if f.name == "Count")
+    assert ANNOTATION_FORCES_DESTROY in f2.annotations
+
+
+def test_annotate_updates_map():
+    old = mock.job()
+    new = old.copy()
+    new.task_groups[0].count += 1
+    d = job_diff(old, new)
+    ann = s.PlanAnnotations(desired_tg_updates={
+        new.task_groups[0].name: s.DesiredUpdates(place=1, ignore=2, stop=3)})
+    annotate(d, ann)
+    tg = d.task_groups[0]
+    assert tg.updates[UPDATE_TYPE_CREATE] == 1
+    assert tg.updates[UPDATE_TYPE_DESTROY] == 3
+
+
+def test_annotate_task_destructive_vs_inplace():
+    old = mock.job()
+    new = old.copy()
+    new.task_groups[0].tasks[0].driver = "raw_exec"
+    d = job_diff(old, new)
+    annotate(d, None)
+    td = d.task_groups[0].tasks[0]
+    assert ANNOTATION_FORCES_DESTRUCTIVE_UPDATE in td.annotations
+
+    # KillTimeout-only change is in-place
+    new2 = old.copy()
+    new2.task_groups[0].tasks[0].kill_timeout = 99.0
+    d2 = job_diff(old, new2)
+    annotate(d2, None)
+    td2 = d2.task_groups[0].tasks[0]
+    assert ANNOTATION_FORCES_INPLACE_UPDATE in td2.annotations
+
+
+def test_annotate_new_task_in_new_group():
+    old = mock.job()
+    new = old.copy()
+    extra = old.task_groups[0].copy()
+    extra.name = "extra"
+    new.task_groups.append(extra)
+    d = job_diff(old, new)
+    annotate(d, None)
+    tg = next(t for t in d.task_groups if t.name == "extra")
+    for td in tg.tasks:
+        assert ANNOTATION_FORCES_CREATE in td.annotations
+
+
+# -- server.job_plan end-to-end --------------------------------------------
+
+
+def test_job_plan_dry_run(server):
+    node = mock.node()
+    server.node_register(node)
+    job = mock.job()
+    resp = server.job_plan(job)
+    assert resp.diff is not None
+    assert resp.diff.type == DIFF_TYPE_ADDED
+    assert resp.annotations is not None
+    tg = job.task_groups[0].name
+    assert resp.annotations.desired_tg_updates[tg].place == job.task_groups[0].count
+    # dry run must not mutate state
+    assert server.state.job_by_id(None, job.id) is None
+
+
+def test_job_plan_reports_failed_placements(server):
+    # No nodes registered: every placement must fail, and the dry-run
+    # response must surface the per-TG AllocMetric forensics.
+    job = mock.job()
+    resp = server.job_plan(job)
+    tg = job.task_groups[0].name
+    assert tg in resp.failed_tg_allocs
+    assert resp.failed_tg_allocs[tg].nodes_evaluated == 0
+
+
+def test_job_plan_update_diff(server):
+    node = mock.node()
+    server.node_register(node)
+    job = mock.job()
+    server.job_register(job)
+    import time
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        allocs = server.job_allocations(job.id)
+        if len(allocs) == job.task_groups[0].count:
+            break
+        time.sleep(0.05)
+    new = job.copy()
+    new.task_groups[0].count += 1
+    resp = server.job_plan(new)
+    assert resp.diff.type == DIFF_TYPE_EDITED
+    assert resp.job_modify_index > 0
+    f = next(f for f in resp.diff.task_groups[0].fields if f.name == "Count")
+    assert ANNOTATION_FORCES_CREATE in f.annotations

@@ -1,0 +1,409 @@
+"""HCL jobspec parser tests (reference: jobspec/parse_test.go)."""
+
+import pytest
+
+from nomad_tpu import jobspec
+from nomad_tpu.jobspec import ParseError, parse, parse_duration
+from nomad_tpu.structs import structs as s
+
+FULL = """
+job "binstore" {
+  region      = "fooregion"
+  type        = "batch"
+  priority    = 52
+  all_at_once = true
+  datacenters = ["us2", "eu1"]
+  vault_token = "foo"
+
+  meta {
+    foo = "bar"
+  }
+
+  constraint {
+    attribute = "kernel.os"
+    value     = "windows"
+  }
+
+  update {
+    stagger      = "60s"
+    max_parallel = 2
+  }
+
+  group "binsl" {
+    count = 5
+
+    restart {
+      attempts = 5
+      interval = "10m"
+      delay    = "15s"
+      mode     = "delay"
+    }
+
+    ephemeral_disk {
+      sticky = true
+      size   = 150
+    }
+
+    task "binstore" {
+      driver = "docker"
+      user   = "bob"
+      leader = true
+
+      config {
+        image = "example/binstore"
+        labels {
+          FOO = "bar"
+        }
+      }
+
+      logs {
+        max_files     = 14
+        max_file_size = 101
+      }
+
+      env {
+        HELLO = "world"
+      }
+
+      service {
+        tags = ["foo", "bar"]
+        port = "http"
+
+        check {
+          name     = "check-name"
+          type     = "tcp"
+          interval = "10s"
+          timeout  = "2s"
+          port     = "admin"
+        }
+      }
+
+      resources {
+        cpu    = 500
+        memory = 128
+
+        network {
+          mbits = "100"
+
+          port "one" {
+            static = 1
+          }
+          port "http" {
+          }
+        }
+      }
+
+      kill_timeout = "22s"
+
+      artifact {
+        source = "http://foo.example.com/artifact"
+        options {
+          checksum = "md5:b8a4f3f72ecab0510a6a31e997461c5f"
+        }
+      }
+
+      vault {
+        policies = ["foo", "bar"]
+      }
+
+      template {
+        source        = "foo"
+        destination   = "foo"
+        change_mode   = "signal"
+        change_signal = "sighup"
+        splay         = "10s"
+      }
+    }
+  }
+}
+"""
+
+
+def test_parse_full_job():
+    job = parse(FULL)
+    assert job.id == "binstore"
+    assert job.name == "binstore"
+    assert job.region == "fooregion"
+    assert job.type == "batch"
+    assert job.priority == 52
+    assert job.all_at_once is True
+    assert job.datacenters == ["us2", "eu1"]
+    assert job.vault_token == "foo"
+    assert job.meta == {"foo": "bar"}
+    assert len(job.constraints) == 1
+    c = job.constraints[0]
+    assert (c.ltarget, c.rtarget, c.operand) == ("kernel.os", "windows", "=")
+    assert job.update.stagger == 60.0
+    assert job.update.max_parallel == 2
+
+    assert len(job.task_groups) == 1
+    tg = job.task_groups[0]
+    assert tg.name == "binsl"
+    assert tg.count == 5
+    assert tg.restart_policy.attempts == 5
+    assert tg.restart_policy.interval == 600.0
+    assert tg.restart_policy.delay == 15.0
+    assert tg.ephemeral_disk.sticky is True
+    assert tg.ephemeral_disk.size_mb == 150
+
+    task = tg.tasks[0]
+    assert task.name == "binstore"
+    assert task.driver == "docker"
+    assert task.user == "bob"
+    assert task.leader is True
+    assert task.config["image"] == "example/binstore"
+    assert task.config["labels"] == {"FOO": "bar"}
+    assert task.log_config.max_files == 14
+    assert task.log_config.max_file_size_mb == 101
+    assert task.env == {"HELLO": "world"}
+    assert task.kill_timeout == 22.0
+
+    svc = task.services[0]
+    assert svc.tags == ["foo", "bar"]
+    assert svc.port_label == "http"
+    assert svc.name == "binstore-binsl-binstore"
+    chk = svc.checks[0]
+    assert chk.name == "check-name"
+    assert chk.type == "tcp"
+    assert chk.interval == 10.0
+    assert chk.timeout == 2.0
+    assert chk.port_label == "admin"
+
+    res = task.resources
+    assert res.cpu == 500
+    assert res.memory_mb == 128
+    net = res.networks[0]
+    assert net.mbits == 100
+    assert [(p.label, p.value) for p in net.reserved_ports] == [("one", 1)]
+    assert [p.label for p in net.dynamic_ports] == ["http"]
+
+    art = task.artifacts[0]
+    assert art.getter_source == "http://foo.example.com/artifact"
+    assert art.relative_dest == "local/"
+    assert art.getter_options["checksum"].startswith("md5:")
+
+    assert task.vault.policies == ["foo", "bar"]
+    tmpl = task.templates[0]
+    assert tmpl.change_mode == "signal"
+    assert tmpl.change_signal == "SIGHUP"
+    assert tmpl.splay == 10.0
+
+
+def test_parse_duration():
+    assert parse_duration("10s") == 10.0
+    assert parse_duration("10m") == 600.0
+    assert parse_duration("1h30m") == 5400.0
+    assert parse_duration("250ms") == 0.25
+    assert parse_duration("0") == 0.0
+    with pytest.raises(ParseError):
+        parse_duration("banana")
+    with pytest.raises(ParseError):
+        parse_duration("10")  # bare numbers in strings are not durations
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(ParseError, match="invalid key"):
+        parse('job "x" { bad_key = 1 }')
+    with pytest.raises(ParseError, match="invalid key"):
+        parse('job "x" { group "g" { bad = true } }')
+    with pytest.raises(ParseError, match="invalid key"):
+        parse('job "x" { task "t" { drivver = "x" } }')
+
+
+def test_default_job():
+    job = parse('job "foo" { }')
+    assert job.id == "foo"
+    assert job.name == "foo"
+    assert job.region == "global"
+    assert job.type == s.JOB_TYPE_SERVICE
+    assert job.priority == s.JOB_DEFAULT_PRIORITY
+
+
+def test_specify_id_and_name():
+    job = parse('job "label" { id = "my-id" name = "my-name" }')
+    assert job.id == "my-id"
+    assert job.name == "my-name"
+
+
+def test_bare_task_wraps_group():
+    job = parse('job "foo" { task "bar" { driver = "raw_exec" } }')
+    assert len(job.task_groups) == 1
+    assert job.task_groups[0].name == "bar"
+    assert job.task_groups[0].count == 1
+    assert job.task_groups[0].tasks[0].driver == "raw_exec"
+
+
+def test_constraint_sugar():
+    job = parse('''
+job "foo" {
+  constraint {
+    attribute = "$attr.kernel.version"
+    regexp    = "[0-9.]+"
+  }
+  constraint {
+    attribute = "$attr.kernel.version"
+    version   = "~> 3.2"
+  }
+  constraint {
+    attribute    = "$meta.data"
+    set_contains = "foo,bar"
+  }
+  constraint {
+    distinct_hosts = true
+  }
+  constraint {
+    distinct_property = "${meta.rack}"
+  }
+}''')
+    ops = [c.operand for c in job.constraints]
+    assert ops == ["regexp", "version", "set_contains", "distinct_hosts",
+                   "distinct_property"]
+    assert job.constraints[0].rtarget == "[0-9.]+"
+    assert job.constraints[1].rtarget == "~> 3.2"
+    assert job.constraints[4].ltarget == "${meta.rack}"
+
+
+def test_periodic_cron():
+    job = parse('''
+job "foo" {
+  periodic {
+    cron             = "*/5 * * * *"
+    prohibit_overlap = true
+  }
+}''')
+    assert job.periodic.enabled is True
+    assert job.periodic.spec == "*/5 * * * *"
+    assert job.periodic.spec_type == s.PERIODIC_SPEC_CRON
+    assert job.periodic.prohibit_overlap is True
+
+
+def test_parameterized_job():
+    job = parse('''
+job "p" {
+  parameterized {
+    payload       = "required"
+    meta_required = ["foo"]
+    meta_optional = ["bar"]
+  }
+  group "foo" {
+    task "bar" {
+      driver = "docker"
+      dispatch_payload {
+        file = "foo/bar"
+      }
+    }
+  }
+}''')
+    assert job.parameterized_job.payload == "required"
+    assert job.parameterized_job.meta_required == ["foo"]
+    assert job.task_groups[0].tasks[0].dispatch_payload.file == "foo/bar"
+
+
+def test_vault_inheritance():
+    job = parse('''
+job "example" {
+  vault {
+    policies = ["job"]
+  }
+  group "cache" {
+    vault {
+      policies = ["group"]
+    }
+    task "redis" { }
+    task "redis2" {
+      vault {
+        policies = ["task"]
+        env      = false
+      }
+    }
+  }
+  group "cache2" {
+    task "redis" { }
+  }
+}''')
+    g1 = job.task_groups[0]
+    assert g1.tasks[0].vault.policies == ["group"]
+    assert g1.tasks[1].vault.policies == ["task"]
+    assert g1.tasks[1].vault.env is False
+    g2 = job.task_groups[1]
+    assert g2.tasks[0].vault.policies == ["job"]
+
+
+def test_port_label_validation():
+    with pytest.raises(ParseError, match="naming requirements"):
+        parse('''
+job "foo" {
+  task "t" {
+    resources {
+      network {
+        port "bad-label!" { }
+      }
+    }
+  }
+}''')
+    with pytest.raises(ParseError, match="collision"):
+        parse('''
+job "foo" {
+  task "t" {
+    resources {
+      network {
+        mbits = 10
+        port "dup" { static = 1 }
+        port "dup" { }
+      }
+    }
+  }
+}''')
+
+
+def test_nested_config_map():
+    job = parse('''
+job "foo" {
+  task "bar" {
+    driver = "docker"
+    config {
+      image = "example/image"
+      port_map {
+        db = 1234
+      }
+    }
+  }
+}''')
+    cfg = job.task_groups[0].tasks[0].config
+    assert cfg["port_map"] == {"db": 1234}
+
+
+def test_multiple_jobs_rejected():
+    with pytest.raises(ParseError):
+        parse('job "a" { }\njob "b" { }')
+    with pytest.raises(ParseError):
+        parse('not_a_job "a" { }')
+
+
+def test_heredoc_and_comments():
+    job = parse('''
+# leading comment
+job "foo" {
+  // line comment
+  /* block
+     comment */
+  task "t" {
+    driver = "raw_exec"
+    template {
+      destination = "local/x"
+      data        = <<EOF
+hello
+world
+EOF
+    }
+  }
+}''')
+    tmpl = job.task_groups[0].tasks[0].templates[0]
+    assert tmpl.embedded_tmpl == "hello\nworld\n"
+
+
+def test_parse_file(tmp_path):
+    p = tmp_path / "job.nomad"
+    p.write_text('job "f" { task "t" { driver = "raw_exec" } }')
+    job = jobspec.parse_file(str(p))
+    assert job.id == "f"
