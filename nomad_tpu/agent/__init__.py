@@ -1,0 +1,10 @@
+"""Agent: server+client composition and the HTTP /v1 API
+(reference: command/agent/)."""
+
+from .agent import Agent
+from .config import (AgentConfig, ClientBlock, Ports, ServerBlock,
+                     load_config_file, parse_config)
+from .http import HTTPServer
+
+__all__ = ["Agent", "AgentConfig", "ClientBlock", "Ports", "ServerBlock",
+           "load_config_file", "parse_config", "HTTPServer"]

@@ -1,0 +1,154 @@
+"""The agent: composes a Server and/or Client in one process plus the HTTP
+API (reference: command/agent/agent.go:46-719 — setupServer at agent.go:336,
+setupClient at agent.go:446, NewHTTPServer wiring)."""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+from ..client import Client, ClientConfig
+from ..server.server import Server, ServerConfig
+from .. import __version__ as VERSION
+from .config import AgentConfig
+from .http import HTTPServer
+
+
+class Agent:
+    def __init__(self, config: Optional[AgentConfig] = None,
+                 logger: Optional[logging.Logger] = None):
+        self.config = config or AgentConfig.dev()
+        self.logger = logger or logging.getLogger("nomad_tpu.agent")
+        self.server: Optional[Server] = None
+        self.client: Optional[Client] = None
+        self.http: Optional[HTTPServer] = None
+        self._setup_server()
+        self._setup_client()
+        if self.server is None and self.client is None:
+            raise ValueError(
+                "must have at least client or server mode enabled")
+
+    # -- composition (agent.go:336/446) ------------------------------------
+
+    def _setup_server(self) -> None:
+        if not self.config.server.enabled:
+            return
+        sb = self.config.server
+        scfg = ServerConfig(
+            region=self.config.region,
+            datacenter=self.config.datacenter,
+            node_name=self.config.name or "server-1",
+            rpc_advertise=f"{self.config.bind_addr}:{self.config.ports.rpc}",
+            data_dir=sb.data_dir or (
+                "" if self.config.dev_mode else self.config.data_dir),
+            num_schedulers=sb.num_schedulers,
+            use_tpu_batch_worker=sb.use_tpu_batch_worker,
+            batch_size=sb.batch_size)
+        if sb.enabled_schedulers:
+            scfg.enabled_schedulers = list(sb.enabled_schedulers) + ["_core"]
+        self.server = Server(scfg, logger=self.logger.getChild("server"))
+
+    def _setup_client(self) -> None:
+        if not self.config.client.enabled:
+            return
+        cb = self.config.client
+        ccfg = ClientConfig(
+            region=self.config.region,
+            datacenter=self.config.datacenter,
+            node_name=self.config.name,
+            node_class=cb.node_class,
+            state_dir=cb.state_dir,
+            alloc_dir=cb.alloc_dir,
+            servers=list(cb.servers),
+            meta=dict(cb.meta),
+            options=dict(cb.options),
+            network_speed=cb.network_speed,
+            cpu_total_compute=cb.cpu_total_compute,
+            gc_max_allocs=cb.gc_max_allocs,
+            dev_mode=self.config.dev_mode)
+        # In-process RPC when this agent also runs a server; a remote RPC
+        # proxy otherwise (reference clients RPC over TCP; the in-proc
+        # fast path mirrors agent-embedded client behavior).
+        rpc = self.server
+        if rpc is None:
+            from ..server.rpc import RemoteServerRPC
+
+            rpc = RemoteServerRPC(cb.servers)
+        self.client = Client(ccfg, rpc=rpc,
+                             logger=self.logger.getChild("client"))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self.server is not None:
+            self.server.start()
+        if self.client is not None:
+            self.client.start()
+        self.http = HTTPServer(self, host=self.config.bind_addr,
+                               port=self.config.ports.http)
+        self.http.start()
+        self.logger.info("agent: started (http=%s)", self.http.address)
+
+    def shutdown(self) -> None:
+        if self.http is not None:
+            self.http.shutdown()
+        if self.client is not None:
+            self.client.shutdown()
+        if self.server is not None:
+            self.server.shutdown()
+
+    # -- introspection (agent_endpoint.go) ---------------------------------
+
+    def self_info(self) -> Dict:
+        cfg = self.config
+        stats: Dict[str, Dict] = {}
+        if self.server is not None:
+            stats["nomad"] = {str(k): str(v)
+                              for k, v in self.server.stats().items()}
+        if self.client is not None:
+            stats["client"] = {
+                "node_id": self.client.node.id,
+                "known_servers": ",".join(self.client.servers.all()),
+                "num_allocations": str(self.client.num_allocs()),
+            }
+        return {
+            "config": {
+                "Region": cfg.region, "Datacenter": cfg.datacenter,
+                "Name": cfg.name, "DataDir": cfg.data_dir,
+                "LogLevel": cfg.log_level, "BindAddr": cfg.bind_addr,
+                "EnableDebug": cfg.enable_debug,
+                "Ports": {"HTTP": cfg.ports.http, "RPC": cfg.ports.rpc,
+                          "Serf": cfg.ports.serf},
+                "Version": VERSION,
+                "Server": {"Enabled": cfg.server.enabled},
+                "Client": {"Enabled": cfg.client.enabled},
+            },
+            "member": self._self_member(),
+            "stats": stats,
+        }
+
+    def _self_member(self) -> Dict:
+        if self.server is None:
+            return {}
+        return {
+            "Name": self.config.name or self.server.config.node_name,
+            "Addr": self.config.bind_addr,
+            "Port": self.config.ports.serf,
+            "Status": "alive",
+            "Tags": {"region": self.config.region,
+                     "dc": self.config.datacenter,
+                     "role": "nomad", "vsn": "1"},
+        }
+
+    def members(self) -> List[Dict]:
+        return [self._self_member()] if self.server is not None else []
+
+    def client_servers(self) -> List[str]:
+        if self.client is None:
+            return []
+        return self.client.servers.all()
+
+    def set_client_servers(self, servers: List[str]) -> None:
+        if self.client is None:
+            raise ValueError("client is not enabled")
+        self.client.servers.set(servers)

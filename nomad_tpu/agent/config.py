@@ -1,0 +1,173 @@
+"""Agent configuration (reference: command/agent/config.go + config_parse.go).
+
+Config comes from HCL/JSON files merged over defaults, with the same block
+shape as the reference agent config (server{}/client{}/ports{}/advertise{}).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..jobspec.hcl import Block, parse_hcl
+
+
+@dataclass
+class Ports:
+    http: int = 4646
+    rpc: int = 4647
+    serf: int = 4648
+
+
+@dataclass
+class ServerBlock:
+    enabled: bool = False
+    bootstrap_expect: int = 1
+    data_dir: str = ""
+    num_schedulers: int = 1
+    enabled_schedulers: List[str] = field(default_factory=list)
+    node_gc_threshold: str = ""
+    heartbeat_grace: str = ""
+    start_join: List[str] = field(default_factory=list)
+    use_tpu_batch_worker: bool = False
+    batch_size: int = 64
+
+
+@dataclass
+class ClientBlock:
+    enabled: bool = False
+    state_dir: str = ""
+    alloc_dir: str = ""
+    servers: List[str] = field(default_factory=list)
+    node_class: str = ""
+    meta: Dict[str, str] = field(default_factory=dict)
+    options: Dict[str, str] = field(default_factory=dict)
+    network_interface: str = ""
+    network_speed: int = 0
+    cpu_total_compute: int = 0
+    gc_interval: str = ""
+    gc_max_allocs: int = 50
+
+
+@dataclass
+class AgentConfig:
+    region: str = "global"
+    datacenter: str = "dc1"
+    name: str = ""
+    data_dir: str = ""
+    log_level: str = "INFO"
+    bind_addr: str = "127.0.0.1"
+    enable_debug: bool = False
+    ports: Ports = field(default_factory=Ports)
+    server: ServerBlock = field(default_factory=ServerBlock)
+    client: ClientBlock = field(default_factory=ClientBlock)
+    dev_mode: bool = False
+
+    @staticmethod
+    def dev() -> "AgentConfig":
+        """-dev: in-memory server + client in one process
+        (command/agent/config.go DevConfig)."""
+        cfg = AgentConfig()
+        cfg.dev_mode = True
+        cfg.server.enabled = True
+        cfg.client.enabled = True
+        cfg.ports.http = 0  # ephemeral
+        return cfg
+
+
+def _scalar(blk: Block, key: str, default=None):
+    e = blk.one(key)
+    if e is None or isinstance(e.value, Block):
+        return default
+    return e.value
+
+
+def _str_list(blk: Block, key: str) -> List[str]:
+    e = blk.one(key)
+    if e is None or isinstance(e.value, Block):
+        return []
+    v = e.value
+    return [str(x) for x in v] if isinstance(v, list) else [str(v)]
+
+
+def _str_map(blk: Block, key: str) -> Dict[str, str]:
+    e = blk.one(key)
+    if e is None or not isinstance(e.value, Block):
+        return {}
+    return {x.key: str(x.value) for x in e.value.entries
+            if not isinstance(x.value, Block)}
+
+
+def parse_config(src: str) -> AgentConfig:
+    """Parse an HCL (or JSON) agent config file into AgentConfig."""
+    src_stripped = src.lstrip()
+    if src_stripped.startswith("{"):
+        return _from_json(json.loads(src))
+    root = parse_hcl(src)
+    cfg = AgentConfig()
+    cfg.region = str(_scalar(root, "region", cfg.region))
+    cfg.datacenter = str(_scalar(root, "datacenter", cfg.datacenter))
+    cfg.name = str(_scalar(root, "name", cfg.name))
+    cfg.data_dir = str(_scalar(root, "data_dir", cfg.data_dir))
+    cfg.log_level = str(_scalar(root, "log_level", cfg.log_level))
+    cfg.bind_addr = str(_scalar(root, "bind_addr", cfg.bind_addr))
+    cfg.enable_debug = bool(_scalar(root, "enable_debug", False))
+
+    pe = root.one("ports")
+    if pe is not None and isinstance(pe.value, Block):
+        cfg.ports.http = int(_scalar(pe.value, "http", cfg.ports.http))
+        cfg.ports.rpc = int(_scalar(pe.value, "rpc", cfg.ports.rpc))
+        cfg.ports.serf = int(_scalar(pe.value, "serf", cfg.ports.serf))
+
+    se = root.one("server")
+    if se is not None and isinstance(se.value, Block):
+        sb = se.value
+        cfg.server.enabled = bool(_scalar(sb, "enabled", False))
+        cfg.server.bootstrap_expect = int(_scalar(sb, "bootstrap_expect", 1))
+        cfg.server.data_dir = str(_scalar(sb, "data_dir", ""))
+        cfg.server.num_schedulers = int(_scalar(sb, "num_schedulers", 1))
+        cfg.server.enabled_schedulers = _str_list(sb, "enabled_schedulers")
+        cfg.server.start_join = _str_list(sb, "start_join")
+        cfg.server.use_tpu_batch_worker = bool(
+            _scalar(sb, "use_tpu_batch_worker", False))
+        cfg.server.batch_size = int(_scalar(sb, "batch_size", 64))
+
+    ce = root.one("client")
+    if ce is not None and isinstance(ce.value, Block):
+        cb = ce.value
+        cfg.client.enabled = bool(_scalar(cb, "enabled", False))
+        cfg.client.state_dir = str(_scalar(cb, "state_dir", ""))
+        cfg.client.alloc_dir = str(_scalar(cb, "alloc_dir", ""))
+        cfg.client.servers = _str_list(cb, "servers")
+        cfg.client.node_class = str(_scalar(cb, "node_class", ""))
+        cfg.client.meta = _str_map(cb, "meta")
+        cfg.client.options = _str_map(cb, "options")
+        cfg.client.network_speed = int(_scalar(cb, "network_speed", 0))
+        cfg.client.cpu_total_compute = int(_scalar(cb, "cpu_total_compute", 0))
+        cfg.client.gc_max_allocs = int(_scalar(cb, "gc_max_allocs", 50))
+
+    return cfg
+
+
+def _from_json(data: dict) -> AgentConfig:
+    cfg = AgentConfig()
+    for k in ("region", "datacenter", "name", "data_dir", "log_level",
+              "bind_addr"):
+        if k in data:
+            setattr(cfg, k, data[k])
+    ports = data.get("ports") or {}
+    for k in ("http", "rpc", "serf"):
+        if k in ports:
+            setattr(cfg.ports, k, int(ports[k]))
+    for blk_name, target in (("server", cfg.server), ("client", cfg.client)):
+        blk = data.get(blk_name) or {}
+        for k, v in blk.items():
+            if hasattr(target, k):
+                setattr(target, k, v)
+    return cfg
+
+
+def load_config_file(path: str) -> AgentConfig:
+    with open(path, "r", encoding="utf-8") as f:
+        return parse_config(f.read())

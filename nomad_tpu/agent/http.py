@@ -1,0 +1,581 @@
+"""HTTP API server: the /v1 surface.
+
+Reference behavior: command/agent/http.go (mux at http.go:135-178, the
+``wrap`` helper at http.go:205 adding region/blocking-query/error handling,
+parseWait at http.go:301) plus the per-resource endpoint files
+(command/agent/*_endpoint.go).  Implemented on the stdlib threading HTTP
+server; JSON bodies are the CamelCase wire shape from api/codec.py.
+
+Blocking queries: ``?index=N&wait=Ds`` long-polls until the relevant state
+tables pass index N (state.WatchSet re-run loop, the moral of
+nomad/rpc.go:340 blockingRPC), replying with ``X-Nomad-Index``.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ..api.codec import from_wire, to_wire
+from ..jobspec.parse import parse_duration
+from ..state.state_store import WatchSet
+from ..structs import structs as s
+
+MAX_BLOCKING_WAIT = 300.0  # 5m default / 10m cap like the reference
+
+
+class CodedError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+class HTTPServer:
+    """Routes /v1 requests onto an Agent's server/client."""
+
+    def __init__(self, agent, host: str = "127.0.0.1", port: int = 4646):
+        self.agent = agent
+        self.host = host
+        self.routes: List[Tuple[str, re.Pattern, Callable]] = []
+        self._register_routes()
+
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                outer.agent.logger.debug("http: " + fmt % args)
+
+            def _handle(self):
+                outer._dispatch(self)
+
+            do_GET = do_PUT = do_POST = do_DELETE = _handle
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        name="http", daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    @property
+    def address(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # ------------------------------------------------------------------
+    # routing / wrap
+    # ------------------------------------------------------------------
+
+    def _register_routes(self) -> None:
+        r = self._route
+        r("/v1/jobs", self.jobs_request)
+        r("/v1/job/(?P<rest>.*)", self.job_specific_request)
+        r("/v1/nodes", self.nodes_request)
+        r("/v1/node/(?P<rest>.*)", self.node_specific_request)
+        r("/v1/allocations", self.allocs_request)
+        r("/v1/allocation/(?P<id>[^/]+)", self.alloc_specific_request)
+        r("/v1/evaluations", self.evals_request)
+        r("/v1/evaluation/(?P<rest>.*)", self.eval_specific_request)
+        r("/v1/client/stats", self.client_stats_request)
+        r("/v1/client/allocation/(?P<id>[^/]+)/stats", self.client_alloc_stats_request)
+        r("/v1/client/fs/(?P<rest>.*)", self.client_fs_request)
+        r("/v1/client/gc", self.client_gc_request)
+        r("/v1/agent/self", self.agent_self_request)
+        r("/v1/agent/members", self.agent_members_request)
+        r("/v1/agent/servers", self.agent_servers_request)
+        r("/v1/agent/join", self.agent_join_request)
+        r("/v1/agent/force-leave", self.agent_force_leave_request)
+        r("/v1/validate/job", self.validate_job_request)
+        r("/v1/regions", self.regions_request)
+        r("/v1/status/leader", self.status_leader_request)
+        r("/v1/status/peers", self.status_peers_request)
+        r("/v1/operator/raft/configuration", self.operator_raft_conf_request)
+        r("/v1/system/gc", self.system_gc_request)
+        r("/v1/system/reconcile/summaries", self.system_reconcile_request)
+
+    def _route(self, pattern: str, fn: Callable) -> None:
+        self.routes.append((pattern, re.compile("^" + pattern + "$"), fn))
+
+    def _dispatch(self, req: BaseHTTPRequestHandler) -> None:
+        parsed = urlparse(req.path)
+        query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        for _pat, rx, fn in self.routes:
+            m = rx.match(parsed.path)
+            if m is None:
+                continue
+            try:
+                obj, index = fn(req, query, **m.groupdict())
+            except CodedError as e:
+                self._reply_error(req, e.code, str(e))
+                return
+            except (ValueError, KeyError) as e:
+                self._reply_error(req, 400, str(e))
+                return
+            except Exception as e:  # 500 like wrap (http.go:224)
+                self.agent.logger.exception("http: request failed")
+                self._reply_error(req, 500, str(e))
+                return
+            self._reply_json(req, obj, index)
+            return
+        self._reply_error(req, 404, "Invalid URL")
+
+    def _reply_json(self, req, obj: Any, index: Optional[int]) -> None:
+        body = b"" if obj is None else json.dumps(
+            to_wire(obj), default=str).encode()
+        req.send_response(200)
+        req.send_header("Content-Type", "application/json")
+        req.send_header("Content-Length", str(len(body)))
+        if index is not None:
+            req.send_header("X-Nomad-Index", str(index))
+            req.send_header("X-Nomad-KnownLeader", "true")
+            req.send_header("X-Nomad-LastContact", "0")
+        req.end_headers()
+        req.wfile.write(body)
+
+    def _reply_error(self, req, code: int, msg: str) -> None:
+        body = msg.encode()
+        req.send_response(code)
+        req.send_header("Content-Type", "text/plain")
+        req.send_header("Content-Length", str(len(body)))
+        req.end_headers()
+        req.wfile.write(body)
+
+    def _body(self, req, typ=None):
+        length = int(req.headers.get("Content-Length") or 0)
+        raw = req.rfile.read(length) if length else b""
+        if typ is None:
+            return json.loads(raw) if raw else None
+        data = json.loads(raw) if raw else None
+        if data is None:
+            raise CodedError(400, "request body required")
+        return from_wire(typ, data)
+
+    @property
+    def server(self):
+        if self.agent.server is None:
+            raise CodedError(400, "server is not enabled")
+        return self.agent.server
+
+    @property
+    def client(self):
+        if self.agent.client is None:
+            raise CodedError(400, "client is not enabled")
+        return self.agent.client
+
+    # ------------------------------------------------------------------
+    # blocking-query helper (http.go:301 parseWait + rpc.go:340 blockingRPC)
+    # ------------------------------------------------------------------
+
+    def _blocking(self, query: dict, run: Callable[[Optional[WatchSet]], Tuple[Any, int]]):
+        min_index = int(query.get("index", 0) or 0)
+        if "wait" in query:
+            wait = min(parse_duration(query["wait"]), MAX_BLOCKING_WAIT)
+        else:
+            wait = MAX_BLOCKING_WAIT
+        if min_index <= 0:
+            return run(None)
+        deadline = time.monotonic() + wait
+        while True:
+            ws = WatchSet()
+            obj, index = run(ws)
+            if index > min_index or time.monotonic() >= deadline:
+                ws.close()
+                return obj, index
+            ws.watch(max(0.0, deadline - time.monotonic()))
+
+    # ------------------------------------------------------------------
+    # jobs (command/agent/job_endpoint.go)
+    # ------------------------------------------------------------------
+
+    def jobs_request(self, req, query):
+        if req.command == "GET":
+            def run(ws):
+                state = self.server.state
+                prefix = query.get("prefix", "")
+                jobs = (state.jobs_by_id_prefix(ws, prefix) if prefix
+                        else state.jobs(ws))
+                stubs = [self._job_stub(j) for j in jobs]
+                return stubs, state.table_index("jobs")
+            return self._blocking(query, run)
+        if req.command in ("PUT", "POST"):
+            payload = self._body(req)
+            if payload is None or "Job" not in payload:
+                raise CodedError(400, "JSON body with Job required")
+            job = from_wire(s.Job, payload["Job"])
+            index, eval_id = self.server.job_register(job)
+            return {"EvalID": eval_id, "EvalCreateIndex": index,
+                    "JobModifyIndex": index}, index
+        raise CodedError(405, "Invalid method")
+
+    @staticmethod
+    def _job_stub(j: s.Job) -> dict:
+        return {
+            "ID": j.id, "ParentID": j.parent_id, "Name": j.name,
+            "Type": j.type, "Priority": j.priority, "Status": j.status,
+            "StatusDescription": j.status_description,
+            "CreateIndex": j.create_index, "ModifyIndex": j.modify_index,
+            "JobModifyIndex": j.job_modify_index,
+        }
+
+    _JOB_SUBPATHS = ("allocations", "evaluations", "summary", "plan",
+                     "evaluate", "periodic/force", "dispatch")
+
+    def job_specific_request(self, req, query, rest: str):
+        # Job IDs may themselves contain slashes (periodic/dispatch children
+        # like "job/periodic-123"), so match known suffixes instead of
+        # splitting at the first slash (reference: http.go jobSpecificRequest
+        # switches on HasSuffix).
+        job_id, sub = rest, ""
+        for cand in self._JOB_SUBPATHS:
+            if rest.endswith("/" + cand):
+                job_id, sub = rest[: -len(cand) - 1], cand
+                break
+        if not job_id:
+            raise CodedError(400, "Missing job ID")
+        if sub == "":
+            return self._job_crud(req, query, job_id)
+        if sub == "allocations":
+            def run(ws):
+                allocs = self.server.state.allocs_by_job(
+                    ws, job_id, query.get("all") not in (None, "", "false"))
+                return ([self._alloc_stub(a) for a in allocs],
+                        self.server.state.table_index("allocs"))
+            return self._blocking(query, run)
+        if sub == "evaluations":
+            def run(ws):
+                evals = self.server.state.evals_by_job(ws, job_id)
+                return evals, self.server.state.table_index("evals")
+            return self._blocking(query, run)
+        if sub == "summary":
+            def run(ws):
+                summary = self.server.job_summary(job_id)
+                if summary is None:
+                    raise CodedError(404, "job summary not found")
+                return summary, self.server.state.table_index("job_summary")
+            return self._blocking(query, run)
+        if sub == "plan":
+            if req.command not in ("PUT", "POST"):
+                raise CodedError(405, "Invalid method")
+            payload = self._body(req)
+            if payload is None or "Job" not in payload:
+                raise CodedError(400, "JSON body with Job required")
+            job = from_wire(s.Job, payload["Job"])
+            if job.id != job_id:
+                raise CodedError(400, "Job ID does not match")
+            resp = self.server.job_plan(job, diff=bool(payload.get("Diff", True)))
+            return resp, self.server.raft.applied_index()
+        if sub == "evaluate":
+            if req.command not in ("PUT", "POST"):
+                raise CodedError(405, "Invalid method")
+            index, eval_id = self.server.job_evaluate(job_id)
+            return {"EvalID": eval_id, "EvalCreateIndex": index,
+                    "JobModifyIndex": index}, index
+        if sub == "periodic/force":
+            if req.command not in ("PUT", "POST"):
+                raise CodedError(405, "Invalid method")
+            child = self.server.periodic_force(job_id)
+            if child is None:
+                raise CodedError(404, f"periodic job {job_id!r} not found")
+            idx = self.server.raft.applied_index()
+            return {"EvalCreateIndex": idx, "Index": idx}, idx
+        if sub == "dispatch":
+            if req.command not in ("PUT", "POST"):
+                raise CodedError(405, "Invalid method")
+            payload = self._body(req) or {}
+            meta = payload.get("Meta") or {}
+            body = payload.get("Payload") or ""
+            import base64 as b64
+            raw = b64.b64decode(body) if isinstance(body, str) and body else b""
+            index, child_id, eval_id = self.server.job_dispatch(
+                job_id, raw, meta)
+            return {"DispatchedJobID": child_id, "EvalID": eval_id,
+                    "EvalCreateIndex": index, "JobCreateIndex": index}, index
+        raise CodedError(404, "Invalid URL")
+
+    def _job_crud(self, req, query, job_id: str):
+        if req.command == "GET":
+            def run(ws):
+                job = self.server.state.job_by_id(ws, job_id)
+                if job is None:
+                    raise CodedError(404, "job not found")
+                return job, self.server.state.table_index("jobs")
+            return self._blocking(query, run)
+        if req.command in ("PUT", "POST"):
+            payload = self._body(req)
+            if payload is None or "Job" not in payload:
+                raise CodedError(400, "JSON body with Job required")
+            job = from_wire(s.Job, payload["Job"])
+            if job.id != job_id:
+                raise CodedError(400, "Job ID does not match name")
+            index, eval_id = self.server.job_register(job)
+            return {"EvalID": eval_id, "EvalCreateIndex": index,
+                    "JobModifyIndex": index}, index
+        if req.command == "DELETE":
+            purge = query.get("purge", "true") != "false"
+            index, eval_id = self.server.job_deregister(job_id, purge=purge)
+            return {"EvalID": eval_id, "EvalCreateIndex": index,
+                    "JobModifyIndex": index}, index
+        raise CodedError(405, "Invalid method")
+
+    # ------------------------------------------------------------------
+    # nodes (command/agent/node_endpoint.go)
+    # ------------------------------------------------------------------
+
+    def nodes_request(self, req, query):
+        if req.command != "GET":
+            raise CodedError(405, "Invalid method")
+
+        def run(ws):
+            state = self.server.state
+            prefix = query.get("prefix", "")
+            nodes = (state.nodes_by_id_prefix(ws, prefix) if prefix
+                     else state.nodes(ws))
+            stubs = [self._node_stub(n) for n in nodes]
+            return stubs, state.table_index("nodes")
+        return self._blocking(query, run)
+
+    @staticmethod
+    def _node_stub(n: s.Node) -> dict:
+        return {
+            "ID": n.id, "Datacenter": n.datacenter, "Name": n.name,
+            "NodeClass": n.node_class, "Drain": n.drain, "Status": n.status,
+            "StatusDescription": n.status_description,
+            "CreateIndex": n.create_index, "ModifyIndex": n.modify_index,
+        }
+
+    def node_specific_request(self, req, query, rest: str):
+        parts = rest.split("/")
+        node_id = parts[0]
+        sub = "/".join(parts[1:])
+        if not node_id:
+            raise CodedError(400, "Missing node ID")
+        if sub == "":
+            if req.command != "GET":
+                raise CodedError(405, "Invalid method")
+
+            def run(ws):
+                node = self.server.state.node_by_id(ws, node_id)
+                if node is None:
+                    raise CodedError(404, "node not found")
+                return node, self.server.state.table_index("nodes")
+            return self._blocking(query, run)
+        if sub == "allocations":
+            def run(ws):
+                allocs = self.server.state.allocs_by_node(ws, node_id)
+                return allocs, self.server.state.table_index("allocs")
+            return self._blocking(query, run)
+        if sub == "evaluate":
+            if req.command not in ("PUT", "POST"):
+                raise CodedError(405, "Invalid method")
+            eval_ids = self.server.node_evaluate(node_id)
+            idx = self.server.raft.applied_index()
+            return {"EvalIDs": eval_ids, "EvalCreateIndex": idx}, idx
+        if sub == "drain":
+            if req.command not in ("PUT", "POST"):
+                raise CodedError(405, "Invalid method")
+            enable = query.get("enable") in ("true", "1")
+            index = self.server.node_update_drain(node_id, enable)
+            return {"EvalCreateIndex": index, "NodeModifyIndex": index}, index
+        raise CodedError(404, "Invalid URL")
+
+    # ------------------------------------------------------------------
+    # allocations / evaluations
+    # ------------------------------------------------------------------
+
+    def allocs_request(self, req, query):
+        if req.command != "GET":
+            raise CodedError(405, "Invalid method")
+
+        def run(ws):
+            state = self.server.state
+            prefix = query.get("prefix", "")
+            allocs = state.allocs(ws)
+            if prefix:
+                allocs = [a for a in allocs if a.id.startswith(prefix)]
+            return ([self._alloc_stub(a) for a in allocs],
+                    state.table_index("allocs"))
+        return self._blocking(query, run)
+
+    @staticmethod
+    def _alloc_stub(a: s.Allocation) -> dict:
+        return {
+            "ID": a.id, "EvalID": a.eval_id, "Name": a.name,
+            "NodeID": a.node_id, "JobID": a.job_id, "TaskGroup": a.task_group,
+            "DesiredStatus": a.desired_status,
+            "DesiredDescription": a.desired_description,
+            "ClientStatus": a.client_status,
+            "ClientDescription": a.client_description,
+            "TaskStates": to_wire(a.task_states),
+            "CreateIndex": a.create_index, "ModifyIndex": a.modify_index,
+            "CreateTime": a.create_time,
+        }
+
+    def alloc_specific_request(self, req, query, id: str):
+        if req.command != "GET":
+            raise CodedError(405, "Invalid method")
+
+        def run(ws):
+            alloc = self.server.state.alloc_by_id(ws, id)
+            if alloc is None:
+                raise CodedError(404, "alloc not found")
+            return alloc, self.server.state.table_index("allocs")
+        return self._blocking(query, run)
+
+    def evals_request(self, req, query):
+        if req.command != "GET":
+            raise CodedError(405, "Invalid method")
+
+        def run(ws):
+            state = self.server.state
+            prefix = query.get("prefix", "")
+            evals = (state.evals_by_id_prefix(ws, prefix) if prefix
+                     else state.evals(ws))
+            return evals, state.table_index("evals")
+        return self._blocking(query, run)
+
+    def eval_specific_request(self, req, query, rest: str):
+        parts = rest.split("/")
+        eval_id = parts[0]
+        sub = "/".join(parts[1:])
+        if sub == "":
+            def run(ws):
+                ev = self.server.state.eval_by_id(ws, eval_id)
+                if ev is None:
+                    raise CodedError(404, "eval not found")
+                return ev, self.server.state.table_index("evals")
+            return self._blocking(query, run)
+        if sub == "allocations":
+            def run(ws):
+                allocs = self.server.state.allocs_by_eval(ws, eval_id)
+                return ([self._alloc_stub(a) for a in allocs],
+                        self.server.state.table_index("allocs"))
+            return self._blocking(query, run)
+        raise CodedError(404, "Invalid URL")
+
+    # ------------------------------------------------------------------
+    # client endpoints (command/agent/{stats,fs}_endpoint.go)
+    # ------------------------------------------------------------------
+
+    def client_stats_request(self, req, query):
+        return self.client.stats(), None
+
+    def client_alloc_stats_request(self, req, query, id: str):
+        runner = self.client.get_alloc_runner(id)
+        if runner is None:
+            raise CodedError(404, f"unknown allocation ID {id!r}")
+        return runner.stats_report(), None
+
+    def client_gc_request(self, req, query):
+        if req.command not in ("PUT", "POST", "GET"):
+            raise CodedError(405, "Invalid method")
+        self.client.garbage_collector.collect_all()
+        return None, None
+
+    def client_fs_request(self, req, query, rest: str):
+        parts = rest.split("/", 1)
+        op = parts[0]
+        alloc_id = parts[1] if len(parts) > 1 else ""
+        if op not in ("ls", "stat", "cat", "readat", "logs"):
+            raise CodedError(404, "Invalid URL")
+        if not alloc_id:
+            raise CodedError(400, "Missing allocation ID")
+        runner = self.client.get_alloc_runner(alloc_id)
+        if runner is None:
+            raise CodedError(404, f"unknown allocation ID {alloc_id!r}")
+        adir = runner.alloc_dir
+        path = query.get("path", "/")
+        if op == "ls":
+            return adir.list_dir(path), None
+        if op == "stat":
+            return adir.stat(path), None
+        if op == "cat":
+            data = adir.read_all(path)
+            return data.decode("utf-8", "replace"), None
+        if op == "readat":
+            offset = int(query.get("offset", 0))
+            limit = int(query.get("limit", 1 << 20))
+            data = adir.read_at(path, offset, limit)
+            return data.decode("utf-8", "replace"), None
+        if op == "logs":
+            task = query.get("task", "")
+            log_type = query.get("type", "stdout")
+            if not task:
+                raise CodedError(400, "Missing task name")
+            return self.client.task_logs(alloc_id, task, log_type), None
+        raise CodedError(404, "Invalid URL")
+
+    # ------------------------------------------------------------------
+    # agent / status / operator / system
+    # ------------------------------------------------------------------
+
+    def agent_self_request(self, req, query):
+        return self.agent.self_info(), None
+
+    def agent_members_request(self, req, query):
+        return {"Members": self.agent.members()}, None
+
+    def agent_servers_request(self, req, query):
+        if req.command == "GET":
+            return self.agent.client_servers(), None
+        if req.command in ("PUT", "POST"):
+            addrs = query.get("address")
+            self.agent.set_client_servers([addrs] if addrs else [])
+            return None, None
+        raise CodedError(405, "Invalid method")
+
+    def agent_join_request(self, req, query):
+        if req.command not in ("PUT", "POST"):
+            raise CodedError(405, "Invalid method")
+        return {"num_joined": 0, "error": ""}, None
+
+    def agent_force_leave_request(self, req, query):
+        if req.command not in ("PUT", "POST"):
+            raise CodedError(405, "Invalid method")
+        return None, None
+
+    def validate_job_request(self, req, query):
+        if req.command not in ("PUT", "POST"):
+            raise CodedError(405, "Invalid method")
+        payload = self._body(req)
+        if payload is None or "Job" not in payload:
+            raise CodedError(400, "JSON body with Job required")
+        job = from_wire(s.Job, payload["Job"])
+        job.canonicalize()
+        problems = job.validate()
+        return {"ValidationErrors": problems,
+                "Error": "; ".join(problems) if problems else ""}, None
+
+    def regions_request(self, req, query):
+        return [self.agent.config.region], None
+
+    def status_leader_request(self, req, query):
+        return self.server.leader_address(), None
+
+    def status_peers_request(self, req, query):
+        return self.server.peer_addresses(), None
+
+    def operator_raft_conf_request(self, req, query):
+        return self.server.raft_configuration(), None
+
+    def system_gc_request(self, req, query):
+        if req.command not in ("PUT", "POST"):
+            raise CodedError(405, "Invalid method")
+        self.server.system_gc()
+        return None, None
+
+    def system_reconcile_request(self, req, query):
+        if req.command not in ("PUT", "POST"):
+            raise CodedError(405, "Invalid method")
+        self.server.system_reconcile_summaries()
+        return None, None

@@ -1,0 +1,10 @@
+"""Client SDK + wire codec (reference: api/ Go package)."""
+
+from .client import (AgentAPI, Allocations, APIError, Evaluations, Jobs,
+                     NomadAPI, Nodes, Operator, QueryMeta, QueryOptions,
+                     Status, System)
+from .codec import from_wire, to_wire
+
+__all__ = ["AgentAPI", "Allocations", "APIError", "Evaluations", "Jobs",
+           "NomadAPI", "Nodes", "Operator", "QueryMeta", "QueryOptions",
+           "Status", "System", "from_wire", "to_wire"]

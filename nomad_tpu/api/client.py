@@ -1,0 +1,313 @@
+"""Python SDK for the HTTP API.
+
+Reference behavior: the api/ Go package (api/api.go Client + per-resource
+handles api/jobs.go, api/nodes.go, api/allocations.go, api/evaluations.go,
+api/agent.go, api/operator.go, api/system.go).  Shapes: jobs are
+structs.Job dataclasses encoded through api/codec.py; list endpoints return
+stub dicts exactly as the HTTP layer emits them.
+
+QueryOptions carry the blocking-query contract (wait_index + wait_time ->
+``?index&wait``), and every query returns QueryMeta with the last index so
+callers can long-poll, like the reference's WaitIndex loop.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..structs import structs as s
+from .codec import from_wire, to_wire
+
+
+class APIError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(f"Unexpected response code: {code} ({message})")
+        self.code = code
+
+
+@dataclass
+class QueryOptions:
+    region: str = ""
+    prefix: str = ""
+    wait_index: int = 0
+    wait_time: float = 0.0  # seconds
+    params: Optional[Dict[str, str]] = None
+
+
+@dataclass
+class QueryMeta:
+    last_index: int = 0
+    known_leader: bool = False
+
+
+class NomadAPI:
+    """api.Client (api/api.go:221 NewClient)."""
+
+    def __init__(self, address: str = "http://127.0.0.1:4646",
+                 region: str = "", timeout: float = 330.0):
+        self.address = address.rstrip("/")
+        self.region = region
+        self.timeout = timeout
+        self.jobs = Jobs(self)
+        self.nodes = Nodes(self)
+        self.allocations = Allocations(self)
+        self.evaluations = Evaluations(self)
+        self.agent = AgentAPI(self)
+        self.system = System(self)
+        self.operator = Operator(self)
+        self.status = Status(self)
+
+    # -- raw transport -----------------------------------------------------
+
+    def _url(self, path: str, q: Optional[QueryOptions]) -> str:
+        params: Dict[str, str] = {}
+        if q is not None:
+            if q.region or self.region:
+                params["region"] = q.region or self.region
+            if q.prefix:
+                params["prefix"] = q.prefix
+            if q.wait_index:
+                params["index"] = str(q.wait_index)
+            if q.wait_time:
+                params["wait"] = f"{q.wait_time}s"
+            if q.params:
+                params.update(q.params)
+        qs = ("?" + urllib.parse.urlencode(params)) if params else ""
+        return self.address + path + qs
+
+    def _do(self, method: str, path: str, body: Any = None,
+            q: Optional[QueryOptions] = None) -> Tuple[Any, QueryMeta]:
+        data = None
+        if body is not None:
+            data = json.dumps(to_wire(body)).encode()
+        req = urllib.request.Request(self._url(path, q), data=data,
+                                     method=method)
+        req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                raw = resp.read()
+                meta = QueryMeta(
+                    last_index=int(resp.headers.get("X-Nomad-Index") or 0),
+                    known_leader=resp.headers.get(
+                        "X-Nomad-KnownLeader") == "true")
+                obj = json.loads(raw) if raw else None
+                return obj, meta
+        except urllib.error.HTTPError as e:
+            raise APIError(e.code, e.read().decode("utf-8", "replace")) from e
+
+    def get(self, path: str, q: Optional[QueryOptions] = None):
+        return self._do("GET", path, None, q)
+
+    def put(self, path: str, body: Any = None, q: Optional[QueryOptions] = None):
+        return self._do("PUT", path, body, q)
+
+    def delete(self, path: str, q: Optional[QueryOptions] = None):
+        return self._do("DELETE", path, None, q)
+
+
+class Jobs:
+    """api/jobs.go."""
+
+    def __init__(self, c: NomadAPI):
+        self.c = c
+
+    def list(self, q: Optional[QueryOptions] = None) -> Tuple[List[dict], QueryMeta]:
+        return self.c.get("/v1/jobs", q)
+
+    def register(self, job: s.Job) -> Tuple[dict, QueryMeta]:
+        return self.c.put("/v1/jobs", {"Job": to_wire(job)})
+
+    def info(self, job_id: str, q: Optional[QueryOptions] = None
+             ) -> Tuple[s.Job, QueryMeta]:
+        obj, meta = self.c.get(f"/v1/job/{job_id}", q)
+        return from_wire(s.Job, obj), meta
+
+    def deregister(self, job_id: str, purge: bool = True) -> Tuple[dict, QueryMeta]:
+        q = QueryOptions(params={"purge": "true" if purge else "false"})
+        return self.c.delete(f"/v1/job/{job_id}", q)
+
+    def allocations(self, job_id: str, all_allocs: bool = False,
+                    q: Optional[QueryOptions] = None):
+        q = q or QueryOptions()
+        if all_allocs:
+            q.params = dict(q.params or {}, all="true")
+        return self.c.get(f"/v1/job/{job_id}/allocations", q)
+
+    def evaluations(self, job_id: str, q: Optional[QueryOptions] = None):
+        return self.c.get(f"/v1/job/{job_id}/evaluations", q)
+
+    def summary(self, job_id: str, q: Optional[QueryOptions] = None
+                ) -> Tuple[s.JobSummary, QueryMeta]:
+        obj, meta = self.c.get(f"/v1/job/{job_id}/summary", q)
+        return from_wire(s.JobSummary, obj), meta
+
+    def plan(self, job: s.Job, diff: bool = True) -> Tuple[s.JobPlanResponse, QueryMeta]:
+        obj, meta = self.c.put(f"/v1/job/{job.id}/plan",
+                               {"Job": to_wire(job), "Diff": diff})
+        return from_wire(s.JobPlanResponse, obj), meta
+
+    def evaluate(self, job_id: str) -> Tuple[dict, QueryMeta]:
+        return self.c.put(f"/v1/job/{job_id}/evaluate")
+
+    def periodic_force(self, job_id: str) -> Tuple[dict, QueryMeta]:
+        return self.c.put(f"/v1/job/{job_id}/periodic/force")
+
+    def dispatch(self, job_id: str, payload: bytes = b"",
+                 meta: Optional[Dict[str, str]] = None) -> Tuple[dict, QueryMeta]:
+        import base64
+        body = {"Payload": base64.b64encode(payload).decode("ascii")
+                if payload else "", "Meta": meta or {}}
+        return self.c.put(f"/v1/job/{job_id}/dispatch", body)
+
+    def validate(self, job: s.Job) -> Tuple[dict, QueryMeta]:
+        return self.c.put("/v1/validate/job", {"Job": to_wire(job)})
+
+
+class Nodes:
+    """api/nodes.go."""
+
+    def __init__(self, c: NomadAPI):
+        self.c = c
+
+    def list(self, q: Optional[QueryOptions] = None):
+        return self.c.get("/v1/nodes", q)
+
+    def info(self, node_id: str, q: Optional[QueryOptions] = None
+             ) -> Tuple[s.Node, QueryMeta]:
+        obj, meta = self.c.get(f"/v1/node/{node_id}", q)
+        return from_wire(s.Node, obj), meta
+
+    def allocations(self, node_id: str, q: Optional[QueryOptions] = None
+                    ) -> Tuple[List[s.Allocation], QueryMeta]:
+        obj, meta = self.c.get(f"/v1/node/{node_id}/allocations", q)
+        return [from_wire(s.Allocation, a) for a in obj or []], meta
+
+    def force_evaluate(self, node_id: str) -> Tuple[dict, QueryMeta]:
+        return self.c.put(f"/v1/node/{node_id}/evaluate")
+
+    def toggle_drain(self, node_id: str, drain: bool) -> Tuple[dict, QueryMeta]:
+        q = QueryOptions(params={"enable": "true" if drain else "false"})
+        return self.c.put(f"/v1/node/{node_id}/drain", None, q)
+
+
+class Allocations:
+    """api/allocations.go."""
+
+    def __init__(self, c: NomadAPI):
+        self.c = c
+
+    def list(self, q: Optional[QueryOptions] = None):
+        return self.c.get("/v1/allocations", q)
+
+    def info(self, alloc_id: str, q: Optional[QueryOptions] = None
+             ) -> Tuple[s.Allocation, QueryMeta]:
+        obj, meta = self.c.get(f"/v1/allocation/{alloc_id}", q)
+        return from_wire(s.Allocation, obj), meta
+
+
+class Evaluations:
+    """api/evaluations.go."""
+
+    def __init__(self, c: NomadAPI):
+        self.c = c
+
+    def list(self, q: Optional[QueryOptions] = None
+             ) -> Tuple[List[s.Evaluation], QueryMeta]:
+        obj, meta = self.c.get("/v1/evaluations", q)
+        return [from_wire(s.Evaluation, e) for e in obj or []], meta
+
+    def info(self, eval_id: str, q: Optional[QueryOptions] = None
+             ) -> Tuple[s.Evaluation, QueryMeta]:
+        obj, meta = self.c.get(f"/v1/evaluation/{eval_id}", q)
+        return from_wire(s.Evaluation, obj), meta
+
+    def allocations(self, eval_id: str, q: Optional[QueryOptions] = None):
+        return self.c.get(f"/v1/evaluation/{eval_id}/allocations", q)
+
+
+class AgentAPI:
+    """api/agent.go."""
+
+    def __init__(self, c: NomadAPI):
+        self.c = c
+
+    def self_info(self) -> dict:
+        obj, _ = self.c.get("/v1/agent/self")
+        return obj
+
+    def members(self) -> dict:
+        obj, _ = self.c.get("/v1/agent/members")
+        return obj
+
+    def servers(self) -> List[str]:
+        obj, _ = self.c.get("/v1/agent/servers")
+        return obj or []
+
+    def client_stats(self) -> dict:
+        obj, _ = self.c.get("/v1/client/stats")
+        return obj
+
+    def alloc_stats(self, alloc_id: str) -> dict:
+        obj, _ = self.c.get(f"/v1/client/allocation/{alloc_id}/stats")
+        return obj
+
+    def task_logs(self, alloc_id: str, task: str,
+                  log_type: str = "stdout") -> str:
+        obj, _ = self.c.get(
+            f"/v1/client/fs/logs/{alloc_id}",
+            QueryOptions(params={"task": task, "type": log_type}))
+        return obj or ""
+
+    def fs_list(self, alloc_id: str, path: str = "/") -> List[dict]:
+        obj, _ = self.c.get(f"/v1/client/fs/ls/{alloc_id}",
+                            QueryOptions(params={"path": path}))
+        return obj or []
+
+    def fs_cat(self, alloc_id: str, path: str) -> str:
+        obj, _ = self.c.get(f"/v1/client/fs/cat/{alloc_id}",
+                            QueryOptions(params={"path": path}))
+        return obj or ""
+
+
+class System:
+    """api/system.go."""
+
+    def __init__(self, c: NomadAPI):
+        self.c = c
+
+    def garbage_collect(self) -> None:
+        self.c.put("/v1/system/gc")
+
+    def reconcile_summaries(self) -> None:
+        self.c.put("/v1/system/reconcile/summaries")
+
+
+class Operator:
+    """api/operator.go."""
+
+    def __init__(self, c: NomadAPI):
+        self.c = c
+
+    def raft_get_configuration(self) -> dict:
+        obj, _ = self.c.get("/v1/operator/raft/configuration")
+        return obj
+
+
+class Status:
+    """api/status.go."""
+
+    def __init__(self, c: NomadAPI):
+        self.c = c
+
+    def leader(self) -> str:
+        obj, _ = self.c.get("/v1/status/leader")
+        return obj or ""
+
+    def peers(self) -> List[str]:
+        obj, _ = self.c.get("/v1/status/peers")
+        return obj or []

@@ -258,3 +258,17 @@ class AllocRunner:
 
     def is_destroyed(self) -> bool:
         return self._destroy.is_set()
+
+    def stats_report(self) -> Dict:
+        """Per-task resource-usage snapshot for the client HTTP stats
+        endpoint (reference: AllocRunner.StatsReporter / alloc stats)."""
+        tasks: Dict[str, Dict] = {}
+        for name, tr in list(self.task_runners.items()):
+            h = tr.handle
+            if h is None:
+                continue
+            try:
+                tasks[name] = h.stats()
+            except Exception:
+                tasks[name] = {}
+        return {"ResourceUsage": {"Tasks": tasks}, "Timestamp": time.time()}

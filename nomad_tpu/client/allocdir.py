@@ -137,6 +137,12 @@ class AllocDir:
         return {"Name": os.path.basename(p), "IsDir": os.path.isdir(p),
                 "Size": st.st_size, "ModTime": st.st_mtime}
 
+    def read_all(self, rel: str, max_bytes: int = 1 << 20) -> bytes:
+        """Read a file, capped at max_bytes (the HTTP cat endpoint must not
+        buffer arbitrarily large task output)."""
+        with open(self._safe_path(rel), "rb") as f:
+            return f.read(max_bytes)
+
     def read_at(self, rel: str, offset: int, limit: int) -> bytes:
         """(alloc_dir.go:334 ReadAt)."""
         p = self._safe_path(rel)

@@ -320,3 +320,35 @@ class Client:
     def num_allocs(self) -> int:
         with self._alloc_lock:
             return len(self.alloc_runners)
+
+    def task_logs(self, alloc_id: str, task: str, log_type: str = "stdout",
+                  max_bytes: int = 1 << 20) -> str:
+        """Concatenate the tail of the rotated log files for a task (fs logs
+        endpoint; reference: client log streaming via AllocDir ReadAt).
+        Reads newest-first and stops once max_bytes is gathered so large
+        rotations aren't buffered whole."""
+        runner = self.get_alloc_runner(alloc_id)
+        if runner is None:
+            raise KeyError(f"unknown allocation ID {alloc_id!r}")
+        log_dir = os.path.join(runner.alloc_dir.alloc_dir, "alloc", "logs")
+        if not os.path.isdir(log_dir):
+            return ""
+        prefix = f"{task}.{log_type}."
+        files = sorted(
+            (f for f in os.listdir(log_dir) if f.startswith(prefix)),
+            key=lambda f: int(f.rsplit(".", 1)[-1])
+            if f.rsplit(".", 1)[-1].isdigit() else 0)
+        chunks: List[bytes] = []
+        remaining = max_bytes
+        for fname in reversed(files):
+            if remaining <= 0:
+                break
+            path = os.path.join(log_dir, fname)
+            size = os.path.getsize(path)
+            with open(path, "rb") as fh:
+                if size > remaining:
+                    fh.seek(size - remaining)
+                data = fh.read(remaining)
+            chunks.append(data)
+            remaining -= len(data)
+        return b"".join(reversed(chunks)).decode("utf-8", "replace")

@@ -32,6 +32,7 @@ class ServerConfig:
     region: str = "global"
     datacenter: str = "dc1"
     node_name: str = "server-1"
+    rpc_advertise: str = "127.0.0.1:4647"
     data_dir: str = ""                  # empty → in-memory log (dev mode)
     num_schedulers: int = 1
     use_tpu_batch_worker: bool = False
@@ -391,6 +392,99 @@ class Server:
 
     def periodic_force(self, job_id: str) -> Optional[s.Job]:
         return self.periodic.force_run(job_id)
+
+    def job_evaluate(self, job_id: str) -> Tuple[int, str]:
+        """Force a new evaluation for an existing job
+        (job_endpoint.go Evaluate)."""
+        job = self.state.job_by_id(None, job_id)
+        if job is None:
+            raise KeyError(f"job not found: {job_id}")
+        if job.is_periodic():
+            raise ValueError("can't evaluate periodic job")
+        if job.is_parameterized():
+            raise ValueError("can't evaluate parameterized job")
+        ev = s.Evaluation(
+            id=s.generate_uuid(), priority=job.priority, type=job.type,
+            triggered_by=s.EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
+            job_modify_index=job.modify_index, status=s.EVAL_STATUS_PENDING)
+        _, index = self.raft.apply(MessageType.EVAL_UPDATE, {"evals": [ev]})
+        return index, ev.id
+
+    def job_dispatch(self, job_id: str, payload: bytes,
+                     meta: Dict[str, str]) -> Tuple[int, str, str]:
+        """Dispatch an instance of a parameterized job
+        (job_endpoint.go Dispatch): validate meta keys against the
+        parameterized config, derive a child job carrying the payload,
+        register it and create its eval.  Returns
+        (index, dispatched_job_id, eval_id)."""
+        parent = self.state.job_by_id(None, job_id)
+        if parent is None:
+            raise KeyError(f"job not found: {job_id}")
+        if not parent.is_parameterized():
+            raise ValueError(f"job {job_id!r} is not parameterized")
+        cfg = parent.parameterized_job
+        if cfg.payload == "required" and not payload:
+            raise ValueError("payload is required by this parameterized job")
+        if cfg.payload == "forbidden" and payload:
+            raise ValueError("payload is forbidden by this parameterized job")
+        if len(payload) > 16 * 1024:
+            raise ValueError("payload exceeds maximum size of 16KiB")
+        keys = set(meta)
+        required = set(cfg.meta_required)
+        allowed = required | set(cfg.meta_optional)
+        if required - keys:
+            raise ValueError(
+                "missing required dispatch metadata: "
+                + ", ".join(sorted(required - keys)))
+        if keys - allowed:
+            raise ValueError(
+                "dispatch metadata not allowed: "
+                + ", ".join(sorted(keys - allowed)))
+
+        child = parent.copy()
+        child.parent_id = parent.id
+        child.id = f"{parent.id}/dispatch-{int(s.now())}-{s.generate_uuid()[:8]}"
+        child.name = child.id
+        child.parameterized_job = None
+        child.payload = payload
+        child.meta = dict(parent.meta)
+        child.meta.update(meta)
+        child.status = s.JOB_STATUS_PENDING
+        _, index = self.raft.apply(MessageType.JOB_REGISTER, {"job": child})
+        ev = s.Evaluation(
+            id=s.generate_uuid(), priority=child.priority, type=child.type,
+            triggered_by=s.EVAL_TRIGGER_JOB_REGISTER, job_id=child.id,
+            job_modify_index=index, status=s.EVAL_STATUS_PENDING)
+        self.raft.apply(MessageType.EVAL_UPDATE, {"evals": [ev]})
+        return index, child.id, ev.id
+
+    def node_evaluate(self, node_id: str) -> List[str]:
+        """Force re-evaluation of all jobs with allocs on a node
+        (node_endpoint.go Evaluate)."""
+        node = self.state.node_by_id(None, node_id)
+        if node is None:
+            raise KeyError(f"node not found: {node_id}")
+        return self._create_node_evals(node_id, node.modify_index)
+
+    # -- status / operator -------------------------------------------------
+
+    def leader_address(self) -> str:
+        return self.config.rpc_advertise if self.is_leader() else ""
+
+    def peer_addresses(self) -> List[str]:
+        return [self.config.rpc_advertise]
+
+    def raft_configuration(self) -> Dict:
+        return {
+            "Servers": [{
+                "ID": self.config.node_name,
+                "Node": self.config.node_name,
+                "Address": self.config.rpc_advertise,
+                "Leader": self.is_leader(),
+                "Voter": True,
+            }],
+            "Index": self.raft.applied_index(),
+        }
 
     # -- Node --------------------------------------------------------------
 

@@ -101,6 +101,12 @@ class WatchSet:
             for st, _, _ in self._entries:
                 st._unregister_watcher(self._event)
 
+    def close(self) -> None:
+        """Unregister without blocking (for queries that returned
+        immediately and will never wait)."""
+        for st, _, _ in self._entries:
+            st._unregister_watcher(self._event)
+
 
 class StateStore:
     """The authoritative in-memory database of cluster state."""

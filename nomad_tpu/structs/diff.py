@@ -77,15 +77,7 @@ class JobDiff:
 # the names the reference emits (and that annotate.go keys on).
 # ---------------------------------------------------------------------------
 
-_TOKEN_MAP = {
-    "id": "ID", "cpu": "CPU", "iops": "IOPS", "mb": "MB", "mbits": "MBits",
-    "url": "URL", "ttl": "TTL", "http": "HTTP", "tls": "TLS", "ip": "IP",
-    "uuid": "UUID", "gc": "GC", "ltarget": "LTarget", "rtarget": "RTarget",
-}
-
-
-def go_name(snake: str) -> str:
-    return "".join(_TOKEN_MAP.get(t, t.capitalize()) for t in snake.split("_"))
+from ..utils.names import go_name  # noqa: E402  (shared with the wire codec)
 
 
 # Struct-type -> ObjectDiff name, as the reference names them.

@@ -1,0 +1,20 @@
+"""snake_case <-> Go-style CamelCase name mapping.
+
+The reference's user-visible JSON (api/ package structs) and diff output use
+Go field names; our dataclasses use snake_case.  One mapping, used by both
+the wire codec and the job-diff renderer.
+"""
+
+from __future__ import annotations
+
+_TOKEN_MAP = {
+    "id": "ID", "cpu": "CPU", "iops": "IOPS", "mb": "MB", "mbits": "MBits",
+    "url": "URL", "ttl": "TTL", "http": "HTTP", "tls": "TLS", "ip": "IP",
+    "uuid": "UUID", "gc": "GC", "ltarget": "LTarget", "rtarget": "RTarget",
+    "tg": "TG", "dc": "DC", "rpc": "RPC", "tmpl": "Tmpl",
+}
+
+
+def go_name(snake: str) -> str:
+    """kill_timeout -> KillTimeout, memory_mb -> MemoryMB, job_id -> JobID."""
+    return "".join(_TOKEN_MAP.get(t, t.capitalize()) for t in snake.split("_"))

@@ -1,0 +1,324 @@
+"""Agent + HTTP API + SDK tests (reference: command/agent/*_endpoint_test.go,
+api/*_test.go against an in-process agent)."""
+
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent import Agent, AgentConfig, parse_config
+from nomad_tpu.api import APIError, NomadAPI, QueryOptions
+from nomad_tpu.structs import structs as s
+
+
+def wait_until(pred, timeout=15.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture(scope="module")
+def agent(tmp_path_factory):
+    cfg = AgentConfig.dev()
+    tmp = tmp_path_factory.mktemp("agent")
+    cfg.client.alloc_dir = str(tmp / "allocs")
+    cfg.client.state_dir = str(tmp / "state")
+    a = Agent(cfg)
+    a.start()
+    yield a
+    a.shutdown()
+
+
+@pytest.fixture(scope="module")
+def api(agent):
+    return NomadAPI(agent.http.address)
+
+
+def exec_job(count=1):
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = count
+    for t in tg.tasks:
+        t.driver = "mock_driver"
+        t.config = {"run_for": "20s"}
+        t.resources = s.Resources(cpu=20, memory_mb=16)
+        t.services = []
+    return job
+
+
+class TestJobEndpoints:
+    def test_register_list_info(self, api):
+        job = exec_job()
+        resp, meta = api.jobs.register(job)
+        assert resp["EvalID"]
+        assert meta.last_index > 0
+
+        jobs, meta = api.jobs.list()
+        assert any(j["ID"] == job.id for j in jobs)
+        assert meta.last_index > 0
+
+        info, _ = api.jobs.info(job.id)
+        assert info.id == job.id
+        assert info.task_groups[0].tasks[0].driver == "mock_driver"
+
+    def test_info_missing_404(self, api):
+        with pytest.raises(APIError) as ei:
+            api.jobs.info("does-not-exist")
+        assert ei.value.code == 404
+
+    def test_allocations_and_evaluations(self, api):
+        job = exec_job()
+        api.jobs.register(job)
+        assert wait_until(lambda: len(api.jobs.allocations(job.id)[0]) == 1)
+        allocs, _ = api.jobs.allocations(job.id)
+        assert allocs[0]["JobID"] == job.id
+        evals, _ = api.jobs.evaluations(job.id)
+        assert evals[0]["JobID" if isinstance(evals[0], dict) else "job_id"] \
+            == job.id if isinstance(evals[0], dict) else True
+
+    def test_summary(self, api):
+        job = exec_job()
+        api.jobs.register(job)
+        assert wait_until(lambda: len(api.jobs.allocations(job.id)[0]) == 1)
+        summary, _ = api.jobs.summary(job.id)
+        assert summary.job_id == job.id
+        assert job.task_groups[0].name in summary.summary
+
+    def test_plan(self, api):
+        job = exec_job(count=2)
+        resp, _ = api.jobs.plan(job)
+        assert resp.diff is not None
+        assert resp.annotations is not None
+        tg = job.task_groups[0].name
+        assert resp.annotations.desired_tg_updates[tg].place == 2
+
+    def test_validate(self, api):
+        job = exec_job()
+        resp, _ = api.jobs.validate(job)
+        assert resp["ValidationErrors"] == []
+        bad = exec_job()
+        bad.task_groups[0].tasks[0].driver = ""
+        resp, _ = api.jobs.validate(bad)
+        assert resp["ValidationErrors"]
+
+    def test_deregister(self, api):
+        job = exec_job()
+        api.jobs.register(job)
+        resp, _ = api.jobs.deregister(job.id)
+        assert resp["EvalID"]
+        with pytest.raises(APIError) as ei:
+            api.jobs.info(job.id)
+        assert ei.value.code == 404
+
+    def test_evaluate(self, api):
+        job = exec_job()
+        api.jobs.register(job)
+        resp, _ = api.jobs.evaluate(job.id)
+        assert resp["EvalID"]
+
+    def test_dispatch_parameterized(self, api):
+        job = exec_job()
+        job.parameterized_job = s.ParameterizedJobConfig(
+            payload="required", meta_required=["who"])
+        api.jobs.register(job)
+        resp, _ = api.jobs.dispatch(job.id, payload=b"hello",
+                                    meta={"who": "world"})
+        child_id = resp["DispatchedJobID"]
+        assert child_id.startswith(job.id + "/dispatch-")
+        info, _ = api.jobs.info(child_id)
+        assert info.parent_id == job.id
+        assert info.meta["who"] == "world"
+
+        with pytest.raises(APIError) as ei:
+            api.jobs.dispatch(job.id, payload=b"x", meta={})
+        assert ei.value.code == 400  # missing required meta
+
+
+class TestNodeEndpoints:
+    def test_node_list_info(self, api, agent):
+        assert wait_until(lambda: len(api.nodes.list()[0]) >= 1)
+        nodes, meta = api.nodes.list()
+        node_id = nodes[0]["ID"]
+        assert meta.last_index > 0
+        node, _ = api.nodes.info(node_id)
+        assert node.id == node_id
+        assert node.status == s.NODE_STATUS_READY
+
+    def test_node_allocations(self, api):
+        nodes, _ = api.nodes.list()
+        node_id = nodes[0]["ID"]
+        allocs, _ = api.nodes.allocations(node_id)
+        assert isinstance(allocs, list)
+
+    def test_drain_and_evaluate(self, api, agent):
+        nodes, _ = api.nodes.list()
+        node_id = nodes[0]["ID"]
+        resp, _ = api.nodes.toggle_drain(node_id, True)
+        assert resp["NodeModifyIndex"] > 0
+        node, _ = api.nodes.info(node_id)
+        assert node.drain is True
+        api.nodes.toggle_drain(node_id, False)
+        resp, _ = api.nodes.force_evaluate(node_id)
+        assert "EvalIDs" in resp
+
+
+class TestAllocEvalEndpoints:
+    def test_alloc_info(self, api):
+        job = exec_job()
+        api.jobs.register(job)
+        assert wait_until(lambda: len(api.jobs.allocations(job.id)[0]) == 1)
+        stub = api.jobs.allocations(job.id)[0][0]
+        alloc, _ = api.allocations.info(stub["ID"])
+        assert alloc.id == stub["ID"]
+        assert alloc.job_id == job.id
+        allocs, _ = api.allocations.list()
+        assert any(a["ID"] == stub["ID"] for a in allocs)
+
+    def test_eval_info_and_allocs(self, api):
+        job = exec_job()
+        resp, _ = api.jobs.register(job)
+        eval_id = resp["EvalID"]
+        ev, _ = api.evaluations.info(eval_id)
+        assert ev.id == eval_id
+        assert wait_until(
+            lambda: len(api.evaluations.allocations(eval_id)[0]) == 1)
+        evals, _ = api.evaluations.list()
+        assert any(e.id == eval_id for e in evals)
+
+
+class TestBlockingQueries:
+    def test_job_list_blocks_until_change(self, api):
+        _, meta = api.jobs.list()
+        index = meta.last_index
+        results = {}
+
+        def poll():
+            jobs, m = api.jobs.list(QueryOptions(wait_index=index,
+                                                 wait_time=10.0))
+            results["index"] = m.last_index
+
+        t = threading.Thread(target=poll)
+        t.start()
+        time.sleep(0.3)
+        assert t.is_alive()  # long-poll is holding
+        api.jobs.register(exec_job())
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert results["index"] > index
+
+    def test_wait_timeout_returns(self, api):
+        _, meta = api.jobs.list()
+        start = time.monotonic()
+        _, m2 = api.jobs.list(QueryOptions(wait_index=meta.last_index + 1000,
+                                           wait_time=1.0))
+        elapsed = time.monotonic() - start
+        assert 0.9 <= elapsed < 5.0
+
+
+class TestClientEndpoints:
+    def test_client_stats(self, api):
+        stats = api.agent.client_stats()
+        assert "node_id" in stats
+
+    def test_fs_and_logs(self, api):
+        job = exec_job()
+        # mock driver writes stdout messages
+        job.task_groups[0].tasks[0].config = {
+            "run_for": "20s", "stdout_string": "hello from task\n"}
+        api.jobs.register(job)
+        assert wait_until(lambda: len(api.jobs.allocations(job.id)[0]) == 1)
+        alloc_id = api.jobs.allocations(job.id)[0][0]["ID"]
+        assert wait_until(lambda: api.jobs.allocations(job.id)[0][0]
+                          ["ClientStatus"] in ("running", "complete"))
+        ls = api.agent.fs_list(alloc_id, "/")
+        assert isinstance(ls, list)
+        stats = api.agent.alloc_stats(alloc_id)
+        assert "ResourceUsage" in stats
+
+    def test_fs_unknown_alloc_404(self, api):
+        with pytest.raises(APIError) as ei:
+            api.agent.fs_list("00000000-0000-0000-0000-000000000000")
+        assert ei.value.code == 404
+
+
+class TestAgentSystemEndpoints:
+    def test_agent_self(self, api):
+        info = api.agent.self_info()
+        assert info["config"]["Server"]["Enabled"] is True
+        assert info["config"]["Client"]["Enabled"] is True
+        assert info["stats"]["nomad"]
+
+    def test_members(self, api):
+        members = api.agent.members()
+        assert len(members["Members"]) == 1
+        assert members["Members"][0]["Status"] == "alive"
+
+    def test_status(self, api):
+        assert api.status.leader()
+        assert len(api.status.peers()) == 1
+
+    def test_regions(self, api):
+        obj, _ = api.get("/v1/regions") if hasattr(api, "get") else (None, None)
+        obj, _ = api._do("GET", "/v1/regions")
+        assert obj == ["global"]
+
+    def test_operator_raft_configuration(self, api):
+        conf = api.operator.raft_get_configuration()
+        assert conf["Servers"][0]["Leader"] is True
+
+    def test_system_gc(self, api):
+        api.system.garbage_collect()
+        api.system.reconcile_summaries()
+
+    def test_unknown_url_404(self, api):
+        with pytest.raises(APIError) as ei:
+            api._do("GET", "/v1/bogus")
+        assert ei.value.code == 404
+
+    def test_method_not_allowed(self, api):
+        with pytest.raises(APIError) as ei:
+            api._do("DELETE", "/v1/nodes")
+        assert ei.value.code == 405
+
+
+class TestAgentConfigParse:
+    def test_hcl_config(self):
+        cfg = parse_config('''
+region     = "euw"
+datacenter = "dc7"
+data_dir   = "/tmp/nomad"
+ports {
+  http = 5646
+}
+server {
+  enabled        = true
+  num_schedulers = 4
+}
+client {
+  enabled = true
+  servers = ["1.2.3.4:4647"]
+  meta {
+    rack = "r1"
+  }
+}
+''')
+        assert cfg.region == "euw"
+        assert cfg.datacenter == "dc7"
+        assert cfg.ports.http == 5646
+        assert cfg.server.enabled is True
+        assert cfg.server.num_schedulers == 4
+        assert cfg.client.enabled is True
+        assert cfg.client.servers == ["1.2.3.4:4647"]
+        assert cfg.client.meta == {"rack": "r1"}
+
+    def test_json_config(self):
+        cfg = parse_config(
+            '{"region": "ap", "ports": {"http": 7777},'
+            ' "server": {"enabled": true}}')
+        assert cfg.region == "ap"
+        assert cfg.ports.http == 7777
+        assert cfg.server.enabled is True
