@@ -99,6 +99,10 @@ class NomadAPI:
                 return obj, meta
         except urllib.error.HTTPError as e:
             raise APIError(e.code, e.read().decode("utf-8", "replace")) from e
+        except urllib.error.URLError as e:
+            # connection-level failure (agent down, bad address)
+            raise APIError(0, f"failed to reach agent at "
+                              f"{self.address}: {e.reason}") from e
 
     def get(self, path: str, q: Optional[QueryOptions] = None):
         return self._do("GET", path, None, q)
@@ -272,6 +276,11 @@ class AgentAPI:
         obj, _ = self.c.get(f"/v1/client/fs/cat/{alloc_id}",
                             QueryOptions(params={"path": path}))
         return obj or ""
+
+    def fs_stat(self, alloc_id: str, path: str) -> dict:
+        obj, _ = self.c.get(f"/v1/client/fs/stat/{alloc_id}",
+                            QueryOptions(params={"path": path}))
+        return obj or {}
 
 
 class System:

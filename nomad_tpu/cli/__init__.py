@@ -1,0 +1,5 @@
+"""CLI (reference: command/ package + main.go)."""
+
+from .commands import build_parser, main
+
+__all__ = ["build_parser", "main"]

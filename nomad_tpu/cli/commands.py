@@ -1,0 +1,816 @@
+"""CLI commands (reference: command/ package — one file per verb,
+registered in commands.go:13; entry at main.go:15).
+
+Every command talks to an agent over the HTTP API via the SDK, exactly like
+the reference CLI does, so the CLI works against any running agent.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+from typing import List, Optional
+
+from .. import __version__
+from ..api import APIError, NomadAPI, QueryOptions
+from ..api.codec import to_wire
+from ..jobspec import ParseError, parse_file
+from ..structs import structs as s
+from .output import format_kv, format_list, format_time, limit
+
+
+class CLIError(Exception):
+    pass
+
+
+def _api(args) -> NomadAPI:
+    addr = args.address or os.environ.get("NOMAD_ADDR", "http://127.0.0.1:4646")
+    return NomadAPI(addr, region=getattr(args, "region", "") or "")
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("-address", default="", help="HTTP address of the agent")
+    p.add_argument("-region", default="", help="region to forward to")
+
+
+# ---------------------------------------------------------------------------
+# eval monitor (command/monitor.go)
+# ---------------------------------------------------------------------------
+
+
+def monitor_eval(api: NomadAPI, eval_id: str, out, detach: bool = False,
+                 timeout: float = 120.0) -> int:
+    if detach:
+        out.write(f"Evaluation ID: {eval_id}\n")
+        return 0
+    out.write(f'==> Monitoring evaluation "{limit(eval_id)}"\n')
+    seen_allocs = set()
+    last_status = ""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            ev, _ = api.evaluations.info(eval_id)
+        except APIError:
+            time.sleep(0.2)
+            continue
+        if ev.status != last_status:
+            if last_status:
+                out.write(f'    Evaluation status changed: '
+                          f'"{last_status}" -> "{ev.status}"\n')
+            else:
+                out.write(f'    Evaluation triggered by job "{ev.job_id}"\n')
+            last_status = ev.status
+        allocs, _ = api.evaluations.allocations(eval_id)
+        for a in allocs:
+            if a["ID"] not in seen_allocs:
+                seen_allocs.add(a["ID"])
+                out.write(f'    Allocation "{limit(a["ID"])}" created: '
+                          f'node "{limit(a["NodeID"])}", '
+                          f'group "{a["TaskGroup"]}"\n')
+        if ev.status in (s.EVAL_STATUS_COMPLETE, s.EVAL_STATUS_FAILED,
+                         s.EVAL_STATUS_CANCELLED):
+            _print_placement_failures(ev, out)
+            out.write(f'==> Evaluation "{limit(eval_id)}" finished '
+                      f'with status "{ev.status}"\n')
+            if ev.status == s.EVAL_STATUS_COMPLETE and ev.blocked_eval:
+                out.write(f'    Evaluation "{limit(ev.blocked_eval)}" '
+                          f'waiting for additional capacity to place '
+                          f'remainder\n')
+            return 0 if ev.status == s.EVAL_STATUS_COMPLETE else 2
+        time.sleep(0.2)
+    out.write("==> Monitor timed out\n")
+    return 1
+
+
+def _print_placement_failures(ev: s.Evaluation, out,
+                              indent: str = "    ") -> None:
+    for tg, metric in (ev.failed_tg_allocs or {}).items():
+        out.write(f'{indent}Task Group "{tg}" '
+                  f'(failed to place an allocation):\n')
+        for line in format_alloc_metrics(metric, prefix=indent + "  "):
+            out.write(line + "\n")
+
+
+def format_alloc_metrics(m: s.AllocMetric, prefix: str = "") -> List[str]:
+    """command/monitor.go:formatAllocMetrics."""
+    out: List[str] = []
+    if m.nodes_evaluated == 0:
+        out.append(f"{prefix}* No nodes were eligible for evaluation")
+    for dc, available in sorted((m.nodes_available or {}).items()):
+        if available == 0:
+            out.append(f'{prefix}* No nodes are available in datacenter "{dc}"')
+    for cls, n in sorted((m.class_filtered or {}).items()):
+        out.append(f'{prefix}* Class "{cls}" filtered {n} nodes')
+    for cons, n in sorted((m.constraint_filtered or {}).items()):
+        out.append(f'{prefix}* Constraint "{cons}" filtered {n} nodes')
+    if m.nodes_exhausted > 0:
+        out.append(f"{prefix}* Resources exhausted on {m.nodes_exhausted} nodes")
+    for cls, n in sorted((m.class_exhausted or {}).items()):
+        out.append(f'{prefix}* Class "{cls}" exhausted on {n} nodes')
+    for dim, n in sorted((m.dimension_exhausted or {}).items()):
+        out.append(f'{prefix}* Dimension "{dim}" exhausted on {n} nodes')
+    if m.scores:
+        for name, score in sorted(m.scores.items()):
+            out.append(f'{prefix}* Score "{name}" = {score:f}')
+    return out
+
+
+# ---------------------------------------------------------------------------
+# commands
+# ---------------------------------------------------------------------------
+
+
+def cmd_run(args, out) -> int:
+    """command/run.go."""
+    try:
+        job = parse_file(args.jobfile)
+    except (ParseError, OSError) as e:
+        out.write(f"Error parsing job file: {e}\n")
+        return 1
+    api = _api(args)
+    if args.output:
+        out.write(json.dumps({"Job": to_wire(job)}, indent=2) + "\n")
+        return 0
+    try:
+        resp, _ = api.jobs.register(job)
+    except APIError as e:
+        out.write(f"Error submitting job: {e}\n")
+        return 1
+    eval_id = resp.get("EvalID", "")
+    if not eval_id:  # periodic/parameterized: no eval created
+        out.write(f'Job registration successful\n')
+        if job.is_periodic():
+            nxt = job.periodic.next(s.now())
+            out.write(f"Approximate next launch time: {format_time(nxt)}\n")
+        return 0
+    return monitor_eval(api, eval_id, out, detach=args.detach)
+
+
+def cmd_plan(args, out) -> int:
+    """command/plan.go."""
+    try:
+        job = parse_file(args.jobfile)
+    except (ParseError, OSError) as e:
+        out.write(f"Error parsing job file: {e}\n")
+        return 1
+    api = _api(args)
+    try:
+        resp, _ = api.jobs.plan(job, diff=not args.no_diff)
+    except APIError as e:
+        out.write(f"Error during plan: {e}\n")
+        return 255
+    if resp.diff is not None:
+        _print_job_diff(resp.diff, out, args.verbose)
+    out.write("\n")
+    changes = False
+    for tg, du in sorted((resp.annotations.desired_tg_updates or {}).items()
+                         if resp.annotations else []):
+        parts = []
+        for label, n in (("create", du.place), ("destroy", du.stop),
+                         ("migrate", du.migrate),
+                         ("in-place update", du.in_place_update),
+                         ("create/destroy update", du.destructive_update),
+                         ("ignore", du.ignore)):
+            if n:
+                parts.append(f"{n} {label}")
+        if parts:
+            out.write(f'Task Group "{tg}" ({", ".join(parts)})\n')
+            if du.place or du.stop or du.migrate or du.destructive_update:
+                changes = True
+    if resp.failed_tg_allocs:
+        out.write("\nPlacement failures:\n")
+        for tg, metric in resp.failed_tg_allocs.items():
+            out.write(f'  Task Group "{tg}":\n')
+            for line in format_alloc_metrics(metric, prefix="    "):
+                out.write(line + "\n")
+    if resp.next_periodic_launch:
+        out.write("Approximate next launch time: "
+                  f"{format_time(resp.next_periodic_launch)}\n")
+    out.write(f"\nJob Modify Index: {resp.job_modify_index}\n")
+    return 1 if changes else 0
+
+
+_DIFF_MARK = {"Added": "+", "Deleted": "-", "Edited": "+/-", "None": ""}
+
+
+def _print_field_diffs(fields, out, indent: str, verbose: bool) -> None:
+    for f in fields:
+        if f.type == "None" and not verbose:
+            continue
+        ann = f" ({', '.join(f.annotations)})" if f.annotations else ""
+        out.write(f"{indent}{_DIFF_MARK.get(f.type, '')} {f.name}: "
+                  f"{f.old!r} => {f.new!r}{ann}\n")
+
+
+def _print_object_diffs(objects, out, indent: str, verbose: bool) -> None:
+    for o in objects:
+        if o.type == "None" and not verbose:
+            continue
+        out.write(f"{indent}{_DIFF_MARK.get(o.type, '')} {o.name}\n")
+        _print_field_diffs(o.fields, out, indent + "  ", verbose)
+        _print_object_diffs(o.objects, out, indent + "  ", verbose)
+
+
+def _print_job_diff(diff, out, verbose: bool) -> None:
+    mark = _DIFF_MARK.get(diff.type, "")
+    out.write(f"{mark} Job: {diff.id!r}\n".lstrip())
+    _print_field_diffs(diff.fields, out, "  ", verbose)
+    _print_object_diffs(diff.objects, out, "  ", verbose)
+    for tg in diff.task_groups:
+        if tg.type == "None" and not verbose:
+            continue
+        counts = ", ".join(f"{n} {k}" for k, n in sorted(
+            (tg.updates or {}).items()))
+        suffix = f" ({counts})" if counts else ""
+        out.write(f"{_DIFF_MARK.get(tg.type, '')} Task Group: "
+                  f"{tg.name!r}{suffix}\n")
+        _print_field_diffs(tg.fields, out, "    ", verbose)
+        _print_object_diffs(tg.objects, out, "    ", verbose)
+        for t in tg.tasks:
+            if t.type == "None" and not verbose:
+                continue
+            ann = f" ({', '.join(t.annotations)})" if t.annotations else ""
+            out.write(f"  {_DIFF_MARK.get(t.type, '')} Task: "
+                      f"{t.name!r}{ann}\n")
+            _print_field_diffs(t.fields, out, "      ", verbose)
+            _print_object_diffs(t.objects, out, "      ", verbose)
+
+
+def cmd_validate(args, out) -> int:
+    """command/validate.go."""
+    try:
+        job = parse_file(args.jobfile)
+    except (ParseError, OSError) as e:
+        out.write(f"Error parsing job file: {e}\n")
+        return 1
+    job.canonicalize()
+    problems = job.validate()
+    if problems:
+        out.write("Job validation errors:\n")
+        for p in problems:
+            out.write(f"  * {p}\n")
+        return 1
+    out.write("Job validation successful\n")
+    return 0
+
+
+def cmd_stop(args, out) -> int:
+    """command/stop.go."""
+    api = _api(args)
+    try:
+        jobs, _ = api.jobs.list(QueryOptions(prefix=args.job_id))
+    except APIError as e:
+        out.write(f"Error deregistering job: {e}\n")
+        return 1
+    matches = [j for j in jobs if j["ID"] == args.job_id] or jobs
+    if not matches:
+        out.write(f'No job(s) with prefix or id "{args.job_id}" found\n')
+        return 1
+    if len(matches) > 1:
+        out.write("Prefix matched multiple jobs:\n")
+        for j in matches:
+            out.write(f"  {j['ID']}\n")
+        return 1
+    try:
+        resp, _ = api.jobs.deregister(matches[0]["ID"])
+    except APIError as e:
+        out.write(f"Error deregistering job: {e}\n")
+        return 1
+    eval_id = resp.get("EvalID", "")
+    if not eval_id:
+        return 0
+    return monitor_eval(api, eval_id, out, detach=args.detach)
+
+
+def cmd_status(args, out) -> int:
+    """command/status.go."""
+    api = _api(args)
+    if not args.job_id:
+        jobs, _ = api.jobs.list()
+        if not jobs:
+            out.write("No running jobs\n")
+            return 0
+        rows = ["ID|Type|Priority|Status"]
+        for j in sorted(jobs, key=lambda x: x["ID"]):
+            rows.append(f"{j['ID']}|{j['Type']}|{j['Priority']}|{j['Status']}")
+        out.write(format_list(rows) + "\n")
+        return 0
+    try:
+        job, _ = api.jobs.info(args.job_id)
+    except APIError:
+        jobs, _ = api.jobs.list(QueryOptions(prefix=args.job_id))
+        if len(jobs) == 1:
+            job, _ = api.jobs.info(jobs[0]["ID"])
+        elif len(jobs) > 1:
+            out.write("Prefix matched multiple jobs:\n")
+            for j in jobs:
+                out.write(f"  {j['ID']}\n")
+            return 1
+        else:
+            out.write(f'No job(s) with prefix or id "{args.job_id}" found\n')
+            return 1
+    periodic = job.is_periodic()
+    kv = [
+        f"ID|{job.id}", f"Name|{job.name}", f"Type|{job.type}",
+        f"Priority|{job.priority}",
+        f"Datacenters|{','.join(job.datacenters)}",
+        f"Status|{job.status}", f"Periodic|{str(periodic).lower()}",
+        f"Parameterized|{str(job.is_parameterized()).lower()}",
+    ]
+    out.write(format_kv(kv) + "\n")
+    try:
+        summary, _ = api.jobs.summary(job.id)
+    except APIError:
+        summary = None
+    if summary is not None and not args.short:
+        out.write("\nSummary\n")
+        rows = ["Task Group|Queued|Starting|Running|Failed|Complete|Lost"]
+        for tg, tgs in sorted(summary.summary.items()):
+            rows.append(f"{tg}|{tgs.queued}|{tgs.starting}|{tgs.running}|"
+                        f"{tgs.failed}|{tgs.complete}|{tgs.lost}")
+        out.write(format_list(rows) + "\n")
+    if not args.short:
+        allocs, _ = api.jobs.allocations(job.id)
+        out.write("\nAllocations\n")
+        if allocs:
+            rows = ["ID|Eval ID|Node ID|Task Group|Desired|Status|Created At"]
+            for a in allocs:
+                rows.append(
+                    f"{limit(a['ID'])}|{limit(a['EvalID'])}|"
+                    f"{limit(a['NodeID'])}|{a['TaskGroup']}|"
+                    f"{a['DesiredStatus']}|{a['ClientStatus']}|"
+                    f"{format_time(a.get('CreateTime') or 0)}")
+            out.write(format_list(rows) + "\n")
+        else:
+            out.write("No allocations placed\n")
+    return 0
+
+
+def cmd_inspect(args, out) -> int:
+    """command/inspect.go."""
+    api = _api(args)
+    try:
+        job, _ = api.jobs.info(args.job_id)
+    except APIError as e:
+        out.write(f"Error inspecting job: {e}\n")
+        return 1
+    out.write(json.dumps({"Job": to_wire(job)}, indent=4, default=str) + "\n")
+    return 0
+
+
+def cmd_node_status(args, out) -> int:
+    """command/node_status.go."""
+    api = _api(args)
+    if not args.node_id:
+        nodes, _ = api.nodes.list()
+        if not nodes:
+            out.write("No nodes registered\n")
+            return 0
+        rows = ["ID|DC|Name|Class|Drain|Status"]
+        for n in sorted(nodes, key=lambda x: x["ID"]):
+            rows.append(
+                f"{limit(n['ID'])}|{n['Datacenter']}|{n['Name']}|"
+                f"{n['NodeClass']}|{str(n['Drain']).lower()}|{n['Status']}")
+        out.write(format_list(rows) + "\n")
+        return 0
+    nodes, _ = api.nodes.list(QueryOptions(prefix=args.node_id))
+    if not nodes:
+        out.write(f'No node(s) with prefix "{args.node_id}" found\n')
+        return 1
+    if len(nodes) > 1:
+        out.write("Prefix matched multiple nodes:\n")
+        for n in nodes:
+            out.write(f"  {n['ID']}\n")
+        return 1
+    node, _ = api.nodes.info(nodes[0]["ID"])
+    kv = [
+        f"ID|{node.id}", f"Name|{node.name}", f"Class|{node.node_class}",
+        f"DC|{node.datacenter}", f"Drain|{str(node.drain).lower()}",
+        f"Status|{node.status}",
+    ]
+    out.write(format_kv(kv) + "\n")
+    allocs, _ = api.nodes.allocations(node.id)
+    running = [a for a in allocs
+               if a.client_status == s.ALLOC_CLIENT_STATUS_RUNNING]
+    if node.resources is not None:
+        used = s.Resources()
+        for a in running:
+            if a.resources is not None:
+                used.add(a.resources)
+        out.write("\nAllocated Resources\n")
+        rows = ["CPU|Memory|Disk|IOPS",
+                f"{used.cpu}/{node.resources.cpu} MHz|"
+                f"{used.memory_mb}/{node.resources.memory_mb} MiB|"
+                f"{used.disk_mb}/{node.resources.disk_mb} MiB|"
+                f"{used.iops}/{node.resources.iops}"]
+        out.write(format_list(rows) + "\n")
+    if not args.short:
+        out.write("\nAllocations\n")
+        if allocs:
+            rows = ["ID|Eval ID|Job ID|Task Group|Desired|Status"]
+            for a in allocs:
+                rows.append(f"{limit(a.id)}|{limit(a.eval_id)}|{a.job_id}|"
+                            f"{a.task_group}|{a.desired_status}|"
+                            f"{a.client_status}")
+            out.write(format_list(rows) + "\n")
+        else:
+            out.write("No allocations placed\n")
+    return 0
+
+
+def cmd_node_drain(args, out) -> int:
+    """command/node_drain.go."""
+    if args.enable == args.disable:
+        out.write("Either the '-enable' or '-disable' flag must be set\n")
+        return 1
+    api = _api(args)
+    nodes, _ = api.nodes.list(QueryOptions(prefix=args.node_id))
+    if not nodes:
+        out.write(f'No node(s) with prefix "{args.node_id}" found\n')
+        return 1
+    if len(nodes) > 1:
+        out.write("Prefix matched multiple nodes:\n")
+        for n in nodes:
+            out.write(f"  {n['ID']}\n")
+        return 1
+    try:
+        api.nodes.toggle_drain(nodes[0]["ID"], args.enable)
+    except APIError as e:
+        out.write(f"Error toggling drain: {e}\n")
+        return 1
+    return 0
+
+
+def cmd_alloc_status(args, out) -> int:
+    """command/alloc_status.go."""
+    api = _api(args)
+    allocs, _ = api.allocations.list(QueryOptions(prefix=args.alloc_id))
+    if not allocs:
+        out.write(f'No allocation(s) with prefix or id '
+                  f'"{args.alloc_id}" found\n')
+        return 1
+    if len(allocs) > 1:
+        out.write("Prefix matched multiple allocations:\n")
+        for a in allocs:
+            out.write(f"  {a['ID']}\n")
+        return 1
+    alloc, _ = api.allocations.info(allocs[0]["ID"])
+    kv = [
+        f"ID|{alloc.id}", f"Eval ID|{limit(alloc.eval_id)}",
+        f"Name|{alloc.name}", f"Node ID|{limit(alloc.node_id)}",
+        f"Job ID|{alloc.job_id}", f"Client Status|{alloc.client_status}",
+        f"Desired Status|{alloc.desired_status}",
+    ]
+    out.write(format_kv(kv) + "\n")
+    for task, state in sorted((alloc.task_states or {}).items()):
+        out.write(f'\nTask "{task}" is "{state.state}"\n')
+        if state.events:
+            out.write("Recent Events:\n")
+            rows = ["Time|Type|Description"]
+            for e in state.events[-10:]:
+                rows.append(f"{format_time(e.time)}|{e.type}|"
+                            f"{e.display_message()}")
+            out.write(format_list(rows) + "\n")
+    if args.verbose and alloc.metrics is not None:
+        out.write("\nPlacement Metrics\n")
+        for line in format_alloc_metrics(alloc.metrics):
+            out.write(line + "\n")
+    return 0
+
+
+def cmd_eval_status(args, out) -> int:
+    """command/eval_status.go."""
+    api = _api(args)
+    evals, _ = api.evaluations.list(QueryOptions(prefix=args.eval_id))
+    if not evals:
+        out.write(f'No evaluation(s) with prefix or id '
+                  f'"{args.eval_id}" found\n')
+        return 1
+    if len(evals) > 1:
+        out.write("Prefix matched multiple evaluations:\n")
+        for e in evals:
+            out.write(f"  {e.id}\n")
+        return 1
+    ev = evals[0]
+    kv = [
+        f"ID|{ev.id}", f"Status|{ev.status}", f"Type|{ev.type}",
+        f"TriggeredBy|{ev.triggered_by}", f"Job ID|{ev.job_id}",
+        f"Priority|{ev.priority}",
+    ]
+    if ev.status_description:
+        kv.append(f"Status Description|{ev.status_description}")
+    out.write(format_kv(kv) + "\n")
+    if ev.failed_tg_allocs:
+        out.write("\nFailed Placements\n")
+        _print_placement_failures(ev, out, indent="")
+    return 0
+
+
+def cmd_logs(args, out) -> int:
+    """command/logs.go."""
+    api = _api(args)
+    allocs, _ = api.allocations.list(QueryOptions(prefix=args.alloc_id))
+    if len(allocs) != 1:
+        out.write(f'No single allocation with prefix "{args.alloc_id}"\n')
+        return 1
+    log_type = "stderr" if args.stderr else "stdout"
+    try:
+        text = api.agent.task_logs(allocs[0]["ID"], args.task, log_type)
+    except APIError as e:
+        out.write(f"Error reading logs: {e}\n")
+        return 1
+    out.write(text)
+    return 0
+
+
+def cmd_fs(args, out) -> int:
+    """command/fs.go."""
+    api = _api(args)
+    allocs, _ = api.allocations.list(QueryOptions(prefix=args.alloc_id))
+    if len(allocs) != 1:
+        out.write(f'No single allocation with prefix "{args.alloc_id}"\n')
+        return 1
+    alloc_id = allocs[0]["ID"]
+    path = args.path or "/"
+    try:
+        if args.stat:
+            st = api.agent.fs_stat(alloc_id, path)
+            out.write(json.dumps(st, indent=2) + "\n")
+        elif args.cat:
+            out.write(api.agent.fs_cat(alloc_id, path))
+        else:
+            entries = api.agent.fs_list(alloc_id, path)
+            rows = ["Name|Size|Dir|Mod Time"]
+            for e in entries:
+                rows.append(f"{e.get('Name', '')}|{e.get('Size', 0)}|"
+                            f"{str(bool(e.get('IsDir'))).lower()}|"
+                            f"{format_time(e.get('ModTime') or 0)}")
+            out.write(format_list(rows) + "\n")
+    except APIError as e:
+        out.write(f"Error: {e}\n")
+        return 1
+    return 0
+
+
+def cmd_server_members(args, out) -> int:
+    """command/server_members.go."""
+    api = _api(args)
+    members = api.agent.members().get("Members", [])
+    rows = ["Name|Address|Port|Status|Region|DC"]
+    for m in members:
+        tags = m.get("Tags", {})
+        rows.append(f"{m['Name']}|{m['Addr']}|{m['Port']}|{m['Status']}|"
+                    f"{tags.get('region', '')}|{tags.get('dc', '')}")
+    out.write(format_list(rows) + "\n")
+    return 0
+
+
+def cmd_agent_info(args, out) -> int:
+    """command/agent_info.go."""
+    api = _api(args)
+    info = api.agent.self_info()
+    for section, stats in sorted((info.get("stats") or {}).items()):
+        out.write(f"{section}\n")
+        for k, v in sorted(stats.items()):
+            out.write(f"  {k} = {v}\n")
+    return 0
+
+
+def cmd_job_dispatch(args, out) -> int:
+    """command/job_dispatch.go."""
+    api = _api(args)
+    payload = b""
+    if args.input_file:
+        if args.input_file == "-":
+            payload = sys.stdin.buffer.read()
+        else:
+            with open(args.input_file, "rb") as f:
+                payload = f.read()
+    meta = {}
+    for m in args.meta or []:
+        if "=" not in m:
+            out.write(f"Invalid meta '{m}': expected key=value\n")
+            return 1
+        k, v = m.split("=", 1)
+        meta[k] = v
+    try:
+        resp, _ = api.jobs.dispatch(args.job_id, payload=payload, meta=meta)
+    except APIError as e:
+        out.write(f"Error dispatching job: {e}\n")
+        return 1
+    out.write(f"Dispatched Job ID = {resp['DispatchedJobID']}\n")
+    out.write(f"Evaluation ID     = {limit(resp['EvalID'])}\n")
+    if args.detach:
+        return 0
+    return monitor_eval(api, resp["EvalID"], out)
+
+
+def cmd_init(args, out) -> int:
+    """command/init.go — write a starter example.nomad."""
+    path = "example.nomad"
+    if os.path.exists(path):
+        out.write(f"Job file '{path}' already exists\n")
+        return 1
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(EXAMPLE_JOB)
+    out.write(f"Example job file written to {path}\n")
+    return 0
+
+
+def cmd_version(args, out) -> int:
+    out.write(f"nomad-tpu v{__version__}\n")
+    return 0
+
+
+def cmd_operator_raft(args, out) -> int:
+    """command/operator_raft_list.go."""
+    api = _api(args)
+    conf = api.operator.raft_get_configuration()
+    rows = ["Node|ID|Address|State|Voter"]
+    for srv in conf.get("Servers", []):
+        state = "leader" if srv.get("Leader") else "follower"
+        rows.append(f"{srv['Node']}|{srv['ID']}|{srv['Address']}|{state}|"
+                    f"{str(srv.get('Voter', False)).lower()}")
+    out.write(format_list(rows) + "\n")
+    return 0
+
+
+def cmd_agent(args, out) -> int:
+    """command/agent/command.go — run an agent until signalled."""
+    from ..agent import Agent, AgentConfig, load_config_file
+
+    if args.dev:
+        cfg = AgentConfig.dev()
+    elif args.config:
+        cfg = load_config_file(args.config)
+    else:
+        out.write("Must specify either -dev or -config\n")
+        return 1
+    if args.server:
+        cfg.server.enabled = True
+    if args.client:
+        cfg.client.enabled = True
+    if args.bind:
+        cfg.bind_addr = args.bind
+
+    agent = Agent(cfg)
+    agent.start()
+    out.write("==> Nomad-TPU agent started! Log data will stream below:\n")
+    out.write(f"    HTTP: {agent.http.address}\n")
+    stop = [False]
+
+    def handler(signum, frame):
+        stop[0] = True
+
+    signal.signal(signal.SIGINT, handler)
+    signal.signal(signal.SIGTERM, handler)
+    try:
+        while not stop[0]:
+            time.sleep(0.2)
+    finally:
+        out.write("==> Caught signal, gracefully shutting down...\n")
+        agent.shutdown()
+    return 0
+
+
+EXAMPLE_JOB = '''# There can only be a single job definition per file.
+job "example" {
+  datacenters = ["dc1"]
+  type        = "service"
+
+  update {
+    stagger      = "10s"
+    max_parallel = 1
+  }
+
+  group "cache" {
+    count = 1
+
+    restart {
+      attempts = 10
+      interval = "5m"
+      delay    = "25s"
+      mode     = "delay"
+    }
+
+    ephemeral_disk {
+      size = 300
+    }
+
+    task "redis" {
+      driver = "exec"
+
+      config {
+        command = "/bin/sh"
+        args    = ["-c", "while true; do echo tick; sleep 5; done"]
+      }
+
+      resources {
+        cpu    = 500
+        memory = 256
+
+        network {
+          mbits = 10
+          port "db" {}
+        }
+      }
+    }
+  }
+}
+'''
+
+
+# ---------------------------------------------------------------------------
+# parser / entry (main.go:15 + commands.go:13)
+# ---------------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="nomad-tpu", description="TPU-native cluster scheduler CLI")
+    sub = p.add_subparsers(dest="command")
+
+    def add(name, fn, configure=None):
+        sp = sub.add_parser(name)
+        _add_common(sp)
+        if configure:
+            configure(sp)
+        sp.set_defaults(fn=fn)
+        return sp
+
+    add("run", cmd_run, lambda sp: (
+        sp.add_argument("jobfile"),
+        sp.add_argument("-detach", action="store_true"),
+        sp.add_argument("-output", action="store_true")))
+    add("plan", cmd_plan, lambda sp: (
+        sp.add_argument("jobfile"),
+        sp.add_argument("-no-diff", dest="no_diff", action="store_true"),
+        sp.add_argument("-verbose", action="store_true")))
+    add("validate", cmd_validate, lambda sp: sp.add_argument("jobfile"))
+    add("stop", cmd_stop, lambda sp: (
+        sp.add_argument("job_id"),
+        sp.add_argument("-detach", action="store_true")))
+    add("status", cmd_status, lambda sp: (
+        sp.add_argument("job_id", nargs="?", default=""),
+        sp.add_argument("-short", action="store_true")))
+    add("inspect", cmd_inspect, lambda sp: sp.add_argument("job_id"))
+    add("node-status", cmd_node_status, lambda sp: (
+        sp.add_argument("node_id", nargs="?", default=""),
+        sp.add_argument("-short", action="store_true")))
+    add("node-drain", cmd_node_drain, lambda sp: (
+        sp.add_argument("node_id"),
+        sp.add_argument("-enable", action="store_true"),
+        sp.add_argument("-disable", action="store_true")))
+    add("alloc-status", cmd_alloc_status, lambda sp: (
+        sp.add_argument("alloc_id"),
+        sp.add_argument("-verbose", action="store_true")))
+    add("eval-status", cmd_eval_status, lambda sp: sp.add_argument("eval_id"))
+    add("logs", cmd_logs, lambda sp: (
+        sp.add_argument("alloc_id"),
+        sp.add_argument("task"),
+        sp.add_argument("-stderr", action="store_true")))
+    add("fs", cmd_fs, lambda sp: (
+        sp.add_argument("alloc_id"),
+        sp.add_argument("path", nargs="?", default="/"),
+        sp.add_argument("-stat", action="store_true"),
+        sp.add_argument("-cat", action="store_true")))
+    add("server-members", cmd_server_members)
+    add("agent-info", cmd_agent_info)
+    add("job-dispatch", cmd_job_dispatch, lambda sp: (
+        sp.add_argument("job_id"),
+        sp.add_argument("input_file", nargs="?", default=""),
+        sp.add_argument("-meta", action="append"),
+        sp.add_argument("-detach", action="store_true")))
+    add("init", cmd_init)
+    add("version", cmd_version)
+    add("operator-raft-list", cmd_operator_raft)
+    add("agent", cmd_agent, lambda sp: (
+        sp.add_argument("-dev", action="store_true"),
+        sp.add_argument("-config", default=""),
+        sp.add_argument("-server", action="store_true"),
+        sp.add_argument("-client", action="store_true"),
+        sp.add_argument("-bind", default="")))
+    return p
+
+
+def main(argv: Optional[List[str]] = None, out=None) -> int:
+    out = out or sys.stdout
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if not getattr(args, "fn", None):
+        parser.print_help(out)
+        return 1
+    try:
+        return args.fn(args, out)
+    except CLIError as e:
+        out.write(f"Error: {e}\n")
+        return 1
+    except APIError as e:
+        # commands catch expected APIErrors themselves; this is the net for
+        # connection-level failures (agent down, bad -address)
+        out.write(f"Error querying agent: {e}\n")
+        return 1

@@ -16,60 +16,13 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 from . import structs as s
+from .structs import (DIFF_TYPE_ADDED, DIFF_TYPE_DELETED, DIFF_TYPE_EDITED,
+                      DIFF_TYPE_NONE, FieldDiff, JobDiff, ObjectDiff,
+                      TaskDiff, TaskGroupDiff)
 
-# Diff types, ordered for sorting (diff.go:14-45).
-DIFF_TYPE_NONE = "None"
-DIFF_TYPE_ADDED = "Added"
-DIFF_TYPE_DELETED = "Deleted"
-DIFF_TYPE_EDITED = "Edited"
-
+# Diff types ordered for sorting (diff.go:14-45).
 _TYPE_ORDER = {DIFF_TYPE_EDITED: 0, DIFF_TYPE_ADDED: 1,
                DIFF_TYPE_DELETED: 2, DIFF_TYPE_NONE: 3}
-
-
-@dataclass
-class FieldDiff:
-    type: str = DIFF_TYPE_NONE
-    name: str = ""
-    old: str = ""
-    new: str = ""
-    annotations: List[str] = field(default_factory=list)
-
-
-@dataclass
-class ObjectDiff:
-    type: str = DIFF_TYPE_NONE
-    name: str = ""
-    fields: List[FieldDiff] = field(default_factory=list)
-    objects: List["ObjectDiff"] = field(default_factory=list)
-
-
-@dataclass
-class TaskDiff:
-    type: str = DIFF_TYPE_NONE
-    name: str = ""
-    fields: List[FieldDiff] = field(default_factory=list)
-    objects: List[ObjectDiff] = field(default_factory=list)
-    annotations: List[str] = field(default_factory=list)
-
-
-@dataclass
-class TaskGroupDiff:
-    type: str = DIFF_TYPE_NONE
-    name: str = ""
-    fields: List[FieldDiff] = field(default_factory=list)
-    objects: List[ObjectDiff] = field(default_factory=list)
-    tasks: List[TaskDiff] = field(default_factory=list)
-    updates: Dict[str, int] = field(default_factory=dict)
-
-
-@dataclass
-class JobDiff:
-    type: str = DIFF_TYPE_NONE
-    id: str = ""
-    fields: List[FieldDiff] = field(default_factory=list)
-    objects: List[ObjectDiff] = field(default_factory=list)
-    task_groups: List[TaskGroupDiff] = field(default_factory=list)
 
 
 # ---------------------------------------------------------------------------

@@ -718,6 +718,27 @@ class TaskEvent:
     def copy(self) -> "TaskEvent":
         return dataclasses.replace(self)
 
+    def display_message(self) -> str:
+        """Human-readable one-liner for CLI/alloc-status (the reference CLI
+        formats events per type in command/alloc_status.go)."""
+        if self.message:
+            return self.message
+        if self.type == TASK_TERMINATED:
+            return f"Exit Code: {self.exit_code}"
+        if self.type == TASK_DRIVER_FAILURE and self.driver_error:
+            return self.driver_error
+        if self.type == TASK_KILLING and self.kill_timeout:
+            return f"Kill Timeout: {self.kill_timeout}s"
+        if self.type == TASK_RESTARTING:
+            parts = []
+            if self.restart_reason:
+                parts.append(self.restart_reason)
+            parts.append(f"Task restarting in {self.start_delay:.1f}s")
+            return " - ".join(parts)
+        if self.type == TASK_SIBLING_FAILED and self.failed_sibling:
+            return f"Sibling task {self.failed_sibling!r} failed"
+        return ""
+
 
 @dataclass
 class TaskState:
@@ -1112,6 +1133,61 @@ class DesiredUpdates:
     destructive_update: int = 0
 
 
+# ---------------------------------------------------------------------------
+# Job diff wire types (diff.go:14-200; the diff engine lives in diff.py)
+# ---------------------------------------------------------------------------
+
+DIFF_TYPE_NONE = "None"
+DIFF_TYPE_ADDED = "Added"
+DIFF_TYPE_DELETED = "Deleted"
+DIFF_TYPE_EDITED = "Edited"
+
+
+@dataclass
+class FieldDiff:
+    type: str = DIFF_TYPE_NONE
+    name: str = ""
+    old: str = ""
+    new: str = ""
+    annotations: List[str] = field(default_factory=list)
+
+
+@dataclass
+class ObjectDiff:
+    type: str = DIFF_TYPE_NONE
+    name: str = ""
+    fields: List[FieldDiff] = field(default_factory=list)
+    objects: List["ObjectDiff"] = field(default_factory=list)
+
+
+@dataclass
+class TaskDiff:
+    type: str = DIFF_TYPE_NONE
+    name: str = ""
+    fields: List[FieldDiff] = field(default_factory=list)
+    objects: List[ObjectDiff] = field(default_factory=list)
+    annotations: List[str] = field(default_factory=list)
+
+
+@dataclass
+class TaskGroupDiff:
+    type: str = DIFF_TYPE_NONE
+    name: str = ""
+    fields: List[FieldDiff] = field(default_factory=list)
+    objects: List[ObjectDiff] = field(default_factory=list)
+    tasks: List[TaskDiff] = field(default_factory=list)
+    updates: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class JobDiff:
+    type: str = DIFF_TYPE_NONE
+    id: str = ""
+    fields: List[FieldDiff] = field(default_factory=list)
+    objects: List[ObjectDiff] = field(default_factory=list)
+    task_groups: List[TaskGroupDiff] = field(default_factory=list)
+
+
 @dataclass
 class JobPlanResponse:
     """Dry-run result returned by Job.Plan (structs.go JobPlanResponse):
@@ -1121,7 +1197,7 @@ class JobPlanResponse:
     failed_tg_allocs: Dict[str, AllocMetric] = field(default_factory=dict)
     job_modify_index: int = 0
     created_evals: List["Evaluation"] = field(default_factory=list)
-    diff: Optional[object] = None  # structs.diff.JobDiff
+    diff: Optional[JobDiff] = None
     next_periodic_launch: float = 0.0
 
 

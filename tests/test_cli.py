@@ -1,0 +1,229 @@
+"""CLI command tests against a live dev agent
+(reference: command/*_test.go)."""
+
+import io
+import time
+
+import pytest
+
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.cli import main
+
+JOBFILE = '''
+job "cli-demo" {
+  datacenters = ["dc1"]
+
+  group "web" {
+    count = 2
+
+    task "srv" {
+      driver = "mock_driver"
+      config {
+        run_for = "60s"
+      }
+      resources {
+        cpu    = 20
+        memory = 16
+      }
+    }
+  }
+}
+'''
+
+
+def wait_until(pred, timeout=15.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture(scope="module")
+def agent(tmp_path_factory):
+    cfg = AgentConfig.dev()
+    tmp = tmp_path_factory.mktemp("cli-agent")
+    cfg.client.alloc_dir = str(tmp / "allocs")
+    cfg.client.state_dir = str(tmp / "state")
+    a = Agent(cfg)
+    a.start()
+    # wait for the client node to register + go ready before scheduling
+    assert wait_until(
+        lambda: any(n.status == "ready" for n in a.server.state.nodes(None)))
+    yield a
+    a.shutdown()
+
+
+@pytest.fixture(scope="module")
+def addr(agent):
+    return agent.http.address
+
+
+@pytest.fixture(scope="module")
+def jobfile(tmp_path_factory):
+    p = tmp_path_factory.mktemp("jobs") / "demo.nomad"
+    p.write_text(JOBFILE)
+    return str(p)
+
+
+def run_cli(args):
+    out = io.StringIO()
+    code = main(args, out=out)
+    return code, out.getvalue()
+
+
+class TestJobLifecycle:
+    def test_validate(self, addr, jobfile):
+        code, out = run_cli(["validate", "-address", addr, jobfile])
+        assert code == 0
+        assert "validation successful" in out
+
+    def test_plan_new_job(self, addr, jobfile):
+        code, out = run_cli(["plan", "-address", addr, jobfile])
+        assert code == 1  # changes present -> exit 1 like the reference
+        assert "Job: 'cli-demo'" in out or "Job: \"cli-demo\"" in out.replace(
+            "'", '"')
+        assert "2 create" in out
+        assert "Job Modify Index: 0" in out
+
+    def test_run_and_monitor(self, addr, jobfile):
+        code, out = run_cli(["run", "-address", addr, jobfile])
+        assert code == 0, out
+        assert "Monitoring evaluation" in out
+        assert 'finished with status "complete"' in out
+        assert out.count("Allocation") >= 2
+
+    def test_status_list_and_detail(self, addr):
+        code, out = run_cli(["status", "-address", addr])
+        assert code == 0
+        assert "cli-demo" in out
+
+        code, out = run_cli(["status", "-address", addr, "cli-demo"])
+        assert code == 0
+        assert "ID" in out and "cli-demo" in out
+        assert "Summary" in out
+        assert "Allocations" in out
+
+    def test_inspect(self, addr):
+        code, out = run_cli(["inspect", "-address", addr, "cli-demo"])
+        assert code == 0
+        assert '"ID": "cli-demo"' in out
+
+    def test_alloc_and_eval_status(self, addr):
+        from nomad_tpu.api import NomadAPI
+        api = NomadAPI(addr)
+        allocs, _ = api.jobs.allocations("cli-demo")
+        assert allocs
+        alloc_id = allocs[0]["ID"]
+        code, out = run_cli(["alloc-status", "-address", addr, alloc_id])
+        assert code == 0
+        assert alloc_id in out
+        assert "cli-demo" in out
+
+        eval_id = allocs[0]["EvalID"]
+        code, out = run_cli(["eval-status", "-address", addr, eval_id])
+        assert code == 0
+        assert "complete" in out
+
+    def test_plan_after_run_no_changes_exit0(self, addr, jobfile):
+        # Re-planning an unchanged job bumps JobModifyIndex in the plan
+        # snapshot, so existing allocs surface as in-place updates — the
+        # reference behaves identically (diffAllocs JobModifyIndex check).
+        code, out = run_cli(["plan", "-address", addr, jobfile])
+        assert code == 0
+        assert "2 in-place update" in out
+
+    def test_stop(self, addr, jobfile):
+        code, out = run_cli(["stop", "-address", addr, "-detach", "cli-demo"])
+        assert code == 0
+        code, out = run_cli(["status", "-address", addr, "cli-demo"])
+        assert code == 1
+        assert "No job(s)" in out
+
+
+class TestNodeCommands:
+    def test_node_status_list(self, addr):
+        code, out = run_cli(["node-status", "-address", addr])
+        assert code == 0
+        assert "ready" in out
+
+    def test_node_status_detail(self, addr):
+        from nomad_tpu.api import NomadAPI
+        nodes, _ = NomadAPI(addr).nodes.list()
+        node_id = nodes[0]["ID"]
+        code, out = run_cli(["node-status", "-address", addr, node_id[:8]])
+        assert code == 0
+        assert "Allocated Resources" in out
+
+    def test_node_drain_requires_flag(self, addr):
+        from nomad_tpu.api import NomadAPI
+        nodes, _ = NomadAPI(addr).nodes.list()
+        node_id = nodes[0]["ID"]
+        code, out = run_cli(["node-drain", "-address", addr, node_id])
+        assert code == 1
+        code, out = run_cli(
+            ["node-drain", "-address", addr, "-enable", node_id])
+        assert code == 0
+        code, out = run_cli(
+            ["node-drain", "-address", addr, "-disable", node_id])
+        assert code == 0
+
+
+class TestMiscCommands:
+    def test_server_members(self, addr):
+        code, out = run_cli(["server-members", "-address", addr])
+        assert code == 0
+        assert "alive" in out
+
+    def test_agent_info(self, addr):
+        code, out = run_cli(["agent-info", "-address", addr])
+        assert code == 0
+        assert "nomad" in out
+
+    def test_operator_raft_list(self, addr):
+        code, out = run_cli(["operator-raft-list", "-address", addr])
+        assert code == 0
+        assert "leader" in out
+
+    def test_version(self):
+        code, out = run_cli(["version"])
+        assert code == 0
+        assert "nomad-tpu v" in out
+
+    def test_init(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        code, out = run_cli(["init"])
+        assert code == 0
+        assert (tmp_path / "example.nomad").exists()
+        # the generated file must itself parse
+        from nomad_tpu.jobspec import parse_file
+        job = parse_file(str(tmp_path / "example.nomad"))
+        assert job.id == "example"
+        code, out = run_cli(["init"])
+        assert code == 1  # already exists
+
+    def test_dispatch(self, addr, tmp_path):
+        from nomad_tpu import mock
+        from nomad_tpu.api import NomadAPI
+        from nomad_tpu.structs import structs as s
+        api = NomadAPI(addr)
+        job = mock.job()
+        job.parameterized_job = s.ParameterizedJobConfig(payload="optional")
+        for t in job.task_groups[0].tasks:
+            t.driver = "mock_driver"
+            t.config = {"run_for": "5s"}
+            t.resources = s.Resources(cpu=20, memory_mb=16)
+            t.services = []
+        api.jobs.register(job)
+        pfile = tmp_path / "payload.txt"
+        pfile.write_text("hello")
+        code, out = run_cli(["job-dispatch", "-address", addr, "-detach",
+                             job.id, str(pfile)])
+        assert code == 0
+        assert "Dispatched Job ID" in out
+
+    def test_no_command_prints_help(self):
+        code, out = run_cli([])
+        assert code == 1
+        assert "usage" in out.lower()
