@@ -1,0 +1,134 @@
+"""RPC endpoint registry: maps wire method names onto Server methods.
+
+Reference behavior: the endpoint structs registered at nomad/server.go:163-174
+(Status, Node, Job, Eval, Plan, Region, Periodic, System, Operator) with
+request forwarding to the leader handled inside each endpoint
+(nomad/rpc.go:178 forward).  Here the Server methods already forward when
+not leader, so handlers just decode the wire body, call, and encode.
+
+Also carries the serf-lite membership channel (Serf.Join / Serf.Members —
+reference: nomad/serf.go gossip events) since membership rides the same
+RPC port in this build.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from ..api.codec import from_wire, to_wire
+from ..structs import structs as s
+
+
+def register_endpoints(server, rpc) -> None:
+    """Attach all wire methods for ``server`` onto RPCServer ``rpc``."""
+
+    # -- Status ------------------------------------------------------------
+
+    def status_ping(body):
+        return {"ok": True}
+
+    def status_leader(body):
+        return server.leader_address()
+
+    def status_peers(body):
+        return server.peer_addresses()
+
+    rpc.register("Status.Ping", status_ping)
+    rpc.register("Status.Leader", status_leader)
+    rpc.register("Status.Peers", status_peers)
+
+    # -- Serf-lite membership ---------------------------------------------
+
+    def serf_join(body):
+        return server.membership_join(body["Member"])
+
+    def serf_members(body):
+        return {"Members": server.members()}
+
+    rpc.register("Serf.Join", serf_join)
+    rpc.register("Serf.Members", serf_members)
+
+    # -- Node (client agent surface) --------------------------------------
+
+    def node_register(body):
+        node = from_wire(s.Node, body["Node"])
+        index, ttl = server.node_register(node)
+        return {"Index": index, "HeartbeatTTL": ttl}
+
+    def node_update_status(body):
+        index, ttl = server.node_update_status(body["NodeID"], body["Status"])
+        return {"Index": index, "HeartbeatTTL": ttl}
+
+    def node_get_client_allocs(body):
+        allocs, index = server.node_get_client_allocs(
+            body["NodeID"], body.get("MinQueryIndex", 0),
+            body.get("MaxQueryTime", 30.0))
+        return {"Allocs": [to_wire(a) for a in allocs], "Index": index}
+
+    def node_update_alloc(body):
+        allocs = [from_wire(s.Allocation, a) for a in body["Allocs"]]
+        index = server.node_update_allocs(allocs)
+        return {"Index": index}
+
+    def node_deregister(body):
+        index = server.node_deregister(body["NodeID"])
+        return {"Index": index}
+
+    def node_update_drain(body):
+        index = server.node_update_drain(body["NodeID"], body["Drain"])
+        return {"Index": index}
+
+    rpc.register("Node.Register", node_register)
+    rpc.register("Node.UpdateStatus", node_update_status)
+    rpc.register("Node.GetClientAllocs", node_get_client_allocs)
+    rpc.register("Node.UpdateAlloc", node_update_alloc)
+    rpc.register("Node.Deregister", node_deregister)
+    rpc.register("Node.UpdateDrain", node_update_drain)
+
+    # -- Job ---------------------------------------------------------------
+
+    def job_register(body):
+        job = from_wire(s.Job, body["Job"])
+        index, eval_id = server.job_register(job)
+        return {"Index": index, "EvalID": eval_id}
+
+    def job_deregister(body):
+        index, eval_id = server.job_deregister(
+            body["JobID"], purge=body.get("Purge", True))
+        return {"Index": index, "EvalID": eval_id}
+
+    def job_evaluate(body):
+        index, eval_id = server.job_evaluate(body["JobID"])
+        return {"Index": index, "EvalID": eval_id}
+
+    def job_dispatch(body):
+        index, child_id, eval_id = server.job_dispatch(
+            body["JobID"], body.get("Payload") or b"", body.get("Meta") or {})
+        return {"Index": index, "DispatchedJobID": child_id,
+                "EvalID": eval_id}
+
+    rpc.register("Job.Register", job_register)
+    rpc.register("Job.Deregister", job_deregister)
+    rpc.register("Job.Evaluate", job_evaluate)
+    rpc.register("Job.Dispatch", job_dispatch)
+
+    # -- Periodic ----------------------------------------------------------
+
+    def periodic_force(body):
+        child = server.periodic_force(body["JobID"])
+        return {"ChildJobID": child.id if child else ""}
+
+    rpc.register("Periodic.Force", periodic_force)
+
+    # -- System ------------------------------------------------------------
+
+    def system_gc(body):
+        server.system_gc()
+        return {}
+
+    def system_reconcile(body):
+        server.system_reconcile_summaries()
+        return {}
+
+    rpc.register("System.GarbageCollect", system_gc)
+    rpc.register("System.ReconcileJobSummaries", system_reconcile)

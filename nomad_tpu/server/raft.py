@@ -212,3 +212,328 @@ class FileLog(RaftLog):
 
     def close(self) -> None:
         self._fh.close()
+
+
+# ---------------------------------------------------------------------------
+# Multi-server replication (hashicorp/raft equivalent)
+# ---------------------------------------------------------------------------
+
+
+class MultiRaft(RaftLog):
+    """Leader election + log replication across servers over the RPC raft
+    channel (reference: hashicorp/raft beneath nomad/server.go setupRaft,
+    transported via raft_rpc.go RaftLayer on the shared RPC port).
+
+    The protocol is Raft's core: randomized election timeouts, term-voted
+    RequestVote, AppendEntries with prev-entry consistency check and
+    follower truncation, majority commit, ordered FSM apply.  Entries carry
+    pickled payloads (trusted intra-cluster channel, as the reference
+    trusts msgpack-encoded structs between its own servers).
+
+    ``apply`` blocks until the entry is committed by a majority and applied
+    locally, then returns (result, index) — identical semantics to the
+    single-voter path so the Server code above it does not change.
+    """
+
+    HEARTBEAT_INTERVAL = 0.08
+    ELECTION_TIMEOUT = (0.25, 0.5)
+
+    def __init__(self, fsm: FSM, my_addr: str, pool,
+                 logger=None):
+        super().__init__(fsm)
+        import logging as _logging
+        import random
+
+        self.logger = logger or _logging.getLogger("nomad_tpu.raft")
+        self.my_addr = my_addr
+        self.pool = pool
+        self._rand = random.Random(hash(my_addr) & 0xFFFF)
+        self._leader = False  # starts as follower, unlike single-voter
+
+        self.term = 0
+        self.voted_for: Optional[str] = None
+        self.leader_addr: Optional[str] = None
+        # log[i] = (term, msg_type_value, payload_bytes); 1-indexed via offset
+        self.log: List[Tuple[int, int, bytes]] = []
+        self.commit_index = 0
+        self.state = "follower"
+        self.peers: List[str] = [my_addr]
+
+        self._apply_cond = threading.Condition(self._l)
+        self._last_contact = 0.0
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._peer_match = {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        import time as _time
+        self._last_contact = _time.monotonic()
+        t = threading.Thread(target=self._election_loop, name="raft-election",
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def close(self) -> None:
+        self._stop.set()
+
+    def set_peers(self, peers: List[str]) -> None:
+        with self._l:
+            self.peers = sorted(set(peers) | {self.my_addr})
+
+    def _quorum(self) -> int:
+        return len(self.peers) // 2 + 1
+
+    # -- RPC entry (RPCServer.raft_handler) --------------------------------
+
+    def handle_message(self, msg: dict) -> dict:
+        kind = msg.get("kind")
+        if kind == "request_vote":
+            return self._on_request_vote(msg)
+        if kind == "append_entries":
+            return self._on_append_entries(msg)
+        raise ValueError(f"unknown raft message kind {kind!r}")
+
+    # -- election ----------------------------------------------------------
+
+    def _election_timeout(self) -> float:
+        lo, hi = self.ELECTION_TIMEOUT
+        return lo + self._rand.random() * (hi - lo)
+
+    def _election_loop(self) -> None:
+        import time as _time
+        timeout = self._election_timeout()
+        while not self._stop.is_set():
+            _time.sleep(0.02)
+            with self._l:
+                is_leader = self.state == "leader"
+                since = _time.monotonic() - self._last_contact
+            if is_leader:
+                self._send_heartbeats()
+                _time.sleep(self.HEARTBEAT_INTERVAL)
+                continue
+            if since >= timeout:
+                self._run_election()
+                timeout = self._election_timeout()
+
+    def _run_election(self) -> None:
+        import time as _time
+        with self._l:
+            self.state = "candidate"
+            self.term += 1
+            term = self.term
+            self.voted_for = self.my_addr
+            self.leader_addr = None
+            last_index = len(self.log)
+            last_term = self.log[-1][0] if self.log else 0
+            peers = [p for p in self.peers if p != self.my_addr]
+            self._last_contact = _time.monotonic()
+        votes = 1
+        lock = threading.Lock()
+        done = threading.Event()
+
+        def ask(peer):
+            nonlocal votes
+            try:
+                from .rpc import RPC_RAFT
+                reply = self.pool.call(peer, "raft", {
+                    "kind": "request_vote", "term": term,
+                    "candidate": self.my_addr,
+                    "last_log_index": last_index, "last_log_term": last_term,
+                }, channel=RPC_RAFT, timeout=0.5)
+            except Exception:
+                return
+            with lock:
+                if reply.get("granted"):
+                    votes += 1
+                    if votes >= self._quorum():
+                        done.set()
+            with self._l:
+                if reply.get("term", 0) > self.term:
+                    self._step_down(reply["term"])
+                    done.set()
+
+        threads = [threading.Thread(target=ask, args=(p,), daemon=True)
+                   for p in peers]
+        for t in threads:
+            t.start()
+        if len(self.peers) == 1:
+            done.set()
+        done.wait(timeout=0.6)
+        with self._l:
+            if self.state == "candidate" and self.term == term \
+                    and votes >= self._quorum():
+                self.state = "leader"
+                self.leader_addr = self.my_addr
+                self.logger.info("raft: %s won election for term %d",
+                                 self.my_addr, term)
+        if self.is_raft_leader():
+            self._send_heartbeats()
+            self._set_leader(True)
+
+    def is_raft_leader(self) -> bool:
+        with self._l:
+            return self.state == "leader"
+
+    def _step_down(self, term: int) -> None:
+        # caller holds self._l
+        was_leader = self.state == "leader"
+        self.term = max(self.term, term)
+        self.state = "follower"
+        self.voted_for = None
+        if was_leader:
+            threading.Thread(target=self._set_leader, args=(False,),
+                             daemon=True).start()
+
+    def _on_request_vote(self, msg: dict) -> dict:
+        import time as _time
+        with self._l:
+            if msg["term"] < self.term:
+                return {"granted": False, "term": self.term}
+            if msg["term"] > self.term:
+                self._step_down(msg["term"])
+            up_to_date = (
+                msg["last_log_term"], msg["last_log_index"]
+            ) >= (self.log[-1][0] if self.log else 0, len(self.log))
+            if up_to_date and self.voted_for in (None, msg["candidate"]):
+                self.voted_for = msg["candidate"]
+                self._last_contact = _time.monotonic()
+                return {"granted": True, "term": self.term}
+            return {"granted": False, "term": self.term}
+
+    # -- replication -------------------------------------------------------
+
+    def _send_heartbeats(self) -> None:
+        self._replicate_round([])
+
+    def _replicate_round(self, new_entries: List[Tuple[int, int, bytes]],
+                         ) -> bool:
+        """Send AppendEntries to every peer; True if majority acked.
+
+        Simplification vs full Raft: each round ships the entries the
+        leader believes the follower is missing based on the follower's
+        acked index returned in the previous reply (stored per-peer)."""
+        with self._l:
+            term = self.term
+            peers = [p for p in self.peers if p != self.my_addr]
+            commit = self.commit_index
+            log_snapshot = list(self.log)
+        if not peers:
+            return True
+        acks = 1
+        lock = threading.Lock()
+        done = threading.Event()
+        quorum = self._quorum()
+
+        def send(peer):
+            nonlocal acks
+            match = self._peer_match.get(peer, 0)
+            while True:
+                entries = log_snapshot[match:]
+                prev_index = match
+                prev_term = log_snapshot[match - 1][0] if match > 0 else 0
+                try:
+                    from .rpc import RPC_RAFT
+                    reply = self.pool.call(peer, "raft", {
+                        "kind": "append_entries", "term": term,
+                        "leader": self.my_addr,
+                        "prev_log_index": prev_index,
+                        "prev_log_term": prev_term,
+                        "entries": entries,
+                        "leader_commit": commit,
+                    }, channel=RPC_RAFT, timeout=2.0)
+                except Exception:
+                    return
+                if reply.get("term", 0) > term:
+                    with self._l:
+                        self._step_down(reply["term"])
+                    done.set()
+                    return
+                if reply.get("success"):
+                    self._peer_match[peer] = len(log_snapshot)
+                    with lock:
+                        acks += 1
+                        if acks >= quorum:
+                            done.set()
+                    return
+                # consistency check failed: back off and retry
+                if match == 0:
+                    return
+                match = max(0, reply.get("match", match - 1))
+
+        threads = [threading.Thread(target=send, args=(p,), daemon=True)
+                   for p in peers]
+        for t in threads:
+            t.start()
+        done.wait(timeout=3.0)
+        with lock:
+            return acks >= quorum
+
+    def _on_append_entries(self, msg: dict) -> dict:
+        import time as _time
+        with self._l:
+            if msg["term"] < self.term:
+                return {"success": False, "term": self.term}
+            if msg["term"] > self.term or self.state != "follower":
+                self._step_down(msg["term"])
+            self.term = msg["term"]
+            self.leader_addr = msg["leader"]
+            self._last_contact = _time.monotonic()
+
+            prev_index = msg["prev_log_index"]
+            prev_term = msg["prev_log_term"]
+            if prev_index > len(self.log):
+                return {"success": False, "term": self.term,
+                        "match": len(self.log)}
+            if prev_index > 0 and self.log[prev_index - 1][0] != prev_term:
+                return {"success": False, "term": self.term,
+                        "match": max(0, prev_index - 1)}
+            # truncate conflicts, append new
+            entries = [tuple(e) for e in msg["entries"]]
+            self.log = self.log[:prev_index] + entries
+            # advance commit + apply
+            new_commit = min(msg["leader_commit"], len(self.log))
+            self._apply_committed(new_commit)
+            return {"success": True, "term": self.term,
+                    "match": len(self.log)}
+
+    def _apply_committed(self, new_commit: int) -> None:
+        # caller holds self._l
+        while self.commit_index < new_commit:
+            self.commit_index += 1
+            term, mt, blob = self.log[self.commit_index - 1]
+            payload = pickle.loads(blob)
+            self._last_index = self.commit_index
+            try:
+                self.fsm.apply(self.commit_index, MessageType(mt), payload)
+            except Exception:
+                self.logger.exception("raft: fsm apply failed at %d",
+                                      self.commit_index)
+
+    # -- the apply path ----------------------------------------------------
+
+    def apply(self, msg_type: MessageType, payload: dict):
+        with self._l:
+            if self.state != "leader":
+                raise NotLeaderError(self.leader_addr or "")
+            blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+            self.log.append((self.term, msg_type.value, blob))
+            index = len(self.log)
+        ok = self._replicate_round([])
+        with self._l:
+            if not ok or self.state != "leader":
+                raise NotLeaderError(self.leader_addr or "")
+            result = None
+            if self.commit_index < index:
+                # commit everything up to and including this entry
+                target = index
+                while self.commit_index < target:
+                    self.commit_index += 1
+                    t_, mt_, blob_ = self.log[self.commit_index - 1]
+                    p_ = pickle.loads(blob_)
+                    self._last_index = self.commit_index
+                    r_ = self.fsm.apply(self.commit_index, MessageType(mt_), p_)
+                    if self.commit_index == target:
+                        result = r_
+            return result, index

@@ -1,0 +1,297 @@
+"""RPC layer: msgpack-RPC over TCP with byte-prefix protocol demux.
+
+Reference behavior: nomad/rpc.go — a single TCP port serves every protocol,
+demuxed by the first byte (rpc.go:23-30: rpcNomad=0x01, rpcRaft=0x02,
+rpcMultiplex=0x03, rpcTLS=0x04); net/rpc with a msgpack codec
+(rpc.go:59-67); ``forward`` routes calls to the cluster leader or a remote
+region (rpc.go:178-283); ConnPool reuses connections (nomad/pool.go).
+
+Frame format on the Nomad channel: length-prefixed msgpack arrays
+``[seq, method, body]`` for requests and ``[seq, error, body]`` for
+responses — the moral of net/rpc's request/response header pairs.  The Raft
+channel carries the same framing but is dispatched to the consensus layer
+(raft_rpc.go RaftLayer).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import msgpack
+
+# Protocol bytes (rpc.go:23-30)
+RPC_NOMAD = 0x01
+RPC_RAFT = 0x02
+
+_LEN = struct.Struct("<I")
+
+
+class RPCError(Exception):
+    pass
+
+
+class NoLeaderError(RPCError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+
+def _send_frame(sock: socket.socket, obj: Any) -> None:
+    data = msgpack.packb(obj, use_bin_type=True)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("connection closed")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock: socket.socket) -> Any:
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if n > 64 << 20:
+        raise RPCError(f"frame too large: {n}")
+    return msgpack.unpackb(_recv_exact(sock, n), raw=False)
+
+
+# ---------------------------------------------------------------------------
+# server side
+# ---------------------------------------------------------------------------
+
+
+class RPCServer:
+    """TCP listener demuxing Nomad-RPC and Raft channels onto handlers.
+
+    ``register(method, fn)`` exposes ``fn(body) -> reply`` on the Nomad
+    channel; ``raft_handler`` receives raft messages (election/replication)
+    from peers.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 logger: Optional[logging.Logger] = None):
+        self.logger = logger or logging.getLogger("nomad_tpu.rpc")
+        self.methods: Dict[str, Callable[[Any], Any]] = {}
+        self.raft_handler: Optional[Callable[[Any], Any]] = None
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock = self.request
+                try:
+                    prefix = _recv_exact(sock, 1)[0]
+                except (ConnectionError, OSError):
+                    return
+                if prefix == RPC_NOMAD:
+                    outer._serve_nomad(sock)
+                elif prefix == RPC_RAFT:
+                    outer._serve_raft(sock)
+                else:
+                    outer.logger.warning("rpc: unrecognized protocol byte %#x",
+                                         prefix)
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self.tcp = Server((host, port), Handler)
+        self.host = host
+        self.port = self.tcp.server_address[1]
+        self._thread = threading.Thread(target=self.tcp.serve_forever,
+                                        name="rpc", daemon=True)
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self.tcp.shutdown()
+        self.tcp.server_close()
+
+    def register(self, method: str, fn: Callable[[Any], Any]) -> None:
+        self.methods[method] = fn
+
+    def _serve_nomad(self, sock: socket.socket) -> None:
+        """One connection, many sequential requests (like a net/rpc codec
+        session over a pooled yamux stream)."""
+        while True:
+            try:
+                seq, method, body = _recv_frame(sock)
+            except (ConnectionError, OSError, ValueError):
+                return
+            fn = self.methods.get(method)
+            if fn is None:
+                reply = [seq, f"rpc: can't find method {method}", None]
+            else:
+                try:
+                    reply = [seq, None, fn(body)]
+                except NoLeaderError as e:
+                    reply = [seq, f"__no_leader__:{e}", None]
+                except Exception as e:  # error string back to caller
+                    reply = [seq, f"{type(e).__name__}: {e}", None]
+            try:
+                _send_frame(sock, reply)
+            except (ConnectionError, OSError):
+                return
+
+    def _serve_raft(self, sock: socket.socket) -> None:
+        while True:
+            try:
+                seq, _method, body = _recv_frame(sock)
+            except (ConnectionError, OSError, ValueError):
+                return
+            handler = self.raft_handler
+            if handler is None:
+                reply = [seq, "raft: not ready", None]
+            else:
+                try:
+                    reply = [seq, None, handler(body)]
+                except Exception as e:
+                    reply = [seq, f"{type(e).__name__}: {e}", None]
+            try:
+                _send_frame(sock, reply)
+            except (ConnectionError, OSError):
+                return
+
+
+# ---------------------------------------------------------------------------
+# client side / conn pool (nomad/pool.go)
+# ---------------------------------------------------------------------------
+
+
+class _Conn:
+    def __init__(self, addr: str, channel: int, timeout: float):
+        host, port = addr.rsplit(":", 1)
+        self.sock = socket.create_connection((host, int(port)),
+                                             timeout=timeout)
+        self.sock.sendall(bytes([channel]))
+        self.seq = 0
+        self.lock = threading.Lock()
+
+    def call(self, method: str, body: Any, timeout: float) -> Any:
+        with self.lock:
+            self.seq += 1
+            seq = self.seq
+            self.sock.settimeout(timeout)
+            _send_frame(self.sock, [seq, method, body])
+            rseq, err, reply = _recv_frame(self.sock)
+        if rseq != seq:
+            raise RPCError(f"rpc: sequence mismatch ({rseq} != {seq})")
+        if err:
+            if isinstance(err, str) and err.startswith("__no_leader__:"):
+                raise NoLeaderError(err.split(":", 1)[1])
+            raise RPCError(err)
+        return reply
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class ConnPool:
+    """Connection reuse per (addr, channel) (pool.go:144)."""
+
+    def __init__(self, timeout: float = 10.0):
+        self.timeout = timeout
+        self._conns: Dict[Tuple[str, int], _Conn] = {}
+        self._lock = threading.Lock()
+
+    def call(self, addr: str, method: str, body: Any,
+             channel: int = RPC_NOMAD, timeout: Optional[float] = None) -> Any:
+        timeout = timeout if timeout is not None else self.timeout
+        key = (addr, channel)
+        with self._lock:
+            conn = self._conns.get(key)
+        if conn is None:
+            conn = _Conn(addr, channel, timeout)
+            with self._lock:
+                self._conns[key] = conn
+        try:
+            return conn.call(method, body, timeout)
+        except (ConnectionError, OSError) as e:
+            with self._lock:
+                if self._conns.get(key) is conn:
+                    del self._conns[key]
+            conn.close()
+            raise RPCError(f"rpc to {addr} failed: {e}") from e
+
+    def close(self) -> None:
+        with self._lock:
+            for conn in self._conns.values():
+                conn.close()
+            self._conns.clear()
+
+
+# ---------------------------------------------------------------------------
+# client agent -> server RPC adapter
+# ---------------------------------------------------------------------------
+
+
+class RemoteServerRPC:
+    """The duck-typed RPC surface nomad_tpu.client.Client expects
+    (node_register / node_update_status / node_get_client_allocs /
+    node_update_allocs), carried over the wire to a server — what the
+    reference client does via msgpack-RPC (client/rpc via
+    client.go:465 Client.RPC).  Retries across the server list.
+    """
+
+    def __init__(self, servers: List[str], pool: Optional[ConnPool] = None):
+        from ..api.codec import from_wire, to_wire
+        self._to_wire = to_wire
+        self._from_wire = from_wire
+        self.servers = list(servers)
+        self.pool = pool or ConnPool()
+
+    def _call(self, method: str, body: Any) -> Any:
+        last: Optional[Exception] = None
+        for addr in list(self.servers):
+            try:
+                return self.pool.call(addr, method, body)
+            except (RPCError, OSError) as e:
+                last = e
+                # demote failed server
+                if addr in self.servers:
+                    self.servers.remove(addr)
+                    self.servers.append(addr)
+        raise RPCError(f"no servers reachable: {last}")
+
+    def node_register(self, node):
+        reply = self._call("Node.Register", {"Node": self._to_wire(node)})
+        return reply["Index"], reply["HeartbeatTTL"]
+
+    def node_update_status(self, node_id: str, status: str):
+        reply = self._call("Node.UpdateStatus",
+                           {"NodeID": node_id, "Status": status})
+        return reply["Index"], reply["HeartbeatTTL"]
+
+    def node_get_client_allocs(self, node_id: str, min_index: int = 0,
+                               timeout: float = 30.0):
+        from ..structs import structs as s
+        reply = self._call("Node.GetClientAllocs",
+                           {"NodeID": node_id, "MinQueryIndex": min_index,
+                            "MaxQueryTime": timeout})
+        allocs = [self._from_wire(s.Allocation, a)
+                  for a in reply["Allocs"] or []]
+        return allocs, reply["Index"]
+
+    def node_update_allocs(self, allocs):
+        reply = self._call(
+            "Node.UpdateAlloc",
+            {"Allocs": [self._to_wire(a) for a in allocs]})
+        return reply["Index"]

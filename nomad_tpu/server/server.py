@@ -34,6 +34,12 @@ class ServerConfig:
     node_name: str = "server-1"
     rpc_advertise: str = "127.0.0.1:4647"
     data_dir: str = ""                  # empty → in-memory log (dev mode)
+    # RPC / clustering (nomad/config.go RPCAddr, BootstrapExpect, serf join)
+    enable_rpc: bool = False            # start the TCP RPC listener
+    rpc_bind: str = "127.0.0.1"
+    rpc_port: int = 0                   # 0 → ephemeral
+    bootstrap_expect: int = 1
+    start_join: List[str] = field(default_factory=list)
     num_schedulers: int = 1
     use_tpu_batch_worker: bool = False
     batch_size: int = 64
