@@ -188,7 +188,11 @@ class HTTPServer:
         deadline = time.monotonic() + wait
         while True:
             ws = WatchSet()
-            obj, index = run(ws)
+            try:
+                obj, index = run(ws)
+            except BaseException:
+                ws.close()
+                raise
             if index > min_index or time.monotonic() >= deadline:
                 ws.close()
                 return obj, index

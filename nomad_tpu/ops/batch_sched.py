@@ -48,10 +48,20 @@ class _CollectingScheduler(GenericScheduler):
         super().__init__(logger_, state, planner, batch)
         self.pending_place: List[AllocTuple] = []
         self.nodes_by_dc: Dict[str, int] = {}
+        # Shared per-batch cache of dc-tuple → nodes-by-dc counts, injected
+        # by TPUBatchScheduler (one full node scan per distinct dc set per
+        # batch instead of per eval).
+        self.dc_cache: Optional[Dict[Tuple[str, ...], Dict[str, int]]] = None
 
     def _compute_placements(self, place: List[AllocTuple]) -> None:
-        _, by_dc = ready_nodes_in_dcs(self.state, self.job.datacenters)
-        self.nodes_by_dc = by_dc
+        dcs = tuple(self.job.datacenters)
+        if self.dc_cache is not None and dcs in self.dc_cache:
+            self.nodes_by_dc = self.dc_cache[dcs]
+        else:
+            _, by_dc = ready_nodes_in_dcs(self.state, self.job.datacenters)
+            self.nodes_by_dc = by_dc
+            if self.dc_cache is not None:
+                self.dc_cache[dcs] = by_dc
         self.pending_place = list(place)
 
 
@@ -82,11 +92,13 @@ class TPUBatchScheduler:
         t0 = time.monotonic()
 
         # Phase 1: host reconciliation per eval (shared oracle code).
+        dc_cache: Dict[Tuple[str, ...], Dict[str, int]] = {}
         scheds: List[Tuple[s.Evaluation, _CollectingScheduler]] = []
         for ev in evals:
             sched = _CollectingScheduler(
                 self.logger, self.state, self.planner,
                 batch=(ev.type == s.JOB_TYPE_BATCH))
+            sched.dc_cache = dc_cache
             sched.eval = ev
             sched.job = self.state.job_by_id(None, ev.job_id)
             sched.plan = ev.make_plan(sched.job)
@@ -129,9 +141,18 @@ class TPUBatchScheduler:
             stats.encode_seconds = kstats["encode_seconds"]
             stats.rounds = kstats["rounds"]
 
+        # Expand per-spec (node, count) assignments into flat slot lists —
+        # once for the whole batch, not per eval.
+        expanded: Dict[Tuple[str, str], List[str]] = {}
+        for key, node_counts in assignments.items():
+            slots: List[str] = []
+            for node_id, cnt in node_counts:
+                slots.extend([node_id] * cnt)
+            expanded[key] = slots
+
         # Phase 3: materialize allocs into each eval's plan and submit.
         for ev, sched in scheds:
-            self._finalize(ev, sched, assignments, unplaced, per_spec_metrics)
+            self._finalize(ev, sched, expanded, unplaced, per_spec_metrics)
 
         stats.total_seconds = time.monotonic() - t0
         stats.num_evals = len(evals)
@@ -155,8 +176,9 @@ class TPUBatchScheduler:
         st = encode.encode_specs(spec_list, ct, all_nodes)
 
         # Existing per-(job, node) alloc counts for anti-affinity/distinct.
-        j_rows = len(st.job_ids)
-        job_counts = np.zeros((max(1, j_rows), ct.n_pad), dtype=np.int32)
+        # Rows padded to the bucketed spec axis so the kernel shape is stable
+        # across batches (job_index < u_real ≤ u_pad).
+        job_counts = np.zeros((st.u_pad, ct.n_pad), dtype=np.int32)
         node_index = {nid: i for i, nid in enumerate(ct.node_ids)}
         for j, job_id in enumerate(st.job_ids):
             for alloc in self.state.allocs_by_job(None, job_id, False):
@@ -229,49 +251,56 @@ class TPUBatchScheduler:
 
     # -- finalize ----------------------------------------------------------
 
-    def _finalize(self, ev, sched, assignments, unplaced, per_spec_metrics) -> None:
-        """Expand per-spec (node, count) assignments into this eval's plan,
-        then submit + set status, mirroring generic_sched.go:104 Process."""
-        # Walk this eval's pending placements and pop assignment slots.
-        cursor: Dict[Tuple[str, str], int] = {}
-        expanded: Dict[Tuple[str, str], List[str]] = {}
-        for key, node_counts in assignments.items():
-            slots: List[str] = []
-            for node_id, cnt in node_counts:
-                slots.extend([node_id] * cnt)
-            expanded[key] = slots
-
+    def _finalize(self, ev, sched, expanded, unplaced, per_spec_metrics) -> None:
+        """Materialize this eval's assigned slots into its plan, then submit
+        + set status, mirroring generic_sched.go:104 Process."""
+        # Prototype alloc per spec: the metric, task_resources, resources and
+        # shared_resources objects are shared by every alloc of the spec —
+        # legal because stored objects are immutable snapshots by convention
+        # (go-memdb shares pointers the same way) and the batch path never
+        # mutates them post-construction.  Per-alloc cost: one shallow copy +
+        # a bulk-generated uuid.
+        by_key: Dict[Tuple[str, str], List[AllocTuple]] = {}
         for tup in sched.pending_place:
-            key = (sched.job.id, tup.task_group.name)
+            by_key.setdefault((sched.job.id, tup.task_group.name), []).append(tup)
+
+        fast_copy = s._fast_copy
+        for key, tups in by_key.items():
             slots = expanded.get(key, [])
-            i = cursor.get(key, 0)
+            tg = tups[0].task_group
             metric = per_spec_metrics.get(key, s.AllocMetric())
             metric.nodes_available = sched.nodes_by_dc
-            if i < len(slots):
-                cursor[key] = i + 1
-                node_id = slots[i]
-                alloc = s.Allocation(
-                    id=s.generate_uuid(),
-                    eval_id=ev.id,
-                    name=tup.name,
-                    job_id=sched.job.id,
-                    task_group=tup.task_group.name,
-                    metrics=metric.copy(),
-                    node_id=node_id,
-                    task_resources={
-                        t.name: t.resources.copy() for t in tup.task_group.tasks},
-                    desired_status=s.ALLOC_DESIRED_STATUS_RUN,
-                    client_status=s.ALLOC_CLIENT_STATUS_PENDING,
-                    shared_resources=s.Resources(
-                        disk_mb=tup.task_group.ephemeral_disk.size_mb),
-                )
+            combined = s.Resources(disk_mb=tg.ephemeral_disk.size_mb)
+            for t in tg.tasks:
+                combined.add(t.resources)
+            proto = s.Allocation(
+                eval_id=ev.id,
+                job_id=sched.job.id,
+                task_group=tg.name,
+                metrics=metric,
+                resources=combined,
+                task_resources={t.name: t.resources.copy() for t in tg.tasks},
+                desired_status=s.ALLOC_DESIRED_STATUS_RUN,
+                client_status=s.ALLOC_CLIENT_STATUS_PENDING,
+                shared_resources=s.Resources(
+                    disk_mb=tg.ephemeral_disk.size_mb),
+            )
+            k = min(len(slots), len(tups))
+            ids = s.generate_uuids(k) if k else []
+            append = sched.plan.append_alloc
+            for i in range(k):
+                tup = tups[i]
+                alloc = fast_copy(proto)
+                alloc.id = ids[i]
+                alloc.name = tup.name
+                alloc.node_id = slots[i]
                 if tup.alloc is not None and tup.alloc.id:
                     alloc.previous_allocation = tup.alloc.id
-                sched.plan.append_alloc(alloc)
-            else:
+                append(alloc)
+            if k < len(tups):
                 if sched.failed_tg_allocs is None:
                     sched.failed_tg_allocs = {}
-                sched.failed_tg_allocs[tup.task_group.name] = metric
+                sched.failed_tg_allocs[tg.name] = metric
 
         # Blocked eval for failures (generic_sched.go:218-227).
         if (ev.status != s.EVAL_STATUS_BLOCKED and sched.failed_tg_allocs

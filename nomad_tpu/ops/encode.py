@@ -60,6 +60,17 @@ def round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def pow2_bucket(x: int, minimum: int = 8) -> int:
+    """Next power of two ≥ x (≥ minimum): batch axes are bucketed so every
+    differently-sized eval batch hits a warm XLA compile cache instead of
+    recompiling (SURVEY.md §7 hard-part vi, padding/recompilation
+    discipline)."""
+    v = minimum
+    while v < x:
+        v <<= 1
+    return v
+
+
 @dataclass
 class ClusterTensors:
     """Device view of the node fleet.
@@ -273,16 +284,17 @@ def encode_specs(
     and host-precomputed boolean rows (cached per computed class, mirroring
     EvalCache / FeasibilityWrapper semantics)."""
     u_real = len(specs)
-    u_pad = max(spec_pad_multiple, round_up(u_real, spec_pad_multiple))
-    k_max = max(
-        [1] + [len(sp.constraints) + len(sp.drivers) for sp in specs])
+    u_pad = pow2_bucket(u_real, spec_pad_multiple)
+    k_max = pow2_bucket(
+        max([1] + [len(sp.constraints) + len(sp.drivers) for sp in specs]),
+        minimum=2)
 
     ask = np.zeros((u_pad, RES_DIMS), dtype=np.int64)
     count = np.zeros(u_pad, dtype=np.int32)
     priority = np.zeros(u_pad, dtype=np.int32)
     penalty = np.zeros(u_pad, dtype=np.float32)
     distinct = np.zeros(u_pad, dtype=bool)
-    n_dcs = max(1, len(ct.dc_codebook))
+    n_dcs = pow2_bucket(max(1, len(ct.dc_codebook)), minimum=2)
     dc_mask = np.zeros((u_pad, n_dcs), dtype=bool)
     c_attr = np.zeros((u_pad, k_max), dtype=np.int32)
     c_op = np.zeros((u_pad, k_max), dtype=np.int32)   # OP_TRUE padding

@@ -77,7 +77,7 @@ class Harness:
                     if alloc.job is None:
                         alloc.job = plan.job
 
-            self.state.upsert_allocs(index, allocs)
+            self.state.upsert_allocs(index, allocs, owned=True)
             return result, None
 
     def update_eval(self, ev: s.Evaluation) -> None:

@@ -537,16 +537,26 @@ class StateStore:
 
     # -- allocs ------------------------------------------------------------
 
-    def upsert_allocs(self, index: int, allocs: List[s.Allocation]) -> None:
-        """(state_store.go:1435)."""
+    def upsert_allocs(self, index: int, allocs: List[s.Allocation],
+                      owned: bool = False) -> None:
+        """(state_store.go:1435).  ``owned=True`` means the caller hands the
+        objects over (plan apply constructs fresh allocs): the store inserts
+        them directly, exactly like go-memdb inserting the FSM's pointers."""
         with self._lock:
-            self._upsert_allocs_impl(index, allocs)
+            self._upsert_allocs_impl(index, allocs, owned)
         self._notify()
 
-    def _upsert_allocs_impl(self, index: int, allocs: List[s.Allocation]) -> None:
+    def _upsert_allocs_impl(self, index: int, allocs: List[s.Allocation],
+                            owned: bool = False) -> None:
         jobs: Dict[str, str] = {}
+        summary_cache: Dict[str, s.JobSummary] = {}
         for alloc in allocs:
-            alloc = alloc.copy()
+            # Shallow copy unless owned: stored objects are immutable
+            # snapshots by convention (go-memdb inserts the caller's pointer
+            # outright, state_store.go:1435); the copy only isolates the
+            # top-level index/status fields this method mutates below.
+            if not owned:
+                alloc = s._fast_copy(alloc)
             existing = self.allocs_table.get(alloc.id)
             if existing is None:
                 alloc.create_index = index
@@ -563,7 +573,7 @@ class StateStore:
                 if alloc.client_status != s.ALLOC_CLIENT_STATUS_LOST:
                     alloc.client_status = existing.client_status
                     alloc.client_description = existing.client_description
-            self._update_summary_with_alloc(index, alloc, existing)
+            self._update_summary_with_alloc(index, alloc, existing, summary_cache)
             if alloc.job is None and existing is not None:
                 alloc.job = existing.job
             self.allocs_table[alloc.id] = alloc
@@ -586,7 +596,7 @@ class StateStore:
                 existing = self.allocs_table.get(client_alloc.id)
                 if existing is None:
                     continue
-                updated = existing.copy()
+                updated = s._fast_copy(existing)
                 updated.client_status = client_alloc.client_status
                 updated.client_description = client_alloc.client_description
                 updated.task_states = {
@@ -722,7 +732,9 @@ class StateStore:
                         total.add(task_res)
                     total.add(alloc.shared_resources)
                     alloc.resources = total
-            self._upsert_allocs_impl(index, allocs)
+            # Plan-result allocs are owned by the state store from here on
+            # (the FSM decoded/constructed them; nothing else mutates them).
+            self._upsert_allocs_impl(index, allocs, owned=True)
         self._notify()
 
     # -- job status machinery ---------------------------------------------
@@ -798,21 +810,28 @@ class StateStore:
         return s.JOB_STATUS_PENDING
 
     def _update_summary_with_alloc(
-        self, index: int, alloc: s.Allocation, existing: Optional[s.Allocation]
+        self, index: int, alloc: s.Allocation, existing: Optional[s.Allocation],
+        cache: Optional[Dict[str, s.JobSummary]] = None,
     ) -> None:
-        """(state_store.go:2296)."""
+        """(state_store.go:2296).
+
+        ``cache`` lets a bulk upsert copy each job's summary once per batch
+        instead of once per alloc (the copy dominated bulk-insert cost)."""
         if alloc.job is None:
             return
-        summary = self.job_summary_table.get(alloc.job_id)
+        summary = cache.get(alloc.job_id) if cache is not None else None
         if summary is None:
-            return
-        if summary.create_index != alloc.job.create_index:
-            return
+            summary = self.job_summary_table.get(alloc.job_id)
+            if summary is None:
+                return
+            if summary.create_index != alloc.job.create_index:
+                return
+            summary = summary.copy()
+            if cache is not None:
+                cache[alloc.job_id] = summary
         tgs = summary.summary.get(alloc.task_group)
         if tgs is None:
             return
-        summary = summary.copy()
-        tgs = summary.summary[alloc.task_group]
 
         changed = False
         if existing is None:
