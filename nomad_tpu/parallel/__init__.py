@@ -3,8 +3,8 @@
 from .sharded import (
     BATCH_AXIS,
     NODE_AXIS,
-    commit_candidates,
     make_node_mesh,
     sharded_candidate_scores,
+    sharded_placement_rounds,
     sharded_schedule_step,
 )

@@ -23,7 +23,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.kernels import _score_fit
+from ..ops.kernels import PlacementResult, _score_fit
 
 NEG_INF = -1e30
 
@@ -104,40 +104,128 @@ def sharded_candidate_scores(
     return scores, idx
 
 
-def commit_candidates(
-    cand_scores: jnp.ndarray,   # [U, C] float32 — gathered candidates
-    cand_idx: jnp.ndarray,      # [U, C] int32 — global node ids
-    used: jnp.ndarray,          # [N, 4] int32
-    capacity: jnp.ndarray,      # [N, 4] int32
-    ask: jnp.ndarray,           # [U, 4] int32
-    count: jnp.ndarray,         # [U] int32
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Sequential commit over the candidate subset: for each spec, greedily
-    take its best remaining candidates under capacity (one alloc per
-    candidate slot).  Returns (placements[U, N] int32, used_after)."""
-    u_pad, c = cand_scores.shape
-    n_pad = used.shape[0]
+def sharded_placement_rounds(
+    mesh: Mesh,
+    feas: jax.Array,           # [U, N] bool — sharded on N
+    used0: jax.Array,          # [N, 4] int32
+    capacity: jax.Array,       # [N, 4] int32
+    denom: jax.Array,          # [N, 2] float32
+    ask: jax.Array,            # [U, 4] int32 — replicated
+    count: jax.Array,          # [U] int32
+    penalty: jax.Array,        # [U] float32
+    distinct_hosts: jax.Array, # [U] bool
+    job_index: jax.Array,      # [U] int32 → row in job_counts
+    job_counts0: jax.Array,    # [J, N] int32 — sharded on N
+    rng_key: jax.Array,
+    k_cand: int = 64,
+    max_rounds: int = 256,
+) -> PlacementResult:
+    """The single-chip `placement_rounds` semantics, node-sharded over the
+    mesh: anti-affinity collisions, distinct_hosts, per-(job,node) counts
+    and the multi-round capacity-feedback loop all run on sharded state.
 
-    def place_spec(carry, u_idx):
-        used_c, placements = carry
-        nodes = cand_idx[u_idx]                       # [C]
-        cap_left = capacity[nodes] - used_c[nodes]    # [C, 4]
-        fits = jnp.all(ask[u_idx][None, :] <= cap_left, axis=1)
-        ok = fits & (cand_scores[u_idx] > NEG_INF / 2)
-        # rank candidates by score, take top remaining count
-        order = jnp.argsort(-jnp.where(ok, cand_scores[u_idx], NEG_INF))
-        ranks = jnp.zeros(c, dtype=jnp.int32).at[order].set(
-            jnp.arange(c, dtype=jnp.int32))
-        take = ok & (ranks < count[u_idx])
-        sel = take.astype(jnp.int32)
-        used_c = used_c.at[nodes].add(sel[:, None] * ask[u_idx][None, :])
-        placements = placements.at[u_idx, nodes].add(sel)
-        return (used_c, placements), jnp.sum(sel)
+    Per spec, each shard scores its node shard (binpack − penalty·collisions
+    + the same jitter the single-chip kernel uses), takes a local top-k_cand,
+    and the k_cand·D candidates are all-gathered over ICI; the global top-k
+    selection and shard-local commit follow.  As long as a spec commits
+    ≤ k_cand allocs in a round (one alloc per node per round — the
+    anti-affinity bound), the selection is *identical* to the single-chip
+    kernel's full-argsort commit, including tie-breaks: gathered candidate
+    order is (shard, local index) = global node order, and both paths use
+    stable sorts.  Specs needing more than k_cand·D per round under-commit
+    that round and finish in later rounds (progress loop).
 
-    placements0 = jnp.zeros((u_pad, n_pad), dtype=jnp.int32)
-    (used_after, placements), _ = lax.scan(
-        place_spec, (used, placements0), jnp.arange(u_pad))
-    return placements, used_after
+    Ref: scheduler/rank.go:247 (anti-affinity), feasible.go:148
+    (distinct_hosts), SURVEY.md §2.9 node-axis sharding.
+    """
+    u_pad, n_pad = feas.shape
+    d = mesh.devices.size
+    assert n_pad % d == 0, (
+        f"mesh size {d} must divide node axis {n_pad} (pad N up)")
+    k_cand = min(k_cand, n_pad // d)
+
+    # Identical jitter to the single-chip kernel (same key, same shape) so
+    # placements are bit-compatible; sharded on N by the in_spec.
+    jitter = jax.random.uniform(rng_key, (u_pad, n_pad), dtype=jnp.float32) * 1e-3
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(None, NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS),
+                  P(NODE_AXIS), P(None), P(None), P(None), P(None),
+                  P(None), P(None, NODE_AXIS), P(None, NODE_AXIS)),
+        out_specs=(P(None, NODE_AXIS), P(None), P(NODE_AXIS), P()),
+    )
+    def _run(feas_l, used_l, cap_l, denom_l, ask_r, count_r, penalty_r,
+             dh_r, job_index_r, jc_l, jitter_l):
+        n_l = used_l.shape[0]
+        shard = lax.axis_index(NODE_AXIS)
+        c_total = k_cand * d
+
+        def place_one_spec(carry, u):
+            used, jc, remaining, placements = carry
+            cap_left = cap_l - used
+            fits = jnp.all(ask_r[u][None, :] <= cap_left, axis=1)
+            collisions = jc[job_index_r[u]]            # [N_l] int32
+            ok = feas_l[u] & fits
+            ok = ok & jnp.where(dh_r[u], collisions == 0, True)
+
+            score = _score_fit(used, ask_r[u], denom_l)
+            score = score - penalty_r[u] * collisions.astype(jnp.float32)
+            score = score + jitter_l[u]
+            scored = jnp.where(ok, score, NEG_INF)
+
+            # Local top-k_cand, then the ICI all-gather: the only
+            # cross-shard traffic in the hot loop is [D, k_cand] floats.
+            loc_scores, loc_idx = lax.top_k(scored, k_cand)
+            all_scores = lax.all_gather(
+                loc_scores, NODE_AXIS, tiled=True)     # [D*k_cand]
+            n_ok = lax.psum(jnp.sum(ok.astype(jnp.int32)), NODE_AXIS)
+            k = jnp.minimum(remaining[u], n_ok)
+
+            order = jnp.argsort(-all_scores)
+            ranks = jnp.zeros(c_total, dtype=jnp.int32).at[order].set(
+                jnp.arange(c_total, dtype=jnp.int32))
+            sel_cand = (all_scores > NEG_INF / 2) & (ranks < k)
+            my_sel = lax.dynamic_slice(sel_cand, (shard * k_cand,), (k_cand,))
+            sel = jnp.zeros(n_l, dtype=bool).at[loc_idx].set(my_sel) & ok
+
+            sel_i = sel.astype(jnp.int32)
+            used = used + sel_i[:, None] * ask_r[u][None, :]
+            jc = jc.at[job_index_r[u]].add(sel_i)
+            placements = placements.at[u].add(sel_i)
+            placed = lax.psum(jnp.sum(sel_i), NODE_AXIS)
+            remaining = remaining.at[u].add(-placed)
+            return (used, jc, remaining, placements), placed
+
+        def round_body(state):
+            used, jc, remaining, placements, _, rounds = state
+            (used, jc, remaining, placements), placed = lax.scan(
+                place_one_spec, (used, jc, remaining, placements),
+                jnp.arange(u_pad))
+            return (used, jc, remaining, placements,
+                    jnp.sum(placed), rounds + 1)
+
+        def round_cond(state):
+            _, _, remaining, _, progress, rounds = state
+            return ((progress > 0) & (jnp.sum(remaining) > 0)
+                    & (rounds < max_rounds))
+
+        placements0 = lax.pcast(
+            jnp.zeros((u_pad, n_l), dtype=jnp.int32),
+            (NODE_AXIS,), to="varying")
+        state = (used_l, jc_l, count_r, placements0,
+                 jnp.array(1, dtype=jnp.int32), jnp.array(0, dtype=jnp.int32))
+        used, jc, remaining, placements, _, rounds = lax.while_loop(
+            round_cond, round_body, state)
+        return placements, remaining, used, rounds
+
+    placements, unplaced, used_after, rounds = _run(
+        feas, used0, capacity, denom, ask, count, penalty, distinct_hosts,
+        job_index, job_counts0, jitter)
+    return PlacementResult(
+        placements=placements, unplaced=unplaced,
+        used_after=used_after, rounds=rounds)
 
 
 def sharded_schedule_step(
@@ -150,9 +238,17 @@ def sharded_schedule_step(
     count: jax.Array,
     k: int = 64,
 ) -> Tuple[jax.Array, jax.Array]:
-    """One full scheduling step over the mesh: sharded scoring + top-k
-    gather + candidate commit.  This is the framework's 'training step' —
-    the function dryrun_multichip jits over an N-device mesh."""
-    cand_scores, cand_idx = sharded_candidate_scores(
-        mesh, feas, used, capacity, denom, ask, k=k)
-    return commit_candidates(cand_scores, cand_idx, used, capacity, ask, count)
+    """Convenience wrapper: one full-semantics scheduling step over the mesh
+    with default job bookkeeping (one job per spec, standard service
+    anti-affinity penalty, no distinct_hosts)."""
+    u_pad, n_pad = feas.shape
+    result = sharded_placement_rounds(
+        mesh, feas, used, capacity, denom, ask, count,
+        penalty=jnp.full((u_pad,), 20.0, dtype=jnp.float32),
+        distinct_hosts=jnp.zeros((u_pad,), dtype=bool),
+        job_index=jnp.arange(u_pad, dtype=jnp.int32),
+        job_counts0=jnp.zeros((u_pad, n_pad), dtype=jnp.int32),
+        rng_key=jax.random.PRNGKey(0),
+        k_cand=k,
+    )
+    return result.placements, result.used_after
