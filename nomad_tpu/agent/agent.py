@@ -41,6 +41,14 @@ class Agent:
             rpc_advertise=f"{self.config.bind_addr}:{self.config.ports.rpc}",
             data_dir=sb.data_dir or (
                 "" if self.config.dev_mode else self.config.data_dir),
+            # Server agents always listen on ports.rpc (agent.go:336
+            # setupServer → server.go:250 setupRPC); dev mode takes an
+            # ephemeral port.
+            enable_rpc=True,
+            rpc_bind=self.config.bind_addr,
+            rpc_port=0 if self.config.dev_mode else self.config.ports.rpc,
+            bootstrap_expect=sb.bootstrap_expect,
+            start_join=list(sb.start_join),
             num_schedulers=sb.num_schedulers,
             use_tpu_batch_worker=sb.use_tpu_batch_worker,
             batch_size=sb.batch_size)
@@ -141,7 +149,21 @@ class Agent:
         }
 
     def members(self) -> List[Dict]:
-        return [self._self_member()] if self.server is not None else []
+        if self.server is None:
+            return []
+        cluster = self.server.members()
+        if cluster:
+            me = self._self_member()
+            out = []
+            for m in cluster:
+                entry = dict(me) if m["Name"] == self.server.config.node_name \
+                    else {"Name": m["Name"], "Addr": m["Addr"].rsplit(":", 1)[0],
+                          "Port": 0, "Status": m.get("Status", "alive"),
+                          "Tags": {"region": m.get("Region", ""),
+                                   "role": "nomad"}}
+                out.append(entry)
+            return out
+        return [self._self_member()]
 
     def client_servers(self) -> List[str]:
         if self.client is None:

@@ -17,10 +17,43 @@ from typing import Any, Dict
 
 from ..api.codec import from_wire, to_wire
 from ..structs import structs as s
+from .raft import NotLeaderError
+from .rpc import NoLeaderError
 
 
 def register_endpoints(server, rpc) -> None:
-    """Attach all wire methods for ``server`` onto RPCServer ``rpc``."""
+    """Attach all wire methods for ``server`` onto RPCServer ``rpc``.
+
+    Every handler forwards to the cluster leader when the local server
+    raises NotLeaderError (nomad/rpc.go:178-283 forward): one hop, using
+    the leader address raft learned from the last heartbeat."""
+
+    def register(method, fn):
+        def handler(body):
+            # One hop only (the reference's Forwarded flag, nomad/rpc.go):
+            # an already-forwarded request that still lands on a non-leader
+            # fails instead of bouncing between stale leader pointers.  The
+            # thread-local marker makes Server._forward observe the hop.
+            forwarded = isinstance(body, dict) and body.pop("__forwarded__",
+                                                            False)
+            if forwarded:
+                server._fwd_ctx.active = True
+            try:
+                return fn(body)
+            except NotLeaderError as e:
+                leader = str(e) or server.leader_address()
+                if not forwarded and leader \
+                        and leader != server.config.rpc_advertise \
+                        and server.pool is not None:
+                    fwd = dict(body) if isinstance(body, dict) else body
+                    if isinstance(fwd, dict):
+                        fwd["__forwarded__"] = True
+                    return server.pool.call(leader, method, fwd)
+                raise NoLeaderError("no cluster leader")
+            finally:
+                if forwarded:
+                    server._fwd_ctx.active = False
+        rpc.register(method, handler)
 
     # -- Status ------------------------------------------------------------
 
@@ -45,8 +78,8 @@ def register_endpoints(server, rpc) -> None:
     def serf_members(body):
         return {"Members": server.members()}
 
-    rpc.register("Serf.Join", serf_join)
-    rpc.register("Serf.Members", serf_members)
+    register("Serf.Join", serf_join)
+    register("Serf.Members", serf_members)
 
     # -- Node (client agent surface) --------------------------------------
 
@@ -78,12 +111,16 @@ def register_endpoints(server, rpc) -> None:
         index = server.node_update_drain(body["NodeID"], body["Drain"])
         return {"Index": index}
 
-    rpc.register("Node.Register", node_register)
-    rpc.register("Node.UpdateStatus", node_update_status)
-    rpc.register("Node.GetClientAllocs", node_get_client_allocs)
-    rpc.register("Node.UpdateAlloc", node_update_alloc)
-    rpc.register("Node.Deregister", node_deregister)
-    rpc.register("Node.UpdateDrain", node_update_drain)
+    def node_evaluate(body):
+        return {"EvalIDs": server.node_evaluate(body["NodeID"])}
+
+    register("Node.Evaluate", node_evaluate)
+    register("Node.Register", node_register)
+    register("Node.UpdateStatus", node_update_status)
+    register("Node.GetClientAllocs", node_get_client_allocs)
+    register("Node.UpdateAlloc", node_update_alloc)
+    register("Node.Deregister", node_deregister)
+    register("Node.UpdateDrain", node_update_drain)
 
     # -- Job ---------------------------------------------------------------
 
@@ -107,10 +144,10 @@ def register_endpoints(server, rpc) -> None:
         return {"Index": index, "DispatchedJobID": child_id,
                 "EvalID": eval_id}
 
-    rpc.register("Job.Register", job_register)
-    rpc.register("Job.Deregister", job_deregister)
-    rpc.register("Job.Evaluate", job_evaluate)
-    rpc.register("Job.Dispatch", job_dispatch)
+    register("Job.Register", job_register)
+    register("Job.Deregister", job_deregister)
+    register("Job.Evaluate", job_evaluate)
+    register("Job.Dispatch", job_dispatch)
 
     # -- Periodic ----------------------------------------------------------
 
@@ -118,7 +155,7 @@ def register_endpoints(server, rpc) -> None:
         child = server.periodic_force(body["JobID"])
         return {"ChildJobID": child.id if child else ""}
 
-    rpc.register("Periodic.Force", periodic_force)
+    register("Periodic.Force", periodic_force)
 
     # -- System ------------------------------------------------------------
 
@@ -130,5 +167,5 @@ def register_endpoints(server, rpc) -> None:
         server.system_reconcile_summaries()
         return {}
 
-    rpc.register("System.GarbageCollect", system_gc)
-    rpc.register("System.ReconcileJobSummaries", system_reconcile)
+    register("System.GarbageCollect", system_gc)
+    register("System.ReconcileJobSummaries", system_reconcile)

@@ -1,0 +1,66 @@
+"""Codec for replicated-log entry payloads.
+
+The reference transports Raft log entries as msgpack-encoded data-only
+structs (nomad/fsm.go:115 decodes each entry with the structs codec;
+nomad/structs/structs.go:4637-4665 codec handles).  This module gives the
+multi-server log the same property: payloads are msgpack trees in which
+dataclass instances are tagged with their type name and re-hydrated through
+the reflection wire codec — never pickled, so a peer on the raft channel
+can only produce whitelisted data types, not code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import msgpack
+
+from ..api.codec import from_wire, to_wire
+from ..state.state_store import PeriodicLaunch, VaultAccessor
+from ..structs import structs as _structs
+
+_TAG = "__t"
+_DATA = "__d"
+
+# Whitelist of decodable payload types: every dataclass in the structs
+# module plus the state-store row types the FSM applies.
+_TYPES: Dict[str, type] = {
+    name: obj
+    for name, obj in vars(_structs).items()
+    if isinstance(obj, type) and dataclasses.is_dataclass(obj)
+}
+_TYPES["PeriodicLaunch"] = PeriodicLaunch
+_TYPES["VaultAccessor"] = VaultAccessor
+
+
+def _enc(v: Any) -> Any:
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        return {_TAG: type(v).__name__, _DATA: to_wire(v)}
+    if isinstance(v, dict):
+        return {k: _enc(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_enc(x) for x in v]
+    return v
+
+
+def _dec(v: Any) -> Any:
+    if isinstance(v, dict):
+        tag = v.get(_TAG)
+        if tag is not None and _DATA in v:
+            cls = _TYPES.get(tag)
+            if cls is None:
+                raise ValueError(f"log codec: unknown payload type {tag!r}")
+            return from_wire(cls, v[_DATA])
+        return {k: _dec(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_dec(x) for x in v]
+    return v
+
+
+def encode_payload(payload: dict) -> bytes:
+    return msgpack.packb(_enc(payload), use_bin_type=True)
+
+
+def decode_payload(blob: bytes) -> dict:
+    return _dec(msgpack.unpackb(blob, raw=False))

@@ -218,28 +218,227 @@ class FileLog(RaftLog):
 # Multi-server replication (hashicorp/raft equivalent)
 # ---------------------------------------------------------------------------
 
+# Log entries are [index, term, msg_type, payload_blob] lists (msgpack-ready
+# for the wire).  msg_type NOOP_TYPE marks the leader's term-establishment
+# no-op entry (hashicorp/raft LogNoop): it commits prior-term entries
+# without feeding the FSM.  CONFIG_TYPE entries carry the voter set
+# (hashicorp/raft LogConfiguration): membership changes replicate through
+# the log so every server's quorum derives from a committed configuration,
+# never from its private gossip view (which could yield disjoint quorums).
+NOOP_TYPE = -1
+CONFIG_TYPE = -2
+
+
+class RaftTimeoutError(Exception):
+    """Apply could not reach quorum within the timeout (the reference's
+    raft.Apply(…, timeout) ErrEnqueueTimeout/leadership-lost errors)."""
+
+
+class _ApplyFuture:
+    """Resolution of one leader-appended log entry: the FSM result once the
+    entry commits, or an error if leadership was lost first.  Fixes the
+    round-1 race where concurrent apply() callers could lose their result
+    to a sibling thread advancing commit_index."""
+
+    __slots__ = ("_ev", "result", "error")
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self.result = None
+        self.error: Optional[Exception] = None
+
+    def resolve(self, result) -> None:
+        self.result = result
+        self._ev.set()
+
+    def fail(self, exc: Exception) -> None:
+        self.error = exc
+        self._ev.set()
+
+    def wait(self, timeout: float):
+        if not self._ev.wait(timeout):
+            raise RaftTimeoutError("raft apply timed out awaiting quorum")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class _RaftStore:
+    """Durable raft state: current term + vote, the entry log, and FSM
+    snapshots (the raft-boltdb log store + stable store + snapshot store
+    roles, nomad/server.go:91-95).  ``data_dir=None`` keeps everything in
+    memory (the raftInmem dev path).
+
+    Layout:
+      meta            — msgpack {term, voted_for}, rewritten + fsynced
+      wal             — length-prefixed msgpack [index, term, type, blob]
+      snapshot-<idx>-<term> — FSM snapshot through <idx>
+    """
+
+    def __init__(self, data_dir: Optional[str]):
+        self.dir = data_dir
+        self._fh = None
+        if self.dir:
+            os.makedirs(self.dir, exist_ok=True)
+
+    # -- load --------------------------------------------------------------
+
+    def load(self):
+        """Returns (term, voted_for, peers, base_index, base_term, entries,
+        snapshot_blob_or_None)."""
+        import msgpack
+        term, voted = 0, None
+        peers: List[str] = []
+        base_index, base_term = 0, 0
+        entries: List[list] = []
+        snap_blob = None
+        if not self.dir:
+            return term, voted, peers, base_index, base_term, entries, snap_blob
+
+        meta_path = os.path.join(self.dir, "meta")
+        if os.path.exists(meta_path):
+            with open(meta_path, "rb") as fh:
+                meta = msgpack.unpackb(fh.read(), raw=False)
+            term, voted = meta.get("term", 0), meta.get("voted_for")
+            peers = meta.get("peers") or []
+
+        snaps = self._snapshot_files()
+        if snaps:
+            (base_index, base_term), path = snaps[-1]
+            with open(path, "rb") as fh:
+                snap_blob = fh.read()
+
+        wal_path = os.path.join(self.dir, "wal")
+        if os.path.exists(wal_path):
+            good = 0
+            size = os.path.getsize(wal_path)
+            with open(wal_path, "rb") as fh:
+                while True:
+                    header = fh.read(_LEN.size)
+                    if len(header) < _LEN.size:
+                        torn = len(header) > 0
+                        break
+                    (length,) = _LEN.unpack(header)
+                    if length > size - fh.tell():
+                        torn = True
+                        break
+                    blob = fh.read(length)
+                    if len(blob) < length:
+                        torn = True
+                        break
+                    entry = msgpack.unpackb(blob, raw=False)
+                    good = fh.tell()
+                    if entry[0] <= base_index:
+                        continue  # covered by the snapshot
+                    entries.append(entry)
+                else:
+                    torn = False
+            if torn:
+                with open(wal_path, "r+b") as fh:
+                    fh.truncate(good)
+        self._fh = open(os.path.join(self.dir, "wal"), "ab") if self.dir else None
+        return term, voted, peers, base_index, base_term, entries, snap_blob
+
+    def _snapshot_files(self):
+        out = []
+        for name in os.listdir(self.dir):
+            if name.startswith("snapshot-"):
+                parts = name.split("-")
+                try:
+                    idx, term = int(parts[1]), int(parts[2])
+                except (IndexError, ValueError):
+                    continue
+                out.append(((idx, term), os.path.join(self.dir, name)))
+        return sorted(out)
+
+    # -- persist -----------------------------------------------------------
+
+    def save_meta(self, term: int, voted_for: Optional[str],
+                  peers: Optional[List[str]] = None) -> None:
+        if not self.dir:
+            return
+        import msgpack
+        path = os.path.join(self.dir, "meta")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(msgpack.packb({"term": term, "voted_for": voted_for,
+                                    "peers": peers or []},
+                                   use_bin_type=True))
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+
+    def append(self, entries: List[list]) -> None:
+        if self._fh is None:
+            return
+        import msgpack
+        for e in entries:
+            blob = msgpack.packb(e, use_bin_type=True)
+            self._fh.write(_LEN.pack(len(blob)))
+            self._fh.write(blob)
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+
+    def rewrite(self, entries: List[list]) -> None:
+        """Conflict truncation / compaction: replace the whole WAL."""
+        if not self.dir:
+            return
+        if self._fh is not None:
+            self._fh.close()
+        self._fh = open(os.path.join(self.dir, "wal"), "wb")
+        self.append(entries)
+
+    def save_snapshot(self, index: int, term: int, blob: bytes) -> None:
+        if not self.dir:
+            return
+        path = os.path.join(self.dir, f"snapshot-{index}-{term}")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(blob)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        for _, old in self._snapshot_files()[:-SNAPSHOTS_RETAINED]:
+            os.unlink(old)
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
 
 class MultiRaft(RaftLog):
     """Leader election + log replication across servers over the RPC raft
     channel (reference: hashicorp/raft beneath nomad/server.go setupRaft,
-    transported via raft_rpc.go RaftLayer on the shared RPC port).
+    transported via raft_rpc.go:34-90 RaftLayer on the shared RPC port).
 
-    The protocol is Raft's core: randomized election timeouts, term-voted
-    RequestVote, AppendEntries with prev-entry consistency check and
-    follower truncation, majority commit, ordered FSM apply.  Entries carry
-    pickled payloads (trusted intra-cluster channel, as the reference
-    trusts msgpack-encoded structs between its own servers).
+    Raft's core, implemented fully: randomized election timeouts, term-voted
+    RequestVote with persisted term/vote, AppendEntries with prev-entry
+    consistency check and follower conflict truncation, per-peer replicator
+    threads driving next/match indexes, majority commit restricted to
+    current-term entries, InstallSnapshot for peers behind the compaction
+    horizon, ordered FSM apply, and per-index apply futures so every
+    ``apply`` caller receives its own FSM result.
+
+    Entry payloads cross the wire as whitelisted msgpack trees
+    (server/log_codec.py), never pickle — a raft peer can only inject data,
+    not code.
 
     ``apply`` blocks until the entry is committed by a majority and applied
     locally, then returns (result, index) — identical semantics to the
     single-voter path so the Server code above it does not change.
     """
 
-    HEARTBEAT_INTERVAL = 0.08
-    ELECTION_TIMEOUT = (0.25, 0.5)
+    HEARTBEAT_INTERVAL = 0.05
+    ELECTION_TIMEOUT = (0.15, 0.30)
+    APPLY_TIMEOUT = 10.0
+    REPLICATE_BATCH = 512
+    # Auto-compact once the in-memory log exceeds this many entries
+    # (hashicorp/raft SnapshotThreshold, default 8192).
+    SNAPSHOT_THRESHOLD = 8192
 
     def __init__(self, fsm: FSM, my_addr: str, pool,
-                 logger=None):
+                 data_dir: Optional[str] = None, logger=None):
         super().__init__(fsm)
         import logging as _logging
         import random
@@ -247,52 +446,146 @@ class MultiRaft(RaftLog):
         self.logger = logger or _logging.getLogger("nomad_tpu.raft")
         self.my_addr = my_addr
         self.pool = pool
-        self._rand = random.Random(hash(my_addr) & 0xFFFF)
+        self._rand = random.Random(hash(my_addr) & 0xFFFFFF)
         self._leader = False  # starts as follower, unlike single-voter
 
-        self.term = 0
-        self.voted_for: Optional[str] = None
-        self.leader_addr: Optional[str] = None
-        # log[i] = (term, msg_type_value, payload_bytes); 1-indexed via offset
-        self.log: List[Tuple[int, int, bytes]] = []
-        self.commit_index = 0
-        self.state = "follower"
-        self.peers: List[str] = [my_addr]
+        self.store = _RaftStore(data_dir)
+        (self.term, self.voted_for, saved_peers, self.base_index,
+         self.base_term, self.log, snap_blob) = self.store.load()
+        if snap_blob is not None:
+            self.fsm.restore(snap_blob)
+        # Only the snapshot prefix is known-committed at boot; WAL entries
+        # beyond it may be uncommitted and are re-committed by the leader.
+        self.commit_index = self.base_index
+        self._last_index = self.base_index  # last *applied*
 
-        self._apply_cond = threading.Condition(self._l)
+        self.leader_addr: Optional[str] = None
+        self.state = "follower"
+        # The voter set comes from the persisted committed configuration;
+        # a fresh server has none and cannot campaign until it is either
+        # gossip-bootstrapped (initial cluster formation) or added to the
+        # cluster through a replicated CONFIG entry.
+        self.peers: List[str] = saved_peers or [my_addr]
+        self._bootstrapped = bool(saved_peers)
+
+        self._futures: dict = {}           # index -> _ApplyFuture
+        self._next: dict = {}              # peer -> next index to send
+        self._match: dict = {}             # peer -> highest replicated index
+        self._repl_events: dict = {}       # peer -> threading.Event
+        self._repl_threads: dict = {}      # peer -> Thread
+
         self._last_contact = 0.0
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
-        self._peer_match = {}
+
+    # -- log shape helpers (caller holds self._l) --------------------------
+
+    def _last_log_index(self) -> int:
+        return self.base_index + len(self.log)
+
+    def _term_at(self, index: int) -> int:
+        if index == self.base_index:
+            return self.base_term
+        if index < self.base_index or index > self._last_log_index():
+            return -1  # unknown (compacted away / beyond end)
+        return self.log[index - self.base_index - 1][1]
+
+    def _entries_from(self, index: int, limit: int) -> List[list]:
+        start = index - self.base_index - 1
+        return self.log[start:start + limit]
 
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
         import time as _time
         self._last_contact = _time.monotonic()
-        t = threading.Thread(target=self._election_loop, name="raft-election",
+        t = threading.Thread(target=self._ticker, name="raft-ticker",
                              daemon=True)
         t.start()
         self._threads.append(t)
 
     def close(self) -> None:
         self._stop.set()
-
-    def set_peers(self, peers: List[str]) -> None:
         with self._l:
+            self._fail_futures(NotLeaderError("shutting down"))
+        for ev in self._repl_events.values():
+            ev.set()
+        self.store.close()
+
+    def bootstrap(self, peers: List[str]) -> None:
+        """Adopt the *initial* voter set and enable elections (serf.go:91
+        maybeBootstrap).  No-op once a configuration exists: later voter
+        changes must replicate through the log (propose_config) so every
+        server's quorum derives from a committed config — unilateral
+        adoption of a private gossip view could produce disjoint quorums
+        and split-brain."""
+        with self._l:
+            if self._bootstrapped:
+                return
             self.peers = sorted(set(peers) | {self.my_addr})
+            self._bootstrapped = True
+            self._persist_meta()
+
+    def propose_config(self, peers: List[str]) -> None:
+        """Leader-only voter-set change via a replicated CONFIG log entry
+        (hashicorp/raft AddVoter; single-config approximation — the leader
+        uses the new config as soon as it is appended, followers on
+        apply)."""
+        import msgpack
+        with self._l:
+            if self.state != "leader":
+                raise NotLeaderError(self.leader_addr or "")
+            peers = sorted(set(peers) | {self.my_addr})
+            if peers == self.peers:
+                return
+            index = self._last_log_index() + 1
+            entry = [index, self.term,
+                     CONFIG_TYPE, msgpack.packb(peers, use_bin_type=True)]
+            self.log.append(entry)
+            self.store.append([entry])
+            fut = _ApplyFuture()
+            self._futures[index] = fut
+            self._adopt_peers(peers)
+            self._advance_commit()
+        self._kick_replicators()
+        fut.wait(self.APPLY_TIMEOUT)
+
+    def _adopt_peers(self, peers: List[str]) -> None:
+        # caller holds self._l
+        added = [p for p in peers if p not in self.peers]
+        self.peers = list(peers)
+        self._bootstrapped = True
+        self._persist_meta()
+        if self.state == "leader":
+            for p in added:
+                if p != self.my_addr:
+                    self._start_replicator(p)
 
     def _quorum(self) -> int:
         return len(self.peers) // 2 + 1
 
+    def is_raft_leader(self) -> bool:
+        with self._l:
+            return self.state == "leader"
+
+    # -- persistence helpers (caller holds self._l) ------------------------
+
+    def _persist_meta(self) -> None:
+        self.store.save_meta(self.term, self.voted_for,
+                             self.peers if self._bootstrapped else [])
+
     # -- RPC entry (RPCServer.raft_handler) --------------------------------
 
     def handle_message(self, msg: dict) -> dict:
+        if self._stop.is_set():
+            raise RuntimeError("raft: node is shut down")
         kind = msg.get("kind")
         if kind == "request_vote":
             return self._on_request_vote(msg)
         if kind == "append_entries":
             return self._on_append_entries(msg)
+        if kind == "install_snapshot":
+            return self._on_install_snapshot(msg)
         raise ValueError(f"unknown raft message kind {kind!r}")
 
     # -- election ----------------------------------------------------------
@@ -301,19 +594,15 @@ class MultiRaft(RaftLog):
         lo, hi = self.ELECTION_TIMEOUT
         return lo + self._rand.random() * (hi - lo)
 
-    def _election_loop(self) -> None:
+    def _ticker(self) -> None:
         import time as _time
         timeout = self._election_timeout()
         while not self._stop.is_set():
-            _time.sleep(0.02)
+            _time.sleep(0.015)
             with self._l:
-                is_leader = self.state == "leader"
+                campaigning_ok = self._bootstrapped and self.state != "leader"
                 since = _time.monotonic() - self._last_contact
-            if is_leader:
-                self._send_heartbeats()
-                _time.sleep(self.HEARTBEAT_INTERVAL)
-                continue
-            if since >= timeout:
+            if campaigning_ok and since >= timeout:
                 self._run_election()
                 timeout = self._election_timeout()
 
@@ -324,9 +613,10 @@ class MultiRaft(RaftLog):
             self.term += 1
             term = self.term
             self.voted_for = self.my_addr
+            self._persist_meta()
             self.leader_addr = None
-            last_index = len(self.log)
-            last_term = self.log[-1][0] if self.log else 0
+            last_index = self._last_log_index()
+            last_term = self._term_at(last_index)
             peers = [p for p in self.peers if p != self.my_addr]
             self._last_contact = _time.monotonic()
         votes = 1
@@ -344,47 +634,105 @@ class MultiRaft(RaftLog):
                 }, channel=RPC_RAFT, timeout=0.5)
             except Exception:
                 return
+            step_down = False
+            with self._l:
+                if reply.get("term", 0) > self.term:
+                    self._step_down(reply["term"])
+                    step_down = True
+            if step_down:
+                done.set()
+                return
             with lock:
                 if reply.get("granted"):
                     votes += 1
                     if votes >= self._quorum():
                         done.set()
-            with self._l:
-                if reply.get("term", 0) > self.term:
-                    self._step_down(reply["term"])
-                    done.set()
 
         threads = [threading.Thread(target=ask, args=(p,), daemon=True)
                    for p in peers]
         for t in threads:
             t.start()
-        if len(self.peers) == 1:
+        if not peers:
             done.set()
         done.wait(timeout=0.6)
+        became_leader = False
         with self._l:
             if self.state == "candidate" and self.term == term \
                     and votes >= self._quorum():
-                self.state = "leader"
-                self.leader_addr = self.my_addr
-                self.logger.info("raft: %s won election for term %d",
-                                 self.my_addr, term)
-        if self.is_raft_leader():
-            self._send_heartbeats()
-            self._set_leader(True)
+                self._become_leader()
+                became_leader = True
+        if became_leader:
+            # Leadership callbacks (broker enable, eval restore, …) run
+            # outside the raft lock: they may apply entries themselves.
+            threading.Thread(target=self._set_leader, args=(True,),
+                             daemon=True).start()
 
-    def is_raft_leader(self) -> bool:
-        with self._l:
-            return self.state == "leader"
+    def _become_leader(self) -> None:
+        # caller holds self._l
+        self.state = "leader"
+        self.leader_addr = self.my_addr
+        self.logger.info("raft: %s won election for term %d",
+                         self.my_addr, self.term)
+        last = self._last_log_index()
+        for p in self.peers:
+            if p == self.my_addr:
+                continue
+            self._next[p] = last + 1
+            self._match[p] = 0
+        # Term-establishment entry (Raft §5.4.2 — a leader never counts
+        # replicas of old-term entries toward commitment directly).  It
+        # carries the current voter configuration so every follower adopts
+        # and persists the committed config (hashicorp/raft re-ships its
+        # LogConfiguration the same way).
+        import msgpack
+        cfg = [last + 1, self.term, CONFIG_TYPE,
+               msgpack.packb(self.peers, use_bin_type=True)]
+        self.log.append(cfg)
+        self.store.append([cfg])
+        for p in self.peers:
+            if p != self.my_addr:
+                self._start_replicator(p)
+        self._advance_commit()
+
+    def _start_replicator(self, peer: str) -> None:
+        # caller holds self._l.  Replicator threads are per-(peer, term):
+        # a thread from an older term is already exiting (its term check
+        # fails), so only an alive *current-term* thread short-circuits.
+        old = self._repl_threads.get(peer)
+        if old is not None and old[0] == self.term and old[1].is_alive():
+            self._repl_events[peer].set()
+            return
+        self._next.setdefault(peer, self._last_log_index() + 1)
+        self._match.setdefault(peer, 0)
+        ev = threading.Event()
+        ev.set()
+        self._repl_events[peer] = ev
+        t = threading.Thread(target=self._replicate_peer,
+                             args=(peer, self.term, ev),
+                             name=f"raft-repl-{peer}", daemon=True)
+        self._repl_threads[peer] = (self.term, t)
+        t.start()
 
     def _step_down(self, term: int) -> None:
         # caller holds self._l
         was_leader = self.state == "leader"
-        self.term = max(self.term, term)
+        if term > self.term:
+            self.term = term
+            self.voted_for = None
+            self._persist_meta()
         self.state = "follower"
-        self.voted_for = None
+        self._fail_futures(NotLeaderError(self.leader_addr or ""))
+        for ev in self._repl_events.values():
+            ev.set()  # wake replicators so they observe the term change
         if was_leader:
             threading.Thread(target=self._set_leader, args=(False,),
                              daemon=True).start()
+
+    def _fail_futures(self, exc: Exception) -> None:
+        # caller holds self._l
+        for fut in self._futures.values():
+            fut.fail(exc)
+        self._futures.clear()
 
     def _on_request_vote(self, msg: dict) -> dict:
         import time as _time
@@ -393,82 +741,162 @@ class MultiRaft(RaftLog):
                 return {"granted": False, "term": self.term}
             if msg["term"] > self.term:
                 self._step_down(msg["term"])
+            my_last = self._last_log_index()
             up_to_date = (
                 msg["last_log_term"], msg["last_log_index"]
-            ) >= (self.log[-1][0] if self.log else 0, len(self.log))
+            ) >= (self._term_at(my_last), my_last)
             if up_to_date and self.voted_for in (None, msg["candidate"]):
                 self.voted_for = msg["candidate"]
+                self._persist_meta()  # durable before granting (Raft §5.2)
                 self._last_contact = _time.monotonic()
                 return {"granted": True, "term": self.term}
             return {"granted": False, "term": self.term}
 
-    # -- replication -------------------------------------------------------
+    # -- leader replication ------------------------------------------------
 
-    def _send_heartbeats(self) -> None:
-        self._replicate_round([])
-
-    def _replicate_round(self, new_entries: List[Tuple[int, int, bytes]],
-                         ) -> bool:
-        """Send AppendEntries to every peer; True if majority acked.
-
-        Simplification vs full Raft: each round ships the entries the
-        leader believes the follower is missing based on the follower's
-        acked index returned in the previous reply (stored per-peer)."""
-        with self._l:
-            term = self.term
-            peers = [p for p in self.peers if p != self.my_addr]
-            commit = self.commit_index
-            log_snapshot = list(self.log)
-        if not peers:
-            return True
-        acks = 1
-        lock = threading.Lock()
-        done = threading.Event()
-        quorum = self._quorum()
-
-        def send(peer):
-            nonlocal acks
-            match = self._peer_match.get(peer, 0)
-            while True:
-                entries = log_snapshot[match:]
-                prev_index = match
-                prev_term = log_snapshot[match - 1][0] if match > 0 else 0
-                try:
-                    from .rpc import RPC_RAFT
-                    reply = self.pool.call(peer, "raft", {
-                        "kind": "append_entries", "term": term,
-                        "leader": self.my_addr,
-                        "prev_log_index": prev_index,
-                        "prev_log_term": prev_term,
-                        "entries": entries,
-                        "leader_commit": commit,
-                    }, channel=RPC_RAFT, timeout=2.0)
-                except Exception:
+    def _replicate_peer(self, peer: str, term: int, kick: threading.Event,
+                        ) -> None:
+        """Per-peer replication loop (hashicorp/raft replicate()): ships
+        missing entries / heartbeats, falls back to InstallSnapshot when the
+        peer is behind the compaction horizon."""
+        from .rpc import RPC_RAFT
+        while not self._stop.is_set():
+            with self._l:
+                if self.state != "leader" or self.term != term:
                     return
-                if reply.get("term", 0) > term:
-                    with self._l:
-                        self._step_down(reply["term"])
-                    done.set()
+                ni = self._next.get(peer, self.base_index + 1)
+                snapshot_needed = ni <= self.base_index
+                if not snapshot_needed:
+                    entries = self._entries_from(ni, self.REPLICATE_BATCH)
+                    prev_index = ni - 1
+                    prev_term = self._term_at(prev_index)
+                    commit = self.commit_index
+            try:
+                if snapshot_needed:
+                    self._send_snapshot(peer, term)
+                    continue
+                reply = self.pool.call(peer, "raft", {
+                    "kind": "append_entries", "term": term,
+                    "leader": self.my_addr,
+                    "prev_log_index": prev_index,
+                    "prev_log_term": prev_term,
+                    "entries": entries,
+                    "leader_commit": commit,
+                }, channel=RPC_RAFT, timeout=2.0)
+            except Exception:
+                kick.clear()
+                kick.wait(0.1)
+                continue
+            with self._l:
+                if reply.get("term", 0) > self.term:
+                    self._step_down(reply["term"])
+                    return
+                if self.state != "leader" or self.term != term:
                     return
                 if reply.get("success"):
-                    self._peer_match[peer] = len(log_snapshot)
-                    with lock:
-                        acks += 1
-                        if acks >= quorum:
-                            done.set()
-                    return
-                # consistency check failed: back off and retry
-                if match == 0:
-                    return
-                match = max(0, reply.get("match", match - 1))
+                    sent_through = prev_index + len(entries)
+                    self._match[peer] = max(self._match.get(peer, 0),
+                                            sent_through)
+                    self._next[peer] = sent_through + 1
+                    self._advance_commit()
+                    more = self._next[peer] <= self._last_log_index()
+                else:
+                    # Consistency check failed: back up using the
+                    # follower's hint (accelerated log backtracking).  A
+                    # hint behind our compaction horizon means the entries
+                    # it needs are gone — ship a snapshot instead.
+                    hint = reply.get("match", prev_index - 1)
+                    if hint < self.base_index:
+                        self._next[peer] = self.base_index
+                    else:
+                        self._next[peer] = max(self.base_index + 1,
+                                               min(hint + 1, ni - 1))
+                    more = True
+            if not more:
+                kick.clear()
+                kick.wait(self.HEARTBEAT_INTERVAL)
 
-        threads = [threading.Thread(target=send, args=(p,), daemon=True)
-                   for p in peers]
-        for t in threads:
-            t.start()
-        done.wait(timeout=3.0)
-        with lock:
-            return acks >= quorum
+    def _send_snapshot(self, peer: str, term: int) -> None:
+        """InstallSnapshot for a peer behind the log horizon."""
+        from .rpc import RPC_RAFT
+        with self._l:
+            if self.state != "leader" or self.term != term:
+                return
+            blob = self.fsm.snapshot()
+            last_index = self._last_index
+            last_term = self._term_at(last_index)
+            if last_term < 0:
+                last_term = self.base_term
+        try:
+            reply = self.pool.call(peer, "raft", {
+                "kind": "install_snapshot", "term": term,
+                "leader": self.my_addr,
+                "last_index": last_index, "last_term": last_term,
+                "peers": self.peers,  # config rides the snapshot
+                "data": blob,
+            }, channel=RPC_RAFT, timeout=10.0)
+        except Exception:
+            self._repl_events[peer].clear()
+            self._repl_events[peer].wait(0.2)
+            return
+        with self._l:
+            if reply.get("term", 0) > self.term:
+                self._step_down(reply["term"])
+                return
+            self._match[peer] = max(self._match.get(peer, 0), last_index)
+            self._next[peer] = last_index + 1
+            self._advance_commit()
+
+    def _kick_replicators(self) -> None:
+        with self._l:
+            events = list(self._repl_events.values())
+        for ev in events:
+            ev.set()
+
+    def _advance_commit(self) -> None:
+        """Majority-match commit advancement; only current-term entries
+        commit by counting (Raft §5.4.2).  Caller holds self._l."""
+        if self.state != "leader":
+            return
+        matches = sorted(
+            [self._last_log_index()]
+            + [self._match.get(p, 0) for p in self.peers if p != self.my_addr]
+        )
+        n = matches[len(matches) - self._quorum()]
+        if n > self.commit_index and self._term_at(n) == self.term:
+            self.commit_index = n
+            self._apply_to(self.commit_index)
+
+    def _apply_to(self, target: int) -> None:
+        """Apply committed entries through ``target`` in index order,
+        resolving apply futures.  Caller holds self._l."""
+        from .log_codec import decode_payload
+        while self._last_index < target:
+            idx = self._last_index + 1
+            _eidx, _eterm, mt, blob = self.log[idx - self.base_index - 1]
+            result = None
+            if mt == CONFIG_TYPE:
+                import msgpack
+                peers = msgpack.unpackb(blob, raw=False)
+                if peers != self.peers:
+                    self._adopt_peers(peers)
+                else:
+                    self._bootstrapped = True
+                    self._persist_meta()
+            elif mt != NOOP_TYPE:
+                try:
+                    result = self.fsm.apply(idx, MessageType(mt),
+                                            decode_payload(blob))
+                except Exception:
+                    self.logger.exception("raft: fsm apply failed at %d", idx)
+            self._last_index = idx
+            fut = self._futures.pop(idx, None)
+            if fut is not None:
+                fut.resolve(result)
+        if len(self.log) > self.SNAPSHOT_THRESHOLD:
+            self._compact()
+
+    # -- follower side -----------------------------------------------------
 
     def _on_append_entries(self, msg: dict) -> dict:
         import time as _time
@@ -477,63 +905,111 @@ class MultiRaft(RaftLog):
                 return {"success": False, "term": self.term}
             if msg["term"] > self.term or self.state != "follower":
                 self._step_down(msg["term"])
-            self.term = msg["term"]
+                self.term = msg["term"]
+                self._persist_meta()
             self.leader_addr = msg["leader"]
             self._last_contact = _time.monotonic()
 
             prev_index = msg["prev_log_index"]
             prev_term = msg["prev_log_term"]
-            if prev_index > len(self.log):
+            entries = [list(e) for e in msg["entries"]]
+            # Anything at or before our snapshot base is already committed
+            # here; skip those entries and anchor at the base.
+            if prev_index < self.base_index:
+                entries = [e for e in entries if e[0] > self.base_index]
+                prev_index = self.base_index
+                prev_term = self.base_term
+            if prev_index > self._last_log_index():
                 return {"success": False, "term": self.term,
-                        "match": len(self.log)}
-            if prev_index > 0 and self.log[prev_index - 1][0] != prev_term:
+                        "match": self._last_log_index()}
+            if self._term_at(prev_index) != prev_term:
                 return {"success": False, "term": self.term,
-                        "match": max(0, prev_index - 1)}
-            # truncate conflicts, append new
-            entries = [tuple(e) for e in msg["entries"]]
-            self.log = self.log[:prev_index] + entries
-            # advance commit + apply
-            new_commit = min(msg["leader_commit"], len(self.log))
-            self._apply_committed(new_commit)
+                        "match": max(self.base_index, prev_index - 1)}
+            # Truncate conflicts, then append the new suffix with ONE
+            # durable write (one fsync per RPC, not per entry).
+            append_from = None
+            for k, e in enumerate(entries):
+                pos = e[0] - self.base_index - 1
+                if pos < len(self.log):
+                    if self.log[pos][1] != e[1]:
+                        del self.log[pos:]
+                        self.store.rewrite(self.log)
+                        append_from = k
+                        break
+                    # identical entry already present — skip
+                else:
+                    append_from = k
+                    break
+            if append_from is not None:
+                new = entries[append_from:]
+                self.log.extend(new)
+                self.store.append(new)
+            new_commit = min(msg["leader_commit"], self._last_log_index())
+            if new_commit > self.commit_index:
+                self.commit_index = new_commit
+                self._apply_to(new_commit)
             return {"success": True, "term": self.term,
-                    "match": len(self.log)}
+                    "match": self._last_log_index()}
 
-    def _apply_committed(self, new_commit: int) -> None:
-        # caller holds self._l
-        while self.commit_index < new_commit:
-            self.commit_index += 1
-            term, mt, blob = self.log[self.commit_index - 1]
-            payload = pickle.loads(blob)
-            self._last_index = self.commit_index
-            try:
-                self.fsm.apply(self.commit_index, MessageType(mt), payload)
-            except Exception:
-                self.logger.exception("raft: fsm apply failed at %d",
-                                      self.commit_index)
+    def _on_install_snapshot(self, msg: dict) -> dict:
+        import time as _time
+        with self._l:
+            if msg["term"] < self.term:
+                return {"term": self.term}
+            if msg["term"] > self.term or self.state != "follower":
+                self._step_down(msg["term"])
+                self.term = msg["term"]
+                self._persist_meta()
+            self.leader_addr = msg["leader"]
+            self._last_contact = _time.monotonic()
+            self.fsm.restore(msg["data"])
+            if msg.get("peers"):
+                self._adopt_peers(list(msg["peers"]))
+            self.base_index = msg["last_index"]
+            self.base_term = msg["last_term"]
+            self.log = []
+            self.store.save_snapshot(self.base_index, self.base_term,
+                                     msg["data"])
+            self.store.rewrite([])
+            self.commit_index = self.base_index
+            self._last_index = self.base_index
+            return {"term": self.term, "success": True}
+
+    # -- compaction --------------------------------------------------------
+
+    def _compact(self) -> None:
+        """Snapshot the FSM at the applied index and drop covered entries.
+        Caller holds self._l."""
+        applied = self._last_index
+        if applied <= self.base_index:
+            return
+        blob = self.fsm.snapshot()
+        new_base_term = self._term_at(applied)
+        self.log = self.log[applied - self.base_index:]
+        self.base_index = applied
+        self.base_term = new_base_term
+        self.store.save_snapshot(applied, new_base_term, blob)
+        self.store.rewrite(self.log)
+
+    def snapshot(self) -> None:
+        with self._l:
+            self._compact()
 
     # -- the apply path ----------------------------------------------------
 
     def apply(self, msg_type: MessageType, payload: dict):
+        from .log_codec import encode_payload
         with self._l:
             if self.state != "leader":
                 raise NotLeaderError(self.leader_addr or "")
-            blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
-            self.log.append((self.term, msg_type.value, blob))
-            index = len(self.log)
-        ok = self._replicate_round([])
-        with self._l:
-            if not ok or self.state != "leader":
-                raise NotLeaderError(self.leader_addr or "")
-            result = None
-            if self.commit_index < index:
-                # commit everything up to and including this entry
-                target = index
-                while self.commit_index < target:
-                    self.commit_index += 1
-                    t_, mt_, blob_ = self.log[self.commit_index - 1]
-                    p_ = pickle.loads(blob_)
-                    self._last_index = self.commit_index
-                    r_ = self.fsm.apply(self.commit_index, MessageType(mt_), p_)
-                    if self.commit_index == target:
-                        result = r_
-            return result, index
+            blob = encode_payload(payload)
+            index = self._last_log_index() + 1
+            entry = [index, self.term, int(msg_type), blob]
+            self.log.append(entry)
+            self.store.append([entry])
+            fut = _ApplyFuture()
+            self._futures[index] = fut
+            self._advance_commit()  # single-voter clusters commit here
+        self._kick_replicators()
+        result = fut.wait(self.APPLY_TIMEOUT)
+        return result, index

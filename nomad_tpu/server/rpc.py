@@ -86,20 +86,29 @@ class RPCServer:
         self.raft_handler: Optional[Callable[[Any], Any]] = None
         outer = self
 
+        self._active: set = set()
+        self._active_lock = threading.Lock()
+
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 sock = self.request
+                with outer._active_lock:
+                    outer._active.add(sock)
                 try:
-                    prefix = _recv_exact(sock, 1)[0]
-                except (ConnectionError, OSError):
-                    return
-                if prefix == RPC_NOMAD:
-                    outer._serve_nomad(sock)
-                elif prefix == RPC_RAFT:
-                    outer._serve_raft(sock)
-                else:
-                    outer.logger.warning("rpc: unrecognized protocol byte %#x",
-                                         prefix)
+                    try:
+                        prefix = _recv_exact(sock, 1)[0]
+                    except (ConnectionError, OSError):
+                        return
+                    if prefix == RPC_NOMAD:
+                        outer._serve_nomad(sock)
+                    elif prefix == RPC_RAFT:
+                        outer._serve_raft(sock)
+                    else:
+                        outer.logger.warning(
+                            "rpc: unrecognized protocol byte %#x", prefix)
+                finally:
+                    with outer._active_lock:
+                        outer._active.discard(sock)
 
         class Server(socketserver.ThreadingTCPServer):
             daemon_threads = True
@@ -121,6 +130,21 @@ class RPCServer:
     def shutdown(self) -> None:
         self.tcp.shutdown()
         self.tcp.server_close()
+        # Established connections must die with the server: a peer's pooled
+        # connection left open would keep talking to this dead instance's
+        # in-memory state instead of reconnecting to its successor.
+        with self._active_lock:
+            conns = list(self._active)
+            self._active.clear()
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def register(self, method: str, fn: Callable[[Any], Any]) -> None:
         self.methods[method] = fn
@@ -190,7 +214,8 @@ class _Conn:
             _send_frame(self.sock, [seq, method, body])
             rseq, err, reply = _recv_frame(self.sock)
         if rseq != seq:
-            raise RPCError(f"rpc: sequence mismatch ({rseq} != {seq})")
+            # Desynchronized stream — the connection is unusable.
+            raise ConnectionError(f"rpc: sequence mismatch ({rseq} != {seq})")
         if err:
             if isinstance(err, str) and err.startswith("__no_leader__:"):
                 raise NoLeaderError(err.split(":", 1)[1])
@@ -205,11 +230,19 @@ class _Conn:
 
 
 class ConnPool:
-    """Connection reuse per (addr, channel) (pool.go:144)."""
+    """Connection reuse per (addr, channel) (pool.go:144).
+
+    Hands out *parallel* connections: a call checks out an idle connection
+    (or dials a new one) and returns it afterwards, so a long-poll holding
+    one connection cannot starve short calls — the role yamux stream
+    multiplexing plays in the reference (pool.go getClient + yamux
+    Session.Open)."""
+
+    MAX_IDLE_PER_KEY = 4
 
     def __init__(self, timeout: float = 10.0):
         self.timeout = timeout
-        self._conns: Dict[Tuple[str, int], _Conn] = {}
+        self._idle: Dict[Tuple[str, int], List[_Conn]] = {}
         self._lock = threading.Lock()
 
     def call(self, addr: str, method: str, body: Any,
@@ -217,25 +250,40 @@ class ConnPool:
         timeout = timeout if timeout is not None else self.timeout
         key = (addr, channel)
         with self._lock:
-            conn = self._conns.get(key)
+            bucket = self._idle.get(key)
+            conn = bucket.pop() if bucket else None
         if conn is None:
-            conn = _Conn(addr, channel, timeout)
-            with self._lock:
-                self._conns[key] = conn
+            try:
+                conn = _Conn(addr, channel, timeout)
+            except OSError as e:
+                raise RPCError(f"rpc to {addr} failed: {e}") from e
         try:
-            return conn.call(method, body, timeout)
+            reply = conn.call(method, body, timeout)
         except (ConnectionError, OSError) as e:
-            with self._lock:
-                if self._conns.get(key) is conn:
-                    del self._conns[key]
             conn.close()
             raise RPCError(f"rpc to {addr} failed: {e}") from e
+        except RPCError:
+            # Application-level error reply: the transport is still healthy,
+            # keep the connection pooled.
+            self._release(key, conn)
+            raise
+        self._release(key, conn)
+        return reply
+
+    def _release(self, key: Tuple[str, int], conn: _Conn) -> None:
+        with self._lock:
+            bucket = self._idle.setdefault(key, [])
+            if len(bucket) < self.MAX_IDLE_PER_KEY:
+                bucket.append(conn)
+                return
+        conn.close()
 
     def close(self) -> None:
         with self._lock:
-            for conn in self._conns.values():
-                conn.close()
-            self._conns.clear()
+            for bucket in self._idle.values():
+                for conn in bucket:
+                    conn.close()
+            self._idle.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -281,11 +329,11 @@ class RemoteServerRPC:
         return reply["Index"], reply["HeartbeatTTL"]
 
     def node_get_client_allocs(self, node_id: str, min_index: int = 0,
-                               timeout: float = 30.0):
+                               max_wait: float = 30.0):
         from ..structs import structs as s
         reply = self._call("Node.GetClientAllocs",
                            {"NodeID": node_id, "MinQueryIndex": min_index,
-                            "MaxQueryTime": timeout})
+                            "MaxQueryTime": max_wait})
         allocs = [self._from_wire(s.Allocation, a)
                   for a in reply["Allocs"] or []]
         return allocs, reply["Index"]

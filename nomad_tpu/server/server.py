@@ -7,6 +7,7 @@ nomad/*_endpoint.go).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -21,7 +22,7 @@ from .heartbeat import HeartbeatTimers
 from .periodic import PeriodicDispatch, derive_job
 from .plan_apply import PlanApplier
 from .plan_queue import PlanQueue
-from .raft import FileLog, InmemLog, RaftLog
+from .raft import FileLog, InmemLog, MultiRaft, NotLeaderError, RaftLog
 from .worker import BatchWorker, Worker
 
 
@@ -79,10 +80,57 @@ class Server:
             on_job_register=self._fsm_job_registered,
             on_job_deregister=self._fsm_job_deregistered,
         )
-        if self.config.data_dir:
-            self.raft: RaftLog = FileLog(self.fsm, self.config.data_dir)
+
+        # RPC listener + connection pool (nomad/server.go:250 setupRPC).
+        # Bound in __init__ so the advertised address is known before raft
+        # construction; served from start().
+        self.rpc = None
+        self.pool = None
+        self._members: Dict[str, Dict] = {}
+        self._members_lock = threading.Lock()
+        # Per-thread marker set while serving a request that was already
+        # forwarded once (endpoints.py); blocks a second hop.
+        self._fwd_ctx = threading.local()
+        if self.config.enable_rpc:
+            from .rpc import ConnPool, RPCServer
+
+            self.pool = ConnPool()
+            self.rpc = RPCServer(host=self.config.rpc_bind,
+                                 port=self.config.rpc_port,
+                                 logger=self.logger.getChild("rpc"))
+            # Advertise the configured host (never a wildcard bind) with
+            # the actually-bound port (config.go AdvertiseAddrs).
+            adv_host = ""
+            if self.config.rpc_advertise:
+                adv_host = self.config.rpc_advertise.rsplit(":", 1)[0]
+            if not adv_host or adv_host == "0.0.0.0":
+                adv_host = (self.config.rpc_bind
+                            if self.config.rpc_bind != "0.0.0.0"
+                            else "127.0.0.1")
+            self.config.rpc_advertise = f"{adv_host}:{self.rpc.port}"
+
+        # Consensus (server.go:257 setupRaft): multi-server raft when
+        # clustering is configured, else the single-voter WAL / in-memory
+        # log (raftInmem dev path).
+        multi = self.config.enable_rpc and (
+            self.config.bootstrap_expect > 1 or bool(self.config.start_join))
+        if multi:
+            raft_dir = (os.path.join(self.config.data_dir, "raft")
+                        if self.config.data_dir else None)
+            self.raft: RaftLog = MultiRaft(
+                self.fsm, self.config.rpc_advertise, self.pool,
+                data_dir=raft_dir, logger=self.logger.getChild("raft"))
+        elif self.config.data_dir:
+            self.raft = FileLog(self.fsm, self.config.data_dir)
         else:
             self.raft = InmemLog(self.fsm)
+
+        if self.rpc is not None:
+            from .endpoints import register_endpoints
+
+            register_endpoints(self, self.rpc)
+            if isinstance(self.raft, MultiRaft):
+                self.rpc.raft_handler = self.raft.handle_message
 
         self.plan_applier = PlanApplier(self.plan_queue, self.raft, self.logger)
         self.heartbeat = HeartbeatTimers(
@@ -98,8 +146,19 @@ class Server:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
-        """Boot: start workers and acquire (single-voter) leadership
-        (server.go:272 setupWorkers + leader.go:28 monitorLeadership)."""
+        """Boot: serve RPC, start raft + membership, start workers, and
+        monitor leadership (server.go:250-284 setupRPC/setupRaft/setupSerf/
+        setupWorkers + leader.go:28 monitorLeadership)."""
+        if self.rpc is not None:
+            self.rpc.start()
+            self._merge_members([self._self_member()])
+        if isinstance(self.raft, MultiRaft):
+            self.raft.start()
+            self._maybe_bootstrap()
+            if self.config.start_join:
+                t = threading.Thread(target=self._join_loop, daemon=True,
+                                     name="serf-join")
+                t.start()
         for i in range(self.config.num_schedulers):
             if self.config.use_tpu_batch_worker:
                 worker: Worker = BatchWorker(
@@ -120,6 +179,7 @@ class Server:
 
     def shutdown(self) -> None:
         self._shutdown.set()
+        self._leader = False
         for worker in self.workers:
             worker.stop()
         self.plan_applier.stop()
@@ -129,6 +189,127 @@ class Server:
         self.periodic.set_enabled(False)
         self.heartbeat.set_enabled(False)
         self.raft.close()
+        if self.rpc is not None:
+            self.rpc.shutdown()
+        if self.pool is not None:
+            self.pool.close()
+
+    # -- membership (serf-lite: nomad/serf.go over the RPC port) -----------
+
+    def _self_member(self) -> Dict:
+        return {"Name": self.config.node_name,
+                "Addr": self.config.rpc_advertise,
+                "Region": self.config.region,
+                "Status": "alive"}
+
+    def members(self) -> List[Dict]:
+        """(serf.Members / nomad/serf.go peer table)."""
+        with self._members_lock:
+            return sorted(self._members.values(), key=lambda m: m["Name"])
+
+    def membership_join(self, member: Dict) -> Dict:
+        """Handle a Serf.Join from a peer: merge, gossip the change, and
+        return the full member list (serf.go:51 nodeJoin)."""
+        self._merge_members([member])
+        return {"Members": self.members()}
+
+    def _merge_members(self, incoming: List[Dict]) -> None:
+        """Merge member records; on change, push our view to peers (the
+        gossip dissemination step) and re-check bootstrap
+        (serf.go:91 maybeBootstrap)."""
+        added = []
+        with self._members_lock:
+            for m in incoming:
+                name = m.get("Name")
+                if not name or not m.get("Addr"):
+                    continue
+                if name not in self._members:
+                    added.append(m)
+                self._members[name] = dict(m)
+            view = list(self._members.values())
+        if not added:
+            return
+        self.logger.info("server: membership now %d members (+%s)",
+                         len(view), ",".join(m["Name"] for m in added))
+        self._maybe_bootstrap()
+        if self.pool is not None:
+            threading.Thread(target=self._push_members, args=(view,),
+                             daemon=True).start()
+
+    def _push_members(self, view: List[Dict]) -> None:
+        """Anti-entropy push: send every member we know to every peer.
+        Receivers that learn nothing new do not re-push, so this
+        terminates."""
+        me = self.config.rpc_advertise
+        for m in view:
+            addr = m["Addr"]
+            if addr == me:
+                continue
+            for peer in view:
+                try:
+                    self.pool.call(addr, "Serf.Join", {"Member": peer},
+                                   timeout=1.0)
+                except Exception:
+                    break  # peer unreachable; heartbeat/rejoin recovers
+
+    def _maybe_bootstrap(self) -> None:
+        """Initial cluster formation + config growth (serf.go:91
+        maybeBootstrap).
+
+        Only a *seed* server (no start_join) may adopt the initial voter
+        set from its gossip view, and only once bootstrap_expect members
+        are alive.  A joining server waits to be added by the leader via a
+        replicated CONFIG entry — self-assembling a quorum from a private
+        member view could create a second, disjoint quorum (split-brain).
+        After bootstrap, the leader proposes a config change whenever
+        gossip surfaces members that are not yet voters (raft AddVoter)."""
+        if not isinstance(self.raft, MultiRaft):
+            return
+        with self._members_lock:
+            addrs = [m["Addr"] for m in self._members.values()]
+        if not self.raft._bootstrapped:
+            if self.config.start_join:
+                return
+            if len(addrs) >= self.config.bootstrap_expect:
+                self.raft.bootstrap(addrs)
+            return
+        if self.raft.is_raft_leader():
+            new = sorted(set(self.raft.peers) | set(addrs))
+            if new != sorted(self.raft.peers):
+                def _propose():
+                    try:
+                        self.raft.propose_config(new)
+                    except Exception as e:
+                        self.logger.warning(
+                            "server: config change failed: %s", e)
+                threading.Thread(target=_propose, daemon=True).start()
+
+    def _join_loop(self) -> None:
+        """Retry start_join addresses until each answers — indefinitely,
+        with capped backoff, like the agent's retry_join: a cluster whose
+        members boot far apart must still converge."""
+        pending = list(self.config.start_join)
+        me = self._self_member()
+        delay = 0.25
+        attempts = 0
+        while not self._shutdown.is_set() and pending:
+            still = []
+            for addr in pending:
+                try:
+                    reply = self.pool.call(addr, "Serf.Join", {"Member": me},
+                                           timeout=1.0)
+                    self._merge_members(reply.get("Members") or [])
+                except Exception:
+                    still.append(addr)
+            pending = still
+            if pending:
+                attempts += 1
+                if attempts % 20 == 0:
+                    self.logger.warning(
+                        "server: still unable to join %s after %d attempts",
+                        ",".join(pending), attempts)
+                self._shutdown.wait(delay)
+                delay = min(delay * 1.5, 5.0)
 
     def is_leader(self) -> bool:
         return self._leader
@@ -157,6 +338,9 @@ class Server:
         self._restore_evals()
         self._restore_periodic_dispatcher()
         self._start_reapers()
+        # Reconcile voters with members discovered while we were a
+        # follower (leader.go establishes raft config on leadership).
+        self._maybe_bootstrap()
 
     def _revoke_leadership(self) -> None:
         self._leader = False
@@ -294,6 +478,22 @@ class Server:
     # RPC endpoint surface (reference: nomad/*_endpoint.go)
     # ======================================================================
 
+    def _forward(self, wire_method: str, body: Dict):
+        """Re-issue a write that hit NotLeaderError as a wire RPC to the
+        leader (nomad/rpc.go:178 forward) — this is what lets the HTTP API
+        of a follower serve writes.  Raises NotLeaderError when there is no
+        known leader, no wire transport, or the request already took its
+        one forwarding hop (the reference's Forwarded flag: a request must
+        not chain through a trail of stale leader pointers)."""
+        leader = self.leader_address()
+        if (self.pool is None or not leader
+                or leader == self.config.rpc_advertise
+                or getattr(self._fwd_ctx, "active", False)):
+            raise NotLeaderError(leader)
+        body = dict(body)
+        body["__forwarded__"] = True
+        return self.pool.call(leader, wire_method, body)
+
     # -- Job ---------------------------------------------------------------
 
     def job_register(self, job: s.Job) -> Tuple[int, str]:
@@ -305,7 +505,12 @@ class Server:
         if problems:
             raise ValueError("job validation failed: " + "; ".join(problems))
 
-        _, index = self.raft.apply(MessageType.JOB_REGISTER, {"job": job})
+        try:
+            _, index = self.raft.apply(MessageType.JOB_REGISTER, {"job": job})
+        except NotLeaderError:
+            from ..api.codec import to_wire
+            reply = self._forward("Job.Register", {"Job": to_wire(job)})
+            return reply["Index"], reply["EvalID"]
 
         eval_id = ""
         if not job.is_periodic() and not job.is_parameterized():
@@ -327,8 +532,13 @@ class Server:
         job = self.state.job_by_id(None, job_id)
         if job is None:
             raise KeyError(f"job not found: {job_id}")
-        _, index = self.raft.apply(MessageType.JOB_DEREGISTER,
-                                   {"job_id": job_id, "purge": purge})
+        try:
+            _, index = self.raft.apply(MessageType.JOB_DEREGISTER,
+                                       {"job_id": job_id, "purge": purge})
+        except NotLeaderError:
+            reply = self._forward("Job.Deregister",
+                                  {"JobID": job_id, "Purge": purge})
+            return reply["Index"], reply["EvalID"]
         eval_id = ""
         if not job.is_periodic() and not job.is_parameterized():
             ev = s.Evaluation(
@@ -397,6 +607,13 @@ class Server:
         return resp
 
     def periodic_force(self, job_id: str) -> Optional[s.Job]:
+        if not self._leader:
+            reply = self._forward("Periodic.Force", {"JobID": job_id})
+            child_id = reply.get("ChildJobID", "")
+            if not child_id:
+                return None
+            child = self.state.job_by_id(None, child_id)
+            return child or s.Job(id=child_id, name=child_id)
         return self.periodic.force_run(job_id)
 
     def job_evaluate(self, job_id: str) -> Tuple[int, str]:
@@ -413,7 +630,11 @@ class Server:
             id=s.generate_uuid(), priority=job.priority, type=job.type,
             triggered_by=s.EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
             job_modify_index=job.modify_index, status=s.EVAL_STATUS_PENDING)
-        _, index = self.raft.apply(MessageType.EVAL_UPDATE, {"evals": [ev]})
+        try:
+            _, index = self.raft.apply(MessageType.EVAL_UPDATE, {"evals": [ev]})
+        except NotLeaderError:
+            reply = self._forward("Job.Evaluate", {"JobID": job_id})
+            return reply["Index"], reply["EvalID"]
         return index, ev.id
 
     def job_dispatch(self, job_id: str, payload: bytes,
@@ -456,7 +677,14 @@ class Server:
         child.meta = dict(parent.meta)
         child.meta.update(meta)
         child.status = s.JOB_STATUS_PENDING
-        _, index = self.raft.apply(MessageType.JOB_REGISTER, {"job": child})
+        try:
+            _, index = self.raft.apply(MessageType.JOB_REGISTER, {"job": child})
+        except NotLeaderError:
+            reply = self._forward("Job.Dispatch",
+                                  {"JobID": job_id, "Payload": payload,
+                                   "Meta": meta})
+            return (reply["Index"], reply["DispatchedJobID"],
+                    reply["EvalID"])
         ev = s.Evaluation(
             id=s.generate_uuid(), priority=child.priority, type=child.type,
             triggered_by=s.EVAL_TRIGGER_JOB_REGISTER, job_id=child.id,
@@ -470,27 +698,40 @@ class Server:
         node = self.state.node_by_id(None, node_id)
         if node is None:
             raise KeyError(f"node not found: {node_id}")
-        return self._create_node_evals(node_id, node.modify_index)
+        try:
+            return self._create_node_evals(node_id, node.modify_index)
+        except NotLeaderError:
+            return self._forward("Node.Evaluate",
+                                 {"NodeID": node_id})["EvalIDs"]
 
     # -- status / operator -------------------------------------------------
 
     def leader_address(self) -> str:
+        """Best-known leader RPC address (Status.Leader,
+        status_endpoint.go)."""
+        if isinstance(self.raft, MultiRaft):
+            return self.raft.leader_addr or ""
         return self.config.rpc_advertise if self.is_leader() else ""
 
     def peer_addresses(self) -> List[str]:
+        if isinstance(self.raft, MultiRaft):
+            return list(self.raft.peers)
         return [self.config.rpc_advertise]
 
     def raft_configuration(self) -> Dict:
-        return {
-            "Servers": [{
-                "ID": self.config.node_name,
-                "Node": self.config.node_name,
-                "Address": self.config.rpc_advertise,
-                "Leader": self.is_leader(),
+        leader = self.leader_address()
+        servers = []
+        members = self.members() or [self._self_member()]
+        for m in members:
+            servers.append({
+                "ID": m["Name"],
+                "Node": m["Name"],
+                "Address": m["Addr"],
+                "Leader": m["Addr"] == leader if leader else (
+                    m["Name"] == self.config.node_name and self.is_leader()),
                 "Voter": True,
-            }],
-            "Index": self.raft.applied_index(),
-        }
+            })
+        return {"Servers": servers, "Index": self.raft.applied_index()}
 
     # -- Node --------------------------------------------------------------
 
@@ -502,7 +743,13 @@ class Server:
         existed = self.state.node_by_id(None, node.id)
         if not node.status:
             node.status = s.NODE_STATUS_INIT
-        _, index = self.raft.apply(MessageType.NODE_REGISTER, {"node": node})
+        try:
+            _, index = self.raft.apply(MessageType.NODE_REGISTER,
+                                       {"node": node})
+        except NotLeaderError:
+            from ..api.codec import to_wire
+            reply = self._forward("Node.Register", {"Node": to_wire(node)})
+            return reply["Index"], reply["HeartbeatTTL"]
         ttl = self.heartbeat.reset_heartbeat_timer(node.id)
         # Transitions create node evals (node_endpoint.go:165).
         if existed is not None and existed.status != node.status:
@@ -510,7 +757,11 @@ class Server:
         return index, ttl
 
     def node_deregister(self, node_id: str) -> int:
-        _, index = self.raft.apply(MessageType.NODE_DEREGISTER, {"node_id": node_id})
+        try:
+            _, index = self.raft.apply(MessageType.NODE_DEREGISTER,
+                                       {"node_id": node_id})
+        except NotLeaderError:
+            return self._forward("Node.Deregister", {"NodeID": node_id})["Index"]
         self.heartbeat.clear_heartbeat_timer(node_id)
         self._create_node_evals(node_id, index)
         return index
@@ -520,6 +771,14 @@ class Server:
         node = self.state.node_by_id(None, node_id)
         if node is None:
             raise KeyError(f"node not found: {node_id}")
+        if not self._leader:
+            # Forward even when the status is unchanged: the heartbeat TTL
+            # timer lives on the leader, and a follower acking a heartbeat
+            # without resetting it would let the leader mark a healthy
+            # node down (node_endpoint.go:277 forwards before anything).
+            reply = self._forward("Node.UpdateStatus",
+                                  {"NodeID": node_id, "Status": status})
+            return reply["Index"], reply["HeartbeatTTL"]
         index = self.raft.applied_index()
         if node.status != status:
             _, index = self.raft.apply(
@@ -551,8 +810,13 @@ class Server:
         node = self.state.node_by_id(None, node_id)
         if node is None:
             raise KeyError(f"node not found: {node_id}")
-        _, index = self.raft.apply(
-            MessageType.NODE_UPDATE_DRAIN, {"node_id": node_id, "drain": drain})
+        try:
+            _, index = self.raft.apply(
+                MessageType.NODE_UPDATE_DRAIN,
+                {"node_id": node_id, "drain": drain})
+        except NotLeaderError:
+            return self._forward("Node.UpdateDrain",
+                                 {"NodeID": node_id, "Drain": drain})["Index"]
         if drain:
             self._create_node_evals(node_id, index)
         return index
@@ -615,20 +879,36 @@ class Server:
 
     def node_update_allocs(self, allocs: List[s.Allocation]) -> int:
         """Client alloc status sync (node_endpoint.go:657 UpdateAlloc)."""
-        _, index = self.raft.apply(MessageType.ALLOC_CLIENT_UPDATE,
-                                   {"allocs": allocs})
+        try:
+            _, index = self.raft.apply(MessageType.ALLOC_CLIENT_UPDATE,
+                                       {"allocs": allocs})
+        except NotLeaderError:
+            from ..api.codec import to_wire
+            return self._forward(
+                "Node.UpdateAlloc",
+                {"Allocs": [to_wire(a) for a in allocs]})["Index"]
         return index
 
     # -- Eval --------------------------------------------------------------
 
+    def _require_leader(self) -> None:
+        """Leader-only subsystems (broker/plan queue) live on the leader;
+        callers on a follower get NotLeaderError, which the RPC endpoint
+        layer turns into a forward (nomad/rpc.go:178)."""
+        if not self._leader:
+            raise NotLeaderError(self.leader_address())
+
     def eval_dequeue(self, schedulers: List[str],
                      timeout: float = 0.0) -> Tuple[Optional[s.Evaluation], str]:
+        self._require_leader()
         return self.eval_broker.dequeue(schedulers, timeout)
 
     def eval_ack(self, eval_id: str, token: str) -> None:
+        self._require_leader()
         self.eval_broker.ack(eval_id, token)
 
     def eval_nack(self, eval_id: str, token: str) -> None:
+        self._require_leader()
         self.eval_broker.nack(eval_id, token)
 
     def eval_get(self, eval_id: str) -> Optional[s.Evaluation]:
@@ -652,15 +932,22 @@ class Server:
 
     def plan_submit(self, plan: s.Plan):
         """(Plan.Submit → PlanQueue, plan_endpoint.go)."""
+        self._require_leader()
         return self.plan_queue.enqueue(plan)
 
     # -- System ------------------------------------------------------------
 
     def system_gc(self) -> None:
-        self._create_core_eval(s.CORE_JOB_FORCE_GC)
+        try:
+            self._create_core_eval(s.CORE_JOB_FORCE_GC)
+        except NotLeaderError:
+            self._forward("System.GarbageCollect", {})
 
     def system_reconcile_summaries(self) -> None:
-        self.raft.apply(MessageType.RECONCILE_JOB_SUMMARIES, {})
+        try:
+            self.raft.apply(MessageType.RECONCILE_JOB_SUMMARIES, {})
+        except NotLeaderError:
+            self._forward("System.ReconcileJobSummaries", {})
 
     def stats(self) -> Dict:
         return {
