@@ -30,25 +30,19 @@ def register_endpoints(server, rpc) -> None:
 
     def register(method, fn):
         def handler(body):
-            # One hop only (the reference's Forwarded flag, nomad/rpc.go):
-            # an already-forwarded request that still lands on a non-leader
-            # fails instead of bouncing between stale leader pointers.  The
-            # thread-local marker makes Server._forward observe the hop.
+            # Forwarding lives in ONE place: the Server write methods call
+            # Server._forward on NotLeaderError.  This wrapper only (a)
+            # marks an already-forwarded request in a thread-local so
+            # _forward enforces the one-hop rule (the reference's
+            # Forwarded flag, nomad/rpc.go), and (b) translates an
+            # unforwardable NotLeaderError into the wire error.
             forwarded = isinstance(body, dict) and body.pop("__forwarded__",
                                                             False)
             if forwarded:
                 server._fwd_ctx.active = True
             try:
                 return fn(body)
-            except NotLeaderError as e:
-                leader = str(e) or server.leader_address()
-                if not forwarded and leader \
-                        and leader != server.config.rpc_advertise \
-                        and server.pool is not None:
-                    fwd = dict(body) if isinstance(body, dict) else body
-                    if isinstance(fwd, dict):
-                        fwd["__forwarded__"] = True
-                    return server.pool.call(leader, method, fwd)
+            except NotLeaderError:
                 raise NoLeaderError("no cluster leader")
             finally:
                 if forwarded:

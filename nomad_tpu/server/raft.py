@@ -380,13 +380,28 @@ class _RaftStore:
         os.fsync(self._fh.fileno())
 
     def rewrite(self, entries: List[list]) -> None:
-        """Conflict truncation / compaction: replace the whole WAL."""
+        """Conflict truncation / compaction: replace the whole WAL.
+
+        Built atomically (tmp + fsync + rename): truncating the live WAL
+        in place would let a crash mid-rewrite wipe already-acked entries
+        — a follower counted toward an entry's commit quorum must never
+        silently lose it."""
         if not self.dir:
             return
+        import msgpack
+        path = os.path.join(self.dir, "wal")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            for e in entries:
+                blob = msgpack.packb(e, use_bin_type=True)
+                fh.write(_LEN.pack(len(blob)))
+                fh.write(blob)
+            fh.flush()
+            os.fsync(fh.fileno())
         if self._fh is not None:
             self._fh.close()
-        self._fh = open(os.path.join(self.dir, "wal"), "wb")
-        self.append(entries)
+        os.replace(tmp, path)
+        self._fh = open(path, "ab")
 
     def save_snapshot(self, index: int, term: int, blob: bytes) -> None:
         if not self.dir:
@@ -477,6 +492,22 @@ class MultiRaft(RaftLog):
         self._last_contact = 0.0
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        # Leadership transitions are delivered to callbacks strictly in
+        # the order they occurred, by one dispatcher thread.  Spawning a
+        # thread per transition could reorder a win-then-step-down into
+        # step-down-then-win, leaving the server side believing it leads
+        # while raft follows.
+        import queue as _queue
+        self._leader_q: "_queue.Queue" = _queue.Queue()
+
+    def _leader_dispatch_loop(self) -> None:
+        import queue as _queue
+        while not self._stop.is_set():
+            try:
+                val = self._leader_q.get(timeout=0.5)
+            except _queue.Empty:
+                continue
+            self._set_leader(val)
 
     # -- log shape helpers (caller holds self._l) --------------------------
 
@@ -499,10 +530,11 @@ class MultiRaft(RaftLog):
     def start(self) -> None:
         import time as _time
         self._last_contact = _time.monotonic()
-        t = threading.Thread(target=self._ticker, name="raft-ticker",
-                             daemon=True)
-        t.start()
-        self._threads.append(t)
+        for target, name in ((self._ticker, "raft-ticker"),
+                             (self._leader_dispatch_loop, "raft-leadership")):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
 
     def close(self) -> None:
         self._stop.set()
@@ -655,17 +687,14 @@ class MultiRaft(RaftLog):
         if not peers:
             done.set()
         done.wait(timeout=0.6)
-        became_leader = False
         with self._l:
             if self.state == "candidate" and self.term == term \
                     and votes >= self._quorum():
                 self._become_leader()
-                became_leader = True
-        if became_leader:
-            # Leadership callbacks (broker enable, eval restore, …) run
-            # outside the raft lock: they may apply entries themselves.
-            threading.Thread(target=self._set_leader, args=(True,),
-                             daemon=True).start()
+                # Callbacks (broker enable, eval restore, …) run on the
+                # ordered dispatcher thread, outside the raft lock: they
+                # may apply entries themselves.
+                self._leader_q.put(True)
 
     def _become_leader(self) -> None:
         # caller holds self._l
@@ -725,8 +754,7 @@ class MultiRaft(RaftLog):
         for ev in self._repl_events.values():
             ev.set()  # wake replicators so they observe the term change
         if was_leader:
-            threading.Thread(target=self._set_leader, args=(False,),
-                             daemon=True).start()
+            self._leader_q.put(False)
 
     def _fail_futures(self, exc: Exception) -> None:
         # caller holds self._l

@@ -103,7 +103,7 @@ def find_leader(servers):
     return None
 
 
-def wait_for_leader(servers, timeout=10.0):
+def wait_for_leader(servers, timeout=30.0):
     assert wait_until(lambda: find_leader(servers) is not None, timeout), \
         "no leader elected"
     return find_leader(servers)
