@@ -49,6 +49,12 @@ UNKNOWN_RHS = np.int32(-2)
 
 RES_DIMS = 4  # cpu, memory_mb, disk_mb, iops — structs.Resources.TENSOR_DIMS
 
+# Port bitmap geometry (structs/network.py mirrors network.go:19-22).
+MAX_VALID_PORT = 65536
+PORT_WORDS = MAX_VALID_PORT // 32          # uint32 words per node bitmap
+MIN_DYNAMIC_PORT = 20000
+MAX_DYNAMIC_PORT = 60000
+
 
 def _res_vec(r: Optional[s.Resources]) -> np.ndarray:
     if r is None:
@@ -93,6 +99,16 @@ class ClusterTensors:
     dc_codebook: Dict[str, int]
     value_codebooks: Dict[str, Dict[str, int]]
     job_count_rows: Dict[str, np.ndarray] = field(default_factory=dict)
+    # Network accounting (SURVEY §7 hard-part iii): first-device bandwidth
+    # (-1 = no device), used-port bitmaps as uint32 words, free-dynamic-port
+    # counts.  Only materialized when the batch contains network asks
+    # (w == PORT_WORDS); otherwise w == 1 and the kernel's network checks
+    # compile away.  Whether a cluster's networks are simple enough for
+    # this path is decided by TPUBatchScheduler._cluster_networks_simple.
+    bw_cap: np.ndarray = None           # [n_pad] int32
+    bw_used: np.ndarray = None          # [n_pad] int32
+    dyn_free: np.ndarray = None         # [n_pad] int32
+    port_words: np.ndarray = None       # [n_pad, w] uint32
 
 
 def encode_cluster(
@@ -100,11 +116,16 @@ def encode_cluster(
     attr_targets: Sequence[str],
     allocs_by_node: Optional[Dict[str, List[s.Allocation]]] = None,
     node_pad_multiple: int = 128,
+    with_networks: bool = False,
 ) -> ClusterTensors:
     """Build the cluster-side tensors.
 
     attr_targets: every ``${...}``/literal LTarget referenced by any
     vectorizable constraint in the batch; each becomes one int32 column.
+
+    with_networks: also build port bitmaps + bandwidth/dynamic-port
+    accounting (only when the batch actually asks for networks — the
+    bitmaps are 8KB per node).
     """
     n_real = len(nodes)
     n_pad = max(node_pad_multiple, round_up(n_real, node_pad_multiple))
@@ -115,6 +136,15 @@ def encode_cluster(
     eligible = np.zeros(n_pad, dtype=bool)
     dc_code = np.full(n_pad, MISSING, dtype=np.int32)
     class_code = np.full(n_pad, MISSING, dtype=np.int32)
+
+    w = PORT_WORDS if with_networks else 1
+    # bw_cap = -1 marks "no network device": any network ask (even 0 mbits)
+    # fails the bandwidth check there, matching the oracle's
+    # "no networks available" (network.go:245).
+    bw_cap = np.full(n_pad, -1 if with_networks else 0, dtype=np.int32)
+    bw_used = np.zeros(n_pad, dtype=np.int32)
+    dyn_free = np.zeros(n_pad, dtype=np.int32)
+    port_words = np.zeros((n_pad, w), dtype=np.uint32)
 
     dc_codebook: Dict[str, int] = {}
     class_codebook: Dict[str, int] = {}
@@ -139,6 +169,32 @@ def encode_cluster(
         eligible[i] = node.ready()
         dc_code[i] = dc_codebook.setdefault(node.datacenter, len(dc_codebook))
         class_code[i] = class_codebook.setdefault(node.computed_class, len(class_codebook))
+
+        if with_networks:
+            nets = [nr for nr in (node.resources.networks or []) if nr.device]
+            if nets:
+                bw_cap[i] = nets[0].mbits
+            used_ports: Set[int] = set()
+
+            def _account(nr: s.NetworkResource, i=i, used_ports=used_ports):
+                bw_used[i] += nr.mbits
+                for p in list(nr.reserved_ports) + list(nr.dynamic_ports):
+                    if 0 <= p.value < MAX_VALID_PORT:
+                        used_ports.add(p.value)
+
+            if node.reserved is not None:
+                for nr in node.reserved.networks or []:
+                    _account(nr)
+            if allocs_by_node:
+                for alloc in allocs_by_node.get(node.id, []):
+                    for tr in alloc.task_resources.values():
+                        if tr.networks:
+                            _account(tr.networks[0])
+            for p in used_ports:
+                port_words[i, p >> 5] |= np.uint32(1 << (p & 31))
+            in_dyn = sum(1 for p in used_ports
+                         if MIN_DYNAMIC_PORT <= p < MAX_DYNAMIC_PORT)
+            dyn_free[i] = (MAX_DYNAMIC_PORT - MIN_DYNAMIC_PORT) - in_dyn
 
     # Ordered value codebooks per attribute target: collect node values, sort,
     # assign ranks — integer compare ≡ lexical compare.
@@ -178,6 +234,10 @@ def encode_cluster(
         attr_index=attr_index,
         dc_codebook=dc_codebook,
         value_codebooks=value_codebooks,
+        bw_cap=bw_cap,
+        bw_used=bw_used,
+        dyn_free=dyn_free,
+        port_words=port_words,
     )
     ct._raw_rows = return_raw          # type: ignore[attr-defined]
     ct._value_sets = value_sets        # type: ignore[attr-defined]
@@ -226,6 +286,20 @@ class PlacementSpec:
     drivers: Set[str] = field(default_factory=set)
     constraints: List[s.Constraint] = field(default_factory=list)
     datacenters: List[str] = field(default_factory=list)
+    # Network asks (rank.go:190-238 per-task offer assignment):
+    net_active: bool = False
+    net_mbits: int = 0
+    dyn_count: int = 0
+    resv_ports: List[int] = field(default_factory=list)
+    resv_in_dyn: int = 0
+    net_asks: Dict[str, s.NetworkResource] = field(default_factory=dict)
+    # distinct_property (propertyset.go:11): at most one natively; the
+    # used-value set is filled by the batch scheduler from plan context.
+    dp_target: Optional[str] = None
+    dp_used_values: Set[str] = field(default_factory=set)
+    # Non-empty → this spec cannot run on the device path; the owning eval
+    # routes through the oracle instead of being silently mis-placed.
+    needs_oracle: str = ""
 
     @property
     def count(self) -> int:
@@ -250,6 +324,37 @@ def build_spec(job: s.Job, tg: s.TaskGroup, batch_penalty: bool) -> PlacementSpe
         constraints=all_constraints,
         datacenters=list(job.datacenters),
     )
+
+    # Network asks: first network per task, like the oracle (rank.go:199).
+    for t in tg.tasks:
+        if t.resources is not None and t.resources.networks:
+            ask_net = t.resources.networks[0]
+            spec.net_asks[t.name] = ask_net
+            spec.net_mbits += ask_net.mbits
+            spec.dyn_count += len(ask_net.dynamic_ports)
+            spec.resv_ports.extend(p.value for p in ask_net.reserved_ports)
+    spec.net_active = bool(spec.net_asks)
+    if spec.net_active:
+        if len(spec.resv_ports) != len(set(spec.resv_ports)):
+            spec.needs_oracle = "conflicting reserved ports within task group"
+        if any(p < 0 or p >= MAX_VALID_PORT for p in spec.resv_ports):
+            spec.needs_oracle = "reserved port out of range"
+        spec.resv_in_dyn = sum(
+            1 for p in set(spec.resv_ports)
+            if MIN_DYNAMIC_PORT <= p < MAX_DYNAMIC_PORT)
+
+    dp_cons = [c for c in all_constraints
+               if c.operand == s.CONSTRAINT_DISTINCT_PROPERTY]
+    if len(dp_cons) > 1:
+        spec.needs_oracle = "multiple distinct_property constraints"
+    elif dp_cons:
+        con = dp_cons[0]
+        if con in job.constraints and len(job.task_groups) > 1:
+            # Job-level distinct_property spans task groups; the per-spec
+            # used-value bitset cannot share across specs — oracle instead.
+            spec.needs_oracle = "job-level distinct_property, multiple groups"
+        else:
+            spec.dp_target = con.ltarget
     return spec
 
 
@@ -272,6 +377,15 @@ class SpecTensors:
     precomp: np.ndarray          # [u_pad, n_pad] bool — non-vectorizable ANDs
     job_index: np.ndarray        # [u_pad] int32 — same-job specs share a row
     job_ids: List[str]
+    # Network asks (zeros when the batch has none; w matches ct.port_words):
+    net_active: np.ndarray = None   # [u_pad] bool
+    net_mbits: np.ndarray = None    # [u_pad] int32
+    dyn_need: np.ndarray = None     # [u_pad] int32 — dynamic + resv-in-dyn
+    resv_words: np.ndarray = None   # [u_pad, w] uint32
+    # distinct_property (V=1 when unused):
+    dp_col: np.ndarray = None       # [u_pad] int32 — attr column or -1
+    dp_active: np.ndarray = None    # [u_pad] bool
+    dp_used: np.ndarray = None      # [u_pad, V] bool — value codes in use
 
 
 def encode_specs(
@@ -305,6 +419,20 @@ def encode_specs(
     job_row: Dict[str, int] = {}
     job_index = np.zeros(u_pad, dtype=np.int32)
 
+    w = ct.port_words.shape[1] if ct.port_words is not None else 1
+    net_active = np.zeros(u_pad, dtype=bool)
+    net_mbits = np.zeros(u_pad, dtype=np.int32)
+    dyn_need = np.zeros(u_pad, dtype=np.int32)
+    resv_words = np.zeros((u_pad, w), dtype=np.uint32)
+    dp_col = np.full(u_pad, -1, dtype=np.int32)
+    dp_active = np.zeros(u_pad, dtype=bool)
+    v_max = 1
+    for sp in specs:
+        if sp.dp_target is not None and sp.dp_target in ct.value_codebooks:
+            v_max = max(v_max, len(ct.value_codebooks[sp.dp_target]) + 1)
+    v_pad = pow2_bucket(v_max, minimum=2) if v_max > 1 else 1
+    dp_used = np.zeros((u_pad, v_pad), dtype=bool)
+
     # Class-level cache for non-vectorizable checks: (constraint-key, class)
     class_cache: Dict[Tuple[str, str, str, int], bool] = {}
     eval_ctx = EvalContext(state=None, plan=s.Plan())  # caches only
@@ -320,6 +448,24 @@ def encode_specs(
             if code is not None:
                 dc_mask[u, code] = True
         job_index[u] = job_row.setdefault(sp.job.id, len(job_row))
+
+        if sp.net_active and w > 1:
+            net_active[u] = True
+            net_mbits[u] = sp.net_mbits
+            dyn_need[u] = sp.dyn_count + sp.resv_in_dyn
+            for p in set(sp.resv_ports):
+                resv_words[u, p >> 5] |= np.uint32(1 << (p & 31))
+
+        if sp.dp_target is not None:
+            col = ct.attr_index.get(sp.dp_target)
+            if col is not None:
+                dp_col[u] = col
+                dp_active[u] = True
+                codebook = ct.value_codebooks.get(sp.dp_target, {})
+                for val in sp.dp_used_values:
+                    code = codebook.get(val)
+                    if code is not None:
+                        dp_used[u, code] = True
 
         k = 0
         # Drivers lower to EQ checks on interned "driver.X" columns when the
@@ -378,6 +524,13 @@ def encode_specs(
         precomp=precomp,
         job_index=job_index,
         job_ids=list(job_row),
+        net_active=net_active,
+        net_mbits=net_mbits,
+        dyn_need=dyn_need,
+        resv_words=resv_words,
+        dp_col=dp_col,
+        dp_active=dp_active,
+        dp_used=dp_used,
     )
     return st
 
@@ -444,6 +597,10 @@ def collect_attr_targets(specs: List[PlacementSpec]) -> Tuple[List[str], Dict[st
                 seen.add(t)
                 targets.append(t)
                 literals.setdefault(t, set())
+        if sp.dp_target is not None and sp.dp_target not in seen:
+            seen.add(sp.dp_target)
+            targets.append(sp.dp_target)
+            literals.setdefault(sp.dp_target, set()).update(sp.dp_used_values)
         for con in sp.constraints:
             if con.operand not in _VECTOR_OPS:
                 continue

@@ -121,7 +121,52 @@ class PlacementResult(NamedTuple):
     rounds: jnp.ndarray       # [] int32
 
 
-@functools.partial(jax.jit, static_argnames=("max_rounds",))
+class NetTensors(NamedTuple):
+    """Per-spec network asks + per-node port/bandwidth state
+    (SURVEY §7 hard-part iii; reference rank.go:190-238 + network.go)."""
+
+    active: jnp.ndarray      # [U] bool
+    mbits: jnp.ndarray       # [U] int32
+    dyn_need: jnp.ndarray    # [U] int32 — dynamic ports + reserved-in-dyn-range
+    resv_words: jnp.ndarray  # [U, W] uint32 — reserved-port bitmask
+    bw_cap: jnp.ndarray      # [N] int32
+    bw_used: jnp.ndarray     # [N] int32
+    dyn_free: jnp.ndarray    # [N] int32
+    port_words: jnp.ndarray  # [N, W] uint32 — node used-port bitmaps
+
+
+class DPTensors(NamedTuple):
+    """distinct_property state (propertyset.go:11): per-spec property
+    column + used-value-code bitsets."""
+
+    col: jnp.ndarray         # [U] int32 — attr column, -1 = none
+    active: jnp.ndarray      # [U] bool
+    used0: jnp.ndarray       # [U, V] bool
+    attr_values: jnp.ndarray  # [N, K] int32 — node attribute codes
+
+
+def _disabled_net(u_pad: int, n_pad: int) -> NetTensors:
+    return NetTensors(
+        active=jnp.zeros(u_pad, dtype=bool),
+        mbits=jnp.zeros(u_pad, dtype=jnp.int32),
+        dyn_need=jnp.zeros(u_pad, dtype=jnp.int32),
+        resv_words=jnp.zeros((u_pad, 1), dtype=jnp.uint32),
+        bw_cap=jnp.zeros(n_pad, dtype=jnp.int32),
+        bw_used=jnp.zeros(n_pad, dtype=jnp.int32),
+        dyn_free=jnp.zeros(n_pad, dtype=jnp.int32),
+        port_words=jnp.zeros((n_pad, 1), dtype=jnp.uint32),
+    )
+
+
+def _disabled_dp(u_pad: int, n_pad: int) -> DPTensors:
+    return DPTensors(
+        col=jnp.full(u_pad, -1, dtype=jnp.int32),
+        active=jnp.zeros(u_pad, dtype=bool),
+        used0=jnp.zeros((u_pad, 1), dtype=bool),
+        attr_values=jnp.full((n_pad, 1), MISSING, dtype=jnp.int32),
+    )
+
+
 def placement_rounds(
     feas: jnp.ndarray,         # [U, N] bool — static feasibility
     used0: jnp.ndarray,        # [N, 4] int32 — usage incl. reserved
@@ -135,6 +180,38 @@ def placement_rounds(
     job_counts0: jnp.ndarray,  # [J, N] int32 — existing allocs per (job, node)
     rng_key: jnp.ndarray,
     max_rounds: int = 256,
+    net: "NetTensors" = None,
+    dp: "DPTensors" = None,
+) -> PlacementResult:
+    """The sequential heart of the batch scheduler (see
+    ``_placement_rounds_impl``).  ``net``/``dp`` default to disabled
+    singleton shapes whose checks compile away."""
+    u_pad, n_pad = feas.shape
+    if net is None:
+        net = _disabled_net(u_pad, n_pad)
+    if dp is None:
+        dp = _disabled_dp(u_pad, n_pad)
+    return _placement_rounds_impl(
+        feas, used0, capacity, denom, ask, count, penalty, distinct_hosts,
+        job_index, job_counts0, rng_key, net, dp, max_rounds=max_rounds)
+
+
+@functools.partial(jax.jit, static_argnames=("max_rounds",))
+def _placement_rounds_impl(
+    feas: jnp.ndarray,
+    used0: jnp.ndarray,
+    capacity: jnp.ndarray,
+    denom: jnp.ndarray,
+    ask: jnp.ndarray,
+    count: jnp.ndarray,
+    penalty: jnp.ndarray,
+    distinct_hosts: jnp.ndarray,
+    job_index: jnp.ndarray,
+    job_counts0: jnp.ndarray,
+    rng_key: jnp.ndarray,
+    net: NetTensors,
+    dp: DPTensors,
+    max_rounds: int = 256,
 ) -> PlacementResult:
     """The sequential heart of the batch scheduler.
 
@@ -145,22 +222,49 @@ def placement_rounds(
     node), committing to its top-k scored nodes under remaining capacity.
     Loop exits when a round makes no progress (capacity exhausted or all
     placed).
+
+    Network accounting per (spec, node): bandwidth fit, reserved-port
+    bitmap conflict, and dynamic-port-capacity checks, with commit updates
+    to all three (rank.go:190-238; concrete dynamic port *values* are
+    assigned host-side at finalize, which device-side capacity accounting
+    makes safe).  distinct_property: a per-spec used-value bitset masks
+    feasibility; a within-round scatter-min keeps only the best-ranked
+    node per property value (propertyset.go:150).
     """
     u_pad, n_pad = feas.shape
+    v_pad = dp.used0.shape[1]
 
     # Deterministic per-(u,n) jitter decorrelates ties exactly like the
     # reference's node shuffling (util.go:325) — magnitude too small to
     # reorder materially different scores.
     jitter = jax.random.uniform(rng_key, (u_pad, n_pad), dtype=jnp.float32) * 1e-3
+    big_rank = jnp.int32(n_pad + 1)
 
     def place_one_spec(carry, u):
-        used, job_counts, remaining_count, placements = carry
+        (used, job_counts, remaining_count, placements,
+         bw_used, port_words, dyn_free, dp_used) = carry
 
         cap_left = capacity - used                       # [N, 4]
         fits = jnp.all(ask[u][None, :] <= cap_left, axis=1)
         collisions = job_counts[job_index[u]]            # [N] int32
         ok = feas[u] & fits
         ok = ok & jnp.where(distinct_hosts[u], collisions == 0, True)
+
+        # Network feasibility (bandwidth + reserved conflicts + dynamic
+        # capacity); compiles to nothing when W == 1 and asks are zero.
+        bw_ok = bw_used + net.mbits[u] <= net.bw_cap
+        resv_hit = jnp.any((port_words & net.resv_words[u][None, :]) != 0,
+                           axis=1)
+        dyn_ok = dyn_free >= net.dyn_need[u]
+        ok = ok & jnp.where(net.active[u], bw_ok & ~resv_hit & dyn_ok, True)
+
+        # distinct_property feasibility: node must have the property and
+        # its value must be unused (propertyset.go:150).
+        col = jnp.clip(dp.col[u], 0, dp.attr_values.shape[1] - 1)
+        codes = dp.attr_values[:, col]                    # [N]
+        code_c = jnp.clip(codes, 0, v_pad - 1)
+        dp_ok = (codes != MISSING) & ~dp_used[u, code_c]
+        ok = ok & jnp.where(dp.active[u], dp_ok, True)
 
         score = _score_fit(used, ask[u], denom)
         score = score - penalty[u] * collisions.astype(jnp.float32)
@@ -175,32 +279,63 @@ def placement_rounds(
         k = jnp.minimum(remaining_count[u], jnp.sum(ok).astype(jnp.int32))
         sel = ok & (ranks < k)
 
+        # Within-round value dedup for distinct_property: among selected
+        # nodes sharing a property value, keep only the best-ranked.
+        sel_ranks = jnp.where(sel, ranks, big_rank)
+        best_per_code = jnp.full(v_pad, big_rank, dtype=jnp.int32
+                                 ).at[code_c].min(sel_ranks)
+        keep_dp = sel & (sel_ranks == best_per_code[code_c])
+        sel = jnp.where(dp.active[u], keep_dp, sel)
+
         sel_i = sel.astype(jnp.int32)
+        placed = jnp.sum(sel_i)
         used = used + sel_i[:, None] * ask[u][None, :]
         job_counts = job_counts.at[job_index[u]].add(sel_i)
         placements = placements.at[u].add(sel_i)
-        remaining_count = remaining_count.at[u].add(-k)
-        return (used, job_counts, remaining_count, placements), k
+        remaining_count = remaining_count.at[u].add(-placed)
+
+        commit_net = net.active[u]
+        bw_used = bw_used + jnp.where(commit_net, sel_i * net.mbits[u], 0)
+        port_words = jnp.where(
+            (commit_net & sel)[:, None],
+            port_words | net.resv_words[u][None, :], port_words)
+        dyn_free = dyn_free - jnp.where(commit_net,
+                                        sel_i * net.dyn_need[u], 0)
+        dp_upd = jnp.zeros(v_pad, dtype=bool).at[code_c].max(
+            sel & dp.active[u])
+        dp_used = dp_used.at[u].set(dp_used[u] | dp_upd)
+
+        return (used, job_counts, remaining_count, placements,
+                bw_used, port_words, dyn_free, dp_used), placed
 
     def round_body(state):
-        used, job_counts, remaining_count, placements, _, rounds = state
-        (used, job_counts, remaining_count, placements), placed = lax.scan(
+        (used, job_counts, remaining_count, placements,
+         bw_used, port_words, dyn_free, dp_used, _, rounds) = state
+        carry, placed = lax.scan(
             place_one_spec,
-            (used, job_counts, remaining_count, placements),
+            (used, job_counts, remaining_count, placements,
+             bw_used, port_words, dyn_free, dp_used),
             jnp.arange(u_pad),
         )
+        (used, job_counts, remaining_count, placements,
+         bw_used, port_words, dyn_free, dp_used) = carry
         progress = jnp.sum(placed)
         return (used, job_counts, remaining_count, placements,
+                bw_used, port_words, dyn_free, dp_used,
                 progress, rounds + 1)
 
     def round_cond(state):
-        _, _, remaining_count, _, progress, rounds = state
+        remaining_count = state[2]
+        progress = state[8]
+        rounds = state[9]
         return (progress > 0) & (jnp.sum(remaining_count) > 0) & (rounds < max_rounds)
 
     placements0 = jnp.zeros((u_pad, n_pad), dtype=jnp.int32)
     state = (used0, job_counts0, count, placements0,
+             net.bw_used, net.port_words, net.dyn_free, dp.used0,
              jnp.array(1, dtype=jnp.int32), jnp.array(0, dtype=jnp.int32))
-    used, job_counts, remaining, placements, _, rounds = lax.while_loop(
+    (used, job_counts, remaining, placements,
+     _bw, _pw, _df, _dpu, _, rounds) = lax.while_loop(
         round_cond, round_body, state)
 
     return PlacementResult(

@@ -3,8 +3,12 @@
 Reference behavior: the endpoint structs registered at nomad/server.go:163-174
 (Status, Node, Job, Eval, Plan, Region, Periodic, System, Operator) with
 request forwarding to the leader handled inside each endpoint
-(nomad/rpc.go:178 forward).  Here the Server methods already forward when
-not leader, so handlers just decode the wire body, call, and encode.
+(nomad/rpc.go:178 forward).  Forwarding ownership here: every Server WRITE
+method catches NotLeaderError internally and re-issues the call to the
+leader via Server._forward (so the HTTP layer forwards too); this module's
+wrapper only marks the one-allowed forwarding hop and translates an
+unforwardable NotLeaderError into the wire error.  A new write endpoint
+must therefore forward inside its Server method, not here.
 
 Also carries the serf-lite membership channel (Serf.Join / Serf.Members —
 reference: nomad/serf.go gossip events) since membership rides the same
@@ -24,9 +28,8 @@ from .rpc import NoLeaderError
 def register_endpoints(server, rpc) -> None:
     """Attach all wire methods for ``server`` onto RPCServer ``rpc``.
 
-    Every handler forwards to the cluster leader when the local server
-    raises NotLeaderError (nomad/rpc.go:178-283 forward): one hop, using
-    the leader address raft learned from the last heartbeat."""
+    Forwarding itself lives in the Server write methods (Server._forward);
+    see the module docstring for the contract."""
 
     def register(method, fn):
         def handler(body):

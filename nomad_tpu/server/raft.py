@@ -507,7 +507,13 @@ class MultiRaft(RaftLog):
                 val = self._leader_q.get(timeout=0.5)
             except _queue.Empty:
                 continue
-            self._set_leader(val)
+            try:
+                self._set_leader(val)
+            except Exception:
+                # A raising leadership callback (e.g. an establish-time
+                # apply losing leadership mid-flight) must not kill the
+                # dispatcher — later transitions still need delivery.
+                self.logger.exception("raft: leadership callback failed")
 
     # -- log shape helpers (caller holds self._l) --------------------------
 

@@ -893,8 +893,9 @@ class Server:
 
     def _require_leader(self) -> None:
         """Leader-only subsystems (broker/plan queue) live on the leader;
-        callers on a follower get NotLeaderError, which the RPC endpoint
-        layer turns into a forward (nomad/rpc.go:178)."""
+        callers on a follower get NotLeaderError (these calls are not
+        forwarded — the in-process worker/plan pipeline only runs on the
+        leader, matching nomad/worker.go's leader-local dequeue)."""
         if not self._leader:
             raise NotLeaderError(self.leader_address())
 

@@ -1,0 +1,356 @@
+"""Differential tests: network/port accounting and distinct_property on the
+device path vs the CPU oracle (VERDICT r1 'What's missing' #5; reference
+scheduler/rank.go:190-238, nomad/structs/network.go:245,
+scheduler/propertyset.go:11)."""
+import random
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.ops import batch_sched  # noqa: F401 — registers 'tpu-batch'
+from nomad_tpu.ops import encode
+from nomad_tpu.scheduler import Harness, new_scheduler, new_service_scheduler
+from nomad_tpu.structs import structs as s
+from nomad_tpu.structs.network import (
+    MAX_DYNAMIC_PORT,
+    MIN_DYNAMIC_PORT,
+    NetworkIndex,
+)
+
+
+def reg_eval(job):
+    return s.Evaluation(
+        id=s.generate_uuid(), priority=job.priority, type=job.type,
+        triggered_by=s.EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
+        status=s.EVAL_STATUS_PENDING)
+
+
+def make_nodes(h, n, mbits=1000):
+    nodes = []
+    for i in range(n):
+        node = mock.node()
+        node.resources.networks = [s.NetworkResource(
+            device="eth0", cidr=f"192.168.0.{100 + i}/32", mbits=mbits)]
+        node.reserved.networks = []
+        node.compute_class()
+        h.state.upsert_node(h.next_index(), node)
+        nodes.append(node)
+    return nodes
+
+
+def port_job(count=1, reserved=(), dynamic=1, mbits=10):
+    job = mock.job()
+    tg = job.task_groups[0]
+    tg.count = count
+    for t in tg.tasks:
+        t.resources.networks = [s.NetworkResource(
+            mbits=mbits,
+            reserved_ports=[s.Port(f"r{p}", p) for p in reserved],
+            dynamic_ports=[s.Port(f"d{i}") for i in range(dynamic)],
+        )]
+    return job
+
+
+def existing_alloc(h, job_src, node, reserved=(), mbits=10):
+    """A live alloc occupying ports/bandwidth on ``node``."""
+    alloc = mock.alloc()
+    alloc.node_id = node.id
+    alloc.job = job_src
+    alloc.job_id = job_src.id
+    net = s.NetworkResource(
+        device="eth0", ip=node.resources.networks[0].cidr.split("/")[0],
+        mbits=mbits,
+        reserved_ports=[s.Port(f"r{p}", p) for p in reserved])
+    alloc.task_resources = {"web": s.Resources(
+        cpu=100, memory_mb=64, networks=[net])}
+    alloc.resources = s.Resources(cpu=100, memory_mb=64, networks=[net])
+    h.state.upsert_allocs(h.next_index(), [alloc])
+    return alloc
+
+
+def run_batch(h, jobs):
+    evals = [reg_eval(j) for j in jobs]
+    sched = new_scheduler("tpu-batch", h.logger, h.snapshot(), h)
+    sched.schedule_batch(evals)
+    return evals
+
+
+class TestDevicePortAccounting:
+    def test_reserved_port_conflict_avoided(self):
+        """A node whose reserved port is taken is infeasible on the device
+        path, exactly as the oracle's assign_network failure."""
+        h = Harness()
+        nodes = make_nodes(h, 4)
+        blocker = mock.job()
+        h.state.upsert_job(h.next_index(), blocker)
+        existing_alloc(h, blocker, nodes[0], reserved=(8080,))
+
+        job = port_job(count=3, reserved=(8080,))
+        h.state.upsert_job(h.next_index(), job)
+        run_batch(h, [job])
+
+        allocs = h.state.allocs_by_job(None, job.id, True)
+        placed_nodes = {a.node_id for a in allocs}
+        assert len(allocs) == 3
+        assert nodes[0].id not in placed_nodes, \
+            "placed on a node with a conflicting reserved port"
+
+    def test_within_batch_reserved_conflict(self):
+        """Two jobs asking the same reserved port in ONE batch must land on
+        different nodes — the device commits port bits between specs."""
+        h = Harness()
+        make_nodes(h, 2)
+        jobs = []
+        for _ in range(2):
+            j = port_job(count=1, reserved=(9000,))
+            h.state.upsert_job(h.next_index(), j)
+            jobs.append(j)
+        run_batch(h, jobs)
+
+        n1 = {a.node_id for a in h.state.allocs_by_job(None, jobs[0].id, True)}
+        n2 = {a.node_id for a in h.state.allocs_by_job(None, jobs[1].id, True)}
+        assert len(n1) == 1 and len(n2) == 1
+        assert n1 != n2, "same reserved port double-booked on one node"
+
+    def test_dynamic_ports_assigned_and_valid(self):
+        h = Harness()
+        make_nodes(h, 4)
+        job = port_job(count=4, dynamic=2)
+        h.state.upsert_job(h.next_index(), job)
+        run_batch(h, [job])
+
+        allocs = h.state.allocs_by_job(None, job.id, True)
+        assert len(allocs) == 4
+        seen_by_node = {}
+        for a in allocs:
+            for tr in a.task_resources.values():
+                assert tr.networks, "no network offer on placed alloc"
+                offer = tr.networks[0]
+                assert offer.ip, "offer missing IP"
+                vals = [p.value for p in offer.dynamic_ports]
+                assert len(vals) == 2
+                for v in vals:
+                    assert MIN_DYNAMIC_PORT <= v < MAX_DYNAMIC_PORT
+                node_ports = seen_by_node.setdefault(a.node_id, set())
+                assert not (node_ports & set(vals)), "dynamic port collision"
+                node_ports.update(vals)
+
+    def test_bandwidth_exhaustion(self):
+        """Nodes without remaining bandwidth are skipped (network.go:60
+        Overcommitted / rank.go bandwidth-exceeded)."""
+        h = Harness()
+        nodes = make_nodes(h, 3, mbits=100)
+        blocker = mock.job()
+        h.state.upsert_job(h.next_index(), blocker)
+        existing_alloc(h, blocker, nodes[0], mbits=80)
+
+        job = port_job(count=2, dynamic=0, mbits=50)
+        h.state.upsert_job(h.next_index(), job)
+        run_batch(h, [job])
+
+        allocs = h.state.allocs_by_job(None, job.id, True)
+        assert len(allocs) == 2
+        assert nodes[0].id not in {a.node_id for a in allocs}
+
+    def test_oracle_and_device_agree_on_port_feasibility(self):
+        """Same cluster + same port-constrained job: oracle and tpu-batch
+        place on the same feasible node set (tie-breaks aside)."""
+
+        def run(kind):
+            h = Harness()
+            nodes = make_nodes(h, 6)
+            blocker = mock.job()
+            h.state.upsert_job(h.next_index(), blocker)
+            # Ports 7000 taken on nodes 0-2 → only 3-5 feasible.
+            for i in range(3):
+                existing_alloc(h, blocker, nodes[i], reserved=(7000,))
+            job = port_job(count=3, reserved=(7000,), dynamic=1)
+            h.state.upsert_job(h.next_index(), job)
+            ev = reg_eval(job)
+            if kind == "tpu-batch":
+                sched = new_scheduler("tpu-batch", h.logger, h.snapshot(), h)
+                sched.process(ev)
+            else:
+                h.process(new_service_scheduler, ev)
+            placed = {a.node_id for a in
+                      h.state.allocs_by_job(None, job.id, True)}
+            free = {n.id for n in nodes[3:]}
+            return placed, free
+
+        for kind in ("oracle", "tpu-batch"):
+            placed, free = run(kind)
+            assert placed == free, f"{kind}: placed {placed} != free {free}"
+
+    def test_no_port_allocs_overcommit_check(self):
+        """Plan-applied network offers replay cleanly into a NetworkIndex
+        (no hidden double-bookings)."""
+        h = Harness()
+        make_nodes(h, 3)
+        jobs = []
+        for i in range(3):
+            j = port_job(count=2, reserved=(6000 + i,), dynamic=1)
+            h.state.upsert_job(h.next_index(), j)
+            jobs.append(j)
+        run_batch(h, jobs)
+
+        by_node = {}
+        for j in jobs:
+            for a in h.state.allocs_by_job(None, j.id, True):
+                by_node.setdefault(a.node_id, []).append(a)
+        for node_id, allocs in by_node.items():
+            node = h.state.node_by_id(None, node_id)
+            idx = NetworkIndex()
+            idx.set_node(node)
+            collide = idx.add_allocs(allocs)
+            assert not collide, f"port collision on node {node_id}"
+            assert not idx.overcommitted()
+
+
+class TestDeviceDistinctProperty:
+    def rack_nodes(self, h, racks):
+        nodes = []
+        for i, rack in enumerate(racks):
+            node = mock.node()
+            node.resources.networks = []
+            node.reserved.networks = []
+            node.meta["rack"] = rack
+            node.compute_class()
+            h.state.upsert_node(h.next_index(), node)
+            nodes.append(node)
+        return nodes
+
+    def dp_job(self, count):
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = count
+        for t in tg.tasks:
+            t.resources.networks = []
+        tg.constraints = list(tg.constraints) + [s.Constraint(
+            "${meta.rack}", "", s.CONSTRAINT_DISTINCT_PROPERTY)]
+        return job
+
+    def test_one_alloc_per_property_value(self):
+        h = Harness()
+        nodes = self.rack_nodes(h, ["r1", "r1", "r2", "r2", "r3", "r3"])
+        job = self.dp_job(3)
+        h.state.upsert_job(h.next_index(), job)
+        run_batch(h, [job])
+
+        allocs = h.state.allocs_by_job(None, job.id, True)
+        assert len(allocs) == 3
+        racks = [h.state.node_by_id(None, a.node_id).meta["rack"]
+                 for a in allocs]
+        assert len(set(racks)) == 3, f"rack reuse: {racks}"
+
+    def test_count_exceeding_values_partially_places(self):
+        h = Harness()
+        self.rack_nodes(h, ["r1", "r2", "r3"])
+        job = self.dp_job(5)
+        h.state.upsert_job(h.next_index(), job)
+        evals = run_batch(h, [job])
+
+        allocs = h.state.allocs_by_job(None, job.id, True)
+        assert len(allocs) == 3
+        # The eval records the failure, like the oracle
+        # (generic_sched.go:218 blocked-eval creation on failed placements).
+        updated = [e for e in h.evals if e.id == evals[0].id]
+        assert updated and updated[-1].failed_tg_allocs
+
+    def test_existing_value_excluded(self):
+        h = Harness()
+        nodes = self.rack_nodes(h, ["r1", "r2", "r3"])
+        job = self.dp_job(2)
+        h.state.upsert_job(h.next_index(), job)
+        existing = existing_alloc_no_net(h, job, nodes[0])
+        run_batch(h, [job])
+
+        allocs = [a for a in h.state.allocs_by_job(None, job.id, True)
+                  if a.id != existing.id]
+        racks = {h.state.node_by_id(None, a.node_id).meta["rack"]
+                 for a in allocs}
+        assert "r1" not in racks, "reused the rack of an existing alloc"
+
+    def test_matches_oracle(self):
+        def run(kind, seed):
+            h = Harness()
+            rng = random.Random(seed)
+            racks = [f"r{rng.randrange(4)}" for _ in range(12)]
+            self.rack_nodes(h, racks)
+            job = self.dp_job(4)
+            h.state.upsert_job(h.next_index(), job)
+            ev = reg_eval(job)
+            if kind == "tpu-batch":
+                sched = new_scheduler("tpu-batch", h.logger, h.snapshot(), h)
+                sched.process(ev)
+            else:
+                h.process(new_service_scheduler, ev)
+            allocs = h.state.allocs_by_job(None, job.id, True)
+            racks_used = sorted(h.state.node_by_id(None, a.node_id).meta["rack"]
+                                for a in allocs)
+            return len(allocs), racks_used
+
+        for seed in (1, 2, 3):
+            n_oracle, racks_oracle = run("oracle", seed)
+            n_batch, racks_batch = run("tpu-batch", seed)
+            assert n_oracle == n_batch
+            assert len(set(racks_oracle)) == len(racks_oracle)
+            assert len(set(racks_batch)) == len(racks_batch)
+
+
+class TestOracleGating:
+    def test_multiple_distinct_property_routes_to_oracle(self):
+        h = Harness()
+        for i in range(4):
+            node = mock.node()
+            node.resources.networks = []
+            node.reserved.networks = []
+            node.meta["rack"] = f"r{i}"
+            node.meta["zone"] = f"z{i % 2}"
+            node.compute_class()
+            h.state.upsert_node(h.next_index(), node)
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 2
+        for t in tg.tasks:
+            t.resources.networks = []
+        tg.constraints = list(tg.constraints) + [
+            s.Constraint("${meta.rack}", "", s.CONSTRAINT_DISTINCT_PROPERTY),
+            s.Constraint("${meta.zone}", "", s.CONSTRAINT_DISTINCT_PROPERTY)]
+        h.state.upsert_job(h.next_index(), job)
+        run_batch(h, [job])
+
+        # Placed correctly (by the oracle fallback): both racks AND zones
+        # distinct.
+        allocs = h.state.allocs_by_job(None, job.id, True)
+        assert len(allocs) == 2
+        racks = {h.state.node_by_id(None, a.node_id).meta["rack"]
+                 for a in allocs}
+        zones = {h.state.node_by_id(None, a.node_id).meta["zone"]
+                 for a in allocs}
+        assert len(racks) == 2 and len(zones) == 2
+
+    def test_spec_gate_reasons(self):
+        job = port_job(count=1, reserved=(5000, 5000))
+        spec = encode.build_spec(job, job.task_groups[0], False)
+        assert "reserved ports" in spec.needs_oracle
+
+        job2 = mock.job()
+        job2.constraints = [s.Constraint(
+            "${meta.rack}", "", s.CONSTRAINT_DISTINCT_PROPERTY)]
+        tg2 = job2.task_groups[0].copy()
+        tg2.name = "second"
+        job2.task_groups.append(tg2)
+        spec2 = encode.build_spec(job2, job2.task_groups[0], False)
+        assert "job-level" in spec2.needs_oracle
+
+
+def existing_alloc_no_net(h, job_src, node):
+    alloc = mock.alloc()
+    alloc.node_id = node.id
+    alloc.job = job_src
+    alloc.job_id = job_src.id
+    alloc.task_group = job_src.task_groups[0].name
+    alloc.task_resources = {"web": s.Resources(cpu=100, memory_mb=64)}
+    alloc.resources = s.Resources(cpu=100, memory_mb=64)
+    h.state.upsert_allocs(h.next_index(), [alloc])
+    return alloc
