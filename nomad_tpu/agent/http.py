@@ -35,6 +35,14 @@ class CodedError(Exception):
         self.code = code
 
 
+class StreamResponse:
+    """Marker return value: the handler yields NDJSON frames instead of one
+    JSON body (fs_endpoint.go streaming framing)."""
+
+    def __init__(self, frames):
+        self.frames = frames
+
+
 class HTTPServer:
     """Routes /v1 requests onto an Agent's server/client."""
 
@@ -126,9 +134,51 @@ class HTTPServer:
                 self.agent.logger.exception("http: request failed")
                 self._reply_error(req, 500, str(e))
                 return
-            self._reply_json(req, obj, index)
+            if isinstance(obj, StreamResponse):
+                self._reply_stream(req, obj)
+            else:
+                self._reply_json(req, obj, index)
             return
         self._reply_error(req, 404, "Invalid URL")
+
+    def _reply_stream(self, req, stream: StreamResponse) -> None:
+        """One NDJSON line per frame, flushed immediately; the connection
+        closes when the generator ends or the consumer disconnects."""
+        req.send_response(200)
+        req.send_header("Content-Type", "application/x-ndjson")
+        req.send_header("Connection", "close")
+        req.end_headers()
+        req.close_connection = True
+        frames = iter(stream.frames)
+        try:
+            while True:
+                # Generator errors (unreadable path, mid-stream IO failure)
+                # must surface, not read as a clean EOF — only write-side
+                # failures mean "consumer went away".
+                try:
+                    frame = next(frames)
+                except StopIteration:
+                    break
+                except OSError as e:
+                    self.agent.logger.warning("http: stream read failed: %s",
+                                              e)
+                    err = {"FileEvent": f"stream error: {e}"}
+                    try:
+                        req.wfile.write(
+                            json.dumps(err).encode() + b"\n")
+                    except OSError:
+                        pass
+                    break
+                line = json.dumps(to_wire(frame)).encode() + b"\n"
+                try:
+                    req.wfile.write(line)
+                    req.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    break  # consumer went away — stop the generator
+        finally:
+            close = getattr(stream.frames, "close", None)
+            if close is not None:
+                close()
 
     def _reply_json(self, req, obj: Any, index: Optional[int]) -> None:
         body = b"" if obj is None else json.dumps(
@@ -490,7 +540,7 @@ class HTTPServer:
         parts = rest.split("/", 1)
         op = parts[0]
         alloc_id = parts[1] if len(parts) > 1 else ""
-        if op not in ("ls", "stat", "cat", "readat", "logs"):
+        if op not in ("ls", "stat", "cat", "readat", "logs", "stream"):
             raise CodedError(404, "Invalid URL")
         if not alloc_id:
             raise CodedError(400, "Missing allocation ID")
@@ -516,7 +566,22 @@ class HTTPServer:
             log_type = query.get("type", "stdout")
             if not task:
                 raise CodedError(400, "Missing task name")
+            if query.get("follow", "").lower() == "true" \
+                    or "origin" in query or "offset" in query:
+                frames = self.client.stream_task_logs(
+                    alloc_id, task, log_type,
+                    offset=int(query.get("offset", 0) or 0),
+                    origin=query.get("origin", "start"),
+                    follow=query.get("follow", "").lower() == "true")
+                return StreamResponse(frames), None
             return self.client.task_logs(alloc_id, task, log_type), None
+        if op == "stream":
+            frames = self.client.stream_file(
+                alloc_id, path,
+                offset=int(query.get("offset", 0) or 0),
+                origin=query.get("origin", "start"),
+                follow=query.get("follow", "true").lower() == "true")
+            return StreamResponse(frames), None
         raise CodedError(404, "Invalid URL")
 
     # ------------------------------------------------------------------

@@ -267,6 +267,55 @@ class AgentAPI:
             QueryOptions(params={"task": task, "type": log_type}))
         return obj or ""
 
+    def _stream(self, path: str, params: Dict[str, str], follow: bool):
+        """Consume an NDJSON StreamFrame response (api/fs.go Stream):
+        yields dicts with 'Data' decoded back to bytes.  Transport errors
+        surface as APIError, like the non-streaming paths."""
+        import base64
+        import urllib.request
+
+        url = self.c._url(path, QueryOptions(params=params))
+        req = urllib.request.Request(url)
+        try:
+            resp = urllib.request.urlopen(
+                req, timeout=None if follow else self.c.timeout)
+        except urllib.error.HTTPError as e:
+            raise APIError(e.code, e.read().decode("utf-8", "replace")) from e
+        except urllib.error.URLError as e:
+            raise APIError(0, f"failed to reach agent at "
+                              f"{self.c.address}: {e.reason}") from e
+        try:
+            for line in resp:
+                line = line.strip()
+                if not line:
+                    continue
+                frame = json.loads(line)
+                if frame.get("Data"):
+                    frame["Data"] = base64.b64decode(frame["Data"])
+                yield frame
+        except OSError as e:
+            raise APIError(0, f"stream interrupted: {e}") from e
+        finally:
+            resp.close()
+
+    def stream_logs(self, alloc_id: str, task: str,
+                    log_type: str = "stdout", follow: bool = False,
+                    offset: int = 0, origin: str = "start"):
+        """Framed log streaming (api/fs.go Logs): generator of StreamFrames."""
+        return self._stream(
+            f"/v1/client/fs/logs/{alloc_id}",
+            {"task": task, "type": log_type, "origin": origin,
+             "offset": str(offset),
+             "follow": "true" if follow else "false"}, follow)
+
+    def stream_file(self, alloc_id: str, path: str, follow: bool = True,
+                    offset: int = 0, origin: str = "start"):
+        """Framed single-file streaming (api/fs.go Stream)."""
+        return self._stream(
+            f"/v1/client/fs/stream/{alloc_id}",
+            {"path": path, "origin": origin, "offset": str(offset),
+             "follow": "true" if follow else "false"}, follow)
+
     def fs_list(self, alloc_id: str, path: str = "/") -> List[dict]:
         obj, _ = self.c.get(f"/v1/client/fs/ls/{alloc_id}",
                             QueryOptions(params={"path": path}))

@@ -518,12 +518,36 @@ def cmd_logs(args, out) -> int:
         out.write(f'No single allocation with prefix "{args.alloc_id}"\n')
         return 1
     log_type = "stderr" if args.stderr else "stdout"
+    follow = getattr(args, "follow", False)
+    tail_bytes = int(getattr(args, "tail_bytes", 0) or 0)
     try:
+        if follow or tail_bytes:
+            # Tail from the end, streaming frames; -f keeps following
+            # (command/logs.go -f/-tail + fs_endpoint.go follow framing).
+            frames = api.agent.stream_logs(
+                allocs[0]["ID"], args.task, log_type,
+                follow=follow, origin="end", offset=tail_bytes)
+            return _drain_frames(frames, out)
         text = api.agent.task_logs(allocs[0]["ID"], args.task, log_type)
     except APIError as e:
         out.write(f"Error reading logs: {e}\n")
         return 1
     out.write(text)
+    return 0
+
+
+def _drain_frames(frames, out) -> int:
+    """Write a StreamFrame sequence's data to ``out`` until the stream ends
+    or the user interrupts."""
+    try:
+        for frame in frames:
+            data = frame.get("Data")
+            if data:
+                out.write(data.decode("utf-8", "replace"))
+                if hasattr(out, "flush"):
+                    out.flush()
+    except KeyboardInterrupt:
+        pass
     return 0
 
 
@@ -540,6 +564,9 @@ def cmd_fs(args, out) -> int:
         if args.stat:
             st = api.agent.fs_stat(alloc_id, path)
             out.write(json.dumps(st, indent=2) + "\n")
+        elif args.cat and getattr(args, "follow", False):
+            return _drain_frames(
+                api.agent.stream_file(alloc_id, path, follow=True), out)
         elif args.cat:
             out.write(api.agent.fs_cat(alloc_id, path))
         else:
@@ -772,12 +799,15 @@ def build_parser() -> argparse.ArgumentParser:
     add("logs", cmd_logs, lambda sp: (
         sp.add_argument("alloc_id"),
         sp.add_argument("task"),
-        sp.add_argument("-stderr", action="store_true")))
+        sp.add_argument("-stderr", action="store_true"),
+        sp.add_argument("-f", dest="follow", action="store_true"),
+        sp.add_argument("-tail", dest="tail_bytes", type=int, default=0)))
     add("fs", cmd_fs, lambda sp: (
         sp.add_argument("alloc_id"),
         sp.add_argument("path", nargs="?", default="/"),
         sp.add_argument("-stat", action="store_true"),
-        sp.add_argument("-cat", action="store_true")))
+        sp.add_argument("-cat", action="store_true"),
+        sp.add_argument("-f", dest="follow", action="store_true")))
     add("server-members", cmd_server_members)
     add("agent-info", cmd_agent_info)
     add("job-dispatch", cmd_job_dispatch, lambda sp: (

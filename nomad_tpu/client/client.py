@@ -321,6 +321,42 @@ class Client:
         with self._alloc_lock:
             return len(self.alloc_runners)
 
+    def stream_task_logs(self, alloc_id: str, task: str,
+                         log_type: str = "stdout", offset: int = 0,
+                         origin: str = "start", follow: bool = False):
+        """Framed log streaming with follow across rotations
+        (fs_endpoint.go logs handler); yields StreamFrame dicts."""
+        from .fs_stream import stream_log_frames
+
+        runner = self.get_alloc_runner(alloc_id)
+        if runner is None:
+            raise KeyError(f"unknown allocation ID {alloc_id!r}")
+        log_dir = os.path.join(runner.alloc_dir.alloc_dir, "alloc", "logs")
+
+        def alive() -> bool:
+            r = self.get_alloc_runner(alloc_id)
+            return r is not None and not r.alloc.terminal_status()
+
+        return stream_log_frames(log_dir, task, log_type, offset=offset,
+                                 origin=origin, follow=follow, alive=alive)
+
+    def stream_file(self, alloc_id: str, path: str, offset: int = 0,
+                    origin: str = "start", follow: bool = False):
+        """Framed single-file streaming (fs_endpoint.go stream handler)."""
+        from .fs_stream import stream_file_frames
+
+        runner = self.get_alloc_runner(alloc_id)
+        if runner is None:
+            raise KeyError(f"unknown allocation ID {alloc_id!r}")
+        abs_path = runner.alloc_dir._safe_path(path)
+
+        def alive() -> bool:
+            r = self.get_alloc_runner(alloc_id)
+            return r is not None and not r.alloc.terminal_status()
+
+        return stream_file_frames(abs_path, path, offset=offset,
+                                  origin=origin, follow=follow, alive=alive)
+
     def task_logs(self, alloc_id: str, task: str, log_type: str = "stdout",
                   max_bytes: int = 1 << 20) -> str:
         """Concatenate the tail of the rotated log files for a task (fs logs

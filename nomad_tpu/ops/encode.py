@@ -49,11 +49,16 @@ UNKNOWN_RHS = np.int32(-2)
 
 RES_DIMS = 4  # cpu, memory_mb, disk_mb, iops — structs.Resources.TENSOR_DIMS
 
-# Port bitmap geometry (structs/network.py mirrors network.go:19-22).
-MAX_VALID_PORT = 65536
+# Port geometry comes from the host NetworkIndex (structs/network.py ←
+# network.go:19-22): the device capacity accounting and the host's
+# concrete port assignment at finalize must agree exactly.
+from ..structs.network import (  # noqa: E402
+    MAX_DYNAMIC_PORT,
+    MAX_VALID_PORT,
+    MIN_DYNAMIC_PORT,
+)
+
 PORT_WORDS = MAX_VALID_PORT // 32          # uint32 words per node bitmap
-MIN_DYNAMIC_PORT = 20000
-MAX_DYNAMIC_PORT = 60000
 
 
 def _res_vec(r: Optional[s.Resources]) -> np.ndarray:
