@@ -14,14 +14,37 @@ from .config import AgentConfig
 from .http import HTTPServer
 
 
+def _advertisable(host: str) -> str:
+    """A wildcard bind must never reach the catalog: a peer dialing
+    0.0.0.0 connects to itself (agent.go advertise-address resolution)."""
+    if not host or host == "0.0.0.0":
+        import socket
+
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except OSError:
+            return "127.0.0.1"
+    return host
+
+
 class Agent:
     def __init__(self, config: Optional[AgentConfig] = None,
-                 logger: Optional[logging.Logger] = None):
+                 logger: Optional[logging.Logger] = None,
+                 vault_api=None):
         self.config = config or AgentConfig.dev()
         self.logger = logger or logging.getLogger("nomad_tpu.agent")
         self.server: Optional[Server] = None
         self.client: Optional[Client] = None
         self.http: Optional[HTTPServer] = None
+        self._vault_api = vault_api
+        # Consul-shaped catalog + service client (command/agent/consul/):
+        # the agent hosts the catalog, registers itself, and shares the
+        # service client with task runners.
+        from ..consul import ServiceCatalog, ServiceClient
+
+        self.catalog = ServiceCatalog()
+        self.consul_service_client = ServiceClient(
+            self.catalog, logger=self.logger.getChild("consul"))
         self._setup_server()
         self._setup_client()
         if self.server is None and self.client is None:
@@ -54,7 +77,20 @@ class Agent:
             batch_size=sb.batch_size)
         if sb.enabled_schedulers:
             scfg.enabled_schedulers = list(sb.enabled_schedulers) + ["_core"]
-        self.server = Server(scfg, logger=self.logger.getChild("server"))
+        if self.config.vault.enabled:
+            from ..server.vault import VaultConfig as SV
+
+            from ..jobspec.parse import parse_duration
+
+            scfg.vault = SV(
+                enabled=True,
+                addr=self.config.vault.address or SV.addr,
+                token=self.config.vault.token,
+                task_token_ttl=(
+                    parse_duration(self.config.vault.task_token_ttl)
+                    if self.config.vault.task_token_ttl else 72 * 3600.0))
+        self.server = Server(scfg, logger=self.logger.getChild("server"),
+                             vault_api=self._vault_api)
 
     def _setup_client(self) -> None:
         if not self.config.client.enabled:
@@ -73,6 +109,11 @@ class Agent:
             network_speed=cb.network_speed,
             cpu_total_compute=cb.cpu_total_compute,
             gc_max_allocs=cb.gc_max_allocs,
+            consul_address=cb.consul_address,
+            vault_addr=(self.config.vault.address
+                        if self.config.vault.enabled else ""),
+            vault_token=(self.config.vault.token
+                         if self.config.vault.enabled else ""),
             dev_mode=self.config.dev_mode)
         # In-process RPC when this agent also runs a server; a remote RPC
         # proxy otherwise (reference clients RPC over TCP; the in-proc
@@ -83,7 +124,9 @@ class Agent:
 
             rpc = RemoteServerRPC(cb.servers)
         self.client = Client(ccfg, rpc=rpc,
-                             logger=self.logger.getChild("client"))
+                             logger=self.logger.getChild("client"),
+                             vault_api=self._vault_api,
+                             consul=self.consul_service_client)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -95,9 +138,22 @@ class Agent:
         self.http = HTTPServer(self, host=self.config.bind_addr,
                                port=self.config.ports.http)
         self.http.start()
+        self.consul_service_client.start()
+        # Self-registration into the catalog (agent.go:492): servers
+        # advertise their RPC endpoint as 'nomad', clients their HTTP as
+        # 'nomad-client'.
+        if self.server is not None:
+            host, port = self.server.config.rpc_advertise.rsplit(":", 1)
+            self.consul_service_client.register_agent(
+                "server", _advertisable(host), int(port), tags=["rpc"])
+        if self.client is not None:
+            self.consul_service_client.register_agent(
+                "client", _advertisable(self.config.bind_addr),
+                self.http.port, tags=["http"])
         self.logger.info("agent: started (http=%s)", self.http.address)
 
     def shutdown(self) -> None:
+        self.consul_service_client.stop()
         if self.http is not None:
             self.http.shutdown()
         if self.client is not None:

@@ -48,6 +48,18 @@ class ClientBlock:
     cpu_total_compute: int = 0
     gc_interval: str = ""
     gc_max_allocs: int = 50
+    consul_address: str = ""  # catalog HTTP address for server discovery
+
+
+@dataclass
+class VaultBlock:
+    """(reference: nomad/structs/config/vault.go via the agent vault{}
+    block)."""
+
+    enabled: bool = False
+    address: str = ""
+    token: str = ""
+    task_token_ttl: str = ""
 
 
 @dataclass
@@ -62,6 +74,7 @@ class AgentConfig:
     ports: Ports = field(default_factory=Ports)
     server: ServerBlock = field(default_factory=ServerBlock)
     client: ClientBlock = field(default_factory=ClientBlock)
+    vault: VaultBlock = field(default_factory=VaultBlock)
     dev_mode: bool = False
 
     @staticmethod
@@ -146,6 +159,15 @@ def parse_config(src: str) -> AgentConfig:
         cfg.client.network_speed = int(_scalar(cb, "network_speed", 0))
         cfg.client.cpu_total_compute = int(_scalar(cb, "cpu_total_compute", 0))
         cfg.client.gc_max_allocs = int(_scalar(cb, "gc_max_allocs", 50))
+        cfg.client.consul_address = str(_scalar(cb, "consul_address", ""))
+
+    ve = root.one("vault")
+    if ve is not None and isinstance(ve.value, Block):
+        vb = ve.value
+        cfg.vault.enabled = bool(_scalar(vb, "enabled", False))
+        cfg.vault.address = str(_scalar(vb, "address", ""))
+        cfg.vault.token = str(_scalar(vb, "token", ""))
+        cfg.vault.task_token_ttl = str(_scalar(vb, "task_token_ttl", ""))
 
     return cfg
 

@@ -111,6 +111,8 @@ class HTTPServer:
         r("/v1/operator/raft/configuration", self.operator_raft_conf_request)
         r("/v1/system/gc", self.system_gc_request)
         r("/v1/system/reconcile/summaries", self.system_reconcile_request)
+        r("/v1/catalog/services", self.catalog_services_request)
+        r("/v1/catalog/service/(?P<name>[^/]+)", self.catalog_service_request)
 
     def _route(self, pattern: str, fn: Callable) -> None:
         self.routes.append((pattern, re.compile("^" + pattern + "$"), fn))
@@ -590,6 +592,18 @@ class HTTPServer:
 
     def agent_self_request(self, req, query):
         return self.agent.self_info(), None
+
+    # Consul-shaped catalog surface (command/agent/consul; discovery
+    # endpoint the reference gets from the real Consul HTTP API).
+    def catalog_services_request(self, req, query):
+        return self.agent.catalog.services(), None
+
+    def catalog_service_request(self, req, query, name: str):
+        tag = query.get("tag", "")
+        healthy = query.get("passing", "").lower() == "true"
+        entries = self.agent.catalog.service(name, tag=tag,
+                                             healthy_only=healthy)
+        return [e.to_wire() for e in entries], None
 
     def agent_members_request(self, req, query):
         return {"Members": self.agent.members()}, None

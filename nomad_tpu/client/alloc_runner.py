@@ -51,12 +51,16 @@ class AllocRunner:
                  node: Optional[s.Node] = None,
                  state_db=None,
                  prev_alloc_dir: Optional[AllocDir] = None,
+                 vault_client=None,
+                 consul=None,
                  logger: Optional[logging.Logger] = None):
         self.config = config
         self.alloc = alloc.copy()
         self.updater = updater
         self.node = node
         self.state_db = state_db
+        self.vault_client = vault_client
+        self.consul = consul
         self.logger = logger or logging.getLogger("nomad_tpu.client.alloc_runner")
 
         base = getattr(config, "alloc_dir", None) or "/tmp/nomad-tpu-allocs"
@@ -199,6 +203,8 @@ class AllocRunner:
                 task_dir=self.alloc_dir.task_dirs[task.name],
                 updater=self._set_task_state,
                 node=self.node,
+                vault_client=self.vault_client,
+                consul=self.consul,
                 logger=self.logger,
             )
             with self._state_lock:

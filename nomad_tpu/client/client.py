@@ -40,10 +40,37 @@ INITIAL_HEARTBEAT_STAGGER = 10.0
 class Client:
     def __init__(self, config: Optional[ClientConfig] = None,
                  rpc=None,
-                 logger: Optional[logging.Logger] = None):
+                 logger: Optional[logging.Logger] = None,
+                 vault_api=None,
+                 consul=None):
         self.config = config or ClientConfig()
         self.rpc = rpc
         self.logger = logger or logging.getLogger("nomad_tpu.client")
+        # Consul-shaped service client (command/agent/consul/client.go:87);
+        # owned by the agent, shared with task runners for service
+        # registration with the task lifecycle.
+        self.consul = consul
+
+        # Vault token manager (client/vaultclient): derives through the
+        # server RPC, renews directly against Vault.  Transport resolution:
+        # injected vault_api (tests/agent) > configured vault_addr (real
+        # HTTP) > the in-process server's own transport (dev agent).
+        from .vaultclient import ClientVaultClient
+
+        if vault_api is None and getattr(self.config, "vault_addr", ""):
+            from ..server.vault import HTTPVault
+
+            vault_api = HTTPVault(self.config.vault_addr,
+                                  getattr(self.config, "vault_token", ""))
+        if vault_api is None:
+            server_vault = getattr(rpc, "vault", None)
+            if server_vault is not None and server_vault.enabled:
+                vault_api = server_vault.api
+        self.vault_client = ClientVaultClient(
+            derive_fn=self._derive_vault_tokens,
+            renew_fn=(vault_api.renew_token if vault_api is not None
+                      else None),
+            logger=self.logger.getChild("vault"))
 
         if not self.config.alloc_dir:
             self.config.alloc_dir = tempfile.mkdtemp(prefix="nomad-tpu-alloc-")
@@ -115,6 +142,11 @@ class Client:
         self.logger.info("client: available drivers: %s", ",".join(avail))
 
     # -- lifecycle ---------------------------------------------------------
+    def _derive_vault_tokens(self, alloc_id: str, task_names):
+        """Node.DeriveVaultToken through whichever server RPC surface this
+        client was built with (in-proc Server or RemoteServerRPC)."""
+        return self.rpc.derive_vault_token(alloc_id, task_names)
+
     def start(self) -> None:
         for target in (self._register_and_heartbeat, self._watch_allocations,
                        self._alloc_sync_loop):
@@ -122,10 +154,12 @@ class Client:
                                  name=f"client-{target.__name__}")
             t.start()
             self._threads.append(t)
+        self.vault_client.start()
         self.garbage_collector.run()
 
     def shutdown(self) -> None:
         self._shutdown.set()
+        self.vault_client.stop()
         self.garbage_collector.stop()
         with self._alloc_lock:
             runners = list(self.alloc_runners.values())
@@ -144,10 +178,39 @@ class Client:
             self.logger.warning("client: registration failed: %s", e)
             return False
 
+    def _consul_discover_servers(self) -> bool:
+        """Find servers through a Consul-shaped catalog when none answer
+        (client.go:2139 consulDiscovery): query the configured catalog's
+        'nomad' service for RPC endpoints."""
+        addr = getattr(self.config, "consul_address", "")
+        if not addr:
+            return False
+        import json
+        import urllib.request
+        try:
+            url = addr.rstrip("/") + "/v1/catalog/service/nomad"
+            with urllib.request.urlopen(url, timeout=5.0) as resp:
+                entries = json.loads(resp.read() or b"[]")
+        except Exception as e:
+            self.logger.warning("client: consul discovery failed: %s", e)
+            return False
+        servers = [f"{e['Address']}:{e['Port']}" for e in entries
+                   if e.get("Address") and e.get("Port")]
+        if not servers:
+            return False
+        self.logger.info("client: discovered servers via consul: %s",
+                         ",".join(servers))
+        self.servers.set(servers)
+        if hasattr(self.rpc, "servers"):
+            self.rpc.servers = list(servers)
+        return True
+
     def _register_and_heartbeat(self) -> None:
         while not self._shutdown.is_set():
             if self._try_register():
                 break
+            if self._consul_discover_servers():
+                continue  # fresh servers — retry immediately
             if self._shutdown.wait(REGISTER_RETRY_INTERVAL):
                 return
         # Heartbeat at TTL/2-ish like the reference's jittered resend
@@ -227,6 +290,8 @@ class Client:
             node=self.node,
             state_db=self.state_db,
             prev_alloc_dir=prev_dir,
+            vault_client=self.vault_client,
+            consul=self.consul,
             logger=self.logger,
         )
         # Block start on the previous alloc reaching a terminal state
@@ -287,7 +352,8 @@ class Client:
             runner = AllocRunner(
                 config=self.config, alloc=alloc,
                 updater=self._alloc_status_update, node=self.node,
-                state_db=self.state_db, logger=self.logger)
+                state_db=self.state_db, vault_client=self.vault_client,
+                consul=self.consul, logger=self.logger)
             runner.task_states = dict(state.get("task_states", {}))
             with self._alloc_lock:
                 self.alloc_runners[alloc_id] = runner

@@ -29,6 +29,13 @@ class ClientConfig:
     gc_inode_usage_threshold: float = 70.0
     gc_max_allocs: int = 50
     gc_parallel_destroys: int = 2
+    # Consul-shaped catalog HTTP address for server discovery
+    # (client/config consul block; client.go:2139 consulDiscovery)
+    consul_address: str = ""
+    # Vault transport for client-side token renewal
+    # (client/vaultclient against the real Vault HTTP API)
+    vault_addr: str = ""
+    vault_token: str = ""
     # Dev-mode shortcuts
     dev_mode: bool = False
 

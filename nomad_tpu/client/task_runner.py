@@ -11,6 +11,7 @@ reference so `alloc-status` output is comparable.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import Callable, Dict, Optional
@@ -46,6 +47,8 @@ class TaskRunner:
                  updater: StateUpdater,
                  node: Optional[s.Node] = None,
                  vault_token: str = "",
+                 vault_client=None,
+                 consul=None,
                  logger: Optional[logging.Logger] = None):
         self.config = config
         self.alloc = alloc
@@ -54,6 +57,8 @@ class TaskRunner:
         self.updater = updater
         self.node = node
         self.vault_token = vault_token
+        self.vault_client = vault_client
+        self.consul = consul
         self.logger = logger or logging.getLogger("nomad_tpu.client.task_runner")
 
         tg = alloc.job.lookup_task_group(alloc.task_group) if alloc.job else None
@@ -162,6 +167,9 @@ class TaskRunner:
                        s.TaskEvent(type=s.TASK_SETUP_FAILURE, failed=True,
                                    message=str(e)))
         finally:
+            if self.vault_token and self.vault_client is not None:
+                self.vault_client.stop_renew_token(self.vault_token)
+            self._deregister_services()
             self.done.set()
 
     def _prestart(self, task_env: envmod.TaskEnv) -> bool:
@@ -191,8 +199,62 @@ class TaskRunner:
             ev = self._destroy_event or s.TaskEvent(type=s.TASK_KILLED)
             self._emit(s.TASK_STATE_DEAD, ev)
 
+    def _derive_vault_token(self) -> bool:
+        """Fetch this task's Vault token through the client's manager and
+        write it to the secrets dir (task_runner.go:675 vault token
+        lifecycle + :785 writeToken); starts renewal tracking."""
+        if self.task.vault is None or self.vault_client is None \
+                or self.vault_token:
+            return True
+        try:
+            info = self.vault_client.derive_token(
+                self.alloc.id, [self.task.name])[self.task.name]
+        except Exception as e:
+            self._emit(s.TASK_STATE_DEAD,
+                       s.TaskEvent(type=s.TASK_SETUP_FAILURE, failed=True,
+                                   message=f"vault token derivation "
+                                           f"failed: {e}"))
+            return False
+        self.vault_token = info["token"]
+        try:
+            token_path = os.path.join(self.task_dir.secrets_dir,
+                                      "vault_token")
+            with open(token_path, "w", encoding="utf-8") as fh:
+                fh.write(self.vault_token)
+            os.chmod(token_path, 0o600)
+        except OSError as e:
+            self.logger.warning("vault token write failed: %s", e)
+        self.vault_client.renew_token(self.vault_token,
+                                      float(info.get("ttl") or 3600.0))
+        return True
+
+    def _register_services(self, handle) -> None:
+        """Advertise the task's services + checks with the task lifecycle
+        (consul/client.go RegisterTask; script checks exec through the
+        driver handle, consul/script.go)."""
+        if self.consul is None or not self.task.services:
+            return
+        # Driver handles expose exec_cmd(cmd, args) -> (output, exit_code)
+        # (driver.py DriverHandle); script checks run through it
+        # (consul/script.go execs via the driver).
+        exec_fn = getattr(handle, "exec_cmd", None)
+        try:
+            self.consul.register_task(self.alloc, self.task, exec_fn=exec_fn)
+        except Exception as e:
+            self.logger.warning("consul: service registration failed: %s", e)
+
+    def _deregister_services(self) -> None:
+        if self.consul is None or not self.task.services:
+            return
+        try:
+            self.consul.deregister_task(self.alloc.id, self.task.name)
+        except Exception as e:
+            self.logger.warning("consul: deregistration failed: %s", e)
+
     def _loop_body(self) -> None:
         while not self._destroy.is_set():
+            if not self._derive_vault_token():
+                return
             task_env = self._build_env()
 
             if not self._prestart(task_env):
@@ -217,6 +279,7 @@ class TaskRunner:
             with self._handle_lock:
                 self.handle = resp.handle
             self._emit(s.TASK_STATE_RUNNING, s.TaskEvent(type=s.TASK_STARTED))
+            self._register_services(resp.handle)
 
             # -- wait -----------------------------------------------------
             wait_ev = resp.handle.wait_ch()
@@ -229,6 +292,7 @@ class TaskRunner:
                     wait_ev.wait()
                     break
             res: WaitResult = resp.handle.wait_result()
+            self._deregister_services()
             with self._handle_lock:
                 self.handle = None
 

@@ -1,0 +1,4 @@
+from .catalog import CatalogEntry, ServiceCatalog
+from .service_client import ServiceClient
+
+__all__ = ["CatalogEntry", "ServiceCatalog", "ServiceClient"]

@@ -111,7 +111,13 @@ def register_endpoints(server, rpc) -> None:
     def node_evaluate(body):
         return {"EvalIDs": server.node_evaluate(body["NodeID"])}
 
+    def node_derive_vault_token(body):
+        tokens = server.derive_vault_token(body["AllocID"],
+                                           body.get("Tasks") or [])
+        return {"Tasks": tokens}
+
     register("Node.Evaluate", node_evaluate)
+    register("Node.DeriveVaultToken", node_derive_vault_token)
     register("Node.Register", node_register)
     register("Node.UpdateStatus", node_update_status)
     register("Node.GetClientAllocs", node_get_client_allocs)

@@ -48,6 +48,7 @@ class FSM:
         on_unblock: Optional[Callable[[str, int], None]] = None,
         on_job_register: Optional[Callable[[s.Job], None]] = None,
         on_job_deregister: Optional[Callable[[str], None]] = None,
+        on_alloc_terminal: Optional[Callable[[str], None]] = None,
     ):
         self.state = state or StateStore()
         self.logger = logger or logging.getLogger("nomad_tpu.fsm")
@@ -56,6 +57,9 @@ class FSM:
         self.on_unblock = on_unblock
         self.on_job_register = on_job_register
         self.on_job_deregister = on_job_deregister
+        # Vault revocation trigger (vault.go RevokeTokens via fsm alloc
+        # client updates): called with the alloc id on terminal transition.
+        self.on_alloc_terminal = on_alloc_terminal
 
     # -- apply -------------------------------------------------------------
 
@@ -148,15 +152,18 @@ class FSM:
         self.state.update_allocs_from_client(index, allocs)
         # Unblock on terminal client updates: capacity freed
         # (fsm.go:465-units).
-        if self.on_unblock:
-            for alloc in allocs:
-                if alloc.client_terminal_status():
-                    existing = self.state.alloc_by_id(None, alloc.id)
-                    if existing is None:
-                        continue
-                    node = self.state.node_by_id(None, existing.node_id)
-                    if node is not None and node.computed_class:
-                        self.on_unblock(node.computed_class, index)
+        for alloc in allocs:
+            if not alloc.client_terminal_status():
+                continue
+            if self.on_alloc_terminal is not None:
+                self.on_alloc_terminal(alloc.id)
+            if self.on_unblock:
+                existing = self.state.alloc_by_id(None, alloc.id)
+                if existing is None:
+                    continue
+                node = self.state.node_by_id(None, existing.node_id)
+                if node is not None and node.computed_class:
+                    self.on_unblock(node.computed_class, index)
 
     # -- plan results ------------------------------------------------------
 

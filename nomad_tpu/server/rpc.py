@@ -343,3 +343,8 @@ class RemoteServerRPC:
             "Node.UpdateAlloc",
             {"Allocs": [self._to_wire(a) for a in allocs]})
         return reply["Index"]
+
+    def derive_vault_token(self, alloc_id: str, task_names):
+        reply = self._call("Node.DeriveVaultToken",
+                           {"AllocID": alloc_id, "Tasks": list(task_names)})
+        return reply["Tasks"]

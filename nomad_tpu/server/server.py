@@ -23,6 +23,7 @@ from .periodic import PeriodicDispatch, derive_job
 from .plan_apply import PlanApplier
 from .plan_queue import PlanQueue
 from .raft import FileLog, InmemLog, MultiRaft, NotLeaderError, RaftLog
+from .vault import ServerVaultClient, VaultConfig, VaultError
 from .worker import BatchWorker, Worker
 
 
@@ -52,15 +53,22 @@ class ServerConfig:
     eval_gc_interval: float = 300.0
     enabled_schedulers: List[str] = field(default_factory=lambda: [
         s.JOB_TYPE_SERVICE, s.JOB_TYPE_BATCH, s.JOB_TYPE_SYSTEM, s.JOB_TYPE_CORE])
+    vault: Optional[VaultConfig] = None
 
 
 class Server:
     """A single control-plane server (nomad/server.go:78 Server)."""
 
     def __init__(self, config: Optional[ServerConfig] = None,
-                 logger: Optional[logging.Logger] = None):
+                 logger: Optional[logging.Logger] = None,
+                 vault_api=None):
         self.config = config or ServerConfig()
         self.logger = logger or logging.getLogger("nomad_tpu.server")
+        # Vault client (nomad/vault.go:234); vault_api injects the fake
+        # in tests (vault_testing.go role).
+        self.vault = ServerVaultClient(self.config.vault or VaultConfig(),
+                                       api=vault_api,
+                                       logger=self.logger.getChild("vault"))
         # Must precede raft construction: WAL replay fires FSM hooks that
         # consult leadership.
         self._leader = False
@@ -79,6 +87,7 @@ class Server:
             on_unblock=self._fsm_unblock,
             on_job_register=self._fsm_job_registered,
             on_job_deregister=self._fsm_job_deregistered,
+            on_alloc_terminal=self._fsm_alloc_terminal,
         )
 
         # RPC listener + connection pool (nomad/server.go:250 setupRPC).
@@ -188,6 +197,7 @@ class Server:
         self.plan_queue.set_enabled(False)
         self.periodic.set_enabled(False)
         self.heartbeat.set_enabled(False)
+        self.vault.stop()
         self.raft.close()
         if self.rpc is not None:
             self.rpc.shutdown()
@@ -338,9 +348,25 @@ class Server:
         self._restore_evals()
         self._restore_periodic_dispatcher()
         self._start_reapers()
+        self._restore_revoking_accessors()
         # Reconcile voters with members discovered while we were a
         # follower (leader.go establishes raft config on leadership).
         self._maybe_bootstrap()
+
+    def _restore_revoking_accessors(self) -> None:
+        """Revoke accessors whose allocation is already terminal or gone —
+        the previous leader may have died mid-revocation
+        (leader.go:221-260 restoreRevokingAccessors)."""
+        if not self.vault.enabled:
+            return
+        stale = []
+        for acc in self.state.vault_accessors(None):
+            alloc = self.state.alloc_by_id(None, acc.alloc_id)
+            if alloc is None or alloc.terminal_status():
+                stale.append(acc)
+        if stale:
+            threading.Thread(target=self._revoke_accessors,
+                             args=(stale,), daemon=True).start()
 
     def _revoke_leadership(self) -> None:
         self._leader = False
@@ -443,6 +469,27 @@ class Server:
     def _fsm_job_deregistered(self, job_id: str) -> None:
         if self._leader:
             self.periodic.remove(job_id)
+
+    def _fsm_alloc_terminal(self, alloc_id: str) -> None:
+        """Terminal alloc ⇒ revoke its derived Vault tokens
+        (vault.go RevokeTokens on alloc terminal)."""
+        if not self._leader or not self.vault.enabled:
+            return
+        accessors = self.state.vault_accessors_by_alloc(None, alloc_id)
+        if accessors:
+            threading.Thread(target=self._revoke_accessors,
+                             args=(accessors,), daemon=True).start()
+
+    def _revoke_accessors(self, accessors) -> None:
+        done = self.vault.revoke_accessors([a.accessor for a in accessors])
+        if not done:
+            return
+        to_remove = [a for a in accessors if a.accessor in done]
+        try:
+            self.raft.apply(MessageType.VAULT_ACCESSOR_DEREGISTER,
+                            {"accessors": to_remove})
+        except NotLeaderError:
+            pass  # new leader's restore pass re-revokes (idempotent)
 
     # -- heartbeat / periodic callbacks ------------------------------------
 
@@ -856,6 +903,47 @@ class Server:
 
     def node_get_allocs(self, node_id: str) -> List[s.Allocation]:
         return self.state.allocs_by_node(None, node_id)
+
+    def derive_vault_token(self, alloc_id: str, task_names: List[str]
+                           ) -> Dict[str, Dict]:
+        """Derive per-task Vault tokens for a client
+        (node_endpoint.go DeriveVaultToken → vault.go DeriveToken):
+        validates the alloc, mints tokens, and registers the accessors
+        through the log so a leader failover can still revoke them."""
+        from ..state.state_store import VaultAccessor
+
+        if not self._leader:
+            # Forward before minting: a follower must not create tokens it
+            # cannot register for revocation.
+            reply = self._forward(
+                "Node.DeriveVaultToken",
+                {"AllocID": alloc_id, "Tasks": list(task_names)})
+            return reply["Tasks"]
+        alloc = self.state.alloc_by_id(None, alloc_id)
+        if alloc is None:
+            raise KeyError(f"allocation {alloc_id!r} not found")
+        if alloc.terminal_status():
+            raise VaultError("cannot derive token for terminal allocation")
+        if alloc.job is None:
+            alloc = alloc.copy()
+            alloc.job = self.state.job_by_id(None, alloc.job_id)
+        tokens = self.vault.derive_token(alloc, task_names)
+        accessors = [VaultAccessor(
+            accessor=info["accessor"], alloc_id=alloc_id,
+            node_id=alloc.node_id, task=task,
+            creation_ttl=int(info.get("ttl", 0)),
+        ) for task, info in tokens.items()]
+        try:
+            self.raft.apply(MessageType.VAULT_ACCESSOR_REGISTER,
+                            {"accessors": accessors})
+        except NotLeaderError:
+            # Leadership lost between mint and registration: the tokens
+            # exist in Vault but no replica knows about them — revoke
+            # immediately rather than leak live credentials for their
+            # full TTL (vault.go revokes on registration failure).
+            self.vault.revoke_accessors([a.accessor for a in accessors])
+            raise
+        return tokens
 
     def node_get_client_allocs(self, node_id: str, min_index: int = 0,
                                max_wait: float = 0.0) -> Tuple[List[s.Allocation], int]:

@@ -1,0 +1,213 @@
+"""Server-side Vault subsystem (reference: nomad/vault.go:234-1218
+vaultClient): derives per-task tokens against a Vault endpoint, renews the
+server's own token, and revokes accessors when allocations terminate.
+
+The transport is pluggable: ``HTTPVault`` speaks the real Vault token API
+(/v1/auth/token/*); ``FakeVault`` is the in-memory double used by tests
+and dev mode (the role of nomad/vault_testing.go + testutil/vault.go).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..structs import structs as s
+
+
+class VaultError(Exception):
+    pass
+
+
+@dataclass
+class VaultConfig:
+    """(reference: nomad/structs/config/vault.go VaultConfig)."""
+
+    enabled: bool = False
+    addr: str = "https://vault.service.consul:8200"
+    token: str = ""
+    task_token_ttl: float = 72 * 3600.0
+    allow_unauthenticated: bool = True
+
+
+class VaultAPI:
+    """The subset of Vault's token API the control plane uses."""
+
+    def create_token(self, policies: List[str], ttl: float,
+                     metadata: Dict[str, str]) -> Dict:
+        """→ {"token", "accessor", "ttl"} (auth/token/create)."""
+        raise NotImplementedError
+
+    def renew_token(self, token: str, increment: float) -> float:
+        """→ new ttl seconds (auth/token/renew)."""
+        raise NotImplementedError
+
+    def revoke_accessor(self, accessor: str) -> None:
+        """(auth/token/revoke-accessor)."""
+        raise NotImplementedError
+
+    def lookup_token(self, token: str) -> Dict:
+        """(auth/token/lookup)."""
+        raise NotImplementedError
+
+
+class FakeVault(VaultAPI):
+    """In-memory Vault double: real token/accessor lifecycle, inspectable
+    revocations (nomad/vault_testing.go)."""
+
+    def __init__(self) -> None:
+        self._l = threading.Lock()
+        self.tokens: Dict[str, Dict] = {}          # token -> record
+        self.by_accessor: Dict[str, str] = {}      # accessor -> token
+        self.revoked_accessors: List[str] = []
+        self.renew_calls = 0
+
+    def create_token(self, policies, ttl, metadata):
+        token = "s." + s.generate_uuid()
+        accessor = "a." + s.generate_uuid()
+        with self._l:
+            rec = {"token": token, "accessor": accessor,
+                   "policies": list(policies), "ttl": ttl,
+                   "expires": time.time() + ttl,
+                   "metadata": dict(metadata), "revoked": False}
+            self.tokens[token] = rec
+            self.by_accessor[accessor] = token
+        return {"token": token, "accessor": accessor, "ttl": ttl}
+
+    def renew_token(self, token, increment):
+        with self._l:
+            rec = self.tokens.get(token)
+            if rec is None or rec["revoked"]:
+                raise VaultError("token not found or revoked")
+            rec["expires"] = time.time() + increment
+            rec["ttl"] = increment
+            self.renew_calls += 1
+            return increment
+
+    def revoke_accessor(self, accessor):
+        with self._l:
+            token = self.by_accessor.get(accessor)
+            if token is not None:
+                self.tokens[token]["revoked"] = True
+            self.revoked_accessors.append(accessor)
+
+    def lookup_token(self, token):
+        with self._l:
+            rec = self.tokens.get(token)
+            if rec is None or rec["revoked"]:
+                raise VaultError("token not found or revoked")
+            return dict(rec)
+
+    # test helpers
+    def is_revoked(self, accessor: str) -> bool:
+        with self._l:
+            return accessor in self.revoked_accessors
+
+
+class HTTPVault(VaultAPI):
+    """Real-Vault transport over its HTTP token API (vault.go uses the
+    official client; the wire calls are the same four)."""
+
+    def __init__(self, addr: str, token: str, timeout: float = 10.0):
+        self.addr = addr.rstrip("/")
+        self.token = token
+        self.timeout = timeout
+
+    def _call(self, method: str, path: str, body: Optional[dict] = None):
+        import json
+        import urllib.request
+
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(self.addr + path, data=data,
+                                     method=method)
+        req.add_header("X-Vault-Token", self.token)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                raw = resp.read()
+                return json.loads(raw) if raw else {}
+        except Exception as e:  # connection + HTTP errors alike
+            raise VaultError(f"vault request {path} failed: {e}") from e
+
+    def create_token(self, policies, ttl, metadata):
+        out = self._call("POST", "/v1/auth/token/create", {
+            "policies": policies, "ttl": f"{int(ttl)}s",
+            "meta": metadata, "renewable": True})
+        auth = out.get("auth") or {}
+        return {"token": auth.get("client_token", ""),
+                "accessor": auth.get("accessor", ""),
+                "ttl": float(auth.get("lease_duration", ttl))}
+
+    def renew_token(self, token, increment):
+        out = self._call("POST", "/v1/auth/token/renew", {
+            "token": token, "increment": f"{int(increment)}s"})
+        return float((out.get("auth") or {}).get("lease_duration", increment))
+
+    def revoke_accessor(self, accessor):
+        self._call("POST", "/v1/auth/token/revoke-accessor",
+                   {"accessor": accessor})
+
+    def lookup_token(self, token):
+        return self._call("POST", "/v1/auth/token/lookup", {"token": token})
+
+
+class ServerVaultClient:
+    """Token derivation + revocation driver on the server
+    (vault.go:234 vaultClient; DeriveToken at vault.go:~900,
+    RevokeTokens at vault.go:~1050)."""
+
+    def __init__(self, config: VaultConfig, api: Optional[VaultAPI] = None,
+                 logger: Optional[logging.Logger] = None):
+        self.config = config
+        self.logger = logger or logging.getLogger("nomad_tpu.vault")
+        self.api = api if api is not None else (
+            HTTPVault(config.addr, config.token) if config.enabled else None)
+        self._stop = threading.Event()
+
+    @property
+    def enabled(self) -> bool:
+        return self.config.enabled and self.api is not None
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def derive_token(self, alloc: s.Allocation, task_names: List[str]
+                     ) -> Dict[str, Dict]:
+        """Create one token per task → {task: {token, accessor, ttl}}.
+        Tasks must carry a vault block (vault.go DeriveToken
+        validation)."""
+        if not self.enabled:
+            raise VaultError("Vault is not enabled")
+        job = alloc.job
+        if job is None:
+            raise VaultError("allocation has no job")
+        tg = next((g for g in job.task_groups
+                   if g.name == alloc.task_group), None)
+        if tg is None:
+            raise VaultError(f"unknown task group {alloc.task_group!r}")
+        out: Dict[str, Dict] = {}
+        for name in task_names:
+            task = next((t for t in tg.tasks if t.name == name), None)
+            if task is None or task.vault is None:
+                raise VaultError(
+                    f"task {name!r} does not request a Vault token")
+            out[name] = self.api.create_token(
+                task.vault.policies, self.config.task_token_ttl,
+                {"AllocationID": alloc.id, "Task": name,
+                 "NodeID": alloc.node_id})
+        return out
+
+    def revoke_accessors(self, accessors: List[str]) -> List[str]:
+        """Best-effort revoke; returns accessors revoked successfully."""
+        if not self.enabled:
+            return list(accessors)  # nothing to revoke against
+        done = []
+        for acc in accessors:
+            try:
+                self.api.revoke_accessor(acc)
+                done.append(acc)
+            except VaultError as e:
+                self.logger.warning("vault: revoke %s failed: %s", acc, e)
+        return done

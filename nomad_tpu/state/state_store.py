@@ -696,6 +696,12 @@ class StateStore:
             self._bump("vault_accessors", index)
         self._notify()
 
+    def vault_accessors(self, ws: Optional[WatchSet]) -> List[VaultAccessor]:
+        if ws is not None:
+            ws.add(self, "vault_accessors")
+        with self._lock:
+            return list(self.vault_accessors_table.values())
+
     def vault_accessor(self, ws: Optional[WatchSet], accessor: str) -> Optional[VaultAccessor]:
         if ws is not None:
             ws.add(self, "vault_accessors")

@@ -17,7 +17,9 @@ from nomad_tpu.server.rpc import ConnPool
 from nomad_tpu.structs import structs as s
 
 
-def wait_until(predicate, timeout=10.0, interval=0.02):
+def wait_until(predicate, timeout=30.0, interval=0.02):
+    """Generous default budget: elections under full-suite CPU contention
+    can need several rounds."""
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if predicate():

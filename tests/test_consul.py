@@ -1,0 +1,213 @@
+"""Consul-equivalent tests: service catalog, task service lifecycle,
+checks, agent self-registration, and client server-discovery
+(reference: command/agent/consul/client.go:87, client/client.go:2139,
+command/agent/agent.go:492)."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.consul import CatalogEntry, ServiceCatalog, ServiceClient
+from nomad_tpu.consul.catalog import CHECK_CRITICAL, CHECK_PASSING
+from nomad_tpu.structs import structs as s
+
+
+def wait_until(pred, timeout=15.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestCatalog:
+    def test_register_query_deregister(self):
+        cat = ServiceCatalog()
+        cat.register(CatalogEntry(id="a", name="web", tags=["v1"],
+                                  address="10.0.0.1", port=80))
+        cat.register(CatalogEntry(id="b", name="web", tags=["v2"],
+                                  address="10.0.0.2", port=81))
+        cat.register(CatalogEntry(id="c", name="db", address="10.0.0.3",
+                                  port=5432))
+        assert set(cat.services()) == {"web", "db"}
+        assert sorted(cat.services()["web"]) == ["v1", "v2"]
+        assert [e.address for e in cat.service("web")] == \
+            ["10.0.0.1", "10.0.0.2"]
+        assert [e.id for e in cat.service("web", tag="v2")] == ["b"]
+        cat.deregister("a")
+        assert [e.id for e in cat.service("web")] == ["b"]
+
+
+class TestServiceClient:
+    def make_alloc_with_service(self, checks=()):
+        job = mock.job()
+        task = job.task_groups[0].tasks[0]
+        task.services = [s.Service(
+            name="web-frontend", port_label="http", tags=["prod"],
+            checks=list(checks))]
+        alloc = mock.alloc()
+        alloc.job = job
+        alloc.task_resources = {"web": s.Resources(networks=[
+            s.NetworkResource(device="eth0", ip="192.168.1.10", mbits=10,
+                              dynamic_ports=[s.Port("http", 23456)])])}
+        return alloc, task
+
+    def test_task_service_lifecycle(self):
+        cat = ServiceCatalog()
+        sc = ServiceClient(cat)
+        alloc, task = self.make_alloc_with_service()
+        sc.register_task(alloc, task)
+        entries = cat.service("web-frontend")
+        assert len(entries) == 1
+        e = entries[0]
+        assert e.address == "192.168.1.10" and e.port == 23456
+        assert e.tags == ["prod"]
+        assert alloc.id in e.id and "web" in e.id
+        sc.deregister_task(alloc.id, task.name)
+        assert cat.service("web-frontend") == []
+
+    def test_script_check_runs_through_exec(self):
+        cat = ServiceCatalog()
+        sc = ServiceClient(cat)
+        sc.start()
+        try:
+            chk = s.ServiceCheck(name="status", type="script",
+                                 command="/bin/check", interval=0.1)
+            alloc, task = self.make_alloc_with_service(checks=[chk])
+            calls = {"n": 0}
+
+            def exec_fn(cmd, args):
+                # DriverHandle.exec_cmd shape: (output, exit_code)
+                calls["n"] += 1
+                return f"run {calls['n']}", (0 if calls["n"] < 3 else 1)
+
+            sc.register_task(alloc, task, exec_fn=exec_fn)
+            entries = cat.service("web-frontend")
+            cid = entries[0].checks[0].id
+            sid = entries[0].id
+            assert wait_until(lambda: calls["n"] >= 3, 5.0)
+            assert wait_until(lambda: cat.entry(sid).checks[0].status ==
+                              CHECK_CRITICAL, 5.0)
+            assert not cat.entry(sid).healthy()
+        finally:
+            sc.stop()
+
+    def test_tcp_check(self):
+        import socketserver
+        import threading
+
+        class Quiet(socketserver.BaseRequestHandler):
+            def handle(self):
+                pass
+
+        srv = socketserver.TCPServer(("127.0.0.1", 0), Quiet)
+        port = srv.server_address[1]
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            cat = ServiceCatalog()
+            sc = ServiceClient(cat)
+            sc.start()
+            chk = s.ServiceCheck(name="up", type="tcp", port_label="http",
+                                 interval=0.1, timeout=1.0)
+            alloc, task = self.make_alloc_with_service(checks=[chk])
+            alloc.task_resources["web"].networks[0].ip = "127.0.0.1"
+            alloc.task_resources["web"].networks[0].dynamic_ports = [
+                s.Port("http", port)]
+            sc.register_task(alloc, task)
+            sid = cat.service("web-frontend")[0].id
+            assert wait_until(
+                lambda: cat.entry(sid).checks[0].output == "tcp connect ok",
+                5.0)
+            sc.stop()
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+
+class TestAgentIntegration:
+    """Services ride the task lifecycle; agents self-register; clients
+    discover servers through the catalog HTTP surface."""
+
+    def _wait_ready(self, srv, client):
+        return wait_until(lambda: srv.node_get(client.node.id) is not None
+                          and srv.node_get(client.node.id).status == "ready")
+
+    def test_services_follow_alloc_lifecycle(self, tmp_path):
+        from nomad_tpu.agent.agent import Agent
+        from nomad_tpu.agent.config import AgentConfig
+
+        cfg = AgentConfig.dev()
+        cfg.client.state_dir = str(tmp_path / "state")
+        cfg.client.alloc_dir = str(tmp_path / "allocs")
+        agent = Agent(cfg)
+        agent.start()
+        try:
+            assert self._wait_ready(agent.server, agent.client)
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.restart_policy = s.RestartPolicy(attempts=0, mode="fail")
+            for t in tg.tasks:
+                t.driver = "mock_driver"
+                t.config = {"run_for": "60s"}
+                t.resources.networks = []
+                t.services = [s.Service(name="web-svc", tags=["t1"])]
+            agent.server.job_register(job)
+            assert wait_until(lambda: len(
+                agent.catalog.service("web-svc")) == 1, 20.0), \
+                "service not registered with running task"
+
+            agent.server.job_deregister(job.id, purge=False)
+            assert wait_until(lambda: agent.catalog.service("web-svc") == [],
+                              20.0), "service not deregistered on stop"
+        finally:
+            agent.shutdown()
+
+    def test_agent_self_registration_and_discovery(self, tmp_path):
+        from nomad_tpu.agent.agent import Agent
+        from nomad_tpu.agent.config import AgentConfig
+
+        # Server-only agent hosting the catalog.
+        scfg = AgentConfig()
+        scfg.name = "srv"
+        scfg.data_dir = str(tmp_path / "srv")
+        scfg.server.enabled = True
+        scfg.server.data_dir = str(tmp_path / "srv")
+        scfg.ports.http = 0
+        scfg.ports.rpc = 0
+        server_agent = Agent(scfg)
+        server_agent.start()
+        client_agent = None
+        try:
+            nomads = server_agent.catalog.service("nomad")
+            assert len(nomads) == 1
+            rpc_addr = server_agent.server.config.rpc_advertise
+            assert f"{nomads[0].address}:{nomads[0].port}" == rpc_addr
+
+            # Client-only agent with NO server list — discovers via the
+            # catalog HTTP surface (client.go:2139 consulDiscovery).
+            ccfg = AgentConfig()
+            ccfg.name = "cli"
+            ccfg.client.enabled = True
+            ccfg.client.state_dir = str(tmp_path / "cstate")
+            ccfg.client.alloc_dir = str(tmp_path / "callocs")
+            ccfg.client.servers = ["127.0.0.1:1"]  # dead on purpose
+            ccfg.client.consul_address = server_agent.http.address
+            ccfg.ports.http = 0
+            client_agent = Agent(ccfg)
+            # fast retry so the test doesn't sit through the 15s interval
+            import nomad_tpu.client.client as cmod
+            orig = cmod.REGISTER_RETRY_INTERVAL
+            cmod.REGISTER_RETRY_INTERVAL = 0.3
+            try:
+                client_agent.start()
+                assert self._wait_ready(server_agent.server,
+                                        client_agent.client), \
+                    "client never registered via discovered servers"
+            finally:
+                cmod.REGISTER_RETRY_INTERVAL = orig
+        finally:
+            if client_agent is not None:
+                client_agent.shutdown()
+            server_agent.shutdown()
