@@ -119,6 +119,12 @@ class PlacementResult(NamedTuple):
     unplaced: jnp.ndarray     # [U] int32 — counts that found no feasible node
     used_after: jnp.ndarray   # [N, 4] int32 — final node usage
     rounds: jnp.ndarray       # [] int32
+    # AllocMetric side-outputs (structs.go:4074 contract): the PURE
+    # binpack score (rank.go:138 score_node "binpack") and the job
+    # collision count at commit time — the host derives the separate
+    # "job-anti-affinity" score entry from the latter (rank.go:167).
+    commit_scores: jnp.ndarray = None      # [U, N] float32
+    commit_collisions: jnp.ndarray = None  # [U, N] int32
 
 
 class NetTensors(NamedTuple):
@@ -242,7 +248,8 @@ def _placement_rounds_impl(
 
     def place_one_spec(carry, u):
         (used, job_counts, remaining_count, placements,
-         bw_used, port_words, dyn_free, dp_used) = carry
+         bw_used, port_words, dyn_free, dp_used, commit_scores,
+         commit_coll) = carry
 
         cap_left = capacity - used                       # [N, 4]
         fits = jnp.all(ask[u][None, :] <= cap_left, axis=1)
@@ -266,8 +273,8 @@ def _placement_rounds_impl(
         dp_ok = (codes != MISSING) & ~dp_used[u, code_c]
         ok = ok & jnp.where(dp.active[u], dp_ok, True)
 
-        score = _score_fit(used, ask[u], denom)
-        score = score - penalty[u] * collisions.astype(jnp.float32)
+        base_score = _score_fit(used, ask[u], denom)
+        score = base_score - penalty[u] * collisions.astype(jnp.float32)
         score = score + jitter[u]
         scored = jnp.where(ok, score, NEG_INF)
 
@@ -304,45 +311,60 @@ def _placement_rounds_impl(
         dp_upd = jnp.zeros(v_pad, dtype=bool).at[code_c].max(
             sel & dp.active[u])
         dp_used = dp_used.at[u].set(dp_used[u] | dp_upd)
+        # Commit-time AllocMetric side-outputs: pure binpack score and
+        # the collision count behind any anti-affinity penalty.
+        commit_scores = commit_scores.at[u].set(jnp.where(
+            sel, base_score, commit_scores[u]))
+        commit_coll = commit_coll.at[u].set(jnp.where(
+            sel, collisions, commit_coll[u]))
 
         return (used, job_counts, remaining_count, placements,
-                bw_used, port_words, dyn_free, dp_used), placed
+                bw_used, port_words, dyn_free, dp_used,
+                commit_scores, commit_coll), placed
 
     def round_body(state):
         (used, job_counts, remaining_count, placements,
-         bw_used, port_words, dyn_free, dp_used, _, rounds) = state
+         bw_used, port_words, dyn_free, dp_used, commit_scores,
+         commit_coll, _, rounds) = state
         carry, placed = lax.scan(
             place_one_spec,
             (used, job_counts, remaining_count, placements,
-             bw_used, port_words, dyn_free, dp_used),
+             bw_used, port_words, dyn_free, dp_used, commit_scores,
+             commit_coll),
             jnp.arange(u_pad),
         )
         (used, job_counts, remaining_count, placements,
-         bw_used, port_words, dyn_free, dp_used) = carry
+         bw_used, port_words, dyn_free, dp_used, commit_scores,
+         commit_coll) = carry
         progress = jnp.sum(placed)
         return (used, job_counts, remaining_count, placements,
-                bw_used, port_words, dyn_free, dp_used,
-                progress, rounds + 1)
+                bw_used, port_words, dyn_free, dp_used, commit_scores,
+                commit_coll, progress, rounds + 1)
 
     def round_cond(state):
         remaining_count = state[2]
-        progress = state[8]
-        rounds = state[9]
+        progress = state[10]
+        rounds = state[11]
         return (progress > 0) & (jnp.sum(remaining_count) > 0) & (rounds < max_rounds)
 
     placements0 = jnp.zeros((u_pad, n_pad), dtype=jnp.int32)
+    scores0 = jnp.zeros((u_pad, n_pad), dtype=jnp.float32)
+    coll0 = jnp.zeros((u_pad, n_pad), dtype=jnp.int32)
     state = (used0, job_counts0, count, placements0,
-             net.bw_used, net.port_words, net.dyn_free, dp.used0,
+             net.bw_used, net.port_words, net.dyn_free, dp.used0, scores0,
+             coll0,
              jnp.array(1, dtype=jnp.int32), jnp.array(0, dtype=jnp.int32))
     (used, job_counts, remaining, placements,
-     _bw, _pw, _df, _dpu, _, rounds) = lax.while_loop(
-        round_cond, round_body, state)
+     _bw, _pw, _df, _dpu, commit_scores, commit_coll, _,
+     rounds) = lax.while_loop(round_cond, round_body, state)
 
     return PlacementResult(
         placements=placements,
         unplaced=remaining,
         used_after=used,
         rounds=rounds,
+        commit_scores=commit_scores,
+        commit_collisions=commit_coll,
     )
 
 

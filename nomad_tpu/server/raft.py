@@ -444,8 +444,12 @@ class MultiRaft(RaftLog):
     single-voter path so the Server code above it does not change.
     """
 
+    # Election timeout must comfortably exceed worst-case scheduling
+    # latency for the first post-election heartbeat — too tight and a
+    # loaded host deposes every new leader before its heartbeat lands
+    # (the reference runs 500ms-1s timeouts against 100ms heartbeats).
     HEARTBEAT_INTERVAL = 0.05
-    ELECTION_TIMEOUT = (0.15, 0.30)
+    ELECTION_TIMEOUT = (0.30, 0.60)
     APPLY_TIMEOUT = 10.0
     REPLICATE_BATCH = 512
     # Auto-compact once the in-memory log exceeds this many entries

@@ -106,8 +106,13 @@ def find_leader(servers):
 
 
 def wait_for_leader(servers, timeout=30.0):
-    assert wait_until(lambda: find_leader(servers) is not None, timeout), \
-        "no leader elected"
+    if not wait_until(lambda: find_leader(servers) is not None, timeout):
+        detail = "; ".join(
+            f"{srv.config.node_name}: raft={srv.raft.state} "
+            f"term={srv.raft.term} leader_flag={srv.is_leader()} "
+            f"peers={len(srv.raft.peers)} members={len(srv.members())}"
+            for srv in servers)
+        raise AssertionError(f"no leader elected: {detail}")
     return find_leader(servers)
 
 

@@ -17,7 +17,9 @@ from nomad_tpu.server import (
 from nomad_tpu.structs import structs as s
 
 
-def wait_until(predicate, timeout=10.0, interval=0.02):
+def wait_until(predicate, timeout=30.0, interval=0.02):
+    """Generous default: the first tpu-batch placement in a process pays
+    the XLA compile, which under full-suite load can take >10s."""
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if predicate():
@@ -460,7 +462,7 @@ class TestBatchWorkerMixedStream:
             job = make_job(2)
             _, eval_id = srv.job_register(job)
             assert wait_until(lambda: len(
-                srv.state.allocs_by_job(None, job.id, True)) == 2, 15.0)
+                srv.state.allocs_by_job(None, job.id, True)) == 2, 30.0)
             assert calls["n"] >= 2
             ev = srv.state.eval_by_id(None, eval_id)
             assert ev.status == s.EVAL_STATUS_COMPLETE
