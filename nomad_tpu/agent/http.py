@@ -113,6 +113,7 @@ class HTTPServer:
         r("/v1/system/reconcile/summaries", self.system_reconcile_request)
         r("/v1/catalog/services", self.catalog_services_request)
         r("/v1/catalog/service/(?P<name>[^/]+)", self.catalog_service_request)
+        r("/v1/metrics", self.metrics_request)
 
     def _route(self, pattern: str, fn: Callable) -> None:
         self.routes.append((pattern, re.compile("^" + pattern + "$"), fn))
@@ -597,6 +598,11 @@ class HTTPServer:
     # endpoint the reference gets from the real Consul HTTP API).
     def catalog_services_request(self, req, query):
         return self.agent.catalog.services(), None
+
+    def metrics_request(self, req, query):
+        """In-memory telemetry aggregates (the reference's go-metrics
+        inventory; names per telemetry.html.md)."""
+        return self.server.metrics.sink.data(), None
 
     def catalog_service_request(self, req, query, name: str):
         tag = query.get("tag", "")

@@ -24,6 +24,7 @@ from ..structs import structs as s
 from ..structs.funcs import allocs_fit, remove_allocs
 from .fsm import MessageType
 from .plan_queue import PlanFuture, PlanQueue
+from ..utils.telemetry import NULL_TELEMETRY
 from .raft import RaftLog
 
 # Above this many touched nodes the vectorized fit re-check is used.
@@ -32,9 +33,11 @@ VECTORIZE_THRESHOLD = 64
 
 class PlanApplier:
     def __init__(self, plan_queue: PlanQueue, raft: RaftLog,
-                 logger: Optional[logging.Logger] = None):
+                 logger: Optional[logging.Logger] = None,
+                 metrics=None):
         self.plan_queue = plan_queue
         self.raft = raft
+        self.metrics = metrics if metrics is not None else NULL_TELEMETRY
         self.logger = logger or logging.getLogger("nomad_tpu.plan_apply")
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -69,7 +72,8 @@ class PlanApplier:
             snap = self.raft.fsm.state.snapshot()
 
             try:
-                result = self.evaluate_plan(snap, plan)
+                with self.metrics.measure("plan.evaluate"):
+                    result = self.evaluate_plan(snap, plan)
             except Exception as exc:  # pragma: no cover — defensive
                 self.logger.exception("plan evaluation failed")
                 future.respond(None, exc)
@@ -77,7 +81,8 @@ class PlanApplier:
 
             if result.node_update or result.node_allocation:
                 try:
-                    index = self.apply_plan(plan, result, snap)
+                    with self.metrics.measure("plan.apply"):
+                        index = self.apply_plan(plan, result, snap)
                     result.alloc_index = index
                     if result.refresh_index:
                         # Partial commit: ensure the scheduler sees at least

@@ -14,6 +14,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from ..structs import structs as s
+from ..utils.telemetry import Telemetry
 from .blocked_evals import BlockedEvals
 from .core_sched import CoreScheduler
 from .eval_broker import EvalBroker
@@ -64,6 +65,10 @@ class Server:
                  vault_api=None):
         self.config = config or ServerConfig()
         self.logger = logger or logging.getLogger("nomad_tpu.server")
+        # Telemetry (go-metrics role): in-memory sink surfaced via
+        # agent-info + /v1/metrics; hot paths measure through it
+        # (server.go:292-305 periodic emitters + MeasureSince call sites).
+        self.metrics = Telemetry()
         # Vault client (nomad/vault.go:234); vault_api injects the fake
         # in tests (vault_testing.go role).
         self.vault = ServerVaultClient(self.config.vault or VaultConfig(),
@@ -141,7 +146,8 @@ class Server:
             if isinstance(self.raft, MultiRaft):
                 self.rpc.raft_handler = self.raft.handle_message
 
-        self.plan_applier = PlanApplier(self.plan_queue, self.raft, self.logger)
+        self.plan_applier = PlanApplier(self.plan_queue, self.raft, self.logger,
+                                        metrics=self.metrics)
         self.heartbeat = HeartbeatTimers(
             on_expire=self._heartbeat_expired,
             min_ttl=self.config.min_heartbeat_ttl,
@@ -168,19 +174,24 @@ class Server:
                 t = threading.Thread(target=self._join_loop, daemon=True,
                                      name="serf-join")
                 t.start()
+        t = threading.Thread(target=self._emit_metrics_loop, daemon=True,
+                             name="metrics-emitter")
+        t.start()
         for i in range(self.config.num_schedulers):
             if self.config.use_tpu_batch_worker:
                 worker: Worker = BatchWorker(
                     self.eval_broker, self.plan_queue, self.raft,
                     blocked_evals=self.blocked_evals, logger=self.logger,
                     time_table=self.time_table,
+                    metrics=self.metrics,
                     max_batch=self.config.batch_size)
             else:
                 worker = Worker(
                     self.eval_broker, self.plan_queue, self.raft,
                     schedulers=self.config.enabled_schedulers,
                     blocked_evals=self.blocked_evals, logger=self.logger,
-                    time_table=self.time_table)
+                    time_table=self.time_table,
+                    metrics=self.metrics)
             self.workers.append(worker)
         self.raft.notify_leadership(self._leadership_changed)
         for worker in self.workers:
@@ -434,6 +445,34 @@ class Server:
             t = threading.Thread(target=target, daemon=True)
             t.start()
             self._reaper_threads.append(t)
+
+    def _emit_metrics_loop(self, interval: float = 1.0) -> None:
+        """Periodic gauge emission (server.go:292-305 EmitStats of the
+        broker, plan queue, blocked evals, and heartbeat timers; metric
+        names per the reference telemetry doc)."""
+        while not self._shutdown.is_set():
+            try:
+                b = self.eval_broker.stats()
+                self.metrics.set_gauge("broker.total_ready",
+                                       b.get("total_ready", 0))
+                self.metrics.set_gauge("broker.total_unacked",
+                                       b.get("total_unacked", 0))
+                self.metrics.set_gauge("broker.total_waiting",
+                                       b.get("total_waiting", 0))
+                bl = self.blocked_evals.stats()
+                self.metrics.set_gauge("blocked_evals.total_blocked",
+                                       bl.get("total_blocked", 0))
+                self.metrics.set_gauge("blocked_evals.total_escaped",
+                                       bl.get("total_escaped", 0))
+                self.metrics.set_gauge("plan.queue_depth",
+                                       self.plan_queue.depth())
+                self.metrics.set_gauge("heartbeat.active",
+                                       self.heartbeat.active())
+                self.metrics.set_gauge("raft.applied_index",
+                                       self.raft.applied_index())
+            except Exception:  # never kill the emitter
+                self.logger.exception("metrics emit failed")
+            self._shutdown.wait(interval)
 
     def _create_core_eval(self, core_job: str) -> None:
         ev = s.Evaluation(
@@ -1039,7 +1078,7 @@ class Server:
             self._forward("System.ReconcileJobSummaries", {})
 
     def stats(self) -> Dict:
-        return {
+        out = {
             "leader": self._leader,
             "applied_index": self.raft.applied_index(),
             "broker": self.eval_broker.stats(),
@@ -1047,3 +1086,11 @@ class Server:
             "plan_queue_depth": self.plan_queue.depth(),
             "heartbeat_active": self.heartbeat.active(),
         }
+        sink = self.metrics.sink
+        if hasattr(sink, "latest"):
+            latest = sink.latest()
+            out["metrics_gauges"] = latest["Gauges"]
+            out["metrics_samples"] = {
+                k: f"count={v['count']} mean={v['mean']}ms"
+                for k, v in latest["Samples"].items()}
+        return out

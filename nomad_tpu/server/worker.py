@@ -18,6 +18,7 @@ from typing import List, Optional, Tuple
 
 from ..scheduler import new_scheduler
 from ..structs import structs as s
+from ..utils.telemetry import NULL_TELEMETRY
 from .eval_broker import EvalBroker, EvalBrokerError
 from .fsm import MessageType
 from .plan_queue import PlanQueue
@@ -93,10 +94,12 @@ class Worker:
         blocked_evals=None,
         logger: Optional[logging.Logger] = None,
         time_table=None,
+        metrics=None,
     ):
         self.broker = broker
         self.plan_queue = plan_queue
         self.raft = raft
+        self.metrics = metrics if metrics is not None else NULL_TELEMETRY
         self.blocked_evals = blocked_evals
         self.time_table = time_table
         self.schedulers = schedulers or [
@@ -155,8 +158,10 @@ class Worker:
     def process_eval(self, ev: s.Evaluation, token: str) -> None:
         """Dequeue→schedule→ack cycle (worker.go:106-227)."""
         try:
-            self.wait_for_index(ev.modify_index, RAFT_SYNC_LIMIT)
-            self.invoke_scheduler(ev, token)
+            with self.metrics.measure("worker.wait_for_index"):
+                self.wait_for_index(ev.modify_index, RAFT_SYNC_LIMIT)
+            with self.metrics.measure(f"worker.invoke_scheduler.{ev.type}"):
+                self.invoke_scheduler(ev, token)
             self.broker.ack(ev.id, token)
         except Exception:
             self.logger.exception("eval %s failed; nacking", ev.id)
@@ -214,7 +219,8 @@ class BatchWorker(Worker):
                 time.sleep(0.05)
                 continue
             if batch:
-                self.process_batch(batch)
+                with self.metrics.measure("worker.invoke_scheduler.batch"):
+                    self.process_batch(batch)
             # Always also poll system/core (zero timeout) so a sustained
             # service/batch stream cannot starve them.
             try:

@@ -1,0 +1,170 @@
+"""Telemetry: gauges / counters / timing samples with a pluggable sink
+(reference: armon/go-metrics as used throughout the server —
+`metrics.MeasureSince` around every hot path, periodic gauge emitters at
+nomad/server.go:292-305; the published-metric inventory lives in
+website/source/docs/agent/telemetry.html.md)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class MetricsSink:
+    """Sink interface (go-metrics MetricSink): statsite/statsd/datadog in
+    the reference; in-memory + blackhole here, externals pluggable."""
+
+    def set_gauge(self, key: str, value: float) -> None:
+        raise NotImplementedError
+
+    def incr_counter(self, key: str, value: float = 1.0) -> None:
+        raise NotImplementedError
+
+    def add_sample(self, key: str, value: float) -> None:
+        raise NotImplementedError
+
+
+class BlackholeSink(MetricsSink):
+    def set_gauge(self, key, value):
+        pass
+
+    def incr_counter(self, key, value=1.0):
+        pass
+
+    def add_sample(self, key, value):
+        pass
+
+
+class _Aggregate:
+    __slots__ = ("count", "sum", "min", "max")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+    def add(self, v: float) -> None:
+        self.count += 1
+        self.sum += v
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+
+    def summary(self) -> Dict:
+        mean = self.sum / self.count if self.count else 0.0
+        return {"count": self.count, "sum": round(self.sum, 6),
+                "min": round(self.min, 6), "max": round(self.max, 6),
+                "mean": round(mean, 6)}
+
+
+class InmemSink(MetricsSink):
+    """Interval-ringed in-memory aggregation (go-metrics InmemSink), the
+    default backing for agent-info / /v1/metrics."""
+
+    def __init__(self, interval: float = 10.0, retain: int = 6):
+        self.interval = interval
+        self.retain = retain
+        self._l = threading.Lock()
+        self._intervals: List[Dict] = []
+        self._roll(time.time())
+
+    def _roll(self, now: float) -> Dict:
+        cur = {"start": now, "gauges": {}, "counters": {}, "samples": {}}
+        self._intervals.append(cur)
+        del self._intervals[:-self.retain]
+        return cur
+
+    def _current(self) -> Dict:
+        now = time.time()
+        cur = self._intervals[-1]
+        if now - cur["start"] >= self.interval:
+            cur = self._roll(now)
+        return cur
+
+    def set_gauge(self, key, value):
+        with self._l:
+            self._current()["gauges"][key] = value
+
+    def incr_counter(self, key, value=1.0):
+        with self._l:
+            agg = self._current()["counters"].setdefault(key, _Aggregate())
+            agg.add(value)
+
+    def add_sample(self, key, value):
+        with self._l:
+            agg = self._current()["samples"].setdefault(key, _Aggregate())
+            agg.add(value)
+
+    def data(self) -> List[Dict]:
+        """Recent intervals, aggregates summarized (InmemSink.Data)."""
+        with self._l:
+            out = []
+            for iv in self._intervals:
+                out.append({
+                    "Start": iv["start"],
+                    "Gauges": dict(iv["gauges"]),
+                    "Counters": {k: v.summary()
+                                 for k, v in iv["counters"].items()},
+                    "Samples": {k: v.summary()
+                                for k, v in iv["samples"].items()},
+                })
+            return out
+
+    def latest(self) -> Dict:
+        """Summary of only the newest interval (stats()'s hot call —
+        avoids aggregating every retained interval under the lock)."""
+        with self._l:
+            iv = self._intervals[-1]
+            return {
+                "Start": iv["start"],
+                "Gauges": dict(iv["gauges"]),
+                "Counters": {k: v.summary() for k, v in iv["counters"].items()},
+                "Samples": {k: v.summary() for k, v in iv["samples"].items()},
+            }
+
+
+class Telemetry:
+    """The measuring front end handed to subsystems
+    (go-metrics Metrics object)."""
+
+    def __init__(self, sink: Optional[MetricsSink] = None,
+                 prefix: str = "nomad"):
+        self.sink = sink if sink is not None else InmemSink()
+        self.prefix = prefix
+
+    def _key(self, key: str) -> str:
+        return f"{self.prefix}.{key}" if self.prefix else key
+
+    def set_gauge(self, key: str, value: float) -> None:
+        self.sink.set_gauge(self._key(key), value)
+
+    def incr_counter(self, key: str, value: float = 1.0) -> None:
+        self.sink.incr_counter(self._key(key), value)
+
+    def add_sample(self, key: str, value: float) -> None:
+        self.sink.add_sample(self._key(key), value)
+
+    def measure_since(self, key: str, start: float) -> None:
+        """Record elapsed milliseconds (metrics.MeasureSince)."""
+        self.sink.add_sample(self._key(key),
+                             (time.monotonic() - start) * 1000.0)
+
+    class _Timer:
+        def __init__(self, t: "Telemetry", key: str):
+            self.t = t
+            self.key = key
+
+        def __enter__(self):
+            self.start = time.monotonic()
+            return self
+
+        def __exit__(self, *exc):
+            self.t.measure_since(self.key, self.start)
+            return False
+
+    def measure(self, key: str) -> "Telemetry._Timer":
+        return Telemetry._Timer(self, key)
+
+
+NULL_TELEMETRY = Telemetry(sink=BlackholeSink())

@@ -1,0 +1,107 @@
+"""Telemetry tests (reference: armon/go-metrics usage; metric names per
+website/source/docs/agent/telemetry.html.md)."""
+import time
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.structs import structs as s
+from nomad_tpu.utils.telemetry import InmemSink, Telemetry
+
+
+def wait_until(pred, timeout=20.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestSink:
+    def test_gauge_counter_sample_aggregation(self):
+        sink = InmemSink(interval=60.0)
+        t = Telemetry(sink)
+        t.set_gauge("broker.total_ready", 3)
+        t.incr_counter("rpc.query")
+        t.incr_counter("rpc.query")
+        t.add_sample("plan.evaluate", 12.5)
+        t.add_sample("plan.evaluate", 7.5)
+        latest = sink.latest()
+        assert latest["Gauges"]["nomad.broker.total_ready"] == 3
+        assert latest["Counters"]["nomad.rpc.query"]["count"] == 2
+        samp = latest["Samples"]["nomad.plan.evaluate"]
+        assert samp["count"] == 2 and samp["mean"] == 10.0
+        assert samp["min"] == 7.5 and samp["max"] == 12.5
+
+    def test_measure_records_milliseconds(self):
+        sink = InmemSink(interval=60.0)
+        t = Telemetry(sink)
+        with t.measure("worker.invoke_scheduler.service"):
+            time.sleep(0.02)
+        samp = sink.latest()["Samples"]["nomad.worker.invoke_scheduler.service"]
+        assert samp["count"] == 1 and samp["min"] >= 15.0
+
+    def test_interval_ring_rolls(self):
+        sink = InmemSink(interval=0.05, retain=3)
+        for i in range(5):
+            sink.set_gauge("g", i)
+            time.sleep(0.06)
+        data = sink.data()
+        assert len(data) <= 3
+
+
+class TestServerEmitters:
+    def test_hot_path_metrics_emitted(self):
+        srv = Server(ServerConfig(num_schedulers=1))
+        srv.start()
+        try:
+            node = mock.node()
+            node.resources.networks = []
+            node.reserved.networks = []
+            srv.node_register(node)
+            job = mock.job()
+            job.task_groups[0].count = 2
+            for t in job.task_groups[0].tasks:
+                t.resources.networks = []
+            srv.job_register(job)
+            assert wait_until(lambda: len(
+                srv.state.allocs_by_job(None, job.id, True)) == 2)
+
+            def emitted():
+                latest = srv.metrics.sink.latest()
+                g, samp = latest["Gauges"], latest["Samples"]
+                return ("nomad.broker.total_ready" in g
+                        and "nomad.plan.queue_depth" in g
+                        and "nomad.heartbeat.active" in g
+                        and any(k.startswith("nomad.worker.invoke_scheduler")
+                                for k in samp)
+                        and "nomad.plan.evaluate" in samp
+                        and "nomad.plan.apply" in samp)
+
+            assert wait_until(emitted, 10.0), \
+                srv.metrics.sink.latest()
+            stats = srv.stats()
+            assert "metrics_gauges" in stats and "metrics_samples" in stats
+        finally:
+            srv.shutdown()
+
+    def test_metrics_http_endpoint(self, tmp_path):
+        from nomad_tpu.agent.agent import Agent
+        from nomad_tpu.agent.config import AgentConfig
+        import json
+        import urllib.request
+
+        cfg = AgentConfig.dev()
+        cfg.client.enabled = False
+        agent = Agent(cfg)
+        agent.start()
+        try:
+            assert wait_until(lambda: bool(
+                agent.server.metrics.sink.latest()["Gauges"]))
+            with urllib.request.urlopen(
+                    agent.http.address + "/v1/metrics") as resp:
+                data = json.loads(resp.read())
+            assert data and "Gauges" in data[-1]
+            assert "nomad.broker.total_ready" in data[-1]["Gauges"]
+        finally:
+            agent.shutdown()
