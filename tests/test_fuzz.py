@@ -1,0 +1,304 @@
+"""Differential fuzz harness: one randomized mutation script applied to
+TWO worlds — the CPU oracle and the TPU batch scheduler — with plan-apply
+invariants checked after every step (VERDICT r1 next-round #10; reference:
+scheduler/generic_sched_test.go's breadth, SURVEY.md §4 items 5-6).
+
+The script is generated up front with index-based references (job #3,
+node #1) so both engines run the *same* sequence even when their placement
+tie-breaks differ mid-run.
+
+Invariants:
+  I1  no node is ever overcommitted (AllocsFit on every node, every step)
+  I2  live desired-run allocs per job never exceed the job's count
+  I3  nothing keeps running on a down node once its node eval processed
+  I4  with ample capacity restored, blocked work drains: every live job
+      converges to exactly its desired count
+  I5  oracle and tpu-batch converge to the same per-job placed counts on
+      the same mutation script (node choice may differ — tie-breaks)
+"""
+import random
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.ops import batch_sched  # noqa: F401 — registers 'tpu-batch'
+from nomad_tpu.scheduler import Harness, new_scheduler, new_service_scheduler
+from nomad_tpu.structs import structs as s
+from nomad_tpu.structs.funcs import allocs_fit
+
+
+def make_script(seed: int, steps: int):
+    """A deterministic mutation script both engines replay."""
+    rng = random.Random(seed)
+    script = [("add_node", rng.choice([2000, 4000]),
+               rng.choice([4096, 8192])) for _ in range(3)]
+    for _ in range(steps):
+        op = rng.choice(("register_job", "register_job", "update_job",
+                         "add_node", "deregister_job", "drain_node",
+                         "node_down", "client_terminal"))
+        if op == "register_job":
+            script.append((op, rng.randrange(1, 5),
+                           rng.choice([200, 400, 600]),
+                           rng.random() < 0.3))
+        elif op == "update_job":
+            script.append((op, rng.randrange(1 << 16), rng.randrange(1, 6)))
+        elif op == "add_node":
+            script.append((op, rng.choice([2000, 4000]),
+                           rng.choice([4096, 8192])))
+        elif op in ("deregister_job", "drain_node", "node_down",
+                    "client_terminal"):
+            script.append((op, rng.randrange(1 << 16)))
+    return script
+
+
+class FuzzWorld:
+    """One scheduler kind replaying the shared mutation script."""
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self.h = Harness()
+        self.jobs = {}            # id -> job (live)
+        self.job_order = []       # creation-ordered live job ids
+        self.stopped_jobs = []    # ids of deregistered jobs
+        self.node_order = []      # creation-ordered node ids
+        self.nodes = {}
+        self.step_no = 0
+
+    # -- plumbing ------------------------------------------------------
+
+    def _eval(self, job, trigger=s.EVAL_TRIGGER_JOB_REGISTER):
+        return s.Evaluation(
+            id=s.generate_uuid(), priority=job.priority, type=job.type,
+            triggered_by=trigger, job_id=job.id,
+            status=s.EVAL_STATUS_PENDING)
+
+    def _process(self, ev):
+        self.h.state.upsert_evals(self.h.next_index(), [ev])
+        if self.kind == "tpu-batch":
+            sched = new_scheduler("tpu-batch", self.h.logger,
+                                  self.h.snapshot(), self.h)
+            sched.process(ev)
+        else:
+            self.h.process(new_service_scheduler, ev)
+
+    def _node_evals(self, node_id):
+        """One eval per job with allocs on the node
+        (node_endpoint.go:803 createNodeEvals)."""
+        job_ids = {a.job_id
+                   for a in self.h.state.allocs_by_node(None, node_id)}
+        for jid in sorted(job_ids):
+            job = self.h.state.job_by_id(None, jid)
+            if job is not None:
+                self._process(self._eval(job, s.EVAL_TRIGGER_NODE_UPDATE))
+
+    # -- script application --------------------------------------------
+
+    def apply(self, op):
+        self.step_no += 1
+        kind = op[0]
+        if kind == "add_node":
+            self.add_node(cpu=op[1], mem=op[2])
+        elif kind == "register_job":
+            self.register_job(count=op[1], cpu=op[2], constrained=op[3])
+        elif kind == "update_job":
+            if self.job_order:
+                self.update_job_count(self.job_order[op[1] % len(self.job_order)],
+                                      op[2])
+        elif kind == "deregister_job":
+            if self.job_order:
+                self.deregister_job(self.job_order[op[1] % len(self.job_order)])
+        elif kind == "drain_node":
+            ready = [n for n in self.node_order
+                     if self.nodes[n].status == s.NODE_STATUS_READY
+                     and not self.nodes[n].drain]
+            if len(ready) > 1:
+                self.drain_node(ready[op[1] % len(ready)])
+        elif kind == "node_down":
+            ready = [n for n in self.node_order
+                     if self.nodes[n].status == s.NODE_STATUS_READY
+                     and not self.nodes[n].drain]
+            if len(ready) > 1:
+                self.node_down(ready[op[1] % len(ready)])
+        elif kind == "client_terminal":
+            # Deterministic logical pick: job by index, its first live
+            # alloc by name order.  Absent in one world → skipped there.
+            if self.job_order:
+                jid = self.job_order[op[1] % len(self.job_order)]
+                self.client_terminal(jid, op[1])
+        self.check_invariants()
+
+    # -- mutations -----------------------------------------------------
+
+    def add_node(self, cpu=4000, mem=8192):
+        n = mock.node()
+        n.resources.networks = []
+        n.reserved.networks = []
+        n.resources.cpu = cpu
+        n.resources.memory_mb = mem
+        n.compute_class()
+        self.h.state.upsert_node(self.h.next_index(), n)
+        self.nodes[n.id] = n
+        self.node_order.append(n.id)
+        return n
+
+    def register_job(self, count, cpu, constrained):
+        job = mock.job()
+        job.id = job.name = f"job-{self.step_no}"
+        tg = job.task_groups[0]
+        tg.count = count
+        for t in tg.tasks:
+            t.resources.networks = []
+            t.resources.cpu = cpu
+            t.resources.memory_mb = 256
+        if constrained:
+            tg.constraints = list(tg.constraints) + [s.Constraint(
+                "${attr.kernel.name}", "linux", "=")]
+        self.h.state.upsert_job(self.h.next_index(), job)
+        self.jobs[job.id] = job
+        self.job_order.append(job.id)
+        self._process(self._eval(job))
+
+    def update_job_count(self, jid, new_count):
+        job = self.jobs[jid].copy()
+        job.task_groups = [g.copy() for g in job.task_groups]
+        job.task_groups[0].count = new_count
+        self.h.state.upsert_job(self.h.next_index(), job)
+        self.jobs[jid] = job
+        self._process(self._eval(job, s.EVAL_TRIGGER_JOB_REGISTER))
+
+    def deregister_job(self, jid):
+        job = self.jobs.pop(jid)
+        self.job_order.remove(jid)
+        self.stopped_jobs.append(jid)
+        stopped = job.copy()
+        stopped.stop = True
+        self.h.state.upsert_job(self.h.next_index(), stopped)
+        self._process(self._eval(stopped, s.EVAL_TRIGGER_JOB_DEREGISTER))
+
+    def drain_node(self, nid):
+        self.h.state.update_node_drain(self.h.next_index(), nid, True)
+        self.nodes[nid] = self.h.state.node_by_id(None, nid)
+        self._node_evals(nid)
+
+    def node_down(self, nid):
+        self.h.state.update_node_status(self.h.next_index(), nid,
+                                        s.NODE_STATUS_DOWN)
+        self.nodes[nid] = self.h.state.node_by_id(None, nid)
+        self._node_evals(nid)
+
+    def client_terminal(self, jid, salt):
+        allocs = sorted(self.live_allocs(jid), key=lambda a: a.name)
+        if not allocs:
+            return
+        a = allocs[salt % len(allocs)].copy()
+        a.client_status = (s.ALLOC_CLIENT_STATUS_COMPLETE if salt % 2 == 0
+                           else s.ALLOC_CLIENT_STATUS_FAILED)
+        self.h.state.update_allocs_from_client(self.h.next_index(), [a])
+        job = self.h.state.job_by_id(None, jid)
+        if job is not None and not job.stopped():
+            self._process(self._eval(job, s.EVAL_TRIGGER_NODE_UPDATE))
+
+    # -- invariants ----------------------------------------------------
+
+    def live_allocs(self, job_id=None):
+        out = []
+        for a in self.h.state.allocs(None):
+            if a.terminal_status() or a.client_terminal_status():
+                continue
+            if a.desired_status != s.ALLOC_DESIRED_STATUS_RUN:
+                continue
+            if job_id is not None and a.job_id != job_id:
+                continue
+            out.append(a)
+        return out
+
+    def check_invariants(self):
+        ctx = f"{self.kind} step {self.step_no}"
+        # I1: no node overcommitted
+        by_node = {}
+        for a in self.live_allocs():
+            by_node.setdefault(a.node_id, []).append(a)
+        for nid, allocs in by_node.items():
+            node = self.h.state.node_by_id(None, nid)
+            fit, dim, _ = allocs_fit(node, allocs)
+            assert fit, f"{ctx}: node {nid} overcommitted: {dim}"
+        # I2: placed never exceeds desired
+        for jid, job in self.jobs.items():
+            placed = len(self.live_allocs(jid))
+            want = job.task_groups[0].count
+            assert placed <= want, \
+                f"{ctx}: job {jid} placed {placed} > count {want}"
+        # I3: nothing lives on a down OR drained node after its node
+        # evals processed (live_allocs already excludes LOST/stop allocs)
+        for nid, node in self.nodes.items():
+            if node.status == s.NODE_STATUS_DOWN or node.drain:
+                state = "down" if node.status == s.NODE_STATUS_DOWN \
+                    else "drained"
+                stragglers = [a for a in self.live_allocs()
+                              if a.node_id == nid]
+                assert not stragglers, \
+                    f"{ctx}: allocs still live on {state} node {nid}"
+        # I2b: a deregistered job keeps no live allocs
+        for jid in self.stopped_jobs:
+            assert not self.live_allocs(jid), \
+                f"{ctx}: deregistered job {jid} still has live allocs"
+
+    # -- convergence ---------------------------------------------------
+
+    def drain_blocked(self):
+        """I4: add ample capacity and reprocess every live job until each
+        reaches its desired count (the blocked-evals-drain guarantee)."""
+        for _ in range(3):
+            self.add_node(cpu=16000, mem=32768)
+        for _ in range(4):
+            for jid in list(self.job_order):
+                self._process(self._eval(self.jobs[jid]))
+            if all(len(self.live_allocs(j)) ==
+                   self.jobs[j].task_groups[0].count
+                   for j in self.jobs):
+                break
+        self.check_invariants()
+
+    def placed_counts(self):
+        return {j: len(self.live_allocs(j)) for j in sorted(self.jobs)}
+
+
+SEEDS = [7, 23, 91, 1337]
+
+
+class TestDifferentialFuzz:
+    @pytest.mark.parametrize("seed", SEEDS)
+    def test_fuzz_invariants_and_convergence(self, seed):
+        script = make_script(seed, steps=60)
+        worlds = {}
+        for kind in ("oracle", "tpu-batch"):
+            w = FuzzWorld(kind)
+            for op in script:
+                w.apply(op)
+            # Snapshot BEFORE ample capacity is restored: this is the real
+            # differential — binpack decisions under contention must yield
+            # the same per-job counts (tie-broken node choice may differ,
+            # but equal scores imply symmetric capacity outcomes).
+            w.pre_drain_counts = w.placed_counts()
+            w.drain_blocked()
+            # I4: every surviving job fully placed after capacity returns
+            for jid, job in w.jobs.items():
+                placed = len(w.live_allocs(jid))
+                want = job.task_groups[0].count
+                assert placed == want, (
+                    f"{kind} seed {seed}: job {jid} stuck at "
+                    f"{placed}/{want} after capacity returned")
+            worlds[kind] = w
+        # I5: under contention, tie-broken node choice changes packing, so
+        # totals may differ slightly (greedy bin-packing fragmentation) —
+        # but a real regression would leave one engine far behind.  Bound
+        # the gap at 15% / 2 allocs (cf. BASELINE's 0.5% score budget,
+        # which test_binpack_score_vs_oracle enforces on uniform configs);
+        # after capacity relief, per-job counts must be identical.
+        a = sum(worlds["oracle"].pre_drain_counts.values())
+        b = sum(worlds["tpu-batch"].pre_drain_counts.values())
+        assert abs(a - b) <= max(2, 0.15 * max(a, b)), (
+            worlds["oracle"].pre_drain_counts,
+            worlds["tpu-batch"].pre_drain_counts)
+        assert worlds["oracle"].placed_counts() == \
+            worlds["tpu-batch"].placed_counts()

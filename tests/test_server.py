@@ -174,7 +174,7 @@ class TestEndToEnd:
         def child_exists():
             return any(j.parent_id == job.id for j in server.state.jobs(None))
 
-        assert wait_until(child_exists, timeout=10.0)
+        assert wait_until(child_exists, timeout=30.0)
         launch = server.state.periodic_launch_by_id(None, job.id)
         assert launch is not None
 
