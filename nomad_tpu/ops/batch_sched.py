@@ -257,111 +257,184 @@ class TPUBatchScheduler:
         encode.finalize_codebooks(ct, literals)
         st = encode.encode_specs(spec_list, ct, all_nodes)
 
-        # Existing per-(job, node) alloc counts for anti-affinity/distinct.
-        # Rows padded to the bucketed spec axis so the kernel shape is stable
-        # across batches (job_index < u_real ≤ u_pad).
-        job_counts = np.zeros((st.u_pad, ct.n_pad), dtype=np.int32)
+        # Existing per-(job, node) alloc counts for anti-affinity/distinct,
+        # uploaded SPARSE and scattered dense on device: the dense U×N
+        # matrix is mostly zeros and the tunneled host↔device link is the
+        # bottleneck at scale.
+        from .kernels import compact_placements, scatter_job_counts
+
         node_index = {nid: i for i, nid in enumerate(ct.node_ids)}
+        jc_entries: Dict[Tuple[int, int], int] = {}
         for j, job_id in enumerate(st.job_ids):
             for alloc in self.state.allocs_by_job(None, job_id, False):
                 if alloc.terminal_status():
                     continue
                 idx = node_index.get(alloc.node_id)
                 if idx is not None:
-                    job_counts[j, idx] += 1
+                    jc_entries[(j, idx)] = jc_entries.get((j, idx), 0) + 1
+        k_jc = encode.pow2_bucket(max(1, len(jc_entries)), minimum=8)
+        jc_rows = np.full(k_jc, -1, dtype=np.int32)
+        jc_cols = np.zeros(k_jc, dtype=np.int32)
+        jc_vals = np.zeros(k_jc, dtype=np.int32)
+        for i, ((j, n), v) in enumerate(jc_entries.items()):
+            jc_rows[i], jc_cols[i], jc_vals[i] = j, n, v
 
         encode_seconds = time.monotonic() - t0
         t1 = time.monotonic()
 
+        # ONE upload for every host array — individual asarray calls each
+        # pay a round trip on a tunneled device.
+        host = {
+            "attr": ct.attr_values, "elig": ct.eligible, "dc": ct.dc_code,
+            "c_attr": st.constraint_attr, "c_op": st.constraint_op,
+            "c_rhs": st.constraint_rhs, "dc_mask": st.dc_mask,
+            "precomp": st.precomp,
+            "used": ct.used.astype(np.int32),
+            "cap": ct.capacity.astype(np.int32),
+            "denom": ct.score_denom,
+            "ask": st.ask.astype(np.int32), "count": st.count,
+            "penalty": st.penalty, "dh": st.distinct_hosts,
+            "ji": st.job_index,
+            "jc_rows": jc_rows, "jc_cols": jc_cols, "jc_vals": jc_vals,
+        }
+        if with_networks:
+            host.update(net_active=st.net_active, net_mbits=st.net_mbits,
+                        dyn_need=st.dyn_need, resv_words=st.resv_words,
+                        bw_cap=ct.bw_cap, bw_used=ct.bw_used,
+                        dyn_free=ct.dyn_free, port_words=ct.port_words)
+        with_dp = any(sp.dp_target is not None for sp in spec_list)
+        if with_dp:
+            host.update(dp_col=st.dp_col, dp_active=st.dp_active,
+                        dp_used=st.dp_used)
+        d = jax.device_put(host)
+
+        job_counts = scatter_job_counts(
+            d["jc_rows"], d["jc_cols"], d["jc_vals"],
+            u_pad=st.u_pad, n_pad=ct.n_pad)
         feas = feasibility_matrix(
-            jax.numpy.asarray(ct.attr_values),
-            jax.numpy.asarray(ct.eligible),
-            jax.numpy.asarray(ct.dc_code),
-            jax.numpy.asarray(st.constraint_attr),
-            jax.numpy.asarray(st.constraint_op),
-            jax.numpy.asarray(st.constraint_rhs),
-            jax.numpy.asarray(st.dc_mask),
-            jax.numpy.asarray(st.precomp),
-        )
-        jnp = jax.numpy
+            d["attr"], d["elig"], d["dc"], d["c_attr"], d["c_op"],
+            d["c_rhs"], d["dc_mask"], d["precomp"])
         net = dp = None
         if with_networks:
             from .kernels import NetTensors
 
             net = NetTensors(
-                active=jnp.asarray(st.net_active),
-                mbits=jnp.asarray(st.net_mbits),
-                dyn_need=jnp.asarray(st.dyn_need),
-                resv_words=jnp.asarray(st.resv_words),
-                bw_cap=jnp.asarray(ct.bw_cap),
-                bw_used=jnp.asarray(ct.bw_used),
-                dyn_free=jnp.asarray(ct.dyn_free),
-                port_words=jnp.asarray(ct.port_words),
-            )
-        if any(sp.dp_target is not None for sp in spec_list):
+                active=d["net_active"], mbits=d["net_mbits"],
+                dyn_need=d["dyn_need"], resv_words=d["resv_words"],
+                bw_cap=d["bw_cap"], bw_used=d["bw_used"],
+                dyn_free=d["dyn_free"], port_words=d["port_words"])
+        if with_dp:
             from .kernels import DPTensors
 
-            dp = DPTensors(
-                col=jnp.asarray(st.dp_col),
-                active=jnp.asarray(st.dp_active),
-                used0=jnp.asarray(st.dp_used),
-                attr_values=jnp.asarray(ct.attr_values),
-            )
+            dp = DPTensors(col=d["dp_col"], active=d["dp_active"],
+                           used0=d["dp_used"], attr_values=d["attr"])
+        # Commit-score side-outputs cost two [U, N] carry buffers; beyond
+        # ~16M cells the HBM + compile cost outweighs score forensics
+        # (counts stay exact either way).
+        with_scores = st.u_pad * ct.n_pad <= 16_000_000
         result = placement_rounds(
-            feas,
-            jax.numpy.asarray(ct.used.astype(np.int32)),
-            jax.numpy.asarray(ct.capacity.astype(np.int32)),
-            jax.numpy.asarray(ct.score_denom),
-            jax.numpy.asarray(st.ask.astype(np.int32)),
-            jax.numpy.asarray(st.count),
-            jax.numpy.asarray(st.penalty),
-            jax.numpy.asarray(st.distinct_hosts),
-            jax.numpy.asarray(st.job_index),
-            jax.numpy.asarray(job_counts),
+            feas, d["used"], d["cap"], d["denom"], d["ask"], d["count"],
+            d["penalty"], d["dh"], d["ji"], job_counts,
             jax.random.PRNGKey(int.from_bytes(s.generate_uuid()[:8].encode(), "big") & 0x7FFFFFFF),
             net=net,
             dp=dp,
+            with_scores=with_scores,
         )
-        placements = np.asarray(jax.device_get(result.placements))
-        unplaced_arr = np.asarray(jax.device_get(result.unplaced))
-        feas_np = np.asarray(jax.device_get(feas))
-        used_after = np.asarray(jax.device_get(result.used_after))
-        commit_scores = np.asarray(jax.device_get(result.commit_scores))
-        commit_coll = np.asarray(jax.device_get(result.commit_collisions))
-        rounds = int(jax.device_get(result.rounds))
+        # Compact on device; fetch COO + summaries only (the dense U×N
+        # matrices never cross the link).
+        total_asks = int(sum(sp.count for sp in spec_list))
+        max_nnz = encode.pow2_bucket(
+            max(8, min(total_asks, st.u_pad * ct.n_pad)), minimum=8)
+        coo = compact_placements(feas, result.placements,
+                                 result.commit_scores,
+                                 result.commit_collisions, max_nnz=max_nnz)
+        # ONE fetch for everything: each device_get is a round trip over
+        # the (possibly tunneled) host↔device link.
+        (coo_rows, coo_cols, coo_counts, coo_scores, coo_coll, feas_count,
+         unplaced_arr, used_after, rounds_arr) = jax.device_get(
+            (*coo, result.unplaced, result.used_after, result.rounds))
+        rounds = int(rounds_arr)
+
+        # Feasibility rows are fetched lazily, only for failed specs that
+        # actually filtered nodes (forensics needs the row then; the
+        # common capacity-exhaustion failure derives it from placements).
+        failed_u = np.nonzero(unplaced_arr[:st.u_real] > 0)[0]
+        feas_rows: Dict[int, np.ndarray] = {}
+        need_rows = [int(u) for u in failed_u
+                     if feas_count[u] < ct.n_real]
+        if need_rows:
+            fetched = np.asarray(jax.device_get(
+                feas[jax.numpy.asarray(np.array(need_rows, dtype=np.int32))]))
+            feas_rows = {u: fetched[i] for i, u in enumerate(need_rows)}
         device_seconds = time.monotonic() - t1
+
+        # COO → per-spec (node, count, score) lists, grouped via one
+        # argsort instead of a python loop over every entry.
+        per_u_entries: Dict[int, List[Tuple[int, int, float, int]]] = {}
+        valid = coo_rows >= 0
+        vr, vc = coo_rows[valid], coo_cols[valid]
+        vcnt, vsc, vco = coo_counts[valid], coo_scores[valid], coo_coll[valid]
+        if len(vr):
+            order = np.argsort(vr, kind="stable")
+            vr, vc = vr[order], vc[order]
+            vcnt, vsc, vco = vcnt[order], vsc[order], vco[order]
+            uniq, starts = np.unique(vr, return_index=True)
+            bounds = np.append(starts, len(vr))
+            for k, u_ in enumerate(uniq):
+                lo, hi = bounds[k], bounds[k + 1]
+                per_u_entries[int(u_)] = list(zip(
+                    vc[lo:hi].tolist(), vcnt[lo:hi].tolist(),
+                    vsc[lo:hi].tolist(), vco[lo:hi].tolist()))
+
+        # Vectorized node facts shared by all specs' forensics
+        # (user_class filled lazily by the first spec that needs it).
+        node_facts = None
+        if len(failed_u):
+            # Explicit dtypes: np.array([]) would default to float64 on an
+            # empty cluster and break the boolean mask math.
+            node_facts = {
+                "ready": np.array([n.ready() for n in all_nodes],
+                                  dtype=bool),
+                "dc": np.array([n.datacenter for n in all_nodes],
+                               dtype=object),
+                "user_class": None,
+            }
 
         assignments: Dict[Tuple[str, str], List[Tuple[str, int]]] = {}
         unplaced: Dict[Tuple[str, str], int] = {}
         metrics: Dict[Tuple[str, str], s.AllocMetric] = {}
         for u, sp in enumerate(spec_list):
             key = (sp.job.id, sp.tg.name)
-            nz = np.nonzero(placements[u])[0]
-            assignments[key] = [(ct.node_ids[i], int(placements[u, i]))
-                                for i in nz if i < ct.n_real]
+            entries = per_u_entries.get(u, [])
+            assignments[key] = [(ct.node_ids[i], cnt)
+                                for i, cnt, _sc, _co in entries
+                                if i < ct.n_real]
             unplaced[key] = int(unplaced_arr[u])
 
             # AllocMetric parity from kernel side-outputs
             # (structs.go:4074-4172 contract; VERDICT r1 weak #7).
             m = s.AllocMetric()
             m.nodes_evaluated = ct.n_real
-            n_feasible = int(feas_np[u, :ct.n_real].sum())
-            m.nodes_filtered = ct.n_real - n_feasible
+            m.nodes_filtered = ct.n_real - int(feas_count[u])
             # Commit-time scores per placed node — the oracle's pure
             # binpack entry (rank.go:139) plus a separate anti-affinity
             # entry when the node had same-job collisions (rank.go:167).
-            for i in nz:
-                if i < ct.n_real:
-                    m.score_node(all_nodes[i], "binpack",
-                                 float(commit_scores[u, i]))
-                    coll = int(commit_coll[u, i])
-                    if coll > 0:
-                        m.score_node(all_nodes[i], "job-anti-affinity",
-                                     -float(sp.anti_affinity_penalty) * coll)
+            if with_scores:
+                for i, _cnt, sc, co in entries:
+                    if i < ct.n_real:
+                        m.score_node(all_nodes[i], "binpack", sc)
+                        if co > 0:
+                            m.score_node(
+                                all_nodes[i], "job-anti-affinity",
+                                -float(sp.anti_affinity_penalty) * co)
             if unplaced[key] > 0:
+                placed_row = np.zeros(ct.n_real, dtype=np.int32)
+                for i, cnt, _sc, _co in entries:
+                    if i < ct.n_real:
+                        placed_row[i] = cnt
                 self._fill_failure_metrics(
-                    m, sp, all_nodes, ct, feas_np[u], placements[u],
-                    used_after)
+                    m, sp, all_nodes, ct, feas_rows.get(u), placed_row,
+                    used_after, node_facts)
                 m.coalesced_failures = unplaced[key] - 1
             metrics[key] = m
 
@@ -373,16 +446,81 @@ class TPUBatchScheduler:
         return assignments, unplaced, metrics, kstats
 
     def _fill_failure_metrics(self, m, sp, nodes, ct, feas_row, placed_row,
-                              used_after) -> None:
+                              used_after, node_facts) -> None:
         """Per-class/per-constraint/per-dimension forensics for a failed
         placement, matching the oracle's filter_node/exhausted_node
         accounting: chain order job constraints → drivers → tg/task
         constraints (feasible.go), class-cache attribution ("computed
         class ineligible" after the first failure of a class,
         feasible.go:597), distinct checks before capacity (stack order),
-        and Resources.superset dimension names (rank.go).  Runs host-side
-        and only on the failure path — the same cost profile as the
-        oracle's own failure forensics."""
+        and Resources.superset dimension names (rank.go).
+
+        The common case — no filtered nodes, capacity exhaustion only —
+        is fully vectorized (one pass of numpy per failed spec); the
+        python checkers run only over the filtered-node subset.
+        ``feas_row`` may be None when the device reported zero filtered
+        nodes (the feasibility row was not fetched — every evaluated node
+        was feasible)."""
+        n_real = ct.n_real
+        feas_r = (feas_row[:n_real].astype(bool) if feas_row is not None
+                  else np.ones(n_real, dtype=bool))
+        placed_r = placed_row[:n_real]
+        evaluated = node_facts["ready"] & np.isin(
+            node_facts["dc"], list(sp.datacenters))
+        m.nodes_evaluated = int(evaluated.sum())
+        m.nodes_filtered = 0
+
+        # -- exhausted (feasible, evaluated, uncommitted): vectorized ----
+        exh_mask = evaluated & feas_r & (placed_r == 0)
+        if exh_mask.any():
+            cap_left = ct.capacity[:n_real] - used_after[:n_real]
+            over = sp.ask[None, :] > cap_left          # [n, 4]
+            dim_names = ("cpu exhausted", "memory exhausted",
+                         "disk exhausted", "iops exhausted")
+            any_over = over.any(axis=1)
+            first_dim = np.argmax(over, axis=1)
+            capacity_exh = exh_mask & any_over
+            n_cap_exh = int(capacity_exh.sum())
+            if n_cap_exh:
+                # Counters + per-dimension tallies in bulk (bincount), the
+                # per-class tally only when user classes exist.
+                m.nodes_exhausted += n_cap_exh
+                dims = np.bincount(first_dim[capacity_exh], minlength=4)
+                for di, cnt in enumerate(dims):
+                    if cnt:
+                        m.dimension_exhausted[dim_names[di]] = (
+                            m.dimension_exhausted.get(dim_names[di], 0)
+                            + int(cnt))
+                if node_facts.get("user_class") is None:
+                    node_facts["user_class"] = np.array(
+                        [n.node_class or "" for n in nodes], dtype=object)
+                classes = node_facts["user_class"][:n_real][capacity_exh]
+                uniq, counts = np.unique(classes, return_counts=True)
+                for cls, cnt in zip(uniq, counts):
+                    if cls:
+                        m.class_exhausted[cls] = (
+                            m.class_exhausted.get(cls, 0) + int(cnt))
+            # The rarer non-capacity blocks keep per-node attribution.
+            rest = np.nonzero(exh_mask & ~any_over)[0]
+            for i in rest:
+                node = nodes[i]
+                if sp.distinct_hosts or sp.dp_target is not None:
+                    # Distinct checks precede BinPack in the oracle chain:
+                    # blocked nodes are FILTERED, not exhausted
+                    # (feasible.go:272).
+                    m.filter_node(
+                        node,
+                        s.CONSTRAINT_DISTINCT_HOSTS if sp.distinct_hosts
+                        else s.CONSTRAINT_DISTINCT_PROPERTY)
+                elif sp.net_active:
+                    m.exhausted_node(node, self._net_exhaust_dim(sp, ct, i))
+                else:
+                    m.exhausted_node(node, "resources exhausted")
+
+        # -- filtered (evaluated, infeasible): python checkers on subset --
+        filt_idx = np.nonzero(evaluated & ~feas_r)[0]
+        if len(filt_idx) == 0:
+            return
         from ..scheduler.context import EvalContext
         from ..scheduler.feasible import ConstraintChecker, DriverChecker
         from .encode import _escapes_class
@@ -402,22 +540,8 @@ class TPUBatchScheduler:
         # are filtered as "computed class ineligible" (feasible.go:627).
         cacheable = all(not _escapes_class(c) for c in job_cons + tg_cons)
         ineligible_classes: set = set()
-
-        m.nodes_evaluated = 0
-        m.nodes_filtered = 0
-        dcs = set(sp.datacenters)
-        for i, node in enumerate(nodes):
-            # readyNodesInDCs pre-filters the iterator source: nodes out
-            # of DC or not ready are never "evaluated" (util.go:224).
-            if node.datacenter not in dcs or not node.ready():
-                continue
-            m.nodes_evaluated += 1
-            if feas_row[i]:
-                if placed_row[i] == 0:
-                    self._exhaust_reason(m, sp, node, i, ct, used_after)
-                continue
-            # Infeasible: attribute the first failing check in chain order
-            # (the checkers call m.filter_node themselves).
+        for i in filt_idx:
+            node = nodes[i]
             if cacheable and node.computed_class in ineligible_classes:
                 m.filter_node(node, "computed class ineligible")
                 continue
@@ -431,39 +555,17 @@ class TPUBatchScheduler:
             elif cacheable and node.computed_class:
                 ineligible_classes.add(node.computed_class)
 
-    def _exhaust_reason(self, m, sp, node, i, ct, used_after) -> None:
-        """Why a feasible node took no (further) alloc: capacity dimension
-        (structs.go:1024 superset order), distinct placement, or network
-        (rank.go:190-238 reasons)."""
-        cap_left = ct.capacity[i] - used_after[i]
-        for d, name in enumerate(("cpu exhausted", "memory exhausted",
-                                  "disk exhausted", "iops exhausted")):
-            if sp.ask[d] > cap_left[d]:
-                m.exhausted_node(node, name)
-                return
-        # Distinct checks run before BinPack in the oracle chain —
-        # distinct-blocked nodes are FILTERED, not exhausted
-        # (feasible.go:272).
-        if sp.distinct_hosts or sp.dp_target is not None:
-            m.filter_node(
-                node, s.CONSTRAINT_DISTINCT_HOSTS if sp.distinct_hosts
-                else s.CONSTRAINT_DISTINCT_PROPERTY)
-            return
-        if sp.net_active:
-            # Derive the oracle's network error strings from the encoded
-            # state (network.go:245 AssignNetwork reasons).
-            if ct.bw_cap is not None and ct.bw_cap[i] < 0:
-                m.exhausted_node(node, "network: no networks available")
-            elif ct.bw_cap is not None and sp.net_mbits > 0 and (
-                    ct.bw_used[i] + sp.net_mbits > ct.bw_cap[i]):
-                m.exhausted_node(node, "network: bandwidth exceeded")
-            elif sp.resv_ports:
-                m.exhausted_node(node, "network: reserved port collision")
-            else:
-                m.exhausted_node(node,
-                                 "network: dynamic port selection failed")
-            return
-        m.exhausted_node(node, "resources exhausted")
+    def _net_exhaust_dim(self, sp, ct, i) -> str:
+        """The oracle's network error strings (network.go:245) derived
+        from encoded state."""
+        if ct.bw_cap is not None and ct.bw_cap[i] < 0:
+            return "network: no networks available"
+        if ct.bw_cap is not None and sp.net_mbits > 0 and (
+                ct.bw_used[i] + sp.net_mbits > ct.bw_cap[i]):
+            return "network: bandwidth exceeded"
+        if sp.resv_ports:
+            return "network: reserved port collision"
+        return "network: dynamic port selection failed"
 
     # -- finalize ----------------------------------------------------------
 

@@ -418,7 +418,15 @@ def encode_specs(
     c_attr = np.zeros((u_pad, k_max), dtype=np.int32)
     c_op = np.zeros((u_pad, k_max), dtype=np.int32)   # OP_TRUE padding
     c_rhs = np.zeros((u_pad, k_max), dtype=np.int32)
-    precomp = np.ones((u_pad, ct.n_pad), dtype=bool)
+    # Lazily materialized: most batches have no host-precomputed rows, and
+    # a trivially-true [1,1] broadcast saves a U×N upload to the device.
+    precomp = None
+
+    def _precomp():
+        nonlocal precomp
+        if precomp is None:
+            precomp = np.ones((u_pad, ct.n_pad), dtype=bool)
+        return precomp
 
     job_ids: List[str] = []
     job_row: Dict[str, int] = {}
@@ -479,7 +487,7 @@ def encode_specs(
             target = "${attr.driver." + driver + "}"
             col = ct.attr_index.get(target)
             if col is None:
-                precomp[u, :ct.n_real] &= _driver_row(nodes, driver)
+                _precomp()[u, :ct.n_real] &= _driver_row(nodes, driver)
                 continue
             # truthy values per strconv.ParseBool; precompute truth set codes
             truthy = {
@@ -492,7 +500,7 @@ def encode_specs(
                 c_rhs[u, k] = next(iter(truthy))
                 k += 1
             else:
-                precomp[u, :ct.n_real] &= _driver_row(nodes, driver)
+                _precomp()[u, :ct.n_real] &= _driver_row(nodes, driver)
 
         for con in sp.constraints:
             if con.operand in (s.CONSTRAINT_DISTINCT_HOSTS,
@@ -510,7 +518,7 @@ def encode_specs(
             else:
                 # Host-evaluated per computed class (or per node if escaped):
                 # the same caching the reference does (feasible.go:597).
-                precomp[u, :ct.n_real] &= _constraint_row(
+                _precomp()[u, :ct.n_real] &= _constraint_row(
                     nodes, con, ct, class_cache, eval_ctx)
 
     st = SpecTensors(
@@ -526,7 +534,8 @@ def encode_specs(
         constraint_attr=c_attr,
         constraint_op=c_op,
         constraint_rhs=c_rhs,
-        precomp=precomp,
+        precomp=(precomp if precomp is not None
+                 else np.ones((1, 1), dtype=bool)),
         job_index=job_index,
         job_ids=list(job_row),
         net_active=net_active,

@@ -188,10 +188,14 @@ def placement_rounds(
     max_rounds: int = 256,
     net: "NetTensors" = None,
     dp: "DPTensors" = None,
+    with_scores: bool = True,
 ) -> PlacementResult:
     """The sequential heart of the batch scheduler (see
     ``_placement_rounds_impl``).  ``net``/``dp`` default to disabled
-    singleton shapes whose checks compile away."""
+    singleton shapes whose checks compile away.  ``with_scores=False``
+    drops the [U, N] commit-score/collision side-outputs (mega-batch
+    shapes: two extra carry buffers of that size cost real HBM and
+    compile time; counts in the result stay exact)."""
     u_pad, n_pad = feas.shape
     if net is None:
         net = _disabled_net(u_pad, n_pad)
@@ -199,10 +203,11 @@ def placement_rounds(
         dp = _disabled_dp(u_pad, n_pad)
     return _placement_rounds_impl(
         feas, used0, capacity, denom, ask, count, penalty, distinct_hosts,
-        job_index, job_counts0, rng_key, net, dp, max_rounds=max_rounds)
+        job_index, job_counts0, rng_key, net, dp, max_rounds=max_rounds,
+        with_scores=with_scores)
 
 
-@functools.partial(jax.jit, static_argnames=("max_rounds",))
+@functools.partial(jax.jit, static_argnames=("max_rounds", "with_scores"))
 def _placement_rounds_impl(
     feas: jnp.ndarray,
     used0: jnp.ndarray,
@@ -218,6 +223,7 @@ def _placement_rounds_impl(
     net: NetTensors,
     dp: DPTensors,
     max_rounds: int = 256,
+    with_scores: bool = True,
 ) -> PlacementResult:
     """The sequential heart of the batch scheduler.
 
@@ -313,10 +319,11 @@ def _placement_rounds_impl(
         dp_used = dp_used.at[u].set(dp_used[u] | dp_upd)
         # Commit-time AllocMetric side-outputs: pure binpack score and
         # the collision count behind any anti-affinity penalty.
-        commit_scores = commit_scores.at[u].set(jnp.where(
-            sel, base_score, commit_scores[u]))
-        commit_coll = commit_coll.at[u].set(jnp.where(
-            sel, collisions, commit_coll[u]))
+        if with_scores:
+            commit_scores = commit_scores.at[u].set(jnp.where(
+                sel, base_score, commit_scores[u]))
+            commit_coll = commit_coll.at[u].set(jnp.where(
+                sel, collisions, commit_coll[u]))
 
         return (used, job_counts, remaining_count, placements,
                 bw_used, port_words, dyn_free, dp_used,
@@ -348,8 +355,9 @@ def _placement_rounds_impl(
         return (progress > 0) & (jnp.sum(remaining_count) > 0) & (rounds < max_rounds)
 
     placements0 = jnp.zeros((u_pad, n_pad), dtype=jnp.int32)
-    scores0 = jnp.zeros((u_pad, n_pad), dtype=jnp.float32)
-    coll0 = jnp.zeros((u_pad, n_pad), dtype=jnp.int32)
+    score_shape = (u_pad, n_pad) if with_scores else (1, 1)
+    scores0 = jnp.zeros(score_shape, dtype=jnp.float32)
+    coll0 = jnp.zeros(score_shape, dtype=jnp.int32)
     state = (used0, job_counts0, count, placements0,
              net.bw_used, net.port_words, net.dyn_free, dp.used0, scores0,
              coll0,
@@ -366,6 +374,52 @@ def _placement_rounds_impl(
         commit_scores=commit_scores,
         commit_collisions=commit_coll,
     )
+
+
+@functools.partial(jax.jit, static_argnames=("max_nnz",))
+def compact_placements(
+    feas: jnp.ndarray,          # [U, N] bool
+    placements: jnp.ndarray,    # [U, N] int32
+    commit_scores: jnp.ndarray,  # [U, N] f32 (or [1,1] when disabled)
+    commit_coll: jnp.ndarray,    # [U, N] int32 (or [1,1])
+    max_nnz: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Device-side compaction of the placement matrix to COO — the
+    host↔device link (tunneled TPU) is the bottleneck at scale, so the
+    dense [U, N] outputs never leave the device:
+
+      rows/cols int32[max_nnz] (-1 padding), counts int32[max_nnz],
+      scores f32[max_nnz], feas_count int32[U]
+
+    max_nnz is bounded by the batch's total asks (static per bucket)."""
+    rows, cols = jnp.nonzero(placements, size=max_nnz, fill_value=-1)
+    valid = rows >= 0
+    r = jnp.clip(rows, 0, placements.shape[0] - 1)
+    c = jnp.clip(cols, 0, placements.shape[1] - 1)
+    counts = jnp.where(valid, placements[r, c], 0)
+    sr = jnp.clip(r, 0, commit_scores.shape[0] - 1)
+    sc = jnp.clip(c, 0, commit_scores.shape[1] - 1)
+    scores = jnp.where(valid, commit_scores[sr, sc], 0.0)
+    coll = jnp.where(valid, commit_coll[sr, sc], 0)
+    feas_count = jnp.sum(feas, axis=1).astype(jnp.int32)
+    return rows, cols, counts, scores, coll, feas_count
+
+
+@functools.partial(jax.jit, static_argnames=("u_pad", "n_pad"))
+def scatter_job_counts(
+    rows: jnp.ndarray,   # [K] int32, -1 padding
+    cols: jnp.ndarray,   # [K] int32
+    vals: jnp.ndarray,   # [K] int32
+    u_pad: int,
+    n_pad: int,
+) -> jnp.ndarray:
+    """Build the dense per-(job,node) count matrix on device from a sparse
+    host upload — the dense matrix is U×N and mostly zeros."""
+    valid = rows >= 0
+    r = jnp.clip(rows, 0, u_pad - 1)
+    c = jnp.clip(cols, 0, n_pad - 1)
+    out = jnp.zeros((u_pad, n_pad), dtype=jnp.int32)
+    return out.at[r, c].add(jnp.where(valid, vals, 0))
 
 
 @jax.jit

@@ -382,3 +382,22 @@ class TestAllocMetricParity:
             assert key in a.metrics.scores, "missing commit-time score"
             # score must equal the oracle's score_fit at commit state
             assert 0.0 <= a.metrics.scores[key] <= 18.0
+
+
+class TestEmptyCluster:
+    def test_batch_schedules_with_zero_nodes(self):
+        """A job registered before any node exists must produce a clean
+        placement failure (blocked eval), not a crash in the vectorized
+        forensics."""
+        h = Harness()
+        job = strip_networks(mock.job())
+        job.task_groups[0].count = 2
+        h.state.upsert_job(h.next_index(), job)
+        ev = reg_eval(job)
+        sched = new_scheduler("tpu-batch", h.logger, h.snapshot(), h)
+        sched.process(ev)
+        assert h.state.allocs_by_job(None, job.id, True) == []
+        updated = [e for e in h.evals if e.id == ev.id]
+        assert updated and updated[-1].failed_tg_allocs
+        m = updated[-1].failed_tg_allocs["web"]
+        assert m.nodes_evaluated == 0 and m.nodes_filtered == 0
