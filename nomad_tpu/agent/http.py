@@ -114,13 +114,15 @@ class HTTPServer:
         r("/v1/catalog/services", self.catalog_services_request)
         r("/v1/catalog/service/(?P<name>[^/]+)", self.catalog_service_request)
         r("/v1/metrics", self.metrics_request)
+        r("/v1/kv/(?P<key>.*)", self.kv_request)
 
     def _route(self, pattern: str, fn: Callable) -> None:
         self.routes.append((pattern, re.compile("^" + pattern + "$"), fn))
 
     def _dispatch(self, req: BaseHTTPRequestHandler) -> None:
         parsed = urlparse(req.path)
-        query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        query = {k: v[0] for k, v in parse_qs(
+            parsed.query, keep_blank_values=True).items()}
         for _pat, rx, fn in self.routes:
             m = rx.match(parsed.path)
             if m is None:
@@ -603,6 +605,31 @@ class HTTPServer:
         """In-memory telemetry aggregates (the reference's go-metrics
         inventory; names per telemetry.html.md)."""
         return self.server.metrics.sink.data(), None
+
+    def kv_request(self, req, query, key: str):
+        """Consul-KV-shaped store feeding task templates
+        (the `{{key}}` function's data source)."""
+        cat = self.agent.catalog
+        if req.command == "GET":
+            recurse = "recurse" in query and \
+                query["recurse"].lower() in ("", "true", "1")
+            if recurse or not key:
+                return cat.kv_list(key), None
+            val = cat.kv_get(key)
+            if val is None:
+                raise CodedError(404, f"key not found: {key}")
+            return {"Key": key, "Value": val,
+                    "ModifyIndex": cat.kv_index()}, None
+        if req.command in ("PUT", "POST"):
+            length = int(req.headers.get("Content-Length") or 0)
+            value = (req.rfile.read(length) if length else b"").decode(
+                "utf-8", "replace")
+            index = cat.kv_set(key, value)
+            return {"Key": key, "ModifyIndex": index}, None
+        if req.command == "DELETE":
+            cat.kv_delete(key)
+            return None, None
+        raise CodedError(405, "Invalid method")
 
     def catalog_service_request(self, req, query, name: str):
         tag = query.get("tag", "")

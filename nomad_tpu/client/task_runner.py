@@ -59,6 +59,7 @@ class TaskRunner:
         self.vault_token = vault_token
         self.vault_client = vault_client
         self.consul = consul
+        self._template_mgr = None
         self.logger = logger or logging.getLogger("nomad_tpu.client.task_runner")
 
         tg = alloc.job.lookup_task_group(alloc.task_group) if alloc.job else None
@@ -169,6 +170,8 @@ class TaskRunner:
         finally:
             if self.vault_token and self.vault_client is not None:
                 self.vault_client.stop_renew_token(self.vault_token)
+            if self._template_mgr is not None:
+                self._template_mgr.stop()
             self._deregister_services()
             self.done.set()
 
@@ -251,11 +254,43 @@ class TaskRunner:
         except Exception as e:
             self.logger.warning("consul: deregistration failed: %s", e)
 
+    def _render_templates(self, task_env) -> bool:
+        """Render-block before start (consul_template.go:52: tasks wait
+        for the initial render) and start the change watcher."""
+        if not self.task.templates:
+            return True
+        if self._template_mgr is None:
+            from .template import TaskTemplateManager
+
+            catalog = getattr(self.consul, "catalog", None) \
+                if self.consul is not None else None
+            self._template_mgr = TaskTemplateManager(
+                templates=self.task.templates,
+                task_dir=self.task_dir.dir,
+                env=task_env.env(),
+                catalog=catalog,
+                on_signal=self.signal,
+                on_restart=lambda: self.restart(source="template",
+                                                reason="template changed"),
+                logger=self.logger)
+            self._emit(s.TASK_STATE_PENDING,
+                       s.TaskEvent(type=s.TASK_RECEIVED,
+                                   message="rendering templates"))
+            ok = self._template_mgr.render_all_blocking(
+                should_abort=self._destroy.is_set)
+            if not ok:
+                return False
+            self._template_mgr.start_watching()
+        return True
+
     def _loop_body(self) -> None:
         while not self._destroy.is_set():
             if not self._derive_vault_token():
                 return
             task_env = self._build_env()
+
+            if not self._render_templates(task_env):
+                return
 
             if not self._prestart(task_env):
                 return

@@ -54,19 +54,56 @@ class CatalogEntry:
 
 
 class ServiceCatalog:
-    """Thread-safe service registry (the catalog half of Consul's API)."""
+    """Thread-safe service registry + KV (the catalog and KV halves of
+    Consul's API — the KV side feeds task templates exactly as
+    consul-template reads Consul KV)."""
 
     def __init__(self) -> None:
         self._l = threading.Lock()
         self._entries: Dict[str, CatalogEntry] = {}
+        self._kv: Dict[str, str] = {}
+        self._kv_index = 0
+        self._generation = 0  # bumps on ANY mutation (KV or services)
+
+    # -- KV (consul-template's `key` function source) ------------------
+
+    def kv_set(self, key: str, value: str) -> int:
+        with self._l:
+            self._kv[key] = value
+            self._kv_index += 1
+            self._generation += 1
+            return self._kv_index
+
+    def kv_get(self, key: str) -> Optional[str]:
+        with self._l:
+            return self._kv.get(key)
+
+    def kv_delete(self, key: str) -> None:
+        with self._l:
+            self._kv.pop(key, None)
+            self._kv_index += 1
+            self._generation += 1
+
+    def kv_list(self, prefix: str = "") -> Dict[str, str]:
+        with self._l:
+            return {k: v for k, v in self._kv.items()
+                    if k.startswith(prefix)}
+
+    def kv_index(self) -> int:
+        """Monotonic modify index — template watchers poll it for change
+        detection (Consul's X-Consul-Index role)."""
+        with self._l:
+            return self._kv_index
 
     def register(self, entry: CatalogEntry) -> None:
         with self._l:
             self._entries[entry.id] = entry
+            self._generation += 1
 
     def deregister(self, service_id: str) -> None:
         with self._l:
             self._entries.pop(service_id, None)
+            self._generation += 1
 
     def entry(self, service_id: str) -> Optional[CatalogEntry]:
         with self._l:
@@ -101,8 +138,16 @@ class ServiceCatalog:
                 return
             for c in e.checks:
                 if c.id == check_id:
+                    if c.status != status:
+                        self._generation += 1
                     c.status = status
                     c.output = output
+
+    def generation(self) -> int:
+        """Monotonic mutation counter across KV + services — template
+        watchers poll it to short-circuit unchanged polls."""
+        with self._l:
+            return self._generation
 
     def ids(self) -> List[str]:
         with self._l:

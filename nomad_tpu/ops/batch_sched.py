@@ -355,17 +355,42 @@ class TPUBatchScheduler:
             (*coo, result.unplaced, result.used_after, result.rounds))
         rounds = int(rounds_arr)
 
-        # Feasibility rows are fetched lazily, only for failed specs that
-        # actually filtered nodes (forensics needs the row then; the
-        # common capacity-exhaustion failure derives it from placements).
+        # Feasibility rows are fetched lazily, only for failed specs whose
+        # feasible count is below their EVALUATED count (= ready nodes in
+        # their DCs) — i.e. some constraint actually filtered a node.  The
+        # common capacity-exhaustion failure derives everything from
+        # placements without moving a row across the link.
         failed_u = np.nonzero(unplaced_arr[:st.u_real] > 0)[0]
         feas_rows: Dict[int, np.ndarray] = {}
-        need_rows = [int(u) for u in failed_u
-                     if feas_count[u] < ct.n_real]
-        if need_rows:
-            fetched = np.asarray(jax.device_get(
-                feas[jax.numpy.asarray(np.array(need_rows, dtype=np.int32))]))
-            feas_rows = {u: fetched[i] for i, u in enumerate(need_rows)}
+        node_facts = None
+        if len(failed_u):
+            # Explicit dtypes: np.array([]) would default to float64 on an
+            # empty cluster and break the boolean mask math.
+            node_facts = {
+                "ready": np.array([n.ready() for n in all_nodes],
+                                  dtype=bool),
+                "dc": np.array([n.datacenter for n in all_nodes],
+                               dtype=object),
+                "user_class": None,
+            }
+            eval_count_cache: Dict[Tuple[str, ...], int] = {}
+
+            def _evaluated_count(sp) -> int:
+                dcs = tuple(sp.datacenters)
+                n = eval_count_cache.get(dcs)
+                if n is None:
+                    n = int((node_facts["ready"] & np.isin(
+                        node_facts["dc"], list(dcs))).sum())
+                    eval_count_cache[dcs] = n
+                return n
+
+            need_rows = [int(u) for u in failed_u
+                         if feas_count[u] < _evaluated_count(spec_list[u])]
+            if need_rows:
+                fetched = np.asarray(jax.device_get(
+                    feas[jax.numpy.asarray(
+                        np.array(need_rows, dtype=np.int32))]))
+                feas_rows = {u: fetched[i] for i, u in enumerate(need_rows)}
         device_seconds = time.monotonic() - t1
 
         # COO → per-spec (node, count, score) lists, grouped via one
@@ -385,20 +410,6 @@ class TPUBatchScheduler:
                 per_u_entries[int(u_)] = list(zip(
                     vc[lo:hi].tolist(), vcnt[lo:hi].tolist(),
                     vsc[lo:hi].tolist(), vco[lo:hi].tolist()))
-
-        # Vectorized node facts shared by all specs' forensics
-        # (user_class filled lazily by the first spec that needs it).
-        node_facts = None
-        if len(failed_u):
-            # Explicit dtypes: np.array([]) would default to float64 on an
-            # empty cluster and break the boolean mask math.
-            node_facts = {
-                "ready": np.array([n.ready() for n in all_nodes],
-                                  dtype=bool),
-                "dc": np.array([n.datacenter for n in all_nodes],
-                               dtype=object),
-                "user_class": None,
-            }
 
         assignments: Dict[Tuple[str, str], List[Tuple[str, int]]] = {}
         unplaced: Dict[Tuple[str, str], int] = {}

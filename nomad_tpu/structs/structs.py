@@ -454,6 +454,11 @@ class TaskArtifact:
         return TaskArtifact(self.getter_source, dict(self.getter_options), self.relative_dest)
 
 
+TEMPLATE_CHANGE_MODE_NOOP = "noop"
+TEMPLATE_CHANGE_MODE_SIGNAL = "signal"
+TEMPLATE_CHANGE_MODE_RESTART = "restart"
+
+
 @dataclass
 class Template:
     """Rendered template block (structs.go:2914-3020)."""

@@ -1,0 +1,221 @@
+"""Task template tests (reference: client/consul_template.go:52-534 —
+render-block before start, change-mode signal/restart, KV-driven
+re-render)."""
+import os
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client.template import (
+    MissingDependency,
+    TaskTemplateManager,
+    parse_signal,
+)
+from nomad_tpu.consul import ServiceCatalog
+from nomad_tpu.consul.catalog import CatalogEntry
+from nomad_tpu.structs import structs as s
+
+
+def wait_until(pred, timeout=15.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestRendering:
+    def mgr(self, tmpl, tmp_path, catalog=None, env=None, **kw):
+        return TaskTemplateManager([tmpl], str(tmp_path),
+                                   env or {}, catalog=catalog, **kw)
+
+    def test_env_and_kv_functions(self, tmp_path):
+        cat = ServiceCatalog()
+        cat.kv_set("app/db_host", "db1.internal")
+        tmpl = s.Template(
+            embedded_tmpl='host={{key "app/db_host"}} user={{env "USER_X"}}',
+            dest_path="local/app.conf")
+        m = self.mgr(tmpl, tmp_path, catalog=cat, env={"USER_X": "svc"})
+        assert m.render_all_blocking(should_abort=lambda: False)
+        out = (tmp_path / "local" / "app.conf").read_text()
+        assert out == "host=db1.internal user=svc"
+
+    def test_service_function_and_range(self, tmp_path):
+        cat = ServiceCatalog()
+        cat.register(CatalogEntry(id="a", name="db", address="10.0.0.1",
+                                  port=5432))
+        cat.register(CatalogEntry(id="b", name="db", address="10.0.0.2",
+                                  port=5433))
+        tmpl = s.Template(
+            embedded_tmpl='upstreams={{service "db"}}\n'
+                          '{{range service "db"}}server {{.Address}}:{{.Port}};\n{{end}}',
+            dest_path="local/lb.conf")
+        m = self.mgr(tmpl, tmp_path, catalog=cat)
+        assert m.render_all_blocking(should_abort=lambda: False)
+        out = (tmp_path / "local" / "lb.conf").read_text()
+        assert "upstreams=10.0.0.1:5432,10.0.0.2:5433" in out
+        assert "server 10.0.0.1:5432;" in out and "server 10.0.0.2:5433;" in out
+
+    def test_blocks_until_key_exists(self, tmp_path):
+        cat = ServiceCatalog()
+        tmpl = s.Template(embedded_tmpl='v={{key "late/key"}}',
+                          dest_path="local/x")
+        m = self.mgr(tmpl, tmp_path, catalog=cat)
+        done = threading.Event()
+        result = {}
+
+        def run():
+            result["ok"] = m.render_all_blocking(should_abort=lambda: False,
+                                                 poll=0.02)
+            done.set()
+
+        threading.Thread(target=run, daemon=True).start()
+        time.sleep(0.3)
+        assert not done.is_set(), "render completed before the key existed"
+        cat.kv_set("late/key", "arrived")
+        assert done.wait(5.0) and result["ok"]
+        assert (tmp_path / "local" / "x").read_text() == "v=arrived"
+
+    def test_source_file_template(self, tmp_path):
+        src = tmp_path / "tmpl.in"
+        src.write_text('greeting={{env "GREET"}}')
+        tmpl = s.Template(source_path=str(src), dest_path="local/out",
+                          perms="0600")
+        m = self.mgr(tmpl, tmp_path, env={"GREET": "hello"})
+        assert m.render_all_blocking(should_abort=lambda: False)
+        dest = tmp_path / "local" / "out"
+        assert dest.read_text() == "greeting=hello"
+        assert oct(dest.stat().st_mode & 0o777) == "0o600"
+
+    def test_parse_signal(self):
+        import signal as sigmod
+        assert parse_signal("SIGHUP") == sigmod.SIGHUP
+        assert parse_signal("usr1") == sigmod.SIGUSR1
+        assert parse_signal("") == sigmod.SIGHUP
+
+
+class TestChangeModes:
+    def test_kv_change_triggers_restart_and_signal(self, tmp_path):
+        cat = ServiceCatalog()
+        cat.kv_set("cfg/a", "1")
+        cat.kv_set("cfg/b", "1")
+        restarts = []
+        signals = []
+        templates = [
+            s.Template(embedded_tmpl='a={{key "cfg/a"}}',
+                       dest_path="local/a", splay=0.0,
+                       change_mode=s.TEMPLATE_CHANGE_MODE_RESTART),
+            s.Template(embedded_tmpl='b={{key "cfg/b"}}',
+                       dest_path="local/b", splay=0.0,
+                       change_mode=s.TEMPLATE_CHANGE_MODE_SIGNAL,
+                       change_signal="SIGHUP"),
+        ]
+        m = TaskTemplateManager(
+            templates, str(tmp_path), {}, catalog=cat,
+            on_signal=signals.append, on_restart=lambda: restarts.append(1))
+        assert m.render_all_blocking(should_abort=lambda: False)
+        m.start_watching()
+        try:
+            cat.kv_set("cfg/b", "2")
+            assert wait_until(lambda: signals, 5.0), "signal never fired"
+            assert not restarts
+            assert (tmp_path / "local" / "b").read_text() == "b=2"
+
+            cat.kv_set("cfg/a", "2")
+            assert wait_until(lambda: restarts, 5.0), "restart never fired"
+            assert (tmp_path / "local" / "a").read_text() == "a=2"
+        finally:
+            m.stop()
+
+    def test_noop_mode_rewrites_without_action(self, tmp_path):
+        cat = ServiceCatalog()
+        cat.kv_set("n/x", "1")
+        fired = []
+        tmpl = s.Template(embedded_tmpl='x={{key "n/x"}}',
+                          dest_path="local/n", splay=0.0,
+                          change_mode=s.TEMPLATE_CHANGE_MODE_NOOP)
+        m = TaskTemplateManager([tmpl], str(tmp_path), {}, catalog=cat,
+                                on_signal=fired.append,
+                                on_restart=lambda: fired.append("r"))
+        assert m.render_all_blocking(should_abort=lambda: False)
+        m.start_watching()
+        try:
+            cat.kv_set("n/x", "2")
+            assert wait_until(
+                lambda: (tmp_path / "local" / "n").read_text() == "x=2", 5.0)
+            assert not fired
+        finally:
+            m.stop()
+
+
+class TestEndToEnd:
+    """A mock task gated on its template; KV update restarts it
+    (consul_template.go render-block + change-mode restart)."""
+
+    @pytest.fixture()
+    def agent(self, tmp_path):
+        from nomad_tpu.agent.agent import Agent
+        from nomad_tpu.agent.config import AgentConfig
+
+        cfg = AgentConfig.dev()
+        cfg.client.state_dir = str(tmp_path / "state")
+        cfg.client.alloc_dir = str(tmp_path / "allocs")
+        a = Agent(cfg)
+        a.start()
+        yield a
+        a.shutdown()
+
+    def test_template_gates_start_and_restarts_on_change(self, agent):
+        srv, client = agent.server, agent.client
+        assert wait_until(lambda: srv.node_get(client.node.id) is not None
+                          and srv.node_get(client.node.id).status == "ready")
+        job = mock.job()
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.restart_policy = s.RestartPolicy(attempts=3, interval=300.0,
+                                            delay=0.1)
+        for t in tg.tasks:
+            t.driver = "mock_driver"
+            t.config = {"run_for": "60s"}
+            t.resources.networks = []
+            t.services = []
+            t.templates = [s.Template(
+                embedded_tmpl='setting={{key "app/config"}}',
+                dest_path="local/app.conf", splay=0.0,
+                change_mode=s.TEMPLATE_CHANGE_MODE_RESTART)]
+        srv.job_register(job)
+
+        # The task must NOT start while the key is missing.
+        time.sleep(1.0)
+        allocs = srv.job_allocations(job.id)
+        assert allocs and allocs[0].client_status == \
+            s.ALLOC_CLIENT_STATUS_PENDING
+
+        agent.catalog.kv_set("app/config", "v1")
+        assert wait_until(lambda: any(
+            a.client_status == s.ALLOC_CLIENT_STATUS_RUNNING
+            for a in srv.job_allocations(job.id)), 20.0), \
+            "task did not start after template rendered"
+        alloc = srv.job_allocations(job.id)[0]
+        runner = client.get_alloc_runner(alloc.id)
+        conf = os.path.join(runner.alloc_dir.task_dirs["web"].dir,
+                            "local", "app.conf")
+        assert open(conf).read() == "setting=v1"
+
+        # KV change → re-render → restart (task stays/returns to running).
+        agent.catalog.kv_set("app/config", "v2")
+        assert wait_until(lambda: os.path.exists(conf)
+                          and open(conf).read() == "setting=v2", 10.0)
+
+        def restarted():
+            a = srv.job_allocations(job.id)[0]
+            st = (a.task_states or {}).get("web")
+            if st is None:
+                return False
+            return any(e.type == s.TASK_RESTART_SIGNAL for e in st.events) \
+                or sum(1 for e in st.events if e.type == s.TASK_STARTED) >= 2
+
+        assert wait_until(restarted, 20.0), "change_mode=restart never fired"
