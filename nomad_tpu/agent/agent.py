@@ -72,6 +72,7 @@ class Agent:
             rpc_port=0 if self.config.dev_mode else self.config.ports.rpc,
             bootstrap_expect=sb.bootstrap_expect,
             start_join=list(sb.start_join),
+            wan_join=list(sb.wan_join),
             num_schedulers=sb.num_schedulers,
             use_tpu_batch_worker=sb.use_tpu_batch_worker,
             batch_size=sb.batch_size)

@@ -30,6 +30,7 @@ class ServerBlock:
     node_gc_threshold: str = ""
     heartbeat_grace: str = ""
     start_join: List[str] = field(default_factory=list)
+    wan_join: List[str] = field(default_factory=list)
     use_tpu_batch_worker: bool = False
     batch_size: int = 64
 
@@ -142,6 +143,7 @@ def parse_config(src: str) -> AgentConfig:
         cfg.server.num_schedulers = int(_scalar(sb, "num_schedulers", 1))
         cfg.server.enabled_schedulers = _str_list(sb, "enabled_schedulers")
         cfg.server.start_join = _str_list(sb, "start_join")
+        cfg.server.wan_join = _str_list(sb, "retry_join_wan")
         cfg.server.use_tpu_batch_worker = bool(
             _scalar(sb, "use_tpu_batch_worker", False))
         cfg.server.batch_size = int(_scalar(sb, "batch_size", 64))

@@ -259,6 +259,16 @@ class HTTPServer:
 
     def jobs_request(self, req, query):
         if req.command == "GET":
+            region = query.get("region", "")
+            if region and region != self.server.config.region:
+                wait = parse_duration(query["wait"]) if "wait" in query \
+                    else MAX_BLOCKING_WAIT
+                jobs, index = self.server.job_list(
+                    prefix=query.get("prefix", ""), region=region,
+                    min_index=int(query.get("index", 0) or 0),
+                    max_wait=wait)
+                return [self._job_stub(j) for j in jobs], index
+
             def run(ws):
                 state = self.server.state
                 prefix = query.get("prefix", "")
@@ -272,7 +282,8 @@ class HTTPServer:
             if payload is None or "Job" not in payload:
                 raise CodedError(400, "JSON body with Job required")
             job = from_wire(s.Job, payload["Job"])
-            index, eval_id = self.server.job_register(job)
+            index, eval_id = self.server.job_register(
+                job, region=query.get("region", ""))
             return {"EvalID": eval_id, "EvalCreateIndex": index,
                     "JobModifyIndex": index}, index
         raise CodedError(405, "Invalid method")
@@ -364,6 +375,13 @@ class HTTPServer:
 
     def _job_crud(self, req, query, job_id: str):
         if req.command == "GET":
+            region = query.get("region", "")
+            if region and region != self.server.config.region:
+                job = self.server.job_get(job_id, region=region)
+                if job is None:
+                    raise CodedError(404, "job not found")
+                return job, None
+
             def run(ws):
                 job = self.server.state.job_by_id(ws, job_id)
                 if job is None:
@@ -377,12 +395,14 @@ class HTTPServer:
             job = from_wire(s.Job, payload["Job"])
             if job.id != job_id:
                 raise CodedError(400, "Job ID does not match name")
-            index, eval_id = self.server.job_register(job)
+            index, eval_id = self.server.job_register(
+                job, region=query.get("region", ""))
             return {"EvalID": eval_id, "EvalCreateIndex": index,
                     "JobModifyIndex": index}, index
         if req.command == "DELETE":
             purge = query.get("purge", "true") != "false"
-            index, eval_id = self.server.job_deregister(job_id, purge=purge)
+            index, eval_id = self.server.job_deregister(
+                job_id, purge=purge, region=query.get("region", ""))
             return {"EvalID": eval_id, "EvalCreateIndex": index,
                     "JobModifyIndex": index}, index
         raise CodedError(405, "Invalid method")
@@ -673,6 +693,8 @@ class HTTPServer:
                 "Error": "; ".join(problems) if problems else ""}, None
 
     def regions_request(self, req, query):
+        if self.agent.server is not None:
+            return self.agent.server.regions(), None
         return [self.agent.config.region], None
 
     def status_leader_request(self, req, query):

@@ -123,17 +123,25 @@ class Jobs:
     def list(self, q: Optional[QueryOptions] = None) -> Tuple[List[dict], QueryMeta]:
         return self.c.get("/v1/jobs", q)
 
-    def register(self, job: s.Job) -> Tuple[dict, QueryMeta]:
-        return self.c.put("/v1/jobs", {"Job": to_wire(job)})
+    def register(self, job: s.Job,
+                 q: Optional[QueryOptions] = None) -> Tuple[dict, QueryMeta]:
+        return self.c.put("/v1/jobs", {"Job": to_wire(job)},
+                          q or QueryOptions())
 
     def info(self, job_id: str, q: Optional[QueryOptions] = None
              ) -> Tuple[s.Job, QueryMeta]:
         obj, meta = self.c.get(f"/v1/job/{job_id}", q)
         return from_wire(s.Job, obj), meta
 
-    def deregister(self, job_id: str, purge: bool = True) -> Tuple[dict, QueryMeta]:
-        q = QueryOptions(params={"purge": "true" if purge else "false"})
-        return self.c.delete(f"/v1/job/{job_id}", q)
+    def deregister(self, job_id: str, purge: bool = True,
+                   q: Optional[QueryOptions] = None) -> Tuple[dict, QueryMeta]:
+        base = q or QueryOptions()
+        params = dict(base.params or {})
+        params["purge"] = "true" if purge else "false"
+        merged = QueryOptions(region=base.region, prefix=base.prefix,
+                              wait_index=base.wait_index,
+                              wait_time=base.wait_time, params=params)
+        return self.c.delete(f"/v1/job/{job_id}", merged)
 
     def allocations(self, job_id: str, all_allocs: bool = False,
                     q: Optional[QueryOptions] = None):

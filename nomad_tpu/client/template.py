@@ -73,6 +73,10 @@ class TaskTemplateManager:
         self.on_restart = on_restart
         self.logger = logger or logging.getLogger("nomad_tpu.template")
         self._rendered: Dict[int, str] = {}    # template idx -> content
+        # Generation observed BEFORE the first render: a mutation landing
+        # between the initial render and the watcher's first poll must
+        # still trigger a re-render.
+        self._gen0 = catalog.generation() if catalog is not None else 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -177,7 +181,7 @@ class TaskTemplateManager:
         self._stop.set()
 
     def _watch_loop(self, poll: float = RENDER_POLL) -> None:
-        last_gen = self.catalog.generation() if self.catalog else 0
+        last_gen = self._gen0
         while not self._stop.wait(poll):
             if self.catalog is not None:
                 gen = self.catalog.generation()

@@ -41,8 +41,12 @@ def register_endpoints(server, rpc) -> None:
             # unforwardable NotLeaderError into the wire error.
             forwarded = isinstance(body, dict) and body.pop("__forwarded__",
                                                             False)
+            region_hop = isinstance(body, dict) and body.pop(
+                "__region_hop__", False)
             if forwarded:
                 server._fwd_ctx.active = True
+            if region_hop:
+                server._fwd_ctx.region_hop = True
             try:
                 return fn(body)
             except NotLeaderError:
@@ -50,6 +54,8 @@ def register_endpoints(server, rpc) -> None:
             finally:
                 if forwarded:
                     server._fwd_ctx.active = False
+                if region_hop:
+                    server._fwd_ctx.region_hop = False
         rpc.register(method, handler)
 
     # -- Status ------------------------------------------------------------
@@ -129,12 +135,14 @@ def register_endpoints(server, rpc) -> None:
 
     def job_register(body):
         job = from_wire(s.Job, body["Job"])
-        index, eval_id = server.job_register(job)
+        index, eval_id = server.job_register(job,
+                                             region=body.get("Region", ""))
         return {"Index": index, "EvalID": eval_id}
 
     def job_deregister(body):
         index, eval_id = server.job_deregister(
-            body["JobID"], purge=body.get("Purge", True))
+            body["JobID"], purge=body.get("Purge", True),
+            region=body.get("Region", ""))
         return {"Index": index, "EvalID": eval_id}
 
     def job_evaluate(body):
@@ -147,6 +155,23 @@ def register_endpoints(server, rpc) -> None:
         return {"Index": index, "DispatchedJobID": child_id,
                 "EvalID": eval_id}
 
+    def job_list(body):
+        jobs, index = server.job_list(
+            prefix=body.get("Prefix", ""), region=body.get("Region", ""),
+            min_index=int(body.get("MinQueryIndex", 0) or 0),
+            max_wait=float(body.get("MaxQueryTime", 0) or 0))
+        return {"Jobs": [to_wire(j) for j in jobs], "Index": index}
+
+    def job_get(body):
+        job = server.job_get(
+            body["JobID"], region=body.get("Region", ""),
+            min_index=int(body.get("MinQueryIndex", 0) or 0),
+            max_wait=float(body.get("MaxQueryTime", 0) or 0))
+        return {"Job": to_wire(job) if job is not None else None,
+                "Index": server.state.table_index("jobs")}
+
+    register("Job.List", job_list)
+    register("Job.Get", job_get)
     register("Job.Register", job_register)
     register("Job.Deregister", job_deregister)
     register("Job.Evaluate", job_evaluate)

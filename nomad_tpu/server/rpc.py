@@ -35,6 +35,16 @@ class RPCError(Exception):
     pass
 
 
+class TransportError(RPCError):
+    """Connection-level failure (dial/read/write) — unlike an application
+    error reply from the remote."""
+
+
+class DialError(TransportError):
+    """The connection could not even be established: the request was never
+    sent, so retrying elsewhere cannot double-apply it."""
+
+
 class NoLeaderError(RPCError):
     pass
 
@@ -256,12 +266,12 @@ class ConnPool:
             try:
                 conn = _Conn(addr, channel, timeout)
             except OSError as e:
-                raise RPCError(f"rpc to {addr} failed: {e}") from e
+                raise DialError(f"rpc to {addr} failed: {e}") from e
         try:
             reply = conn.call(method, body, timeout)
         except (ConnectionError, OSError) as e:
             conn.close()
-            raise RPCError(f"rpc to {addr} failed: {e}") from e
+            raise TransportError(f"rpc to {addr} failed: {e}") from e
         except RPCError:
             # Application-level error reply: the transport is still healthy,
             # keep the connection pooled.

@@ -43,6 +43,9 @@ class ServerConfig:
     rpc_port: int = 0                   # 0 → ephemeral
     bootstrap_expect: int = 1
     start_join: List[str] = field(default_factory=list)
+    # Cross-region federation joins (serf WAN, nomad/serf.go): membership
+    # only — never part of this region's raft quorum.
+    wan_join: List[str] = field(default_factory=list)
     num_schedulers: int = 1
     use_tpu_batch_worker: bool = False
     batch_size: int = 64
@@ -170,10 +173,11 @@ class Server:
         if isinstance(self.raft, MultiRaft):
             self.raft.start()
             self._maybe_bootstrap()
-            if self.config.start_join:
-                t = threading.Thread(target=self._join_loop, daemon=True,
-                                     name="serf-join")
-                t.start()
+        if self.rpc is not None and (self.config.start_join
+                                     or self.config.wan_join):
+            t = threading.Thread(target=self._join_loop, daemon=True,
+                                 name="serf-join")
+            t.start()
         t = threading.Thread(target=self._emit_metrics_loop, daemon=True,
                              name="metrics-emitter")
         t.start()
@@ -226,7 +230,8 @@ class Server:
     def members(self) -> List[Dict]:
         """(serf.Members / nomad/serf.go peer table)."""
         with self._members_lock:
-            return sorted(self._members.values(), key=lambda m: m["Name"])
+            return sorted(self._members.values(),
+                          key=lambda m: (m.get("Region", ""), m["Name"]))
 
     def membership_join(self, member: Dict) -> Dict:
         """Handle a Serf.Join from a peer: merge, gossip the change, and
@@ -244,9 +249,13 @@ class Server:
                 name = m.get("Name")
                 if not name or not m.get("Addr"):
                     continue
-                if name not in self._members:
+                # Names are only unique within a region (serf WAN names
+                # members "name.region"); key by both so two regions'
+                # default-named servers cannot overwrite each other.
+                key = (name, m.get("Region", ""))
+                if key not in self._members:
                     added.append(m)
-                self._members[name] = dict(m)
+                self._members[key] = dict(m)
             view = list(self._members.values())
         if not added:
             return
@@ -287,7 +296,11 @@ class Server:
         if not isinstance(self.raft, MultiRaft):
             return
         with self._members_lock:
-            addrs = [m["Addr"] for m in self._members.values()]
+            # WAN members of other regions are never raft voters
+            # (serf.go: per-region raft, WAN gossip for federation only).
+            addrs = [m["Addr"] for m in self._members.values()
+                     if m.get("Region", self.config.region)
+                     == self.config.region]
         if not self.raft._bootstrapped:
             if self.config.start_join:
                 return
@@ -309,7 +322,7 @@ class Server:
         """Retry start_join addresses until each answers — indefinitely,
         with capped backoff, like the agent's retry_join: a cluster whose
         members boot far apart must still converge."""
-        pending = list(self.config.start_join)
+        pending = list(self.config.start_join) + list(self.config.wan_join)
         me = self._self_member()
         delay = 0.25
         attempts = 0
@@ -556,13 +569,57 @@ class Server:
                 if any(not a.terminal_status()
                        for a in self.state.allocs_by_job(None, child.id)):
                     return
-        self.job_register(derived)
+        # Explicit own region: a derived child must never region-route
+        # away from its parent (periodic.go children are region-local).
+        self.job_register(derived, region=self.config.region)
         self.raft.apply(MessageType.PERIODIC_LAUNCH_UPSERT,
                         {"job_id": parent.id, "launch": launch_time})
 
     # ======================================================================
     # RPC endpoint surface (reference: nomad/*_endpoint.go)
     # ======================================================================
+
+    def regions(self) -> List[str]:
+        """Distinct regions known through membership (region_endpoint.go
+        List over serf WAN members)."""
+        out = {self.config.region}
+        for m in self.members():
+            r = m.get("Region")
+            if r:
+                out.add(r)
+        return sorted(out)
+
+    def _forward_region(self, region: str, wire_method: str, body: Dict):
+        """Route a request to any alive server of another region
+        (nomad/rpc.go:263 forwardRegion over the WAN member table).  Does
+        NOT consume the one leader-forward hop: the remote server may
+        still forward to its own region's leader."""
+        from .rpc import DialError
+
+        if getattr(self._fwd_ctx, "region_hop", False):
+            # This request already took its region hop; stale member
+            # records must not bounce it between regions.
+            raise ValueError(
+                f"request for region {region!r} arrived at "
+                f"{self.config.region!r} after a region forward")
+        candidates = [m for m in self.members()
+                      if m.get("Region") == region]
+        if not candidates or self.pool is None:
+            raise ValueError(f"no servers known in region {region!r}")
+        body = dict(body)
+        body["Region"] = region
+        body["__region_hop__"] = True
+        last: Optional[Exception] = None
+        for m in candidates:
+            try:
+                return self.pool.call(m["Addr"], wire_method, body)
+            except DialError as e:
+                # Only DIAL failures rotate — the request was never sent.
+                # A post-send transport error may have applied remotely;
+                # retrying could double-apply a write, and application
+                # errors must propagate as-is.
+                last = e
+        raise ValueError(f"no path to region {region!r}: {last}")
 
     def _forward(self, wire_method: str, body: Dict):
         """Re-issue a write that hit NotLeaderError as a wire RPC to the
@@ -582,9 +639,23 @@ class Server:
 
     # -- Job ---------------------------------------------------------------
 
-    def job_register(self, job: s.Job) -> Tuple[int, str]:
+    def job_register(self, job: s.Job, region: str = "") -> Tuple[int, str]:
         """(job_endpoint.go:47 Register): validate → log JobRegister → eval
-        unless periodic/parameterized.  Returns (modify_index, eval_id)."""
+        unless periodic/parameterized.  Returns (modify_index, eval_id).
+
+        A request whose effective region (explicit arg, else Job.Region)
+        differs from this server's routes to that region
+        (rpc.go:263 forwardRegion).  An EXPLICIT region always routes (and
+        errors if unknown); a job-file region only routes when that region
+        is actually federated — otherwise it registers locally, so a
+        default-region job file still works on a renamed cluster."""
+        target = region or job.region
+        if target and target != self.config.region and (
+                region or target in self.regions()):
+            from ..api.codec import to_wire
+            reply = self._forward_region(target, "Job.Register",
+                                         {"Job": to_wire(job)})
+            return reply["Index"], reply["EvalID"]
         job = job.copy()
         job.canonicalize()
         problems = job.validate()
@@ -613,8 +684,13 @@ class Server:
             eval_id = ev.id
         return index, eval_id
 
-    def job_deregister(self, job_id: str, purge: bool = True) -> Tuple[int, str]:
+    def job_deregister(self, job_id: str, purge: bool = True,
+                       region: str = "") -> Tuple[int, str]:
         """(job_endpoint.go Deregister)."""
+        if region and region != self.config.region:
+            reply = self._forward_region(region, "Job.Deregister",
+                                         {"JobID": job_id, "Purge": purge})
+            return reply["Index"], reply["EvalID"]
         job = self.state.job_by_id(None, job_id)
         if job is None:
             raise KeyError(f"job not found: {job_id}")
@@ -635,10 +711,55 @@ class Server:
             eval_id = ev.id
         return index, eval_id
 
-    def job_list(self) -> List[s.Job]:
-        return self.state.jobs(None)
+    def job_list(self, prefix: str = "", region: str = "",
+                 min_index: int = 0,
+                 max_wait: float = 0.0) -> Tuple[List[s.Job], int]:
+        """Region-routed job listing (reads forward like writes —
+        rpc.go:178 forwards every RPC, reads included).  Blocking-query
+        semantics run at the OWNING region (min_index/max_wait travel
+        with the forward, rpc.go:340 blockingRPC).  Returns (jobs, index)."""
+        if region and region != self.config.region:
+            from ..api.codec import from_wire
+            reply = self._forward_region(
+                region, "Job.List",
+                {"Prefix": prefix, "MinQueryIndex": min_index,
+                 "MaxQueryTime": max_wait})
+            return ([from_wire(s.Job, j) for j in reply["Jobs"] or []],
+                    int(reply.get("Index", 0)))
+        self._block_on_table("jobs", min_index, max_wait)
+        jobs = (self.state.jobs_by_id_prefix(None, prefix) if prefix
+                else self.state.jobs(None))
+        return jobs, self.state.table_index("jobs")
 
-    def job_get(self, job_id: str) -> Optional[s.Job]:
+    def _block_on_table(self, table: str, min_index: int,
+                        max_wait: float) -> None:
+        """Server-side long-poll on a state table (rpc.go:340
+        blockingRPC)."""
+        if min_index <= 0 or max_wait <= 0:
+            return
+        from ..state.state_store import WatchSet
+        deadline = time.time() + min(max_wait, 300.0)
+        while self.state.table_index(table) <= min_index:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                return
+            ws = WatchSet()
+            # register interest, then wait for the next write
+            getattr(self.state, "jobs")(ws)
+            ws.watch(timeout=min(remaining, 1.0))
+
+    def job_get(self, job_id: str, region: str = "",
+                min_index: int = 0,
+                max_wait: float = 0.0) -> Optional[s.Job]:
+        if region and region != self.config.region:
+            from ..api.codec import from_wire
+            reply = self._forward_region(
+                region, "Job.Get",
+                {"JobID": job_id, "MinQueryIndex": min_index,
+                 "MaxQueryTime": max_wait})
+            data = reply.get("Job")
+            return from_wire(s.Job, data) if data else None
+        self._block_on_table("jobs", min_index, max_wait)
         return self.state.job_by_id(None, job_id)
 
     def job_summary(self, job_id: str) -> Optional[s.JobSummary]:

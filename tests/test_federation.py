@@ -1,0 +1,183 @@
+"""Multi-region federation tests (reference: nomad/rpc.go:263
+forwardRegion, nomad/serf.go WAN gossip): regions federate through WAN
+membership; requests targeting another region route to a server there;
+WAN members never join the local region's raft quorum."""
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.structs import structs as s
+
+
+def wait_until(pred, timeout=20.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture()
+def federation(tmp_path):
+    """One single-voter server per region, WAN-joined."""
+    global_srv = Server(ServerConfig(
+        region="global", node_name="global-1", enable_rpc=True,
+        num_schedulers=1))
+    global_srv.start()
+    eu_srv = Server(ServerConfig(
+        region="eu", node_name="eu-1", enable_rpc=True,
+        num_schedulers=1,
+        wan_join=[global_srv.config.rpc_advertise]))
+    eu_srv.start()
+    yield global_srv, eu_srv
+    eu_srv.shutdown()
+    global_srv.shutdown()
+
+
+def make_job(region):
+    job = mock.job()
+    job.region = region
+    job.task_groups[0].count = 1
+    for t in job.task_groups[0].tasks:
+        t.resources.networks = []
+    return job
+
+
+class TestFederation:
+    def test_wan_membership_and_regions(self, federation):
+        global_srv, eu_srv = federation
+        assert wait_until(lambda: len(global_srv.members()) == 2)
+        assert wait_until(lambda: len(eu_srv.members()) == 2)
+        assert global_srv.regions() == ["eu", "global"]
+        assert eu_srv.regions() == ["eu", "global"]
+        # Both remain leaders of their own (single-voter) regions.
+        assert global_srv.is_leader() and eu_srv.is_leader()
+
+    def test_wan_members_not_in_raft_quorum(self, tmp_path):
+        """A multi-server region federated over WAN must keep only its own
+        region's servers as voters."""
+        s1 = Server(ServerConfig(
+            region="global", node_name="g1", enable_rpc=True,
+            data_dir=str(tmp_path / "g1"), bootstrap_expect=2,
+            num_schedulers=0))
+        s1.start()
+        s2 = Server(ServerConfig(
+            region="global", node_name="g2", enable_rpc=True,
+            data_dir=str(tmp_path / "g2"), bootstrap_expect=2,
+            start_join=[s1.config.rpc_advertise], num_schedulers=0))
+        s2.start()
+        eu = Server(ServerConfig(
+            region="eu", node_name="eu1", enable_rpc=True,
+            num_schedulers=0, wan_join=[s1.config.rpc_advertise]))
+        eu.start()
+        try:
+            assert wait_until(lambda: any(
+                srv.is_leader() for srv in (s1, s2)), 20.0)
+            assert wait_until(lambda: len(s1.members()) == 3)
+            leader = s1 if s1.is_leader() else s2
+            # Voter set stays the two global servers, never the eu member.
+            peers = set(leader.raft.peers)
+            assert peers == {s1.config.rpc_advertise,
+                             s2.config.rpc_advertise}, peers
+        finally:
+            eu.shutdown()
+            s2.shutdown()
+            s1.shutdown()
+
+    def test_job_routes_to_its_region(self, federation):
+        global_srv, eu_srv = federation
+        assert wait_until(lambda: len(global_srv.members()) == 2)
+
+        job = make_job("eu")
+        index, eval_id = global_srv.job_register(job)
+        assert eval_id
+        # The job lives in the eu region's state, not global's.
+        assert eu_srv.state.job_by_id(None, job.id) is not None
+        assert global_srv.state.job_by_id(None, job.id) is None
+
+        # And it schedules there once eu has capacity.
+        node = mock.node()
+        node.resources.networks = []
+        node.reserved.networks = []
+        eu_srv.node_register(node)
+        assert wait_until(lambda: len(
+            eu_srv.state.allocs_by_job(None, job.id, True)) == 1)
+
+        # Deregister routed the same way.
+        global_srv.job_deregister(job.id, purge=False, region="eu")
+        assert wait_until(lambda: eu_srv.state.job_by_id(
+            None, job.id).stop is True)
+
+    def test_http_region_param_routes(self, federation, tmp_path):
+        global_srv, eu_srv = federation
+        assert wait_until(lambda: len(global_srv.members()) == 2)
+        from nomad_tpu.agent.agent import Agent
+        from nomad_tpu.agent.config import AgentConfig
+        from nomad_tpu.api.client import NomadAPI, QueryOptions
+
+        # HTTP agent fronting the *global* server: point its server block
+        # at the running global server via an in-process shim is complex;
+        # instead drive the global server's own HTTP by building an agent
+        # around a fresh server in region 'global' WAN-joined to eu.
+        cfg = AgentConfig()
+        cfg.name = "g-http"
+        cfg.server.enabled = True
+        cfg.ports.http = 0
+        cfg.ports.rpc = 0
+        cfg.server.wan_join = [eu_srv.config.rpc_advertise]
+        agent = Agent(cfg)
+        agent.start()
+        try:
+            assert wait_until(lambda: "eu" in agent.server.regions())
+            api = NomadAPI(address=agent.http.address, region="eu")
+            job = make_job("eu")
+            job.id = job.name = "http-routed"
+            resp, _ = api.jobs.register(job)
+            assert resp["EvalID"]
+            assert wait_until(lambda: eu_srv.state.job_by_id(
+                None, "http-routed") is not None)
+            assert agent.server.state.job_by_id(None, "http-routed") is None
+            # /v1/regions lists the federation.
+            import json
+            import urllib.request
+            with urllib.request.urlopen(
+                    agent.http.address + "/v1/regions") as r:
+                regions = json.loads(r.read())
+            assert regions == ["eu", "global"]
+        finally:
+            agent.shutdown()
+
+    def test_unknown_region_semantics(self, federation):
+        global_srv, _ = federation
+        # An EXPLICITLY requested unknown region is an error…
+        job = make_job("mars")
+        with pytest.raises(ValueError):
+            global_srv.job_register(job, region="mars")
+        # …but a job-file region that is not federated registers locally
+        # (a renamed single-region cluster still accepts default-region
+        # job files).
+        job2 = make_job("mars")
+        index, eval_id = global_srv.job_register(job2)
+        assert eval_id
+        assert global_srv.state.job_by_id(None, job2.id) is not None
+
+
+class TestRegionReads:
+    def test_job_list_and_get_route(self, federation):
+        global_srv, eu_srv = federation
+        assert wait_until(lambda: len(global_srv.members()) == 2)
+        job = make_job("eu")
+        job.id = job.name = "read-routed"
+        global_srv.job_register(job)
+        assert wait_until(lambda: eu_srv.state.job_by_id(
+            None, "read-routed") is not None)
+        # Reads against the GLOBAL server route to eu when asked to
+        # (rpc.go:178 forwards reads too).
+        got = global_srv.job_get("read-routed", region="eu")
+        assert got is not None and got.id == "read-routed"
+        listed, _idx = global_srv.job_list(prefix="read-", region="eu")
+        assert [j.id for j in listed] == ["read-routed"]
+        assert global_srv.job_get("read-routed") is None
