@@ -673,11 +673,22 @@ class HTTPServer:
     def agent_join_request(self, req, query):
         if req.command not in ("PUT", "POST"):
             raise CodedError(405, "Invalid method")
-        return {"num_joined": 0, "error": ""}, None
+        addrs = [a for a in (query.get("address", "")).split(",") if a]
+        if not addrs:
+            raise CodedError(400, "missing address to join")
+        try:
+            joined = self.server.join(addrs)
+        except ValueError as e:
+            return {"num_joined": 0, "error": str(e)}, None
+        return {"num_joined": joined, "error": ""}, None
 
     def agent_force_leave_request(self, req, query):
         if req.command not in ("PUT", "POST"):
             raise CodedError(405, "Invalid method")
+        node = query.get("node", "")
+        if not node:
+            raise CodedError(400, "missing node to force leave")
+        self.server.force_leave(node)
         return None, None
 
     def validate_job_request(self, req, query):

@@ -260,6 +260,15 @@ class AgentAPI:
         obj, _ = self.c.get("/v1/agent/servers")
         return obj or []
 
+    def join(self, addresses) -> dict:
+        q = QueryOptions(params={"address": ",".join(addresses)})
+        obj, _ = self.c.put("/v1/agent/join", None, q)
+        return obj or {}
+
+    def force_leave(self, node: str) -> None:
+        q = QueryOptions(params={"node": node})
+        self.c.put("/v1/agent/force-leave", None, q)
+
     def client_stats(self) -> dict:
         obj, _ = self.c.get("/v1/client/stats")
         return obj

@@ -583,6 +583,102 @@ def cmd_fs(args, out) -> int:
     return 0
 
 
+def cmd_server_join(args, out) -> int:
+    """command/server_join.go: join this agent's server to an existing
+    cluster's gossip."""
+    api = _api(args)
+    reply = api.agent.join(args.addresses)
+    if reply.get("error"):
+        out.write(f"Error joining: {reply['error']}\n")
+        return 1
+    out.write(f"Joined {reply.get('num_joined', 0)} servers successfully\n")
+    return 0
+
+
+def cmd_server_force_leave(args, out) -> int:
+    """command/server_force_leave.go."""
+    api = _api(args)
+    try:
+        api.agent.force_leave(args.node)
+    except APIError as e:
+        out.write(f"Error force-leaving: {e}\n")
+        return 1
+    out.write(f"Server {args.node} marked as left\n")
+    return 0
+
+
+def cmd_keygen(args, out) -> int:
+    """command/keygen.go: a random 32-byte base64 gossip key."""
+    import base64
+    import os as _os
+
+    out.write(base64.b64encode(_os.urandom(32)).decode("ascii") + "\n")
+    return 0
+
+
+def cmd_keyring(args, out) -> int:
+    """command/keyring.go: manage the gossip keyring file
+    (<data_dir>/keyring.json).  Key install/list/use/remove semantics
+    mirror serf's keyring management; the wire encryption itself is a
+    transport concern (the reference's serf encrypt option)."""
+    import base64
+    import json as _json
+    import os as _os
+
+    path = _os.path.join(args.data_dir or ".", "keyring.json")
+    ring = {"Keys": [], "Primary": ""}
+    if _os.path.exists(path):
+        with open(path) as fh:
+            ring = _json.load(fh)
+
+    def save():
+        with open(path, "w") as fh:
+            _json.dump(ring, fh, indent=2)
+
+    if args.list_keys:
+        if not ring["Keys"]:
+            out.write("Keyring is empty\n")
+        for k in ring["Keys"]:
+            marker = " (primary)" if k == ring["Primary"] else ""
+            out.write(f"{k}{marker}\n")
+        return 0
+    key = args.install or args.use or args.remove
+    if key:
+        try:
+            if len(base64.b64decode(key)) != 32:
+                raise ValueError
+        except Exception:
+            out.write("Error: key must be 32 bytes of base64\n")
+            return 1
+    if args.install:
+        if args.install not in ring["Keys"]:
+            ring["Keys"].append(args.install)
+        if not ring["Primary"]:
+            ring["Primary"] = args.install
+        save()
+        out.write("Installed key\n")
+        return 0
+    if args.use:
+        if args.use not in ring["Keys"]:
+            out.write("Error: key is not in the keyring\n")
+            return 1
+        ring["Primary"] = args.use
+        save()
+        out.write("Changed primary key\n")
+        return 0
+    if args.remove:
+        if args.remove == ring["Primary"]:
+            out.write("Error: cannot remove the primary key\n")
+            return 1
+        if args.remove in ring["Keys"]:
+            ring["Keys"].remove(args.remove)
+            save()
+        out.write("Removed key\n")
+        return 0
+    out.write("Specify one of -install, -list, -use, -remove\n")
+    return 1
+
+
 def cmd_server_members(args, out) -> int:
     """command/server_members.go."""
     api = _api(args)
@@ -809,6 +905,17 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("-cat", action="store_true"),
         sp.add_argument("-f", dest="follow", action="store_true")))
     add("server-members", cmd_server_members)
+    add("server-join", cmd_server_join, lambda sp: sp.add_argument(
+        "addresses", nargs="+"))
+    add("server-force-leave", cmd_server_force_leave, lambda sp:
+        sp.add_argument("node"))
+    add("keygen", cmd_keygen)
+    add("keyring", cmd_keyring, lambda sp: (
+        sp.add_argument("-data-dir", dest="data_dir", default="."),
+        sp.add_argument("-install", default=""),
+        sp.add_argument("-list", dest="list_keys", action="store_true"),
+        sp.add_argument("-use", default=""),
+        sp.add_argument("-remove", default="")))
     add("agent-info", cmd_agent_info)
     add("job-dispatch", cmd_job_dispatch, lambda sp: (
         sp.add_argument("job_id"),

@@ -245,6 +245,35 @@ class EnvAWSFingerprint(Fingerprint):
         return True
 
 
+class EnvGCEFingerprint(Fingerprint):
+    """(fingerprint/env_gce.go) — GCE metadata; same zero-egress fast
+    probe as env_aws (metadata.google.internal answers instantly on GCE,
+    refuses instantly elsewhere)."""
+
+    name = "env_gce"
+
+    def fingerprint(self, config, node: s.Node) -> bool:
+        try:
+            sk = socket.create_connection(("169.254.169.254", 80),
+                                          timeout=0.2)
+            sk.close()
+        except OSError:
+            return False
+        # Distinguish from AWS by the Metadata-Flavor header probe.
+        import urllib.request
+        try:
+            req = urllib.request.Request(
+                "http://169.254.169.254/computeMetadata/v1/",
+                headers={"Metadata-Flavor": "Google"})
+            with urllib.request.urlopen(req, timeout=0.2) as resp:
+                if resp.headers.get("Metadata-Flavor") != "Google":
+                    return False
+        except OSError:
+            return False
+        node.attributes["platform.gce.probed"] = "1"
+        return True
+
+
 BUILTIN_FINGERPRINTS: List[Callable[[], Fingerprint]] = [
     ArchFingerprint,
     CPUFingerprint,
@@ -256,6 +285,7 @@ BUILTIN_FINGERPRINTS: List[Callable[[], Fingerprint]] = [
     StorageFingerprint,
     TPUFingerprint,
     EnvAWSFingerprint,
+    EnvGCEFingerprint,
 ]
 
 

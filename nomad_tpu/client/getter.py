@@ -1,10 +1,11 @@
 """Artifact fetcher (reference: client/getter/getter.go:36-127, which
 wraps go-getter).
 
-Supports ``file://`` paths, plain local paths, and ``http(s)://`` URLs,
-with optional sha256/md5 checksum verification via the same
-``checksum=<type>:<hex>`` option go-getter uses.  Source strings are
-env-interpolated before fetch (getter.go GetArtifact).
+Supports ``file://`` paths, plain local paths, ``http(s)://`` URLs, and
+``git::`` clones (ref via the ``ref`` getter option), with optional
+sha256/md5 checksum verification via the same ``checksum=<type>:<hex>``
+option go-getter uses.  Source strings are env-interpolated before fetch
+(getter.go GetArtifact).
 """
 from __future__ import annotations
 
@@ -30,6 +31,11 @@ def get_artifact(task_env: TaskEnv, artifact: s.TaskArtifact, task_dir: str) -> 
     rel_dest = task_env.replace_env(artifact.relative_dest or "local/")
     dest_dir = os.path.join(task_dir, rel_dest.lstrip("/"))
     os.makedirs(dest_dir, exist_ok=True)
+
+    # git::<url> (go-getter forced-protocol syntax) clones the repository
+    # into the destination directory.
+    if source.startswith("git::") or source.endswith(".git"):
+        return _get_git(source, artifact, dest_dir)
 
     parsed = urllib.parse.urlparse(source)
     name = os.path.basename(parsed.path) or "artifact"
@@ -76,3 +82,30 @@ def _verify_checksum(artifact: s.TaskArtifact, task_env: TaskEnv, path: str) -> 
     if h.hexdigest() != want.lower():
         raise ArtifactError(
             f"checksum mismatch for {path}: got {h.hexdigest()}, want {want}")
+
+
+def _get_git(source: str, artifact: s.TaskArtifact, dest_dir: str) -> str:
+    """Clone a git artifact (go-getter's git detector): ``git::<url>``,
+    optional ``ref`` getter option selects a branch/tag/commit."""
+    import subprocess
+
+    url = source[len("git::"):] if source.startswith("git::") else source
+    name = os.path.basename(urllib.parse.urlparse(url).path)
+    if name.endswith(".git"):
+        name = name[:-4]
+    dest = os.path.join(dest_dir, name or "repo")
+    ref = (artifact.getter_options or {}).get("ref", "")
+    try:
+        subprocess.run(["git", "clone", "--quiet", url, dest],
+                       check=True, capture_output=True, timeout=300)
+        if ref:
+            subprocess.run(["git", "-C", dest, "checkout", "--quiet", ref],
+                           check=True, capture_output=True, timeout=60)
+    except FileNotFoundError as e:
+        raise ArtifactError(f"git not available: {e}") from e
+    except subprocess.TimeoutExpired as e:
+        raise ArtifactError(f"git clone timed out: {e}") from e
+    except subprocess.CalledProcessError as e:
+        raise ArtifactError(
+            f"git clone failed: {e.stderr.decode(errors='replace')}") from e
+    return dest

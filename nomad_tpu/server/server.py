@@ -233,6 +233,37 @@ class Server:
             return sorted(self._members.values(),
                           key=lambda m: (m.get("Region", ""), m["Name"]))
 
+    def join(self, addresses: List[str]) -> int:
+        """Operator-initiated join (agent_endpoint.go Join → serf.Join):
+        dial each address's Serf.Join, merge the replies; returns how many
+        answered."""
+        if self.pool is None:
+            raise ValueError("RPC is not enabled")
+        me = self._self_member()
+        joined = 0
+        for addr in addresses:
+            try:
+                reply = self.pool.call(addr, "Serf.Join", {"Member": me},
+                                       timeout=2.0)
+                self._merge_members(reply.get("Members") or [])
+                joined += 1
+            except Exception as e:
+                self.logger.warning("server: join %s failed: %s", addr, e)
+        return joined
+
+    def force_leave(self, name: str) -> bool:
+        """Mark a member as left (serf.RemoveFailedNode /
+        agent_endpoint.go ForceLeave): it stops being a routing/forward
+        candidate; a same-region raft peer set is untouched (voter removal
+        is a config change, not a gossip eviction)."""
+        changed = False
+        with self._members_lock:
+            for key, m in list(self._members.items()):
+                if m["Name"] == name:
+                    m["Status"] = "left"
+                    changed = True
+        return changed
+
     def membership_join(self, member: Dict) -> Dict:
         """Handle a Serf.Join from a peer: merge, gossip the change, and
         return the full member list (serf.go:51 nodeJoin)."""
@@ -603,7 +634,8 @@ class Server:
                 f"request for region {region!r} arrived at "
                 f"{self.config.region!r} after a region forward")
         candidates = [m for m in self.members()
-                      if m.get("Region") == region]
+                      if m.get("Region") == region
+                      and m.get("Status", "alive") == "alive"]
         if not candidates or self.pool is None:
             raise ValueError(f"no servers known in region {region!r}")
         body = dict(body)

@@ -227,3 +227,47 @@ class TestMiscCommands:
         code, out = run_cli([])
         assert code == 1
         assert "usage" in out.lower()
+
+
+class TestClusterVerbs:
+    def test_keygen_and_keyring(self, tmp_path):
+        out = run_cli(["keygen"])
+        assert out[0] == 0
+        key = out[1].strip()
+        import base64
+        assert len(base64.b64decode(key)) == 32
+
+        d = str(tmp_path)
+        code, o = run_cli(["keyring", "-data-dir", d, "-install", key])
+        assert code == 0 and "Installed" in o
+        code, o = run_cli(["keyring", "-data-dir", d, "-list"])
+        assert code == 0 and key in o and "(primary)" in o
+        code, o = run_cli(["keyring", "-data-dir", d, "-remove", key])
+        assert code == 1  # primary cannot be removed
+        code, o = run_cli(["keygen"])
+        key2 = o.strip()
+        run_cli(["keyring", "-data-dir", d, "-install", key2])
+        code, o = run_cli(["keyring", "-data-dir", d, "-use", key2])
+        assert code == 0
+        code, o = run_cli(["keyring", "-data-dir", d, "-remove", key])
+        assert code == 0
+
+    def test_server_join_and_force_leave(self, addr):
+        from nomad_tpu.server import Server, ServerConfig
+
+        other = Server(ServerConfig(node_name="joiner", enable_rpc=True,
+                                    num_schedulers=0))
+        other.start()
+        try:
+            code, o = run_cli(["server-join", "-address", addr,
+                               other.config.rpc_advertise])
+            assert code == 0 and "Joined 1 servers" in o
+
+            code, o = run_cli(["server-members", "-address", addr])
+            assert code == 0 and "joiner" in o
+
+            code, o = run_cli(["server-force-leave", "-address", addr,
+                               "joiner"])
+            assert code == 0
+        finally:
+            other.shutdown()

@@ -486,3 +486,32 @@ class TestGC:
             gc.mark_for_collection(self._terminal_runner(tmp_path, n))
         assert gc.collect_all() == 2
         assert gc.count() == 0
+
+
+class TestGitGetter:
+    def test_git_clone_artifact(self, tmp_path):
+        """go-getter git:: support (client/getter wraps go-getter)."""
+        import subprocess
+
+        from nomad_tpu.client.getter import get_artifact
+        from nomad_tpu.client.driver.env import TaskEnv
+        from nomad_tpu.structs import structs as s
+
+        src_repo = tmp_path / "srcrepo"
+        src_repo.mkdir()
+        subprocess.run(["git", "init", "-q", str(src_repo)], check=True)
+        (src_repo / "hello.txt").write_text("from git")
+        env = {"GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+               "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"}
+        import os as _os
+        subprocess.run(["git", "-C", str(src_repo), "add", "."], check=True)
+        subprocess.run(["git", "-C", str(src_repo), "commit", "-q", "-m", "x"],
+                       check=True, env={**_os.environ, **env})
+
+        task_dir = tmp_path / "task"
+        task_dir.mkdir()
+        art = s.TaskArtifact(getter_source=f"git::file://{src_repo}",
+                             relative_dest="local/")
+        dest = get_artifact(TaskEnv(), art, str(task_dir))
+        assert (pathlib_path := __import__("pathlib").Path(dest) / "hello.txt").exists()
+        assert pathlib_path.read_text() == "from git"

@@ -461,8 +461,10 @@ class TestBatchWorkerMixedStream:
             srv.node_register(make_node())
             job = make_job(2)
             _, eval_id = srv.job_register(job)
+            # generous: redelivery + a cold XLA compile under full-suite
+            # contention on a shared box
             assert wait_until(lambda: len(
-                srv.state.allocs_by_job(None, job.id, True)) == 2, 30.0)
+                srv.state.allocs_by_job(None, job.id, True)) == 2, 60.0)
             assert calls["n"] >= 2
             ev = srv.state.eval_by_id(None, eval_id)
             assert ev.status == s.EVAL_STATUS_COMPLETE
