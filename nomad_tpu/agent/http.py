@@ -688,7 +688,8 @@ class HTTPServer:
         node = query.get("node", "")
         if not node:
             raise CodedError(400, "missing node to force leave")
-        self.server.force_leave(node)
+        if not self.server.force_leave(node):
+            raise CodedError(404, f"unknown member {node!r}")
         return None, None
 
     def validate_job_request(self, req, query):

@@ -610,9 +610,8 @@ def cmd_server_force_leave(args, out) -> int:
 def cmd_keygen(args, out) -> int:
     """command/keygen.go: a random 32-byte base64 gossip key."""
     import base64
-    import os as _os
 
-    out.write(base64.b64encode(_os.urandom(32)).decode("ascii") + "\n")
+    out.write(base64.b64encode(os.urandom(32)).decode("ascii") + "\n")
     return 0
 
 
@@ -622,18 +621,18 @@ def cmd_keyring(args, out) -> int:
     mirror serf's keyring management; the wire encryption itself is a
     transport concern (the reference's serf encrypt option)."""
     import base64
-    import json as _json
-    import os as _os
 
-    path = _os.path.join(args.data_dir or ".", "keyring.json")
+    data_dir = args.data_dir or "."
+    path = os.path.join(data_dir, "keyring.json")
     ring = {"Keys": [], "Primary": ""}
-    if _os.path.exists(path):
+    if os.path.exists(path):
         with open(path) as fh:
-            ring = _json.load(fh)
+            ring = json.load(fh)
 
     def save():
+        os.makedirs(data_dir, exist_ok=True)
         with open(path, "w") as fh:
-            _json.dump(ring, fh, indent=2)
+            json.dump(ring, fh, indent=2)
 
     if args.list_keys:
         if not ring["Keys"]:

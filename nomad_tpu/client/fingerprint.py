@@ -241,6 +241,16 @@ class EnvAWSFingerprint(Fingerprint):
             sk.close()
         except OSError:
             return False
+        # GCE answers the same address: its replies carry
+        # Metadata-Flavor: Google — that is NOT an EC2 metadata service.
+        import urllib.request
+        try:
+            with urllib.request.urlopen("http://169.254.169.254/",
+                                        timeout=0.2) as resp:
+                if resp.headers.get("Metadata-Flavor") == "Google":
+                    return False
+        except OSError:
+            pass  # EC2 IMDSv2 may refuse the bare request; still AWS-ish
         node.attributes["platform.aws.probed"] = "1"
         return True
 

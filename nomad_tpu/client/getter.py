@@ -89,12 +89,21 @@ def _get_git(source: str, artifact: s.TaskArtifact, dest_dir: str) -> str:
     optional ``ref`` getter option selects a branch/tag/commit."""
     import subprocess
 
+    opts = artifact.getter_options or {}
+    if opts.get("checksum"):
+        # go-getter rejects checksums on directory gets; silently skipping
+        # a user-specified integrity check would be worse.
+        raise ArtifactError(
+            "checksum verification is not supported for git artifacts")
     url = source[len("git::"):] if source.startswith("git::") else source
     name = os.path.basename(urllib.parse.urlparse(url).path)
     if name.endswith(".git"):
         name = name[:-4]
     dest = os.path.join(dest_dir, name or "repo")
-    ref = (artifact.getter_options or {}).get("ref", "")
+    # Restart loops re-run artifact fetch; a stale clone must not fail it.
+    if os.path.isdir(dest):
+        shutil.rmtree(dest, ignore_errors=True)
+    ref = opts.get("ref", "")
     try:
         subprocess.run(["git", "clone", "--quiet", url, dest],
                        check=True, capture_output=True, timeout=300)

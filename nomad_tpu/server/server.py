@@ -225,7 +225,8 @@ class Server:
         return {"Name": self.config.node_name,
                 "Addr": self.config.rpc_advertise,
                 "Region": self.config.region,
-                "Status": "alive"}
+                "Status": "alive",
+                "StatusTime": 1}
 
     def members(self) -> List[Dict]:
         """(serf.Members / nomad/serf.go peer table)."""
@@ -253,15 +254,21 @@ class Server:
 
     def force_leave(self, name: str) -> bool:
         """Mark a member as left (serf.RemoveFailedNode /
-        agent_endpoint.go ForceLeave): it stops being a routing/forward
-        candidate; a same-region raft peer set is untouched (voter removal
-        is a config change, not a gossip eviction)."""
+        agent_endpoint.go ForceLeave) and gossip it: the record carries a
+        bumped StatusTime so peers' merges keep 'left' over stale 'alive'
+        views.  A same-region raft peer set is untouched (voter removal is
+        a config change, not a gossip eviction)."""
         changed = False
         with self._members_lock:
             for key, m in list(self._members.items()):
                 if m["Name"] == name:
                     m["Status"] = "left"
+                    m["StatusTime"] = int(m.get("StatusTime", 1)) + 1
                     changed = True
+            view = list(self._members.values())
+        if changed and self.pool is not None:
+            threading.Thread(target=self._push_members, args=(view,),
+                             daemon=True).start()
         return changed
 
     def membership_join(self, member: Dict) -> Dict:
@@ -284,9 +291,19 @@ class Server:
                 # members "name.region"); key by both so two regions'
                 # default-named servers cannot overwrite each other.
                 key = (name, m.get("Region", ""))
-                if key not in self._members:
+                old = self._members.get(key)
+                if old is None:
                     added.append(m)
-                self._members[key] = dict(m)
+                    self._members[key] = dict(m)
+                    continue
+                # Conflict resolution: the record with the newer
+                # StatusTime wins, so a gossiped 'left' is not
+                # resurrected by a peer's stale 'alive' view.
+                if int(m.get("StatusTime", 1)) >= \
+                        int(old.get("StatusTime", 1)):
+                    if m.get("Status") != old.get("Status"):
+                        added.append(m)  # status change gossips onward
+                    self._members[key] = dict(m)
             view = list(self._members.values())
         if not added:
             return
