@@ -132,12 +132,18 @@ class Agent:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
+        # Bind HTTP first: the client advertises its HTTP address on the
+        # node (structs Node.HTTPAddr) so peers can pull sticky-disk
+        # snapshots from it (client.go:1743 migrateRemoteAllocDir).
+        self.http = HTTPServer(self, host=self.config.bind_addr,
+                               port=self.config.ports.http)
+        if self.client is not None:
+            self.client.node.http_addr = (
+                f"{_advertisable(self.config.bind_addr)}:{self.http.port}")
         if self.server is not None:
             self.server.start()
         if self.client is not None:
             self.client.start()
-        self.http = HTTPServer(self, host=self.config.bind_addr,
-                               port=self.config.ports.http)
         self.http.start()
         self.consul_service_client.start()
         # Self-registration into the catalog (agent.go:492): servers

@@ -565,7 +565,8 @@ class HTTPServer:
         parts = rest.split("/", 1)
         op = parts[0]
         alloc_id = parts[1] if len(parts) > 1 else ""
-        if op not in ("ls", "stat", "cat", "readat", "logs", "stream"):
+        if op not in ("ls", "stat", "cat", "readat", "logs", "stream",
+                      "snapshot"):
             raise CodedError(404, "Invalid URL")
         if not alloc_id:
             raise CodedError(400, "Missing allocation ID")
@@ -600,6 +601,31 @@ class HTTPServer:
                     follow=query.get("follow", "").lower() == "true")
                 return StreamResponse(frames), None
             return self.client.task_logs(alloc_id, task, log_type), None
+        if op == "snapshot":
+            # Sticky-disk migration pull (alloc_dir.go:110 Snapshot via
+            # the fs surface), streamed as frames from a temp tar so
+            # multi-GB sticky disks never sit in memory.
+            import tempfile
+
+            fd, tmp = tempfile.mkstemp(suffix=".tar")
+            os_close = __import__("os").close
+            os_close(fd)
+            adir.snapshot_to_file(tmp)
+
+            def frames(path=tmp):
+                import os as _os
+
+                from ..client.fs_stream import stream_file_frames
+                try:
+                    yield from stream_file_frames(path, "snapshot.tar",
+                                                  follow=False)
+                finally:
+                    try:
+                        _os.unlink(path)
+                    except OSError:
+                        pass
+
+            return StreamResponse(frames()), None
         if op == "stream":
             frames = self.client.stream_file(
                 alloc_id, path,

@@ -78,6 +78,10 @@ class AllocRunner:
         self.done = threading.Event()
         self.waiting_on_previous = threading.Event()
         self.waiting_on_previous.set()
+        # Path to a sticky-disk tar pulled from a previous alloc on
+        # another node (client.go:1743); applied after the alloc dir is
+        # built, then deleted.
+        self.remote_snapshot_path = None
 
     # -- views -------------------------------------------------------------
     def current_alloc(self) -> s.Allocation:
@@ -194,6 +198,17 @@ class AllocRunner:
                                     [t.name for t in tg.tasks])
             except OSError as e:
                 self.logger.warning("sticky disk move failed: %s", e)
+        elif self.remote_snapshot_path:
+            import tarfile
+            try:
+                self.alloc_dir.restore_snapshot_file(self.remote_snapshot_path)
+            except (OSError, tarfile.TarError) as e:
+                self.logger.warning("remote sticky restore failed: %s", e)
+            try:
+                os.unlink(self.remote_snapshot_path)
+            except OSError:
+                pass
+            self.remote_snapshot_path = None
 
         for task in tg.tasks:
             tr = TaskRunner(

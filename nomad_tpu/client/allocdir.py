@@ -107,15 +107,35 @@ class AllocDir:
                 tar.add(root, arcname=arc_root)
         return buf.getvalue()
 
+    def snapshot_to_file(self, path: str) -> None:
+        """Tar the sticky data straight to ``path`` — migration transfers
+        must not hold whole disks in memory (alloc_dir.go streams its
+        Snapshot too)."""
+        with tarfile.open(path, mode="w") as tar:
+            targets = [os.path.join(self.shared_dir, SHARED_DATA_DIR)]
+            targets += [td.local_dir for td in self.task_dirs.values()]
+            for root in targets:
+                if not os.path.isdir(root):
+                    continue
+                arc_root = os.path.relpath(root, self.alloc_dir)
+                tar.add(root, arcname=arc_root)
+
+    def restore_snapshot_file(self, path: str) -> None:
+        with tarfile.open(path, mode="r") as tar:
+            self._extract(tar)
+
     def restore_snapshot(self, data: bytes) -> None:
         with tarfile.open(fileobj=io.BytesIO(data), mode="r") as tar:
-            for member in tar.getmembers():
-                # refuse path escapes
-                target = os.path.join(self.alloc_dir, member.name)
-                if not os.path.realpath(target).startswith(
-                        os.path.realpath(self.alloc_dir) + os.sep):
-                    continue
-                tar.extract(member, self.alloc_dir, filter="data")
+            self._extract(tar)
+
+    def _extract(self, tar) -> None:
+        for member in tar.getmembers():
+            # refuse path escapes
+            target = os.path.join(self.alloc_dir, member.name)
+            if not os.path.realpath(target).startswith(
+                    os.path.realpath(self.alloc_dir) + os.sep):
+                continue
+            tar.extract(member, self.alloc_dir, filter="data")
 
     # -- log access (fs API) ----------------------------------------------
     def list_dir(self, rel: str) -> List[Dict]:

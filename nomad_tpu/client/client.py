@@ -272,12 +272,18 @@ class Client:
         """(client.go:1812 addAlloc) + sticky-disk chaining."""
         tg = alloc.job.lookup_task_group(alloc.task_group) if alloc.job else None
         prev_dir = None
+        remote_migrate = False
         if (alloc.previous_allocation and tg is not None
                 and tg.ephemeral_disk is not None and tg.ephemeral_disk.sticky):
             with self._alloc_lock:
                 prev = self.alloc_runners.get(alloc.previous_allocation)
             if prev is not None:
                 prev_dir = prev.alloc_dir
+            elif tg.ephemeral_disk.migrate:
+                # Previous alloc lives on another node: pull its sticky
+                # data over that node's HTTP fs surface once it is
+                # terminal (client.go:1743 migrateRemoteAllocDir).
+                remote_migrate = True
 
         self.garbage_collector.make_room_for(
             tg.ephemeral_disk.size_mb if tg and tg.ephemeral_disk else 0,
@@ -305,9 +311,83 @@ class Client:
                     target=lambda: (prev.done.wait(),
                                     runner.waiting_on_previous.set()),
                     daemon=True).start()
+            elif remote_migrate:
+                runner.waiting_on_previous.clear()
+                threading.Thread(
+                    target=self._migrate_remote_alloc_dir,
+                    args=(alloc.previous_allocation, runner),
+                    daemon=True).start()
         with self._alloc_lock:
             self.alloc_runners[alloc.id] = runner
         runner.run()
+
+    def _migrate_remote_alloc_dir(self, prev_alloc_id: str,
+                                  runner: AllocRunner) -> None:
+        """Pull the previous allocation's sticky data from its node's HTTP
+        fs surface once that alloc is terminal
+        (client.go:1743 migrateRemoteAllocDir).  Always releases the
+        runner's start gate — a failed migration starts fresh, it does
+        not wedge the replacement."""
+        import base64
+        import json as _json
+        import tempfile
+        import urllib.request
+
+        try:
+            deadline = time.time() + 300.0
+            prev = None
+            terminal = False
+            while time.time() < deadline and not self._shutdown.is_set():
+                prev = self.rpc.alloc_get(prev_alloc_id)
+                if prev is None or prev.terminal_status() \
+                        or prev.client_terminal_status():
+                    terminal = True
+                    break
+                time.sleep(0.5)
+            if prev is None:
+                return
+            if not terminal:
+                # The old alloc is still live: snapshotting a dir being
+                # written would migrate torn data.  Start fresh instead.
+                self.logger.warning(
+                    "migration: previous alloc %s still running after "
+                    "wait; starting without sticky data",
+                    prev_alloc_id[:8])
+                return
+            node = self.rpc.node_get(prev.node_id)
+            if node is None or not node.http_addr:
+                self.logger.warning(
+                    "migration: node %s has no HTTP address", prev.node_id)
+                return
+            url = (f"http://{node.http_addr}/v1/client/fs/snapshot/"
+                   f"{prev_alloc_id}")
+            # Stream the tar frames to a temp file: sticky disks can be
+            # GBs; neither side holds the whole archive in memory.
+            fd, tmp = tempfile.mkstemp(suffix=".tar")
+            size = 0
+            with os.fdopen(fd, "wb") as out, urllib.request.urlopen(
+                    url, timeout=300.0) as resp:
+                for line in resp:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    frame = _json.loads(line)
+                    if frame.get("Data"):
+                        chunk = base64.b64decode(frame["Data"])
+                        out.write(chunk)
+                        size += len(chunk)
+            if size:
+                runner.remote_snapshot_path = tmp
+                self.logger.info(
+                    "migration: pulled %d bytes of sticky data for %s",
+                    size, runner.alloc.id[:8])
+            else:
+                os.unlink(tmp)
+        except Exception as e:
+            self.logger.warning("migration from %s failed: %s",
+                                prev_alloc_id[:8], e)
+        finally:
+            runner.waiting_on_previous.set()
 
     def _remove_alloc(self, alloc_id: str, runner: AllocRunner) -> None:
         with self._alloc_lock:

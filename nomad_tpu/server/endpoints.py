@@ -122,6 +122,16 @@ def register_endpoints(server, rpc) -> None:
                                            body.get("Tasks") or [])
         return {"Tasks": tokens}
 
+    def node_get(body):
+        node = server.node_get(body["NodeID"])
+        return {"Node": to_wire(node) if node is not None else None}
+
+    def alloc_get(body):
+        alloc = server.alloc_get(body["AllocID"])
+        return {"Alloc": to_wire(alloc) if alloc is not None else None}
+
+    register("Node.Get", node_get)
+    register("Alloc.Get", alloc_get)
     register("Node.Evaluate", node_evaluate)
     register("Node.DeriveVaultToken", node_derive_vault_token)
     register("Node.Register", node_register)

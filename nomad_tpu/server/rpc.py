@@ -354,6 +354,18 @@ class RemoteServerRPC:
             {"Allocs": [self._to_wire(a) for a in allocs]})
         return reply["Index"]
 
+    def node_get(self, node_id: str):
+        from ..structs import structs as s
+        reply = self._call("Node.Get", {"NodeID": node_id})
+        data = reply.get("Node")
+        return self._from_wire(s.Node, data) if data else None
+
+    def alloc_get(self, alloc_id: str):
+        from ..structs import structs as s
+        reply = self._call("Alloc.Get", {"AllocID": alloc_id})
+        data = reply.get("Alloc")
+        return self._from_wire(s.Allocation, data) if data else None
+
     def derive_vault_token(self, alloc_id: str, task_names):
         reply = self._call("Node.DeriveVaultToken",
                            {"AllocID": alloc_id, "Tasks": list(task_names)})

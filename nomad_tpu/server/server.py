@@ -105,6 +105,9 @@ class Server:
         self.pool = None
         self._members: Dict[str, Dict] = {}
         self._members_lock = threading.Lock()
+        # Incarnation for this server's own member record (serf's
+        # refutation counter): bumped past any gossiped 'left' about us.
+        self._status_time = 1
         # Per-thread marker set while serving a request that was already
         # forwarded once (endpoints.py); blocks a second hop.
         self._fwd_ctx = threading.local()
@@ -226,7 +229,7 @@ class Server:
                 "Addr": self.config.rpc_advertise,
                 "Region": self.config.region,
                 "Status": "alive",
-                "StatusTime": 1}
+                "StatusTime": self._status_time}
 
     def members(self) -> List[Dict]:
         """(serf.Members / nomad/serf.go peer table)."""
@@ -295,6 +298,18 @@ class Server:
                 if old is None:
                     added.append(m)
                     self._members[key] = dict(m)
+                    continue
+                # Refutation (serf alive/suspect semantics): a 'left'
+                # about OURSELVES while we are alive gets out-bid by
+                # bumping our incarnation past it and re-gossiping.
+                if (name == self.config.node_name
+                        and m.get("Region", "") == self.config.region
+                        and m.get("Status") != "alive"
+                        and int(m.get("StatusTime", 1)) >= self._status_time):
+                    self._status_time = int(m.get("StatusTime", 1)) + 1
+                    refreshed = self._self_member()
+                    self._members[key] = refreshed
+                    added.append(refreshed)  # gossip the refutation
                     continue
                 # Conflict resolution: the record with the newer
                 # StatusTime wins, so a gossiped 'left' is not

@@ -321,3 +321,38 @@ class TestClientOverTCP:
             if client is not None:
                 client.shutdown()
             srv.shutdown()
+
+
+class TestForceLeaveRejoin:
+    def test_force_left_server_can_rejoin(self):
+        """serf refutation: a force-left server that is actually alive
+        out-bids the 'left' record with a higher incarnation on rejoin."""
+        a = Server(ServerConfig(node_name="srv-a", enable_rpc=True,
+                                num_schedulers=0))
+        b = Server(ServerConfig(node_name="srv-b", enable_rpc=True,
+                                num_schedulers=0))
+        a.start()
+        b.start()
+        try:
+            assert a.join([b.config.rpc_advertise]) == 1
+            assert wait_until(lambda: len(a.members()) == 2
+                              and len(b.members()) == 2)
+            assert a.force_leave("srv-b")
+            assert wait_until(lambda: any(
+                m["Name"] == "srv-b" and m["Status"] == "left"
+                for m in a.members()))
+            # b rejoins: its refutation must flip the record back to alive
+            # on BOTH sides.
+            assert b.join([a.config.rpc_advertise]) == 1
+
+            def alive_everywhere():
+                return all(any(m["Name"] == "srv-b"
+                               and m["Status"] == "alive"
+                               for m in srv.members())
+                           for srv in (a, b))
+
+            assert wait_until(alive_everywhere, 10.0), (
+                a.members(), b.members())
+        finally:
+            b.shutdown()
+            a.shutdown()

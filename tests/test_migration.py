@@ -1,0 +1,114 @@
+"""Cross-node sticky-disk migration (reference: client/client.go:1743
+migrateRemoteAllocDir): a replacement allocation on another node pulls the
+previous allocation's sticky data over the old node's HTTP fs surface."""
+import os
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.agent.agent import Agent
+from nomad_tpu.agent.config import AgentConfig
+from nomad_tpu.structs import structs as s
+
+
+def wait_until(pred, timeout=30.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    scfg = AgentConfig()
+    scfg.name = "mig-server"
+    scfg.server.enabled = True
+    scfg.ports.http = 0
+    scfg.ports.rpc = 0
+    server_agent = Agent(scfg)
+    server_agent.start()
+    rpc_addr = server_agent.server.config.rpc_advertise
+
+    clients = []
+    for i in (1, 2):
+        ccfg = AgentConfig()
+        ccfg.name = f"mig-client-{i}"
+        ccfg.client.enabled = True
+        ccfg.client.state_dir = str(tmp_path / f"c{i}-state")
+        ccfg.client.alloc_dir = str(tmp_path / f"c{i}-allocs")
+        ccfg.client.servers = [rpc_addr]
+        ccfg.ports.http = 0
+        a = Agent(ccfg)
+        a.start()
+        clients.append(a)
+    yield server_agent, clients
+    for a in clients:
+        a.shutdown()
+    server_agent.shutdown()
+
+
+class TestRemoteMigration:
+    def test_sticky_data_follows_alloc_across_nodes(self, cluster):
+        server_agent, clients = cluster
+        srv = server_agent.server
+        assert wait_until(lambda: sum(
+            1 for n in srv.state.nodes(None)
+            if n.status == s.NODE_STATUS_READY) == 2, 40.0), \
+            "clients never became ready"
+
+        job = mock.job()
+        job.id = job.name = "sticky-job"
+        tg = job.task_groups[0]
+        tg.count = 1
+        tg.ephemeral_disk = s.EphemeralDisk(sticky=True, migrate=True,
+                                            size_mb=50)
+        tg.restart_policy = s.RestartPolicy(attempts=0, mode="fail")
+        for t in tg.tasks:
+            t.driver = "mock_driver"
+            t.config = {"run_for": "120s"}
+            t.resources.networks = []
+            t.services = []
+        srv.job_register(job)
+        assert wait_until(lambda: any(
+            a.client_status == s.ALLOC_CLIENT_STATUS_RUNNING
+            for a in srv.job_allocations(job.id)))
+        alloc1 = srv.job_allocations(job.id)[0]
+        src_client = next(c for c in clients
+                          if c.client.node.id == alloc1.node_id)
+        dst_client = next(c for c in clients if c is not src_client)
+
+        # The task writes state into its sticky local dir.
+        runner1 = src_client.client.get_alloc_runner(alloc1.id)
+        local_dir = runner1.alloc_dir.task_dirs["web"].local_dir
+        with open(os.path.join(local_dir, "state.db"), "w") as fh:
+            fh.write("precious sticky state")
+
+        # Drain the node: the replacement lands on the other node with
+        # previous_allocation set (migrate path, util.go evictAndPlace).
+        srv.node_update_drain(alloc1.node_id, True)
+        assert wait_until(lambda: any(
+            a.id != alloc1.id and a.node_id == dst_client.client.node.id
+            and a.previous_allocation == alloc1.id
+            for a in srv.job_allocations(job.id)), 30.0), \
+            "replacement with previous_allocation never appeared"
+        alloc2 = next(a for a in srv.job_allocations(job.id)
+                      if a.id != alloc1.id)
+
+        # The new node's alloc dir receives the migrated sticky data.
+        def migrated():
+            runner2 = dst_client.client.get_alloc_runner(alloc2.id)
+            if runner2 is None:
+                return False
+            path = os.path.join(runner2.alloc_dir.task_dirs["web"].local_dir,
+                                "state.db")
+            return os.path.exists(path) and \
+                open(path).read() == "precious sticky state"
+
+        assert wait_until(migrated, 40.0), "sticky data never migrated"
+        assert wait_until(lambda: any(
+            a.id == alloc2.id
+            and a.client_status == s.ALLOC_CLIENT_STATUS_RUNNING
+            for a in srv.job_allocations(job.id)), 30.0)
