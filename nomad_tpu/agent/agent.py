@@ -33,6 +33,20 @@ class Agent:
                  vault_api=None):
         self.config = config or AgentConfig.dev()
         self.logger = logger or logging.getLogger("nomad_tpu.agent")
+        # Log ring for /v1/agent/monitor (command/agent/log_writer.go):
+        # retains recent lines and fans out to attached monitors.  NOTE:
+        # agents sharing one logger in one process (tests) share the
+        # stream, like processes sharing stderr; shutdown detaches the
+        # handler and restores the level.
+        from ..utils.logring import LogRingHandler
+
+        self.log_ring = LogRingHandler()
+        self.log_ring.setLevel(getattr(logging, self.config.log_level.upper(),
+                                       logging.INFO))
+        self._prev_log_level = self.logger.level
+        self.logger.addHandler(self.log_ring)
+        self.logger.setLevel(min(self.logger.level or logging.INFO,
+                                 self.log_ring.level) or logging.INFO)
         self.server: Optional[Server] = None
         self.client: Optional[Client] = None
         self.http: Optional[HTTPServer] = None
@@ -160,6 +174,8 @@ class Agent:
         self.logger.info("agent: started (http=%s)", self.http.address)
 
     def shutdown(self) -> None:
+        self.logger.removeHandler(self.log_ring)
+        self.logger.setLevel(self._prev_log_level)
         self.consul_service_client.stop()
         if self.http is not None:
             self.http.shutdown()

@@ -14,6 +14,7 @@ nomad/rpc.go:340 blockingRPC), replying with ``X-Nomad-Index``.
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
 import time
@@ -100,6 +101,7 @@ class HTTPServer:
         r("/v1/client/fs/(?P<rest>.*)", self.client_fs_request)
         r("/v1/client/gc", self.client_gc_request)
         r("/v1/agent/self", self.agent_self_request)
+        r("/v1/agent/monitor", self.agent_monitor_request)
         r("/v1/agent/members", self.agent_members_request)
         r("/v1/agent/servers", self.agent_servers_request)
         r("/v1/agent/join", self.agent_join_request)
@@ -608,20 +610,24 @@ class HTTPServer:
             import tempfile
 
             fd, tmp = tempfile.mkstemp(suffix=".tar")
-            os_close = __import__("os").close
-            os_close(fd)
-            adir.snapshot_to_file(tmp)
+            os.close(fd)
+            try:
+                adir.snapshot_to_file(tmp)
+            except Exception:
+                try:
+                    os.unlink(tmp)  # failed tar must not leak
+                except OSError:
+                    pass
+                raise
 
             def frames(path=tmp):
-                import os as _os
-
                 from ..client.fs_stream import stream_file_frames
                 try:
                     yield from stream_file_frames(path, "snapshot.tar",
                                                   follow=False)
                 finally:
                     try:
-                        _os.unlink(path)
+                        os.unlink(path)
                     except OSError:
                         pass
 
@@ -646,6 +652,19 @@ class HTTPServer:
     # endpoint the reference gets from the real Consul HTTP API).
     def catalog_services_request(self, req, query):
         return self.agent.catalog.services(), None
+
+    def agent_monitor_request(self, req, query):
+        """Stream the agent's log ring + live lines
+        (command/agent/log_*.go monitor surface)."""
+        ring = getattr(self.agent, "log_ring", None)
+        if ring is None:
+            raise CodedError(404, "log monitoring unavailable")
+
+        def frames():
+            for line in ring.monitor():
+                yield {"Data": (line + "\n").encode()}
+
+        return StreamResponse(frames()), None
 
     def metrics_request(self, req, query):
         """In-memory telemetry aggregates (the reference's go-metrics

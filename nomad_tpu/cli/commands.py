@@ -678,6 +678,31 @@ def cmd_keyring(args, out) -> int:
     return 1
 
 
+def cmd_monitor(args, out) -> int:
+    """command/monitor-style agent log streaming (agent monitor)."""
+    api = _api(args)
+    try:
+        frames = api.agent._stream("/v1/agent/monitor", {}, follow=True)
+        return _drain_frames(frames, out)
+    except APIError as e:
+        out.write(f"Error monitoring agent: {e}\n")
+        return 1
+
+
+def cmd_check(args, out) -> int:
+    """command/check.go: agent health probe — exit 0 healthy, 1 not."""
+    api = _api(args)
+    try:
+        info = api.agent.self_info()
+    except APIError as e:
+        out.write(f"unhealthy: {e}\n")
+        return 1
+    stats = info.get("stats", {})
+    server_ok = "nomad" not in stats or         stats["nomad"].get("leader") in ("True", "true", True) or         stats["nomad"].get("applied_index") is not None
+    out.write("ok\n" if server_ok else "unhealthy: no server state\n")
+    return 0 if server_ok else 1
+
+
 def cmd_server_members(args, out) -> int:
     """command/server_members.go."""
     api = _api(args)
@@ -909,6 +934,8 @@ def build_parser() -> argparse.ArgumentParser:
     add("server-force-leave", cmd_server_force_leave, lambda sp:
         sp.add_argument("node"))
     add("keygen", cmd_keygen)
+    add("agent-monitor", cmd_monitor)
+    add("check", cmd_check)
     add("keyring", cmd_keyring, lambda sp: (
         sp.add_argument("-data-dir", dest="data_dir", default="."),
         sp.add_argument("-install", default=""),

@@ -365,17 +365,24 @@ class Client:
             # GBs; neither side holds the whole archive in memory.
             fd, tmp = tempfile.mkstemp(suffix=".tar")
             size = 0
-            with os.fdopen(fd, "wb") as out, urllib.request.urlopen(
-                    url, timeout=300.0) as resp:
-                for line in resp:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    frame = _json.loads(line)
-                    if frame.get("Data"):
-                        chunk = base64.b64decode(frame["Data"])
-                        out.write(chunk)
-                        size += len(chunk)
+            try:
+                with os.fdopen(fd, "wb") as out, urllib.request.urlopen(
+                        url, timeout=300.0) as resp:
+                    for line in resp:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        frame = _json.loads(line)
+                        if frame.get("Data"):
+                            chunk = base64.b64decode(frame["Data"])
+                            out.write(chunk)
+                            size += len(chunk)
+            except Exception:
+                try:
+                    os.unlink(tmp)  # never leak a partial multi-GB tar
+                except OSError:
+                    pass
+                raise
             if size:
                 runner.remote_snapshot_path = tmp
                 self.logger.info(

@@ -322,3 +322,46 @@ client {
         assert cfg.region == "ap"
         assert cfg.ports.http == 7777
         assert cfg.server.enabled is True
+
+
+class TestAgentMonitor:
+    def test_monitor_streams_backlog_and_live_lines(self, agent):
+        import json
+        import threading
+        import urllib.request
+
+        agent.logger.info("before-monitor marker")
+        lines = []
+        lock = threading.Lock()
+
+        def run():
+            try:
+                with urllib.request.urlopen(
+                        agent.http.address + "/v1/agent/monitor",
+                        timeout=30) as resp:
+                    for raw in resp:
+                        frame = json.loads(raw)
+                        if frame.get("Data"):
+                            import base64
+                            with lock:
+                                lines.append(
+                                    base64.b64decode(frame["Data"]).decode())
+            except Exception:
+                pass
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+
+        def text():
+            with lock:
+                return "".join(lines)
+
+        deadline = time.time() + 10
+        while time.time() < deadline and "before-monitor" not in text():
+            time.sleep(0.05)
+        assert "before-monitor marker" in text(), "backlog line not streamed"
+        agent.logger.info("after-monitor marker")
+        deadline = time.time() + 10
+        while time.time() < deadline and "after-monitor" not in text():
+            time.sleep(0.05)
+        assert "after-monitor marker" in text(), "live line not streamed"
