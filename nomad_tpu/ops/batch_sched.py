@@ -371,7 +371,8 @@ class TPUBatchScheduler:
                                   dtype=bool),
                 "dc": np.array([n.datacenter for n in all_nodes],
                                dtype=object),
-                "user_class": None,
+                "class_codes": None,
+                "class_names": None,
             }
             eval_count_cache: Dict[Tuple[str, ...], int] = {}
 
@@ -493,8 +494,9 @@ class TPUBatchScheduler:
             capacity_exh = exh_mask & any_over
             n_cap_exh = int(capacity_exh.sum())
             if n_cap_exh:
-                # Counters + per-dimension tallies in bulk (bincount), the
-                # per-class tally only when user classes exist.
+                # Counters + per-dimension and per-class tallies in bulk:
+                # classes are interned to int codes once per batch so the
+                # per-spec tally is a bincount, not an object-array sort.
                 m.nodes_exhausted += n_cap_exh
                 dims = np.bincount(first_dim[capacity_exh], minlength=4)
                 for di, cnt in enumerate(dims):
@@ -502,15 +504,29 @@ class TPUBatchScheduler:
                         m.dimension_exhausted[dim_names[di]] = (
                             m.dimension_exhausted.get(dim_names[di], 0)
                             + int(cnt))
-                if node_facts.get("user_class") is None:
-                    node_facts["user_class"] = np.array(
-                        [n.node_class or "" for n in nodes], dtype=object)
-                classes = node_facts["user_class"][:n_real][capacity_exh]
-                uniq, counts = np.unique(classes, return_counts=True)
-                for cls, cnt in zip(uniq, counts):
-                    if cls:
-                        m.class_exhausted[cls] = (
-                            m.class_exhausted.get(cls, 0) + int(cnt))
+                if node_facts.get("class_codes") is None:
+                    names: List[str] = []
+                    index: Dict[str, int] = {}
+                    codes = np.empty(len(nodes), dtype=np.int32)
+                    for i2, n2 in enumerate(nodes):
+                        cls = n2.node_class or ""
+                        code = index.get(cls)
+                        if code is None:
+                            code = index[cls] = len(names)
+                            names.append(cls)
+                        codes[i2] = code
+                    node_facts["class_codes"] = codes
+                    node_facts["class_names"] = names
+                codes = node_facts["class_codes"][:n_real]
+                names = node_facts["class_names"]
+                if len(names) > 1 or names[0]:
+                    counts = np.bincount(codes[capacity_exh],
+                                         minlength=len(names))
+                    for code, cnt in enumerate(counts):
+                        if cnt and names[code]:
+                            m.class_exhausted[names[code]] = (
+                                m.class_exhausted.get(names[code], 0)
+                                + int(cnt))
             # The rarer non-capacity blocks keep per-node attribution.
             rest = np.nonzero(exh_mask & ~any_over)[0]
             for i in rest:
