@@ -150,9 +150,18 @@ class Driver:
         return None
 
     # -- metadata ----------------------------------------------------------
+    # Field schema for this driver's task config (helper/fields role);
+    # subclasses declare {field: FieldSchema} and inherit validation.
+    CONFIG_FIELDS: Dict = {}
+
     def validate(self, config: Dict) -> None:
-        """Raise ValueError on bad task driver config (driver.go:230)."""
-        return None
+        """Raise ValueError on bad task driver config (driver.go:230 via
+        helper/fields FieldData.Validate)."""
+        from .fields import validate_fields
+
+        problems = validate_fields(config, self.CONFIG_FIELDS)
+        if problems:
+            raise ValueError("; ".join(problems))
 
     def abilities(self) -> DriverAbilities:
         return DriverAbilities()

@@ -17,6 +17,7 @@ import threading
 from typing import Dict, List, Optional
 
 from ...structs import structs as s
+from .fields import FieldSchema
 from .driver import (
     Driver,
     DriverAbilities,
@@ -94,11 +95,10 @@ class _ExecFamilyDriver(Driver):
         env = exec_ctx.task_env
         return env.replace_env(command), env.parse_and_replace(args)
 
-    def validate(self, config) -> None:
-        if not isinstance(config, dict):
-            raise ValueError("driver config must be a map")
-        if not config.get("command"):
-            raise ValueError("missing 'command'")
+    CONFIG_FIELDS = {
+        "command": FieldSchema("string", required=True),
+        "args": FieldSchema("list"),
+    }
 
     def start(self, exec_ctx: ExecContext, task: s.Task) -> StartResponse:
         cmd, args = self.command_line(exec_ctx, task)
@@ -172,10 +172,17 @@ class JavaDriver(_ExecFamilyDriver):
     name = "java"
     enforce_memory = True
 
+    CONFIG_FIELDS = {
+        "jar_path": FieldSchema("string"),
+        "class": FieldSchema("string"),
+        "class_path": FieldSchema("string"),
+        "jvm_options": FieldSchema("list"),
+        "args": FieldSchema("list"),
+    }
+
     def validate(self, config) -> None:
-        if not isinstance(config, dict):
-            raise ValueError("driver config must be a map")
-        if not config.get("jar_path") and not config.get("class"):
+        super().validate(config)
+        if not (config or {}).get("jar_path") and not (config or {}).get("class"):
             raise ValueError("missing 'jar_path' or 'class'")
 
     def command_line(self, exec_ctx: ExecContext, task: s.Task):
@@ -219,11 +226,12 @@ class QemuDriver(_ExecFamilyDriver):
     name = "qemu"
     isolation = "image"
 
-    def validate(self, config) -> None:
-        if not isinstance(config, dict):
-            raise ValueError("driver config must be a map")
-        if not config.get("image_path"):
-            raise ValueError("missing 'image_path'")
+    CONFIG_FIELDS = {
+        "image_path": FieldSchema("string", required=True),
+        "accelerator": FieldSchema("string"),
+        "args": FieldSchema("list"),
+        "port_map": FieldSchema("map"),
+    }
 
     def command_line(self, exec_ctx: ExecContext, task: s.Task):
         cfg = task.config or {}
@@ -257,11 +265,14 @@ class DockerDriver(_ExecFamilyDriver):
     name = "docker"
     isolation = "image"
 
-    def validate(self, config) -> None:
-        if not isinstance(config, dict):
-            raise ValueError("driver config must be a map")
-        if not config.get("image"):
-            raise ValueError("missing 'image'")
+    CONFIG_FIELDS = {
+        "image": FieldSchema("string", required=True),
+        "command": FieldSchema("string"),
+        "args": FieldSchema("list"),
+        "port_map": FieldSchema("map"),
+        "network_mode": FieldSchema("string"),
+        "labels": FieldSchema("map"),
+    }
 
     def command_line(self, exec_ctx: ExecContext, task: s.Task):
         cfg = task.config or {}

@@ -17,6 +17,7 @@ import threading
 import time
 
 from ...structs import structs as s
+from .fields import FieldSchema
 from .driver import (
     Driver,
     DriverAbilities,
@@ -123,8 +124,19 @@ class MockDriver(Driver):
                              kill_after=0)
         return h
 
-    def validate(self, config) -> None:
-        return None
+    # Weakly typed like the driver's own start-time casts (parse_duration
+    # passes numbers through; exit codes cast digit strings).
+    CONFIG_FIELDS = {
+        "run_for": FieldSchema("duration"),
+        "start_error": FieldSchema("string"),
+        "start_error_recoverable": FieldSchema("boollike"),
+        "exit_code": FieldSchema("intlike"),
+        "exit_signal": FieldSchema("intlike"),
+        "exit_err_msg": FieldSchema("string"),
+        "signal_error": FieldSchema("string"),
+        "stdout_string": FieldSchema("string"),
+        "kill_after": FieldSchema("duration"),
+    }
 
     def fingerprint(self, node: s.Node) -> bool:
         node.attributes["driver.mock_driver"] = "1"

@@ -296,8 +296,21 @@ class TaskRunner:
                 return
 
             # -- start ----------------------------------------------------
+            # Config validation is TERMINAL: an invalid config can never
+            # succeed, so it must not burn restart attempts
+            # (the reference fails Validate once, before the run loop).
             try:
                 driver = self._create_driver(task_env)
+                driver.validate(self.task.config or {})
+            except ValueError as e:
+                self._emit(s.TASK_STATE_DEAD,
+                           s.TaskEvent(type=s.TASK_DRIVER_FAILURE,
+                                       failed=True,
+                                       message=f"driver config "
+                                               f"validation failed: {e}"))
+                return
+
+            try:
                 exec_ctx = ExecContext(task_dir=self.task_dir, task_env=task_env)
                 driver.prestart(exec_ctx, self.task)
                 resp: StartResponse = driver.start(exec_ctx, self.task)

@@ -515,3 +515,88 @@ class TestGitGetter:
         dest = get_artifact(TaskEnv(), art, str(task_dir))
         assert (pathlib_path := __import__("pathlib").Path(dest) / "hello.txt").exists()
         assert pathlib_path.read_text() == "from git"
+
+
+class TestDriverFieldSchemas:
+    """helper/fields FieldData.Validate role: typed driver-config
+    validation through the shared schema."""
+
+    def test_schema_validation(self):
+        from nomad_tpu.client.driver.fields import FieldSchema, validate_fields
+
+        schema = {"command": FieldSchema("string", required=True),
+                  "args": FieldSchema("list"),
+                  "count": FieldSchema("int"),
+                  "verbose": FieldSchema("bool")}
+        assert validate_fields({"command": "/bin/x"}, schema) == []
+        assert "missing required field 'command'" in \
+            validate_fields({}, schema)[0]
+        probs = validate_fields({"command": 5, "args": "no",
+                                 "count": "x"}, schema)
+        assert len(probs) == 3
+        assert validate_fields({"command": "x", "bogus": 1}, schema,
+                               strict=True) != []
+
+    def test_driver_validates_config(self):
+        from nomad_tpu.client.driver.driver import validate_driver_config
+        import pytest as _pytest
+
+        validate_driver_config("exec", {"command": "/bin/true"})
+        with _pytest.raises(ValueError):
+            validate_driver_config("exec", {})
+        with _pytest.raises(ValueError):
+            validate_driver_config("exec", {"command": 123})
+        with _pytest.raises(ValueError):
+            validate_driver_config("qemu", {})
+        validate_driver_config("java", {"jar_path": "a.jar"})
+        with _pytest.raises(ValueError):
+            validate_driver_config("java", {})
+
+    def test_invalid_config_fails_task_cleanly(self, tmp_path):
+        """An invalid driver config must surface as a driver failure
+        event, not a crash."""
+        import time
+
+        from nomad_tpu import mock
+        from nomad_tpu.client import Client, ClientConfig
+        from nomad_tpu.server import Server, ServerConfig
+        from nomad_tpu.structs import structs as s
+
+        srv = Server(ServerConfig(num_schedulers=1))
+        srv.start()
+        client = None
+        try:
+            client = Client(ClientConfig(
+                alloc_dir=str(tmp_path / "allocs")), rpc=srv)
+            client.start()
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                n = srv.node_get(client.node.id)
+                if n is not None and n.status == "ready":
+                    break
+                time.sleep(0.05)
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.restart_policy = s.RestartPolicy(attempts=0, mode="fail")
+            for t in tg.tasks:
+                t.driver = "mock_driver"
+                t.config = {"exit_code": "not-an-int"}  # schema violation
+                t.resources.networks = []
+                t.services = []
+            srv.job_register(job)
+            deadline = time.time() + 20
+            failed = False
+            while time.time() < deadline and not failed:
+                for a in srv.job_allocations(job.id):
+                    st = (a.task_states or {}).get("web")
+                    if st and any("exit_code" in (e.message or "")
+                                  and "int" in (e.message or "")
+                                  for e in st.events):
+                        failed = True
+                time.sleep(0.1)
+            assert failed, "schema violation never surfaced in task events"
+        finally:
+            if client is not None:
+                client.shutdown()
+            srv.shutdown()
