@@ -90,6 +90,7 @@ class Agent:
             num_schedulers=sb.num_schedulers,
             use_tpu_batch_worker=sb.use_tpu_batch_worker,
             batch_size=sb.batch_size)
+        scfg.tls = self.config.tls.to_tls_config()
         if sb.enabled_schedulers:
             scfg.enabled_schedulers = list(sb.enabled_schedulers) + ["_core"]
         if self.config.vault.enabled:
@@ -135,9 +136,15 @@ class Agent:
         # fast path mirrors agent-embedded client behavior).
         rpc = self.server
         if rpc is None:
-            from ..server.rpc import RemoteServerRPC
+            from ..server.rpc import ConnPool, RemoteServerRPC
 
-            rpc = RemoteServerRPC(cb.servers)
+            pool = None
+            tls_cfg = self.config.tls.to_tls_config()
+            if tls_cfg is not None:
+                from ..utils.tlsutil import client_context
+
+                pool = ConnPool(tls_context=client_context(tls_cfg))
+            rpc = RemoteServerRPC(cb.servers, pool=pool)
         self.client = Client(ccfg, rpc=rpc,
                              logger=self.logger.getChild("client"),
                              vault_api=self._vault_api,

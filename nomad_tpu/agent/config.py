@@ -53,6 +53,31 @@ class ClientBlock:
 
 
 @dataclass
+class TLSBlock:
+    """(reference: helper/tlsutil via the agent tls{} block)."""
+
+    rpc: bool = False
+    ca_file: str = ""
+    cert_file: str = ""
+    key_file: str = ""
+    # Verify the server cert's hostname/SAN on dial (requires certs with
+    # address SANs; default is cluster-CA pinning like the reference's
+    # verify_server_hostname=false).
+    verify_server_hostname: bool = False
+
+    def to_tls_config(self):
+        """→ tlsutil.TLSConfig, or None when TLS is off — the ONE place
+        agent TLS settings become a config object."""
+        if not self.rpc:
+            return None
+        from ..utils.tlsutil import TLSConfig
+
+        return TLSConfig(enabled=True, ca_file=self.ca_file,
+                         cert_file=self.cert_file, key_file=self.key_file,
+                         verify_server_hostname=self.verify_server_hostname)
+
+
+@dataclass
 class VaultBlock:
     """(reference: nomad/structs/config/vault.go via the agent vault{}
     block)."""
@@ -76,6 +101,7 @@ class AgentConfig:
     server: ServerBlock = field(default_factory=ServerBlock)
     client: ClientBlock = field(default_factory=ClientBlock)
     vault: VaultBlock = field(default_factory=VaultBlock)
+    tls: TLSBlock = field(default_factory=TLSBlock)
     dev_mode: bool = False
 
     @staticmethod
@@ -162,6 +188,16 @@ def parse_config(src: str) -> AgentConfig:
         cfg.client.cpu_total_compute = int(_scalar(cb, "cpu_total_compute", 0))
         cfg.client.gc_max_allocs = int(_scalar(cb, "gc_max_allocs", 50))
         cfg.client.consul_address = str(_scalar(cb, "consul_address", ""))
+
+    te = root.one("tls")
+    if te is not None and isinstance(te.value, Block):
+        tb = te.value
+        cfg.tls.rpc = bool(_scalar(tb, "rpc", False))
+        cfg.tls.ca_file = str(_scalar(tb, "ca_file", ""))
+        cfg.tls.cert_file = str(_scalar(tb, "cert_file", ""))
+        cfg.tls.key_file = str(_scalar(tb, "key_file", ""))
+        cfg.tls.verify_server_hostname = bool(
+            _scalar(tb, "verify_server_hostname", False))
 
     ve = root.one("vault")
     if ve is not None and isinstance(ve.value, Block):

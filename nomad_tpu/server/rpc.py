@@ -90,10 +90,12 @@ class RPCServer:
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 logger: Optional[logging.Logger] = None):
+                 logger: Optional[logging.Logger] = None,
+                 tls_context=None):
         self.logger = logger or logging.getLogger("nomad_tpu.rpc")
         self.methods: Dict[str, Callable[[Any], Any]] = {}
         self.raft_handler: Optional[Callable[[Any], Any]] = None
+        self.tls_context = tls_context
         outer = self
 
         self._active: set = set()
@@ -102,8 +104,30 @@ class RPCServer:
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 sock = self.request
+                # Track the RAW socket first so shutdown() can sever a
+                # connection stuck mid-handshake; bound the handshake so a
+                # silent peer cannot pin this thread forever.
                 with outer._active_lock:
                     outer._active.add(sock)
+                if outer.tls_context is not None:
+                    # mTLS: every connection handshakes before the
+                    # protocol byte (helper/tlsutil wraps the whole
+                    # stream; rpcTLS demux byte in the reference).
+                    try:
+                        sock.settimeout(10.0)
+                        tls_sock = outer.tls_context.wrap_socket(
+                            sock, server_side=True)
+                        tls_sock.settimeout(None)
+                    except OSError as e:
+                        outer.logger.warning("rpc: TLS handshake failed: %s",
+                                             e)
+                        with outer._active_lock:
+                            outer._active.discard(sock)
+                        return
+                    with outer._active_lock:
+                        outer._active.discard(sock)
+                        outer._active.add(tls_sock)
+                    sock = tls_sock
                 try:
                     try:
                         prefix = _recv_exact(sock, 1)[0]
@@ -119,6 +143,7 @@ class RPCServer:
                 finally:
                     with outer._active_lock:
                         outer._active.discard(sock)
+                        outer._active.discard(self.request)
 
         class Server(socketserver.ThreadingTCPServer):
             daemon_threads = True
@@ -208,10 +233,14 @@ class RPCServer:
 
 
 class _Conn:
-    def __init__(self, addr: str, channel: int, timeout: float):
+    def __init__(self, addr: str, channel: int, timeout: float,
+                 tls_context=None):
         host, port = addr.rsplit(":", 1)
         self.sock = socket.create_connection((host, int(port)),
                                              timeout=timeout)
+        if tls_context is not None:
+            self.sock = tls_context.wrap_socket(self.sock,
+                                                server_hostname=host)
         self.sock.sendall(bytes([channel]))
         self.seq = 0
         self.lock = threading.Lock()
@@ -250,8 +279,9 @@ class ConnPool:
 
     MAX_IDLE_PER_KEY = 4
 
-    def __init__(self, timeout: float = 10.0):
+    def __init__(self, timeout: float = 10.0, tls_context=None):
         self.timeout = timeout
+        self.tls_context = tls_context
         self._idle: Dict[Tuple[str, int], List[_Conn]] = {}
         self._lock = threading.Lock()
 
@@ -264,8 +294,9 @@ class ConnPool:
             conn = bucket.pop() if bucket else None
         if conn is None:
             try:
-                conn = _Conn(addr, channel, timeout)
-            except OSError as e:
+                conn = _Conn(addr, channel, timeout,
+                             tls_context=self.tls_context)
+            except OSError as e:  # includes ssl.SSLError
                 raise DialError(f"rpc to {addr} failed: {e}") from e
         try:
             reply = conn.call(method, body, timeout)

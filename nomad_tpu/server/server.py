@@ -24,6 +24,7 @@ from .periodic import PeriodicDispatch, derive_job
 from .plan_apply import PlanApplier
 from .plan_queue import PlanQueue
 from .raft import FileLog, InmemLog, MultiRaft, NotLeaderError, RaftLog
+from ..utils.tlsutil import TLSConfig, client_context, server_context
 from .vault import ServerVaultClient, VaultConfig, VaultError
 from .worker import BatchWorker, Worker
 
@@ -58,6 +59,7 @@ class ServerConfig:
     enabled_schedulers: List[str] = field(default_factory=lambda: [
         s.JOB_TYPE_SERVICE, s.JOB_TYPE_BATCH, s.JOB_TYPE_SYSTEM, s.JOB_TYPE_CORE])
     vault: Optional[VaultConfig] = None
+    tls: Optional[TLSConfig] = None
 
 
 class Server:
@@ -114,10 +116,12 @@ class Server:
         if self.config.enable_rpc:
             from .rpc import ConnPool, RPCServer
 
-            self.pool = ConnPool()
+            tls_cfg = self.config.tls or TLSConfig()
+            self.pool = ConnPool(tls_context=client_context(tls_cfg))
             self.rpc = RPCServer(host=self.config.rpc_bind,
                                  port=self.config.rpc_port,
-                                 logger=self.logger.getChild("rpc"))
+                                 logger=self.logger.getChild("rpc"),
+                                 tls_context=server_context(tls_cfg))
             # Advertise the configured host (never a wildcard bind) with
             # the actually-bound port (config.go AdvertiseAddrs).
             adv_host = ""
