@@ -1,0 +1,154 @@
+"""cgroup resource isolation for executed tasks (reference:
+client/driver/executor/executor_linux.go — configureCgroups applies
+memory.limit_in_bytes, cpu.shares, and cleanup kills the group).
+
+Supports both hierarchies:
+  v2 (unified): /sys/fs/cgroup/cgroup.controllers present —
+      memory.max + cpu.weight, membership via cgroup.procs
+  v1 (split):   per-controller trees memory/ and cpu/
+
+Availability is probed once; on hosts without writable cgroups (or
+non-root) isolation degrades to the executor's RLIMIT fallback, like the
+reference's non-Linux executors."""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import signal
+import time
+from typing import List, Optional
+
+CGROUP_ROOT = "/sys/fs/cgroup"
+PARENT = "nomad-tpu"
+
+logger = logging.getLogger("nomad_tpu.cgroups")
+
+
+def _is_v2() -> bool:
+    return os.path.exists(os.path.join(CGROUP_ROOT, "cgroup.controllers"))
+
+
+@functools.lru_cache(maxsize=1)
+def available() -> bool:
+    """Writable cgroup tree + root: isolation can be applied.  Probed
+    once per process (a host property that does not change)."""
+    if os.geteuid() != 0:
+        return False
+    try:
+        if _is_v2():
+            probe = os.path.join(CGROUP_ROOT, f"{PARENT}-probe")
+        else:
+            probe = os.path.join(CGROUP_ROOT, "memory", f"{PARENT}-probe")
+        os.makedirs(probe, exist_ok=True)
+        os.rmdir(probe)
+        return True
+    except OSError:
+        return False
+
+
+class TaskCgroup:
+    """One task's cgroup(s): created with limits, pid added, destroyed
+    with the task (executor_linux.go configureCgroups + destroyCgroup)."""
+
+    def __init__(self, name: str, cpu_mhz: int = 0, memory_mb: int = 0):
+        self.name = name
+        self.cpu_mhz = cpu_mhz
+        self.memory_mb = memory_mb
+        self.paths: List[str] = []
+
+    def _write(self, path: str, fname: str, value: str) -> bool:
+        try:
+            with open(os.path.join(path, fname), "w") as fh:
+                fh.write(value)
+            return True
+        except OSError as e:
+            logger.warning("cgroup write %s/%s failed: %s", path, fname, e)
+            return False
+
+    def create(self) -> bool:
+        """True only when the MEMORY limit verifiably applied — a caller
+        that drops its RLIMIT fallback on our word must not be lied to."""
+        try:
+            mem_ok = True
+            if _is_v2():
+                parent = os.path.join(CGROUP_ROOT, PARENT)
+                os.makedirs(parent, exist_ok=True)
+                # v2 children only get controller files once the parent
+                # delegates them (cgroup.subtree_control).
+                self._write(CGROUP_ROOT, "cgroup.subtree_control",
+                            "+memory +cpu")
+                self._write(parent, "cgroup.subtree_control",
+                            "+memory +cpu")
+                path = os.path.join(parent, self.name)
+                os.makedirs(path, exist_ok=True)
+                if self.memory_mb > 0:
+                    mem_ok = self._write(path, "memory.max",
+                                         str(self.memory_mb * 1024 * 1024))
+                if self.cpu_mhz > 0:
+                    # cpu.weight 1-10000; the reference maps MHz shares —
+                    # same monotone mapping, clamped.
+                    self._write(path, "cpu.weight",
+                                str(max(1, min(10000, self.cpu_mhz))))
+                self.paths = [path]
+            else:
+                mem = os.path.join(CGROUP_ROOT, "memory", PARENT, self.name)
+                cpu = os.path.join(CGROUP_ROOT, "cpu", PARENT, self.name)
+                os.makedirs(mem, exist_ok=True)
+                os.makedirs(cpu, exist_ok=True)
+                if self.memory_mb > 0:
+                    mem_ok = self._write(mem, "memory.limit_in_bytes",
+                                         str(self.memory_mb * 1024 * 1024))
+                if self.cpu_mhz > 0:
+                    # cpu.shares: MHz, floor 2 (executor_linux.go)
+                    self._write(cpu, "cpu.shares",
+                                str(max(2, self.cpu_mhz)))
+                self.paths = [mem, cpu]
+            if not mem_ok:
+                self.destroy(kill=False)
+                return False
+            return True
+        except OSError as e:
+            logger.warning("cgroup create failed for %s: %s", self.name, e)
+            self.paths = []
+            return False
+
+    def add_pid(self, pid: int) -> None:
+        for path in self.paths:
+            self._write(path, "cgroup.procs", str(pid))
+
+    def pids(self) -> List[int]:
+        """Union over every hierarchy — a process may have joined only
+        one of the v1 controllers."""
+        out: set = set()
+        for path in self.paths:
+            try:
+                with open(os.path.join(path, "cgroup.procs")) as fh:
+                    out.update(int(line) for line in fh if line.strip())
+            except OSError:
+                pass
+        return sorted(out)
+
+    def destroy(self, kill: bool = True, timeout: float = 5.0) -> None:
+        """Kill every process still in the group, then remove it
+        (executor_linux.go destroyCgroup)."""
+        if kill:
+            deadline = time.time() + timeout
+            sig = signal.SIGKILL
+            while time.time() < deadline:
+                pids = self.pids()
+                if not pids:
+                    break
+                for pid in pids:
+                    try:
+                        os.kill(pid, sig)
+                    except (ProcessLookupError, PermissionError):
+                        pass
+                time.sleep(0.05)
+        for path in self.paths:
+            try:
+                os.rmdir(path)
+            except OSError:
+                pass
+        self.paths = []

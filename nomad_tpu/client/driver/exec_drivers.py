@@ -76,6 +76,10 @@ class ExecutorHandle(DriverHandle):
 class _ExecFamilyDriver(Driver):
     """Shared start path for raw_exec/exec/java/qemu."""
 
+    # cgroup isolation for the family (executor_linux.go); raw_exec opts
+    # out to run unisolated like the reference.
+    use_cgroups = True
+
     name = ""
     isolation = FS_ISOLATION_NONE
     enforce_memory = False
@@ -117,6 +121,11 @@ class _ExecFamilyDriver(Driver):
             memory_limit_mb=(
                 task.resources.memory_mb
                 if (self.enforce_memory and task.resources) else 0),
+            cpu_limit=(task.resources.cpu if task.resources else 0),
+            # exec-family isolation (exec_linux.go): cgroups when the
+            # host allows; raw_exec opts out by design (raw_exec.go).
+            use_cgroups=self.use_cgroups,
+            cgroup_name=f"{self.ctx.alloc_id[:8]}-{task.name}",
         )
         executor = Executor(exec_cmd)
         try:
@@ -139,6 +148,8 @@ class _ExecFamilyDriver(Driver):
 class RawExecDriver(_ExecFamilyDriver):
     """(raw_exec.go) — no isolation; must be enabled explicitly via client
     option ``driver.raw_exec.enable``."""
+
+    use_cgroups = False
 
     name = "raw_exec"
     isolation = FS_ISOLATION_NONE

@@ -104,9 +104,11 @@ class ExecCommand:
     log_dir: str = ""
     max_log_files: int = 10
     max_log_file_size_mb: int = 10
-    cpu_limit: int = 0        # MHz ask — advisory (no cgroups here)
-    memory_limit_mb: int = 0  # enforced via RLIMIT_AS when >0
+    cpu_limit: int = 0        # MHz ask — cpu.shares/weight when cgroups apply
+    memory_limit_mb: int = 0  # cgroup memory limit; RLIMIT_AS fallback
     user: str = ""
+    use_cgroups: bool = False  # exec-family isolation (executor_linux.go)
+    cgroup_name: str = ""
 
 
 class Executor:
@@ -122,6 +124,7 @@ class Executor:
         self._out_rot: Optional[LogRotator] = None
         self._err_rot: Optional[LogRotator] = None
         self._pumps: List[threading.Thread] = []
+        self.cgroup = None
 
     # -- lifecycle ---------------------------------------------------------
     def launch(self) -> int:
@@ -135,8 +138,38 @@ class Executor:
                                        c.max_log_files, c.max_log_file_size_mb)
             stdout = stderr = subprocess.PIPE
 
+        # Isolation: cgroup limits when requested and the host allows
+        # (executor_linux.go configureCgroups); RLIMIT_AS fallback keeps
+        # a memory bound on hosts without cgroups.
+        use_rlimit = c.memory_limit_mb > 0
+        if c.use_cgroups:
+            from . import cgroups
+
+            if cgroups.available():
+                self.cgroup = cgroups.TaskCgroup(
+                    c.cgroup_name or f"{c.task_name}-{os.getpid()}",
+                    cpu_mhz=c.cpu_limit, memory_mb=c.memory_limit_mb)
+                if self.cgroup.create():
+                    use_rlimit = False
+                else:
+                    self.cgroup = None
+
+        cg_paths = list(self.cgroup.paths) if self.cgroup is not None else []
+
         def preexec():
-            if c.memory_limit_mb > 0:
+            # Join the cgroup BEFORE exec so nothing the task forks can
+            # escape it (executor_linux.go joins pre-exec); if the join
+            # fails, fall back to RLIMIT_AS in-child.
+            joined = False
+            for path in cg_paths:
+                try:
+                    with open(os.path.join(path, "cgroup.procs"), "w") as fh:
+                        fh.write(str(os.getpid()))
+                    joined = True
+                except OSError:
+                    pass
+            if (use_rlimit or (cg_paths and not joined)) \
+                    and c.memory_limit_mb > 0:
                 lim = c.memory_limit_mb * 1024 * 1024
                 try:
                     resource.setrlimit(resource.RLIMIT_AS, (lim, lim))
@@ -184,6 +217,11 @@ class Executor:
             self.result = WaitResult(exit_code=0, signal=-rc)
         else:
             self.result = WaitResult(exit_code=rc)
+        if self.cgroup is not None:
+            # Reap stragglers the task forked, then remove the group
+            # (executor_linux.go destroyCgroup).
+            self.cgroup.destroy()
+            self.cgroup = None
         self.exited.set()
 
     # -- control -----------------------------------------------------------

@@ -600,3 +600,70 @@ class TestDriverFieldSchemas:
             if client is not None:
                 client.shutdown()
             srv.shutdown()
+
+
+class TestCgroupIsolation:
+    """executor_linux.go cgroup isolation: exec-family tasks land in a
+    per-task cgroup with memory/cpu limits, destroyed with the task."""
+
+    def test_exec_task_runs_in_cgroup(self, tmp_path):
+        import subprocess
+        import time as _time
+
+        from nomad_tpu.client.driver import cgroups
+        from nomad_tpu.client.driver.executor import ExecCommand, Executor
+
+        if not cgroups.available():
+            import pytest as _pytest
+            _pytest.skip("cgroups not writable on this host")
+
+        cmd = ExecCommand(
+            cmd="/bin/sh", args=["-c", "sleep 5"],
+            cwd=str(tmp_path), task_name="cg-test",
+            memory_limit_mb=64, cpu_limit=100,
+            use_cgroups=True, cgroup_name="test-cg-task")
+        ex = Executor(cmd)
+        pid = ex.launch()
+        try:
+            assert ex.cgroup is not None and ex.cgroup.paths
+            deadline = _time.time() + 5
+            while _time.time() < deadline and pid not in ex.cgroup.pids():
+                _time.sleep(0.05)
+            assert pid in ex.cgroup.pids(), "pid never joined the cgroup"
+            mem_path = ex.cgroup.paths[0]
+            import os as _os
+            if _os.path.exists(_os.path.join(mem_path,
+                                             "memory.limit_in_bytes")):
+                limit = int(open(_os.path.join(
+                    mem_path, "memory.limit_in_bytes")).read())
+            else:
+                limit = int(open(_os.path.join(mem_path,
+                                               "memory.max")).read())
+            assert limit == 64 * 1024 * 1024
+        finally:
+            ex.shutdown(grace=0.2)
+            ex.exited.wait(10)
+        # group destroyed with the task
+        assert ex.cgroup is None
+
+    def test_cgroup_destroy_reaps_stragglers(self, tmp_path):
+        import time as _time
+
+        from nomad_tpu.client.driver import cgroups
+
+        if not cgroups.available():
+            import pytest as _pytest
+            _pytest.skip("cgroups not writable on this host")
+
+        import subprocess
+        cg = cgroups.TaskCgroup("straggler-test", memory_mb=32)
+        assert cg.create()
+        proc = subprocess.Popen(["sleep", "30"])
+        cg.add_pid(proc.pid)
+        assert proc.pid in cg.pids()
+        cg.destroy()
+        deadline = _time.time() + 5
+        while _time.time() < deadline and proc.poll() is None:
+            _time.sleep(0.05)
+        assert proc.poll() is not None, "straggler survived cgroup destroy"
+        proc.wait()
