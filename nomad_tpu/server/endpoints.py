@@ -49,8 +49,10 @@ def register_endpoints(server, rpc) -> None:
                 server._fwd_ctx.region_hop = True
             try:
                 return fn(body)
-            except NotLeaderError:
-                raise NoLeaderError("no cluster leader")
+            except NotLeaderError as e:
+                # Carry the known leader address so wire clients can
+                # redirect (rpc.go structs.ErrNoLeader vs redirect info).
+                raise NoLeaderError(str(e) or "no cluster leader")
             finally:
                 if forwarded:
                     server._fwd_ctx.active = False
@@ -186,6 +188,77 @@ def register_endpoints(server, rpc) -> None:
     register("Job.Deregister", job_deregister)
     register("Job.Evaluate", job_evaluate)
     register("Job.Dispatch", job_dispatch)
+
+    # -- Eval (worker surface, eval_endpoint.go:64-211) --------------------
+
+    def eval_dequeue(body):
+        # Cap the server-side block below the transport read timeout so a
+        # client-supplied Timeout cannot park this connection thread
+        # (worker long-polls re-issue; eval_broker.go Dequeue).
+        timeout = min(float(body.get("Timeout", 0.0) or 0.0), 5.0)
+        ev, token = server.eval_dequeue(
+            body.get("Schedulers") or [], timeout)
+        return {"Eval": to_wire(ev) if ev is not None else None,
+                "Token": token}
+
+    def eval_ack(body):
+        server.eval_ack(body["EvalID"], body["Token"])
+        return {}
+
+    def eval_nack(body):
+        server.eval_nack(body["EvalID"], body["Token"])
+        return {}
+
+    def eval_get(body):
+        ev = server.eval_get(body["EvalID"])
+        return {"Eval": to_wire(ev) if ev is not None else None}
+
+    def eval_list(body):
+        return {"Evals": [to_wire(e) for e in server.eval_list()],
+                "Index": server.state.table_index("evals")}
+
+    def eval_allocations(body):
+        allocs = server.eval_allocations(body["EvalID"])
+        return {"Allocs": [to_wire(a) for a in allocs],
+                "Index": server.state.table_index("allocs")}
+
+    register("Eval.Dequeue", eval_dequeue)
+    register("Eval.Ack", eval_ack)
+    register("Eval.Nack", eval_nack)
+    register("Eval.GetEval", eval_get)
+    register("Eval.List", eval_list)
+    register("Eval.Allocations", eval_allocations)
+
+    # -- Plan (plan_endpoint.go) -------------------------------------------
+
+    def plan_submit(body):
+        plan = from_wire(s.Plan, body["Plan"])
+        future = server.plan_submit(plan)
+        # Bounded: a dropped plan (leadership churn) responds with an
+        # error; an unresponsive applier must not pin this thread.
+        result = future.wait(timeout=60.0)
+        return {"Result": to_wire(result) if result is not None else None}
+
+    register("Plan.Submit", plan_submit)
+
+    # -- Region / Operator -------------------------------------------------
+
+    def region_list(body):
+        return {"Regions": server.regions()}
+
+    def operator_raft_config(body):
+        return server.raft_configuration()
+
+    rpc.register("Region.List", region_list)
+    rpc.register("Operator.RaftGetConfiguration", operator_raft_config)
+
+    # -- Alloc -------------------------------------------------------------
+
+    def alloc_list(body):
+        return {"Allocs": [to_wire(a) for a in server.alloc_list()],
+                "Index": server.state.table_index("allocs")}
+
+    register("Alloc.List", alloc_list)
 
     # -- Periodic ----------------------------------------------------------
 

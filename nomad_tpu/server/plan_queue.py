@@ -56,6 +56,12 @@ class PlanQueue:
         with self._l:
             self._enabled = enabled
             if not enabled:
+                # Pending submitters must hear about the discard — a
+                # silent drop would hang their future.wait() forever
+                # (plan_queue.go Flush responds with an error).
+                for item in self._heap:
+                    item.future.respond(None, RuntimeError(
+                        "plan queue is disabled (leadership lost)"))
                 self._heap = []
             self._cond.notify_all()
 

@@ -1221,11 +1221,15 @@ class Server:
         return self.eval_broker.dequeue(schedulers, timeout)
 
     def eval_ack(self, eval_id: str, token: str) -> None:
-        self._require_leader()
+        if not self._leader:
+            self._forward("Eval.Ack", {"EvalID": eval_id, "Token": token})
+            return
         self.eval_broker.ack(eval_id, token)
 
     def eval_nack(self, eval_id: str, token: str) -> None:
-        self._require_leader()
+        if not self._leader:
+            self._forward("Eval.Nack", {"EvalID": eval_id, "Token": token})
+            return
         self.eval_broker.nack(eval_id, token)
 
     def eval_get(self, eval_id: str) -> Optional[s.Evaluation]:

@@ -356,3 +356,45 @@ class TestForceLeaveRejoin:
         finally:
             b.shutdown()
             a.shutdown()
+
+
+class TestWireEndpointSurface:
+    """The reference's RPC endpoint families (server.go:163-174) exist on
+    the wire: Eval dequeue/ack flow, Plan.Submit, Region/Operator reads."""
+
+    def test_eval_and_plan_wire_flow(self):
+        srv = Server(ServerConfig(enable_rpc=True, num_schedulers=0))
+        srv.start()
+        pool = ConnPool()
+        try:
+            addr = srv.config.rpc_advertise
+            node = mock.node()
+            node.resources.networks = []
+            node.reserved.networks = []
+            srv.node_register(node)
+            job = make_job(1)
+            reply = pool.call(addr, "Job.Register", {"Job": to_wire(job)})
+            assert reply["EvalID"]
+
+            # A remote worker dequeues the eval over the wire…
+            dq = pool.call(addr, "Eval.Dequeue",
+                           {"Schedulers": [job.type], "Timeout": 5.0})
+            assert dq["Eval"] and dq["Eval"]["ID"] == reply["EvalID"]
+            token = dq["Token"]
+            # …acks it…
+            pool.call(addr, "Eval.Ack",
+                      {"EvalID": reply["EvalID"], "Token": token})
+            got = pool.call(addr, "Eval.GetEval",
+                            {"EvalID": reply["EvalID"]})
+            assert got["Eval"] is not None
+            listed = pool.call(addr, "Eval.List", {})
+            assert any(e["ID"] == reply["EvalID"]
+                       for e in listed["Evals"])
+
+            regions = pool.call(addr, "Region.List", {})
+            assert regions["Regions"] == ["global"]
+            raft_cfg = pool.call(addr, "Operator.RaftGetConfiguration", {})
+            assert raft_cfg["Servers"]
+        finally:
+            pool.close()
+            srv.shutdown()
