@@ -22,6 +22,7 @@ already guarantees at most one outstanding eval per job).
 from __future__ import annotations
 
 import logging
+import os
 import time
 from collections import defaultdict
 from typing import Dict, List, Optional, Tuple
@@ -33,10 +34,36 @@ from ..scheduler.generic import GenericScheduler
 from ..scheduler.scheduler import register_scheduler
 from ..scheduler.util import AllocTuple, ready_nodes_in_dcs, set_status
 from ..structs import structs as s
-from . import encode
-from .kernels import feasibility_matrix, placement_rounds
+from . import encode, xfer
+from .kernels import device_pass, summary_layout
 
 logger = logging.getLogger("nomad_tpu.ops.batch_sched")
+
+_cache_configured = False
+
+
+def _ensure_compile_cache() -> None:
+    """Enable JAX's persistent compilation cache for the scheduling
+    programs: they cost tens of seconds of XLA compile per shape bucket,
+    and the cache turns that into a once-per-machine tax (measured:
+    48s → 1.3s warm).  Called at scheduler construction, not package
+    import, so embedding applications keep their own JAX config; an
+    already-configured cache dir is respected.  Disable with
+    NOMAD_TPU_NO_COMPILE_CACHE=1 (any value except 0/false/empty)."""
+    global _cache_configured
+    if _cache_configured:
+        return
+    _cache_configured = True
+    flag = os.environ.get("NOMAD_TPU_NO_COMPILE_CACHE", "").strip().lower()
+    if flag not in ("", "0", "false", "no"):
+        return
+    if jax.config.jax_compilation_cache_dir is not None:
+        return  # the application already configured one
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get("NOMAD_TPU_COMPILE_CACHE_DIR",
+                       os.path.expanduser("~/.cache/nomad_tpu/xla")))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
 class _CollectingScheduler(GenericScheduler):
@@ -46,14 +73,19 @@ class _CollectingScheduler(GenericScheduler):
 
     def __init__(self, logger_, state, planner, batch: bool):
         super().__init__(logger_, state, planner, batch)
-        self.pending_place: List[AllocTuple] = []
+        # Placement asks in bulk (columnar) form: per task group, the alloc
+        # names and previous-alloc ids (None when fresh).  Built either by
+        # the register fast path below or by grouping the oracle's
+        # AllocTuples in _compute_placements.
+        self.pending_bulk: List[
+            Tuple[s.TaskGroup, List[str], Optional[List[Optional[str]]]]] = []
         self.nodes_by_dc: Dict[str, int] = {}
         # Shared per-batch cache of dc-tuple → nodes-by-dc counts, injected
         # by TPUBatchScheduler (one full node scan per distinct dc set per
         # batch instead of per eval).
         self.dc_cache: Optional[Dict[Tuple[str, ...], Dict[str, int]]] = None
 
-    def _compute_placements(self, place: List[AllocTuple]) -> None:
+    def _set_nodes_by_dc(self) -> None:
         dcs = tuple(self.job.datacenters)
         if self.dc_cache is not None and dcs in self.dc_cache:
             self.nodes_by_dc = self.dc_cache[dcs]
@@ -62,7 +94,46 @@ class _CollectingScheduler(GenericScheduler):
             self.nodes_by_dc = by_dc
             if self.dc_cache is not None:
                 self.dc_cache[dcs] = by_dc
-        self.pending_place = list(place)
+
+    def _compute_job_allocs(self) -> None:
+        """Register fast path: a job with NO existing allocations (the
+        common high-volume case the batch scheduler exists for) places
+        every materialized instance — the diff is the identity
+        (util.go:70: existing empty ⇒ all required names → place), so the
+        name dict, AllocTuples, taint scan and in-place machinery are all
+        skipped.  Anything with history takes the inherited oracle path."""
+        job = self.job
+        if (job is None or job.stopped() or self.eval.annotate_plan
+                or self.state.allocs_by_job(None, self.eval.job_id, True)):
+            super()._compute_job_allocs()
+            return
+        bulk = []
+        for tg in job.task_groups:
+            if tg.count <= 0:
+                continue
+            names = [f"{job.name}.{tg.name}[{i}]" for i in range(tg.count)]
+            self.queued_allocs[tg.name] = tg.count
+            bulk.append((tg, names, None))
+        self.pending_bulk = bulk
+        if bulk:
+            self._set_nodes_by_dc()
+
+    def _compute_placements(self, place: List[AllocTuple]) -> None:
+        self._set_nodes_by_dc()
+        by_tg: Dict[str, Tuple[s.TaskGroup, List[str], List[Optional[str]]]] = {}
+        order: List[Tuple[s.TaskGroup, List[str], List[Optional[str]]]] = []
+        for tup in place:
+            ent = by_tg.get(tup.task_group.name)
+            if ent is None:
+                ent = (tup.task_group, [], [])
+                by_tg[tup.task_group.name] = ent
+                order.append(ent)
+            ent[1].append(tup.name)
+            ent[2].append(tup.alloc.id if tup.alloc is not None else None)
+        self.pending_bulk = [
+            (tg, names,
+             prevs if any(p is not None for p in prevs) else None)
+            for tg, names, prevs in order]
 
 
 class TPUBatchScheduler:
@@ -77,6 +148,7 @@ class TPUBatchScheduler:
         self.logger = logger_
         self.state = state
         self.planner = planner
+        _ensure_compile_cache()
 
     # -- single-eval compatibility ----------------------------------------
 
@@ -92,6 +164,7 @@ class TPUBatchScheduler:
         t0 = time.monotonic()
 
         # Phase 1: host reconciliation per eval (shared oracle code).
+        t_phase1 = time.monotonic()
         dc_cache: Dict[Tuple[str, ...], Dict[str, int]] = {}
         scheds: List[Tuple[s.Evaluation, _CollectingScheduler]] = []
         for ev in evals:
@@ -112,23 +185,23 @@ class TPUBatchScheduler:
                 sched.stack.set_job(sched.job)
             sched._compute_job_allocs()
             scheds.append((ev, sched))
+        stats.phase1_seconds = time.monotonic() - t_phase1
+        t_phase2 = time.monotonic()
 
         # Phase 2: dedup placement asks into specs.
         specs: Dict[Tuple[str, str], encode.PlacementSpec] = {}
         spec_evs: Dict[Tuple[str, str], s.Evaluation] = {}
         for ev, sched in scheds:
-            for tup in sched.pending_place:
-                key = (sched.job.id, tup.task_group.name)
+            for tg, names, prevs in sched.pending_bulk:
+                key = (sched.job.id, tg.name)
                 spec = specs.get(key)
                 if spec is None:
-                    spec = encode.build_spec(sched.job, tup.task_group, sched.batch)
+                    spec = encode.build_spec(sched.job, tg, sched.batch)
                     if spec.dp_target is not None:
                         spec.dp_used_values = self._dp_used_values(sched, spec)
                     specs[key] = spec
                     spec_evs[key] = ev
-                spec.names.append(tup.name)
-                spec.prev_alloc_ids.append(tup.alloc.id if tup.alloc else None)
-                spec.eval_ids.append(ev.id)
+                spec.names.extend(names)
 
         # Gate: specs the device path cannot express route their whole
         # eval through the oracle instead of being silently mis-placed
@@ -155,6 +228,7 @@ class TPUBatchScheduler:
         spec_list = sorted(specs.values(), key=lambda sp: -sp.priority)
         stats.num_specs = len(spec_list)
         stats.num_asks = sum(sp.count for sp in spec_list)
+        stats.phase2_seconds = time.monotonic() - t_phase2
 
         assignments: Dict[Tuple[str, str], List[Tuple[str, int]]] = {}
         unplaced: Dict[Tuple[str, str], int] = {}
@@ -165,6 +239,7 @@ class TPUBatchScheduler:
                 spec_list)
             stats.device_seconds = kstats["device_seconds"]
             stats.encode_seconds = kstats["encode_seconds"]
+            stats.metrics_seconds = kstats["metrics_seconds"]
             stats.rounds = kstats["rounds"]
 
         # Expand per-spec (node, count) assignments into flat slot lists —
@@ -177,10 +252,12 @@ class TPUBatchScheduler:
             expanded[key] = slots
 
         # Phase 3: materialize allocs into each eval's plan and submit.
+        t_final = time.monotonic()
         net_index_cache: Dict[str, "NetworkIndex"] = {}
         for ev, sched in scheds:
             self._finalize(ev, sched, specs, expanded, unplaced,
                            per_spec_metrics, net_index_cache)
+        stats.finalize_seconds = time.monotonic() - t_final
 
         stats.total_seconds = time.monotonic() - t0
         stats.num_evals = len(evals)
@@ -261,8 +338,6 @@ class TPUBatchScheduler:
         # uploaded SPARSE and scattered dense on device: the dense U×N
         # matrix is mostly zeros and the tunneled host↔device link is the
         # bottleneck at scale.
-        from .kernels import compact_placements, scatter_job_counts
-
         node_index = {nid: i for i, nid in enumerate(ct.node_ids)}
         jc_entries: Dict[Tuple[int, int], int] = {}
         for j, job_id in enumerate(st.job_ids):
@@ -279,11 +354,10 @@ class TPUBatchScheduler:
         for i, ((j, n), v) in enumerate(jc_entries.items()):
             jc_rows[i], jc_cols[i], jc_vals[i] = j, n, v
 
-        encode_seconds = time.monotonic() - t0
-        t1 = time.monotonic()
-
-        # ONE upload for every host array — individual asarray calls each
-        # pay a round trip on a tunneled device.
+        # ONE packed upload for every host array, ONE device dispatch, ONE
+        # packed summary fetch + ONE COO-prefix fetch: the tunneled
+        # host↔device link pays ~50-110ms per transfer regardless of size
+        # (measured — bench.py detail), so transfer count is the limit.
         host = {
             "attr": ct.attr_values, "elig": ct.eligible, "dc": ct.dc_code,
             "c_attr": st.constraint_attr, "c_op": st.constraint_op,
@@ -296,6 +370,9 @@ class TPUBatchScheduler:
             "penalty": st.penalty, "dh": st.distinct_hosts,
             "ji": st.job_index,
             "jc_rows": jc_rows, "jc_cols": jc_cols, "jc_vals": jc_vals,
+            "rng_seed": np.array(
+                [int.from_bytes(s.generate_uuid()[:8].encode(), "big")
+                 & 0x7FFFFFFF], dtype=np.int32),
         }
         if with_networks:
             host.update(net_active=st.net_active, net_mbits=st.net_mbits,
@@ -306,54 +383,51 @@ class TPUBatchScheduler:
         if with_dp:
             host.update(dp_col=st.dp_col, dp_active=st.dp_active,
                         dp_used=st.dp_used)
-        d = jax.device_put(host)
+        buf, meta = xfer.pack_host(host)
+        encode_seconds = time.monotonic() - t0
+        t1 = time.monotonic()
 
-        job_counts = scatter_job_counts(
-            d["jc_rows"], d["jc_cols"], d["jc_vals"],
-            u_pad=st.u_pad, n_pad=ct.n_pad)
-        feas = feasibility_matrix(
-            d["attr"], d["elig"], d["dc"], d["c_attr"], d["c_op"],
-            d["c_rhs"], d["dc_mask"], d["precomp"])
-        net = dp = None
-        if with_networks:
-            from .kernels import NetTensors
-
-            net = NetTensors(
-                active=d["net_active"], mbits=d["net_mbits"],
-                dyn_need=d["dyn_need"], resv_words=d["resv_words"],
-                bw_cap=d["bw_cap"], bw_used=d["bw_used"],
-                dyn_free=d["dyn_free"], port_words=d["port_words"])
-        if with_dp:
-            from .kernels import DPTensors
-
-            dp = DPTensors(col=d["dp_col"], active=d["dp_active"],
-                           used0=d["dp_used"], attr_values=d["attr"])
         # Commit-score side-outputs cost two [U, N] carry buffers; beyond
         # ~16M cells the HBM + compile cost outweighs score forensics
         # (counts stay exact either way).
         with_scores = st.u_pad * ct.n_pad <= 16_000_000
-        result = placement_rounds(
-            feas, d["used"], d["cap"], d["denom"], d["ask"], d["count"],
-            d["penalty"], d["dh"], d["ji"], job_counts,
-            jax.random.PRNGKey(int.from_bytes(s.generate_uuid()[:8].encode(), "big") & 0x7FFFFFFF),
-            net=net,
-            dp=dp,
-            with_scores=with_scores,
-        )
-        # Compact on device; fetch COO + summaries only (the dense U×N
-        # matrices never cross the link).
         total_asks = int(sum(sp.count for sp in spec_list))
         max_nnz = encode.pow2_bucket(
             max(8, min(total_asks, st.u_pad * ct.n_pad)), minimum=8)
-        coo = compact_placements(feas, result.placements,
-                                 result.commit_scores,
-                                 result.commit_collisions, max_nnz=max_nnz)
-        # ONE fetch for everything: each device_get is a round trip over
-        # the (possibly tunneled) host↔device link.
-        (coo_rows, coo_cols, coo_counts, coo_scores, coo_coll, feas_count,
-         unplaced_arr, used_after, rounds_arr) = jax.device_get(
-            (*coo, result.unplaced, result.used_after, result.rounds))
-        rounds = int(rounds_arr)
+        summary_buf, coo_mat, feas = device_pass(
+            jax.device_put(buf), meta=meta, u_pad=st.u_pad, n_pad=ct.n_pad,
+            with_networks=with_networks, with_dp=with_dp,
+            with_scores=with_scores, max_nnz=max_nnz)
+        ncols = 5 if with_scores else 3
+        # Small COO bucket: fetch summary + full bucket concurrently (one
+        # blocking round).  Big bucket: summary first, then exactly the
+        # [nnz, C] prefix — two rounds beat streaming the whole bucket.
+        if max_nnz * ncols * 4 <= (4 << 20):
+            sraw, coo_full = jax.device_get((summary_buf, coo_mat))
+            summary = xfer.unpack_host(np.asarray(sraw),
+                                       summary_layout(st.u_pad, ct.n_pad))
+            nnz = int(summary["scalars"][0])
+            coo = np.asarray(coo_full[:nnz])
+        else:
+            summary = xfer.unpack_host(
+                np.asarray(jax.device_get(summary_buf)),
+                summary_layout(st.u_pad, ct.n_pad))
+            nnz = int(summary["scalars"][0])
+            if nnz:
+                coo = np.asarray(jax.device_get(coo_mat[:nnz]))
+            else:
+                coo = np.zeros((0, ncols), dtype=np.int32)
+        rounds = int(summary["scalars"][1])
+        unplaced_arr = summary["unplaced"]
+        used_after = summary["used_after"]
+        feas_count = summary["feas_count"]
+        coo_rows, coo_cols, coo_counts = coo[:, 0], coo[:, 1], coo[:, 2]
+        if with_scores:
+            coo_scores = np.ascontiguousarray(coo[:, 3]).view(np.float32)
+            coo_coll = coo[:, 4]
+        else:
+            coo_scores = np.zeros(len(coo), dtype=np.float32)
+            coo_coll = np.zeros(len(coo), dtype=np.int32)
 
         # Feasibility rows are fetched lazily, only for failed specs whose
         # feasible count is below their EVALUATED count (= ready nodes in
@@ -393,6 +467,7 @@ class TPUBatchScheduler:
                         np.array(need_rows, dtype=np.int32))]))
                 feas_rows = {u: fetched[i] for i, u in enumerate(need_rows)}
         device_seconds = time.monotonic() - t1
+        t_metrics = time.monotonic()
 
         # COO → per-spec (node, count, score) lists, grouped via one
         # argsort instead of a python loop over every entry.
@@ -453,6 +528,7 @@ class TPUBatchScheduler:
         kstats = {
             "device_seconds": device_seconds,
             "encode_seconds": encode_seconds,
+            "metrics_seconds": time.monotonic() - t_metrics,
             "rounds": rounds,
         }
         return assignments, unplaced, metrics, kstats
@@ -625,14 +701,10 @@ class TPUBatchScheduler:
         # (go-memdb shares pointers the same way) and the batch path never
         # mutates them post-construction.  Per-alloc cost: one shallow copy +
         # a bulk-generated uuid.
-        by_key: Dict[Tuple[str, str], List[AllocTuple]] = {}
-        for tup in sched.pending_place:
-            by_key.setdefault((sched.job.id, tup.task_group.name), []).append(tup)
-
         fast_copy = s._fast_copy
-        for key, tups in by_key.items():
+        for tg, names, prevs in sched.pending_bulk:
+            key = (sched.job.id, tg.name)
             slots = expanded.get(key, [])
-            tg = tups[0].task_group
             metric = per_spec_metrics.get(key, s.AllocMetric())
             metric.nodes_available = sched.nodes_by_dc
             combined = s.Resources(disk_mb=tg.ephemeral_disk.size_mb)
@@ -652,19 +724,35 @@ class TPUBatchScheduler:
             )
             spec = specs.get(key)
             net_asks = spec.net_asks if spec is not None else {}
-            k = min(len(slots), len(tups))
-            ids = s.generate_uuids(k) if k else []
+            k = min(len(slots), len(names))
             appended = 0
-            append = sched.plan.append_alloc
-            import random as _random
-            net_rng = _random.Random(ev.id) if net_asks else None
-            for i in range(k):
-                tup = tups[i]
-                alloc = fast_copy(proto)
-                alloc.id = ids[i]
-                alloc.name = tup.name
-                alloc.node_id = slots[i]
-                if net_asks:
+            if not net_asks:
+                # Columnar fast path: ONE AllocSlab per (job, tg) instead
+                # of k Allocation objects — the prototype is stored once
+                # and per-alloc columns carry only id/name/node/prev
+                # (structs.AllocSlab; the host-side bottleneck at bench
+                # scale was exactly this materialization loop).
+                if k:
+                    slab = s.AllocSlab(
+                        proto=proto,
+                        ids=s.generate_uuids(k),
+                        names=names[:k] if k < len(names) else names,
+                        node_ids=slots[:k] if k < len(slots) else slots,
+                        prev_ids=([p or "" for p in prevs[:k]]
+                                  if prevs is not None else []),
+                    )
+                    sched.plan.append_slab(slab)
+                    appended = k
+            else:
+                ids = s.generate_uuids(k) if k else []
+                append = sched.plan.append_alloc
+                import random as _random
+                net_rng = _random.Random(ev.id)
+                for i in range(k):
+                    alloc = fast_copy(proto)
+                    alloc.id = ids[i]
+                    alloc.name = names[i]
+                    alloc.node_id = slots[i]
                     # Concrete per-task network offers (IP + dynamic port
                     # values): the device reserved ports/bandwidth/dyn
                     # capacity; the host picks the actual port numbers
@@ -692,15 +780,15 @@ class TPUBatchScheduler:
                         continue
                     alloc.task_resources = task_resources
                     alloc.resources = total
-                if tup.alloc is not None and tup.alloc.id:
-                    alloc.previous_allocation = tup.alloc.id
-                append(alloc)
-                appended += 1
+                    if prevs is not None and prevs[i]:
+                        alloc.previous_allocation = prevs[i]
+                    append(alloc)
+                    appended += 1
             # Any slot that did not yield a plan alloc — including a failed
             # host-side network offer — is a placement failure and must
             # produce a blocked eval (generic_sched.go:218), not a silent
             # under-placement.
-            if appended < len(tups):
+            if appended < len(names):
                 if sched.failed_tg_allocs is None:
                     sched.failed_tg_allocs = {}
                 sched.failed_tg_allocs[tg.name] = metric
@@ -762,13 +850,22 @@ class BatchStats:
         self.num_asks = 0
         self.encode_seconds = 0.0
         self.device_seconds = 0.0
+        self.phase1_seconds = 0.0
+        self.phase2_seconds = 0.0
+        self.metrics_seconds = 0.0
+        self.finalize_seconds = 0.0
         self.total_seconds = 0.0
         self.rounds = 0
 
     def __repr__(self) -> str:
         return (f"BatchStats(evals={self.num_evals} specs={self.num_specs} "
-                f"asks={self.num_asks} encode={self.encode_seconds:.3f}s "
-                f"device={self.device_seconds:.3f}s total={self.total_seconds:.3f}s "
+                f"asks={self.num_asks} phase1={self.phase1_seconds:.3f}s "
+                f"phase2={self.phase2_seconds:.3f}s "
+                f"encode={self.encode_seconds:.3f}s "
+                f"device={self.device_seconds:.3f}s "
+                f"metrics={self.metrics_seconds:.3f}s "
+                f"finalize={self.finalize_seconds:.3f}s "
+                f"total={self.total_seconds:.3f}s "
                 f"rounds={self.rounds})")
 
 

@@ -282,8 +282,6 @@ class PlacementSpec:
     job: s.Job
     tg: s.TaskGroup
     names: List[str]                    # alloc names to materialize, len=count
-    prev_alloc_ids: List[Optional[str]]
-    eval_ids: List[str]                 # parallel to names: owning eval
     ask: np.ndarray = None              # [4] int64
     priority: int = 50
     anti_affinity_penalty: float = 20.0
@@ -318,8 +316,6 @@ def build_spec(job: s.Job, tg: s.TaskGroup, batch_penalty: bool) -> PlacementSpe
         job=job,
         tg=tg,
         names=[],
-        prev_alloc_ids=[],
-        eval_ids=[],
         ask=_res_vec(tup.size),
         priority=job.priority,
         anti_affinity_penalty=10.0 if batch_penalty else 20.0,

@@ -63,6 +63,7 @@ class Harness:
             result = s.PlanResult(
                 node_update=plan.node_update,
                 node_allocation=plan.node_allocation,
+                alloc_slabs=plan.alloc_slabs,
                 alloc_index=index,
             )
 
@@ -76,8 +77,13 @@ class Harness:
                 for alloc in allocs:
                     if alloc.job is None:
                         alloc.job = plan.job
+                for slab in plan.alloc_slabs:
+                    if slab.proto.job is None:
+                        slab.proto.job = plan.job
 
             self.state.upsert_allocs(index, allocs, owned=True)
+            if plan.alloc_slabs:
+                self.state.upsert_slabs(index, plan.alloc_slabs)
             return result, None
 
     def update_eval(self, ev: s.Evaluation) -> None:

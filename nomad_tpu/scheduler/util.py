@@ -434,6 +434,16 @@ def adjust_queued_allocations(
                 logger.error(
                     "allocation %r placed but not in list of unplaced allocations",
                     allocation.task_group)
+    for slab in result.alloc_slabs:
+        if slab.create_index != slab.modify_index:
+            continue
+        tg = slab.proto.task_group
+        if tg in queued_allocs:
+            queued_allocs[tg] -= len(slab)
+        else:
+            logger.error(
+                "allocation %r placed but not in list of unplaced allocations",
+                tg)
 
 
 def update_non_terminal_allocs_to_lost(

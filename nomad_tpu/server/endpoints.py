@@ -235,8 +235,17 @@ def register_endpoints(server, rpc) -> None:
         plan = from_wire(s.Plan, body["Plan"])
         future = server.plan_submit(plan)
         # Bounded: a dropped plan (leadership churn) responds with an
-        # error; an unresponsive applier must not pin this thread.
-        result = future.wait(timeout=60.0)
+        # error; an unresponsive applier must not pin this thread.  On
+        # timeout, cancel-if-unclaimed: either the applier never saw the
+        # plan (safe for the worker to replan) or it owns it and will
+        # respond — keep waiting a grace period rather than let the same
+        # placements commit twice.
+        try:
+            result = future.wait(timeout=60.0)
+        except TimeoutError:
+            if future.cancel():
+                raise
+            result = future.wait(timeout=540.0)
         return {"Result": to_wire(result) if result is not None else None}
 
     register("Plan.Submit", plan_submit)

@@ -168,7 +168,8 @@ class FSM:
     # -- plan results ------------------------------------------------------
 
     def _apply_plan_results(self, index: int, req: dict):
-        self.state.upsert_plan_results(index, req.get("job"), req["allocs"])
+        self.state.upsert_plan_results(index, req.get("job"), req["allocs"],
+                                       req.get("slabs"))
 
     # -- summaries / vault / periodic --------------------------------------
 

@@ -69,6 +69,12 @@ class PlanApplier:
             if item is None:
                 continue
             plan, future = item
+            if not future.claim():
+                # Submitter gave up (RPC deadline) before we started:
+                # skipping here is what makes its replan safe.
+                self.logger.warning("plan for eval %s was cancelled before "
+                                    "apply; dropping", plan.eval_id)
+                continue
             snap = self.raft.fsm.state.snapshot()
 
             try:
@@ -79,7 +85,7 @@ class PlanApplier:
                 future.respond(None, exc)
                 continue
 
-            if result.node_update or result.node_allocation:
+            if result.node_update or result.node_allocation or result.alloc_slabs:
                 try:
                     with self.metrics.measure("plan.apply"):
                         index = self.apply_plan(plan, result, snap)
@@ -98,13 +104,21 @@ class PlanApplier:
 
     def evaluate_plan(self, snap, plan: s.Plan) -> s.PlanResult:
         """Determine the committable subset (plan_apply.go:202
-        evaluatePlan): per-node fit re-check, partial or gang commit."""
+        evaluatePlan): per-node fit re-check, partial or gang commit.
+        Columnar alloc slabs (the TPU batch path) are kept whole on a full
+        commit and filtered per node on a partial one."""
         result = s.PlanResult(node_update={}, node_allocation={})
-        node_ids = list({*plan.node_update, *plan.node_allocation})
+        touched = {*plan.node_update, *plan.node_allocation}
+        for slab in plan.alloc_slabs:
+            touched.update(slab.node_ids)
+        node_ids = list(touched)
 
-        fits = self._evaluate_nodes(snap, plan, node_ids)
+        slab_adds = self._slab_node_adds(plan)
+        fits = self._evaluate_nodes(snap, plan, node_ids, slab_adds)
 
         partial = False
+        gang_failed = False
+        ok_nodes = set()
         for node_id, fit in fits.items():
             if not fit:
                 partial = True
@@ -112,26 +126,52 @@ class PlanApplier:
                     # gang semantics: all or nothing
                     result.node_update = {}
                     result.node_allocation = {}
+                    gang_failed = True
                     break
                 continue
+            ok_nodes.add(node_id)
             if plan.node_update.get(node_id):
                 result.node_update[node_id] = plan.node_update[node_id]
             if plan.node_allocation.get(node_id):
                 result.node_allocation[node_id] = plan.node_allocation[node_id]
+
+        if not gang_failed:
+            for slab in plan.alloc_slabs:
+                if not partial:
+                    result.alloc_slabs.append(slab)
+                else:
+                    filtered = slab.filter_nodes(ok_nodes)
+                    if len(filtered):
+                        result.alloc_slabs.append(filtered)
 
         if partial:
             result.refresh_index = max(
                 snap.table_index("nodes"), snap.table_index("allocs"))
         return result
 
-    def _evaluate_nodes(self, snap, plan: s.Plan, node_ids: List[str]) -> Dict[str, bool]:
-        if len(node_ids) >= VECTORIZE_THRESHOLD:
-            return self._evaluate_nodes_vectorized(snap, plan, node_ids)
-        return {nid: self._evaluate_node_plan(snap, plan, nid) for nid in node_ids}
+    @staticmethod
+    def _slab_node_adds(plan: s.Plan) -> Dict[str, List[Tuple[s.Allocation, int]]]:
+        """Per-node (proto, count) additions proposed by the plan's slabs."""
+        out: Dict[str, List[Tuple[s.Allocation, int]]] = {}
+        for slab in plan.alloc_slabs:
+            for nid, cnt in slab.node_counts().items():
+                out.setdefault(nid, []).append((slab.proto, cnt))
+        return out
 
-    def _evaluate_node_plan(self, snap, plan: s.Plan, node_id: str) -> bool:
+    def _evaluate_nodes(self, snap, plan: s.Plan, node_ids: List[str],
+                        slab_adds: Optional[Dict] = None) -> Dict[str, bool]:
+        slab_adds = slab_adds or {}
+        if len(node_ids) >= VECTORIZE_THRESHOLD:
+            return self._evaluate_nodes_vectorized(snap, plan, node_ids,
+                                                   slab_adds)
+        return {nid: self._evaluate_node_plan(snap, plan, nid, slab_adds)
+                for nid in node_ids}
+
+    def _evaluate_node_plan(self, snap, plan: s.Plan, node_id: str,
+                            slab_adds: Optional[Dict] = None) -> bool:
         """(plan_apply.go:327 evaluateNodePlan)."""
-        if not plan.node_allocation.get(node_id):
+        slab_here = (slab_adds or {}).get(node_id, [])
+        if not plan.node_allocation.get(node_id) and not slab_here:
             return True  # evict-only always fits
         node = snap.node_by_id(None, node_id)
         if node is None or node.status != s.NODE_STATUS_READY or node.drain:
@@ -141,6 +181,8 @@ class PlanApplier:
         remove.extend(plan.node_allocation.get(node_id, []))
         proposed = remove_allocs(existing, remove)
         proposed = proposed + list(plan.node_allocation.get(node_id, []))
+        for proto, cnt in slab_here:
+            proposed.extend([proto] * cnt)
         try:
             fit, _, _ = allocs_fit(node, proposed)
         except ValueError:
@@ -148,7 +190,8 @@ class PlanApplier:
         return fit
 
     def _evaluate_nodes_vectorized(
-        self, snap, plan: s.Plan, node_ids: List[str]
+        self, snap, plan: s.Plan, node_ids: List[str],
+        slab_adds: Optional[Dict] = None,
     ) -> Dict[str, bool]:
         """Batched re-check: one kernel call replaces the reference's
         NumCPU/2 verification pool (scalar network checks retained
@@ -166,10 +209,12 @@ class PlanApplier:
                 return np.zeros(4, dtype=np.int64)
             return np.array([r.cpu, r.memory_mb, r.disk_mb, r.iops], dtype=np.int64)
 
+        slab_adds = slab_adds or {}
         alloc_only: List[bool] = []
         scalar_fallback: Dict[str, bool] = {}
         for i, node_id in enumerate(node_ids):
-            if not plan.node_allocation.get(node_id):
+            slab_here = slab_adds.get(node_id, [])
+            if not plan.node_allocation.get(node_id) and not slab_here:
                 alloc_only.append(True)
                 continue
             alloc_only.append(False)
@@ -195,11 +240,15 @@ class PlanApplier:
                     for tr in alloc.task_resources.values():
                         used[i] += res_vec(tr)
                         has_networks = has_networks or bool(tr.networks)
+            for proto, cnt in slab_here:
+                used[i] += cnt * res_vec(proto.resources)
+                has_networks = has_networks or bool(
+                    proto.resources is not None and proto.resources.networks)
             if has_networks:
                 # Port/bandwidth accounting stays host-side: full scalar
                 # re-check for nodes with network reservations.
                 scalar_fallback[node_id] = self._evaluate_node_plan(
-                    snap, plan, node_id)
+                    snap, plan, node_id, slab_adds)
 
         fit, _ = batch_allocs_fit(
             jnp.asarray(capacity, dtype=jnp.int32),
@@ -231,7 +280,12 @@ class PlanApplier:
         for alloc in allocs:
             if alloc.create_time == 0:
                 alloc.create_time = now
+        for slab in result.alloc_slabs:
+            if slab.proto.create_time == 0:
+                slab.proto.create_time = now
 
         payload = {"job": plan.job, "allocs": allocs}
+        if result.alloc_slabs:
+            payload["slabs"] = result.alloc_slabs
         _, index = self.raft.apply(MessageType.APPLY_PLAN_RESULTS, payload)
         return index

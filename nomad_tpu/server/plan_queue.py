@@ -12,12 +12,38 @@ from ..structs import structs as s
 
 
 class PlanFuture:
-    """Future for a submitted plan's result."""
+    """Future for a submitted plan's result.
+
+    claim()/cancel() close the abandoned-plan race: a submitter whose
+    wait timed out cancels the future, and the applier claims it before
+    evaluating — so a plan is either cancelled (never applied; the
+    submitter may safely replan without double-committing placements) or
+    claimed (the applier owns it; the submitter must keep waiting)."""
 
     def __init__(self):
         self._event = threading.Event()
         self._result: Optional[s.PlanResult] = None
         self._error: Optional[Exception] = None
+        self._state_l = threading.Lock()
+        self._claimed = False
+        self._cancelled = False
+
+    def claim(self) -> bool:
+        """Applier-side: take ownership; False if already cancelled."""
+        with self._state_l:
+            if self._cancelled:
+                return False
+            self._claimed = True
+            return True
+
+    def cancel(self) -> bool:
+        """Submitter-side: abandon; False if the applier already owns it
+        (the plan may still commit — keep waiting)."""
+        with self._state_l:
+            if self._claimed:
+                return False
+            self._cancelled = True
+            return True
 
     def respond(self, result: Optional[s.PlanResult], error: Optional[Exception]):
         self._result = result

@@ -108,6 +108,19 @@ class WatchSet:
             st._unregister_watcher(self._event)
 
 
+class _SlabSlot:
+    """Lazy alloc-table entry: alloc i of a columnar AllocSlab
+    (structs.AllocSlab).  Bulk plan commits insert one slot per alloc in
+    O(columns); the full Allocation object is materialized (and cached
+    back into the table) on first read."""
+
+    __slots__ = ("slab", "i")
+
+    def __init__(self, slab, i: int):
+        self.slab = slab
+        self.i = i
+
+
 class StateStore:
     """The authoritative in-memory database of cluster state."""
 
@@ -172,6 +185,17 @@ class StateStore:
 
     def _bump(self, table: str, index: int) -> None:
         self._indexes[table] = index
+
+    # -- lazy slab resolution ---------------------------------------------
+
+    def _get_alloc(self, alloc_id: str) -> Optional[s.Allocation]:
+        """allocs_table read with slab-slot materialization + cache-back.
+        Caller holds the lock (or owns an immutable snapshot)."""
+        v = self.allocs_table.get(alloc_id)
+        if type(v) is _SlabSlot:
+            v = v.slab.materialize(v.i)
+            self.allocs_table[alloc_id] = v
+        return v
 
     def table_index(self, table: str) -> int:
         with self._lock:
@@ -557,7 +581,7 @@ class StateStore:
             # top-level index/status fields this method mutates below.
             if not owned:
                 alloc = s._fast_copy(alloc)
-            existing = self.allocs_table.get(alloc.id)
+            existing = self._get_alloc(alloc.id)
             if existing is None:
                 alloc.create_index = index
                 alloc.modify_index = index
@@ -593,7 +617,7 @@ class StateStore:
         """Merge client-authoritative fields (state_store.go:1367)."""
         with self._lock:
             for client_alloc in allocs:
-                existing = self.allocs_table.get(client_alloc.id)
+                existing = self._get_alloc(client_alloc.id)
                 if existing is None:
                     continue
                 updated = s._fast_copy(existing)
@@ -614,27 +638,34 @@ class StateStore:
         alloc = self.allocs_table.pop(alloc_id, None)
         if alloc is None:
             return
-        self._allocs_by_node[alloc.node_id].discard(alloc_id)
-        self._allocs_by_job[alloc.job_id].discard(alloc_id)
-        self._allocs_by_eval[alloc.eval_id].discard(alloc_id)
+        if type(alloc) is _SlabSlot:
+            node_id = alloc.slab.node_ids[alloc.i]
+            proto = alloc.slab.proto
+            job_id, eval_id = proto.job_id, proto.eval_id
+        else:
+            node_id, job_id, eval_id = alloc.node_id, alloc.job_id, alloc.eval_id
+        self._allocs_by_node[node_id].discard(alloc_id)
+        self._allocs_by_job[job_id].discard(alloc_id)
+        self._allocs_by_eval[eval_id].discard(alloc_id)
 
     def alloc_by_id(self, ws: Optional[WatchSet], alloc_id: str) -> Optional[s.Allocation]:
         if ws is not None:
             ws.add(self, "allocs")
         with self._lock:
-            return self.allocs_table.get(alloc_id)
+            return self._get_alloc(alloc_id)
 
     def allocs_by_id_prefix(self, ws: Optional[WatchSet], prefix: str) -> List[s.Allocation]:
         if ws is not None:
             ws.add(self, "allocs")
         with self._lock:
-            return [a for aid, a in self.allocs_table.items() if aid.startswith(prefix)]
+            return [self._get_alloc(aid) for aid in list(self.allocs_table)
+                    if aid.startswith(prefix)]
 
     def allocs_by_node(self, ws: Optional[WatchSet], node_id: str) -> List[s.Allocation]:
         if ws is not None:
             ws.add(self, "allocs")
         with self._lock:
-            return [self.allocs_table[aid] for aid in self._allocs_by_node.get(node_id, ())
+            return [self._get_alloc(aid) for aid in self._allocs_by_node.get(node_id, ())
                     if aid in self.allocs_table]
 
     def allocs_by_node_terminal(
@@ -651,7 +682,7 @@ class StateStore:
         if ws is not None:
             ws.add(self, "allocs")
         with self._lock:
-            out = [self.allocs_table[aid] for aid in self._allocs_by_job.get(job_id, ())
+            out = [self._get_alloc(aid) for aid in self._allocs_by_job.get(job_id, ())
                    if aid in self.allocs_table]
             if all_allocs:
                 return out
@@ -665,14 +696,14 @@ class StateStore:
         if ws is not None:
             ws.add(self, "allocs")
         with self._lock:
-            return [self.allocs_table[aid] for aid in self._allocs_by_eval.get(eval_id, ())
+            return [self._get_alloc(aid) for aid in self._allocs_by_eval.get(eval_id, ())
                     if aid in self.allocs_table]
 
     def allocs(self, ws: Optional[WatchSet] = None) -> List[s.Allocation]:
         if ws is not None:
             ws.add(self, "allocs")
         with self._lock:
-            return list(self.allocs_table.values())
+            return [self._get_alloc(aid) for aid in list(self.allocs_table)]
 
     # -- vault accessors ---------------------------------------------------
 
@@ -725,9 +756,12 @@ class StateStore:
     # -- plan application --------------------------------------------------
 
     def upsert_plan_results(self, index: int, job: Optional[s.Job],
-                            allocs: List[s.Allocation]) -> None:
+                            allocs: List[s.Allocation],
+                            slabs: Optional[List[s.AllocSlab]] = None) -> None:
         """Apply a committed plan: denormalize the job onto allocs, rebuild
-        combined resources, and upsert (state_store.go:89)."""
+        combined resources, and upsert (state_store.go:89).  Columnar
+        alloc slabs (the TPU batch path's bulk placements) are inserted in
+        O(columns) — see _upsert_slabs_impl."""
         with self._lock:
             for alloc in allocs:
                 if alloc.job is None and not alloc.terminal_status():
@@ -741,7 +775,73 @@ class StateStore:
             # Plan-result allocs are owned by the state store from here on
             # (the FSM decoded/constructed them; nothing else mutates them).
             self._upsert_allocs_impl(index, allocs, owned=True)
+            if slabs:
+                for slab in slabs:
+                    p = slab.proto
+                    if p.job is None and not p.terminal_status():
+                        p.job = job
+                self._upsert_slabs_impl(index, slabs)
         self._notify()
+
+    def upsert_slabs(self, index: int, slabs: List[s.AllocSlab]) -> None:
+        """Bulk columnar insert (the TPU batch placement path)."""
+        with self._lock:
+            self._upsert_slabs_impl(index, slabs)
+        self._notify()
+
+    def _upsert_slabs_impl(self, index: int, slabs: List[s.AllocSlab]) -> None:
+        """Insert a fresh-allocation slab per _SlabSlot: per-alloc work is
+        three index inserts and one slot object; everything else (summary,
+        job status, create/modify indexes) is amortized across the slab.
+        Slab allocs are always NEW (fresh uuids from the batch scheduler) —
+        the update/merge semantics of _upsert_allocs_impl don't apply."""
+        jobs: Dict[str, str] = {}
+        for slab in slabs:
+            ids = slab.ids
+            if not ids:
+                continue
+            slab.create_index = index
+            slab.modify_index = index
+            proto = slab.proto
+            self._allocs_by_job[proto.job_id].update(ids)
+            self._allocs_by_eval[proto.eval_id].update(ids)
+            by_node = self._allocs_by_node
+            for nid, aid in zip(slab.node_ids, ids):
+                by_node[nid].add(aid)
+            table = self.allocs_table
+            slot = _SlabSlot
+            for i, aid in enumerate(ids):
+                table[aid] = slot(slab, i)
+            self._update_summary_bulk(index, proto, len(ids))
+            if proto.job is not None:
+                forced = ("" if proto.terminal_status()
+                          else s.JOB_STATUS_RUNNING)
+                jobs[proto.job_id] = jobs.get(proto.job_id) or forced
+        self._set_job_statuses(index, jobs, eval_delete=False)
+        self._bump("allocs", index)
+
+    def _update_summary_bulk(self, index: int, proto: s.Allocation,
+                             n: int) -> None:
+        """n fresh pending allocs of one (job, tg) — the bulk equivalent of
+        n _update_summary_with_alloc(existing=None) calls."""
+        job = proto.job
+        if job is None:
+            return
+        summary = self.job_summary_table.get(proto.job_id)
+        if summary is None or summary.create_index != job.create_index:
+            return
+        tgs_ref = summary.summary.get(proto.task_group)
+        if tgs_ref is None:
+            return
+        if proto.client_status != s.ALLOC_CLIENT_STATUS_PENDING:
+            return
+        summary = summary.copy()
+        tgs = summary.summary[proto.task_group]
+        tgs.starting += n
+        tgs.queued = max(0, tgs.queued - n)
+        summary.modify_index = index
+        self.job_summary_table[proto.job_id] = summary
+        self._bump("job_summary", index)
 
     # -- job status machinery ---------------------------------------------
 
@@ -791,6 +891,10 @@ class StateStore:
             alloc = self.allocs_table.get(aid)
             if alloc is None:
                 continue
+            if type(alloc) is _SlabSlot:
+                # Status fields live on the shared proto (a client update
+                # replaces the slot with a real object) — no materialize.
+                alloc = alloc.slab.proto
             has_alloc = True
             if not alloc.terminal_status():
                 return s.JOB_STATUS_RUNNING
@@ -884,6 +988,8 @@ class StateStore:
                     summary.summary[tg.name] = s.TaskGroupSummary()
                 for aid in self._allocs_by_job.get(job.id, ()):
                     alloc = self.allocs_table.get(aid)
+                    if type(alloc) is _SlabSlot:
+                        alloc = alloc.slab.proto
                     if alloc is None or alloc.task_group not in summary.summary:
                         continue
                     tgs = summary.summary[alloc.task_group]
@@ -913,7 +1019,14 @@ class StateStore:
                 "job_versions": self.job_versions,
                 "job_summary": self.job_summary_table,
                 "evals": self.evals_table,
-                "allocs": self.allocs_table,
+                # Slab slots are materialized for the snapshot blob ONLY
+                # (no cache-back): the blob format stays plain Allocation
+                # rows (fsm.go:568) while the live table keeps its compact
+                # columnar slots.
+                "allocs": {
+                    aid: (v.slab.materialize(v.i) if type(v) is _SlabSlot
+                          else v)
+                    for aid, v in self.allocs_table.items()},
                 "periodic_launch": self.periodic_launch_table,
                 "vault_accessors": self.vault_accessors_table,
                 "indexes": self._indexes,

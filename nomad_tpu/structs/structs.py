@@ -1068,6 +1068,69 @@ class Evaluation:
 
 
 @dataclass
+class AllocSlab:
+    """Columnar batch of placements sharing one prototype allocation.
+
+    The TPU batch scheduler places tens of thousands of near-identical
+    task-group instances per device dispatch; materializing a full
+    Allocation object per placement is the dominant host-side cost at
+    that scale.  A slab stores the shared prototype ONCE plus per-alloc
+    columns (id, name, node, previous-alloc) and materializes Allocation
+    objects lazily on read — the same pointer-sharing go-memdb relies on
+    (the reference inserts the FSM's pointers outright,
+    state_store.go:1435), taken to its SoA conclusion.
+
+    ``prev_ids`` uses "" for "no previous allocation" so the slab stays
+    a plain data-only msgpack tree on the replicated log (log_codec)."""
+
+    proto: Optional[Allocation] = None
+    ids: List[str] = field(default_factory=list)
+    names: List[str] = field(default_factory=list)
+    node_ids: List[str] = field(default_factory=list)
+    prev_ids: List[str] = field(default_factory=list)
+    create_index: int = 0
+    modify_index: int = 0
+
+    def __len__(self) -> int:
+        return len(self.ids)
+
+    def materialize(self, i: int) -> Allocation:
+        a = _fast_copy(self.proto)
+        a.id = self.ids[i]
+        a.name = self.names[i]
+        a.node_id = self.node_ids[i]
+        if self.prev_ids and self.prev_ids[i]:
+            a.previous_allocation = self.prev_ids[i]
+        a.create_index = self.create_index
+        a.modify_index = self.modify_index
+        a.alloc_modify_index = self.modify_index
+        return a
+
+    def allocs(self) -> List[Allocation]:
+        return [self.materialize(i) for i in range(len(self.ids))]
+
+    def node_counts(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for nid in self.node_ids:
+            counts[nid] = counts.get(nid, 0) + 1
+        return counts
+
+    def filter_nodes(self, keep: set) -> "AllocSlab":
+        """Slab restricted to placements on ``keep`` nodes (partial plan
+        commit, plan_apply.go:242)."""
+        idx = [i for i, nid in enumerate(self.node_ids) if nid in keep]
+        return AllocSlab(
+            proto=self.proto,
+            ids=[self.ids[i] for i in idx],
+            names=[self.names[i] for i in idx],
+            node_ids=[self.node_ids[i] for i in idx],
+            prev_ids=[self.prev_ids[i] for i in idx] if self.prev_ids else [],
+            create_index=self.create_index,
+            modify_index=self.modify_index,
+        )
+
+
+@dataclass
 class Plan:
     """The scheduler's proposed state mutation, submitted for optimistic
     apply (structs.go:4477-4570)."""
@@ -1079,6 +1142,7 @@ class Plan:
     job: Optional[Job] = None
     node_update: Dict[str, List[Allocation]] = field(default_factory=dict)
     node_allocation: Dict[str, List[Allocation]] = field(default_factory=dict)
+    alloc_slabs: List[AllocSlab] = field(default_factory=list)
     annotations: Optional["PlanAnnotations"] = None
 
     def append_update(
@@ -1116,12 +1180,17 @@ class Plan:
     def append_alloc(self, alloc: Allocation) -> None:
         self.node_allocation.setdefault(alloc.node_id, []).append(alloc)
 
+    def append_slab(self, slab: AllocSlab) -> None:
+        self.alloc_slabs.append(slab)
+
     def is_no_op(self) -> bool:
-        return not self.node_update and not self.node_allocation
+        return (not self.node_update and not self.node_allocation
+                and not self.alloc_slabs)
 
     def total_allocs(self) -> int:
-        return sum(len(v) for v in self.node_allocation.values()) + sum(
-            len(v) for v in self.node_update.values())
+        return (sum(len(v) for v in self.node_allocation.values())
+                + sum(len(v) for v in self.node_update.values())
+                + sum(len(sl) for sl in self.alloc_slabs))
 
 
 @dataclass
@@ -1130,6 +1199,7 @@ class PlanResult:
 
     node_update: Dict[str, List[Allocation]] = field(default_factory=dict)
     node_allocation: Dict[str, List[Allocation]] = field(default_factory=dict)
+    alloc_slabs: List[AllocSlab] = field(default_factory=list)
     refresh_index: int = 0
     alloc_index: int = 0
 
@@ -1143,6 +1213,8 @@ class PlanResult:
         for node, allocs in plan.node_allocation.items():
             expected += len(allocs)
             actual += len(self.node_allocation.get(node, []))
+        expected += sum(len(sl) for sl in plan.alloc_slabs)
+        actual += sum(len(sl) for sl in self.alloc_slabs)
         return actual == expected, expected, actual
 
 

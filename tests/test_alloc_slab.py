@@ -1,0 +1,225 @@
+"""Columnar AllocSlab path: state-store equivalence with per-object
+upserts, plan-applier partial/gang commits, log-codec round-trip, and
+snapshot persistence (the bulk-placement machinery behind the TPU batch
+scheduler's finalize phase)."""
+from __future__ import annotations
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import structs as s
+
+
+def _proto(job, ev_id="ev-1"):
+    """Prototype like batch_sched._finalize builds — the slab path only
+    serves no-network specs (network asks take the per-alloc offer path),
+    so the mock tasks' network asks are stripped."""
+    tg = job.task_groups[0]
+    for t in tg.tasks:
+        t.resources.networks = []
+    combined = s.Resources(disk_mb=tg.ephemeral_disk.size_mb)
+    for t in tg.tasks:
+        combined.add(t.resources)
+    return s.Allocation(
+        eval_id=ev_id,
+        job_id=job.id,
+        job=job,
+        task_group=tg.name,
+        resources=combined,
+        task_resources={t.name: t.resources.copy() for t in tg.tasks},
+        desired_status=s.ALLOC_DESIRED_STATUS_RUN,
+        client_status=s.ALLOC_CLIENT_STATUS_PENDING,
+        shared_resources=s.Resources(disk_mb=tg.ephemeral_disk.size_mb),
+    )
+
+
+def _slab(job, nodes, ev_id="ev-1"):
+    k = len(nodes)
+    return s.AllocSlab(
+        proto=_proto(job, ev_id),
+        ids=s.generate_uuids(k),
+        names=[f"{job.name}.{job.task_groups[0].name}[{i}]" for i in range(k)],
+        node_ids=list(nodes),
+    )
+
+
+def _store_with_job(n_nodes=3, job=None):
+    store = StateStore()
+    if job is None:
+        job = mock.job()
+        job.task_groups[0].count = n_nodes
+    store.upsert_job(1, job)
+    # Real flows thread the STATE-STORED job (with its create_index) into
+    # plans/allocs; use it so the summary create_index guard matches.
+    job = store.job_by_id(None, job.id)
+    nodes = []
+    for i in range(n_nodes):
+        node = mock.node()
+        node.id = f"node-{i}"
+        store.upsert_node(2, node)
+        nodes.append(node)
+    return store, job, nodes
+
+
+def test_slab_upsert_equivalent_to_object_upsert():
+    """A slab insert must leave the store observably identical to
+    inserting the same allocs as objects."""
+    store_a, job_a, nodes_a = _store_with_job()
+    store_b, job_b, _ = _store_with_job(job=job_a)
+    node_ids = [n.id for n in nodes_a]
+
+    slab = _slab(job_a, node_ids)
+    store_a.upsert_slabs(10, [slab])
+
+    allocs = []
+    for i, nid in enumerate(node_ids):
+        a = _proto(job_b)
+        a.id = slab.ids[i]
+        a.name = slab.names[i]
+        a.node_id = nid
+        allocs.append(a)
+    store_b.upsert_allocs(10, allocs, owned=True)
+
+    got_a = sorted(store_a.allocs(None), key=lambda a: a.id)
+    got_b = sorted(store_b.allocs(None), key=lambda a: a.id)
+    assert [a.id for a in got_a] == [a.id for a in got_b]
+    for x, y in zip(got_a, got_b):
+        assert (x.name, x.node_id, x.job_id, x.create_index, x.modify_index,
+                x.client_status) == (
+            y.name, y.node_id, y.job_id, y.create_index, y.modify_index,
+            y.client_status)
+
+    # Secondary indexes behave identically.
+    for nid in node_ids:
+        assert ([a.id for a in store_a.allocs_by_node(None, nid)]
+                == [a.id for a in store_b.allocs_by_node(None, nid)])
+    assert (len(store_a.allocs_by_job(None, job_a.id, True))
+            == len(store_b.allocs_by_job(None, job_b.id, True)))
+    assert (len(store_a.allocs_by_eval(None, "ev-1"))
+            == len(store_b.allocs_by_eval(None, "ev-1")))
+
+    # Summary bulk update matches the per-alloc accounting.
+    sum_a = store_a.job_summary_by_id(None, job_a.id)
+    sum_b = store_b.job_summary_by_id(None, job_b.id)
+    tg = job_a.task_groups[0].name
+    assert sum_a.summary[tg].starting == sum_b.summary[tg].starting == 3
+    # Job flipped to running both ways.
+    assert store_a.job_by_id(None, job_a.id).status == s.JOB_STATUS_RUNNING
+
+
+def test_slab_lazy_materialization_caches():
+    store, job, nodes = _store_with_job()
+    slab = _slab(job, [n.id for n in nodes])
+    store.upsert_slabs(10, [slab])
+    aid = slab.ids[1]
+    a1 = store.alloc_by_id(None, aid)
+    a2 = store.alloc_by_id(None, aid)
+    assert a1 is a2, "materialized alloc should be cached back"
+    assert a1.node_id == nodes[1].id
+    assert a1.create_index == 10 and a1.modify_index == 10
+
+
+def test_slab_client_update_and_remove():
+    store, job, nodes = _store_with_job()
+    slab = _slab(job, [n.id for n in nodes])
+    store.upsert_slabs(10, [slab])
+
+    upd = s.Allocation(id=slab.ids[0],
+                       client_status=s.ALLOC_CLIENT_STATUS_RUNNING)
+    store.update_allocs_from_client(11, [upd])
+    got = store.alloc_by_id(None, slab.ids[0])
+    assert got.client_status == s.ALLOC_CLIENT_STATUS_RUNNING
+    # Siblings untouched (still pending via the shared proto).
+    assert (store.alloc_by_id(None, slab.ids[1]).client_status
+            == s.ALLOC_CLIENT_STATUS_PENDING)
+
+
+def test_plan_result_full_commit_counts_slabs():
+    store, job, nodes = _store_with_job()
+    plan = s.Plan(eval_id="ev-1", job=job)
+    plan.append_slab(_slab(job, [n.id for n in nodes]))
+    assert not plan.is_no_op()
+    assert plan.total_allocs() == 3
+
+    result = s.PlanResult(alloc_slabs=list(plan.alloc_slabs))
+    ok, expected, actual = result.full_commit(plan)
+    assert ok and expected == 3 and actual == 3
+
+    partial = s.PlanResult(
+        alloc_slabs=[plan.alloc_slabs[0].filter_nodes({nodes[0].id})])
+    ok, expected, actual = partial.full_commit(plan)
+    assert not ok and expected == 3 and actual == 1
+
+
+def test_plan_apply_partial_commit_filters_slab():
+    """A slab node that fails the fit re-check is dropped; survivors
+    commit (plan_apply.go:202 evaluatePlan semantics)."""
+    from nomad_tpu.server.fsm import FSM
+    from nomad_tpu.server.plan_apply import PlanApplier
+    from nomad_tpu.server.plan_queue import PlanQueue
+    from nomad_tpu.server.raft import RaftLog
+
+    store, job, nodes = _store_with_job()
+    # Fill node-0 to the brim so the slab's placement there fails.
+    hog = _proto(job, ev_id="ev-0")
+    hog.id = s.generate_uuid()
+    hog.name = "hog"
+    hog.node_id = nodes[0].id
+    hog.resources = s.Resources(cpu=nodes[0].resources.cpu,
+                                memory_mb=nodes[0].resources.memory_mb)
+    store.upsert_allocs(5, [hog], owned=True)
+
+    fsm = FSM(state=store)
+    raft = RaftLog(fsm)
+    applier = PlanApplier(PlanQueue(), raft)
+
+    plan = s.Plan(eval_id="ev-1", job=job)
+    plan.append_slab(_slab(job, [n.id for n in nodes]))
+    snap = store.snapshot()
+    result = applier.evaluate_plan(snap, plan)
+    committed = {nid for sl in result.alloc_slabs for nid in sl.node_ids}
+    assert nodes[0].id not in committed
+    assert committed == {nodes[1].id, nodes[2].id}
+    assert result.refresh_index > 0
+
+    # Gang semantics: all-or-nothing.
+    gang = s.Plan(eval_id="ev-2", job=job, all_at_once=True)
+    gang.append_slab(_slab(job, [n.id for n in nodes], ev_id="ev-2"))
+    gang_result = applier.evaluate_plan(snap, gang)
+    assert not gang_result.alloc_slabs
+    assert not gang_result.node_allocation
+
+    # Applying the partial result lands exactly the committed subset.
+    applier.apply_plan(plan, result, snap)
+    placed = store.allocs_by_eval(None, "ev-1")
+    assert sorted(a.node_id for a in placed) == sorted(committed)
+    for a in placed:
+        assert a.job is not None and a.create_time > 0
+
+
+def test_slab_log_codec_roundtrip():
+    from nomad_tpu.server.log_codec import decode_payload, encode_payload
+
+    _, job, nodes = _store_with_job()
+    slab = _slab(job, [n.id for n in nodes])
+    blob = encode_payload({"job": job, "slabs": [slab], "allocs": []})
+    out = decode_payload(blob)
+    got = out["slabs"][0]
+    assert isinstance(got, s.AllocSlab)
+    assert got.ids == slab.ids
+    assert got.node_ids == slab.node_ids
+    assert got.proto.job_id == job.id
+
+
+def test_persist_restore_materializes_slabs():
+    store, job, nodes = _store_with_job()
+    slab = _slab(job, [n.id for n in nodes])
+    store.upsert_slabs(10, [slab])
+    blob = store.persist()
+    restored = StateStore.restore(blob)
+    got = sorted(restored.allocs(None), key=lambda a: a.id)
+    assert [a.id for a in got] == sorted(slab.ids)
+    assert all(a.node_id for a in got)
+    # Indexes rebuilt.
+    assert len(restored.allocs_by_job(None, job.id, True)) == 3
