@@ -149,7 +149,8 @@ class NullPlanner:
         from nomad_tpu.structs import structs as s
 
         return s.PlanResult(node_update=plan.node_update,
-                            node_allocation=plan.node_allocation), None
+                            node_allocation=plan.node_allocation,
+                            alloc_slabs=plan.alloc_slabs), None
 
     def update_eval(self, ev):
         pass

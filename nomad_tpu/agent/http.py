@@ -117,6 +117,12 @@ class HTTPServer:
         r("/v1/catalog/service/(?P<name>[^/]+)", self.catalog_service_request)
         r("/v1/metrics", self.metrics_request)
         r("/v1/kv/(?P<key>.*)", self.kv_request)
+        # Debug/profiling surface, gated by enable_debug — the reference
+        # mounts net/http/pprof the same way (command/agent/http.go:173).
+        r("/debug/pprof/profile", self.debug_profile_request)
+        r("/debug/pprof/heap", self.debug_heap_request)
+        r("/debug/pprof/threads", self.debug_threads_request)
+        r("/debug/pprof/trace", self.debug_trace_request)
 
     def _route(self, pattern: str, fn: Callable) -> None:
         self.routes.append((pattern, re.compile("^" + pattern + "$"), fn))
@@ -670,6 +676,51 @@ class HTTPServer:
         """In-memory telemetry aggregates (the reference's go-metrics
         inventory; names per telemetry.html.md)."""
         return self.server.metrics.sink.data(), None
+
+    # -- debug / profiling (pprof equivalent) --------------------------
+
+    def _require_debug(self) -> None:
+        if not self.agent.config.enable_debug:
+            raise CodedError(404, "debug endpoints disabled "
+                                  "(set enable_debug = true)")
+
+    def debug_profile_request(self, req, query):
+        """Process CPU profile over a bounded window
+        (/debug/pprof/profile?seconds=N equivalent)."""
+        self._require_debug()
+        from ..utils import profiling
+
+        seconds = float(query.get("seconds", "1"))
+        text = profiling.cpu_profile(
+            seconds, sort=query.get("sort", "cumulative"),
+            top=int(query.get("top", "60")))
+        return {"Seconds": seconds, "Profile": text}, None
+
+    def debug_heap_request(self, req, query):
+        """tracemalloc top allocation sites (/debug/pprof/heap)."""
+        self._require_debug()
+        from ..utils import profiling
+
+        return profiling.heap_profile(int(query.get("top", "40"))), None
+
+    def debug_threads_request(self, req, query):
+        """All-thread stack dump (/debug/pprof/goroutine?debug=2)."""
+        self._require_debug()
+        from ..utils import profiling
+
+        return {"Stacks": profiling.thread_dump()}, None
+
+    def debug_trace_request(self, req, query):
+        """Bounded JAX device trace for TensorBoard/XProf — the
+        device-side pprof replacement (SURVEY.md §5)."""
+        self._require_debug()
+        from ..utils import profiling
+
+        tracer = getattr(self.agent, "_device_tracer", None)
+        if tracer is None:
+            tracer = profiling.DeviceTracer()
+            self.agent._device_tracer = tracer
+        return tracer.capture(float(query.get("seconds", "1"))), None
 
     def kv_request(self, req, query, key: str):
         """Consul-KV-shaped store feeding task templates

@@ -230,26 +230,19 @@ class TPUBatchScheduler:
         stats.num_asks = sum(sp.count for sp in spec_list)
         stats.phase2_seconds = time.monotonic() - t_phase2
 
-        assignments: Dict[Tuple[str, str], List[Tuple[str, int]]] = {}
+        # Per-spec flat slot lists (node id per placement), expanded on
+        # the numpy side in _place_on_device.
+        expanded: Dict[Tuple[str, str], List[str]] = {}
         unplaced: Dict[Tuple[str, str], int] = {}
         per_spec_metrics: Dict[Tuple[str, str], s.AllocMetric] = {}
 
         if spec_list:
-            assignments, unplaced, per_spec_metrics, kstats = self._place_on_device(
+            expanded, unplaced, per_spec_metrics, kstats = self._place_on_device(
                 spec_list)
             stats.device_seconds = kstats["device_seconds"]
             stats.encode_seconds = kstats["encode_seconds"]
             stats.metrics_seconds = kstats["metrics_seconds"]
             stats.rounds = kstats["rounds"]
-
-        # Expand per-spec (node, count) assignments into flat slot lists —
-        # once for the whole batch, not per eval.
-        expanded: Dict[Tuple[str, str], List[str]] = {}
-        for key, node_counts in assignments.items():
-            slots: List[str] = []
-            for node_id, cnt in node_counts:
-                slots.extend([node_id] * cnt)
-            expanded[key] = slots
 
         # Phase 3: materialize allocs into each eval's plan and submit.
         t_final = time.monotonic()
@@ -324,9 +317,15 @@ class TPUBatchScheduler:
 
         attr_targets, literals = encode.collect_attr_targets(spec_list)
         allocs_by_node: Dict[str, List[s.Allocation]] = defaultdict(list)
-        for alloc in self.state.allocs(None):
-            if not alloc.terminal_status():
-                allocs_by_node[alloc.node_id].append(alloc)
+        alloc_rows = getattr(self.state, "alloc_rows", None)
+        if alloc_rows is not None:
+            for node_id, row in alloc_rows(None):
+                if not row.terminal_status():
+                    allocs_by_node[node_id].append(row)
+        else:  # non-StateStore State implementations (test doubles)
+            for alloc in self.state.allocs(None):
+                if not alloc.terminal_status():
+                    allocs_by_node[alloc.node_id].append(alloc)
 
         with_networks = any(sp.net_active for sp in spec_list)
         ct = encode.encode_cluster(all_nodes, attr_targets, allocs_by_node,
@@ -340,11 +339,17 @@ class TPUBatchScheduler:
         # bottleneck at scale.
         node_index = {nid: i for i, nid in enumerate(ct.node_ids)}
         jc_entries: Dict[Tuple[int, int], int] = {}
+        rows_by_job = getattr(self.state, "alloc_rows_by_job", None)
         for j, job_id in enumerate(st.job_ids):
-            for alloc in self.state.allocs_by_job(None, job_id, False):
-                if alloc.terminal_status():
+            if rows_by_job is not None:
+                job_rows = rows_by_job(None, job_id)
+            else:
+                job_rows = [(a.node_id, a) for a in
+                            self.state.allocs_by_job(None, job_id, False)]
+            for node_id, row in job_rows:
+                if row.terminal_status():
                     continue
-                idx = node_index.get(alloc.node_id)
+                idx = node_index.get(node_id)
                 if idx is not None:
                     jc_entries[(j, idx)] = jc_entries.get((j, idx), 0) + 1
         k_jc = encode.pow2_bucket(max(1, len(jc_entries)), minimum=8)
@@ -447,17 +452,23 @@ class TPUBatchScheduler:
                                dtype=object),
                 "class_codes": None,
                 "class_names": None,
+                # dcs tuple → (evaluated mask, count): the np.isin over
+                # an object array costs ~ms at 50k nodes — once per DC
+                # set per batch, NOT once per failed spec.
+                "evaluated": {},
             }
-            eval_count_cache: Dict[Tuple[str, ...], int] = {}
+
+            def _evaluated_mask(sp) -> np.ndarray:
+                dcs = tuple(sp.datacenters)
+                ent = node_facts["evaluated"].get(dcs)
+                if ent is None:
+                    ent = node_facts["ready"] & np.isin(
+                        node_facts["dc"], list(dcs))
+                    node_facts["evaluated"][dcs] = ent
+                return ent
 
             def _evaluated_count(sp) -> int:
-                dcs = tuple(sp.datacenters)
-                n = eval_count_cache.get(dcs)
-                if n is None:
-                    n = int((node_facts["ready"] & np.isin(
-                        node_facts["dc"], list(dcs))).sum())
-                    eval_count_cache[dcs] = n
-                return n
+                return int(_evaluated_mask(sp).sum())
 
             need_rows = [int(u) for u in failed_u
                          if feas_count[u] < _evaluated_count(spec_list[u])]
@@ -469,33 +480,26 @@ class TPUBatchScheduler:
         device_seconds = time.monotonic() - t1
         t_metrics = time.monotonic()
 
-        # COO → per-spec (node, count, score) lists, grouped via one
-        # argsort instead of a python loop over every entry.
-        per_u_entries: Dict[int, List[Tuple[int, int, float, int]]] = {}
-        valid = coo_rows >= 0
+        # COO → per-spec placement slots, vectorized: nonzero emits rows
+        # in ascending order, so per-spec extents are searchsorted slices;
+        # slot node-ids come from ONE fancy-index over the interned id
+        # array + np.repeat of the counts — no per-entry python tuples.
+        valid = (coo_rows >= 0) & (coo_cols < ct.n_real)
         vr, vc = coo_rows[valid], coo_cols[valid]
         vcnt, vsc, vco = coo_counts[valid], coo_scores[valid], coo_coll[valid]
-        if len(vr):
-            order = np.argsort(vr, kind="stable")
-            vr, vc = vr[order], vc[order]
-            vcnt, vsc, vco = vcnt[order], vsc[order], vco[order]
-            uniq, starts = np.unique(vr, return_index=True)
-            bounds = np.append(starts, len(vr))
-            for k, u_ in enumerate(uniq):
-                lo, hi = bounds[k], bounds[k + 1]
-                per_u_entries[int(u_)] = list(zip(
-                    vc[lo:hi].tolist(), vcnt[lo:hi].tolist(),
-                    vsc[lo:hi].tolist(), vco[lo:hi].tolist()))
+        u_lo = np.searchsorted(vr, np.arange(len(spec_list)), side="left")
+        u_hi = np.searchsorted(vr, np.arange(len(spec_list)), side="right")
+        node_id_arr = np.array(ct.node_ids, dtype=object)
+        rep_ids = node_id_arr[np.repeat(vc, vcnt)]
+        csum = np.concatenate([[0], np.cumsum(vcnt, dtype=np.int64)])
 
-        assignments: Dict[Tuple[str, str], List[Tuple[str, int]]] = {}
+        expanded: Dict[Tuple[str, str], List[str]] = {}
         unplaced: Dict[Tuple[str, str], int] = {}
         metrics: Dict[Tuple[str, str], s.AllocMetric] = {}
         for u, sp in enumerate(spec_list):
             key = (sp.job.id, sp.tg.name)
-            entries = per_u_entries.get(u, [])
-            assignments[key] = [(ct.node_ids[i], cnt)
-                                for i, cnt, _sc, _co in entries
-                                if i < ct.n_real]
+            lo, hi = int(u_lo[u]), int(u_hi[u])
+            expanded[key] = rep_ids[csum[lo]:csum[hi]].tolist()
             unplaced[key] = int(unplaced_arr[u])
 
             # AllocMetric parity from kernel side-outputs
@@ -507,18 +511,16 @@ class TPUBatchScheduler:
             # binpack entry (rank.go:139) plus a separate anti-affinity
             # entry when the node had same-job collisions (rank.go:167).
             if with_scores:
-                for i, _cnt, sc, co in entries:
-                    if i < ct.n_real:
-                        m.score_node(all_nodes[i], "binpack", sc)
-                        if co > 0:
-                            m.score_node(
-                                all_nodes[i], "job-anti-affinity",
-                                -float(sp.anti_affinity_penalty) * co)
+                for i, sc, co in zip(vc[lo:hi].tolist(), vsc[lo:hi].tolist(),
+                                     vco[lo:hi].tolist()):
+                    m.score_node(all_nodes[i], "binpack", sc)
+                    if co > 0:
+                        m.score_node(
+                            all_nodes[i], "job-anti-affinity",
+                            -float(sp.anti_affinity_penalty) * co)
             if unplaced[key] > 0:
                 placed_row = np.zeros(ct.n_real, dtype=np.int32)
-                for i, cnt, _sc, _co in entries:
-                    if i < ct.n_real:
-                        placed_row[i] = cnt
+                placed_row[vc[lo:hi]] = vcnt[lo:hi]
                 self._fill_failure_metrics(
                     m, sp, all_nodes, ct, feas_rows.get(u), placed_row,
                     used_after, node_facts)
@@ -531,7 +533,7 @@ class TPUBatchScheduler:
             "metrics_seconds": time.monotonic() - t_metrics,
             "rounds": rounds,
         }
-        return assignments, unplaced, metrics, kstats
+        return expanded, unplaced, metrics, kstats
 
     def _fill_failure_metrics(self, m, sp, nodes, ct, feas_row, placed_row,
                               used_after, node_facts) -> None:
@@ -553,20 +555,36 @@ class TPUBatchScheduler:
         feas_r = (feas_row[:n_real].astype(bool) if feas_row is not None
                   else np.ones(n_real, dtype=bool))
         placed_r = placed_row[:n_real]
-        evaluated = node_facts["ready"] & np.isin(
-            node_facts["dc"], list(sp.datacenters))
+        dcs = tuple(sp.datacenters)
+        evaluated = node_facts["evaluated"].get(dcs)
+        if evaluated is None:
+            evaluated = node_facts["ready"] & np.isin(
+                node_facts["dc"], list(dcs))
+            node_facts["evaluated"][dcs] = evaluated
         m.nodes_evaluated = int(evaluated.sum())
         m.nodes_filtered = 0
 
         # -- exhausted (feasible, evaluated, uncommitted): vectorized ----
         exh_mask = evaluated & feas_r & (placed_r == 0)
         if exh_mask.any():
-            cap_left = ct.capacity[:n_real] - used_after[:n_real]
-            over = sp.ask[None, :] > cap_left          # [n, 4]
+            # cap_left is per-batch; the over/first_dim compare is keyed
+            # by the spec's ask vector — one [n, 4] pass per DISTINCT ask
+            # per batch, not per failed spec (uniform fleets fail by the
+            # hundreds with identical asks).
+            ask_cache = node_facts.setdefault("ask_over", {})
+            ask_key = sp.ask.tobytes()
+            ent = ask_cache.get(ask_key)
+            if ent is None:
+                cap_left = node_facts.get("cap_left")
+                if cap_left is None:
+                    cap_left = ct.capacity[:n_real] - used_after[:n_real]
+                    node_facts["cap_left"] = cap_left
+                over = sp.ask[None, :] > cap_left      # [n, 4]
+                ent = (over.any(axis=1), np.argmax(over, axis=1))
+                ask_cache[ask_key] = ent
+            any_over, first_dim = ent
             dim_names = ("cpu exhausted", "memory exhausted",
                          "disk exhausted", "iops exhausted")
-            any_over = over.any(axis=1)
-            first_dim = np.argmax(over, axis=1)
             capacity_exh = exh_mask & any_over
             n_cap_exh = int(capacity_exh.sum())
             if n_cap_exh:

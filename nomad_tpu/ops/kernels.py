@@ -48,7 +48,7 @@ def _select_top_k(scored: jnp.ndarray, ok: jnp.ndarray,
                   k: jnp.ndarray) -> jnp.ndarray:
     """Boolean mask of the k highest-scored ok nodes, without a sort.
 
-    Bisects the score threshold (the k-th largest value): ~35 reduce
+    Bisects the score threshold (the k-th largest value): ~45 reduce
     passes over N, each a single vectorized compare+sum, which the TPU
     pipelines from VMEM — versus the O(N log N) full argsort this
     replaced, which dominated device time at N ≈ 50k.  Exact-k selection:
@@ -70,13 +70,14 @@ def _select_top_k(scored: jnp.ndarray, ok: jnp.ndarray,
         take = above >= k
         return jnp.where(take, mid, lo), jnp.where(take, hi, mid)
 
-    lo, hi = lax.fori_loop(0, 35, body, (lo0 - 1.0, hi0 + 1.0))
-    # 35 iterations over a span ≤ ~2e4 converge lo/hi to ADJACENT f32
-    # values (span/2^35 ≪ ulp), so (lo, hi] contains exactly the k-th
-    # largest value v: take everything strictly above it, then fill from
-    # the v-valued band in node-index order — the stable-argsort tie
-    # order.  The band bound must be STRICT (> lo): `>= lo` would admit
-    # lo-valued nodes (below v) ahead of higher-scored band members.
+    lo, hi = lax.fori_loop(0, 45, body, (lo0 - 1.0, hi0 + 1.0))
+    # 45 iterations shrink (lo, hi] to span/2^45 ≤ ~6e-13 — far below
+    # the tie-breaking jitter's own quantum (2^-24 · 1e-3 ≈ 6e-11, see
+    # _placement_rounds_impl), so the band holds exactly one distinct
+    # score value: take everything strictly above it, then fill from the
+    # band in node-index order — the stable-argsort tie order.  The band
+    # bound must be STRICT (> lo): `>= lo` would admit lo-valued nodes
+    # (below the k-th value) ahead of higher-scored band members.
     sel_gt = masked > hi
     band = ok & ~sel_gt & (masked > lo)
     need = k - jnp.sum(sel_gt.astype(jnp.int32))

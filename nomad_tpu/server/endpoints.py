@@ -245,7 +245,18 @@ def register_endpoints(server, rpc) -> None:
         except TimeoutError:
             if future.cancel():
                 raise
-            result = future.wait(timeout=540.0)
+            try:
+                result = future.wait(timeout=540.0)
+            except TimeoutError:
+                # The applier owns the plan but hasn't responded within
+                # the grace period: the outcome is UNKNOWN (the plan may
+                # still commit).  Distinct error so the submitter nacks
+                # with delay instead of replanning immediately — by
+                # redelivery time a committed plan shows up in the
+                # scheduler's fresh snapshot as a no-op diff.
+                raise TimeoutError(
+                    "plan outcome unknown: applier claimed the plan but "
+                    "did not respond in 600s; do not replan immediately")
         return {"Result": to_wire(result) if result is not None else None}
 
     register("Plan.Submit", plan_submit)

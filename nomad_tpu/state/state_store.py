@@ -705,6 +705,46 @@ class StateStore:
         with self._lock:
             return [self._get_alloc(aid) for aid in list(self.allocs_table)]
 
+    # -- non-materializing row reads (batch encode path) -------------------
+    #
+    # The TPU batch scheduler only needs (node_id, resources, status)
+    # per alloc to encode cluster usage; materializing every slab slot
+    # into a throwaway snapshot each batch would re-pay the per-alloc
+    # cost the slabs exist to avoid.  These return the shared slab PROTO
+    # as the row for slot entries (node_id supplied separately) — rows
+    # are read-only by contract.
+
+    def alloc_rows(self, ws: Optional[WatchSet] = None
+                   ) -> List[Tuple[str, s.Allocation]]:
+        """(node_id, row) for every alloc, without slab materialization."""
+        if ws is not None:
+            ws.add(self, "allocs")
+        with self._lock:
+            out = []
+            for v in self.allocs_table.values():
+                if type(v) is _SlabSlot:
+                    out.append((v.slab.node_ids[v.i], v.slab.proto))
+                else:
+                    out.append((v.node_id, v))
+            return out
+
+    def alloc_rows_by_job(self, ws: Optional[WatchSet], job_id: str
+                          ) -> List[Tuple[str, s.Allocation]]:
+        """(node_id, row) for a job's allocs, without materialization."""
+        if ws is not None:
+            ws.add(self, "allocs")
+        with self._lock:
+            out = []
+            for aid in self._allocs_by_job.get(job_id, ()):
+                v = self.allocs_table.get(aid)
+                if v is None:
+                    continue
+                if type(v) is _SlabSlot:
+                    out.append((v.slab.node_ids[v.i], v.slab.proto))
+                else:
+                    out.append((v.node_id, v))
+            return out
+
     # -- vault accessors ---------------------------------------------------
 
     def upsert_vault_accessors(self, index: int, accessors: List[VaultAccessor]) -> None:

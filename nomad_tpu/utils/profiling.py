@@ -1,0 +1,162 @@
+"""Runtime profiling: the pprof-equivalent debug surface plus JAX device
+tracing.
+
+The reference mounts net/http/pprof under /debug/pprof when enableDebug
+is set (command/agent/http.go:173-178) — CPU profiles, heap profiles, and
+goroutine stacks.  The equivalents here:
+
+- profile:   cProfile captured over a bounded window across all threads
+             (pstats text output, sorted by cumulative time).
+- heap:      tracemalloc top allocation sites (started lazily on first
+             request; subsequent requests diff against a live tracer).
+- threads:   stack dump of every live thread (goroutine-dump analogue).
+- trace:     jax.profiler device trace written to a directory for
+             TensorBoard/XProf — the device-side replacement for pprof
+             the SURVEY calls for ("JAX profiler + XLA HLO dumps replace
+             pprof for device side", SURVEY.md §5).
+
+All captures are bounded and lock-free with respect to the runtime: the
+CPU profiler uses the interpreter's global profile hook for its window;
+heap/threads are point-in-time snapshots.
+"""
+from __future__ import annotations
+
+import cProfile
+import io
+import pstats
+import sys
+import threading
+import time
+import traceback
+from typing import Dict, Optional
+
+_profile_lock = threading.Lock()
+
+
+def cpu_profile(seconds: float = 1.0, sort: str = "cumulative",
+                top: int = 60) -> str:
+    """Profile the whole process for ``seconds`` and render pstats text.
+
+    Serialized by a module lock: concurrent profile requests would fight
+    over the interpreter's single profile hook."""
+    seconds = max(0.05, min(float(seconds), 30.0))
+    if not _profile_lock.acquire(timeout=0.1):
+        raise RuntimeError("another cpu profile is in progress")
+    try:
+        pr = cProfile.Profile()
+        pr.enable()
+        time.sleep(seconds)
+        pr.disable()
+        out = io.StringIO()
+        stats = pstats.Stats(pr, stream=out)
+        stats.sort_stats(sort)
+        stats.print_stats(top)
+        return out.getvalue()
+    finally:
+        _profile_lock.release()
+
+
+_heap_started = False
+
+
+def heap_profile(top: int = 40) -> Dict:
+    """tracemalloc snapshot of the top allocation sites.
+
+    The tracer is started on the first request (like pprof's heap
+    profile, which is always-on in Go; Python's tracer costs ~2x alloc
+    overhead, so it's opt-in via first use of this endpoint)."""
+    global _heap_started
+    import tracemalloc
+
+    if not _heap_started:
+        tracemalloc.start(10)
+        _heap_started = True
+        return {"status": "tracer started; re-request for data"}
+    snap = tracemalloc.take_snapshot()
+    stats = snap.statistics("lineno")[:top]
+    current, peak = tracemalloc.get_traced_memory()
+    return {
+        "current_bytes": current,
+        "peak_bytes": peak,
+        "top": [
+            {
+                "site": str(st.traceback[0]) if st.traceback else "?",
+                "size_bytes": st.size,
+                "count": st.count,
+            }
+            for st in stats
+        ],
+    }
+
+
+def thread_dump() -> str:
+    """Stack trace of every live thread — the goroutine-dump analogue
+    (pprof /debug/pprof/goroutine?debug=2)."""
+    frames = sys._current_frames()
+    by_id = {t.ident: t for t in threading.enumerate()}
+    out = io.StringIO()
+    for tid, frame in sorted(frames.items()):
+        t = by_id.get(tid)
+        name = t.name if t is not None else "?"
+        daemon = " daemon" if (t is not None and t.daemon) else ""
+        out.write(f"thread {tid} [{name}]{daemon}:\n")
+        traceback.print_stack(frame, file=out)
+        out.write("\n")
+    return out.getvalue()
+
+
+class DeviceTracer:
+    """Bounded jax.profiler trace sessions (device-side profiling).
+
+    One active trace at a time; the trace directory is returned so the
+    operator can pull it into TensorBoard/XProf."""
+
+    def __init__(self, base_dir: Optional[str] = None):
+        import os
+        import tempfile
+
+        self.base_dir = base_dir or os.path.join(
+            tempfile.gettempdir(), "nomad_tpu_traces")
+        self._lock = threading.Lock()
+        self._active_dir: Optional[str] = None
+        self._started_at = 0.0
+
+    def start(self) -> str:
+        import os
+
+        import jax
+
+        with self._lock:
+            if self._active_dir is not None:
+                raise RuntimeError(
+                    f"trace already active in {self._active_dir}")
+            d = os.path.join(self.base_dir, time.strftime("%Y%m%d-%H%M%S"))
+            os.makedirs(d, exist_ok=True)
+            jax.profiler.start_trace(d)
+            self._active_dir = d
+            self._started_at = time.monotonic()
+            return d
+
+    def stop(self) -> Dict:
+        import jax
+
+        with self._lock:
+            if self._active_dir is None:
+                raise RuntimeError("no active trace")
+            jax.profiler.stop_trace()
+            d, self._active_dir = self._active_dir, None
+            return {"dir": d,
+                    "duration_s": round(time.monotonic() - self._started_at,
+                                        3)}
+
+    def capture(self, seconds: float = 1.0) -> Dict:
+        """start → sleep → stop in one bounded call (the /trace?seconds=N
+        endpoint shape)."""
+        seconds = max(0.05, min(float(seconds), 30.0))
+        d = self.start()
+        try:
+            time.sleep(seconds)
+        finally:
+            info = self.stop()
+        info["dir"] = d
+        return info

@@ -1,0 +1,95 @@
+"""Debug/profiling surface: the pprof-equivalent endpoints under
+/debug/pprof (reference: command/agent/http.go:173-178 mounts
+net/http/pprof behind enableDebug) plus the profiling helpers."""
+
+import json
+import urllib.request
+
+import pytest
+
+from nomad_tpu.agent import Agent, AgentConfig
+from nomad_tpu.utils import profiling
+
+
+def _get(addr, path):
+    with urllib.request.urlopen(addr + path, timeout=30) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+@pytest.fixture(scope="module")
+def debug_agent(tmp_path_factory):
+    cfg = AgentConfig.dev()
+    cfg.enable_debug = True
+    tmp = tmp_path_factory.mktemp("dbg")
+    cfg.client.alloc_dir = str(tmp / "allocs")
+    cfg.client.state_dir = str(tmp / "state")
+    a = Agent(cfg)
+    a.start()
+    yield a
+    a.shutdown()
+
+
+class TestPprofEndpoints:
+    def test_cpu_profile(self, debug_agent):
+        status, body = _get(debug_agent.http.address,
+                            "/debug/pprof/profile?seconds=0.1")
+        assert status == 200
+        assert "Profile" in body
+        assert "function calls" in body["Profile"]
+
+    def test_heap(self, debug_agent):
+        # First call arms the tracer, second returns data.
+        _get(debug_agent.http.address, "/debug/pprof/heap")
+        status, body = _get(debug_agent.http.address,
+                            "/debug/pprof/heap?top=5")
+        assert status == 200
+        assert body.get("top") is not None
+        assert body["current_bytes"] > 0
+
+    def test_threads(self, debug_agent):
+        status, body = _get(debug_agent.http.address,
+                            "/debug/pprof/threads")
+        assert status == 200
+        # The HTTP serving thread itself must appear.
+        assert "thread" in body["Stacks"]
+        assert "http" in body["Stacks"]
+
+    def test_gated_when_disabled(self, tmp_path):
+        cfg = AgentConfig.dev()
+        cfg.enable_debug = False
+        cfg.client.alloc_dir = str(tmp_path / "allocs")
+        cfg.client.state_dir = str(tmp_path / "state")
+        a = Agent(cfg)
+        a.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as excinfo:
+                _get(a.http.address, "/debug/pprof/threads")
+            assert excinfo.value.code == 404
+        finally:
+            a.shutdown()
+
+
+class TestDeviceTracer:
+    def test_capture_writes_trace_dir(self, tmp_path):
+        import os
+
+        tracer = profiling.DeviceTracer(base_dir=str(tmp_path))
+        import jax
+        import jax.numpy as jnp
+
+        tracer_dir = tracer.start()
+        jnp.sum(jnp.arange(1024)).block_until_ready()
+        info = tracer.stop()
+        assert info["dir"] == tracer_dir
+        # jax writes plugins/profile/... under the trace dir.
+        found = [p for p, _dirs, files in os.walk(tracer_dir) if files]
+        assert found, "trace produced no files"
+
+    def test_single_active_trace(self, tmp_path):
+        tracer = profiling.DeviceTracer(base_dir=str(tmp_path))
+        tracer.start()
+        with pytest.raises(RuntimeError):
+            tracer.start()
+        tracer.stop()
+        with pytest.raises(RuntimeError):
+            tracer.stop()
