@@ -716,11 +716,8 @@ class HTTPServer:
         self._require_debug()
         from ..utils import profiling
 
-        tracer = getattr(self.agent, "_device_tracer", None)
-        if tracer is None:
-            tracer = profiling.DeviceTracer()
-            self.agent._device_tracer = tracer
-        return tracer.capture(float(query.get("seconds", "1"))), None
+        return profiling.get_tracer().capture(
+            float(query.get("seconds", "1"))), None
 
     def kv_request(self, req, query, key: str):
         """Consul-KV-shaped store feeding task templates

@@ -1,0 +1,236 @@
+// Group-commit write-ahead log — the native durability hot path behind
+// server/raft.py FileLog (and the multi-server _RaftStore entry log).
+//
+// Every raft apply pays an fsync; under concurrent RPC handlers the pure
+// Python log serializes one fsync per append.  This WAL batches them:
+// appenders write their framed record under the lock, then one thread
+// performs a single fsync for every record written since the last sync
+// (group commit, the same trick raft-boltdb gets from bolt's single
+// writer + the reference's batched raft.Apply pipeline).
+//
+// Record framing:  [u32 len][u32 crc32(payload)][payload]
+// Recovery: scan until EOF/short-read/CRC mismatch, truncate the torn or
+// corrupt tail so subsequent appends follow the last good record.
+//
+// Plain C ABI for ctypes (no pybind11 dependency in this image).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <condition_variable>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+// CRC-32 (IEEE, reflected) — table-driven, computed once.
+uint32_t crc_table[256];
+bool crc_init_done = false;
+
+void crc_init() {
+  if (crc_init_done) return;
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++)
+      c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc_table[i] = c;
+  }
+  crc_init_done = true;
+}
+
+uint32_t crc32(const uint8_t* p, size_t n) {
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++)
+    c = crc_table[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+struct Wal {
+  int fd = -1;
+  std::string path;
+  int sync_mode = 1;  // 0 = no fsync (tests), 1 = fsync on append
+
+  std::mutex mu;
+  std::condition_variable cv;
+  uint64_t written_seq = 0;   // records written to the fd
+  uint64_t synced_seq = 0;    // records known durable
+  bool sync_in_flight = false;
+
+  // iteration state (single iterator at a time; guarded by mu)
+  std::vector<uint8_t> iter_buf;
+  off_t iter_off = 0;
+
+  long entry_count = 0;
+};
+
+void set_err(char* errbuf, int errcap, const char* msg) {
+  if (errbuf && errcap > 0) {
+    std::snprintf(errbuf, (size_t)errcap, "%s: %s", msg, std::strerror(errno));
+  }
+}
+
+// Scan the log, count whole CRC-valid records, truncate anything after
+// the last good one.  Returns -1 on IO error.
+long recover(Wal* w, char* errbuf, int errcap) {
+  off_t size = ::lseek(w->fd, 0, SEEK_END);
+  if (size < 0) { set_err(errbuf, errcap, "lseek"); return -1; }
+  off_t off = 0;
+  long count = 0;
+  std::vector<uint8_t> buf;
+  while (true) {
+    uint8_t hdr[8];
+    if (off + 8 > size) break;  // short header → torn tail
+    if (::pread(w->fd, hdr, 8, off) != 8) break;
+    uint32_t len, crc;
+    std::memcpy(&len, hdr, 4);
+    std::memcpy(&crc, hdr + 4, 4);
+    if (off + 8 + (off_t)len > size) break;  // record runs past EOF
+    buf.resize(len);
+    if (len && ::pread(w->fd, buf.data(), len, off + 8) != (ssize_t)len)
+      break;
+    if (crc32(buf.data(), len) != crc) break;  // corrupt tail
+    off += 8 + (off_t)len;
+    count++;
+  }
+  if (off < size) {
+    if (::ftruncate(w->fd, off) != 0) {
+      set_err(errbuf, errcap, "ftruncate");
+      return -1;
+    }
+  }
+  if (::lseek(w->fd, off, SEEK_SET) < 0) {
+    set_err(errbuf, errcap, "lseek");
+    return -1;
+  }
+  return count;
+}
+
+}  // namespace
+
+extern "C" {
+
+Wal* nwal_open(const char* path, int sync_mode, char* errbuf, int errcap) {
+  crc_init();
+  Wal* w = new Wal();
+  w->path = path;
+  w->sync_mode = sync_mode;
+  w->fd = ::open(path, O_RDWR | O_CREAT, 0644);
+  if (w->fd < 0) {
+    set_err(errbuf, errcap, "open");
+    delete w;
+    return nullptr;
+  }
+  long n = recover(w, errbuf, errcap);
+  if (n < 0) {
+    ::close(w->fd);
+    delete w;
+    return nullptr;
+  }
+  w->entry_count = n;
+  return w;
+}
+
+long nwal_entry_count(Wal* w) { return w->entry_count; }
+
+// Append one record; returns 0 when the record is DURABLE (group-commit
+// fsync has covered it), -1 on error.
+int nwal_append(Wal* w, const void* data, uint32_t len) {
+  uint8_t hdr[8];
+  uint32_t crc = crc32((const uint8_t*)data, len);
+  std::memcpy(hdr, &len, 4);
+  std::memcpy(hdr + 4, &crc, 4);
+
+  uint64_t my_seq;
+  {
+    std::unique_lock<std::mutex> lk(w->mu);
+    // Write under the lock: record order == seq order.
+    if (::write(w->fd, hdr, 8) != 8) return -1;
+    if (len && ::write(w->fd, data, len) != (ssize_t)len) return -1;
+    my_seq = ++w->written_seq;
+    w->entry_count++;
+    if (w->sync_mode == 0) {
+      w->synced_seq = my_seq;
+      return 0;
+    }
+    // Group commit: wait while another thread's fsync is in flight —
+    // when it finishes it covers every record written before it started
+    // its fsync; if ours isn't covered, we become the next syncer.
+    while (true) {
+      if (w->synced_seq >= my_seq) return 0;
+      if (!w->sync_in_flight) break;
+      w->cv.wait(lk);
+    }
+    w->sync_in_flight = true;
+  }
+  // fsync outside the lock: appenders keep writing into the next batch.
+  uint64_t cover;
+  {
+    std::lock_guard<std::mutex> lk(w->mu);
+    cover = w->written_seq;
+  }
+  int rc = ::fsync(w->fd);
+  {
+    std::lock_guard<std::mutex> lk(w->mu);
+    w->sync_in_flight = false;
+    if (rc == 0 && cover > w->synced_seq) w->synced_seq = cover;
+    w->cv.notify_all();
+  }
+  return rc == 0 ? 0 : -1;
+}
+
+// Iterate records from the start.  nwal_iter_next fills *data/*len with
+// a pointer valid until the next call; returns 1 on a record, 0 at end,
+// -1 on error.
+void nwal_iter_start(Wal* w) {
+  std::lock_guard<std::mutex> lk(w->mu);
+  w->iter_off = 0;
+}
+
+int nwal_iter_next(Wal* w, const uint8_t** data, uint32_t* len) {
+  std::lock_guard<std::mutex> lk(w->mu);
+  uint8_t hdr[8];
+  ssize_t r = ::pread(w->fd, hdr, 8, w->iter_off);
+  if (r == 0) return 0;
+  if (r != 8) return 0;  // torn tail already truncated at open; be lenient
+  uint32_t rlen, crc;
+  std::memcpy(&rlen, hdr, 4);
+  std::memcpy(&crc, hdr + 4, 4);
+  w->iter_buf.resize(rlen);
+  if (rlen && ::pread(w->fd, w->iter_buf.data(), rlen, w->iter_off + 8)
+                  != (ssize_t)rlen)
+    return -1;
+  if (crc32(w->iter_buf.data(), rlen) != crc) return -1;
+  w->iter_off += 8 + (off_t)rlen;
+  *data = w->iter_buf.data();
+  *len = rlen;
+  return 1;
+}
+
+// Reset the log to empty (post-snapshot truncation).
+int nwal_reset(Wal* w) {
+  std::lock_guard<std::mutex> lk(w->mu);
+  if (::ftruncate(w->fd, 0) != 0) return -1;
+  if (::lseek(w->fd, 0, SEEK_SET) < 0) return -1;
+  w->entry_count = 0;
+  if (w->sync_mode && ::fsync(w->fd) != 0) return -1;
+  return 0;
+}
+
+int nwal_sync(Wal* w) {
+  if (w->sync_mode == 0) return 0;
+  return ::fsync(w->fd) == 0 ? 0 : -1;
+}
+
+void nwal_close(Wal* w) {
+  if (!w) return;
+  if (w->fd >= 0) ::close(w->fd);
+  delete w;
+}
+
+}  // extern "C"

@@ -59,6 +59,11 @@ def _ensure_compile_cache() -> None:
         return
     if jax.config.jax_compilation_cache_dir is not None:
         return  # the application already configured one
+    if jax.default_backend() == "cpu":
+        # CPU compiles are fast, and cached CPU AOT executables are
+        # machine-feature sensitive (XLA warns about SIGILL on feature
+        # mismatch) — the cache only pays for itself on accelerators.
+        return
     jax.config.update(
         "jax_compilation_cache_dir",
         os.environ.get("NOMAD_TPU_COMPILE_CACHE_DIR",

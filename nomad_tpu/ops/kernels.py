@@ -71,13 +71,17 @@ def _select_top_k(scored: jnp.ndarray, ok: jnp.ndarray,
         return jnp.where(take, mid, lo), jnp.where(take, hi, mid)
 
     lo, hi = lax.fori_loop(0, 45, body, (lo0 - 1.0, hi0 + 1.0))
-    # 45 iterations shrink (lo, hi] to span/2^45 ≤ ~6e-13 — far below
-    # the tie-breaking jitter's own quantum (2^-24 · 1e-3 ≈ 6e-11, see
-    # _placement_rounds_impl), so the band holds exactly one distinct
-    # score value: take everything strictly above it, then fill from the
-    # band in node-index order — the stable-argsort tie order.  The band
-    # bound must be STRICT (> lo): `>= lo` would admit lo-valued nodes
-    # (below the k-th value) ahead of higher-scored band members.
+    # The band (lo, hi] holds exactly ONE distinct f32 score value in
+    # both regimes: at |score| ≳ 1e-2 the f32 bisection stalls once lo/hi
+    # are adjacent representables, so the band is a single value by
+    # construction; near zero (where f32 resolves far finer than the
+    # jitter) 45 iterations shrink the span to ~span0/2^45 ≤ 6e-13,
+    # below the tie-jitter quantum (2^-24 · 1e-3 ≈ 6e-11), so distinct
+    # jittered scores can't share the band.  Either way, filling the
+    # single-valued band in node-index order reproduces the stable-
+    # argsort tie order.  The band bound must be STRICT (> lo): `>= lo`
+    # would admit lo-valued nodes (below the k-th value) ahead of
+    # higher-scored band members.
     sel_gt = masked > hi
     band = ok & ~sel_gt & (masked > lo)
     need = k - jnp.sum(sel_gt.astype(jnp.int32))

@@ -108,7 +108,14 @@ class FileLog(RaftLog):
     """Durable single-voter WAL + snapshots.
 
     Layout in ``data_dir``:
-      wal.log         — length-prefixed pickled (index, type, payload)
+      wal.crc         — CRC-framed records via the native group-commit WAL
+                        (nomad_tpu/native/wal.cc) when the toolchain is
+                        available: concurrent appends coalesce into one
+                        fsync (~10x append throughput under RPC-handler
+                        concurrency, the raft-boltdb single-writer role)
+      wal.log         — legacy length-prefixed fallback (pure Python),
+                        used when native is unavailable; replayed before
+                        wal.crc on recovery so upgrades are seamless
       snapshot-<idx>  — FSM snapshot taken at <idx>
     Recovery: newest snapshot restore, then WAL replay of entries > idx.
     """
@@ -119,8 +126,20 @@ class FileLog(RaftLog):
         self.fsync = fsync
         os.makedirs(data_dir, exist_ok=True)
         self.wal_path = os.path.join(data_dir, "wal.log")
+        self._nwal = None
+        try:
+            from ..native import NativeWAL, NativeUnavailable
+
+            try:
+                self._nwal = NativeWAL(os.path.join(data_dir, "wal.crc"),
+                                       fsync=fsync)
+            except NativeUnavailable:
+                self._nwal = None
+        except ImportError:  # pragma: no cover
+            self._nwal = None
         self._recover()
-        self._fh = open(self.wal_path, "ab")
+        self._fh = (open(self.wal_path, "ab") if self._nwal is None
+                    else None)
 
     # -- recovery ----------------------------------------------------------
 
@@ -144,8 +163,70 @@ class FileLog(RaftLog):
                 self.fsm.restore(fh.read())
             self._last_index = snap_idx
 
+        # Gather entries from BOTH logs and apply in index order: a node
+        # toggled between native and fallback modes may have newer entries
+        # in either file.
+        entries = self._read_legacy_entries(snap_idx)
+        if self._nwal is not None:
+            # Native log replay (CRC + torn-tail handling done at open).
+            for blob in self._nwal.records():
+                index, msg_type, payload = pickle.loads(blob)
+                if index > snap_idx:
+                    entries.append((index, msg_type, payload))
+        else:
+            # Native unavailable on THIS boot but a wal.crc exists from a
+            # previous one: replay it through the pure-Python CRC reader —
+            # silently ignoring it would roll back committed entries and
+            # reuse their indexes.
+            entries.extend(self._read_crc_entries(snap_idx))
+        # Same-index duplicates can only be identical payloads (an index
+        # is written to exactly one log at append time); keep the first.
+        entries.sort(key=lambda e: e[0])
+        prev_index = None
+        for index, msg_type, payload in entries:
+            if index == prev_index:
+                continue
+            prev_index = index
+            self.fsm.apply(index, MessageType(msg_type), payload)
+            self._last_index = index
+
+    def _read_crc_entries(self, snap_idx: int):
+        """Pure-Python reader for the native wal.crc format
+        ([u32 len][u32 crc32(payload)][payload]); validates CRCs and
+        truncates a torn/corrupt tail exactly like wal.cc recover()."""
+        import struct as _struct
+        import zlib
+
+        out = []
+        path = os.path.join(self.data_dir, "wal.crc")
+        if not os.path.exists(path):
+            return out
+        size = os.path.getsize(path)
+        good = 0
+        with open(path, "rb") as fh:
+            while True:
+                header = fh.read(8)
+                if len(header) < 8:
+                    break
+                length, crc = _struct.unpack("<II", header)
+                if length > size - fh.tell():
+                    break
+                blob = fh.read(length)
+                if len(blob) < length or (zlib.crc32(blob) & 0xFFFFFFFF) != crc:
+                    break
+                good = fh.tell()
+                index, msg_type, payload = pickle.loads(blob)
+                if index > snap_idx:
+                    out.append((index, msg_type, payload))
+        if good < size:
+            with open(path, "r+b") as fh:
+                fh.truncate(good)
+        return out
+
+    def _read_legacy_entries(self, snap_idx: int):
+        out = []
         if not os.path.exists(self.wal_path):
-            return
+            return out
         good_offset = 0
         torn = False
         wal_size = os.path.getsize(self.wal_path)
@@ -169,20 +250,24 @@ class FileLog(RaftLog):
                 good_offset = fh.tell()
                 if index <= snap_idx:
                     continue
-                self.fsm.apply(index, MessageType(msg_type), payload)
-                self._last_index = index
+                out.append((index, msg_type, payload))
         # Truncate the torn tail so subsequent appends follow the last good
         # record — otherwise new fsynced entries land after garbage and are
         # unreachable on the next replay (silent loss).
         if torn:
             with open(self.wal_path, "r+b") as fh:
                 fh.truncate(good_offset)
+        return out
 
     # -- persistence -------------------------------------------------------
 
     def _persist(self, index: int, msg_type: MessageType, payload: dict) -> None:
         blob = pickle.dumps((index, int(msg_type), payload),
                             protocol=pickle.HIGHEST_PROTOCOL)
+        if self._nwal is not None:
+            # Durable on return; concurrent appends share one fsync.
+            self._nwal.append(blob)
+            return
         self._fh.write(_LEN.pack(len(blob)))
         self._fh.write(blob)
         self._fh.flush()
@@ -203,15 +288,24 @@ class FileLog(RaftLog):
                 os.fsync(fh.fileno())
             os.replace(tmp, path)
             # Truncate the WAL: all entries ≤ index are in the snapshot.
-            self._fh.close()
-            self._fh = open(self.wal_path, "wb")
+            if self._nwal is not None:
+                self._nwal.reset()
+                if os.path.exists(self.wal_path):
+                    # Legacy records are covered by the snapshot too.
+                    open(self.wal_path, "wb").close()
+            else:
+                self._fh.close()
+                self._fh = open(self.wal_path, "wb")
             # Retain only the most recent snapshots.
             snaps = self._snapshot_files()
             for old_idx, old_path in snaps[:-SNAPSHOTS_RETAINED]:
                 os.unlink(old_path)
 
     def close(self) -> None:
-        self._fh.close()
+        if self._nwal is not None:
+            self._nwal.close()
+        if self._fh is not None:
+            self._fh.close()
 
 
 # ---------------------------------------------------------------------------

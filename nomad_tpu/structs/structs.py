@@ -112,8 +112,36 @@ def generate_uuid() -> str:
     return f"{h[:8]}-{h[8:12]}-{h[12:16]}-{h[16:20]}-{h[20:]}"
 
 
+_native_uuids = None  # resolved in the background; False = unavailable
+_native_uuids_resolving = False
+
+
+def _resolve_native_uuids() -> None:
+    global _native_uuids
+    try:
+        from ..native import generate_uuids as _ng
+
+        _ng(1)  # force build/load; may raise NativeUnavailable
+        _native_uuids = _ng
+    except Exception:
+        _native_uuids = False
+
+
 def generate_uuids(n: int) -> List[str]:
-    """Bulk UUIDs: one urandom read for n ids (bulk-placement hot path)."""
+    """Bulk UUIDs for the bulk-placement hot path: native formatter
+    (nomad_tpu/native/ids.cc, ~2.3x end to end) once available, else one
+    urandom read + python hex slicing.  The native build/load runs in a
+    BACKGROUND thread kicked off by the first bulk call — a cold cache
+    means a g++ invocation, which must not stall plan materialization."""
+    global _native_uuids_resolving
+    if _native_uuids is None and n >= 64 and not _native_uuids_resolving:
+        _native_uuids_resolving = True
+        import threading as _threading
+
+        _threading.Thread(target=_resolve_native_uuids,
+                          name="native-uuids-build", daemon=True).start()
+    if _native_uuids and n >= 64:
+        return _native_uuids(n)
     hx = _os.urandom(16 * n).hex()
     return [
         f"{h[:8]}-{h[8:12]}-{h[12:16]}-{h[16:20]}-{h[20:]}"

@@ -5,8 +5,10 @@ The reference mounts net/http/pprof under /debug/pprof when enableDebug
 is set (command/agent/http.go:173-178) — CPU profiles, heap profiles, and
 goroutine stacks.  The equivalents here:
 
-- profile:   cProfile captured over a bounded window across all threads
-             (pstats text output, sorted by cumulative time).
+- profile:   sampling profiler over a bounded window — stacks of EVERY
+             live thread sampled at ~200Hz and aggregated (pprof's CPU
+             profile is also a sampler; a cProfile hook would only see
+             the handler's own thread).
 - heap:      tracemalloc top allocation sites (started lazily on first
              request; subsequent requests diff against a live tracer).
 - threads:   stack dump of every live thread (goroutine-dump analogue).
@@ -21,9 +23,7 @@ heap/threads are point-in-time snapshots.
 """
 from __future__ import annotations
 
-import cProfile
 import io
-import pstats
 import sys
 import threading
 import time
@@ -34,23 +34,52 @@ _profile_lock = threading.Lock()
 
 
 def cpu_profile(seconds: float = 1.0, sort: str = "cumulative",
-                top: int = 60) -> str:
-    """Profile the whole process for ``seconds`` and render pstats text.
-
-    Serialized by a module lock: concurrent profile requests would fight
-    over the interpreter's single profile hook."""
+                top: int = 60, hz: float = 200.0) -> str:
+    """Sample every live thread's stack for ``seconds`` and render an
+    aggregated report: per-frame inclusive/leaf sample counts across ALL
+    threads (cProfile's hook is per-thread — it would only ever see this
+    handler sleeping).  Serialized by a module lock so concurrent profile
+    requests don't double the sampling load."""
     seconds = max(0.05, min(float(seconds), 30.0))
+    interval = 1.0 / max(1.0, min(hz, 1000.0))
     if not _profile_lock.acquire(timeout=0.1):
         raise RuntimeError("another cpu profile is in progress")
     try:
-        pr = cProfile.Profile()
-        pr.enable()
-        time.sleep(seconds)
-        pr.disable()
+        me = threading.get_ident()
+        inclusive: dict = {}
+        leaf: dict = {}
+        samples = 0
+        deadline = time.monotonic() + seconds
+        while time.monotonic() < deadline:
+            for tid, frame in sys._current_frames().items():
+                if tid == me:
+                    continue
+                depth = 0
+                f = frame
+                first = True
+                while f is not None and depth < 64:
+                    code = f.f_code
+                    key = (code.co_filename, code.co_firstlineno,
+                           code.co_qualname)
+                    inclusive[key] = inclusive.get(key, 0) + 1
+                    if first:
+                        leaf[key] = leaf.get(key, 0) + 1
+                        first = False
+                    f = f.f_back
+                    depth += 1
+            samples += 1
+            time.sleep(interval)
         out = io.StringIO()
-        stats = pstats.Stats(pr, stream=out)
-        stats.sort_stats(sort)
-        stats.print_stats(top)
+        out.write(f"{samples} samples over {seconds:.2f}s "
+                  f"({len(inclusive)} function calls observed)\n\n")
+        out.write(f"{'incl':>8} {'leaf':>8}  function\n")
+        ranked = sorted(inclusive.items(),
+                        key=lambda kv: -(leaf.get(kv[0], 0) if sort == "leaf"
+                                         else kv[1]))
+        for key, n in ranked[:top]:
+            fname, lineno, qual = key
+            out.write(f"{n:>8} {leaf.get(key, 0):>8}  "
+                      f"{qual} ({fname}:{lineno})\n")
         return out.getvalue()
     finally:
         _profile_lock.release()
@@ -160,3 +189,18 @@ class DeviceTracer:
             info = self.stop()
         info["dir"] = d
         return info
+
+
+_tracer_lock = threading.Lock()
+_tracer: Optional[DeviceTracer] = None
+
+
+def get_tracer() -> DeviceTracer:
+    """Process-wide tracer singleton: the jax profiler is process-global,
+    so two DeviceTracer instances started concurrently would corrupt each
+    other's sessions."""
+    global _tracer
+    with _tracer_lock:
+        if _tracer is None:
+            _tracer = DeviceTracer()
+        return _tracer

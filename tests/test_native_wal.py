@@ -1,0 +1,231 @@
+"""Native group-commit WAL (nomad_tpu/native/wal.cc) and its FileLog
+integration: CRC framing, torn/corrupt-tail recovery, concurrent append
+durability, and mixed native/legacy replay ordering."""
+
+import os
+import threading
+
+import pytest
+
+from nomad_tpu.native import NativeWAL, native_wal_available
+
+pytestmark = pytest.mark.skipif(
+    not native_wal_available(), reason="native toolchain unavailable")
+
+
+class TestNativeWAL:
+    def test_append_replay(self, tmp_path):
+        p = str(tmp_path / "wal.crc")
+        w = NativeWAL(p)
+        for i in range(50):
+            w.append(f"r{i}".encode())
+        assert len(w) == 50
+        w.close()
+
+        w2 = NativeWAL(p)
+        recs = list(w2.records())
+        assert len(recs) == 50
+        assert recs[0] == b"r0" and recs[-1] == b"r49"
+        w2.close()
+
+    def test_torn_tail_truncated(self, tmp_path):
+        p = str(tmp_path / "wal.crc")
+        w = NativeWAL(p)
+        w.append(b"good-1")
+        w.append(b"good-2")
+        w.close()
+        # Crash mid-write: a length prefix claiming more than exists.
+        with open(p, "ab") as fh:
+            fh.write(b"\xff\xff\x00\x00garbage")
+        w2 = NativeWAL(p)
+        assert list(w2.records()) == [b"good-1", b"good-2"]
+        # Appends after recovery land cleanly after the truncation point.
+        w2.append(b"good-3")
+        w2.close()
+        w3 = NativeWAL(p)
+        assert list(w3.records()) == [b"good-1", b"good-2", b"good-3"]
+        w3.close()
+
+    def test_corrupt_crc_truncated(self, tmp_path):
+        p = str(tmp_path / "wal.crc")
+        w = NativeWAL(p)
+        w.append(b"alpha")
+        w.append(b"beta")
+        w.close()
+        # Flip a payload byte of the LAST record: CRC must reject it.
+        size = os.path.getsize(p)
+        with open(p, "r+b") as fh:
+            fh.seek(size - 1)
+            last = fh.read(1)
+            fh.seek(size - 1)
+            fh.write(bytes([last[0] ^ 0xFF]))
+        w2 = NativeWAL(p)
+        assert list(w2.records()) == [b"alpha"]
+        w2.close()
+
+    def test_concurrent_appends_all_durable(self, tmp_path):
+        p = str(tmp_path / "wal.crc")
+        w = NativeWAL(p)
+        n_threads, per = 8, 100
+
+        def worker(k):
+            for i in range(per):
+                w.append(f"t{k}-{i}".encode())
+
+        ts = [threading.Thread(target=worker, args=(k,))
+              for k in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(w) == n_threads * per
+        w.close()
+        w2 = NativeWAL(p)
+        recs = list(w2.records())
+        assert len(recs) == n_threads * per
+        # Every thread's records appear, in that thread's order.
+        for k in range(n_threads):
+            mine = [r for r in recs if r.startswith(f"t{k}-".encode())]
+            assert mine == [f"t{k}-{i}".encode() for i in range(per)]
+        w2.close()
+
+    def test_reset(self, tmp_path):
+        p = str(tmp_path / "wal.crc")
+        w = NativeWAL(p)
+        w.append(b"x")
+        w.reset()
+        assert len(w) == 0
+        w.append(b"y")
+        w.close()
+        w2 = NativeWAL(p)
+        assert list(w2.records()) == [b"y"]
+        w2.close()
+
+
+class TestFileLogNative:
+    def _mk(self, data_dir):
+        from nomad_tpu.server.fsm import FSM, MessageType
+        from nomad_tpu.server.raft import FileLog
+
+        fsm = FSM()
+        return FileLog(fsm, data_dir), MessageType
+
+    def test_native_wal_used_and_replayed(self, tmp_path):
+        from nomad_tpu import mock
+
+        data_dir = str(tmp_path / "raft")
+        log, MT = self._mk(data_dir)
+        assert log._nwal is not None, "native WAL should be active"
+        node = mock.node()
+        log.apply(MT.NODE_REGISTER, {"node": node})
+        log.close()
+        assert os.path.getsize(os.path.join(data_dir, "wal.crc")) > 0
+
+        log2, _ = self._mk(data_dir)
+        assert log2.fsm.state.node_by_id(None, node.id) is not None
+        log2.close()
+
+    def test_native_torn_tail(self, tmp_path):
+        from nomad_tpu import mock
+
+        data_dir = str(tmp_path / "raft")
+        log, MT = self._mk(data_dir)
+        node = mock.node()
+        log.apply(MT.NODE_REGISTER, {"node": node})
+        applied = log.applied_index()
+        log.close()
+
+        with open(os.path.join(data_dir, "wal.crc"), "ab") as fh:
+            fh.write(b"\x99\x00\x00\x00partial-record")
+
+        log2, MT = self._mk(data_dir)
+        assert log2.applied_index() == applied
+        job = mock.job()
+        log2.apply(MT.JOB_REGISTER, {"job": job})
+        applied2 = log2.applied_index()
+        log2.close()
+
+        log3, _ = self._mk(data_dir)
+        assert log3.applied_index() == applied2
+        assert log3.fsm.state.job_by_id(None, job.id) is not None
+        log3.close()
+
+    def test_mixed_legacy_then_native_replays_in_order(self, tmp_path,
+                                                       monkeypatch):
+        """Entries written by the pure-Python fallback replay together
+        with (and before) later native entries."""
+        from nomad_tpu import mock
+
+        data_dir = str(tmp_path / "raft")
+        monkeypatch.setenv("NOMAD_TPU_NO_NATIVE", "1")
+        log, MT = self._mk(data_dir)
+        assert log._nwal is None
+        node = mock.node()
+        log.apply(MT.NODE_REGISTER, {"node": node})
+        log.close()
+
+        monkeypatch.delenv("NOMAD_TPU_NO_NATIVE")
+        log2, MT = self._mk(data_dir)
+        assert log2._nwal is not None
+        assert log2.fsm.state.node_by_id(None, node.id) is not None
+        job = mock.job()
+        log2.apply(MT.JOB_REGISTER, {"job": job})
+        applied = log2.applied_index()
+        log2.close()
+
+        log3, _ = self._mk(data_dir)
+        assert log3.applied_index() == applied
+        assert log3.fsm.state.node_by_id(None, node.id) is not None
+        assert log3.fsm.state.job_by_id(None, job.id) is not None
+        log3.close()
+
+    def test_native_entries_survive_native_unavailable_boot(self, tmp_path,
+                                                            monkeypatch):
+        """A wal.crc written natively must replay through the pure-Python
+        CRC reader when the toolchain disappears — silently ignoring it
+        would roll back committed entries."""
+        from nomad_tpu import mock
+
+        data_dir = str(tmp_path / "raft")
+        log, MT = self._mk(data_dir)
+        assert log._nwal is not None
+        node = mock.node()
+        log.apply(MT.NODE_REGISTER, {"node": node})
+        applied = log.applied_index()
+        log.close()
+
+        monkeypatch.setenv("NOMAD_TPU_NO_NATIVE", "1")
+        log2, MT = self._mk(data_dir)
+        assert log2._nwal is None
+        assert log2.applied_index() == applied
+        assert log2.fsm.state.node_by_id(None, node.id) is not None
+        # New entries append to the legacy log with fresh indexes.
+        job = mock.job()
+        log2.apply(MT.JOB_REGISTER, {"job": job})
+        applied2 = log2.applied_index()
+        assert applied2 > applied
+        log2.close()
+
+        # Back on native: both files replay, in index order, no dups.
+        monkeypatch.delenv("NOMAD_TPU_NO_NATIVE")
+        log3, _ = self._mk(data_dir)
+        assert log3.applied_index() == applied2
+        assert log3.fsm.state.node_by_id(None, node.id) is not None
+        assert log3.fsm.state.job_by_id(None, job.id) is not None
+        log3.close()
+
+    def test_snapshot_truncates_both_logs(self, tmp_path):
+        from nomad_tpu import mock
+
+        data_dir = str(tmp_path / "raft")
+        log, MT = self._mk(data_dir)
+        log.apply(MT.NODE_REGISTER, {"node": mock.node()})
+        log.snapshot()
+        assert os.path.getsize(os.path.join(data_dir, "wal.crc")) == 0
+        applied = log.applied_index()
+        log.close()
+
+        log2, _ = self._mk(data_dir)
+        assert log2.applied_index() == applied
+        assert len(log2.fsm.state.nodes(None)) == 1
+        log2.close()

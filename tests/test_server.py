@@ -67,8 +67,11 @@ class TestEndToEnd:
 
         assert wait_until(
             lambda: len(server.state.allocs_by_job(None, job.id, True)) == 3)
-        ev = server.state.eval_by_id(None, eval_id)
-        assert ev.status == s.EVAL_STATUS_COMPLETE
+        # eval completion lands one raft apply AFTER the plan: wait, don't
+        # sample (the worker acks between the two applies).
+        assert wait_until(
+            lambda: server.state.eval_by_id(None, eval_id).status
+            == s.EVAL_STATUS_COMPLETE)
         # allocs have create_time stamped by plan apply
         for a in server.state.allocs_by_job(None, job.id, True):
             assert a.create_time > 0
@@ -175,8 +178,10 @@ class TestEndToEnd:
             return any(j.parent_id == job.id for j in server.state.jobs(None))
 
         assert wait_until(child_exists, timeout=30.0)
-        launch = server.state.periodic_launch_by_id(None, job.id)
-        assert launch is not None
+        # The launch record is a separate raft apply from the child job.
+        assert wait_until(
+            lambda: server.state.periodic_launch_by_id(None, job.id)
+            is not None)
 
     def test_force_gc_removes_terminal_evals(self, server):
         server.node_register(make_node())
@@ -466,8 +471,9 @@ class TestBatchWorkerMixedStream:
             assert wait_until(lambda: len(
                 srv.state.allocs_by_job(None, job.id, True)) == 2, 60.0)
             assert calls["n"] >= 2
-            ev = srv.state.eval_by_id(None, eval_id)
-            assert ev.status == s.EVAL_STATUS_COMPLETE
+            assert wait_until(
+                lambda: srv.state.eval_by_id(None, eval_id).status
+                == s.EVAL_STATUS_COMPLETE)
         finally:
             srv.shutdown()
 
