@@ -35,6 +35,7 @@ class TaskDir:
     """Paths for one task within an allocation (task_dir.go)."""
 
     def __init__(self, alloc_dir: str, task_name: str):
+        self.task_name = task_name
         self.dir = os.path.join(alloc_dir, task_name)
         self.local_dir = os.path.join(self.dir, TASK_LOCAL)
         self.secrets_dir = os.path.join(self.dir, TASK_SECRETS)

@@ -324,8 +324,19 @@ class DockerDriver(_ExecFamilyDriver):
         return (True, 30.0)
 
 
+def _docker_factory(ctx):
+    """Prefer the Engine API over the daemon socket (docker.go's actual
+    transport); fall back to the CLI shell-out when no socket answers."""
+    from .docker_api import DockerAPI, DockerAPIDriver
+
+    api = DockerAPI()
+    if api.available():
+        return DockerAPIDriver(ctx, api)
+    return DockerDriver(ctx)
+
+
 register_driver("raw_exec", RawExecDriver)
 register_driver("exec", ExecDriver)
 register_driver("java", JavaDriver)
 register_driver("qemu", QemuDriver)
-register_driver("docker", DockerDriver)
+register_driver("docker", _docker_factory)

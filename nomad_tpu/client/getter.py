@@ -1,11 +1,13 @@
 """Artifact fetcher (reference: client/getter/getter.go:36-127, which
 wraps go-getter).
 
-Supports ``file://`` paths, plain local paths, ``http(s)://`` URLs, and
-``git::`` clones (ref via the ``ref`` getter option), with optional
-sha256/md5 checksum verification via the same ``checksum=<type>:<hex>``
-option go-getter uses.  Source strings are env-interpolated before fetch
-(getter.go GetArtifact).
+Supports ``file://`` paths, plain local paths, ``http(s)://`` URLs,
+``git::`` clones (ref via the ``ref`` getter option), and ``s3://``
+objects (anonymous for public objects; SigV4-signed via stdlib
+hmac/hashlib when AWS_ACCESS_KEY_ID/AWS_SECRET_ACCESS_KEY are present —
+no SDK dependency), with optional sha256/md5 checksum verification via
+the same ``checksum=<type>:<hex>`` option go-getter uses.  Source
+strings are env-interpolated before fetch (getter.go GetArtifact).
 """
 from __future__ import annotations
 
@@ -36,6 +38,10 @@ def get_artifact(task_env: TaskEnv, artifact: s.TaskArtifact, task_dir: str) -> 
     # into the destination directory.
     if source.startswith("git::") or source.endswith(".git"):
         return _get_git(source, artifact, dest_dir)
+    if source.startswith("s3::") or source.startswith("s3://"):
+        dest = _get_s3(source, artifact, task_env, dest_dir)
+        _verify_checksum(artifact, task_env, dest)
+        return dest
 
     parsed = urllib.parse.urlparse(source)
     name = os.path.basename(parsed.path) or "artifact"
@@ -82,6 +88,115 @@ def _verify_checksum(artifact: s.TaskArtifact, task_env: TaskEnv, path: str) -> 
     if h.hexdigest() != want.lower():
         raise ArtifactError(
             f"checksum mismatch for {path}: got {h.hexdigest()}, want {want}")
+
+
+def _get_s3(source: str, artifact: s.TaskArtifact, task_env: TaskEnv,
+            dest_dir: str) -> str:
+    """Fetch an S3 object (go-getter's s3 getter, client/getter).
+
+    Source forms:
+      s3://bucket/key            — region via the ``region`` getter
+                                   option or AWS_REGION (default us-east-1)
+      s3::https://host/bucket/key — explicit endpoint (go-getter forced-
+                                   protocol form; also how tests point at
+                                   a local fake)
+    Anonymous unless AWS_ACCESS_KEY_ID/AWS_SECRET_ACCESS_KEY are set, in
+    which case the request is SigV4-signed with stdlib hmac/hashlib."""
+    opts = artifact.getter_options or {}
+    region = task_env.replace_env(opts.get("region", "") or "") or \
+        os.environ.get("AWS_REGION") or "us-east-1"
+
+    if source.startswith("s3::"):
+        url = source[len("s3::"):]
+        parsed = urllib.parse.urlparse(url)
+        key_path = parsed.path.lstrip("/")
+    else:
+        parsed = urllib.parse.urlparse(source)  # s3://bucket/key
+        bucket, key_path = parsed.netloc, parsed.path.lstrip("/")
+        host = f"{bucket}.s3.{region}.amazonaws.com"
+        url = f"https://{host}/{key_path}"
+        parsed = urllib.parse.urlparse(url)
+
+    name = os.path.basename(key_path) or "artifact"
+    dest = os.path.join(dest_dir, name)
+
+    headers = {}
+    access = os.environ.get("AWS_ACCESS_KEY_ID")
+    secret = os.environ.get("AWS_SECRET_ACCESS_KEY")
+    if access and secret:
+        headers = _sigv4_headers(
+            "GET", parsed, region, access, secret,
+            os.environ.get("AWS_SESSION_TOKEN"))
+
+    req = urllib.request.Request(url, headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp, \
+                open(dest, "wb") as out:
+            shutil.copyfileobj(resp, out)
+    except OSError as e:
+        raise ArtifactError(f"failed to fetch {source}: {e}") from e
+    return dest
+
+
+def _sigv4_headers(method: str, parsed, region: str, access: str,
+                   secret: str, session_token: Optional[str]) -> dict:
+    """AWS Signature Version 4 for a bodyless request — the standard
+    canonical-request / string-to-sign / signing-key derivation, done
+    with hashlib+hmac so no SDK is needed."""
+    import datetime
+    import hmac
+
+    t = datetime.datetime.now(datetime.timezone.utc)
+    amz_date = t.strftime("%Y%m%dT%H%M%SZ")
+    datestamp = t.strftime("%Y%m%d")
+    service = "s3"
+    payload_hash = hashlib.sha256(b"").hexdigest()
+    host = parsed.netloc
+
+    signed = {"host": host, "x-amz-content-sha256": payload_hash,
+              "x-amz-date": amz_date}
+    if session_token:
+        signed["x-amz-security-token"] = session_token
+    signed_names = ";".join(sorted(signed))
+    canonical_headers = "".join(
+        f"{k}:{signed[k]}\n" for k in sorted(signed))
+    # Canonical URI: each path segment URI-encoded exactly once (an
+    # already-encoded path must not be double-encoded — unquote first),
+    # and the query string as sorted, individually-encoded k=v pairs.
+    segments = (parsed.path or "/").split("/")
+    canonical_uri = "/".join(
+        urllib.parse.quote(urllib.parse.unquote(seg), safe="-_.~")
+        for seg in segments) or "/"
+    q_pairs = urllib.parse.parse_qsl(parsed.query, keep_blank_values=True)
+    canonical_query = "&".join(
+        f"{urllib.parse.quote(k, safe='-_.~')}="
+        f"{urllib.parse.quote(v, safe='-_.~')}"
+        for k, v in sorted(q_pairs))
+    canonical = (f"{method}\n{canonical_uri}\n{canonical_query}\n"
+                 f"{canonical_headers}\n{signed_names}\n{payload_hash}")
+    scope = f"{datestamp}/{region}/{service}/aws4_request"
+    to_sign = ("AWS4-HMAC-SHA256\n" + amz_date + "\n" + scope + "\n"
+               + hashlib.sha256(canonical.encode()).hexdigest())
+
+    def _hmac(key: bytes, msg: str) -> bytes:
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+    k = _hmac(("AWS4" + secret).encode(), datestamp)
+    k = _hmac(k, region)
+    k = _hmac(k, service)
+    k = _hmac(k, "aws4_request")
+    signature = hmac.new(k, to_sign.encode(), hashlib.sha256).hexdigest()
+
+    headers = {
+        "x-amz-date": amz_date,
+        "x-amz-content-sha256": payload_hash,
+        "Authorization": (
+            f"AWS4-HMAC-SHA256 Credential={access}/{scope}, "
+            f"SignedHeaders={signed_names}, Signature={signature}"),
+    }
+    if session_token:
+        headers["x-amz-security-token"] = session_token
+    return headers
 
 
 def _get_git(source: str, artifact: s.TaskArtifact, dest_dir: str) -> str:

@@ -1,0 +1,269 @@
+"""Docker Engine API driver against a fake daemon on a unix socket
+(reference: client/driver/docker_test.go runs against a real daemon; the
+fake keeps the API contract testable in this environment)."""
+
+import http.server
+import json
+import os
+import socketserver
+import struct
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client.driver.docker_api import (
+    DockerAPI,
+    DockerAPIDriver,
+    _demux,
+)
+from nomad_tpu.client.driver.driver import DriverContext, ExecContext
+from nomad_tpu.client.driver.env import TaskEnv
+from nomad_tpu.structs import structs as s
+
+
+class _FakeDockerd(socketserver.ThreadingUnixStreamServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def _frame(stream: int, payload: bytes) -> bytes:
+    return bytes([stream, 0, 0, 0]) + struct.pack(">I", len(payload)) + payload
+
+
+class FakeState:
+    def __init__(self):
+        self.containers = {}
+        self.images = {"present:latest"}
+        self.pulled = []
+        self.killed = []
+        self.removed = []
+        self.created_payloads = {}
+        self.exit_code = 0
+        self.wait_delay = 0.05
+
+
+def make_handler(state: FakeState):
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _json(self, code, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _raw(self, code, body, ctype="application/octet-stream"):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            path = self.path
+            if path == "/_ping":
+                return self._raw(200, b"OK", "text/plain")
+            if path.endswith("/version"):
+                return self._json(200, {"Version": "99.fake"})
+            if "/images/" in path and path.endswith("/json"):
+                name = path.split("/images/")[1][:-len("/json")]
+                if ":" not in name:
+                    name += ":latest"
+                if name in state.images:
+                    return self._json(200, {"Id": "sha256:abc"})
+                return self._json(404, {"message": "no such image"})
+            if path.endswith("/json") and "/containers/" in path:
+                cid = path.split("/containers/")[1][:-len("/json")]
+                if cid in state.containers:
+                    return self._json(200, {"Id": cid,
+                                            "State": {"Running": True}})
+                return self._json(404, {"message": "no such container"})
+            if "/logs" in path:
+                return self._raw(200, _frame(1, b"hello-out\n")
+                                 + _frame(2, b"hello-err\n"))
+            if "/stats" in path:
+                return self._json(200, {
+                    "memory_stats": {"usage": 1048576},
+                    "cpu_stats": {"cpu_usage": {"total_usage": 5000000}}})
+            return self._json(404, {"message": f"GET {path}?"})
+
+        def do_POST(self):
+            path = self.path
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length else b""
+            if "/images/create" in path:
+                image = path.split("fromImage=")[1]
+                state.pulled.append(image)
+                state.images.add(image)
+                return self._raw(200, json.dumps(
+                    {"status": "Download complete"}).encode() + b"\n")
+            if path.endswith("/containers/create") or \
+                    "/containers/create?name=" in path:
+                name = path.split("name=")[1] if "name=" in path else "c"
+                cid = f"cid-{len(state.containers)}-{name[:20]}"
+                state.containers[cid] = "created"
+                state.created_payloads[cid] = json.loads(body)
+                return self._json(201, {"Id": cid})
+            if path.endswith("/start"):
+                cid = path.split("/containers/")[1][:-len("/start")]
+                state.containers[cid] = "running"
+                self.send_response(204)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            if path.endswith("/wait"):
+                cid = path.split("/containers/")[1][:-len("/wait")]
+                time.sleep(state.wait_delay)
+                state.containers[cid] = "exited"
+                return self._json(200, {"StatusCode": state.exit_code})
+            if "/kill" in path:
+                cid = path.split("/containers/")[1].split("/kill")[0]
+                sig = path.split("signal=")[1] if "signal=" in path else ""
+                state.killed.append((cid, sig))
+                self.send_response(204)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            return self._json(404, {"message": f"POST {path}?"})
+
+        def do_DELETE(self):
+            cid = self.path.split("/containers/")[1].split("?")[0]
+            state.removed.append(cid)
+            state.containers.pop(cid, None)
+            self.send_response(204)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    return Handler
+
+
+@pytest.fixture
+def fake_dockerd(tmp_path):
+    state = FakeState()
+    sock = str(tmp_path / "docker.sock")
+    server = _FakeDockerd(sock, make_handler(state))
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield sock, state
+    server.shutdown()
+
+
+def _mk_driver(sock):
+    api = DockerAPI(socket_path=sock)
+    ctx = DriverContext(driver_name="docker", alloc_id="a1", config=None)
+    return DockerAPIDriver(ctx, api), api
+
+
+class _TaskDir:
+    def __init__(self, base):
+        self.dir = str(base)
+        self.log_dir = os.path.join(str(base), "logs")
+        self.task_name = "web"
+
+
+def _mk_task(image="present", command=""):
+    task = mock.job().task_groups[0].tasks[0]
+    task.name = "web"
+    task.driver = "docker"
+    task.config = {"image": image}
+    if command:
+        task.config["command"] = command
+    task.resources = s.Resources(cpu=250, memory_mb=64)
+    task.resources.networks = []
+    return task
+
+
+class TestDockerAPIDriver:
+    def test_fingerprint(self, fake_dockerd):
+        sock, state = fake_dockerd
+        drv, _ = _mk_driver(sock)
+        node = mock.node()
+        assert drv.fingerprint(node)
+        assert node.attributes["driver.docker"] == "1"
+        assert node.attributes["driver.docker.version"] == "99.fake"
+
+    def test_unavailable_socket(self, tmp_path):
+        drv, api = _mk_driver(str(tmp_path / "nope.sock"))
+        assert not api.available()
+        assert not drv.fingerprint(mock.node())
+
+    def test_full_lifecycle(self, fake_dockerd, tmp_path):
+        sock, state = fake_dockerd
+        drv, _ = _mk_driver(sock)
+        task = _mk_task(image="busybox", command="sleep")
+        env = TaskEnv(env_map={"NOMAD_TASK_NAME": "web"})
+        ectx = ExecContext(task_dir=_TaskDir(tmp_path / "task"), task_env=env)
+
+        drv.prestart(ectx, task)  # image absent → pull
+        assert state.pulled == ["busybox:latest"]
+
+        resp = drv.start(ectx, task)
+        handle = resp.handle
+        cid = handle.cid
+        payload = state.created_payloads[cid]
+        assert payload["Image"] == "busybox"
+        assert payload["HostConfig"]["Memory"] == 64 * 1024 * 1024
+        assert payload["HostConfig"]["CpuShares"] == 250
+        assert any(e.startswith("NOMAD_TASK_NAME=") for e in payload["Env"])
+        assert payload["Cmd"] == ["sleep"]
+
+        assert handle.wait_ch().wait(10.0)
+        assert handle.wait_result().exit_code == 0
+        # logs were flushed into the executor-style log tree
+        out = open(os.path.join(ectx.task_dir.log_dir, "web.stdout.0"),
+                   "rb").read()
+        err = open(os.path.join(ectx.task_dir.log_dir, "web.stderr.0"),
+                   "rb").read()
+        assert out == b"hello-out\n" and err == b"hello-err\n"
+        assert cid in state.removed
+
+    def test_failure_exit_code(self, fake_dockerd, tmp_path):
+        sock, state = fake_dockerd
+        state.exit_code = 137
+        drv, _ = _mk_driver(sock)
+        ectx = ExecContext(task_dir=_TaskDir(tmp_path / "t2"), task_env=TaskEnv())
+        resp = drv.start(ectx, _mk_task())
+        assert resp.handle.wait_ch().wait(10.0)
+        assert resp.handle.wait_result().exit_code == 137
+
+    def test_kill_and_signal(self, fake_dockerd, tmp_path):
+        sock, state = fake_dockerd
+        state.wait_delay = 1.0
+        drv, _ = _mk_driver(sock)
+        ectx = ExecContext(task_dir=_TaskDir(tmp_path / "t3"), task_env=TaskEnv())
+        resp = drv.start(ectx, _mk_task())
+        resp.handle.signal(15)
+        resp.handle.kill()
+        sigs = [sig for _c, sig in state.killed]
+        assert "SIGTERM" in sigs and "SIGKILL" in sigs
+        assert resp.handle.wait_ch().wait(10.0)
+
+    def test_open_reattach(self, fake_dockerd, tmp_path):
+        sock, state = fake_dockerd
+        state.wait_delay = 0.5
+        drv, _ = _mk_driver(sock)
+        ectx = ExecContext(task_dir=_TaskDir(tmp_path / "t4"), task_env=TaskEnv())
+        resp = drv.start(ectx, _mk_task())
+        hid = resp.handle.id()
+        assert hid.startswith("docker-api:")
+        h2 = drv.open(ectx, hid)
+        assert h2.wait_ch().wait(10.0)
+
+    def test_stats(self, fake_dockerd, tmp_path):
+        sock, state = fake_dockerd
+        state.wait_delay = 1.0
+        drv, _ = _mk_driver(sock)
+        ectx = ExecContext(task_dir=_TaskDir(tmp_path / "t5"), task_env=TaskEnv())
+        resp = drv.start(ectx, _mk_task())
+        st = resp.handle.stats()
+        assert st["memory_rss_bytes"] == 1048576
+        resp.handle.kill()
+
+
+def test_demux_tty_fallback():
+    out, err = _demux(b"raw tty output with no framing")
+    assert out == b"raw tty output with no framing" and err == b""
