@@ -291,7 +291,17 @@ class DockerAPIHandle(DriverHandle):
         pass
 
     def kill(self) -> None:
-        self.api.kill(self.cid)
+        # Transport hiccups are absorbed like the executor handle's
+        # shutdown path — the destroy flow must not mark the task dead
+        # while leaving the container running silently; the wait loop
+        # still owns cleanup when the container eventually exits.
+        try:
+            self.api.kill(self.cid)
+        except (OSError, http.client.HTTPException, DriverError) as exc:
+            import logging
+
+            logging.getLogger("nomad_tpu.client.driver.docker").warning(
+                "docker kill %s failed: %s", self.cid[:12], exc)
 
     def signal(self, sig: int) -> None:
         import signal as _signal
@@ -300,14 +310,24 @@ class DockerAPIHandle(DriverHandle):
             name = _signal.Signals(sig).name
         except ValueError:
             name = str(sig)
-        self.api.kill(self.cid, name)
+        try:
+            self.api.kill(self.cid, name)
+        except (OSError, http.client.HTTPException, DriverError) as exc:
+            import logging
+
+            logging.getLogger("nomad_tpu.client.driver.docker").warning(
+                "docker signal %s %s failed: %s", name, self.cid[:12], exc)
 
     def stats(self) -> Dict:
+        """Executor-schema stats ({rss_bytes, cpu_seconds, ...}) so the
+        client stats endpoint reports one shape regardless of which
+        transport ran the docker task."""
         raw = self.api.stats(self.cid)
         mem = (raw.get("memory_stats") or {}).get("usage", 0)
-        cpu = ((raw.get("cpu_stats") or {}).get("cpu_usage") or {}).get(
+        cpu_ns = ((raw.get("cpu_stats") or {}).get("cpu_usage") or {}).get(
             "total_usage", 0)
-        return {"memory_rss_bytes": mem, "cpu_total_ns": cpu}
+        return {"rss_bytes": mem, "cpu_seconds": cpu_ns / 1e9,
+                "container_id": self.cid}
 
 
 class DockerAPIDriver(Driver):
@@ -415,10 +435,17 @@ class DockerAPIDriver(Driver):
 
     def fingerprint(self, node: s.Node) -> bool:
         if not self.api.available():
+            # The daemon went away: withdraw the capability so the
+            # scheduler stops placing docker tasks here (the sibling
+            # drivers pop their attribute the same way).
+            node.attributes.pop("driver.docker", None)
+            node.attributes.pop("driver.docker.version", None)
             return False
         try:
             ver = self.api.version()
         except DriverError:
+            node.attributes.pop("driver.docker", None)
+            node.attributes.pop("driver.docker.version", None)
             return False
         node.attributes["driver.docker"] = "1"
         node.attributes["driver.docker.version"] = str(

@@ -107,14 +107,19 @@ def _get_s3(source: str, artifact: s.TaskArtifact, task_env: TaskEnv,
         os.environ.get("AWS_REGION") or "us-east-1"
 
     if source.startswith("s3::"):
+        # Forced-protocol form: an explicit, already-encoded URL.
         url = source[len("s3::"):]
         parsed = urllib.parse.urlparse(url)
         key_path = parsed.path.lstrip("/")
     else:
-        parsed = urllib.parse.urlparse(source)  # s3://bucket/key
-        bucket, key_path = parsed.netloc, parsed.path.lstrip("/")
+        # s3://bucket/key — the key is RAW (may contain spaces/#/?), so
+        # parse it manually (urlparse would strip a '#key-fragment') and
+        # percent-encode it into the URL we actually send.
+        rest = source[len("s3://"):]
+        bucket, _, key_path = rest.partition("/")
         host = f"{bucket}.s3.{region}.amazonaws.com"
-        url = f"https://{host}/{key_path}"
+        url = (f"https://{host}/"
+               f"{urllib.parse.quote(key_path, safe='/-_.~')}")
         parsed = urllib.parse.urlparse(url)
 
     name = os.path.basename(key_path) or "artifact"

@@ -39,6 +39,10 @@ from .kernels import device_pass, summary_layout
 
 logger = logging.getLogger("nomad_tpu.ops.batch_sched")
 
+# Static cluster-tensor cache: (nodes index, attr targets, literals,
+# with_networks) → finalized ClusterTensors (see _place_on_device).
+_CLUSTER_CACHE: Dict[Tuple, "encode.ClusterTensors"] = {}
+
 _cache_configured = False
 
 
@@ -333,9 +337,32 @@ class TPUBatchScheduler:
                     allocs_by_node[alloc.node_id].append(alloc)
 
         with_networks = any(sp.net_active for sp in spec_list)
-        ct = encode.encode_cluster(all_nodes, attr_targets, allocs_by_node,
-                                   with_networks=with_networks)
-        encode.finalize_codebooks(ct, literals)
+        # Static cluster tensors are cached across batches keyed by the
+        # nodes-table raft index (+ the constraint vocabulary): a stable
+        # fleet re-encodes nothing; only alloc usage is layered on per
+        # batch (SURVEY §2.2 incremental device mirror).
+        base = None
+        cache_key = None
+        table_index = getattr(self.state, "table_index", None)
+        store_uid = getattr(self.state, "store_uid", None)
+        if table_index is not None and store_uid is not None:
+            lit_key = tuple(sorted(
+                (t, tuple(sorted(vs))) for t, vs in literals.items()))
+            cache_key = (store_uid, table_index("nodes"),
+                         tuple(attr_targets), lit_key, with_networks)
+            base = _CLUSTER_CACHE.pop(cache_key, None)
+            if base is not None:
+                _CLUSTER_CACHE[cache_key] = base  # LRU touch-on-hit
+        if base is None:
+            base = encode.encode_cluster_static(
+                all_nodes, attr_targets, with_networks=with_networks)
+            encode.finalize_codebooks(base, literals)
+            if cache_key is not None:
+                _CLUSTER_CACHE[cache_key] = base
+                while len(_CLUSTER_CACHE) > 4:
+                    _CLUSTER_CACHE.pop(next(iter(_CLUSTER_CACHE)))
+        ct = (encode.apply_alloc_usage(base, allocs_by_node)
+              if allocs_by_node else base)
         st = encode.encode_specs(spec_list, ct, all_nodes)
 
         # Existing per-(job, node) alloc counts for anti-affinity/distinct,

@@ -132,6 +132,26 @@ def encode_cluster(
     accounting (only when the batch actually asks for networks — the
     bitmaps are 8KB per node).
     """
+    ct = encode_cluster_static(nodes, attr_targets,
+                               node_pad_multiple=node_pad_multiple,
+                               with_networks=with_networks)
+    if allocs_by_node:
+        ct = apply_alloc_usage(ct, allocs_by_node)
+    return ct
+
+
+def encode_cluster_static(
+    nodes: Sequence[s.Node],
+    attr_targets: Sequence[str],
+    node_pad_multiple: int = 128,
+    with_networks: bool = False,
+) -> ClusterTensors:
+    """The alloc-independent cluster tensors: capacity, reserved-only
+    usage, eligibility, dc/class codes, attribute columns, reserved-port
+    bitmaps.  Cacheable across batches keyed by the nodes-table raft
+    index (SURVEY §2.2: the scheduler-visible state is mirrored into
+    device tensors incrementally); per-batch alloc usage is layered on
+    with apply_alloc_usage()."""
     n_real = len(nodes)
     n_pad = max(node_pad_multiple, round_up(n_real, node_pad_multiple))
 
@@ -160,14 +180,6 @@ def encode_cluster(
         capacity[i] = _res_vec(node.resources)
         reserved = _res_vec(node.reserved)
         used[i] = reserved
-        if allocs_by_node:
-            for alloc in allocs_by_node.get(node.id, []):
-                if alloc.resources is not None:
-                    used[i] += _res_vec(alloc.resources)
-                else:
-                    used[i] += _res_vec(alloc.shared_resources)
-                    for tr in alloc.task_resources.values():
-                        used[i] += _res_vec(tr)
         denom_cpu = float(capacity[i][0] - reserved[0])
         denom_mem = float(capacity[i][1] - reserved[1])
         score_denom[i] = (denom_cpu, denom_mem)
@@ -190,11 +202,6 @@ def encode_cluster(
             if node.reserved is not None:
                 for nr in node.reserved.networks or []:
                     _account(nr)
-            if allocs_by_node:
-                for alloc in allocs_by_node.get(node.id, []):
-                    for tr in alloc.task_resources.values():
-                        if tr.networks:
-                            _account(tr.networks[0])
             for p in used_ports:
                 port_words[i, p >> 5] |= np.uint32(1 << (p & 31))
             in_dyn = sum(1 for p in used_ports
@@ -247,7 +254,77 @@ def encode_cluster(
     ct._raw_rows = return_raw          # type: ignore[attr-defined]
     ct._value_sets = value_sets        # type: ignore[attr-defined]
     ct._class_codebook = class_codebook  # type: ignore[attr-defined]
+    ct._nodes = list(nodes)            # type: ignore[attr-defined]
+    ct._with_networks = with_networks  # type: ignore[attr-defined]
+    ct._node_index = {nid: i for i, nid in enumerate(node_ids)}  # type: ignore[attr-defined]
     return ct
+
+
+def apply_alloc_usage(
+    ct: ClusterTensors,
+    allocs_by_node: Dict[str, List[s.Allocation]],
+) -> ClusterTensors:
+    """Layer live-allocation usage onto (a shallow copy of) the static
+    cluster tensors — the cached static part is never mutated.
+
+    Resource usage adds each alloc's combined (or per-task) resources;
+    network accounting re-derives each TOUCHED node's used-port set from
+    reserved + alloc networks, exactly like the fused loop this replaces."""
+    import dataclasses as _dc
+
+    new = _dc.replace(
+        ct,
+        used=ct.used.copy(),
+        bw_used=ct.bw_used.copy(),
+        dyn_free=ct.dyn_free.copy(),
+        port_words=(ct.port_words.copy()
+                    if getattr(ct, "_with_networks", False) else ct.port_words),
+    )
+    for attr in ("_raw_rows", "_value_sets", "_class_codebook", "_nodes",
+                 "_with_networks", "_node_index"):
+        if hasattr(ct, attr):
+            setattr(new, attr, getattr(ct, attr))
+
+    node_index = new._node_index
+    nodes = new._nodes
+    with_networks = getattr(ct, "_with_networks", False)
+    used = new.used
+    for nid, allocs in allocs_by_node.items():
+        i = node_index.get(nid)
+        if i is None:
+            continue
+        for alloc in allocs:
+            if alloc.resources is not None:
+                used[i] += _res_vec(alloc.resources)
+            else:
+                used[i] += _res_vec(alloc.shared_resources)
+                for tr in alloc.task_resources.values():
+                    used[i] += _res_vec(tr)
+        if with_networks:
+            node = nodes[i]
+            new.bw_used[i] = 0
+            new.port_words[i, :] = 0
+            used_ports: Set[int] = set()
+
+            def _account(nr: s.NetworkResource):
+                new.bw_used[i] += nr.mbits
+                for p in list(nr.reserved_ports) + list(nr.dynamic_ports):
+                    if 0 <= p.value < MAX_VALID_PORT:
+                        used_ports.add(p.value)
+
+            if node.reserved is not None:
+                for nr in node.reserved.networks or []:
+                    _account(nr)
+            for alloc in allocs:
+                for tr in alloc.task_resources.values():
+                    if tr.networks:
+                        _account(tr.networks[0])
+            for p in used_ports:
+                new.port_words[i, p >> 5] |= np.uint32(1 << (p & 31))
+            in_dyn = sum(1 for p in used_ports
+                         if MIN_DYNAMIC_PORT <= p < MAX_DYNAMIC_PORT)
+            new.dyn_free[i] = (MAX_DYNAMIC_PORT - MIN_DYNAMIC_PORT) - in_dyn
+    return new
 
 
 def finalize_codebooks(ct: ClusterTensors, literals: Dict[str, Set[str]]) -> None:

@@ -1134,6 +1134,16 @@ class AllocSlab:
         a.alloc_modify_index = self.modify_index
         return a
 
+    def id_index(self, alloc_id: str) -> int:
+        """Column index of an alloc id; the reverse map is built lazily on
+        first by-id access (bulk inserts never need it — undeclared attr,
+        so it stays off the wire codec)."""
+        idx = getattr(self, "_id_idx", None)
+        if idx is None:
+            idx = {aid: i for i, aid in enumerate(self.ids)}
+            self._id_idx = idx
+        return idx[alloc_id]
+
     def allocs(self) -> List[Allocation]:
         return [self.materialize(i) for i in range(len(self.ids))]
 

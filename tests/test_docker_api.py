@@ -260,7 +260,9 @@ class TestDockerAPIDriver:
         ectx = ExecContext(task_dir=_TaskDir(tmp_path / "t5"), task_env=TaskEnv())
         resp = drv.start(ectx, _mk_task())
         st = resp.handle.stats()
-        assert st["memory_rss_bytes"] == 1048576
+        # Executor-schema keys: one stats shape regardless of transport.
+        assert st["rss_bytes"] == 1048576
+        assert st["cpu_seconds"] == pytest.approx(0.005)
         resp.handle.kill()
 
 

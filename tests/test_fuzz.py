@@ -39,7 +39,9 @@ def make_script(seed: int, steps: int):
         if op == "register_job":
             script.append((op, rng.randrange(1, 5),
                            rng.choice([200, 400, 600]),
-                           rng.random() < 0.3))
+                           rng.random() < 0.3,
+                           rng.random() < 0.2,    # distinct_hosts
+                           rng.random() < 0.2))   # batch-type job
         elif op == "update_job":
             script.append((op, rng.randrange(1 << 16), rng.randrange(1, 6)))
         elif op == "add_node":
@@ -79,7 +81,13 @@ class FuzzWorld:
                                   self.h.snapshot(), self.h)
             sched.process(ev)
         else:
-            self.h.process(new_service_scheduler, ev)
+            # Factory by eval type, exactly like the worker
+            # (worker.go:262 invokeScheduler).
+            from nomad_tpu.scheduler import new_batch_scheduler
+
+            factory = (new_batch_scheduler if ev.type == s.JOB_TYPE_BATCH
+                       else new_service_scheduler)
+            self.h.process(factory, ev)
 
     def _node_evals(self, node_id):
         """One eval per job with allocs on the node
@@ -99,7 +107,9 @@ class FuzzWorld:
         if kind == "add_node":
             self.add_node(cpu=op[1], mem=op[2])
         elif kind == "register_job":
-            self.register_job(count=op[1], cpu=op[2], constrained=op[3])
+            self.register_job(count=op[1], cpu=op[2], constrained=op[3],
+                              distinct_hosts=(op[4] if len(op) > 4 else False),
+                              batch_type=(op[5] if len(op) > 5 else False))
         elif kind == "update_job":
             if self.job_order:
                 self.update_job_count(self.job_order[op[1] % len(self.job_order)],
@@ -141,9 +151,12 @@ class FuzzWorld:
         self.node_order.append(n.id)
         return n
 
-    def register_job(self, count, cpu, constrained):
+    def register_job(self, count, cpu, constrained, distinct_hosts=False,
+                     batch_type=False):
         job = mock.job()
         job.id = job.name = f"job-{self.step_no}"
+        if batch_type:
+            job.type = s.JOB_TYPE_BATCH
         tg = job.task_groups[0]
         tg.count = count
         for t in tg.tasks:
@@ -153,6 +166,9 @@ class FuzzWorld:
         if constrained:
             tg.constraints = list(tg.constraints) + [s.Constraint(
                 "${attr.kernel.name}", "linux", "=")]
+        if distinct_hosts:
+            tg.constraints = list(tg.constraints) + [s.Constraint(
+                "", "", s.CONSTRAINT_DISTINCT_HOSTS)]
         self.h.state.upsert_job(self.h.next_index(), job)
         self.jobs[job.id] = job
         self.job_order.append(job.id)
@@ -247,8 +263,10 @@ class FuzzWorld:
 
     def drain_blocked(self):
         """I4: add ample capacity and reprocess every live job until each
-        reaches its desired count (the blocked-evals-drain guarantee)."""
-        for _ in range(3):
+        reaches its desired count (the blocked-evals-drain guarantee).
+        Five fresh nodes: distinct_hosts jobs (count ≤ 4) must find enough
+        eligible hosts even if every earlier node went down."""
+        for _ in range(5):
             self.add_node(cpu=16000, mem=32768)
         for _ in range(4):
             for jid in list(self.job_order):
@@ -264,12 +282,15 @@ class FuzzWorld:
 
 
 SEEDS = [7, 23, 91, 1337]
+LONG_SEEDS = [2024, 4242]
 
 
 class TestDifferentialFuzz:
-    @pytest.mark.parametrize("seed", SEEDS)
-    def test_fuzz_invariants_and_convergence(self, seed):
-        script = make_script(seed, steps=60)
+    @pytest.mark.parametrize("seed,steps",
+                             [(s_, 60) for s_ in SEEDS]
+                             + [(s_, 140) for s_ in LONG_SEEDS])
+    def test_fuzz_invariants_and_convergence(self, seed, steps):
+        script = make_script(seed, steps=steps)
         worlds = {}
         for kind in ("oracle", "tpu-batch"):
             w = FuzzWorld(kind)
