@@ -1,12 +1,15 @@
 """Headline benchmark: batched TPU scheduling throughput vs the CPU oracle.
 
-Config (b) from BASELINE.json: 10k nodes × 100k task-groups, CPU+mem-only
-bin-pack, plus a config (e)-scale secondary run (50k nodes × 1M task-groups).
+BASELINE.json configs measured:
+  (b) 10k nodes × 100k task-groups, CPU+mem bin-pack  — the HEADLINE
+  (c)  5k nodes ×  50k task-groups, hard constraints + distinct_hosts
+  (d) 10k nodes, one system job (oracle SystemScheduler — host path)
+  (e) 50k nodes ×   1M task-groups — the north-star scale
 The CPU oracle (our faithful GenericScheduler implementation) is timed on a
-10% sample of the same config — the reference publishes no absolute numbers
-(BASELINE.md), so phase-0 is to measure the oracle ourselves.  The headline
-value is *placed* task-groups per second (not asks/sec): placements are the
-work actually done.
+10% sample of the full config (b) — the reference publishes no absolute
+numbers (BASELINE.md), so phase-0 is to measure the oracle ourselves.  The
+headline value is *placed* task-groups per second (not asks/sec):
+placements are the work actually done.
 
 Warm-up uses the full eval set against a state snapshot + null planner so the
 timed run hits a warm XLA cache on identical bucketed shapes; the one-time
@@ -52,14 +55,22 @@ def build_cluster(h, n_nodes):
         h.state.upsert_node(h.next_index(), node)
 
 
-def make_job(count):
+def make_job(count, constrained=False):
     from nomad_tpu import mock
+    from nomad_tpu.structs import structs as s
 
     job = mock.job()
     job.task_groups[0].count = count
     for tg in job.task_groups:
         for t in tg.tasks:
             t.resources.networks = []
+    if constrained:
+        # Config (c): a hard attribute constraint plus distinct_hosts.
+        tg = job.task_groups[0]
+        tg.constraints = list(tg.constraints) + [
+            s.Constraint("${attr.kernel.name}", "linux", "="),
+            s.Constraint("", "", s.CONSTRAINT_DISTINCT_HOSTS),
+        ]
     return job
 
 
@@ -95,7 +106,37 @@ def bench_oracle() -> float:
     return rate
 
 
-def run_config(n_nodes: int, n_jobs: int, count_per_job: int, label: str):
+def bench_system(n_nodes: int):
+    """Config (d): one system job across the fleet (SystemScheduler —
+    a host-path measurement; the device path covers service/batch)."""
+    from nomad_tpu import mock
+    from nomad_tpu.scheduler import Harness, new_system_scheduler
+    from nomad_tpu.structs import structs as s
+
+    h = Harness()
+    build_cluster(h, n_nodes)
+    job = mock.system_job() if hasattr(mock, "system_job") else None
+    if job is None:
+        job = make_job(1)
+        job.type = s.JOB_TYPE_SYSTEM
+    else:
+        for tg in job.task_groups:
+            for t in tg.tasks:
+                t.resources.networks = []
+    h.state.upsert_job(h.next_index(), job)
+    ev = reg_eval(job)
+    t0 = time.monotonic()
+    h.process(new_system_scheduler, ev)
+    elapsed = time.monotonic() - t0
+    placed = len(h.state.allocs_by_job(None, job.id, True))
+    log(f"config-d: system job on {n_nodes} nodes: {placed} placed in "
+        f"{elapsed:.2f}s → {placed / elapsed:.0f} placed-tg/s")
+    return {"placed": placed, "elapsed_s": round(elapsed, 3),
+            "placed_per_s": round(placed / elapsed, 1)}
+
+
+def run_config(n_nodes: int, n_jobs: int, count_per_job: int, label: str,
+               constrained: bool = False):
     """One warm-compiled tpu-batch run; returns (placed_rate, detail)."""
     import jax
 
@@ -104,7 +145,8 @@ def run_config(n_nodes: int, n_jobs: int, count_per_job: int, label: str):
 
     h = Harness()
     build_cluster(h, n_nodes)
-    jobs = [make_job(count_per_job) for _ in range(n_jobs)]
+    jobs = [make_job(count_per_job, constrained=constrained)
+            for _ in range(n_jobs)]
     for j in jobs:
         h.state.upsert_job(h.next_index(), j)
     evals = [reg_eval(j) for j in jobs]
@@ -165,6 +207,20 @@ class NullPlanner:
 def main():
     oracle_rate = bench_oracle()
     rate_b, detail_b = run_config(N_NODES, N_JOBS, COUNT_PER_JOB, "config-b")
+    extras = {}
+    try:
+        rate_c, detail_c = run_config(5_000, 50, COUNT_PER_JOB, "config-c",
+                                      constrained=True)
+        extras["config_c_constraints_distinct_hosts"] = detail_c
+        extras["config_c_placed_per_s"] = round(rate_c, 1)
+    except Exception as exc:
+        log(f"config-c failed: {exc!r}")
+        extras["config_c_constraints_distinct_hosts"] = {"error": repr(exc)}
+    try:
+        extras["config_d_system_10k_nodes"] = bench_system(N_NODES)
+    except Exception as exc:
+        log(f"config-d failed: {exc!r}")
+        extras["config_d_system_10k_nodes"] = {"error": repr(exc)}
     try:
         rate_e, detail_e = run_config(E_N_NODES, E_N_JOBS, COUNT_PER_JOB,
                                       "config-e")
@@ -182,6 +238,7 @@ def main():
             "config_b": detail_b,
             "config_e_50k_nodes_1m_tgs": detail_e,
             "config_e_placed_per_s": round(rate_e, 1),
+            **extras,
         },
     }
     print(json.dumps(out), flush=True)
