@@ -107,32 +107,45 @@ def bench_oracle() -> float:
 
 
 def bench_system(n_nodes: int):
-    """Config (d): one system job across the fleet (SystemScheduler —
-    a host-path measurement; the device path covers service/batch)."""
+    """Config (d): one system job across the fleet — the vectorized
+    'tpu-system' pass (ops/system_batch.py), with the per-node oracle
+    loop timed on a 10% sample for comparison."""
     from nomad_tpu import mock
+    from nomad_tpu.ops.system_batch import new_tpu_system_scheduler
     from nomad_tpu.scheduler import Harness, new_system_scheduler
-    from nomad_tpu.structs import structs as s
 
-    h = Harness()
-    build_cluster(h, n_nodes)
-    job = mock.system_job() if hasattr(mock, "system_job") else None
-    if job is None:
-        job = make_job(1)
-        job.type = s.JOB_TYPE_SYSTEM
-    else:
+    def mk_job():
+        job = mock.system_job()
         for tg in job.task_groups:
             for t in tg.tasks:
                 t.resources.networks = []
+        return job
+
+    # Oracle sample (10%).
+    h = Harness()
+    build_cluster(h, n_nodes // 10)
+    job = mk_job()
     h.state.upsert_job(h.next_index(), job)
-    ev = reg_eval(job)
     t0 = time.monotonic()
-    h.process(new_system_scheduler, ev)
+    h.process(new_system_scheduler, reg_eval(job))
+    oracle_elapsed = time.monotonic() - t0
+    oracle_rate = len(
+        h.state.allocs_by_job(None, job.id, True)) / oracle_elapsed
+
+    h = Harness()
+    build_cluster(h, n_nodes)
+    job = mk_job()
+    h.state.upsert_job(h.next_index(), job)
+    t0 = time.monotonic()
+    h.process(new_tpu_system_scheduler, reg_eval(job))
     elapsed = time.monotonic() - t0
     placed = len(h.state.allocs_by_job(None, job.id, True))
     log(f"config-d: system job on {n_nodes} nodes: {placed} placed in "
-        f"{elapsed:.2f}s → {placed / elapsed:.0f} placed-tg/s")
+        f"{elapsed:.2f}s → {placed / elapsed:.0f} placed-tg/s "
+        f"(oracle loop: {oracle_rate:.0f}/s)")
     return {"placed": placed, "elapsed_s": round(elapsed, 3),
-            "placed_per_s": round(placed / elapsed, 1)}
+            "placed_per_s": round(placed / elapsed, 1),
+            "oracle_placed_per_s": round(oracle_rate, 1)}
 
 
 def run_config(n_nodes: int, n_jobs: int, count_per_job: int, label: str,

@@ -180,7 +180,9 @@ def retry_max(max_attempts: int, cb, reset=None) -> None:
 
 def progress_made(result: Optional[s.PlanResult]) -> bool:
     """(util.go:291)."""
-    return result is not None and (bool(result.node_update) or bool(result.node_allocation))
+    return result is not None and (bool(result.node_update)
+                                   or bool(result.node_allocation)
+                                   or bool(result.alloc_slabs))
 
 
 def tainted_nodes(state, allocs: List[s.Allocation]) -> Dict[str, Optional[s.Node]]:

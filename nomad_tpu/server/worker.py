@@ -179,11 +179,16 @@ class Worker:
             time.sleep(0.005)
         return self.raft.applied_index() >= index
 
+    def sched_name(self, ev: s.Evaluation) -> str:
+        """Scheduler-registry name for an eval (overridable: the batch
+        worker swaps in vectorized implementations)."""
+        return ev.type
+
     def invoke_scheduler(self, ev: s.Evaluation, token: str) -> None:
         """(worker.go:262): snapshot state, instantiate by eval type."""
         snap = self.raft.fsm.state.snapshot()
         planner = WorkerPlanner(self, ev, token)
-        sched_name = ev.type
+        sched_name = self.sched_name(ev)
         if ev.type == s.JOB_TYPE_CORE:
             from .core_sched import CoreScheduler
 
@@ -198,13 +203,20 @@ class BatchWorker(Worker):
     """Drains evals in batches into the TPU batch scheduler.
 
     Service and batch evals are batched (their placement logic is the
-    generic scheduler's); system/core evals are processed singly via the
-    oracle path.
+    generic scheduler's); system evals run through the vectorized
+    'tpu-system' pass; core evals stay on the oracle path.
     """
 
     def __init__(self, *args, max_batch: int = 64, **kwargs):
         super().__init__(*args, **kwargs)
         self.max_batch = max_batch
+
+    def sched_name(self, ev: s.Evaluation) -> str:
+        if ev.type == s.JOB_TYPE_SYSTEM:
+            from ..ops import system_batch  # noqa: F401 — registers it
+
+            return "tpu-system"
+        return super().sched_name(ev)
 
     def run(self) -> None:
         from ..ops import batch_sched  # noqa: F401 — registers 'tpu-batch'

@@ -1,0 +1,142 @@
+"""Vectorized system scheduler (ops/system_batch.py) vs the oracle
+SystemScheduler: identical placements on the happy path, oracle fallback
+parity on filtered/exhausted clusters."""
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.ops.system_batch import new_tpu_system_scheduler
+from nomad_tpu.scheduler import Harness
+from nomad_tpu.scheduler.system import new_system_scheduler
+from nomad_tpu.structs import structs as s
+
+
+def _cluster(h, n, cpu=4000, mem=8192, attrs=None):
+    nodes = []
+    for i in range(n):
+        node = mock.node()
+        node.id = f"node-{i:04d}"
+        node.resources.networks = []
+        node.reserved.networks = []
+        node.resources.cpu = cpu
+        node.resources.memory_mb = mem
+        if attrs:
+            node.attributes.update(attrs(i))
+        node.compute_class()
+        h.state.upsert_node(h.next_index(), node)
+        nodes.append(node)
+    return nodes
+
+
+def _system_job(cpu=100, constrained=False):
+    job = mock.system_job()
+    for tg in job.task_groups:
+        for t in tg.tasks:
+            t.resources.networks = []
+            t.resources.cpu = cpu
+            t.resources.memory_mb = 64
+    if constrained:
+        job.task_groups[0].constraints = list(
+            job.task_groups[0].constraints) + [
+            s.Constraint("${attr.rack}", "r1", "=")]
+    return job
+
+
+def _eval(job):
+    return s.Evaluation(
+        id=s.generate_uuid(), priority=job.priority, type=job.type,
+        triggered_by=s.EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
+        status=s.EVAL_STATUS_PENDING)
+
+
+def _run(factory, n_nodes, job_fn, attrs=None):
+    h = Harness()
+    _cluster(h, n_nodes, attrs=attrs)
+    job = job_fn()
+    h.state.upsert_job(h.next_index(), job)
+    h.process(factory, job and _eval(job))
+    placements = sorted(
+        (a.node_id, a.task_group, a.name)
+        for a in h.state.allocs_by_job(None, job.id, True))
+    ev_status = h.evals[-1].status if h.evals else None
+    return h, job, placements, ev_status
+
+
+class TestSystemBatchDifferential:
+    def test_happy_path_identical(self):
+        _, _, oracle, st1 = _run(new_system_scheduler, 50, _system_job)
+        _, _, fast, st2 = _run(new_tpu_system_scheduler, 50, _system_job)
+        assert len(oracle) == len(fast) == 50
+        assert [p[0] for p in oracle] == [p[0] for p in fast]
+        assert st1 == st2 == s.EVAL_STATUS_COMPLETE
+
+    def test_constraint_filtered_falls_back_identically(self):
+        attrs = lambda i: {"rack": "r1" if i % 3 == 0 else "r2"}
+        _, _, oracle, _ = _run(
+            new_system_scheduler, 30,
+            lambda: _system_job(constrained=True), attrs=attrs)
+        _, _, fast, _ = _run(
+            new_tpu_system_scheduler, 30,
+            lambda: _system_job(constrained=True), attrs=attrs)
+        assert [p[0] for p in oracle] == [p[0] for p in fast]
+        assert len(fast) == 10  # every third node
+
+    def test_exhausted_falls_back_identically(self):
+        # Asks bigger than half the node: only 1 fits per node; second
+        # task group exhausts → oracle fallback with failure metrics.
+        def fat_job():
+            job = _system_job(cpu=3500)
+            return job
+
+        ha, _, oracle, _ = _run(new_system_scheduler, 5, fat_job)
+        hb, _, fast, _ = _run(new_tpu_system_scheduler, 5, fat_job)
+        assert oracle == fast
+
+    def test_prev_alloc_chained_on_node_update(self):
+        h = Harness()
+        _cluster(h, 8)
+        job = _system_job()
+        h.state.upsert_job(h.next_index(), job)
+        h.process(new_tpu_system_scheduler, _eval(job))
+        first = {a.node_id: a for a in h.state.allocs_by_job(None, job.id, True)}
+        assert len(first) == 8
+
+        # New node arrives: only it gets a placement, existing ones stay.
+        node = mock.node()
+        node.id = "node-new"
+        node.resources.networks = []
+        node.reserved.networks = []
+        h.state.upsert_node(h.next_index(), node)
+        ev = _eval(job)
+        ev.triggered_by = s.EVAL_TRIGGER_NODE_UPDATE
+        h.process(new_tpu_system_scheduler, ev)
+        after = h.state.allocs_by_job(None, job.id, True)
+        assert len(after) == 9
+        assert sum(1 for a in after if a.node_id == "node-new") == 1
+
+    def test_worker_routes_system_to_vectorized(self, tmp_path):
+        from nomad_tpu.server.server import Server, ServerConfig
+
+        cfg = ServerConfig(data_dir=str(tmp_path / "raft"),
+                           use_tpu_batch_worker=True)
+        srv = Server(cfg)
+        srv.start()
+        try:
+            import time
+
+            for i in range(6):
+                node = mock.node()
+                node.id = f"n-{i}"
+                node.resources.networks = []
+                node.reserved.networks = []
+                srv.node_register(node)
+            job = _system_job()
+            srv.job_register(job)
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if len(srv.state.allocs_by_job(None, job.id, True)) == 6:
+                    break
+                time.sleep(0.05)
+            assert len(srv.state.allocs_by_job(None, job.id, True)) == 6
+        finally:
+            srv.shutdown()
