@@ -82,12 +82,13 @@ class _CollectingScheduler(GenericScheduler):
 
     def __init__(self, logger_, state, planner, batch: bool):
         super().__init__(logger_, state, planner, batch)
-        # Placement asks in bulk (columnar) form: per task group, the alloc
-        # names and previous-alloc ids (None when fresh).  Built either by
-        # the register fast path below or by grouping the oracle's
-        # AllocTuples in _compute_placements.
-        self.pending_bulk: List[
-            Tuple[s.TaskGroup, List[str], Optional[List[Optional[str]]]]] = []
+        # Placement asks in bulk (columnar) form: per task group,
+        # (tg, names-or-count, prev-ids-or-None).  The register fast path
+        # stores just the COUNT — names are formulaic '<job>.<tg>[i]'
+        # (util.go:22) and get materialized at finalize only for the
+        # placements that actually happen; the oracle-diff path keeps
+        # explicit name/prev lists.
+        self.pending_bulk: List[Tuple] = []
         self.nodes_by_dc: Dict[str, int] = {}
         # Shared per-batch cache of dc-tuple → nodes-by-dc counts, injected
         # by TPUBatchScheduler (one full node scan per distinct dc set per
@@ -120,9 +121,8 @@ class _CollectingScheduler(GenericScheduler):
         for tg in job.task_groups:
             if tg.count <= 0:
                 continue
-            names = [f"{job.name}.{tg.name}[{i}]" for i in range(tg.count)]
             self.queued_allocs[tg.name] = tg.count
-            bulk.append((tg, names, None))
+            bulk.append((tg, tg.count, None))
         self.pending_bulk = bulk
         if bulk:
             self._set_nodes_by_dc()
@@ -201,7 +201,7 @@ class TPUBatchScheduler:
         specs: Dict[Tuple[str, str], encode.PlacementSpec] = {}
         spec_evs: Dict[Tuple[str, str], s.Evaluation] = {}
         for ev, sched in scheds:
-            for tg, names, prevs in sched.pending_bulk:
+            for tg, names_or_count, prevs in sched.pending_bulk:
                 key = (sched.job.id, tg.name)
                 spec = specs.get(key)
                 if spec is None:
@@ -210,7 +210,8 @@ class TPUBatchScheduler:
                         spec.dp_used_values = self._dp_used_values(sched, spec)
                     specs[key] = spec
                     spec_evs[key] = ev
-                spec.names.extend(names)
+                spec.count += (names_or_count if isinstance(names_or_count, int)
+                               else len(names_or_count))
 
         # Gate: specs the device path cannot express route their whole
         # eval through the oracle instead of being silently mis-placed
@@ -436,10 +437,13 @@ class TPUBatchScheduler:
             with_networks=with_networks, with_dp=with_dp,
             with_scores=with_scores, max_nnz=max_nnz)
         ncols = 5 if with_scores else 3
+        # dtype truth comes from the device array itself (uint16 when the
+        # kernel compacted small, int32 otherwise).
+        isz = coo_mat.dtype.itemsize
         # Small COO bucket: fetch summary + full bucket concurrently (one
         # blocking round).  Big bucket: summary first, then exactly the
         # [nnz, C] prefix — two rounds beat streaming the whole bucket.
-        if max_nnz * ncols * 4 <= (4 << 20):
+        if max_nnz * ncols * isz <= (4 << 20):
             sraw, coo_full = jax.device_get((summary_buf, coo_mat))
             summary = xfer.unpack_host(np.asarray(sraw),
                                        summary_layout(st.u_pad, ct.n_pad))
@@ -453,7 +457,7 @@ class TPUBatchScheduler:
             if nnz:
                 coo = np.asarray(jax.device_get(coo_mat[:nnz]))
             else:
-                coo = np.zeros((0, ncols), dtype=np.int32)
+                coo = np.zeros((0, ncols), dtype=np.dtype(coo_mat.dtype))
         rounds = int(summary["scalars"][1])
         unplaced_arr = summary["unplaced"]
         used_after = summary["used_after"]
@@ -752,9 +756,15 @@ class TPUBatchScheduler:
         # mutates them post-construction.  Per-alloc cost: one shallow copy +
         # a bulk-generated uuid.
         fast_copy = s._fast_copy
-        for tg, names, prevs in sched.pending_bulk:
+        for tg, names_or_count, prevs in sched.pending_bulk:
             key = (sched.job.id, tg.name)
             slots = expanded.get(key, [])
+            if isinstance(names_or_count, int):
+                n_asks = names_or_count
+                names = None   # formulaic; generated below only as needed
+            else:
+                names = names_or_count
+                n_asks = len(names)
             metric = per_spec_metrics.get(key, s.AllocMetric())
             metric.nodes_available = sched.nodes_by_dc
             combined = s.Resources(disk_mb=tg.ephemeral_disk.size_mb)
@@ -774,7 +784,12 @@ class TPUBatchScheduler:
             )
             spec = specs.get(key)
             net_asks = spec.net_asks if spec is not None else {}
-            k = min(len(slots), len(names))
+            k = min(len(slots), n_asks)
+            if names is None and k:
+                # Formulaic names generated only for actual placements:
+                # the full ask list never materializes at batch scale.
+                names = [f"{sched.job.name}.{tg.name}[{i}]"
+                         for i in range(k)]
             appended = 0
             if not net_asks:
                 # Columnar fast path: ONE AllocSlab per (job, tg) instead
@@ -838,7 +853,7 @@ class TPUBatchScheduler:
             # host-side network offer — is a placement failure and must
             # produce a blocked eval (generic_sched.go:218), not a silent
             # under-placement.
-            if appended < len(names):
+            if appended < n_asks:
                 if sched.failed_tg_allocs is None:
                     sched.failed_tg_allocs = {}
                 sched.failed_tg_allocs[tg.name] = metric

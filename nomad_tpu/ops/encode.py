@@ -358,7 +358,7 @@ class PlacementSpec:
 
     job: s.Job
     tg: s.TaskGroup
-    names: List[str]                    # alloc names to materialize, len=count
+    count: int = 0                      # expansion count (asks)
     ask: np.ndarray = None              # [4] int64
     priority: int = 50
     anti_affinity_penalty: float = 20.0
@@ -381,10 +381,6 @@ class PlacementSpec:
     # routes through the oracle instead of being silently mis-placed.
     needs_oracle: str = ""
 
-    @property
-    def count(self) -> int:
-        return len(self.names)
-
 
 def build_spec(job: s.Job, tg: s.TaskGroup, batch_penalty: bool) -> PlacementSpec:
     tup = task_group_constraints(tg)
@@ -392,7 +388,7 @@ def build_spec(job: s.Job, tg: s.TaskGroup, batch_penalty: bool) -> PlacementSpe
     spec = PlacementSpec(
         job=job,
         tg=tg,
-        names=[],
+        count=0,
         ask=_res_vec(tup.size),
         priority=job.priority,
         anti_affinity_penalty=10.0 if batch_penalty else 20.0,

@@ -480,12 +480,19 @@ def _device_schedule(
     return result, feas
 
 
-@functools.partial(jax.jit, static_argnames=("with_scores", "max_nnz"))
+@functools.partial(jax.jit, static_argnames=("with_scores", "max_nnz",
+                                             "compact_u16"))
 def _device_compact(result: PlacementResult, feas: jnp.ndarray,
-                    *, with_scores: bool, max_nnz: int):
+                    *, with_scores: bool, max_nnz: int,
+                    compact_u16: bool = False):
     """Dispatch 2: COO compaction + packed summary (device-resident
     inputs, so the extra dispatch costs no link traffic — and keeping it
-    out of the scheduling program keeps XLA compile time sane)."""
+    out of the scheduling program keeps XLA compile time sane).
+
+    compact_u16 halves the COO bytes on the link (row/col/count as
+    uint16) — valid only without scores and when U/N fit in 16 bits;
+    safe because the host only ever reads the valid [:nnz] prefix (the
+    -1 fill would wrap)."""
     from . import xfer
 
     u_pad, n_pad = feas.shape
@@ -495,7 +502,8 @@ def _device_compact(result: PlacementResult, feas: jnp.ndarray,
     r = jnp.clip(rows, 0, u_pad - 1)
     c = jnp.clip(cols, 0, n_pad - 1)
     counts = jnp.where(valid, result.placements[r, c], 0)
-    coo_cols = [rows.astype(jnp.int32), cols.astype(jnp.int32), counts]
+    dt = jnp.uint16 if compact_u16 else jnp.int32
+    coo_cols = [rows.astype(dt), cols.astype(dt), counts.astype(dt)]
     if with_scores:
         sc = jnp.where(valid, result.commit_scores[r, c], 0.0)
         co = jnp.where(valid, result.commit_collisions[r, c], 0)
@@ -535,16 +543,21 @@ def device_pass(
     the XLA optimization time of the big scheduling program from
     compounding with the compaction graph.
 
-    Returns (summary_buf uint8, coo int32[max_nnz, C], feas bool[U, N]);
-    C = 5 with scores (row, col, count, score-bits, collisions) else 3.
-    feas stays on device for the rare lazy failure-forensics row fetch.
+    Returns (summary_buf uint8, coo [max_nnz, C], feas bool[U, N]);
+    C = 5 with scores (int32: row, col, count, score-bits, collisions),
+    else 3 (row, col, count — uint16 when U/N/rounds all fit 16 bits,
+    int32 otherwise; read the dtype off the array).  feas stays on
+    device for the rare lazy failure-forensics row fetch.
     """
     result, feas = _device_schedule(
         buf, meta=meta, u_pad=u_pad, n_pad=n_pad,
         with_networks=with_networks, with_dp=with_dp,
         with_scores=with_scores, max_rounds=max_rounds)
+    compact_u16 = (not with_scores and u_pad < 65536 and n_pad < 65536
+                   and max_rounds < 65536)
     summary, coo = _device_compact(
-        result, feas, with_scores=with_scores, max_nnz=max_nnz)
+        result, feas, with_scores=with_scores, max_nnz=max_nnz,
+        compact_u16=compact_u16)
     return summary, coo, feas
 
 
