@@ -119,6 +119,7 @@ class StateStore:
         "allocs",
         "periodic_launch",
         "vault_accessors",
+        "deployment",
     )
 
     def __init__(self) -> None:
@@ -137,6 +138,7 @@ class StateStore:
         self.allocs_table: Dict[str, s.Allocation] = {}
         self.periodic_launch_table: Dict[str, PeriodicLaunch] = {}
         self.vault_accessors_table: Dict[str, VaultAccessor] = {}
+        self.deployments_table: Dict[str, s.Deployment] = {}
         self._indexes: Dict[str, int] = {}
         # Secondary indexes (reference: schema.go secondary memdb indexes)
         self._allocs_by_node: Dict[str, Set[str]] = defaultdict(set)
@@ -164,6 +166,7 @@ class StateStore:
             snap.allocs_table = dict(self.allocs_table)
             snap.periodic_launch_table = dict(self.periodic_launch_table)
             snap.vault_accessors_table = dict(self.vault_accessors_table)
+            snap.deployments_table = dict(self.deployments_table)
             snap._indexes = dict(self._indexes)
             # Secondary-index SETS are immutable by contract (mutators go
             # through _idx_add/_idx_discard which REPLACE the set), so a
@@ -806,6 +809,87 @@ class StateStore:
             self._bump("vault_accessors", index)
         self._notify()
 
+    # -- deployments -------------------------------------------------------
+
+    def upsert_deployment(self, index: int, deployment: s.Deployment,
+                          cancel_prior: bool = False) -> None:
+        """(state_store.go:221 UpsertDeployment).  cancel_prior marks any
+        other ACTIVE deployment of the same job cancelled
+        (state_store.go:266 cancelPriorDeployments)."""
+        with self._lock:
+            d = deployment.copy()
+            existing = self.deployments_table.get(d.id)
+            if existing is None:
+                d.create_index = index
+            else:
+                d.create_index = existing.create_index
+            d.modify_index = index
+            if cancel_prior:
+                for other in list(self.deployments_table.values()):
+                    if (other.id != d.id and other.job_id == d.job_id
+                            and other.active()):
+                        upd = other.copy()
+                        upd.status = s.DEPLOYMENT_STATUS_CANCELLED
+                        upd.status_description = (
+                            "made obsolete by a newer deployment")
+                        upd.modify_index = index
+                        self.deployments_table[other.id] = upd
+            self.deployments_table[d.id] = d
+            self._bump("deployment", index)
+        self._notify()
+
+    def update_deployment_status(self, index: int,
+                                 update: s.DeploymentStatusUpdate) -> None:
+        """Apply a status transition (structs.go:379 DeploymentUpdates)."""
+        with self._lock:
+            existing = self.deployments_table.get(update.deployment_id)
+            if existing is None:
+                return
+            d = existing.copy()
+            d.status = update.status
+            d.status_description = update.status_description
+            d.modify_index = index
+            self.deployments_table[d.id] = d
+            self._bump("deployment", index)
+        self._notify()
+
+    def deployment_by_id(self, ws: Optional[WatchSet],
+                         deployment_id: str) -> Optional[s.Deployment]:
+        """(state_store.go:311)."""
+        if ws is not None:
+            ws.add(self, "deployment")
+        with self._lock:
+            return self.deployments_table.get(deployment_id)
+
+    def deployments(self, ws: Optional[WatchSet] = None) -> List[s.Deployment]:
+        """(state_store.go:298)."""
+        if ws is not None:
+            ws.add(self, "deployment")
+        with self._lock:
+            return list(self.deployments_table.values())
+
+    def deployments_by_job(self, ws: Optional[WatchSet],
+                           job_id: str) -> List[s.Deployment]:
+        """(state_store.go:330 DeploymentsByJobID)."""
+        if ws is not None:
+            ws.add(self, "deployment")
+        with self._lock:
+            return [d for d in self.deployments_table.values()
+                    if d.job_id == job_id]
+
+    def latest_deployment_by_job(self, ws: Optional[WatchSet],
+                                 job_id: str) -> Optional[s.Deployment]:
+        """Newest deployment of a job by create index
+        (state_store.go LatestDeploymentByJobID)."""
+        out = self.deployments_by_job(ws, job_id)
+        return max(out, key=lambda d: d.create_index) if out else None
+
+    def delete_deployment(self, index: int, deployment_id: str) -> None:
+        with self._lock:
+            if self.deployments_table.pop(deployment_id, None) is not None:
+                self._bump("deployment", index)
+        self._notify()
+
     def vault_accessors(self, ws: Optional[WatchSet]) -> List[VaultAccessor]:
         if ws is not None:
             ws.add(self, "vault_accessors")
@@ -1112,6 +1196,7 @@ class StateStore:
                     for aid, v in self.allocs_table.items()},
                 "periodic_launch": self.periodic_launch_table,
                 "vault_accessors": self.vault_accessors_table,
+                "deployments": self.deployments_table,
                 "indexes": self._indexes,
             }
             return pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
@@ -1130,6 +1215,7 @@ class StateStore:
         store.allocs_table = payload["allocs"]
         store.periodic_launch_table = payload["periodic_launch"]
         store.vault_accessors_table = payload["vault_accessors"]
+        store.deployments_table = payload.get("deployments", {})
         store._indexes = payload["indexes"]
         for ev in store.evals_table.values():
             store._evals_by_job[ev.job_id].add(ev.id)

@@ -1095,6 +1095,69 @@ class Evaluation:
 # ---------------------------------------------------------------------------
 
 
+# Deployment statuses (structs.go:3688-3694).
+DEPLOYMENT_STATUS_RUNNING = "running"
+DEPLOYMENT_STATUS_FAILED = "failed"
+DEPLOYMENT_STATUS_SUCCESSFUL = "successful"
+DEPLOYMENT_STATUS_CANCELLED = "cancelled"
+DEPLOYMENT_STATUS_PAUSED = "paused"
+
+
+@dataclass
+class DeploymentState:
+    """Per-task-group deployment progress (structs.go:3757-3790)."""
+
+    promoted: bool = False
+    requires_promotion: bool = False
+    desired_canaries: int = 0
+    desired_total: int = 0
+    placed_allocs: int = 0
+    healthy_allocs: int = 0
+    unhealthy_allocs: int = 0
+
+    def copy(self) -> "DeploymentState":
+        return _fast_copy(self)
+
+
+@dataclass
+class Deployment:
+    """Tracks a job version's rollout (structs.go:3698-3755).
+
+    At this reference version the scheduler never CREATES deployments
+    (`grep CreatedDeployment scheduler/` is empty — SURVEY.md §2.1);
+    the struct + state-store surface exist for the API contract."""
+
+    id: str = ""
+    job_id: str = ""
+    job_version: int = 0
+    job_modify_index: int = 0
+    job_create_index: int = 0
+    task_groups: Dict[str, DeploymentState] = field(default_factory=dict)
+    status: str = DEPLOYMENT_STATUS_RUNNING
+    status_description: str = ""
+    create_index: int = 0
+    modify_index: int = 0
+
+    def active(self) -> bool:
+        """(structs.go:3747-3752)."""
+        return self.status in (DEPLOYMENT_STATUS_RUNNING,
+                               DEPLOYMENT_STATUS_PAUSED)
+
+    def copy(self) -> "Deployment":
+        c = _fast_copy(self)
+        c.task_groups = {k: v.copy() for k, v in self.task_groups.items()}
+        return c
+
+
+@dataclass
+class DeploymentStatusUpdate:
+    """A status transition carried in a plan (structs.go:379,3795)."""
+
+    deployment_id: str = ""
+    status: str = ""
+    status_description: str = ""
+
+
 @dataclass
 class AllocSlab:
     """Columnar batch of placements sharing one prototype allocation.

@@ -1,0 +1,84 @@
+"""Deployment structs + state-store surface (reference:
+nomad/structs/structs.go:3698-3795, nomad/state/state_store.go:219-345 —
+at this reference version the scheduler never creates deployments; the
+struct + store contract is the parity target)."""
+
+from nomad_tpu.state import StateStore
+from nomad_tpu.structs import structs as s
+
+
+def _dep(job_id="job-1", status=s.DEPLOYMENT_STATUS_RUNNING):
+    return s.Deployment(
+        id=s.generate_uuid(),
+        job_id=job_id,
+        job_version=3,
+        task_groups={"web": s.DeploymentState(desired_total=5,
+                                              placed_allocs=2)},
+        status=status,
+    )
+
+
+class TestDeployments:
+    def test_upsert_get_list(self):
+        store = StateStore()
+        d = _dep()
+        store.upsert_deployment(10, d)
+        got = store.deployment_by_id(None, d.id)
+        assert got.job_id == "job-1"
+        assert got.create_index == 10 and got.modify_index == 10
+        assert got.task_groups["web"].desired_total == 5
+        assert got.active()
+        assert [x.id for x in store.deployments(None)] == [d.id]
+        assert store.table_index("deployment") == 10
+
+    def test_cancel_prior(self):
+        store = StateStore()
+        old = _dep()
+        store.upsert_deployment(10, old)
+        newer = _dep()
+        store.upsert_deployment(11, newer, cancel_prior=True)
+        got_old = store.deployment_by_id(None, old.id)
+        assert got_old.status == s.DEPLOYMENT_STATUS_CANCELLED
+        assert not got_old.active()
+        assert store.deployment_by_id(None, newer.id).active()
+        # Latest by create index is the newer one.
+        assert store.latest_deployment_by_job(None, "job-1").id == newer.id
+
+    def test_status_update_and_delete(self):
+        store = StateStore()
+        d = _dep()
+        store.upsert_deployment(10, d)
+        store.update_deployment_status(11, s.DeploymentStatusUpdate(
+            deployment_id=d.id, status=s.DEPLOYMENT_STATUS_SUCCESSFUL,
+            status_description="done"))
+        got = store.deployment_by_id(None, d.id)
+        assert got.status == s.DEPLOYMENT_STATUS_SUCCESSFUL
+        assert got.status_description == "done"
+        store.delete_deployment(12, d.id)
+        assert store.deployment_by_id(None, d.id) is None
+
+    def test_snapshot_isolated_and_persist_roundtrip(self):
+        store = StateStore()
+        d = _dep()
+        store.upsert_deployment(10, d)
+        snap = store.snapshot()
+        store.update_deployment_status(11, s.DeploymentStatusUpdate(
+            deployment_id=d.id, status=s.DEPLOYMENT_STATUS_FAILED))
+        assert snap.deployment_by_id(None, d.id).status == \
+            s.DEPLOYMENT_STATUS_RUNNING
+
+        blob = store.persist()
+        restored = StateStore.restore(blob)
+        assert restored.deployment_by_id(None, d.id).status == \
+            s.DEPLOYMENT_STATUS_FAILED
+
+    def test_blocking_query_watch_fires(self):
+        store = StateStore()
+        from nomad_tpu.state.state_store import WatchSet
+
+        ws = WatchSet()
+        ws.add(store, "deployment")
+        store.upsert_deployment(10, _dep())
+        # watch() returns False when a watched table advanced (True only
+        # on timeout) — the upsert must wake the watcher.
+        assert ws.watch(timeout=2.0) is False
