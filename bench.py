@@ -4,16 +4,34 @@ BASELINE.json configs measured:
   (b) 10k nodes × 100k task-groups, CPU+mem bin-pack  — the HEADLINE
   (c)  5k nodes ×  50k task-groups, hard constraints + distinct_hosts
   (d) 10k nodes, one system job (oracle SystemScheduler — host path)
-  (e) 50k nodes ×   1M task-groups — the north-star scale
-The CPU oracle (our faithful GenericScheduler implementation) is timed on a
-10% sample of the full config (b) — the reference publishes no absolute
-numbers (BASELINE.md), so phase-0 is to measure the oracle ourselves.  The
-headline value is *placed* task-groups per second (not asks/sec):
-placements are the work actually done.
+  (e) 50k nodes ×   1M task-groups
+  (north star) 10k nodes × 1M task-groups — the literal BASELINE.json
+  target shape: "schedule 1M pending task-groups across 10k simulated
+  nodes in <2s on a v5e-1 with ≤0.5% bin-pack score regression".
 
-Warm-up uses the full eval set against a state snapshot + null planner so the
-timed run hits a warm XLA cache on identical bucketed shapes; the one-time
-compile cost is reported separately in detail.
+The CPU oracle (our faithful GenericScheduler implementation) is timed on
+a 10% sample of the full config (b) — the reference publishes no absolute
+numbers (BASELINE.md), so phase-0 is to measure the oracle ourselves.
+``vs_baseline`` is the ratio against that oracle (``oracle_impl`` in the
+detail says which implementation produced it).  The score-regression
+budget is measured on the same 10% sample: both engines schedule the
+identical cluster+jobs and ``score_delta_pct`` compares their mean final
+bin-pack score over used nodes (funcs.go:123 ScoreFit semantics).
+
+The headline value is *placed* task-groups per second (not asks/sec):
+placements are the work actually done.  Each config reports the MEDIAN
+over trials (the tunneled host↔device link adds 50-300ms of latency
+jitter per transfer; best-trial is kept as a secondary field).
+
+``reschedule`` exercises the elastic re-admission loop (SURVEY §3.3):
+after config (b) fills the cluster, 20% of allocs terminate and the
+blocked evals re-place through the batch scheduler against the now
+alloc-bearing state — the steady-state path with live usage encoding,
+diff reconciliation and deferred-index drains all paid inside the timer.
+
+Warm-up uses the full eval set against a state snapshot + null planner so
+the timed run hits a warm XLA cache on identical bucketed shapes; the
+one-time compile cost is reported separately in detail.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
@@ -22,6 +40,7 @@ plus human-readable detail on stderr.
 from __future__ import annotations
 
 import json
+import statistics
 import sys
 import time
 from pathlib import Path
@@ -34,6 +53,7 @@ COUNT_PER_JOB = 1_000          # 100k task-groups total
 ORACLE_SAMPLE_JOBS = 10        # oracle baseline: 10% of the full config
 E_N_NODES = 50_000             # config (e) scale
 E_N_JOBS = 1_000               # 1M task-groups total
+NS_N_JOBS = 1_000              # north star: 1M tgs on the 10k cluster
 
 
 def log(*args):
@@ -83,9 +103,38 @@ def reg_eval(job):
         status=s.EVAL_STATUS_PENDING)
 
 
-def bench_oracle() -> float:
-    """Placed task-groups/sec of the CPU oracle on a 10% sample of the full
-    config (b) cluster — same 10k nodes, same 1000-count jobs."""
+def mean_binpack_score(h) -> float:
+    """Mean final-state ScoreFit (funcs.go:123: 20 − Σ 10^freeFrac,
+    clipped to [0, 18]) over nodes carrying at least one alloc — a
+    deterministic, order-free basis for comparing two engines' bin-pack
+    quality on the same cluster."""
+    used = {}
+    for nid, row in h.state.alloc_rows(None):
+        if row.terminal_status():
+            continue
+        cpu, mem = used.get(nid, (0, 0))
+        res = row.resources
+        used[nid] = (cpu + res.cpu, mem + res.memory_mb)
+    if not used:
+        return 0.0
+    total = 0.0
+    for nid, (cpu, mem) in used.items():
+        node = h.state.node_by_id(None, nid)
+        res = node.resources
+        reserved = node.reserved
+        cap_cpu = res.cpu - (reserved.cpu if reserved else 0)
+        cap_mem = res.memory_mb - (reserved.memory_mb if reserved else 0)
+        free_cpu = 1.0 - (cpu / cap_cpu if cap_cpu else 1.0)
+        free_mem = 1.0 - (mem / cap_mem if cap_mem else 1.0)
+        score = 20.0 - (10.0 ** free_cpu + 10.0 ** free_mem)
+        total += min(18.0, max(0.0, score))
+    return total / len(used)
+
+
+def bench_oracle():
+    """Placed task-groups/sec of the CPU oracle on a 10% sample of the
+    full config (b) cluster — same 10k nodes, same 1000-count jobs.
+    Returns (rate, mean_score, placed)."""
     from nomad_tpu.scheduler import Harness, new_service_scheduler
 
     h = Harness()
@@ -102,14 +151,45 @@ def bench_oracle() -> float:
     placed = sum(
         len(h.state.allocs_by_job(None, j.id, True)) for j in jobs)
     rate = placed / elapsed
-    log(f"oracle: {placed} placements in {elapsed:.2f}s → {rate:.0f} placed-tg/s")
-    return rate
+    score = mean_binpack_score(h)
+    log(f"oracle: {placed} placements in {elapsed:.2f}s → "
+        f"{rate:.0f} placed-tg/s (mean ScoreFit {score:.4f})")
+    return rate, score, placed
+
+
+def bench_score_delta(oracle_score: float, oracle_placed: int):
+    """The ≤0.5% score-regression budget, measured at the 10% sample
+    scale where the oracle can run: the tpu-batch engine schedules the
+    IDENTICAL cluster+jobs and the mean final ScoreFit is compared."""
+    from nomad_tpu.scheduler import Harness, new_scheduler
+    from nomad_tpu.ops import batch_sched  # noqa: F401
+
+    h = Harness()
+    build_cluster(h, N_NODES)
+    jobs = [make_job(COUNT_PER_JOB) for _ in range(ORACLE_SAMPLE_JOBS)]
+    for j in jobs:
+        h.state.upsert_job(h.next_index(), j)
+    evals = [reg_eval(j) for j in jobs]
+    sched = new_scheduler("tpu-batch", h.logger, h.snapshot(), h)
+    sched.schedule_batch(evals)
+    placed = sum(
+        len(h.state.allocs_by_job(None, j.id, True)) for j in jobs)
+    score = mean_binpack_score(h)
+    delta_pct = (100.0 * (oracle_score - score) / oracle_score
+                 if oracle_score else 0.0)
+    log(f"score-delta: tpu mean ScoreFit {score:.4f} vs oracle "
+        f"{oracle_score:.4f} → regression {delta_pct:+.3f}% "
+        f"(placed {placed} vs oracle {oracle_placed})")
+    return {"tpu_mean_scorefit": round(score, 4),
+            "oracle_mean_scorefit": round(oracle_score, 4),
+            "score_delta_pct": round(delta_pct, 3),
+            "tpu_placed": placed, "oracle_placed": oracle_placed}
 
 
 def bench_system(n_nodes: int):
     """Config (d): one system job across the fleet — the vectorized
-    'tpu-system' pass (ops/system_batch.py), with the per-node oracle
-    loop timed on a 10% sample for comparison."""
+    'tpu-system' pass (ops/system_batch.py) vs the per-node oracle loop
+    timed on the SAME full fleet (same-shape comparison)."""
     from nomad_tpu import mock
     from nomad_tpu.ops.system_batch import new_tpu_system_scheduler
     from nomad_tpu.scheduler import Harness, new_system_scheduler
@@ -121,9 +201,9 @@ def bench_system(n_nodes: int):
                 t.resources.networks = []
         return job
 
-    # Oracle sample (10%).
+    # Oracle on the FULL fleet (it is a one-shot host loop).
     h = Harness()
-    build_cluster(h, n_nodes // 10)
+    build_cluster(h, n_nodes)
     job = mk_job()
     h.state.upsert_job(h.next_index(), job)
     t0 = time.monotonic()
@@ -142,18 +222,66 @@ def bench_system(n_nodes: int):
     placed = len(h.state.allocs_by_job(None, job.id, True))
     log(f"config-d: system job on {n_nodes} nodes: {placed} placed in "
         f"{elapsed:.2f}s → {placed / elapsed:.0f} placed-tg/s "
-        f"(oracle loop: {oracle_rate:.0f}/s)")
+        f"(oracle, same {n_nodes} nodes: {oracle_rate:.0f}/s)")
     return {"placed": placed, "elapsed_s": round(elapsed, 3),
             "placed_per_s": round(placed / elapsed, 1),
-            "oracle_placed_per_s": round(oracle_rate, 1)}
+            "oracle_placed_per_s": round(oracle_rate, 1),
+            "oracle_nodes": n_nodes}
+
+
+def bench_reschedule(h, jobs):
+    """Elastic re-admission (SURVEY §3.3): terminate 20% of the allocs
+    config (b) placed, then push the blocked evals back through the
+    batch scheduler.  Everything the steady-state server pays — live
+    usage encode, deferred-index drains, per-job diff reconciliation —
+    runs inside the timer."""
+    from nomad_tpu.scheduler import new_scheduler
+    from nomad_tpu.structs import structs as s
+
+    blocked = [ev for ev in h.create_evals
+               if ev.status == s.EVAL_STATUS_BLOCKED]
+    if not blocked:
+        log("reschedule: no blocked evals; skipping")
+        return {"skipped": "no blocked evals"}
+    # Terminate 20% of placed allocs (deterministic stride) — frees
+    # capacity exactly like batch completions would.
+    all_allocs = [a for a in h.state.allocs(None)
+                  if not a.terminal_status()]
+    victims = all_allocs[::5]
+    updates = []
+    for a in victims:
+        upd = s._fast_copy(a)
+        upd.client_status = s.ALLOC_CLIENT_STATUS_COMPLETE
+        updates.append(upd)
+    h.state.update_allocs_from_client(h.next_index(), updates)
+    before = len([a for a in h.state.allocs(None)
+                  if not a.terminal_status()])
+
+    sched = new_scheduler("tpu-batch", h.logger, h.snapshot(), h)
+    t0 = time.monotonic()
+    sched.schedule_batch(blocked)
+    elapsed = time.monotonic() - t0
+    after = len([a for a in h.state.allocs(None)
+                 if not a.terminal_status()])
+    replaced = after - before
+    rate = replaced / elapsed if elapsed > 0 else 0.0
+    log(f"reschedule: {len(victims)} terminated, {replaced} re-placed "
+        f"from {len(blocked)} blocked evals in {elapsed:.2f}s → "
+        f"{rate:.0f} placed-tg/s")
+    return {"terminated": len(victims), "replaced": replaced,
+            "blocked_evals": len(blocked),
+            "elapsed_s": round(elapsed, 3),
+            "replaced_per_s": round(rate, 1)}
 
 
 def run_config(n_nodes: int, n_jobs: int, count_per_job: int, label: str,
-               constrained: bool = False, trials: int = 3):
-    """Warm-compiled tpu-batch runs; best of ``trials`` (fresh state each)
-    — the tunneled host↔device link adds 50-300ms of latency jitter per
-    transfer, so a single sample can swing the reported rate ±40%; the
-    best trial reflects steady-state capability.  Returns (rate, detail)."""
+               constrained: bool = False, trials: int = 3,
+               keep_state: bool = False):
+    """Warm-compiled tpu-batch runs; MEDIAN of ``trials`` (fresh state
+    each) headlines — the tunneled host↔device link adds 50-300ms of
+    latency jitter per transfer, so a single sample can swing the rate
+    ±40%.  Best-trial is kept as a secondary field.  Returns
+    (rate, detail[, harness+jobs of the last trial])."""
     import jax
 
     from nomad_tpu.scheduler import Harness, new_scheduler
@@ -178,8 +306,7 @@ def run_config(n_nodes: int, n_jobs: int, count_per_job: int, label: str,
     compile_s = time.monotonic() - t0
     log(f"{label}: warm-up (incl. XLA compile) pass: {compile_s:.2f}s")
 
-    best = None
-    trial_s = []
+    runs = []
     for trial in range(max(1, trials)):
         if trial > 0:
             h, jobs, evals = build()
@@ -189,19 +316,23 @@ def run_config(n_nodes: int, n_jobs: int, count_per_job: int, label: str,
         elapsed = time.monotonic() - t0
         placed = sum(len(h.state.allocs_by_job(None, j.id, True))
                      for j in jobs)
-        trial_s.append(round(elapsed, 3))
-        if best is None or elapsed < best[0]:
-            best = (elapsed, placed, stats)
-    elapsed, placed, stats = best
+        runs.append((elapsed, placed, stats))
+    trial_s = [round(e, 3) for e, _, _ in runs]
+    median_s = statistics.median(trial_s)
+    # The median trial's stats/placed (or closest to median).
+    elapsed, placed, stats = min(runs, key=lambda r: abs(r[0] - median_s))
+    best_s = min(trial_s)
 
-    rate = placed / elapsed
+    rate = placed / median_s
     log(f"{label}: {stats!r}")
-    log(f"{label}: {placed} placed of {stats.num_asks} asks in {elapsed:.2f}s "
-        f"→ {rate:.0f} placed-tg/s (trials: {trial_s})")
+    log(f"{label}: {placed} placed of {stats.num_asks} asks, median "
+        f"{median_s:.2f}s → {rate:.0f} placed-tg/s "
+        f"(trials: {trial_s}, best {best_s:.2f}s)")
     detail = {
         "placed": placed,
         "asks": stats.num_asks,
-        "elapsed_s": round(elapsed, 3),
+        "elapsed_s": median_s,
+        "best_s": best_s,
         "trial_elapsed_s": trial_s,
         "device_s": round(stats.device_seconds, 3),
         "encode_s": round(stats.encode_seconds, 3),
@@ -209,6 +340,8 @@ def run_config(n_nodes: int, n_jobs: int, count_per_job: int, label: str,
         "rounds": stats.rounds,
         "platform": str(jax.devices()[0].platform),
     }
+    if keep_state:
+        return rate, detail, (h, jobs)
     return rate, detail
 
 
@@ -233,9 +366,22 @@ class NullPlanner:
 
 
 def main():
-    oracle_rate = bench_oracle()
-    rate_b, detail_b = run_config(N_NODES, N_JOBS, COUNT_PER_JOB, "config-b")
+    oracle_rate, oracle_score, oracle_placed = bench_oracle()
     extras = {}
+    try:
+        extras["score_regression"] = bench_score_delta(
+            oracle_score, oracle_placed)
+    except Exception as exc:
+        log(f"score-delta failed: {exc!r}")
+        extras["score_regression"] = {"error": repr(exc)}
+
+    rate_b, detail_b, (h_b, jobs_b) = run_config(
+        N_NODES, N_JOBS, COUNT_PER_JOB, "config-b", keep_state=True)
+    try:
+        extras["reschedule"] = bench_reschedule(h_b, jobs_b)
+    except Exception as exc:
+        log(f"reschedule failed: {exc!r}")
+        extras["reschedule"] = {"error": repr(exc)}
     try:
         rate_c, detail_c = run_config(5_000, 50, COUNT_PER_JOB, "config-c",
                                       constrained=True)
@@ -252,9 +398,23 @@ def main():
     try:
         rate_e, detail_e = run_config(E_N_NODES, E_N_JOBS, COUNT_PER_JOB,
                                       "config-e")
+        extras["config_e_50k_nodes_1m_tgs"] = detail_e
+        extras["config_e_placed_per_s"] = round(rate_e, 1)
     except Exception as exc:  # config (e) is stretch scale — report, don't die
         log(f"config-e failed: {exc!r}")
-        rate_e, detail_e = 0.0, {"error": repr(exc)}
+        extras["config_e_50k_nodes_1m_tgs"] = {"error": repr(exc)}
+    try:
+        # The literal BASELINE.json north star: 1M pending task-groups
+        # across 10k nodes, target < 2s end to end.
+        rate_ns, detail_ns = run_config(N_NODES, NS_N_JOBS, COUNT_PER_JOB,
+                                        "config-northstar")
+        detail_ns["target_s"] = 2.0
+        detail_ns["target_met"] = detail_ns["elapsed_s"] < 2.0
+        extras["config_northstar_10k_x_1m"] = detail_ns
+    except Exception as exc:
+        log(f"config-northstar failed: {exc!r}")
+        extras["config_northstar_10k_x_1m"] = {"error": repr(exc)}
+
     vs = rate_b / oracle_rate if oracle_rate > 0 else 0.0
     out = {
         "metric": "placed_taskgroups_per_sec (10k nodes x 100k tgs, cpu+mem binpack)",
@@ -263,9 +423,8 @@ def main():
         "vs_baseline": round(vs, 2),
         "detail": {
             "oracle_placed_per_s": round(oracle_rate, 1),
+            "oracle_impl": "python",
             "config_b": detail_b,
-            "config_e_50k_nodes_1m_tgs": detail_e,
-            "config_e_placed_per_s": round(rate_e, 1),
             **extras,
         },
     }

@@ -55,6 +55,10 @@ def to_wire(v: Any) -> Any:
         return [to_wire(x) for x in v]
     if isinstance(v, bytes):
         return base64.b64encode(v).decode("ascii")
+    if getattr(v, "__lazy_strs__", False):
+        # Lazily-generated slab columns (structs._LazyStrs) materialize
+        # to plain string lists on the wire.
+        return list(v)
     return v
 
 

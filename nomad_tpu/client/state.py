@@ -3,7 +3,9 @@ disk so a restarted agent re-attaches to its work
 (reference: client/client.go:357 bolt state.db,
 alloc_runner.go:322 saveAllocRunnerState).
 
-The reference uses boltdb; here each alloc's state is one pickle file
+The reference uses boltdb; here each alloc's state is one msgpack file
+(whitelisted struct trees via server/log_codec — never pickle, so a
+corrupt or attacker-written state file can only inject data, not code)
 under ``<state_dir>/allocs/<alloc_id>`` written atomically (tmp+rename),
 giving the same crash-safety contract (a partially written state file is
 never observed).
@@ -11,9 +13,10 @@ never observed).
 from __future__ import annotations
 
 import os
-import pickle
 import threading
 from typing import Dict, List, Optional
+
+from ..server.log_codec import decode_payload, encode_payload
 
 
 class StateDB:
@@ -30,7 +33,7 @@ class StateDB:
         tmp = path + ".tmp"
         with self._lock:
             with open(tmp, "wb") as f:
-                pickle.dump(state, f)
+                f.write(encode_payload(state))
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, path)
@@ -38,8 +41,10 @@ class StateDB:
     def get_alloc_runner(self, alloc_id: str) -> Optional[Dict]:
         try:
             with open(self._path(alloc_id), "rb") as f:
-                return pickle.load(f)
-        except (OSError, pickle.UnpicklingError, EOFError):
+                return decode_payload(f.read())
+        except Exception:
+            # Unreadable/corrupt state file == no state (the agent
+            # restarts the alloc from the server's view).
             return None
 
     def list_alloc_runners(self) -> List[str]:

@@ -43,6 +43,13 @@ logger = logging.getLogger("nomad_tpu.ops.batch_sched")
 # with_networks) → finalized ClusterTensors (see _place_on_device).
 _CLUSTER_CACHE: Dict[Tuple, "encode.ClusterTensors"] = {}
 
+# Device-resident copies of the packed static cluster buffer, keyed by
+# CONTENT digest (not store identity): a rebuilt-but-identical cluster —
+# e.g. bench trials on fresh state stores — skips the multi-MB upload
+# entirely.  The tunneled link runs at single-digit MB/s, so re-shipping
+# the static tensors per batch dominated device time at 50k nodes.
+_DEVICE_STATIC_CACHE: Dict[Tuple, object] = {}
+
 _cache_configured = False
 
 
@@ -392,38 +399,81 @@ class TPUBatchScheduler:
         for i, ((j, n), v) in enumerate(jc_entries.items()):
             jc_rows[i], jc_cols[i], jc_vals[i] = j, n, v
 
-        # ONE packed upload for every host array, ONE device dispatch, ONE
-        # packed summary fetch + ONE COO-prefix fetch: the tunneled
-        # host↔device link pays ~50-110ms per transfer regardless of size
-        # (measured — bench.py detail), so transfer count is the limit.
-        host = {
+        # Upload split (ops/kernels.py device_pass): the multi-MB static
+        # cluster tensors ship once and live on device keyed by content
+        # digest; the per-batch dynamic buffer carries only the U-sized
+        # spec tensors plus sparse alloc-usage deltas.  The tunneled
+        # host↔device link pays ~50-110ms per transfer and single-digit
+        # MB/s, so transfer bytes are the limit (measured — bench.py).
+        static = {
             "attr": ct.attr_values, "elig": ct.eligible, "dc": ct.dc_code,
+            "cap": ct.capacity.astype(np.int32),
+            "denom": ct.score_denom,
+            "used_base": base.used.astype(np.int32),
+        }
+        if with_networks:
+            static.update(bw_cap=ct.bw_cap, bw_used_base=base.bw_used,
+                          dyn_free_base=base.dyn_free,
+                          port_words_base=base.port_words)
+
+        # Sparse usage deltas over the static reserved-only baseline: one
+        # row per node carrying live allocs this batch.
+        touched = sorted(i for i in (node_index.get(nid)
+                                     for nid in allocs_by_node)
+                         if i is not None)
+        k_u = encode.pow2_bucket(max(1, len(touched)), minimum=8)
+        u_rows = np.full(k_u, -1, dtype=np.int32)
+        u_vals = np.zeros((k_u, 4), dtype=np.int32)
+        if touched:
+            tr = np.asarray(touched, dtype=np.int64)
+            u_rows[:len(touched)] = tr.astype(np.int32)
+            u_vals[:len(touched)] = (ct.used[tr] - base.used[tr]).astype(
+                np.int32)
+
+        dyn = {
             "c_attr": st.constraint_attr, "c_op": st.constraint_op,
             "c_rhs": st.constraint_rhs, "dc_mask": st.dc_mask,
             "precomp": st.precomp,
-            "used": ct.used.astype(np.int32),
-            "cap": ct.capacity.astype(np.int32),
-            "denom": ct.score_denom,
             "ask": st.ask.astype(np.int32), "count": st.count,
             "penalty": st.penalty, "dh": st.distinct_hosts,
             "ji": st.job_index,
             "jc_rows": jc_rows, "jc_cols": jc_cols, "jc_vals": jc_vals,
+            "u_rows": u_rows, "u_vals": u_vals,
             "rng_seed": np.array(
                 [int.from_bytes(s.generate_uuid()[:8].encode(), "big")
                  & 0x7FFFFFFF], dtype=np.int32),
         }
         if with_networks:
-            host.update(net_active=st.net_active, net_mbits=st.net_mbits,
-                        dyn_need=st.dyn_need, resv_words=st.resv_words,
-                        bw_cap=ct.bw_cap, bw_used=ct.bw_used,
-                        dyn_free=ct.dyn_free, port_words=ct.port_words)
+            u_bw = np.zeros(k_u, dtype=np.int32)
+            u_dyn = np.zeros(k_u, dtype=np.int32)
+            u_ports = np.zeros((k_u, ct.port_words.shape[1]),
+                               dtype=np.uint32)
+            if touched:
+                u_bw[:len(touched)] = ct.bw_used[tr] - base.bw_used[tr]
+                u_dyn[:len(touched)] = ct.dyn_free[tr] - base.dyn_free[tr]
+                u_ports[:len(touched)] = ct.port_words[tr]
+            dyn.update(net_active=st.net_active, net_mbits=st.net_mbits,
+                       dyn_need=st.dyn_need, resv_words=st.resv_words,
+                       u_bw=u_bw, u_dyn=u_dyn, u_ports=u_ports)
         with_dp = any(sp.dp_target is not None for sp in spec_list)
         if with_dp:
-            host.update(dp_col=st.dp_col, dp_active=st.dp_active,
-                        dp_used=st.dp_used)
-        buf, meta = xfer.pack_host(host)
+            dyn.update(dp_col=st.dp_col, dp_active=st.dp_active,
+                       dp_used=st.dp_used)
+
+        sbuf, meta_s = xfer.pack_host(static)
+        dbuf, meta_d = xfer.pack_host(dyn)
         encode_seconds = time.monotonic() - t0
         t1 = time.monotonic()
+
+        import hashlib
+        digest = (hashlib.blake2b(sbuf.tobytes(), digest_size=16).hexdigest(),
+                  meta_s)
+        static_dev = _DEVICE_STATIC_CACHE.pop(digest, None)
+        if static_dev is None:
+            static_dev = jax.device_put(sbuf)
+        _DEVICE_STATIC_CACHE[digest] = static_dev  # LRU touch-on-hit
+        while len(_DEVICE_STATIC_CACHE) > 4:
+            _DEVICE_STATIC_CACHE.pop(next(iter(_DEVICE_STATIC_CACHE)))
 
         # Commit-score side-outputs cost two [U, N] carry buffers; beyond
         # ~16M cells the HBM + compile cost outweighs score forensics
@@ -432,43 +482,125 @@ class TPUBatchScheduler:
         total_asks = int(sum(sp.count for sp in spec_list))
         max_nnz = encode.pow2_bucket(
             max(8, min(total_asks, st.u_pad * ct.n_pad)), minimum=8)
-        summary_buf, coo_mat, feas = device_pass(
-            jax.device_put(buf), meta=meta, u_pad=st.u_pad, n_pad=ct.n_pad,
-            with_networks=with_networks, with_dp=with_dp,
-            with_scores=with_scores, max_nnz=max_nnz)
-        ncols = 5 if with_scores else 3
-        # dtype truth comes from the device array itself (uint16 when the
-        # kernel compacted small, int32 otherwise).
-        isz = coo_mat.dtype.itemsize
-        # Small COO bucket: fetch summary + full bucket concurrently (one
-        # blocking round).  Big bucket: summary first, then exactly the
-        # [nnz, C] prefix — two rounds beat streaming the whole bucket.
-        if max_nnz * ncols * isz <= (4 << 20):
-            sraw, coo_full = jax.device_get((summary_buf, coo_mat))
+        # Slot mode (score-less mega-batches): the kernel records each
+        # commit's node indices into a compact [U, M] matrix during the
+        # scan, so no [U, N] compaction program runs and summary+slots
+        # come back in ONE blocking fetch.
+        slot_m = 0
+        if not with_scores and ct.n_pad <= 65536:
+            max_count = max((sp.count for sp in spec_list), default=1)
+            m_b = encode.pow2_bucket(max(8, max_count), minimum=8)
+            if st.u_pad * m_b * 2 <= (8 << 20):
+                slot_m = m_b
+        if os.environ.get("NOMAD_TPU_TIMING") == "2":
+            # Staged sync (diagnostics only): force the schedule program
+            # to finish before compaction dispatch so the log splits
+            # schedule vs compact+fetch.  This branch always produces COO
+            # output, so slot mode must be OFF — otherwise the decode
+            # below would misread COO triplets as a slot matrix.
+            slot_m = 0
+            from .kernels import _device_compact, _device_schedule
+            t_s0 = time.monotonic()
+            result, feas = _device_schedule(
+                static_dev, jax.device_put(dbuf), meta_s=meta_s,
+                meta_d=meta_d, u_pad=st.u_pad, n_pad=ct.n_pad,
+                with_networks=with_networks, with_dp=with_dp,
+                with_scores=with_scores)
+            jax.device_get(result.unplaced)
+            logger.warning("timing2: schedule %.3fs",
+                           time.monotonic() - t_s0)
+            t_s1 = time.monotonic()
+            compact_u16 = (not with_scores and st.u_pad <= 65536
+                           and ct.n_pad <= 65536)
+            summary_buf, coo_mat = _device_compact(
+                result, feas, with_scores=with_scores, max_nnz=max_nnz,
+                compact_u16=compact_u16)
+            jax.device_get(summary_buf[:4])
+            logger.warning("timing2: compact %.3fs",
+                           time.monotonic() - t_s1)
+        else:
+            summary_buf, coo_mat, feas = device_pass(
+                static_dev, jax.device_put(dbuf), meta_s=meta_s,
+                meta_d=meta_d, u_pad=st.u_pad, n_pad=ct.n_pad,
+                with_networks=with_networks, with_dp=with_dp,
+                with_scores=with_scores, max_nnz=max_nnz, slot_m=slot_m)
+        t_disp = time.monotonic()
+        dbg = os.environ.get("NOMAD_TPU_TIMING")
+        if slot_m:
+            # One blocking round: summary (KBs) + slot matrix together.
+            sraw, slots_np = jax.device_get((summary_buf, coo_mat))
             summary = xfer.unpack_host(np.asarray(sraw),
                                        summary_layout(st.u_pad, ct.n_pad))
-            nnz = int(summary["scalars"][0])
-            coo = np.asarray(coo_full[:nnz])
+            if dbg:
+                logger.warning(
+                    "timing: summary+slots fetch %.3fs ([%d, %d] u16)",
+                    time.monotonic() - t_disp, st.u_pad, slot_m)
         else:
-            summary = xfer.unpack_host(
-                np.asarray(jax.device_get(summary_buf)),
-                summary_layout(st.u_pad, ct.n_pad))
-            nnz = int(summary["scalars"][0])
-            if nnz:
-                coo = np.asarray(jax.device_get(coo_mat[:nnz]))
+            ncols = 5 if with_scores else 3
+            # dtype truth comes from the device array itself (uint16 when
+            # the kernel compacted small, int32 otherwise).
+            isz = coo_mat.dtype.itemsize
+            # Small COO bucket: fetch summary + full bucket concurrently
+            # (one blocking round).  Big bucket: summary first, then a
+            # power-of-two bucketed [nnz_b, C] prefix — the bucket keeps
+            # the slice shape stable across batches (a raw [:nnz] slice
+            # would trace+compile a fresh program per distinct nnz).
+            if max_nnz * ncols * isz <= (4 << 20):
+                sraw, coo_full = jax.device_get((summary_buf, coo_mat))
+                summary = xfer.unpack_host(
+                    np.asarray(sraw), summary_layout(st.u_pad, ct.n_pad))
+                nnz = int(summary["scalars"][0])
+                coo = np.asarray(coo_full[:nnz])
+                if dbg:
+                    logger.warning("timing: summary+coo fetch %.3fs",
+                                   time.monotonic() - t_disp)
             else:
-                coo = np.zeros((0, ncols), dtype=np.dtype(coo_mat.dtype))
+                summary = xfer.unpack_host(
+                    np.asarray(jax.device_get(summary_buf)),
+                    summary_layout(st.u_pad, ct.n_pad))
+                t_sum = time.monotonic()
+                nnz = int(summary["scalars"][0])
+                if nnz:
+                    nnz_b = min(max_nnz,
+                                encode.pow2_bucket(nnz, minimum=8))
+                    coo = np.asarray(jax.device_get(coo_mat[:nnz_b]))[:nnz]
+                else:
+                    coo = np.zeros((0, ncols),
+                                   dtype=np.dtype(coo_mat.dtype))
+                if dbg:
+                    logger.warning(
+                        "timing: summary fetch (compute wait) %.3fs; coo "
+                        "fetch %.3fs (%d entries x %d cols x %d B)",
+                        t_sum - t_disp, time.monotonic() - t_sum, nnz,
+                        ncols, isz)
         rounds = int(summary["scalars"][1])
         unplaced_arr = summary["unplaced"]
-        used_after = summary["used_after"]
         feas_count = summary["feas_count"]
-        coo_rows, coo_cols, coo_counts = coo[:, 0], coo[:, 1], coo[:, 2]
-        if with_scores:
-            coo_scores = np.ascontiguousarray(coo[:, 3]).view(np.float32)
-            coo_coll = coo[:, 4]
+        if slot_m:
+            # Decode slots → flat (row, col) pairs, one per alloc, in
+            # per-spec commit order: the shared downstream path (extent
+            # slices, id expansion, metrics) is unchanged with counts=1.
+            placed_arr = np.array(
+                [sp.count for sp in spec_list], dtype=np.int64)
+            placed_arr -= unplaced_arr[:st.u_real].astype(np.int64)
+            np.clip(placed_arr, 0, None, out=placed_arr)
+            mask = (np.arange(slot_m, dtype=np.int64)[None, :]
+                    < placed_arr[:, None])
+            coo_rows = np.repeat(
+                np.arange(len(spec_list), dtype=np.int64), placed_arr)
+            coo_cols = np.asarray(slots_np[:len(spec_list)])[mask].astype(
+                np.int64)
+            coo_counts = np.ones(len(coo_cols), dtype=np.int32)
+            coo_scores = np.zeros(len(coo_cols), dtype=np.float32)
+            coo_coll = np.zeros(len(coo_cols), dtype=np.int32)
         else:
-            coo_scores = np.zeros(len(coo), dtype=np.float32)
-            coo_coll = np.zeros(len(coo), dtype=np.int32)
+            coo_rows, coo_cols, coo_counts = coo[:, 0], coo[:, 1], coo[:, 2]
+            if with_scores:
+                coo_scores = np.ascontiguousarray(coo[:, 3]).view(np.float32)
+                coo_coll = coo[:, 4]
+            else:
+                coo_scores = np.zeros(len(coo), dtype=np.float32)
+                coo_coll = np.zeros(len(coo), dtype=np.int32)
 
         # Feasibility rows are fetched lazily, only for failed specs whose
         # feasible count is below their EVALUATED count (= ready nodes in
@@ -529,14 +661,46 @@ class TPUBatchScheduler:
         rep_ids = node_id_arr[np.repeat(vc, vcnt)]
         csum = np.concatenate([[0], np.cumsum(vcnt, dtype=np.int64)])
 
+        # used_after is reconstructed host-side from used0 + committed
+        # placements × asks — exact (integer adds, same order-free sum the
+        # kernel computes) and ~1MB of link traffic cheaper than shipping
+        # the [N, 4] matrix in the summary.  Only failure forensics needs
+        # it (cap_left attribution in _fill_failure_metrics).
+        used_after = None
+        if len(failed_u):
+            used_after = np.asarray(ct.used, dtype=np.int64).copy()
+            if len(vr):
+                np.add.at(used_after, vc.astype(np.int64),
+                          vcnt.astype(np.int64)[:, None]
+                          * np.asarray(st.ask)[vr.astype(np.int64)])
+
         expanded: Dict[Tuple[str, str], List[str]] = {}
         unplaced: Dict[Tuple[str, str], int] = {}
         metrics: Dict[Tuple[str, str], s.AllocMetric] = {}
+        # Failure-metric memo: specs that placed NOTHING and had no
+        # feasibility row fetched produce a metric fully determined by
+        # (spec shape, feas_count, unplaced) and the batch-global state —
+        # uniform fleets fail by the hundreds with identical signatures,
+        # so the vectorized-but-per-spec forensics run once per shape.
+        fail_cache: Dict[Tuple, s.AllocMetric] = {}
         for u, sp in enumerate(spec_list):
             key = (sp.job.id, sp.tg.name)
             lo, hi = int(u_lo[u]), int(u_hi[u])
             expanded[key] = rep_ids[csum[lo]:csum[hi]].tolist()
             unplaced[key] = int(unplaced_arr[u])
+
+            n_unplaced = unplaced[key]
+            sig = None
+            if n_unplaced > 0 and lo == hi and feas_rows.get(u) is None:
+                sig = (sp.ask.tobytes(), tuple(sp.datacenters),
+                       tuple((c.ltarget, c.operand, c.rtarget)
+                             for c in sp.constraints),
+                       tuple(sorted(sp.drivers)), bool(sp.distinct_hosts),
+                       sp.dp_target, int(feas_count[u]), n_unplaced)
+                cached = fail_cache.get(sig)
+                if cached is not None:
+                    metrics[key] = cached.copy()
+                    continue
 
             # AllocMetric parity from kernel side-outputs
             # (structs.go:4074-4172 contract; VERDICT r1 weak #7).
@@ -554,13 +718,15 @@ class TPUBatchScheduler:
                         m.score_node(
                             all_nodes[i], "job-anti-affinity",
                             -float(sp.anti_affinity_penalty) * co)
-            if unplaced[key] > 0:
+            if n_unplaced > 0:
                 placed_row = np.zeros(ct.n_real, dtype=np.int32)
                 placed_row[vc[lo:hi]] = vcnt[lo:hi]
                 self._fill_failure_metrics(
                     m, sp, all_nodes, ct, feas_rows.get(u), placed_row,
                     used_after, node_facts)
-                m.coalesced_failures = unplaced[key] - 1
+                m.coalesced_failures = n_unplaced - 1
+                if sig is not None:
+                    fail_cache[sig] = m
             metrics[key] = m
 
         kstats = {
@@ -785,23 +951,24 @@ class TPUBatchScheduler:
             spec = specs.get(key)
             net_asks = spec.net_asks if spec is not None else {}
             k = min(len(slots), n_asks)
-            if names is None and k:
-                # Formulaic names generated only for actual placements:
-                # the full ask list never materializes at batch scale.
-                names = [f"{sched.job.name}.{tg.name}[{i}]"
-                         for i in range(k)]
             appended = 0
             if not net_asks:
                 # Columnar fast path: ONE AllocSlab per (job, tg) instead
                 # of k Allocation objects — the prototype is stored once
                 # and per-alloc columns carry only id/name/node/prev
                 # (structs.AllocSlab; the host-side bottleneck at bench
-                # scale was exactly this materialization loop).
+                # scale was exactly this materialization loop).  Ids and
+                # formulaic names are LAZY columns: the strings only
+                # exist if something reads them (structs._LazyStrs).
                 if k:
                     slab = s.AllocSlab(
                         proto=proto,
-                        ids=s.generate_uuids(k),
-                        names=names[:k] if k < len(names) else names,
+                        ids=s.LazyUuids(k),
+                        names=(s.LazyNames(
+                                   k, f"{sched.job.name}.{tg.name}")
+                               if names is None
+                               else (names[:k] if k < len(names)
+                                     else names)),
                         node_ids=slots[:k] if k < len(slots) else slots,
                         prev_ids=([p or "" for p in prevs[:k]]
                                   if prevs is not None else []),
@@ -809,6 +976,9 @@ class TPUBatchScheduler:
                     sched.plan.append_slab(slab)
                     appended = k
             else:
+                if names is None and k:
+                    names = [f"{sched.job.name}.{tg.name}[{i}]"
+                             for i in range(k)]
                 ids = s.generate_uuids(k) if k else []
                 append = sched.plan.append_alloc
                 import random as _random

@@ -48,42 +48,57 @@ def _select_top_k(scored: jnp.ndarray, ok: jnp.ndarray,
                   k: jnp.ndarray) -> jnp.ndarray:
     """Boolean mask of the k highest-scored ok nodes, without a sort.
 
-    Bisects the score threshold (the k-th largest value): ~45 reduce
-    passes over N, each a single vectorized compare+sum, which the TPU
-    pipelines from VMEM — versus the O(N log N) full argsort this
-    replaced, which dominated device time at N ≈ 50k.  Exact-k selection:
-    nodes strictly above the converged threshold are taken outright and
-    the remainder comes from the threshold band in node-index order
-    (cumsum), which is the same tie order a stable argsort over
-    (-score) yields — so placements are bit-identical to the sort-based
-    kernel, which the oracle/sharded differential tests pin down.
+    Exact radix-quantile select on the monotone bit-space image of f32:
+    IEEE-754 floats map to uint32 such that float order == unsigned
+    order (set the sign bit for non-negatives, invert negatives), then
+    the k-th largest value T is found byte-by-byte — 4 passes, each one
+    [N, 256] compare-and-reduce (a dense TPU reduction; no scatter, no
+    data-dependent loop), versus the 45 sequential threshold-bisection
+    reduce passes this replaced (each a loop-carried [N] pass — latency-
+    bound at ~2.7ms/select, the dominant device cost at N ≈ 50k).
+
+    Selection is exact: nodes strictly above T are taken outright and
+    the == T band fills in node-index order (cumsum), the same tie order
+    a stable argsort over (-score) yields — so placements are
+    bit-identical to both the argsort and bisection kernels, which the
+    oracle/sharded differential tests pin down.
     """
-    neg = jnp.float32(NEG_INF)
-    masked = jnp.where(ok, scored, neg)
-    hi0 = jnp.max(masked)
-    lo0 = jnp.minimum(jnp.min(jnp.where(ok, scored, jnp.inf)), hi0)
+    bits = lax.bitcast_convert_type(scored, jnp.uint32)
+    ordered = jnp.where((bits >> 31) == 0,
+                        bits | jnp.uint32(0x80000000), ~bits)
+    bins = jnp.arange(256, dtype=jnp.uint32)
+    bins_i = jnp.arange(256, dtype=jnp.int32)
 
-    def body(_, lohi):
-        lo, hi = lohi
-        mid = 0.5 * (lo + hi)
-        above = jnp.sum((masked > mid).astype(jnp.int32))
-        take = above >= k
-        return jnp.where(take, mid, lo), jnp.where(take, hi, mid)
+    def radix_pass(cand, byte, above):
+        # hist[b] = #cand nodes whose current byte == b; [256, N] with N
+        # minor so the reduce runs along lanes (TPU-friendly layout).
+        hist = jnp.sum(cand[None, :] & (byte[None, :] == bins[:, None]),
+                       axis=1, dtype=jnp.int32)
+        cnt_ge = above + jnp.cumsum(hist[::-1])[::-1]
+        # cnt_ge is non-increasing in b and cnt_ge[0] >= k (the top-k all
+        # carry the known prefix or better), so the threshold byte is the
+        # last b with cnt_ge[b] >= k.
+        t_b = jnp.sum((cnt_ge >= k).astype(jnp.int32)) - 1
+        above = above + jnp.sum(jnp.where(bins_i > t_b, hist, 0))
+        return t_b.astype(jnp.uint32), above
 
-    lo, hi = lax.fori_loop(0, 45, body, (lo0 - 1.0, hi0 + 1.0))
-    # The band (lo, hi] holds exactly ONE distinct f32 score value in
-    # both regimes: at |score| ≳ 1e-2 the f32 bisection stalls once lo/hi
-    # are adjacent representables, so the band is a single value by
-    # construction; near zero (where f32 resolves far finer than the
-    # jitter) 45 iterations shrink the span to ~span0/2^45 ≤ 6e-13,
-    # below the tie-jitter quantum (2^-24 · 1e-3 ≈ 6e-11), so distinct
-    # jittered scores can't share the band.  Either way, filling the
-    # single-valued band in node-index order reproduces the stable-
-    # argsort tie order.  The band bound must be STRICT (> lo): `>= lo`
-    # would admit lo-valued nodes (below the k-th value) ahead of
-    # higher-scored band members.
-    sel_gt = masked > hi
-    band = ok & ~sel_gt & (masked > lo)
+    above = jnp.int32(0)
+    t1, above = radix_pass(ok, ordered >> 24, above)
+    cand = ok & ((ordered >> 24) == t1)
+    t2, above = radix_pass(cand, (ordered >> 16) & 0xFF, above)
+    p16 = (t1 << 8) | t2
+    cand = ok & ((ordered >> 16) == p16)
+    t3, above = radix_pass(cand, (ordered >> 8) & 0xFF, above)
+    p24 = (p16 << 8) | t3
+    cand = ok & ((ordered >> 8) == p24)
+    t4, above = radix_pass(cand, ordered & 0xFF, above)
+    thresh = (p24 << 8) | t4
+
+    # T is exactly the k-th largest ok value; `above` (< k) of the ok
+    # nodes are strictly greater.  Fill the remainder from the == T band
+    # in node-index order.
+    sel_gt = ok & (ordered > thresh)
+    band = ok & (ordered == thresh)
     need = k - jnp.sum(sel_gt.astype(jnp.int32))
     csum = jnp.cumsum(band.astype(jnp.int32))
     return sel_gt | (band & (csum <= need))
@@ -170,6 +185,11 @@ class PlacementResult(NamedTuple):
     # "job-anti-affinity" score entry from the latter (rank.go:167).
     commit_scores: jnp.ndarray = None      # [U, N] float32
     commit_collisions: jnp.ndarray = None  # [U, N] int32
+    # Compact slot record (slot_m > 0): slots[u, j] = node index of spec
+    # u's j-th committed alloc, appended in commit order — the device→
+    # host placement payload without any nonzero/compaction pass over
+    # the [U, N] matrix.  -1 padding beyond each spec's placed count.
+    slots: jnp.ndarray = None              # [U, M] int32
 
 
 class NetTensors(NamedTuple):
@@ -197,24 +217,27 @@ class DPTensors(NamedTuple):
 
 
 def _disabled_net(u_pad: int, n_pad: int) -> NetTensors:
+    # Size-1 placeholders: with use_net=False the kernel never touches
+    # these (python-level `if`, not jnp.where), so they only exist to
+    # keep the carry pytree structure stable.
     return NetTensors(
-        active=jnp.zeros(u_pad, dtype=bool),
-        mbits=jnp.zeros(u_pad, dtype=jnp.int32),
-        dyn_need=jnp.zeros(u_pad, dtype=jnp.int32),
-        resv_words=jnp.zeros((u_pad, 1), dtype=jnp.uint32),
-        bw_cap=jnp.zeros(n_pad, dtype=jnp.int32),
-        bw_used=jnp.zeros(n_pad, dtype=jnp.int32),
-        dyn_free=jnp.zeros(n_pad, dtype=jnp.int32),
-        port_words=jnp.zeros((n_pad, 1), dtype=jnp.uint32),
+        active=jnp.zeros(1, dtype=bool),
+        mbits=jnp.zeros(1, dtype=jnp.int32),
+        dyn_need=jnp.zeros(1, dtype=jnp.int32),
+        resv_words=jnp.zeros((1, 1), dtype=jnp.uint32),
+        bw_cap=jnp.zeros(1, dtype=jnp.int32),
+        bw_used=jnp.zeros(1, dtype=jnp.int32),
+        dyn_free=jnp.zeros(1, dtype=jnp.int32),
+        port_words=jnp.zeros((1, 1), dtype=jnp.uint32),
     )
 
 
 def _disabled_dp(u_pad: int, n_pad: int) -> DPTensors:
     return DPTensors(
-        col=jnp.full(u_pad, -1, dtype=jnp.int32),
-        active=jnp.zeros(u_pad, dtype=bool),
-        used0=jnp.zeros((u_pad, 1), dtype=bool),
-        attr_values=jnp.full((n_pad, 1), MISSING, dtype=jnp.int32),
+        col=jnp.full(1, -1, dtype=jnp.int32),
+        active=jnp.zeros(1, dtype=bool),
+        used0=jnp.zeros((1, 1), dtype=bool),
+        attr_values=jnp.full((1, 1), MISSING, dtype=jnp.int32),
     )
 
 
@@ -234,14 +257,19 @@ def placement_rounds(
     net: "NetTensors" = None,
     dp: "DPTensors" = None,
     with_scores: bool = True,
+    slot_m: int = 0,
 ) -> PlacementResult:
     """The sequential heart of the batch scheduler (see
-    ``_placement_rounds_impl``).  ``net``/``dp`` default to disabled
-    singleton shapes whose checks compile away.  ``with_scores=False``
-    drops the [U, N] commit-score/collision side-outputs (mega-batch
-    shapes: two extra carry buffers of that size cost real HBM and
-    compile time; counts in the result stay exact)."""
+    ``_placement_rounds_impl``).  ``net``/``dp`` default to None, which
+    statically compiles the network/distinct_property code OUT of the
+    program (a disabled-but-present path still costs per-spec gathers
+    and scatters inside the scan).  ``with_scores=False`` drops the
+    [U, N] commit-score/collision side-outputs (mega-batch shapes: two
+    extra carry buffers of that size cost real HBM and compile time;
+    counts in the result stay exact)."""
     u_pad, n_pad = feas.shape
+    use_net = net is not None
+    use_dp = dp is not None
     if net is None:
         net = _disabled_net(u_pad, n_pad)
     if dp is None:
@@ -249,10 +277,12 @@ def placement_rounds(
     return _placement_rounds_impl(
         feas, used0, capacity, denom, ask, count, penalty, distinct_hosts,
         job_index, job_counts0, rng_key, net, dp, max_rounds=max_rounds,
-        with_scores=with_scores)
+        with_scores=with_scores, use_net=use_net, use_dp=use_dp,
+        slot_m=slot_m)
 
 
-@functools.partial(jax.jit, static_argnames=("max_rounds", "with_scores"))
+@functools.partial(jax.jit, static_argnames=("max_rounds", "with_scores",
+                                             "use_net", "use_dp", "slot_m"))
 def _placement_rounds_impl(
     feas: jnp.ndarray,
     used0: jnp.ndarray,
@@ -269,6 +299,9 @@ def _placement_rounds_impl(
     dp: DPTensors,
     max_rounds: int = 256,
     with_scores: bool = True,
+    use_net: bool = False,
+    use_dp: bool = False,
+    slot_m: int = 0,
 ) -> PlacementResult:
     """The sequential heart of the batch scheduler.
 
@@ -299,120 +332,183 @@ def _placement_rounds_impl(
     big_idx = jnp.int32(n_pad + 1)
 
     def place_one_spec(carry, u):
-        (used, job_counts, remaining_count, placements,
-         bw_used, port_words, dyn_free, dp_used, commit_scores,
-         commit_coll) = carry
+        def try_place(carry):
+            (used, job_counts, remaining_count, placements,
+             bw_used, port_words, dyn_free, dp_used, commit_scores,
+             commit_coll, slots) = carry
 
-        cap_left = capacity - used                       # [N, 4]
-        fits = jnp.all(ask[u][None, :] <= cap_left, axis=1)
-        collisions = job_counts[job_index[u]]            # [N] int32
-        ok = feas[u] & fits
-        ok = ok & jnp.where(distinct_hosts[u], collisions == 0, True)
+            cap_left = capacity - used                       # [N, 4]
+            fits = jnp.all(ask[u][None, :] <= cap_left, axis=1)
+            collisions = job_counts[job_index[u]]            # [N] int32
+            ok = feas[u] & fits
+            ok = ok & jnp.where(distinct_hosts[u], collisions == 0, True)
 
-        # Network feasibility (bandwidth + reserved conflicts + dynamic
-        # capacity); compiles to nothing when W == 1 and asks are zero.
-        bw_ok = bw_used + net.mbits[u] <= net.bw_cap
-        resv_hit = jnp.any((port_words & net.resv_words[u][None, :]) != 0,
-                           axis=1)
-        dyn_ok = dyn_free >= net.dyn_need[u]
-        ok = ok & jnp.where(net.active[u], bw_ok & ~resv_hit & dyn_ok, True)
+            # Network feasibility (bandwidth + reserved conflicts +
+            # dynamic capacity); statically absent when the batch has no
+            # network asks.
+            if use_net:
+                bw_ok = bw_used + net.mbits[u] <= net.bw_cap
+                resv_hit = jnp.any(
+                    (port_words & net.resv_words[u][None, :]) != 0, axis=1)
+                dyn_ok = dyn_free >= net.dyn_need[u]
+                ok = ok & jnp.where(net.active[u],
+                                    bw_ok & ~resv_hit & dyn_ok, True)
 
-        # distinct_property feasibility: node must have the property and
-        # its value must be unused (propertyset.go:150).
-        col = jnp.clip(dp.col[u], 0, dp.attr_values.shape[1] - 1)
-        codes = dp.attr_values[:, col]                    # [N]
-        code_c = jnp.clip(codes, 0, v_pad - 1)
-        dp_ok = (codes != MISSING) & ~dp_used[u, code_c]
-        ok = ok & jnp.where(dp.active[u], dp_ok, True)
+            # distinct_property feasibility: node must have the property
+            # and its value must be unused (propertyset.go:150).
+            if use_dp:
+                col = jnp.clip(dp.col[u], 0, dp.attr_values.shape[1] - 1)
+                codes = dp.attr_values[:, col]                    # [N]
+                code_c = jnp.clip(codes, 0, v_pad - 1)
+                dp_ok = (codes != MISSING) & ~dp_used[u, code_c]
+                ok = ok & jnp.where(dp.active[u], dp_ok, True)
+            else:
+                code_c = None
 
-        base_score = _score_fit(used, ask[u], denom)
-        score = base_score - penalty[u] * collisions.astype(jnp.float32)
-        score = score + jitter[u]
-        scored = jnp.where(ok, score, NEG_INF)
+            # Commit the top-k scored nodes (k = remaining count, bounded
+            # by feasible nodes) — one alloc per node this round.
+            k = jnp.minimum(remaining_count[u],
+                            jnp.sum(ok).astype(jnp.int32))
+            return lax.cond(k > 0, lambda c: commit(c, ok, collisions,
+                                                    code_c, k),
+                            skip, carry)
 
-        # Commit the top-k scored nodes (k = remaining count, bounded by
-        # feasible nodes) — one alloc per node this round.  Threshold
-        # bisection instead of a full argsort: same selection, same tie
-        # order, ~100x less device work at N ≈ 50k.
-        k = jnp.minimum(remaining_count[u], jnp.sum(ok).astype(jnp.int32))
-        sel = _select_top_k(scored, ok, k)
+        def commit(carry, ok, collisions, code_c, k):
+            (used, job_counts, remaining_count, placements,
+             bw_used, port_words, dyn_free, dp_used, commit_scores,
+             commit_coll, slots) = carry
+            base_score = _score_fit(used, ask[u], denom)
+            score = base_score - penalty[u] * collisions.astype(jnp.float32)
+            score = score + jitter[u]
+            scored = jnp.where(ok, score, NEG_INF)
 
-        # Within-round value dedup for distinct_property: among selected
-        # nodes sharing a property value, keep only the best-scored (ties
-        # by lowest node index — the stable-sort order).
-        sel_score = jnp.where(sel, scored, jnp.float32(NEG_INF))
-        best_per_code = jnp.full(v_pad, NEG_INF, dtype=jnp.float32
-                                 ).at[code_c].max(sel_score)
-        cand_dp = sel & (sel_score >= best_per_code[code_c])
-        best_idx = jnp.full(v_pad, big_idx, dtype=jnp.int32).at[code_c].min(
-            jnp.where(cand_dp, node_idx, big_idx))
-        keep_dp = cand_dp & (node_idx == best_idx[code_c])
-        sel = jnp.where(dp.active[u], keep_dp, sel)
+            # Threshold bisection instead of a full argsort: same
+            # selection, same tie order, ~100x less device work at N≈50k.
+            sel = _select_top_k(scored, ok, k)
 
-        sel_i = sel.astype(jnp.int32)
-        placed = jnp.sum(sel_i)
-        used = used + sel_i[:, None] * ask[u][None, :]
-        job_counts = job_counts.at[job_index[u]].add(sel_i)
-        placements = placements.at[u].add(sel_i)
-        remaining_count = remaining_count.at[u].add(-placed)
+            # Within-round value dedup for distinct_property: among
+            # selected nodes sharing a property value, keep only the
+            # best-scored (ties by lowest node index — stable-sort order).
+            if use_dp:
+                sel_score = jnp.where(sel, scored, jnp.float32(NEG_INF))
+                best_per_code = jnp.full(v_pad, NEG_INF, dtype=jnp.float32
+                                         ).at[code_c].max(sel_score)
+                cand_dp = sel & (sel_score >= best_per_code[code_c])
+                best_idx = jnp.full(v_pad, big_idx, dtype=jnp.int32
+                                    ).at[code_c].min(
+                    jnp.where(cand_dp, node_idx, big_idx))
+                keep_dp = cand_dp & (node_idx == best_idx[code_c])
+                sel = jnp.where(dp.active[u], keep_dp, sel)
 
-        commit_net = net.active[u]
-        bw_used = bw_used + jnp.where(commit_net, sel_i * net.mbits[u], 0)
-        port_words = jnp.where(
-            (commit_net & sel)[:, None],
-            port_words | net.resv_words[u][None, :], port_words)
-        dyn_free = dyn_free - jnp.where(commit_net,
-                                        sel_i * net.dyn_need[u], 0)
-        dp_upd = jnp.zeros(v_pad, dtype=bool).at[code_c].max(
-            sel & dp.active[u])
-        dp_used = dp_used.at[u].set(dp_used[u] | dp_upd)
-        # Commit-time AllocMetric side-outputs: pure binpack score and
-        # the collision count behind any anti-affinity penalty.
-        if with_scores:
-            commit_scores = commit_scores.at[u].set(jnp.where(
-                sel, base_score, commit_scores[u]))
-            commit_coll = commit_coll.at[u].set(jnp.where(
-                sel, collisions, commit_coll[u]))
+            sel_i = sel.astype(jnp.int32)
+            placed = jnp.sum(sel_i)
+            used = used + sel_i[:, None] * ask[u][None, :]
+            job_counts = job_counts.at[job_index[u]].add(sel_i)
+            placements = placements.at[u].add(sel_i)
 
-        return (used, job_counts, remaining_count, placements,
-                bw_used, port_words, dyn_free, dp_used,
-                commit_scores, commit_coll), placed
+            if slot_m:
+                # Compact slot record: append this commit's node indices
+                # to spec u's slot row in ascending-node order — the
+                # device→host payload needs no nonzero pass later.
+                pos = jnp.cumsum(sel.astype(jnp.int32))
+                offset = count[u] - remaining_count[u]  # placed so far
+                dest = jnp.where(sel, offset + pos - 1, jnp.int32(slot_m))
+                slots = slots.at[u, dest].set(node_idx, mode="drop")
+
+            remaining_count = remaining_count.at[u].add(-placed)
+
+            if use_net:
+                commit_net = net.active[u]
+                bw_used = bw_used + jnp.where(commit_net,
+                                              sel_i * net.mbits[u], 0)
+                port_words = jnp.where(
+                    (commit_net & sel)[:, None],
+                    port_words | net.resv_words[u][None, :], port_words)
+                dyn_free = dyn_free - jnp.where(commit_net,
+                                                sel_i * net.dyn_need[u], 0)
+            if use_dp:
+                dp_upd = jnp.zeros(v_pad, dtype=bool).at[code_c].max(
+                    sel & dp.active[u])
+                dp_used = dp_used.at[u].set(dp_used[u] | dp_upd)
+            # Commit-time AllocMetric side-outputs: pure binpack score and
+            # the collision count behind any anti-affinity penalty.
+            if with_scores:
+                commit_scores = commit_scores.at[u].set(jnp.where(
+                    sel, base_score, commit_scores[u]))
+                commit_coll = commit_coll.at[u].set(jnp.where(
+                    sel, collisions, commit_coll[u]))
+            return (used, job_counts, remaining_count, placements,
+                    bw_used, port_words, dyn_free, dp_used,
+                    commit_scores, commit_coll, slots), placed
+
+        def skip(carry):
+            return carry, jnp.int32(0)
+
+        # Two-level skip, both REAL branches on TPU (the scan over specs
+        # is sequential, not vmapped, so lax.cond doesn't get batched
+        # into a select):
+        #  - outer: remaining_count[u] == 0 (spec fully placed) skips
+        #    even the feasibility/fit prefix — a scalar test, so placed
+        #    specs cost nothing in later rounds;
+        #  - inner (in try_place): k == 0 (no feasible node under
+        #    remaining capacity) skips the scoring transcendentals and
+        #    the top-k select.
+        # Neither branch commits anything, so placements stay
+        # bit-identical to the unguarded kernel.
+        return lax.cond(carry[2][u] > 0, try_place, skip, carry)
 
     def round_body(state):
         (used, job_counts, remaining_count, placements,
          bw_used, port_words, dyn_free, dp_used, commit_scores,
-         commit_coll, _, rounds) = state
+         commit_coll, slots, _, rounds) = state
         carry, placed = lax.scan(
             place_one_spec,
             (used, job_counts, remaining_count, placements,
              bw_used, port_words, dyn_free, dp_used, commit_scores,
-             commit_coll),
+             commit_coll, slots),
             jnp.arange(u_pad),
         )
         (used, job_counts, remaining_count, placements,
          bw_used, port_words, dyn_free, dp_used, commit_scores,
-         commit_coll) = carry
+         commit_coll, slots) = carry
         progress = jnp.sum(placed)
         return (used, job_counts, remaining_count, placements,
                 bw_used, port_words, dyn_free, dp_used, commit_scores,
-                commit_coll, progress, rounds + 1)
+                commit_coll, slots, progress, rounds + 1)
 
     def round_cond(state):
+        used = state[0]
         remaining_count = state[2]
-        progress = state[10]
-        rounds = state[11]
-        return (progress > 0) & (jnp.sum(remaining_count) > 0) & (rounds < max_rounds)
+        progress = state[11]
+        rounds = state[12]
+        go = ((progress > 0) & (jnp.sum(remaining_count) > 0)
+              & (rounds < max_rounds))
+        # Capacity early-exit: if no node can fit even the SMALLEST
+        # remaining ask (dimension-wise lower bound), no spec can place
+        # anything, so the round would only burn one feasibility prefix
+        # per active spec to discover no progress.  This turns the
+        # always-paid final no-progress round into one [N, 4] pass.
+        # Necessary-condition only (net/dp/constraints are stricter), so
+        # placements are unchanged.
+        active = remaining_count > 0
+        min_ask = jnp.min(jnp.where(active[:, None], ask,
+                                    jnp.int32(2**30)), axis=0)
+        fits_any = jnp.any(jnp.all(min_ask[None, :] <= capacity - used,
+                                   axis=1))
+        return go & fits_any
 
     placements0 = jnp.zeros((u_pad, n_pad), dtype=jnp.int32)
     score_shape = (u_pad, n_pad) if with_scores else (1, 1)
     scores0 = jnp.zeros(score_shape, dtype=jnp.float32)
     coll0 = jnp.zeros(score_shape, dtype=jnp.int32)
+    slots0 = jnp.full((u_pad, slot_m) if slot_m else (1, 1), -1,
+                      dtype=jnp.int32)
     state = (used0, job_counts0, count, placements0,
              net.bw_used, net.port_words, net.dyn_free, dp.used0, scores0,
-             coll0,
+             coll0, slots0,
              jnp.array(1, dtype=jnp.int32), jnp.array(0, dtype=jnp.int32))
     (used, job_counts, remaining, placements,
-     _bw, _pw, _df, _dpu, commit_scores, commit_coll, _,
+     _bw, _pw, _df, _dpu, commit_scores, commit_coll, slots, _,
      rounds) = lax.while_loop(round_cond, round_body, state)
 
     return PlacementResult(
@@ -422,62 +518,120 @@ def _placement_rounds_impl(
         rounds=rounds,
         commit_scores=commit_scores,
         commit_collisions=commit_coll,
+        slots=slots,
     )
 
 
 def summary_layout(u_pad: int, n_pad: int):
     """Layout of the packed device→host summary buffer (shared contract
-    between device_pass and its caller; see ops/xfer.py layout())."""
+    between device_pass and its caller; see ops/xfer.py layout()).
+
+    used_after is deliberately NOT shipped: [n_pad, 4] int32 is ~1MB at
+    50k nodes and the tunneled link runs at single-digit MB/s — the host
+    reconstructs it exactly from used0 + the COO placements × asks (see
+    batch_sched._place_on_device), so the summary stays a few KB."""
     from . import xfer
 
     return xfer.layout({
         "unplaced": ("i32", (u_pad,)),
-        "used_after": ("i32", (n_pad, 4)),
         "feas_count": ("i32", (u_pad,)),
         "scalars": ("i32", (2,)),       # [nnz, rounds]
     })
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "meta", "u_pad", "n_pad", "with_networks", "with_dp", "with_scores",
-    "max_rounds"))
+    "meta_s", "meta_d", "u_pad", "n_pad", "with_networks", "with_dp",
+    "with_scores", "max_rounds", "slot_m"))
 def _device_schedule(
-    buf: jnp.ndarray,                 # packed uint8 upload (ops/xfer.py)
+    static_buf: jnp.ndarray,          # packed uint8, device-cached (xfer)
+    dyn_buf: jnp.ndarray,             # packed uint8, per-batch upload
     *,
-    meta,
+    meta_s,
+    meta_d,
     u_pad: int,
     n_pad: int,
     with_networks: bool,
     with_dp: bool,
     with_scores: bool,
     max_rounds: int = 256,
+    slot_m: int = 0,
 ):
-    """Dispatch 1: unpack + feasibility + placement rounds."""
+    """Dispatch 1: unpack + feasibility + placement rounds.
+
+    The upload is split so the link carries only what changed: the
+    static cluster buffer (attr/elig/dc/cap/denom + network baselines —
+    the multi-MB part) is uploaded once per fleet state and cached as a
+    device array by the caller; the per-batch dynamic buffer holds the
+    U-sized spec tensors plus SPARSE alloc-usage deltas scattered onto
+    the static baselines here."""
     from . import xfer
 
-    d = xfer.unpack_device(buf, meta)
+    d = xfer.unpack_device(static_buf, meta_s)
+    d.update(xfer.unpack_device(dyn_buf, meta_d))
+    # Materialize the unpacked arrays before they enter the placement
+    # while/scan: without the barrier XLA fuses the slice+bitcast decode
+    # of the packed buffer into the loop BODY and re-decodes the whole
+    # buffer every spec iteration (measured: 0.88s vs 0.04s for the same
+    # placement program at U=1024, N=64k).
+    d = dict(zip(d.keys(), lax.optimization_barrier(tuple(d.values()))))
     job_counts = scatter_job_counts(
         d["jc_rows"], d["jc_cols"], d["jc_vals"], u_pad=u_pad, n_pad=n_pad)
     feas = feasibility_matrix(
         d["attr"], d["elig"], d["dc"], d["c_attr"], d["c_op"], d["c_rhs"],
         d["dc_mask"], d["precomp"])
+    # Alloc usage arrives as sparse (node, 4-dim) deltas over the static
+    # reserved-only baseline; -1 rows are padding.  Padding routes to an
+    # out-of-bounds index under mode="drop" — clipping it to a real row
+    # would put DUPLICATE indices in the scatter, and for the port-word
+    # SET below a padding row's identity write could then race with (and
+    # clobber) a real touched-node write.
+    uvalid = d["u_rows"] >= 0
+    uidx = jnp.where(uvalid, d["u_rows"], jnp.int32(n_pad))
+    used0 = d["used_base"].at[uidx].add(d["u_vals"], mode="drop")
     net = None
     if with_networks:
+        bw_used = d["bw_used_base"].at[uidx].add(d["u_bw"], mode="drop")
+        dyn_free = d["dyn_free_base"].at[uidx].add(d["u_dyn"], mode="drop")
+        # Port bitmaps are REPLACED per touched node (the host re-derives
+        # the full set for nodes with allocs), not OR-merged.
+        port_words = d["port_words_base"].at[uidx].set(
+            d["u_ports"], mode="drop")
         net = NetTensors(
             active=d["net_active"], mbits=d["net_mbits"],
             dyn_need=d["dyn_need"], resv_words=d["resv_words"],
-            bw_cap=d["bw_cap"], bw_used=d["bw_used"],
-            dyn_free=d["dyn_free"], port_words=d["port_words"])
+            bw_cap=d["bw_cap"], bw_used=bw_used,
+            dyn_free=dyn_free, port_words=port_words)
     dp = None
     if with_dp:
         dp = DPTensors(col=d["dp_col"], active=d["dp_active"],
                        used0=d["dp_used"], attr_values=d["attr"])
     key = jax.random.PRNGKey(d["rng_seed"][0])
     result = placement_rounds(
-        feas, d["used"], d["cap"], d["denom"], d["ask"], d["count"],
+        feas, used0, d["cap"], d["denom"], d["ask"], d["count"],
         d["penalty"], d["dh"], d["ji"], job_counts, key,
-        max_rounds=max_rounds, net=net, dp=dp, with_scores=with_scores)
+        max_rounds=max_rounds, net=net, dp=dp, with_scores=with_scores,
+        slot_m=slot_m)
     return result, feas
+
+
+@jax.jit
+def _device_slots_pack(result: PlacementResult, feas: jnp.ndarray):
+    """Dispatch 2 (slot mode): summary pack only — placements already
+    live in the compact [U, M] slot matrix recorded during the scan, so
+    no nonzero/compaction pass over the [U, N] matrix runs at all (that
+    pass measured 0.6s at 1024×50048).  Slots ship as uint16 (node
+    index < 65536; -1 padding wraps to 65535 but the host reads only
+    each spec's placed-count prefix)."""
+    from . import xfer
+
+    feas_count = jnp.sum(feas, axis=1).astype(jnp.int32)
+    summary, _ = xfer.pack_device({
+        "unplaced": result.unplaced,
+        "feas_count": feas_count,
+        "scalars": jnp.stack(
+            [jnp.int32(0), result.rounds]).astype(jnp.int32),
+    })
+    return summary, result.slots.astype(jnp.uint16)
 
 
 @functools.partial(jax.jit, static_argnames=("with_scores", "max_nnz",
@@ -513,7 +667,6 @@ def _device_compact(result: PlacementResult, feas: jnp.ndarray,
     feas_count = jnp.sum(feas, axis=1).astype(jnp.int32)
     summary, _ = xfer.pack_device({
         "unplaced": result.unplaced,
-        "used_after": result.used_after,
         "feas_count": feas_count,
         "scalars": jnp.stack([nnz, result.rounds]).astype(jnp.int32),
     })
@@ -521,9 +674,11 @@ def _device_compact(result: PlacementResult, feas: jnp.ndarray,
 
 
 def device_pass(
-    buf: jnp.ndarray,
+    static_buf: jnp.ndarray,
+    dyn_buf: jnp.ndarray,
     *,
-    meta,
+    meta_s,
+    meta_d,
     u_pad: int,
     n_pad: int,
     with_networks: bool,
@@ -531,29 +686,44 @@ def device_pass(
     with_scores: bool,
     max_nnz: int,
     max_rounds: int = 256,
+    slot_m: int = 0,
 ):
-    """The whole batch-scheduling device program over ONE uploaded buffer,
-    returning ONE packed summary + a COO matrix the host fetches as a
-    [nnz, C] prefix — the tunneled host↔device link pays ~50-110ms per
-    transfer, so transfer count (not FLOPs) is the scaling limit
-    (VERDICT r1 weak #1; bench.py link measurements).
+    """The whole batch-scheduling device program over a cached static
+    buffer + ONE per-batch dynamic upload, returning ONE packed summary
+    + a COO matrix the host fetches as a [nnz, C] prefix — the tunneled
+    host↔device link pays ~50-110ms per transfer and single-digit MB/s,
+    so transfer bytes (not FLOPs) are the scaling limit (VERDICT r1
+    weak #1; bench.py link measurements).
 
     Two dispatches (schedule, compact) rather than one fused program:
     both stay on device so the split is free at the link, and it keeps
     the XLA optimization time of the big scheduling program from
     compounding with the compaction graph.
 
-    Returns (summary_buf uint8, coo [max_nnz, C], feas bool[U, N]);
+    With slot_m > 0 (requires with_scores=False, n_pad <= 65536):
+    returns (summary_buf uint8, slots uint16[U, slot_m], feas) — the
+    placement payload recorded compactly during the scan, skipping the
+    [U, N] nonzero pass entirely.
+
+    Otherwise returns (summary_buf uint8, coo [max_nnz, C], feas);
     C = 5 with scores (int32: row, col, count, score-bits, collisions),
     else 3 (row, col, count — uint16 when U/N/rounds all fit 16 bits,
     int32 otherwise; read the dtype off the array).  feas stays on
     device for the rare lazy failure-forensics row fetch.
     """
     result, feas = _device_schedule(
-        buf, meta=meta, u_pad=u_pad, n_pad=n_pad,
+        static_buf, dyn_buf, meta_s=meta_s, meta_d=meta_d,
+        u_pad=u_pad, n_pad=n_pad,
         with_networks=with_networks, with_dp=with_dp,
-        with_scores=with_scores, max_rounds=max_rounds)
-    compact_u16 = (not with_scores and u_pad < 65536 and n_pad < 65536
+        with_scores=with_scores, max_rounds=max_rounds, slot_m=slot_m)
+    if slot_m:
+        summary, slots = _device_slots_pack(result, feas)
+        return summary, slots, feas
+    # <= 65536: u16 stores values 0..65535 and row/col/count are all
+    # strictly below their pad bound (a 65536-node bucket still has max
+    # col 65535 — `< 65536` wrongly fell back to int32 exactly at the
+    # 50k-node bench shape, tripling the COO bytes on the link).
+    compact_u16 = (not with_scores and u_pad <= 65536 and n_pad <= 65536
                    and max_rounds < 65536)
     summary, coo = _device_compact(
         result, feas, with_scores=with_scores, max_nnz=max_nnz,

@@ -40,8 +40,20 @@ class EvalContext:
         self.cache = EvalCache()
         self._eligibility: Optional[EvalEligibility] = None
         # Per-eval PRNG ≙ the reference's global math/rand; seedable for
-        # deterministic differential tests.
-        self.rng = rng or random.Random()
+        # deterministic differential tests.  Constructed lazily: seeding
+        # from os.urandom costs ~130µs and the TPU batch path never
+        # touches it (measured at 0.13s per 1k-eval batch).
+        self._rng = rng
+
+    @property
+    def rng(self) -> random.Random:
+        if self._rng is None:
+            self._rng = random.Random()
+        return self._rng
+
+    @rng.setter
+    def rng(self, value) -> None:
+        self._rng = value
 
     def reset(self) -> None:
         """Invoked after each placement (context.go:107)."""

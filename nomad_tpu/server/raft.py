@@ -6,9 +6,12 @@ This module provides the same shape:
 
 - ``RaftLog``        — the log interface the server applies through.
 - ``InmemLog``       — in-memory log (tests / dev mode), like raftInmem.
-- ``FileLog``        — single-voter durable WAL with length-prefixed pickled
-                       entries, fsync batching, and snapshot+truncate —
-                       filling boltdb's role.
+- ``FileLog``        — single-voter durable WAL with length-prefixed
+                       entries (whitelisted msgpack trees via
+                       server/log_codec — never pickle, so a corrupt or
+                       attacker-written WAL/snapshot can only inject
+                       data, not code), fsync batching, and
+                       snapshot+truncate — filling boltdb's role.
 - ``ReplicatedLog``  — leader-append + follower-replication over a
                        transport callable; majority commit.  Single-voter
                        by default; multi-server replication uses the RPC
@@ -22,12 +25,24 @@ enable/disable the broker exactly as the reference does
 from __future__ import annotations
 
 import os
-import pickle
 import struct
 import threading
 from typing import Callable, List, Optional, Tuple
 
 from .fsm import FSM, MessageType
+from .log_codec import decode_payload, encode_payload
+
+
+def _encode_entry(index, msg_type, payload):
+    return encode_payload({"i": int(index), "t": int(msg_type),
+                           "p": payload})
+
+
+def _decode_entry(blob):
+    """Decode one WAL record; raises on anything that is not a
+    well-formed msgpack entry (callers treat that as a corrupt tail)."""
+    d = decode_payload(blob)
+    return d["i"], d["t"], d["p"]
 
 _LEN = struct.Struct("<Q")
 
@@ -169,8 +184,14 @@ class FileLog(RaftLog):
         entries = self._read_legacy_entries(snap_idx)
         if self._nwal is not None:
             # Native log replay (CRC + torn-tail handling done at open).
+            # A CRC-valid record that still fails to decode (garbage or a
+            # pre-msgpack-format file) ends replay at the last good entry
+            # rather than crashing recovery.
             for blob in self._nwal.records():
-                index, msg_type, payload = pickle.loads(blob)
+                try:
+                    index, msg_type, payload = _decode_entry(blob)
+                except Exception:
+                    break
                 if index > snap_idx:
                     entries.append((index, msg_type, payload))
         else:
@@ -214,8 +235,11 @@ class FileLog(RaftLog):
                 blob = fh.read(length)
                 if len(blob) < length or (zlib.crc32(blob) & 0xFFFFFFFF) != crc:
                     break
+                try:
+                    index, msg_type, payload = _decode_entry(blob)
+                except Exception:
+                    break  # undecodable record — treat as corrupt tail
                 good = fh.tell()
-                index, msg_type, payload = pickle.loads(blob)
                 if index > snap_idx:
                     out.append((index, msg_type, payload))
         if good < size:
@@ -246,7 +270,14 @@ class FileLog(RaftLog):
                 if len(blob) < length:
                     torn = True
                     break  # torn tail write — discard
-                index, msg_type, payload = pickle.loads(blob)
+                try:
+                    index, msg_type, payload = _decode_entry(blob)
+                except Exception:
+                    # Length-valid but undecodable (garbage flush, or a
+                    # pre-msgpack-format record): corrupt tail — truncate
+                    # so appends follow the last good record.
+                    torn = True
+                    break
                 good_offset = fh.tell()
                 if index <= snap_idx:
                     continue
@@ -262,8 +293,7 @@ class FileLog(RaftLog):
     # -- persistence -------------------------------------------------------
 
     def _persist(self, index: int, msg_type: MessageType, payload: dict) -> None:
-        blob = pickle.dumps((index, int(msg_type), payload),
-                            protocol=pickle.HIGHEST_PROTOCOL)
+        blob = _encode_entry(index, msg_type, payload)
         if self._nwal is not None:
             # Durable on return; concurrent appends share one fsync.
             self._nwal.append(blob)

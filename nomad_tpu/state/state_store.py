@@ -19,13 +19,15 @@ sync boundary that ops/encode.py mirrors into device tensors.
 from __future__ import annotations
 
 import dataclasses
-import pickle
 import threading
 from collections import defaultdict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..structs import structs as s
+
+# Shared immutable empty result for index misses (never mutated).
+_EMPTY_SET: Set[str] = set()
 
 # Number of historical job versions retained (reference: structs.go
 # JobTrackedVersions = 6).
@@ -147,6 +149,12 @@ class StateStore:
         self._evals_by_job: Dict[str, Set[str]] = defaultdict(set)
         self._vault_by_alloc: Dict[str, Set[str]] = defaultdict(set)
         self._vault_by_node: Dict[str, Set[str]] = defaultdict(set)
+        # Slabs whose by-id table rows and per-node index cells have not
+        # been built yet (see _upsert_slabs_impl / _materialize_pending):
+        # bulk batch commits never read them in-batch, so the per-alloc
+        # indexing cost lands on the first reader that needs it.
+        self._pending_slabs: List[s.AllocSlab] = []
+        self._pending_by_job: Dict[str, List[s.AllocSlab]] = {}
 
     # -- snapshot ----------------------------------------------------------
 
@@ -180,28 +188,78 @@ class StateStore:
             snap._evals_by_job = defaultdict(set, self._evals_by_job)
             snap._vault_by_alloc = defaultdict(set, self._vault_by_alloc)
             snap._vault_by_node = defaultdict(set, self._vault_by_node)
+            # Pending slabs are immutable post-insert; each store drains
+            # its own copy of the list into its own dicts independently.
+            snap._pending_slabs = list(self._pending_slabs)
+            snap._pending_by_job = {k: list(v)
+                                    for k, v in self._pending_by_job.items()}
             return snap
 
     # -- immutable index-set updates ---------------------------------------
     #
-    # Index sets are never mutated in place: additions/removals build a
-    # replacement set.  Per-key sets are small (a node's or job's allocs),
-    # so the functional update is cheap, and it's what lets snapshot()
-    # share the index dicts shallowly.
+    # Index values are never mutated in place: additions/removals build a
+    # replacement value, which is what lets snapshot() share the index
+    # dicts shallowly.  A value is EITHER a canonical set OR a cons chain
+    # `(parent_value, item_or_items)` produced by the O(1) bulk-append
+    # path (_idx_append): the TPU batch scheduler commits hundreds of
+    # thousands of slab allocs per pass, and building a replacement set
+    # per touched node was the single largest host cost at bench scale.
+    # Readers go through _idx_get, which flattens a chain once and
+    # path-compresses it back into the reading store's dict (safe: the
+    # replacement has identical contents, and each store/snapshot owns
+    # its dict while sharing the immutable values).
 
     @staticmethod
-    def _idx_add(idx: Dict[str, Set[str]], key: str, item: str) -> None:
+    def _idx_get(idx: Dict[str, object], key: str) -> Set[str]:
         cur = idx.get(key)
-        idx[key] = {item} if cur is None else cur | {item}
+        if cur is None:
+            return _EMPTY_SET
+        if type(cur) is set:
+            return cur
+        out: Set[str] = set()
+        stack = [cur]
+        while stack:
+            v = stack.pop()
+            if v is None:
+                continue
+            if type(v) is set:
+                out |= v
+            else:  # cons cell (parent, item_or_items)
+                stack.append(v[0])
+                items = v[1]
+                if type(items) is str:
+                    out.add(items)
+                else:
+                    out.update(items)
+        idx[key] = out
+        return out
+
+    @classmethod
+    def _idx_add(cls, idx: Dict[str, object], key: str, item: str) -> None:
+        cur = cls._idx_get(idx, key)
+        idx[key] = {item} if not cur else cur | {item}
+
+    @classmethod
+    def _idx_update(cls, idx: Dict[str, object], key: str, items) -> None:
+        cur = cls._idx_get(idx, key)
+        idx[key] = set(items) if not cur else cur | set(items)
 
     @staticmethod
-    def _idx_update(idx: Dict[str, Set[str]], key: str, items) -> None:
+    def _idx_append(idx: Dict[str, object], key: str, items) -> None:
+        """O(1) bulk append: cons `items` (an id or a sequence of ids,
+        all NEW — never already present) onto the current value.  Always
+        a cons, even on a fresh key: `items` may be a lazy column
+        (structs._LazyStrs) whose strings must not materialize on the
+        commit path — flatten happens on first read (_idx_get)."""
         cur = idx.get(key)
-        idx[key] = set(items) if cur is None else cur | set(items)
+        if cur is None and type(items) is str:
+            idx[key] = {items}
+        else:
+            idx[key] = (cur, items)
 
-    @staticmethod
-    def _idx_discard(idx: Dict[str, Set[str]], key: str, item: str) -> None:
-        cur = idx.get(key)
+    @classmethod
+    def _idx_discard(cls, idx: Dict[str, object], key: str, item: str) -> None:
+        cur = cls._idx_get(idx, key)
         if cur and item in cur:
             idx[key] = cur - {item}
 
@@ -217,10 +275,38 @@ class StateStore:
     # time.  By-id reads materialize the full Allocation (and cache it
     # back); bulk reads enumerate each slab once.
 
+    def _materialize_pending(self) -> None:
+        """Flush deferred slab indexing (see _upsert_slabs_impl): build
+        the by-id table rows and per-node index cells for every pending
+        slab.  Lazy id columns are materialized once here and cached
+        back onto the slab (deterministic values — an independent drain
+        of a snapshot's copy produces equal strings)."""
+        pending = self._pending_slabs
+        if not pending:
+            return
+        self._pending_slabs = []
+        self._pending_by_job = {}
+        table = self.allocs_table
+        by_node = self._allocs_by_node
+        get = by_node.get
+        for slab in pending:
+            ids = slab.ids
+            if type(ids) is not list:
+                ids = list(ids)
+                slab.ids = ids
+            for nid, aid in zip(slab.node_ids, ids):
+                cur = get(nid)
+                by_node[nid] = {aid} if cur is None else (cur, aid)
+            for aid in ids:
+                table[aid] = slab
+
     def _get_alloc(self, alloc_id: str) -> Optional[s.Allocation]:
         """allocs_table read with slab materialization + cache-back.
         Caller holds the lock (or owns an immutable snapshot)."""
         v = self.allocs_table.get(alloc_id)
+        if v is None and self._pending_slabs:
+            self._materialize_pending()
+            v = self.allocs_table.get(alloc_id)
         if type(v) is s.AllocSlab:
             v = v.materialize(v.id_index(alloc_id))
             self.allocs_table[alloc_id] = v
@@ -534,7 +620,7 @@ class StateStore:
 
         # A successful eval cancels the job's blocked evals.
         if ev.status == s.EVAL_STATUS_COMPLETE and not ev.failed_tg_allocs:
-            for eid in list(self._evals_by_job.get(ev.job_id, ())):
+            for eid in list(self._idx_get(self._evals_by_job, ev.job_id)):
                 blocked = self.evals_table.get(eid)
                 if blocked is not None and blocked.status == s.EVAL_STATUS_BLOCKED:
                     cancelled = blocked.copy()
@@ -579,7 +665,7 @@ class StateStore:
         if ws is not None:
             ws.add(self, "evals")
         with self._lock:
-            return [self.evals_table[eid] for eid in self._evals_by_job.get(job_id, ())
+            return [self.evals_table[eid] for eid in self._idx_get(self._evals_by_job, job_id)
                     if eid in self.evals_table]
 
     def evals(self, ws: Optional[WatchSet] = None) -> List[s.Evaluation]:
@@ -664,6 +750,8 @@ class StateStore:
         self._notify()
 
     def _remove_alloc(self, alloc_id: str) -> None:
+        if self._pending_slabs:
+            self._materialize_pending()
         alloc = self.allocs_table.pop(alloc_id, None)
         if alloc is None:
             return
@@ -687,6 +775,8 @@ class StateStore:
         if ws is not None:
             ws.add(self, "allocs")
         with self._lock:
+            if self._pending_slabs:
+                self._materialize_pending()
             return [self._get_alloc(aid) for aid in list(self.allocs_table)
                     if aid.startswith(prefix)]
 
@@ -694,7 +784,9 @@ class StateStore:
         if ws is not None:
             ws.add(self, "allocs")
         with self._lock:
-            return [self._get_alloc(aid) for aid in self._allocs_by_node.get(node_id, ())
+            if self._pending_slabs:
+                self._materialize_pending()
+            return [self._get_alloc(aid) for aid in self._idx_get(self._allocs_by_node, node_id)
                     if aid in self.allocs_table]
 
     def allocs_by_node_terminal(
@@ -711,7 +803,9 @@ class StateStore:
         if ws is not None:
             ws.add(self, "allocs")
         with self._lock:
-            out = [self._get_alloc(aid) for aid in self._allocs_by_job.get(job_id, ())
+            if self._pending_slabs:
+                self._materialize_pending()
+            out = [self._get_alloc(aid) for aid in self._idx_get(self._allocs_by_job, job_id)
                    if aid in self.allocs_table]
             if all_allocs:
                 return out
@@ -725,13 +819,17 @@ class StateStore:
         if ws is not None:
             ws.add(self, "allocs")
         with self._lock:
-            return [self._get_alloc(aid) for aid in self._allocs_by_eval.get(eval_id, ())
+            if self._pending_slabs:
+                self._materialize_pending()
+            return [self._get_alloc(aid) for aid in self._idx_get(self._allocs_by_eval, eval_id)
                     if aid in self.allocs_table]
 
     def allocs(self, ws: Optional[WatchSet] = None) -> List[s.Allocation]:
         if ws is not None:
             ws.add(self, "allocs")
         with self._lock:
+            if self._pending_slabs:
+                self._materialize_pending()
             return [self._get_alloc(aid) for aid in list(self.allocs_table)]
 
     # -- non-materializing row reads (batch encode path) -------------------
@@ -750,6 +848,12 @@ class StateStore:
             ws.add(self, "allocs")
         with self._lock:
             out = []
+            # Pending slabs (deferred indexing) have no replaced/removed
+            # entries yet — emit their rows directly, no drain needed.
+            for slab in self._pending_slabs:
+                proto = slab.proto
+                for nid in slab.node_ids:
+                    out.append((nid, proto))
             seen_slabs = set()
             table = self.allocs_table
             for aid, v in table.items():
@@ -775,7 +879,11 @@ class StateStore:
             ws.add(self, "allocs")
         with self._lock:
             out = []
-            for aid in self._allocs_by_job.get(job_id, ()):
+            for slab in self._pending_by_job.get(job_id, ()):
+                proto = slab.proto
+                for nid in slab.node_ids:
+                    out.append((nid, proto))
+            for aid in self._idx_get(self._allocs_by_job, job_id):
                 v = self.allocs_table.get(aid)
                 if v is None:
                     continue
@@ -906,14 +1014,14 @@ class StateStore:
         if ws is not None:
             ws.add(self, "vault_accessors")
         with self._lock:
-            return [self.vault_accessors_table[a] for a in self._vault_by_alloc.get(alloc_id, ())
+            return [self.vault_accessors_table[a] for a in self._idx_get(self._vault_by_alloc, alloc_id)
                     if a in self.vault_accessors_table]
 
     def vault_accessors_by_node(self, ws: Optional[WatchSet], node_id: str) -> List[VaultAccessor]:
         if ws is not None:
             ws.add(self, "vault_accessors")
         with self._lock:
-            return [self.vault_accessors_table[a] for a in self._vault_by_node.get(node_id, ())
+            return [self.vault_accessors_table[a] for a in self._idx_get(self._vault_by_node, node_id)
                     if a in self.vault_accessors_table]
 
     # -- plan application --------------------------------------------------
@@ -967,17 +1075,15 @@ class StateStore:
             slab.create_index = index
             slab.modify_index = index
             proto = slab.proto
-            self._idx_update(self._allocs_by_job, proto.job_id, ids)
-            self._idx_update(self._allocs_by_eval, proto.eval_id, ids)
-            by_node = self._allocs_by_node
-            added: Dict[str, List[str]] = {}
-            for nid, aid in zip(slab.node_ids, ids):
-                added.setdefault(nid, []).append(aid)
-            for nid, aids in added.items():
-                self._idx_update(by_node, nid, aids)
-            table = self.allocs_table
-            for aid in ids:
-                table[aid] = slab
+            self._idx_append(self._allocs_by_job, proto.job_id, ids)
+            self._idx_append(self._allocs_by_eval, proto.eval_id, ids)
+            # The per-alloc work — by-id table rows and per-node index
+            # cells — is DEFERRED to the first reader that needs it
+            # (_materialize_pending): bulk batch commits never query
+            # their own slabs in-batch, and this loop was the single
+            # largest host cost of the whole scheduling pass at 1M asks.
+            self._pending_slabs.append(slab)
+            self._pending_by_job.setdefault(proto.job_id, []).append(slab)
             self._update_summary_bulk(index, proto, len(ids))
             if proto.job is not None:
                 forced = ("" if proto.terminal_status()
@@ -1053,7 +1159,11 @@ class StateStore:
     def _get_job_status(self, job: s.Job, eval_delete: bool) -> str:
         """(state_store.go:2092)."""
         has_alloc = False
-        for aid in self._allocs_by_job.get(job.id, ()):
+        for slab in self._pending_by_job.get(job.id, ()):
+            has_alloc = True
+            if not slab.proto.terminal_status():
+                return s.JOB_STATUS_RUNNING
+        for aid in self._idx_get(self._allocs_by_job, job.id):
             alloc = self.allocs_table.get(aid)
             if alloc is None:
                 continue
@@ -1067,7 +1177,7 @@ class StateStore:
                 return s.JOB_STATUS_RUNNING
 
         has_eval = False
-        for eid in self._evals_by_job.get(job.id, ()):
+        for eid in self._idx_get(self._evals_by_job, job.id):
             ev = self.evals_table.get(eid)
             if ev is None:
                 continue
@@ -1148,12 +1258,14 @@ class StateStore:
     def reconcile_job_summaries(self, index: int) -> None:
         """Rebuild all summaries from allocs (state_store.go:1883)."""
         with self._lock:
+            if self._pending_slabs:
+                self._materialize_pending()
             for job in list(self.jobs_table.values()):
                 summary = s.JobSummary(job_id=job.id, create_index=job.create_index,
                                        modify_index=index)
                 for tg in job.task_groups:
                     summary.summary[tg.name] = s.TaskGroupSummary()
-                for aid in self._allocs_by_job.get(job.id, ()):
+                for aid in self._idx_get(self._allocs_by_job, job.id):
                     alloc = self.allocs_table.get(aid)
                     if type(alloc) is s.AllocSlab:
                         alloc = alloc.proto
@@ -1180,32 +1292,60 @@ class StateStore:
     def persist(self) -> bytes:
         """Serialize all tables for an FSM snapshot (fsm.go:568 Snapshot)."""
         with self._lock:
+            if self._pending_slabs:
+                self._materialize_pending()
+            # Slab entries are materialized for the snapshot blob ONLY
+            # (no cache-back): the blob format stays plain Allocation
+            # rows (fsm.go:568) while the live table keeps its compact
+            # columnar form.  Embedded job trees are deduplicated by
+            # object identity into one shared list — pickle's memo table
+            # used to encode each shared proto.job once, but the msgpack
+            # codec walks values independently, so a 100k-alloc store
+            # would otherwise re-encode the multi-KB Job tree per alloc.
+            alloc_jobs: List[s.Job] = []
+            job_ref_by_identity: Dict[int, int] = {}
+            allocs_out: Dict[str, s.Allocation] = {}
+            alloc_job_refs: Dict[str, int] = {}
+            for aid, v in self.allocs_table.items():
+                a = (v.materialize(v.id_index(aid))
+                     if type(v) is s.AllocSlab else v)
+                if a.job is not None:
+                    ref = job_ref_by_identity.get(id(a.job))
+                    if ref is None:
+                        ref = job_ref_by_identity[id(a.job)] = len(alloc_jobs)
+                        alloc_jobs.append(a.job)
+                    a = s._fast_copy(a)
+                    a.job = None
+                    alloc_job_refs[aid] = ref
+                allocs_out[aid] = a
             payload = {
                 "nodes": self.nodes_table,
                 "jobs": self.jobs_table,
                 "job_versions": self.job_versions,
                 "job_summary": self.job_summary_table,
                 "evals": self.evals_table,
-                # Slab entries are materialized for the snapshot blob ONLY
-                # (no cache-back): the blob format stays plain Allocation
-                # rows (fsm.go:568) while the live table keeps its compact
-                # columnar form.
-                "allocs": {
-                    aid: (v.materialize(v.id_index(aid))
-                          if type(v) is s.AllocSlab else v)
-                    for aid, v in self.allocs_table.items()},
+                "allocs": allocs_out,
+                "alloc_jobs": alloc_jobs,
+                "alloc_job_refs": alloc_job_refs,
                 "periodic_launch": self.periodic_launch_table,
                 "vault_accessors": self.vault_accessors_table,
                 "deployments": self.deployments_table,
                 "indexes": self._indexes,
             }
-            return pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+            # Whitelisted msgpack trees (server/log_codec), never pickle:
+            # a corrupt or attacker-written snapshot file can only inject
+            # data types from the structs whitelist, not code.
+            from ..server.log_codec import encode_payload
+
+            return encode_payload(payload)
 
     @classmethod
     def restore(cls, blob: bytes) -> "StateStore":
         """Rebuild a store (and its secondary indexes) from a snapshot
         (fsm.go:582 Restore)."""
-        payload = pickle.loads(blob)
+        from ..server.log_codec import decode_payload
+
+        payload = decode_payload(blob)
         store = cls()
         store.nodes_table = payload["nodes"]
         store.jobs_table = payload["jobs"]
@@ -1213,6 +1353,13 @@ class StateStore:
         store.job_summary_table = payload["job_summary"]
         store.evals_table = payload["evals"]
         store.allocs_table = payload["allocs"]
+        # Re-attach the deduplicated job trees (shared objects restored
+        # as shared objects — one Job instance per ref).
+        alloc_jobs = payload.get("alloc_jobs", [])
+        for aid, ref in payload.get("alloc_job_refs", {}).items():
+            alloc = store.allocs_table.get(aid)
+            if alloc is not None and 0 <= ref < len(alloc_jobs):
+                alloc.job = alloc_jobs[ref]
         store.periodic_launch_table = payload["periodic_launch"]
         store.vault_accessors_table = payload["vault_accessors"]
         store.deployments_table = payload.get("deployments", {})

@@ -1158,6 +1158,74 @@ class DeploymentStatusUpdate:
     status_description: str = ""
 
 
+class _LazyStrs:
+    """A lazily-generated string column for AllocSlab: values are
+    formulaic (prefix + ordinal) and materialized only when read.  The
+    batch scheduler commits hundreds of thousands of slab allocs per
+    pass; generating every id/name string eagerly was a measurable slice
+    of the plan-materialization hot path, and most are never read
+    individually.  ``__lazy_strs__`` marks instances for the wire codec
+    (api/codec.to_wire), which materializes them to plain lists."""
+
+    __lazy_strs__ = True
+    __slots__ = ("n",)
+
+    def __init__(self, n: int) -> None:
+        self.n = n
+
+    def _make(self, i: int) -> str:
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        return self.n
+
+    def __bool__(self) -> bool:
+        return self.n > 0
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self._make(j) for j in range(*i.indices(self.n))]
+        if i < 0:
+            i += self.n
+        if not 0 <= i < self.n:
+            raise IndexError(i)
+        return self._make(i)
+
+    def __iter__(self):
+        make = self._make
+        return (make(i) for i in range(self.n))
+
+
+class LazyUuids(_LazyStrs):
+    """Formulaic uuid column: one random uuid prefix (first 24 chars,
+    8-4-4-4- groups) + the ordinal as the final 12 hex digits — still
+    canonical 36-char uuid form, unique across slabs by the ~76 random
+    prefix bits."""
+
+    __slots__ = ("prefix",)
+
+    def __init__(self, n: int, prefix: Optional[str] = None) -> None:
+        super().__init__(n)
+        self.prefix = prefix if prefix is not None else generate_uuid()[:24]
+
+    def _make(self, i: int) -> str:
+        return f"{self.prefix}{i:012x}"
+
+
+class LazyNames(_LazyStrs):
+    """Formulaic alloc names '<job>.<tg>[i]' (reference
+    structs.go AllocName / scheduler/util.go:22)."""
+
+    __slots__ = ("prefix",)
+
+    def __init__(self, n: int, prefix: str) -> None:
+        super().__init__(n)
+        self.prefix = prefix
+
+    def _make(self, i: int) -> str:
+        return f"{self.prefix}[{i}]"
+
+
 @dataclass
 class AllocSlab:
     """Columnar batch of placements sharing one prototype allocation.
