@@ -114,7 +114,14 @@ def mean_binpack_score(h) -> float:
             continue
         cpu, mem = used.get(nid, (0, 0))
         res = row.resources
-        used[nid] = (cpu + res.cpu, mem + res.memory_mb)
+        if res is None:
+            # Oracle-path allocs carry per-task resources only (the
+            # combined total is normally filled at plan apply).
+            r_cpu = sum(t.cpu for t in row.task_resources.values())
+            r_mem = sum(t.memory_mb for t in row.task_resources.values())
+        else:
+            r_cpu, r_mem = res.cpu, res.memory_mb
+        used[nid] = (cpu + r_cpu, mem + r_mem)
     if not used:
         return 0.0
     total = 0.0

@@ -696,7 +696,13 @@ class TPUBatchScheduler:
                        tuple((c.ltarget, c.operand, c.rtarget)
                              for c in sp.constraints),
                        tuple(sorted(sp.drivers)), bool(sp.distinct_hosts),
-                       sp.dp_target, int(feas_count[u]), n_unplaced)
+                       sp.dp_target, int(feas_count[u]), n_unplaced,
+                       # Network shape: _net_exhaust_dim's attribution
+                       # depends on all of these, so specs that fail for
+                       # different network reasons must not share a metric.
+                       bool(sp.net_active), int(sp.net_mbits),
+                       int(sp.dyn_count), int(sp.resv_in_dyn),
+                       tuple(sp.resv_ports))
                 cached = fail_cache.get(sig)
                 if cached is not None:
                     metrics[key] = cached.copy()

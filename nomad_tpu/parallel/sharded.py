@@ -23,7 +23,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.kernels import PlacementResult, _score_fit
+from ..ops.kernels import DPTensors, NetTensors, PlacementResult, _score_fit
+from ..ops.encode import MISSING
 
 NEG_INF = -1e30
 
@@ -119,10 +120,13 @@ def sharded_placement_rounds(
     rng_key: jax.Array,
     k_cand: int = 64,
     max_rounds: int = 256,
+    net: NetTensors = None,
+    dp: DPTensors = None,
 ) -> PlacementResult:
     """The single-chip `placement_rounds` semantics, node-sharded over the
-    mesh: anti-affinity collisions, distinct_hosts, per-(job,node) counts
-    and the multi-round capacity-feedback loop all run on sharded state.
+    mesh: anti-affinity collisions, distinct_hosts, per-(job,node) counts,
+    network port/bandwidth accounting, distinct_property and the
+    multi-round capacity-feedback loop all run on sharded state.
 
     Per spec, each shard scores its node shard (binpack − penalty·collisions
     + the same jitter the single-chip kernel uses), takes a local top-k_cand,
@@ -135,6 +139,14 @@ def sharded_placement_rounds(
     stable sorts.  Specs needing more than k_cand·D per round under-commit
     that round and finish in later rounds (progress loop).
 
+    ``net`` shards its per-node state (bw_cap/bw_used/dyn_free/port_words)
+    over the mesh and replicates the per-spec asks — feasibility and
+    commits are shard-local, mirroring ops/kernels.py (rank.go:190-238).
+    ``dp`` replicates the per-spec used-value bitsets; the within-round
+    best-per-value dedup runs as pmax/pmin all-reduces over the value
+    axis so every shard keeps the same winner the single-chip
+    scatter-max/min picks (propertyset.go:150).
+
     Ref: scheduler/rank.go:247 (anti-affinity), feasible.go:148
     (distinct_hosts), SURVEY.md §2.9 node-axis sharding.
     """
@@ -143,6 +155,27 @@ def sharded_placement_rounds(
     assert n_pad % d == 0, (
         f"mesh size {d} must divide node axis {n_pad} (pad N up)")
     k_cand = min(k_cand, n_pad // d)
+    use_net = net is not None
+    use_dp = dp is not None
+    if net is None:
+        net = NetTensors(
+            active=jnp.zeros(1, dtype=bool),
+            mbits=jnp.zeros(1, dtype=jnp.int32),
+            dyn_need=jnp.zeros(1, dtype=jnp.int32),
+            resv_words=jnp.zeros((1, 1), dtype=jnp.uint32),
+            bw_cap=jnp.zeros(n_pad, dtype=jnp.int32),
+            bw_used=jnp.zeros(n_pad, dtype=jnp.int32),
+            dyn_free=jnp.zeros(n_pad, dtype=jnp.int32),
+            port_words=jnp.zeros((n_pad, 1), dtype=jnp.uint32),
+        )
+    if dp is None:
+        dp = DPTensors(
+            col=jnp.full(1, -1, dtype=jnp.int32),
+            active=jnp.zeros(1, dtype=bool),
+            used0=jnp.zeros((1, 1), dtype=bool),
+            attr_values=jnp.full((n_pad, 1), MISSING, dtype=jnp.int32),
+        )
+    v_pad = dp.used0.shape[1]
 
     # Identical jitter to the single-chip kernel (same key, same shape) so
     # placements are bit-compatible; sharded on N by the in_spec.
@@ -153,22 +186,48 @@ def sharded_placement_rounds(
         mesh=mesh,
         in_specs=(P(None, NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS),
                   P(NODE_AXIS), P(None), P(None), P(None), P(None),
-                  P(None), P(None, NODE_AXIS), P(None, NODE_AXIS)),
+                  P(None), P(None, NODE_AXIS), P(None, NODE_AXIS),
+                  # net: per-spec replicated, per-node sharded
+                  P(None), P(None), P(None), P(None),
+                  P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS),
+                  # dp: per-spec replicated, node attrs sharded
+                  P(None), P(None), P(None), P(NODE_AXIS)),
         out_specs=(P(None, NODE_AXIS), P(None), P(NODE_AXIS), P()),
     )
     def _run(feas_l, used_l, cap_l, denom_l, ask_r, count_r, penalty_r,
-             dh_r, job_index_r, jc_l, jitter_l):
+             dh_r, job_index_r, jc_l, jitter_l,
+             net_active_r, net_mbits_r, dyn_need_r, resv_words_r,
+             bw_cap_l, bw_used_l0, dyn_free_l0, port_words_l0,
+             dp_col_r, dp_active_r, dp_used0_r, dp_attr_l):
         n_l = used_l.shape[0]
         shard = lax.axis_index(NODE_AXIS)
         c_total = k_cand * d
+        big_idx = jnp.int32(n_pad + 1)
+        gidx = shard * n_l + jnp.arange(n_l, dtype=jnp.int32)
 
         def place_one_spec(carry, u):
-            used, jc, remaining, placements = carry
+            (used, jc, remaining, placements,
+             bw_used, port_words, dyn_free, dp_used) = carry
             cap_left = cap_l - used
             fits = jnp.all(ask_r[u][None, :] <= cap_left, axis=1)
             collisions = jc[job_index_r[u]]            # [N_l] int32
             ok = feas_l[u] & fits
             ok = ok & jnp.where(dh_r[u], collisions == 0, True)
+
+            if use_net:
+                bw_ok = bw_used + net_mbits_r[u] <= bw_cap_l
+                resv_hit = jnp.any(
+                    (port_words & resv_words_r[u][None, :]) != 0, axis=1)
+                dyn_ok = dyn_free >= dyn_need_r[u]
+                ok = ok & jnp.where(net_active_r[u],
+                                    bw_ok & ~resv_hit & dyn_ok, True)
+
+            if use_dp:
+                col = jnp.clip(dp_col_r[u], 0, dp_attr_l.shape[1] - 1)
+                codes = dp_attr_l[:, col]              # [N_l]
+                code_c = jnp.clip(codes, 0, v_pad - 1)
+                dp_ok = (codes != MISSING) & ~dp_used[u, code_c]
+                ok = ok & jnp.where(dp_active_r[u], dp_ok, True)
 
             score = _score_fit(used, ask_r[u], denom_l)
             score = score - penalty_r[u] * collisions.astype(jnp.float32)
@@ -190,24 +249,66 @@ def sharded_placement_rounds(
             my_sel = lax.dynamic_slice(sel_cand, (shard * k_cand,), (k_cand,))
             sel = jnp.zeros(n_l, dtype=bool).at[loc_idx].set(my_sel) & ok
 
+            if use_dp:
+                # Cross-shard within-round value dedup: the best-scored
+                # selected node per property value wins globally (ties by
+                # lowest GLOBAL node index), via pmax/pmin over the value
+                # axis — bit-identical to the single-chip scatter-max/min.
+                sel_score = jnp.where(sel, scored, jnp.float32(NEG_INF))
+                best_l = jnp.full(v_pad, NEG_INF, dtype=jnp.float32
+                                  ).at[code_c].max(sel_score)
+                best_g = lax.pmax(best_l, NODE_AXIS)
+                cand_dp = sel & (sel_score >= best_g[code_c])
+                idx_l = jnp.full(v_pad, big_idx, dtype=jnp.int32
+                                 ).at[code_c].min(
+                    jnp.where(cand_dp, gidx, big_idx))
+                idx_g = lax.pmin(idx_l, NODE_AXIS)
+                keep = cand_dp & (gidx == idx_g[code_c])
+                sel = jnp.where(dp_active_r[u], keep, sel)
+
             sel_i = sel.astype(jnp.int32)
             used = used + sel_i[:, None] * ask_r[u][None, :]
             jc = jc.at[job_index_r[u]].add(sel_i)
             placements = placements.at[u].add(sel_i)
             placed = lax.psum(jnp.sum(sel_i), NODE_AXIS)
             remaining = remaining.at[u].add(-placed)
-            return (used, jc, remaining, placements), placed
+
+            if use_net:
+                commit_net = net_active_r[u]
+                bw_used = bw_used + jnp.where(commit_net,
+                                              sel_i * net_mbits_r[u], 0)
+                port_words = jnp.where(
+                    (commit_net & sel)[:, None],
+                    port_words | resv_words_r[u][None, :], port_words)
+                dyn_free = dyn_free - jnp.where(
+                    commit_net, sel_i * dyn_need_r[u], 0)
+            if use_dp:
+                dp_upd_l = jnp.zeros(v_pad, dtype=bool).at[code_c].max(
+                    sel & dp_active_r[u])
+                dp_upd = lax.psum(
+                    dp_upd_l.astype(jnp.int32), NODE_AXIS) > 0
+                dp_used = dp_used.at[u].set(dp_used[u] | dp_upd)
+
+            return (used, jc, remaining, placements,
+                    bw_used, port_words, dyn_free, dp_used), placed
 
         def round_body(state):
-            used, jc, remaining, placements, _, rounds = state
-            (used, jc, remaining, placements), placed = lax.scan(
-                place_one_spec, (used, jc, remaining, placements),
+            (used, jc, remaining, placements, bw_used, port_words,
+             dyn_free, dp_used, _, rounds) = state
+            carry, placed = lax.scan(
+                place_one_spec,
+                (used, jc, remaining, placements, bw_used, port_words,
+                 dyn_free, dp_used),
                 jnp.arange(u_pad))
-            return (used, jc, remaining, placements,
-                    jnp.sum(placed), rounds + 1)
+            (used, jc, remaining, placements, bw_used, port_words,
+             dyn_free, dp_used) = carry
+            return (used, jc, remaining, placements, bw_used, port_words,
+                    dyn_free, dp_used, jnp.sum(placed), rounds + 1)
 
         def round_cond(state):
-            _, _, remaining, _, progress, rounds = state
+            remaining = state[2]
+            progress = state[8]
+            rounds = state[9]
             return ((progress > 0) & (jnp.sum(remaining) > 0)
                     & (rounds < max_rounds))
 
@@ -215,14 +316,18 @@ def sharded_placement_rounds(
             jnp.zeros((u_pad, n_l), dtype=jnp.int32),
             (NODE_AXIS,), to="varying")
         state = (used_l, jc_l, count_r, placements0,
+                 bw_used_l0, port_words_l0, dyn_free_l0, dp_used0_r,
                  jnp.array(1, dtype=jnp.int32), jnp.array(0, dtype=jnp.int32))
-        used, jc, remaining, placements, _, rounds = lax.while_loop(
-            round_cond, round_body, state)
+        (used, jc, remaining, placements, _bw, _pw, _df, _dpu, _,
+         rounds) = lax.while_loop(round_cond, round_body, state)
         return placements, remaining, used, rounds
 
     placements, unplaced, used_after, rounds = _run(
         feas, used0, capacity, denom, ask, count, penalty, distinct_hosts,
-        job_index, job_counts0, jitter)
+        job_index, job_counts0, jitter,
+        net.active, net.mbits, net.dyn_need, net.resv_words,
+        net.bw_cap, net.bw_used, net.dyn_free, net.port_words,
+        dp.col, dp.active, dp.used0, dp.attr_values)
     return PlacementResult(
         placements=placements, unplaced=unplaced,
         used_after=used_after, rounds=rounds)

@@ -186,14 +186,25 @@ class FileLog(RaftLog):
             # Native log replay (CRC + torn-tail handling done at open).
             # A CRC-valid record that still fails to decode (garbage or a
             # pre-msgpack-format file) ends replay at the last good entry
-            # rather than crashing recovery.
+            # — and the log is REWRITTEN to that good prefix, so entries
+            # appended after this boot land after valid records and stay
+            # recoverable (leaving the bad record in place would strand
+            # every later append behind it on the next replay).
+            good_blobs = []
+            bad = False
             for blob in self._nwal.records():
                 try:
                     index, msg_type, payload = _decode_entry(blob)
                 except Exception:
+                    bad = True
                     break
+                good_blobs.append(blob)
                 if index > snap_idx:
                     entries.append((index, msg_type, payload))
+            if bad:
+                self._nwal.reset()
+                for blob in good_blobs:
+                    self._nwal.append(blob)
         else:
             # Native unavailable on THIS boot but a wal.crc exists from a
             # previous one: replay it through the pure-Python CRC reader —

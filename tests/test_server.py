@@ -373,6 +373,57 @@ class TestWALTornTail:
         finally:
             srv3.raft.close()
 
+    def test_undecodable_native_record_truncated_then_appended(
+            self, tmp_path):
+        """A CRC-valid but undecodable record (garbage flush, or a
+        pre-msgpack-format file) must end replay at the good prefix AND
+        rewrite the native log to it, so post-recovery appends stay
+        reachable on the next replay (raft.py FileLog._recover)."""
+        import struct
+        import zlib
+
+        data_dir = str(tmp_path / "raft")
+        srv = Server(ServerConfig(data_dir=data_dir))
+        srv.start()
+        try:
+            srv.node_register(make_node())
+            applied = srv.raft.applied_index()
+            native = srv.raft._nwal is not None
+        finally:
+            srv.shutdown()
+
+        # Append a CRC-valid record whose payload is not a msgpack entry
+        # to whichever log is in use.
+        garbage = b"\x93not-an-entry"
+        crc_path = os.path.join(data_dir, "wal.crc")
+        if native or os.path.exists(crc_path):
+            with open(crc_path, "ab") as f:
+                f.write(struct.pack("<II", len(garbage),
+                                    zlib.crc32(garbage) & 0xFFFFFFFF))
+                f.write(garbage)
+        else:
+            with open(os.path.join(data_dir, "wal.log"), "ab") as f:
+                f.write(struct.pack("<Q", len(garbage)))
+                f.write(garbage)
+
+        srv2 = Server(ServerConfig(data_dir=data_dir))
+        try:
+            assert srv2.raft.applied_index() == applied
+            job = make_job(1)
+            srv2.job_register(job)
+            applied2 = srv2.raft.applied_index()
+            assert applied2 > applied
+        finally:
+            srv2.raft.close()
+
+        srv3 = Server(ServerConfig(data_dir=data_dir))
+        try:
+            assert srv3.raft.applied_index() == applied2
+            assert srv3.state.job_by_id(None, job.id) is not None
+            assert len(srv3.state.nodes(None)) == 1
+        finally:
+            srv3.raft.close()
+
 
 class TestPeriodicReAdd:
     def test_re_add_does_not_duplicate_chain(self):
