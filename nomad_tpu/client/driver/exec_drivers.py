@@ -32,7 +32,14 @@ from .driver import (
     opt,
     register_driver,
 )
-from .executor import AttachedExecutor, ExecCommand, Executor, attach
+from .executor import (
+    AttachedExecutor,
+    ExecCommand,
+    Executor,
+    SupervisedExecutor,
+    attach,
+    attach_supervised,
+)
 
 
 class ExecutorHandle(DriverHandle):
@@ -44,6 +51,9 @@ class ExecutorHandle(DriverHandle):
         self.kill_timeout = kill_timeout or 5.0
 
     def id(self) -> str:
+        ctl = getattr(self.executor, "ctl_dir", None)
+        if ctl:
+            return f"sup:{ctl}"
         return f"pid:{self.executor.pid}"
 
     def wait_ch(self) -> threading.Event:
@@ -127,7 +137,11 @@ class _ExecFamilyDriver(Driver):
             use_cgroups=self.use_cgroups,
             cgroup_name=f"{self.ctx.alloc_id[:8]}-{task.name}",
         )
-        executor = Executor(exec_cmd)
+        # Every exec-family task runs under a detached supervisor
+        # subprocess (driver/supervisor.py ≙ executor_plugin.go): the
+        # agent can restart and re-attach with the real exit status.
+        ctl_dir = os.path.join(td.dir, f".{task.name}.executor")
+        executor = SupervisedExecutor(exec_cmd, ctl_dir)
         try:
             executor.launch()
         except OSError as e:
@@ -136,6 +150,11 @@ class _ExecFamilyDriver(Driver):
             handle=ExecutorHandle(executor, task.name, task.kill_timeout))
 
     def open(self, exec_ctx: ExecContext, handle_id: str) -> DriverHandle:
+        if handle_id.startswith("sup:"):
+            ex = attach_supervised(handle_id.split(":", 1)[1])
+            if ex is None:
+                raise DriverError(f"supervised task gone: {handle_id!r}")
+            return ExecutorHandle(ex, "reattached", 5.0)
         if not handle_id.startswith("pid:"):
             raise DriverError(f"bad handle id {handle_id!r}")
         pid = int(handle_id.split(":", 1)[1])

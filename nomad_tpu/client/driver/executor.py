@@ -263,6 +263,178 @@ class Executor:
             return {"pid": self.pid}
 
 
+class SupervisedExecutor(Executor):
+    """Runs the task under a DETACHED supervisor subprocess
+    (driver/supervisor.py ≙ the reference's go-plugin executor,
+    client/driver/executor_plugin.go): the agent can die and restart and
+    the supervisor keeps running the task, serving control on a unix
+    socket and persisting the exit status to disk — so re-attach
+    re-collects the real exit code, not a best-effort guess."""
+
+    def __init__(self, command: ExecCommand, ctl_dir: str):
+        super().__init__(command)
+        self.ctl_dir = ctl_dir
+        self.supervisor_pid = 0
+
+    def launch(self) -> int:
+        import json
+        import sys
+
+        from . import supervisor as sup
+
+        os.makedirs(self.ctl_dir, exist_ok=True)
+        with open(os.path.join(self.ctl_dir, "command.json"), "w") as fh:
+            json.dump(self.command.__dict__, fh)
+        # The supervisor needs the package importable regardless of the
+        # agent's own cwd.
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = pkg_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "nomad_tpu.client.driver.supervisor",
+             self.ctl_dir],
+            env=env, stdin=subprocess.DEVNULL, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL, start_new_session=True)
+        self.supervisor_pid = proc.pid
+        # Wait for the task pid (or an immediate launch failure).
+        pid_path = os.path.join(self.ctl_dir, "task.pid")
+        deadline = time.time() + 15.0
+        while time.time() < deadline:
+            if os.path.exists(pid_path):
+                with open(pid_path) as fh:
+                    self.pid = json.load(fh)["pid"]
+                break
+            if os.path.exists(sup.exit_path(self.ctl_dir)):
+                break  # launch failed; watcher delivers the error result
+            if proc.poll() is not None and not os.path.exists(pid_path):
+                raise OSError(
+                    f"supervisor exited rc={proc.returncode} before launch")
+            time.sleep(0.02)
+        else:
+            raise OSError("timed out waiting for supervised task launch")
+        self.start_time = time.time()
+        threading.Thread(target=self._watch, daemon=True).start()
+        return self.pid
+
+    # -- result collection -------------------------------------------------
+
+    def _watch(self) -> None:
+        """Block on the supervisor's wait op; fall back to polling
+        exit.json if the socket goes away (supervisor reaped after
+        persisting the status)."""
+        import json
+
+        from . import supervisor as sup
+
+        try:
+            resp = sup.request(self.ctl_dir, {"op": "wait"}, timeout=None)
+            res = resp["result"]
+            self.result = WaitResult(exit_code=res["exit_code"],
+                                     signal=res["signal"])
+            self.exited.set()
+            return
+        except (OSError, KeyError, ValueError):
+            pass
+        while True:
+            ep = sup.exit_path(self.ctl_dir)
+            if os.path.exists(ep):
+                with open(ep) as fh:
+                    res = json.load(fh)
+                self.result = WaitResult(exit_code=res.get("exit_code", 0),
+                                         signal=res.get("signal", 0))
+                self.exited.set()
+                return
+            if self.pid:
+                try:
+                    os.kill(self.pid, 0)
+                except (ProcessLookupError, PermissionError):
+                    # Task gone AND no exit record: the supervisor died
+                    # before persisting — degrade like a pid re-attach.
+                    self.result = WaitResult(exit_code=0)
+                    self.exited.set()
+                    return
+            time.sleep(0.25)
+
+    # -- control (socket first, direct-signal fallback) --------------------
+
+    def shutdown(self, grace: float = 5.0) -> None:
+        from . import supervisor as sup
+
+        if self.result is not None:
+            return
+        try:
+            sup.request(self.ctl_dir, {"op": "shutdown", "grace": grace})
+            self.exited.wait(grace + 5.0)
+            return
+        except (OSError, ValueError):
+            pass
+        if self.pid:
+            try:
+                os.killpg(self.pid, signal.SIGINT)
+            except (ProcessLookupError, PermissionError, OSError):
+                return
+            if not self.exited.wait(grace):
+                try:
+                    os.killpg(self.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError, OSError):
+                    pass
+
+    def send_signal(self, sig: int) -> None:
+        from . import supervisor as sup
+
+        try:
+            sup.request(self.ctl_dir, {"op": "signal", "sig": sig})
+        except (OSError, ValueError):
+            if self.pid and self.result is None:
+                os.kill(self.pid, sig)
+
+    def stats(self) -> Dict:
+        from . import supervisor as sup
+
+        try:
+            return sup.request(self.ctl_dir, {"op": "stats"})["stats"]
+        except (OSError, KeyError, ValueError):
+            return super().stats()
+
+
+def attach_supervised(ctl_dir: str) -> Optional["SupervisedExecutor"]:
+    """Re-attach to a supervised task after agent restart: the exit
+    status persisted by the supervisor (exit.json) makes collection
+    exact even when the task finished while the agent was down."""
+    import json
+
+    from . import supervisor as sup
+
+    if not os.path.isdir(ctl_dir):
+        return None
+    ex = SupervisedExecutor(ExecCommand(cmd=""), ctl_dir)
+    pid_path = os.path.join(ctl_dir, "task.pid")
+    if os.path.exists(pid_path):
+        try:
+            with open(pid_path) as fh:
+                ex.pid = json.load(fh)["pid"]
+        except (OSError, ValueError, KeyError):
+            pass
+    ep = sup.exit_path(ctl_dir)
+    live = False
+    if not os.path.exists(ep):
+        try:
+            resp = sup.request(ctl_dir, {"op": "ping"}, timeout=2.0)
+            live = bool(resp.get("ok"))
+        except (OSError, ValueError):
+            live = False
+        if not live and ex.pid:
+            try:
+                os.kill(ex.pid, 0)
+            except (ProcessLookupError, PermissionError):
+                return None  # no record, no task: nothing to re-attach
+    ex.start_time = time.time()
+    threading.Thread(target=ex._watch, daemon=True).start()
+    return ex
+
+
 def attach(pid: int) -> Optional["AttachedExecutor"]:
     """Re-attach to a still-running task process after agent restart
     (reference: executor plugin re-connect, task_runner.go:279)."""
