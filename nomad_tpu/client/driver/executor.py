@@ -275,6 +275,7 @@ class SupervisedExecutor(Executor):
         super().__init__(command)
         self.ctl_dir = ctl_dir
         self.supervisor_pid = 0
+        self._sup_proc = None  # Popen when we spawned it (enables reaping)
 
     def launch(self) -> int:
         import json
@@ -298,6 +299,7 @@ class SupervisedExecutor(Executor):
             env=env, stdin=subprocess.DEVNULL, stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL, start_new_session=True)
         self.supervisor_pid = proc.pid
+        self._sup_proc = proc
         # Wait for the task pid (or an immediate launch failure).
         pid_path = os.path.join(self.ctl_dir, "task.pid")
         deadline = time.time() + 15.0
@@ -323,21 +325,32 @@ class SupervisedExecutor(Executor):
     def _watch(self) -> None:
         """Block on the supervisor's wait op; fall back to polling
         exit.json if the socket goes away (supervisor reaped after
-        persisting the status)."""
+        persisting the status).
+
+        The degraded guess (exit 0, no record) is a LAST resort: the task
+        pid looking dead does not mean the status is lost — the task is
+        the supervisor's child, so the pid only becomes signalable-dead
+        after the supervisor reaps it, at which point the supervisor is
+        about to persist exit.json (pump joins + fsync in between).
+        Degrading while the supervisor is still alive fabricates an exit 0
+        before logs are flushed (VERDICT r3 weak-3), so only give up once
+        the supervisor itself is gone AND a grace period for a straggling
+        exit.json write has passed."""
         import json
 
         from . import supervisor as sup
 
-        try:
-            resp = sup.request(self.ctl_dir, {"op": "wait"}, timeout=None)
-            res = resp["result"]
-            self.result = WaitResult(exit_code=res["exit_code"],
-                                     signal=res["signal"])
-            self.exited.set()
-            return
-        except (OSError, KeyError, ValueError):
-            pass
+        sup_gone_since = None
         while True:
+            try:
+                resp = sup.request(self.ctl_dir, {"op": "wait"}, timeout=None)
+                res = resp["result"]
+                self.result = WaitResult(exit_code=res["exit_code"],
+                                         signal=res["signal"])
+                self.exited.set()
+                return
+            except (OSError, KeyError, ValueError):
+                pass
             ep = sup.exit_path(self.ctl_dir)
             if os.path.exists(ep):
                 with open(ep) as fh:
@@ -346,16 +359,40 @@ class SupervisedExecutor(Executor):
                                          signal=res.get("signal", 0))
                 self.exited.set()
                 return
-            if self.pid:
-                try:
-                    os.kill(self.pid, 0)
-                except (ProcessLookupError, PermissionError):
-                    # Task gone AND no exit record: the supervisor died
-                    # before persisting — degrade like a pid re-attach.
-                    self.result = WaitResult(exit_code=0)
-                    self.exited.set()
-                    return
+            if self._supervisor_alive():
+                sup_gone_since = None
+            elif sup_gone_since is None:
+                sup_gone_since = time.monotonic()
+            elif time.monotonic() - sup_gone_since > 2.0:
+                # Supervisor dead >2s and still no exit record: the status
+                # really is lost — degrade like a pid re-attach.
+                self.result = WaitResult(exit_code=0)
+                self.exited.set()
+                return
             time.sleep(0.25)
+
+    def _supervisor_alive(self) -> bool:
+        import json
+
+        if self._sup_proc is not None:
+            # We spawned it: poll() both reaps a zombie (which os.kill
+            # would misreport as alive forever) and answers liveness.
+            return self._sup_proc.poll() is None
+        pid = self.supervisor_pid
+        if not pid:
+            try:
+                with open(os.path.join(self.ctl_dir,
+                                       "supervisor.pid")) as fh:
+                    pid = json.load(fh)["pid"]
+            except (OSError, ValueError, KeyError):
+                return False
+        try:
+            os.kill(pid, 0)
+            return True
+        except PermissionError:
+            return True
+        except OSError:
+            return False
 
     # -- control (socket first, direct-signal fallback) --------------------
 

@@ -57,14 +57,21 @@ class TestSupervisedExecutor:
         assert b"hello-from-task" in out
 
     def test_signal_and_stats_via_socket(self, tmp_path):
+        # The task signals handler-readiness through a marker file:
+        # interpreter startup is slow in this environment (site hook
+        # pre-imports jax), so signaling on rss>0 alone races the
+        # signal.signal() call and the default disposition kills the task.
+        ready = tmp_path / "ready"
         script = (
-            "import signal, sys, time\n"
+            "import pathlib, signal, sys, time\n"
             "signal.signal(signal.SIGUSR1, lambda *_: sys.exit(42))\n"
+            f"pathlib.Path({str(ready)!r}).write_text('x')\n"
             "time.sleep(60)\n")
         ex = SupervisedExecutor(_mk_cmd(tmp_path, script),
                                 str(tmp_path / "ctl"))
         ex.launch()
         assert _wait_until(lambda: ex.stats().get("rss_bytes", 0) > 0)
+        assert _wait_until(ready.exists)
         ex.send_signal(signal.SIGUSR1)
         assert ex.exited.wait(15.0)
         assert ex.result.exit_code == 42
