@@ -106,6 +106,7 @@ class HTTPServer:
         r("/v1/agent/servers", self.agent_servers_request)
         r("/v1/agent/join", self.agent_join_request)
         r("/v1/agent/force-leave", self.agent_force_leave_request)
+        r("/v1/agent/keyring/(?P<op>[^/]+)", self.agent_keyring_request)
         r("/v1/validate/job", self.validate_job_request)
         r("/v1/regions", self.regions_request)
         r("/v1/status/leader", self.status_leader_request)
@@ -774,6 +775,34 @@ class HTTPServer:
         except ValueError as e:
             return {"num_joined": 0, "error": str(e)}, None
         return {"num_joined": joined, "error": ""}, None
+
+    def agent_keyring_request(self, req, query, op=""):
+        """Gossip keyring management over HTTP
+        (command/agent/http.go:158 + agent_endpoint.go:166
+        KeyringOperationRequest): /v1/agent/keyring/{list,install,use,
+        remove}, mutations via PUT/POST with a {"Key": ...} body.
+        Server-only, like the reference (501 when no server)."""
+        from ..utils import keyring
+
+        if self.agent.server is None:
+            raise CodedError(501, "keyring requires a server agent")
+        data_dir = (getattr(self.agent.config, "data_dir", "") or
+                    getattr(self.agent.server.config, "data_dir", "") or ".")
+        if op == "list":
+            return keyring.key_response(data_dir), None
+        if op not in ("install", "use", "remove"):
+            raise CodedError(404, "resource not found")
+        if req.command not in ("PUT", "POST"):
+            raise CodedError(405, "Invalid method")
+        body = self._body(req) or {}
+        key = body.get("Key", "")
+        if not key:
+            raise CodedError(400, "missing key")
+        try:
+            getattr(keyring, op)(data_dir, key)
+        except keyring.KeyringError as e:
+            raise CodedError(400, str(e))
+        return keyring.key_response(data_dir), None
 
     def agent_force_leave_request(self, req, query):
         if req.command not in ("PUT", "POST"):

@@ -617,62 +617,30 @@ def cmd_keygen(args, out) -> int:
 
 def cmd_keyring(args, out) -> int:
     """command/keyring.go: manage the gossip keyring file
-    (<data_dir>/keyring.json).  Key install/list/use/remove semantics
-    mirror serf's keyring management; the wire encryption itself is a
-    transport concern (the reference's serf encrypt option)."""
-    import base64
+    (<data_dir>/keyring.json) through the shared utils/keyring helper —
+    the same logic backing the /v1/agent/keyring HTTP surface."""
+    from ..utils import keyring
 
     data_dir = args.data_dir or "."
-    path = os.path.join(data_dir, "keyring.json")
-    ring = {"Keys": [], "Primary": ""}
-    if os.path.exists(path):
-        with open(path) as fh:
-            ring = json.load(fh)
-
-    def save():
-        os.makedirs(data_dir, exist_ok=True)
-        with open(path, "w") as fh:
-            json.dump(ring, fh, indent=2)
-
     if args.list_keys:
+        ring = keyring.list_keys(data_dir)
         if not ring["Keys"]:
             out.write("Keyring is empty\n")
         for k in ring["Keys"]:
             marker = " (primary)" if k == ring["Primary"] else ""
             out.write(f"{k}{marker}\n")
         return 0
-    key = args.install or args.use or args.remove
-    if key:
+    if args.install or args.use or args.remove:
+        op, key, done = (
+            ("install", args.install, "Installed key\n") if args.install
+            else ("use", args.use, "Changed primary key\n") if args.use
+            else ("remove", args.remove, "Removed key\n"))
         try:
-            if len(base64.b64decode(key)) != 32:
-                raise ValueError
-        except Exception:
-            out.write("Error: key must be 32 bytes of base64\n")
+            getattr(keyring, op)(data_dir, key)
+        except keyring.KeyringError as e:
+            out.write(f"Error: {e}\n")
             return 1
-    if args.install:
-        if args.install not in ring["Keys"]:
-            ring["Keys"].append(args.install)
-        if not ring["Primary"]:
-            ring["Primary"] = args.install
-        save()
-        out.write("Installed key\n")
-        return 0
-    if args.use:
-        if args.use not in ring["Keys"]:
-            out.write("Error: key is not in the keyring\n")
-            return 1
-        ring["Primary"] = args.use
-        save()
-        out.write("Changed primary key\n")
-        return 0
-    if args.remove:
-        if args.remove == ring["Primary"]:
-            out.write("Error: cannot remove the primary key\n")
-            return 1
-        if args.remove in ring["Keys"]:
-            ring["Keys"].remove(args.remove)
-            save()
-        out.write("Removed key\n")
+        out.write(done)
         return 0
     out.write("Specify one of -install, -list, -use, -remove\n")
     return 1

@@ -1,0 +1,93 @@
+"""Gossip keyring file management (<data_dir>/keyring.json).
+
+Shared by the CLI keyring verb (cli/commands.py) and the agent HTTP
+surface (/v1/agent/keyring/<op>, command/agent/http.go:158 +
+agent_endpoint.go:166 KeyringOperationRequest).  Key semantics mirror
+serf's keyring management: install adds a key (first install becomes
+primary), use re-points the primary, remove refuses to drop the primary.
+Keys are 32 bytes of base64; the wire encryption itself is a transport
+concern (the reference's serf encrypt option).
+"""
+from __future__ import annotations
+
+import base64
+import json
+import os
+from typing import Dict, List
+
+
+class KeyringError(ValueError):
+    pass
+
+
+def keyring_path(data_dir: str) -> str:
+    return os.path.join(data_dir or ".", "keyring.json")
+
+
+def load(data_dir: str) -> Dict:
+    path = keyring_path(data_dir)
+    if os.path.exists(path):
+        with open(path) as fh:
+            return json.load(fh)
+    return {"Keys": [], "Primary": ""}
+
+
+def save(data_dir: str, ring: Dict) -> None:
+    os.makedirs(data_dir or ".", exist_ok=True)
+    with open(keyring_path(data_dir), "w") as fh:
+        json.dump(ring, fh, indent=2)
+
+
+def validate_key(key: str) -> None:
+    try:
+        if len(base64.b64decode(key)) != 32:
+            raise ValueError
+    except Exception:
+        raise KeyringError("key must be 32 bytes of base64") from None
+
+
+def list_keys(data_dir: str) -> Dict:
+    ring = load(data_dir)
+    return {"Keys": list(ring["Keys"]), "Primary": ring["Primary"]}
+
+
+def install(data_dir: str, key: str) -> None:
+    validate_key(key)
+    ring = load(data_dir)
+    if key not in ring["Keys"]:
+        ring["Keys"].append(key)
+    if not ring["Primary"]:
+        ring["Primary"] = key
+    save(data_dir, ring)
+
+
+def use(data_dir: str, key: str) -> None:
+    validate_key(key)
+    ring = load(data_dir)
+    if key not in ring["Keys"]:
+        raise KeyringError("key is not in the keyring")
+    ring["Primary"] = key
+    save(data_dir, ring)
+
+
+def remove(data_dir: str, key: str) -> None:
+    validate_key(key)
+    ring = load(data_dir)
+    if key == ring["Primary"]:
+        raise KeyringError("cannot remove the primary key")
+    if key in ring["Keys"]:
+        ring["Keys"].remove(key)
+        save(data_dir, ring)
+
+
+def key_response(data_dir: str) -> Dict:
+    """The serf.KeyResponse shape the reference endpoint returns
+    (agent_endpoint.go:205-215): per-key node counts — a single-process
+    keyring reports one node."""
+    ring = load(data_dir)
+    return {
+        "Messages": {},
+        "NumNodes": 1,
+        "Keys": {k: 1 for k in ring["Keys"]},
+        "PrimaryKeys": ({ring["Primary"]: 1} if ring["Primary"] else {}),
+    }

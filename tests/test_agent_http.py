@@ -274,6 +274,38 @@ class TestAgentSystemEndpoints:
         api.system.garbage_collect()
         api.system.reconcile_summaries()
 
+    def test_keyring_http(self, api, agent, tmp_path):
+        """/v1/agent/keyring/{list,install,use,remove}
+        (command/agent/http.go:158, agent_endpoint.go:166)."""
+        import base64
+
+        agent.config.data_dir = str(tmp_path)
+        k1 = base64.b64encode(bytes(range(32))).decode()
+        k2 = base64.b64encode(bytes(range(1, 33))).decode()
+        resp, _ = api._do("PUT", "/v1/agent/keyring/install", {"Key": k1})
+        assert resp["Keys"] == {k1: 1}
+        assert resp["PrimaryKeys"] == {k1: 1}
+        api._do("PUT", "/v1/agent/keyring/install", {"Key": k2})
+        resp, _ = api._do("GET", "/v1/agent/keyring/list")
+        assert set(resp["Keys"]) == {k1, k2}
+        # The primary key is protected from removal.
+        with pytest.raises(APIError) as ei:
+            api._do("PUT", "/v1/agent/keyring/remove", {"Key": k1})
+        assert ei.value.code == 400
+        api._do("PUT", "/v1/agent/keyring/use", {"Key": k2})
+        resp, _ = api._do("PUT", "/v1/agent/keyring/remove", {"Key": k1})
+        assert resp["Keys"] == {k2: 1}
+        assert resp["PrimaryKeys"] == {k2: 1}
+        with pytest.raises(APIError) as ei:
+            api._do("PUT", "/v1/agent/keyring/install", {"Key": "short"})
+        assert ei.value.code == 400
+        with pytest.raises(APIError) as ei:
+            api._do("GET", "/v1/agent/keyring/bogus")
+        assert ei.value.code == 404
+        with pytest.raises(APIError) as ei:
+            api._do("GET", "/v1/agent/keyring/install")
+        assert ei.value.code == 405
+
     def test_unknown_url_404(self, api):
         with pytest.raises(APIError) as ei:
             api._do("GET", "/v1/bogus")
