@@ -29,6 +29,7 @@ from .stats import HostStatsCollector, ServerList
 # Import for driver-registry side effects (BuiltinDrivers registration).
 from .driver import mock_driver as _mock_driver  # noqa: F401
 from .driver import exec_drivers as _exec_drivers  # noqa: F401
+from .driver import container_drivers as _container_drivers  # noqa: F401
 from .driver.driver import BUILTIN_DRIVERS, DriverContext, new_driver
 
 # Status-sync batching interval (client.go:76-78 allocSyncIntv = 200ms).

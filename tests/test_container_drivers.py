@@ -1,0 +1,277 @@
+"""rkt + lxc driver tests (reference: client/driver/rkt_test.go,
+lxc_test.go — config validation, command assembly, fingerprint gating,
+and a full start path against a stub binary)."""
+import os
+import stat
+import sys
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.client.allocdir import AllocDir
+from nomad_tpu.client.driver.container_drivers import (
+    LXC_ENABLE_OPTION,
+    LxcDriver,
+    RktDriver,
+)
+from nomad_tpu.client.driver.driver import (
+    DriverContext,
+    DriverError,
+    ExecContext,
+    validate_driver_config,
+)
+from nomad_tpu.client.driver.env import TaskEnv
+from nomad_tpu.structs import structs as s
+
+
+class FakeConfig:
+    def __init__(self, options=None):
+        self.options = options or {}
+
+
+def mk_ctx(name, options=None):
+    return DriverContext(driver_name=name, alloc_id="alloc12345",
+                         config=FakeConfig(options))
+
+
+def mk_exec_ctx(tmp_path, env=None):
+    ad = AllocDir(str(tmp_path / "alloc-dir"))
+    ad.build()
+    td = ad.new_task_dir("web")
+    td.build()
+    return ExecContext(task_dir=td, task_env=env or TaskEnv())
+
+
+def mk_task(driver, config):
+    task = s.Task(name="web", driver=driver, config=config,
+                  resources=s.Resources(cpu=500, memory_mb=256))
+    return task
+
+
+class TestRktDriver:
+    def test_validate_config(self):
+        validate_driver_config("rkt", {"image": "coreos.com/etcd:v2.0.4"})
+        with pytest.raises(ValueError):
+            validate_driver_config("rkt", {})
+        with pytest.raises(ValueError):
+            validate_driver_config("rkt", {"image": 123})
+
+    def test_command_line_full_surface(self, tmp_path):
+        """rkt.go:251-370: insecure default, task-dir mounts, net/dns,
+        port map, isolators, --exec and trailing args."""
+        d = RktDriver(mk_ctx("rkt"))
+        env = TaskEnv(env_map={"NOMAD_TASK_NAME": "web"})
+        ectx = mk_exec_ctx(tmp_path, env)
+        task = mk_task("rkt", {
+            "image": "example.com/app:1.0",
+            "command": "/bin/serve",
+            "args": ["--name", "${NOMAD_TASK_NAME}"],
+            "dns_servers": ["8.8.8.8"],
+            "dns_search_domains": ["example.com"],
+            "net": ["host"],
+            "port_map": {"http": "8080"},
+            "volumes": ["/host/data:/data"],
+            "no_overlay": True,
+            "debug": True,
+        })
+        cmd, args = d.command_line(ectx, task)
+        assert cmd == "rkt"
+        joined = " ".join(args)
+        # No trust prefix ⇒ verification off, exactly like rkt.go:270-279.
+        assert "--insecure-options=all" in joined
+        assert "--debug=true" in joined
+        assert "run" in args
+        assert "--no-overlay=true" in joined
+        td = ectx.task_dir
+        assert f"--volume=alloc,kind=host,source={td.shared_alloc_dir}" in args
+        assert "--mount=volume=alloc,target=/alloc" in args
+        assert "--mount=volume=local,target=/local" in args
+        assert "--mount=volume=secrets,target=/secrets" in args
+        assert "--volume=task-0,kind=host,source=/host/data" in args
+        assert "--mount=volume=task-0,target=/data" in args
+        assert "--net=host" in args
+        assert "--dns=8.8.8.8" in args
+        assert "--dns-search=example.com" in args
+        assert "--port=http:8080" in args
+        assert "--memory=256M" in args
+        assert "--cpu=500m" in args
+        assert "--exec=/bin/serve" in args
+        # interpolated trailing args after the -- separator
+        assert args[args.index("--"):] == ["--", "--name", "web"]
+        # image comes before --exec
+        assert args.index("example.com/app:1.0") < args.index("--exec=/bin/serve")
+
+    def test_insecure_options_with_trust(self, tmp_path):
+        d = RktDriver(mk_ctx("rkt"))
+        ectx = mk_exec_ctx(tmp_path)
+        task = mk_task("rkt", {"image": "img", "trust_prefix": "example.com",
+                               "insecure_options": ["image"]})
+        _, args = d.command_line(ectx, task)
+        assert "--insecure-options=image" in args
+        assert "--insecure-options=all" not in " ".join(args)
+
+    def test_volumes_gated_by_client_option(self, tmp_path):
+        d = RktDriver(mk_ctx("rkt", {"rkt.volumes.enabled": "false"}))
+        ectx = mk_exec_ctx(tmp_path)
+        task = mk_task("rkt", {"image": "img", "volumes": ["/a:/b"]})
+        with pytest.raises(DriverError):
+            d.command_line(ectx, task)
+
+    def test_bad_volume_spec(self, tmp_path):
+        d = RktDriver(mk_ctx("rkt"))
+        ectx = mk_exec_ctx(tmp_path)
+        task = mk_task("rkt", {"image": "img", "volumes": ["/only-host-path"]})
+        with pytest.raises(DriverError):
+            d.command_line(ectx, task)
+
+    def test_fingerprint_absent(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PATH", str(tmp_path))
+        d = RktDriver(mk_ctx("rkt"))
+        node = mock.node()
+        node.attributes["driver.rkt"] = "1"
+        assert d.fingerprint(node) is False
+        assert "driver.rkt" not in node.attributes
+
+    def test_fingerprint_versions(self, tmp_path, monkeypatch):
+        rkt = tmp_path / "rkt"
+        rkt.write_text("#!/bin/sh\n"
+                       "echo 'rkt Version: 1.29.0'\n"
+                       "echo 'appc Version: 0.8.11'\n")
+        rkt.chmod(rkt.stat().st_mode | stat.S_IEXEC)
+        monkeypatch.setenv("PATH", str(tmp_path))
+        d = RktDriver(mk_ctx("rkt"))
+        node = mock.node()
+        assert d.fingerprint(node) is True
+        assert node.attributes["driver.rkt"] == "1"
+        assert node.attributes["driver.rkt.version"] == "1.29.0"
+        assert node.attributes["driver.rkt.appc.version"] == "0.8.11"
+
+    def test_trust_failure_fails_start(self, tmp_path, monkeypatch):
+        d = RktDriver(mk_ctx("rkt"))
+
+        class Boom:
+            returncode = 1
+            stderr = b"no such prefix"
+
+        monkeypatch.setattr(d, "_run_rkt_trust", lambda *a: Boom())
+        ectx = mk_exec_ctx(tmp_path)
+        task = mk_task("rkt", {"image": "img", "trust_prefix": "x.com"})
+        with pytest.raises(DriverError, match="rkt trust failed"):
+            d.start(ectx, task)
+
+    def test_start_runs_stub_binary(self, tmp_path, monkeypatch):
+        """Full start path: the assembled rkt argv runs under the
+        supervisor against a stub binary, logs flow, exit collected."""
+        stub = tmp_path / "bin" / "rkt"
+        stub.parent.mkdir()
+        stub.write_text("#!/bin/sh\necho rkt-ran-ok\nexit 0\n")
+        stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+        monkeypatch.setenv(
+            "PATH", f"{stub.parent}{os.pathsep}{os.environ['PATH']}")
+        d = RktDriver(mk_ctx("rkt"))
+        env = TaskEnv(env_map={"PATH": os.environ["PATH"]})
+        ectx = mk_exec_ctx(tmp_path, env)
+        task = mk_task("rkt", {"image": "img"})
+        resp = d.start(ectx, task)
+        assert resp.handle.wait_ch().wait(20.0)
+        assert resp.handle.wait_result().exit_code == 0
+        out = b"".join(
+            open(os.path.join(ectx.task_dir.log_dir, f), "rb").read()
+            for f in os.listdir(ectx.task_dir.log_dir) if ".stdout." in f)
+        assert b"rkt-ran-ok" in out
+
+
+class TestLxcDriver:
+    def test_validate_config(self):
+        validate_driver_config("lxc", {"template": "/usr/share/lxc/t"})
+        with pytest.raises(ValueError):
+            validate_driver_config("lxc", {})
+
+    def test_create_args(self, tmp_path):
+        """lxc.go:228-242 TemplateOptions → lxc-create args."""
+        d = LxcDriver(mk_ctx("lxc"))
+        ectx = mk_exec_ctx(tmp_path)
+        task = mk_task("lxc", {
+            "template": "download", "distro": "ubuntu", "release": "xenial",
+            "arch": "amd64", "disable_gpg": True,
+            "template_args": ["--extra", "1"],
+        })
+        args = d.create_args(ectx, task)
+        assert args[:4] == ["-n", "web-alloc12345", "-t", "download"]
+        tail = args[args.index("--") + 1:]
+        assert ("--dist", "ubuntu") == tuple(tail[0:2])
+        assert ("--release", "xenial") == tuple(tail[2:4])
+        assert ("--arch", "amd64") == tuple(tail[4:6])
+        assert "--no-validate" in tail
+        assert tail[-2:] == ["--extra", "1"]
+
+    def test_command_line_mounts(self, tmp_path):
+        """lxc.go:244-258: alloc/local/secrets bind mounts."""
+        d = LxcDriver(mk_ctx("lxc"))
+        ectx = mk_exec_ctx(tmp_path)
+        task = mk_task("lxc", {"template": "t",
+                               "volumes": ["/host/x:container/x"]})
+        cmd, args = d.command_line(ectx, task)
+        assert cmd == "lxc-start"
+        assert args[:3] == ["-F", "-n", "web-alloc12345"]
+        joined = " ".join(args)
+        td = ectx.task_dir
+        assert f"lxc.mount.entry={td.shared_alloc_dir} alloc" in joined
+        assert f"lxc.mount.entry={td.local_dir} local" in joined
+        assert f"lxc.mount.entry={td.secrets_dir} secrets" in joined
+        assert "lxc.mount.entry=/host/x container/x" in joined
+
+    def test_absolute_container_volume_rejected(self, tmp_path):
+        d = LxcDriver(mk_ctx("lxc"))
+        ectx = mk_exec_ctx(tmp_path)
+        task = mk_task("lxc", {"template": "t", "volumes": ["/a:/abs"]})
+        with pytest.raises(DriverError):
+            d.command_line(ectx, task)
+
+    def test_fingerprint_needs_enable_option(self, tmp_path, monkeypatch):
+        lxc = tmp_path / "lxc-start"
+        lxc.write_text("#!/bin/sh\necho 2.0.8\n")
+        lxc.chmod(lxc.stat().st_mode | stat.S_IEXEC)
+        monkeypatch.setenv("PATH", str(tmp_path))
+        node = mock.node()
+        # present but not enabled → off (lxc.go lxcConfigOption)
+        d = LxcDriver(mk_ctx("lxc"))
+        assert d.fingerprint(node) is False
+        d = LxcDriver(mk_ctx("lxc", {LXC_ENABLE_OPTION: "1"}))
+        assert d.fingerprint(node) is True
+        assert node.attributes["driver.lxc.version"] == "2.0.8"
+
+    def test_create_failure_fails_start(self, tmp_path, monkeypatch):
+        d = LxcDriver(mk_ctx("lxc"))
+
+        class Boom:
+            returncode = 1
+            stderr = b"template not found"
+
+        monkeypatch.setattr(d, "_run_lxc_create", lambda *a: Boom())
+        ectx = mk_exec_ctx(tmp_path)
+        task = mk_task("lxc", {"template": "nope"})
+        with pytest.raises(DriverError, match="lxc-create failed"):
+            d.start(ectx, task)
+
+    def test_start_runs_stub_binary(self, tmp_path, monkeypatch):
+        """Create pre-step + foreground start against stub binaries."""
+        bindir = tmp_path / "bin"
+        bindir.mkdir()
+        created = tmp_path / "created"
+        create = bindir / "lxc-create"
+        create.write_text(f"#!/bin/sh\ntouch {created}\nexit 0\n")
+        start = bindir / "lxc-start"
+        start.write_text("#!/bin/sh\necho lxc-ran-ok\nexit 0\n")
+        for f in (create, start):
+            f.chmod(f.stat().st_mode | stat.S_IEXEC)
+        monkeypatch.setenv(
+            "PATH", f"{bindir}{os.pathsep}{os.environ['PATH']}")
+        d = LxcDriver(mk_ctx("lxc"))
+        env = TaskEnv(env_map={"PATH": os.environ["PATH"]})
+        ectx = mk_exec_ctx(tmp_path, env)
+        task = mk_task("lxc", {"template": "busybox"})
+        resp = d.start(ectx, task)
+        assert created.exists()
+        assert resp.handle.wait_ch().wait(20.0)
+        assert resp.handle.wait_result().exit_code == 0
