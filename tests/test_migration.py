@@ -12,7 +12,10 @@ from nomad_tpu.agent.config import AgentConfig
 from nomad_tpu.structs import structs as s
 
 
-def wait_until(pred, timeout=30.0, interval=0.05):
+def wait_until(pred, timeout=60.0, interval=0.05):
+    # 60s default: liveness bound only — the full cluster round-trip
+    # (register → eval → plan → client pull → runner start) competes with
+    # the whole suite for 2 cores.
     deadline = time.time() + timeout
     while time.time() < deadline:
         if pred():
