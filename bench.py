@@ -649,12 +649,20 @@ def _child_main():
     # The literal BASELINE.json north star: 1M pending task-groups across
     # 10k nodes, target < 2s end to end — before stretch config (e) so a
     # tight budget drops (e), never the north star.
+    # The north star always gets median-of-3 — THE metric must not swing
+    # on one noisy trial (observed 1.3-3.0s for identical work on the
+    # shared-tenant CPU fallback), and the <2s target is defined on
+    # v5e-1 hardware, so record the platform context alongside.
     ns = phase("config_northstar_10k_x_1m", 120, run_config, N_NODES,
-               NS_N_JOBS, COUNT_PER_JOB, "config-northstar", trials=trials)
+               NS_N_JOBS, COUNT_PER_JOB, "config-northstar", trials=3)
     if ns is not None:
         rate_ns, detail_ns = ns
         detail_ns["target_s"] = 2.0
         detail_ns["target_met"] = detail_ns["elapsed_s"] < 2.0
+        detail_ns["target_hardware"] = "tpu v5e-1"
+        if degraded:
+            detail_ns["note"] = ("measured on the cpu fallback, not the "
+                                 "v5e-1 target hardware")
         detail["config_northstar_10k_x_1m"] = detail_ns
 
     # Secondary fidelity check AFTER the primary metrics so its 150s of
