@@ -568,8 +568,8 @@ def _child_main():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-        detail["degraded"] = ("default backend failed init/probe; "
-                              "cpu fallback, 1 trial per config")
+        detail["degraded"] = ("default backend failed init/probe; cpu "
+                              "fallback, 1 trial per config (north star: 3)")
         log("backend probe FAILED; degrading to CPU")
     detail["platform_probe"] = platform or "unreachable"
     flush()
@@ -653,7 +653,7 @@ def _child_main():
     # on one noisy trial (observed 1.3-3.0s for identical work on the
     # shared-tenant CPU fallback), and the <2s target is defined on
     # v5e-1 hardware, so record the platform context alongside.
-    ns = phase("config_northstar_10k_x_1m", 120, run_config, N_NODES,
+    ns = phase("config_northstar_10k_x_1m", 180, run_config, N_NODES,
                NS_N_JOBS, COUNT_PER_JOB, "config-northstar", trials=3)
     if ns is not None:
         rate_ns, detail_ns = ns

@@ -404,3 +404,27 @@ def test_sharded_contended_multi_round_at_4k_nodes(mesh):
     q_single = quality(np.asarray(single.used_after))
     q_shard = quality(used_after)
     assert q_shard >= 0.995 * q_single
+
+
+def test_driver_dryrun_composition(mesh):
+    """Pin the EXACT composition the driver's multichip artifact runs —
+    ``jax.jit`` over ``functools.partial(sharded_placement_rounds, mesh)``
+    with the dryrun's shapes — so a regression in that path (r03: the
+    artifact hung while the direct-call tests stayed green) fails in CI,
+    not in the driver. Deadline-guarded: a recurrence of the hang must
+    FAIL here, not stall the suite."""
+    import signal
+
+    import __graft_entry__ as g  # repo root is on sys.path via conftest
+
+    def _timeout(signum, frame):
+        raise TimeoutError("dryrun composition exceeded 120s — "
+                           "the r03 hang is back")
+
+    old = signal.signal(signal.SIGALRM, _timeout)
+    signal.alarm(120)
+    try:
+        g._dryrun_multichip_impl(8)
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
