@@ -106,13 +106,14 @@ class AgentConfig:
 
     @staticmethod
     def dev() -> "AgentConfig":
-        """-dev: in-memory server + client in one process
-        (command/agent/config.go DevConfig)."""
+        """-dev: in-memory server + client in one process, on the
+        standard port so the CLI's default address reaches it
+        (command/agent/config.go DevConfig). Tests that run many agents
+        set ``ports.http = 0`` for an ephemeral port."""
         cfg = AgentConfig()
         cfg.dev_mode = True
         cfg.server.enabled = True
         cfg.client.enabled = True
-        cfg.ports.http = 0  # ephemeral
         return cfg
 
 

@@ -22,3 +22,14 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def dev_test_config():
+    """AgentConfig.dev() with an ephemeral HTTP port: dev() binds the
+    standard 4646 for CLI parity, which concurrent test agents must not
+    share."""
+    from nomad_tpu.agent import AgentConfig
+
+    cfg = AgentConfig.dev()
+    cfg.ports.http = 0
+    return cfg

@@ -6,6 +6,8 @@ import time
 
 import pytest
 
+import conftest
+
 from nomad_tpu.agent import Agent, AgentConfig
 from nomad_tpu.cli import main
 
@@ -42,7 +44,7 @@ def wait_until(pred, timeout=15.0, interval=0.05):
 
 @pytest.fixture(scope="module")
 def agent(tmp_path_factory):
-    cfg = AgentConfig.dev()
+    cfg = conftest.dev_test_config()
     tmp = tmp_path_factory.mktemp("cli-agent")
     cfg.client.alloc_dir = str(tmp / "allocs")
     cfg.client.state_dir = str(tmp / "state")

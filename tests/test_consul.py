@@ -6,6 +6,8 @@ import time
 
 import pytest
 
+import conftest
+
 from nomad_tpu import mock
 from nomad_tpu.consul import CatalogEntry, ServiceCatalog, ServiceClient
 from nomad_tpu.consul.catalog import CHECK_CRITICAL, CHECK_PASSING
@@ -137,7 +139,7 @@ class TestAgentIntegration:
         from nomad_tpu.agent.agent import Agent
         from nomad_tpu.agent.config import AgentConfig
 
-        cfg = AgentConfig.dev()
+        cfg = conftest.dev_test_config()
         cfg.client.state_dir = str(tmp_path / "state")
         cfg.client.alloc_dir = str(tmp_path / "allocs")
         agent = Agent(cfg)

@@ -7,6 +7,8 @@ import time
 
 import pytest
 
+import conftest
+
 from nomad_tpu import mock
 from nomad_tpu.client.fs_stream import stream_file_frames, stream_log_frames
 from nomad_tpu.structs import structs as s
@@ -152,7 +154,7 @@ class TestHTTPStreaming:
         from nomad_tpu.agent.agent import Agent
         from nomad_tpu.agent.config import AgentConfig
 
-        cfg = AgentConfig.dev()
+        cfg = conftest.dev_test_config()
         cfg.client.state_dir = str(tmp_path / "state")
         cfg.client.alloc_dir = str(tmp_path / "allocs")
         a = Agent(cfg)

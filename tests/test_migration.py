@@ -105,8 +105,10 @@ class TestRemoteMigration:
             runner2 = dst_client.client.get_alloc_runner(alloc2.id)
             if runner2 is None:
                 return False
-            path = os.path.join(runner2.alloc_dir.task_dirs["web"].local_dir,
-                                "state.db")
+            td = runner2.alloc_dir.task_dirs.get("web")
+            if td is None:
+                return False  # runner exists, task dirs not built yet
+            path = os.path.join(td.local_dir, "state.db")
             return os.path.exists(path) and \
                 open(path).read() == "precious sticky state"
 

@@ -7,6 +7,8 @@ import urllib.request
 
 import pytest
 
+import conftest
+
 from nomad_tpu.agent import Agent, AgentConfig
 from nomad_tpu.utils import profiling
 
@@ -18,7 +20,7 @@ def _get(addr, path):
 
 @pytest.fixture(scope="module")
 def debug_agent(tmp_path_factory):
-    cfg = AgentConfig.dev()
+    cfg = conftest.dev_test_config()
     cfg.enable_debug = True
     tmp = tmp_path_factory.mktemp("dbg")
     cfg.client.alloc_dir = str(tmp / "allocs")
@@ -55,7 +57,7 @@ class TestPprofEndpoints:
         assert "http" in body["Stacks"]
 
     def test_gated_when_disabled(self, tmp_path):
-        cfg = AgentConfig.dev()
+        cfg = conftest.dev_test_config()
         cfg.enable_debug = False
         cfg.client.alloc_dir = str(tmp_path / "allocs")
         cfg.client.state_dir = str(tmp_path / "state")

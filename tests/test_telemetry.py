@@ -2,6 +2,8 @@
 website/source/docs/agent/telemetry.html.md)."""
 import time
 
+import conftest
+
 from nomad_tpu import mock
 from nomad_tpu.server import Server, ServerConfig
 from nomad_tpu.structs import structs as s
@@ -91,7 +93,7 @@ class TestServerEmitters:
         import json
         import urllib.request
 
-        cfg = AgentConfig.dev()
+        cfg = conftest.dev_test_config()
         cfg.client.enabled = False
         agent = Agent(cfg)
         agent.start()

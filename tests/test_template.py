@@ -7,6 +7,8 @@ import time
 
 import pytest
 
+import conftest
+
 from nomad_tpu import mock
 from nomad_tpu.client.template import (
     MissingDependency,
@@ -160,7 +162,7 @@ class TestEndToEnd:
         from nomad_tpu.agent.agent import Agent
         from nomad_tpu.agent.config import AgentConfig
 
-        cfg = AgentConfig.dev()
+        cfg = conftest.dev_test_config()
         cfg.client.state_dir = str(tmp_path / "state")
         cfg.client.alloc_dir = str(tmp_path / "allocs")
         a = Agent(cfg)
