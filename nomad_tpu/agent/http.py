@@ -787,7 +787,11 @@ class HTTPServer:
         if self.agent.server is None:
             raise CodedError(501, "keyring requires a server agent")
         data_dir = (getattr(self.agent.config, "data_dir", "") or
-                    getattr(self.agent.server.config, "data_dir", "") or ".")
+                    getattr(self.agent.server.config, "data_dir", ""))
+        if not data_dir:
+            # A dev agent has no data_dir; silently writing keyring.json
+            # into the process cwd would persist stale keys across runs.
+            raise CodedError(400, "keyring requires a data_dir")
         if op == "list":
             return keyring.key_response(data_dir), None
         if op not in ("install", "use", "remove"):

@@ -269,6 +269,24 @@ class AgentAPI:
         q = QueryOptions(params={"node": node})
         self.c.put("/v1/agent/force-leave", None, q)
 
+    # Gossip keyring (api/agent.go:175-215 ListKeys/InstallKey/UseKey/
+    # RemoveKey → /v1/agent/keyring/<op>).
+    def list_keys(self) -> dict:
+        obj, _ = self.c.get("/v1/agent/keyring/list")
+        return obj
+
+    def install_key(self, key: str) -> dict:
+        obj, _ = self.c.put("/v1/agent/keyring/install", {"Key": key})
+        return obj
+
+    def use_key(self, key: str) -> dict:
+        obj, _ = self.c.put("/v1/agent/keyring/use", {"Key": key})
+        return obj
+
+    def remove_key(self, key: str) -> dict:
+        obj, _ = self.c.put("/v1/agent/keyring/remove", {"Key": key})
+        return obj
+
     def client_stats(self) -> dict:
         obj, _ = self.c.get("/v1/client/stats")
         return obj

@@ -615,35 +615,64 @@ def cmd_keygen(args, out) -> int:
     return 0
 
 
+def _keyring_render(out, keys, primaries) -> int:
+    if not keys:
+        out.write("Keyring is empty\n")
+    for k in sorted(keys):
+        out.write(f"{k}{' (primary)' if k in primaries else ''}\n")
+    return 0
+
+
+_KEYRING_VERBS = (("install", "Installed key\n"),
+                  ("use", "Changed primary key\n"),
+                  ("remove", "Removed key\n"))
+
+
 def cmd_keyring(args, out) -> int:
-    """command/keyring.go: manage the gossip keyring file
-    (<data_dir>/keyring.json) through the shared utils/keyring helper —
-    the same logic backing the /v1/agent/keyring HTTP surface."""
+    """command/keyring.go: manage the gossip keyring. Like the reference,
+    operations go through the agent HTTP API (client.Agent().InstallKey
+    et al., keyring.go:66-97); with an explicit -data-dir the shared
+    utils/keyring helper edits the file directly (offline management,
+    e.g. pre-seeding before first start)."""
     from ..utils import keyring
 
-    data_dir = args.data_dir or "."
-    if args.list_keys:
-        ring = keyring.list_keys(data_dir)
-        if not ring["Keys"]:
-            out.write("Keyring is empty\n")
-        for k in ring["Keys"]:
-            marker = " (primary)" if k == ring["Primary"] else ""
-            out.write(f"{k}{marker}\n")
-        return 0
-    if args.install or args.use or args.remove:
-        op, key, done = (
-            ("install", args.install, "Installed key\n") if args.install
-            else ("use", args.use, "Changed primary key\n") if args.use
-            else ("remove", args.remove, "Removed key\n"))
+    verb = next(((op, getattr(args, op), done)
+                 for op, done in _KEYRING_VERBS if getattr(args, op)), None)
+    if not args.list_keys and verb is None:
+        out.write("Specify one of -install, -list, -use, -remove\n")
+        return 1
+
+    if not args.data_dir:
+        api = _api(args)
         try:
-            getattr(keyring, op)(data_dir, key)
-        except keyring.KeyringError as e:
-            out.write(f"Error: {e}\n")
+            if args.list_keys:
+                resp = api.agent.list_keys()
+                return _keyring_render(out, resp["Keys"],
+                                       resp["PrimaryKeys"])
+            op, key, done = verb
+            getattr(api.agent, f"{op}_key")(key)
+            out.write(done)
+            return 0
+        except APIError as e:
+            if e.code != 0:  # agent answered with an error
+                out.write(f"Error: {e}\n")
+                return 1
+            out.write("Error: no agent reachable (use -address, or "
+                      "-data-dir for offline file management)\n")
             return 1
-        out.write(done)
-        return 0
-    out.write("Specify one of -install, -list, -use, -remove\n")
-    return 1
+
+    if args.list_keys:
+        ring = keyring.list_keys(args.data_dir)
+        return _keyring_render(out, ring["Keys"],
+                               {ring["Primary"]} if ring["Primary"] else ())
+    op, key, done = verb
+    try:
+        getattr(keyring, op)(args.data_dir, key)
+    except keyring.KeyringError as e:
+        out.write(f"Error: {e}\n")
+        return 1
+    out.write(done)
+    return 0
 
 
 def cmd_monitor(args, out) -> int:
@@ -905,7 +934,7 @@ def build_parser() -> argparse.ArgumentParser:
     add("agent-monitor", cmd_monitor)
     add("check", cmd_check)
     add("keyring", cmd_keyring, lambda sp: (
-        sp.add_argument("-data-dir", dest="data_dir", default="."),
+        sp.add_argument("-data-dir", dest="data_dir", default=""),
         sp.add_argument("-install", default=""),
         sp.add_argument("-list", dest="list_keys", action="store_true"),
         sp.add_argument("-use", default=""),

@@ -254,6 +254,25 @@ class TestClusterVerbs:
         code, o = run_cli(["keyring", "-data-dir", d, "-remove", key])
         assert code == 0
 
+    def test_keyring_via_agent_http(self, addr, agent, tmp_path):
+        """Default mode matches the reference: keyring verbs go through
+        the agent HTTP API (command/keyring.go:66-97)."""
+        prev = agent.config.data_dir
+        agent.config.data_dir = str(tmp_path)
+        try:
+            code, o = run_cli(["keygen"])
+            key = o.strip()
+            code, o = run_cli(["keyring", "-address", addr,
+                               "-install", key])
+            assert code == 0 and "Installed" in o
+            code, o = run_cli(["keyring", "-address", addr, "-list"])
+            assert code == 0 and key in o and "(primary)" in o
+            code, o = run_cli(["keyring", "-address", addr,
+                               "-remove", key])
+            assert code == 1  # primary protected, surfaced as an error
+        finally:
+            agent.config.data_dir = prev
+
     def test_server_join_and_force_leave(self, addr):
         from nomad_tpu.server import Server, ServerConfig
 
