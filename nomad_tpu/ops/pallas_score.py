@@ -1,0 +1,136 @@
+"""Pallas TPU kernel for the mesh scoring hot path.
+
+``masked_score_matrix`` fuses the per-(spec, node) feasibility test
+(capacity fit + static feasibility mask) with the bin-pack ScoreFit
+(funcs.go:123, mirrored exactly from ops/kernels.py:_score_fit) into ONE
+pass over HBM: node tensors stream through VMEM once per spec row,
+instead of XLA materializing separate fit-mask and score intermediates.
+This is the FLOPs core of the multichip candidate-scoring path
+(parallel/sharded.py sharded_candidate_scores), where each shard scores
+its node slice for every spec before the local top-k.
+
+Layout: the node axis is the minor (lane) dimension, so node tensors are
+transposed to SoA ([4, N], [2, N]) host-side — a one-time relayout XLA
+fuses into the producing op.  The grid tiles (spec, node-block); each
+program scores one spec row over one 512-node block held in VMEM.
+
+On non-TPU backends the kernel runs in interpret mode (bit-identical
+semantics, no Mosaic), which is how the differential tests pin it to the
+jnp reference composition.  Opt-in at the call sites via
+``NOMAD_TPU_PALLAS=1`` — default stays the XLA path until TPU-measured.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+NODE_BLOCK = 512
+
+
+def pallas_enabled() -> bool:
+    return os.environ.get("NOMAD_TPU_PALLAS", "") in ("1", "true")
+
+
+def _score_kernel(feas_ref, used_ref, cap_ref, denom_ref, ask_ref, out_ref):
+    """One (spec row, node block): fused fit mask + ScoreFit.
+
+    feas_ref  [1, Nb] int8   — static feasibility for this spec
+    used_ref  [4, Nb] int32  — node usage, SoA
+    cap_ref   [4, Nb] int32  — node capacity, SoA
+    denom_ref [2, Nb] f32    — cpu/mem capacity minus reserved, SoA
+    ask_ref   [1, 4]  int32  — this spec's ask
+    out_ref   [1, Nb] f32    — masked score (NEG_INF where infeasible)
+    """
+    used = used_ref[...]                                   # [4, Nb]
+    cap = cap_ref[...]
+    ask = ask_ref[0, :]                                    # [4]
+    denom = denom_ref[...]                                 # [2, Nb]
+
+    fits = jnp.all(ask[:, None] <= cap - used, axis=0)     # [Nb]
+    ok = (feas_ref[0, :] != 0) & fits
+
+    # ScoreFit, term-for-term with ops/kernels.py:_score_fit.
+    after = used[:2].astype(jnp.float32) + ask[:2].astype(jnp.float32)[:, None]
+    safe_denom = jnp.where(denom == 0.0, 1.0, denom)
+    frac = 1.0 - after / safe_denom
+    frac = jnp.where(denom == 0.0, -jnp.inf, frac)
+    total = jnp.power(10.0, frac[0]) + jnp.power(10.0, frac[1])
+    score = 20.0 - total
+    score = jnp.nan_to_num(score, nan=0.0, posinf=18.0, neginf=0.0)
+    score = jnp.clip(score, 0.0, 18.0)
+
+    out_ref[0, :] = jnp.where(ok, score, jnp.float32(NEG_INF))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _masked_score_matrix_impl(feas, used_t, cap_t, denom_t, ask,
+                              interpret: bool):
+    u, n_pad = feas.shape
+    grid = (u, n_pad // NODE_BLOCK)
+    out_shape = jax.ShapeDtypeStruct((u, n_pad), jnp.float32)
+    try:
+        # Under shard_map the output varies over whatever mesh axes the
+        # inputs vary over (check_vma requires declaring this), and
+        # replicated inputs (the ask table) must be pvary-promoted so
+        # kernel ops see matching varying axes.
+        vma = frozenset().union(*(getattr(jax.typeof(x), "vma", frozenset())
+                                  for x in (feas, used_t, cap_t, denom_t,
+                                            ask)))
+        if vma:
+            out_shape = jax.ShapeDtypeStruct((u, n_pad), jnp.float32,
+                                             vma=vma)
+            promote = (lambda x: jax.lax.pvary(
+                x, tuple(vma - getattr(jax.typeof(x), "vma", frozenset()))))
+            feas, used_t, cap_t, denom_t, ask = map(
+                promote, (feas, used_t, cap_t, denom_t, ask))
+    except (AttributeError, TypeError):
+        pass
+    return pl.pallas_call(
+        _score_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, NODE_BLOCK), lambda iu, ib: (iu, ib)),
+            pl.BlockSpec((4, NODE_BLOCK), lambda iu, ib: (0, ib)),
+            pl.BlockSpec((4, NODE_BLOCK), lambda iu, ib: (0, ib)),
+            pl.BlockSpec((2, NODE_BLOCK), lambda iu, ib: (0, ib)),
+            pl.BlockSpec((1, 4), lambda iu, ib: (iu, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, NODE_BLOCK), lambda iu, ib: (iu, ib)),
+        out_shape=out_shape,
+        interpret=interpret,
+    )(feas, used_t, cap_t, denom_t, ask)
+
+
+def masked_score_matrix(
+    feas: jax.Array,       # [U, N] bool
+    used: jax.Array,       # [N, 4] int32
+    capacity: jax.Array,   # [N, 4] int32
+    denom: jax.Array,      # [N, 2] float32
+    ask: jax.Array,        # [U, 4] int32
+    interpret: "bool | None" = None,
+) -> jax.Array:            # [U, N] float32, NEG_INF where infeasible
+    """All-pairs masked ScoreFit in one fused HBM pass (padded node axis
+    handled here; padded columns come back NEG_INF via the feas mask).
+
+    ``interpret`` defaults to "not on the TPU backend"; callers whose
+    execution devices differ from the default backend (e.g. a CPU mesh
+    on a TPU host) must pass it explicitly."""
+    u, n = feas.shape
+    n_pad = -(-n // NODE_BLOCK) * NODE_BLOCK
+    pad = n_pad - n
+    feas_i8 = feas.astype(jnp.int8)
+    if pad:
+        feas_i8 = jnp.pad(feas_i8, ((0, 0), (0, pad)))
+        used = jnp.pad(used, ((0, pad), (0, 0)))
+        capacity = jnp.pad(capacity, ((0, pad), (0, 0)))
+        denom = jnp.pad(denom, ((0, pad), (0, 0)))
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    out = _masked_score_matrix_impl(
+        feas_i8, used.T, capacity.T, denom.T, ask, interpret)
+    return out[:, :n]

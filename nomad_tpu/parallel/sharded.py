@@ -48,12 +48,26 @@ def _local_topk_scores(
     denom_local: jnp.ndarray,    # [N_local, 2] float32
     ask: jnp.ndarray,            # [U, 4] int32 (replicated)
     k: int,
+    use_pallas: bool = False,
+    pallas_interpret: "bool | None" = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Per-shard scoring + top-k: the FLOPs-heavy part of the scheduler.
+
+    With ``use_pallas`` the mask+score computes in the fused pallas
+    kernel (ops/pallas_score.py, one HBM pass over the node tensors);
+    both paths are bit-identical (differential-tested).
 
     Returns (scores[U, k], local_idx[U, k]).
     """
     u = ask.shape[0]
+
+    if use_pallas:
+        from ..ops.pallas_score import masked_score_matrix
+
+        scored = masked_score_matrix(
+            feas_local, used_local, capacity_local, denom_local, ask,
+            interpret=pallas_interpret)
+        return jax.vmap(lambda s: lax.top_k(s, k))(scored)
 
     def score_one(u_idx):
         cap_left = capacity_local - used_local
@@ -75,14 +89,31 @@ def sharded_candidate_scores(
     denom: jax.Array,      # [N, 2] f32   — sharded on N
     ask: jax.Array,        # [U, 4] int32 — replicated
     k: int = 64,
+    use_pallas: "bool | None" = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Score all (spec, node) pairs across the mesh and return the global
     top-(k·D) candidates per spec as (scores[U, k*D], node_idx[U, k*D]).
 
     XLA inserts the all-gather over ICI; node indices are translated from
-    shard-local to global inside the mapped function.
+    shard-local to global inside the mapped function.  ``use_pallas``
+    routes the shard-local mask+score through the fused pallas kernel
+    (default: the NOMAD_TPU_PALLAS env opt-in).
     """
+    if use_pallas is None:
+        from ..ops.pallas_score import pallas_enabled
+
+        use_pallas = pallas_enabled()
     n_per_shard = used.shape[0] // mesh.devices.size
+
+    # Route by the MESH's devices, not the default backend: a CPU mesh
+    # on a TPU host must interpret, and vice versa.
+    mesh_on_tpu = mesh.devices.flat[0].platform == "tpu"
+    smap_kwargs = {}
+    if use_pallas and not mesh_on_tpu:
+        # Pallas interpret mode's internal block slicing carries no
+        # varying-manual-axes info, which trips shard_map's vma checker
+        # on CPU; the compiled TPU path keeps full checking.
+        smap_kwargs["check_vma"] = False
 
     @functools.partial(
         jax.shard_map,
@@ -90,10 +121,12 @@ def sharded_candidate_scores(
         in_specs=(P(None, NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS),
                   P(NODE_AXIS), P(None)),
         out_specs=(P(None, NODE_AXIS), P(None, NODE_AXIS)),
+        **smap_kwargs,
     )
     def _shard_fn(feas_l, used_l, cap_l, denom_l, ask_r):
         scores, local_idx = _local_topk_scores(
-            feas_l, used_l, cap_l, denom_l, ask_r, k)
+            feas_l, used_l, cap_l, denom_l, ask_r, k,
+            use_pallas=use_pallas, pallas_interpret=not mesh_on_tpu)
         shard = lax.axis_index(NODE_AXIS)
         global_idx = local_idx + shard * n_per_shard
         return scores, global_idx
