@@ -217,7 +217,9 @@ class TPUFingerprint(Fingerprint):
             return False
         try:
             import jax
-            devs = [d for d in jax.devices() if d.platform == "tpu"]
+
+            from ..utils.platform import is_tpu_platform
+            devs = [d for d in jax.devices() if is_tpu_platform(d.platform)]
         except Exception:
             return False
         if not devs:

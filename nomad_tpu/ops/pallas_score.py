@@ -32,6 +32,9 @@ NEG_INF = -1e30
 NODE_BLOCK = 512
 
 
+from ..utils.platform import is_tpu_platform  # noqa: F401 (re-export)
+
+
 def pallas_enabled() -> bool:
     return os.environ.get("NOMAD_TPU_PALLAS", "") in ("1", "true")
 
@@ -130,7 +133,7 @@ def masked_score_matrix(
         capacity = jnp.pad(capacity, ((0, pad), (0, 0)))
         denom = jnp.pad(denom, ((0, pad), (0, 0)))
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = not is_tpu_platform(jax.default_backend())
     out = _masked_score_matrix_impl(
         feas_i8, used.T, capacity.T, denom.T, ask, interpret)
     return out[:, :n]

@@ -107,7 +107,9 @@ def sharded_candidate_scores(
 
     # Route by the MESH's devices, not the default backend: a CPU mesh
     # on a TPU host must interpret, and vice versa.
-    mesh_on_tpu = mesh.devices.flat[0].platform == "tpu"
+    from ..utils.platform import is_tpu_platform
+
+    mesh_on_tpu = is_tpu_platform(mesh.devices.flat[0].platform)
     smap_kwargs = {}
     if use_pallas and not mesh_on_tpu:
         # Pallas interpret mode's internal block slicing carries no

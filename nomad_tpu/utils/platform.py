@@ -1,0 +1,8 @@
+"""Device-platform identification shared by fingerprints and kernels."""
+
+
+def is_tpu_platform(platform: str) -> bool:
+    """Whether a jax device platform string is a TPU. The real chip in
+    this environment registers through the experimental 'axon' PJRT
+    plugin rather than as 'tpu'; both compile through Mosaic."""
+    return platform in ("tpu", "axon")
