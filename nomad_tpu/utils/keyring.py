@@ -13,7 +13,7 @@ from __future__ import annotations
 import base64
 import json
 import os
-from typing import Dict, List
+from typing import Dict
 
 
 class KeyringError(ValueError):

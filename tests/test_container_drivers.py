@@ -3,7 +3,6 @@ lxc_test.go — config validation, command assembly, fingerprint gating,
 and a full start path against a stub binary)."""
 import os
 import stat
-import sys
 
 import pytest
 
