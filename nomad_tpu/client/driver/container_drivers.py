@@ -19,13 +19,14 @@ from typing import List
 from ...structs import structs as s
 from .driver import (
     DriverError,
+    DriverHandle,
     ExecContext,
     StartResponse,
     find_executable,
     opt,
     register_driver,
 )
-from .exec_drivers import _ExecFamilyDriver
+from .exec_drivers import ExecutorHandle, _ExecFamilyDriver
 from .fields import FieldSchema
 
 # In-container mount targets (reference: client/allocdir/alloc_dir.go
@@ -168,17 +169,25 @@ class RktDriver(_ExecFamilyDriver):
             capture_output=True, timeout=120)
 
     def fingerprint(self, node: s.Node) -> bool:
-        """rkt.go:171-215: present + versions recorded."""
+        """rkt.go:171-215: present + versions recorded.
+
+        Attributes are dropped up front and re-set only on a fully
+        working binary: absent, raising, and nonzero-exit rkt all stop
+        advertising the driver identically."""
+        for attr in ("driver.rkt", "driver.rkt.version",
+                     "driver.rkt.appc.version"):
+            node.attributes.pop(attr, None)
         if not find_executable("rkt"):
-            node.attributes.pop("driver.rkt", None)
             return False
         try:
             out = subprocess.run(["rkt", "version"], capture_output=True,
-                                 timeout=10).stdout.decode(errors="replace")
+                                 timeout=10)
         except (OSError, subprocess.SubprocessError):
             return False
+        if out.returncode != 0:
+            return False
         versions = {}
-        for line in out.splitlines():
+        for line in out.stdout.decode(errors="replace").splitlines():
             if ":" in line:
                 k, _, v = line.partition(":")
                 versions[k.strip().lower()] = v.strip()
@@ -191,6 +200,41 @@ class RktDriver(_ExecFamilyDriver):
 
     def periodic(self):
         return (True, 30.0)
+
+
+def _lxc_teardown(container_name: str) -> None:
+    """Authoritative container stop + rootfs removal (lxc.go:388
+    h.container.Stop(); the CLI twin is lxc-stop -k).  Signaling the
+    foreground lxc-start monitor is not enough: if the supervisor
+    escalates to SIGKILL, the monitor dies but the container init is
+    reparented and keeps running — so always force-stop the container
+    itself, then destroy the lxc-create'd rootfs."""
+    for cmd, timeout in ((["lxc-stop", "-n", container_name, "-k"], 30),
+                         (["lxc-destroy", "-n", container_name, "-f"], 60)):
+        try:
+            subprocess.run(cmd, capture_output=True, timeout=timeout)
+        except (OSError, subprocess.SubprocessError):
+            pass
+
+
+class LxcHandle(ExecutorHandle):
+    """ExecutorHandle that also owns the container lifecycle: after the
+    monitor is signaled (and possibly SIGKILLed past the grace period),
+    force-stop the container and remove its rootfs."""
+
+    def __init__(self, executor, task_name: str, kill_timeout: float,
+                 container_name: str):
+        super().__init__(executor, task_name, kill_timeout)
+        self.container_name = container_name
+
+    def kill(self) -> None:
+        super().kill()
+        # Synchronous on purpose: a restart re-enters start() with the
+        # SAME container name the moment kill() returns, and agent
+        # shutdown exits the process right after — a background teardown
+        # would either destroy the restarted container or never run.
+        self.executor.exited.wait(self.kill_timeout + 10.0)
+        _lxc_teardown(self.container_name)
 
 
 class LxcDriver(_ExecFamilyDriver):
@@ -226,8 +270,21 @@ class LxcDriver(_ExecFamilyDriver):
     }
 
     def container_name(self, exec_ctx: ExecContext, task: s.Task) -> str:
-        """(lxc.go:200) <task>-<alloc_id>."""
-        return f"{task.name}-{self.ctx.alloc_id}"
+        """(lxc.go:200) <task>-<alloc_id>, plus a per-launch nonce.
+
+        The nonce makes each start attempt's container unique: the task
+        runner is released by the executor's exit event, not by kill()
+        returning, so a restart can lxc-create while the previous
+        handle's stop/destroy is still in flight — under a reused name
+        that teardown would hit the NEW container.  The previous
+        launch's name is persisted in the ctl dir and cleaned up before
+        the next create."""
+        if self._launch_name is None:
+            self._launch_name = (
+                f"{task.name}-{self.ctx.alloc_id}-{os.urandom(4).hex()}")
+        return self._launch_name
+
+    _launch_name: str | None = None
 
     def create_args(self, exec_ctx: ExecContext, task: s.Task) -> List[str]:
         """lxc-create argument list from the template options
@@ -294,33 +351,95 @@ class LxcDriver(_ExecFamilyDriver):
         return "lxc-start", args
 
     def start(self, exec_ctx: ExecContext, task: s.Task) -> StartResponse:
+        ctl_dir = self.ctl_dir(exec_ctx, task.name)
+        # A task that exited on its own (no kill()) leaves its rootfs
+        # behind; clean up the PREVIOUS launch's container before
+        # creating this one.
+        try:
+            with open(os.path.join(ctl_dir, "container.name")) as fh:
+                prev = fh.read().strip()
+        except OSError:
+            prev = ""
+        if prev:
+            _lxc_teardown(prev)
+        self._launch_name = None        # fresh nonce for this attempt
+        name = self.container_name(exec_ctx, task)
         create = self.create_args(exec_ctx, task)
         out = self._run_lxc_create(create)
         if out.returncode != 0:
             raise DriverError(
                 f"lxc-create failed: {out.stderr.decode(errors='replace')}")
-        return super().start(exec_ctx, task)
+        # Persist the name BEFORE launching: the moment a container can
+        # be running, a re-attaching agent (and the next start attempt)
+        # must be able to find and tear it down.
+        os.makedirs(ctl_dir, exist_ok=True)
+        with open(os.path.join(ctl_dir, "container.name"), "w") as fh:
+            fh.write(name)
+        try:
+            resp = super().start(exec_ctx, task)
+        except DriverError:
+            # Supervisor launch failed after the rootfs was built: tear
+            # it down now — a rescheduled alloc may never retry here.
+            _lxc_teardown(name)
+            try:
+                os.unlink(os.path.join(ctl_dir, "container.name"))
+            except OSError:
+                pass
+            raise
+        base = resp.handle
+        return StartResponse(
+            handle=LxcHandle(base.executor, task.name, task.kill_timeout,
+                             name),
+            network=resp.network)
+
+    def open(self, exec_ctx: ExecContext, handle_id: str) -> DriverHandle:
+        name = ""
+        if handle_id.startswith("sup:"):
+            ctl_dir = handle_id.split(":", 1)[1]
+            try:
+                with open(os.path.join(ctl_dir, "container.name")) as fh:
+                    name = fh.read().strip()
+            except OSError:
+                pass
+        try:
+            base = super().open(exec_ctx, handle_id)
+        except DriverError:
+            # Supervisor gone (e.g. OOM-killed): the reparented container
+            # init may still be running — tear it down before reporting
+            # the task lost, or it leaks forever.
+            if name:
+                _lxc_teardown(name)
+            raise
+        if name:
+            return LxcHandle(base.executor, base.task_name,
+                             base.kill_timeout, name)
+        return base
 
     def _run_lxc_create(self, args: List[str]):
         return subprocess.run(["lxc-create"] + args, capture_output=True,
                               timeout=600)
 
     def fingerprint(self, node: s.Node) -> bool:
-        """lxc.go:139-160: gated by driver.lxc.enable + liblxc present."""
+        """lxc.go:139-160: gated by driver.lxc.enable + liblxc present.
+        Disabled, absent, raising, and nonzero-exit lxc-start all stop
+        advertising the driver identically."""
+        node.attributes.pop("driver.lxc", None)
+        node.attributes.pop("driver.lxc.version", None)
         options = getattr(self.ctx.config, "options", {}) or {}
         enabled = str(options.get(LXC_ENABLE_OPTION, "")).lower() in (
             "1", "true")
         if not enabled or not find_executable("lxc-start"):
-            node.attributes.pop("driver.lxc", None)
             return False
         try:
             out = subprocess.run(["lxc-start", "--version"],
-                                 capture_output=True,
-                                 timeout=10).stdout.decode(errors="replace")
+                                 capture_output=True, timeout=10)
         except (OSError, subprocess.SubprocessError):
             return False
+        if out.returncode != 0:
+            return False
         node.attributes["driver.lxc"] = "1"
-        node.attributes["driver.lxc.version"] = out.strip()
+        node.attributes["driver.lxc.version"] = out.stdout.decode(
+            errors="replace").strip()
         return True
 
 

@@ -114,6 +114,11 @@ class _ExecFamilyDriver(Driver):
         "args": FieldSchema("list"),
     }
 
+    def ctl_dir(self, exec_ctx: ExecContext, task_name: str) -> str:
+        """The supervisor control dir for a task (one place owns the
+        naming convention; LxcDriver reads it pre-launch)."""
+        return os.path.join(exec_ctx.task_dir.dir, f".{task_name}.executor")
+
     def start(self, exec_ctx: ExecContext, task: s.Task) -> StartResponse:
         cmd, args = self.command_line(exec_ctx, task)
         td = exec_ctx.task_dir
@@ -140,8 +145,8 @@ class _ExecFamilyDriver(Driver):
         # Every exec-family task runs under a detached supervisor
         # subprocess (driver/supervisor.py ≙ executor_plugin.go): the
         # agent can restart and re-attach with the real exit status.
-        ctl_dir = os.path.join(td.dir, f".{task.name}.executor")
-        executor = SupervisedExecutor(exec_cmd, ctl_dir)
+        executor = SupervisedExecutor(exec_cmd,
+                                      self.ctl_dir(exec_ctx, task.name))
         try:
             executor.launch()
         except OSError as e:

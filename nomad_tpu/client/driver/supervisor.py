@@ -130,6 +130,8 @@ def main(ctl_dir: str) -> int:
         if server is not None:
             server.close()
         server = None
+        _cleanup_sock(ctl_dir, spath)
+        spath = ""
 
     executor = Executor(command)
     try:
@@ -210,8 +212,9 @@ def main(ctl_dir: str) -> int:
                 pass
 
     if server is None:
-        # No control socket: just outlive the task long enough for a
-        # collector to land on exit.json.
+        # No control socket (cleaned up in the bind-failure handler):
+        # just outlive the task long enough for a collector to land on
+        # exit.json.
         executor.exited.wait()
         time.sleep(LINGER_AFTER_EXIT)
         return 0

@@ -11,9 +11,35 @@ concern (the reference's serf encrypt option).
 from __future__ import annotations
 
 import base64
+import contextlib
 import json
 import os
+import threading
 from typing import Dict
+
+# The agent HTTP server is threaded; every mutation is a
+# load→mutate→save round, so serialize them process-wide...
+_LOCK = threading.Lock()
+
+
+@contextlib.contextmanager
+def _ring_lock(data_dir: str):
+    """...and across processes: the CLI's file mode mutates the same
+    keyring.json a live agent serves, so a thread lock alone still
+    loses updates.  fcntl.flock on a sidecar lockfile covers both."""
+    with _LOCK:
+        os.makedirs(data_dir or ".", exist_ok=True)
+        lockfile = keyring_path(data_dir) + ".lock"
+        fh = open(lockfile, "a")
+        try:
+            try:
+                import fcntl
+                fcntl.flock(fh.fileno(), fcntl.LOCK_EX)
+            except (ImportError, OSError):
+                pass  # non-posix: thread lock only
+            yield
+        finally:
+            fh.close()
 
 
 class KeyringError(ValueError):
@@ -34,8 +60,13 @@ def load(data_dir: str) -> Dict:
 
 def save(data_dir: str, ring: Dict) -> None:
     os.makedirs(data_dir or ".", exist_ok=True)
-    with open(keyring_path(data_dir), "w") as fh:
+    path = keyring_path(data_dir)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
         json.dump(ring, fh, indent=2)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
 
 
 def validate_key(key: str) -> None:
@@ -53,31 +84,34 @@ def list_keys(data_dir: str) -> Dict:
 
 def install(data_dir: str, key: str) -> None:
     validate_key(key)
-    ring = load(data_dir)
-    if key not in ring["Keys"]:
-        ring["Keys"].append(key)
-    if not ring["Primary"]:
-        ring["Primary"] = key
-    save(data_dir, ring)
+    with _ring_lock(data_dir):
+        ring = load(data_dir)
+        if key not in ring["Keys"]:
+            ring["Keys"].append(key)
+        if not ring["Primary"]:
+            ring["Primary"] = key
+        save(data_dir, ring)
 
 
 def use(data_dir: str, key: str) -> None:
     validate_key(key)
-    ring = load(data_dir)
-    if key not in ring["Keys"]:
-        raise KeyringError("key is not in the keyring")
-    ring["Primary"] = key
-    save(data_dir, ring)
+    with _ring_lock(data_dir):
+        ring = load(data_dir)
+        if key not in ring["Keys"]:
+            raise KeyringError("key is not in the keyring")
+        ring["Primary"] = key
+        save(data_dir, ring)
 
 
 def remove(data_dir: str, key: str) -> None:
     validate_key(key)
-    ring = load(data_dir)
-    if key == ring["Primary"]:
-        raise KeyringError("cannot remove the primary key")
-    if key in ring["Keys"]:
-        ring["Keys"].remove(key)
-        save(data_dir, ring)
+    with _ring_lock(data_dir):
+        ring = load(data_dir)
+        if key == ring["Primary"]:
+            raise KeyringError("cannot remove the primary key")
+        if key in ring["Keys"]:
+            ring["Keys"].remove(key)
+            save(data_dir, ring)
 
 
 def key_response(data_dir: str) -> Dict:

@@ -196,7 +196,9 @@ class TestLxcDriver:
             "template_args": ["--extra", "1"],
         })
         args = d.create_args(ectx, task)
-        assert args[:4] == ["-n", "web-alloc12345", "-t", "download"]
+        name = d.container_name(ectx, task)
+        assert name.startswith("web-alloc12345-")   # per-launch nonce
+        assert args[:4] == ["-n", name, "-t", "download"]
         tail = args[args.index("--") + 1:]
         assert ("--dist", "ubuntu") == tuple(tail[0:2])
         assert ("--release", "xenial") == tuple(tail[2:4])
@@ -212,7 +214,7 @@ class TestLxcDriver:
                                "volumes": ["/host/x:container/x"]})
         cmd, args = d.command_line(ectx, task)
         assert cmd == "lxc-start"
-        assert args[:3] == ["-F", "-n", "web-alloc12345"]
+        assert args[:3] == ["-F", "-n", d.container_name(ectx, task)]
         joined = " ".join(args)
         td = ectx.task_dir
         assert f"lxc.mount.entry={td.shared_alloc_dir} alloc" in joined
@@ -274,3 +276,66 @@ class TestLxcDriver:
         assert created.exists()
         assert resp.handle.wait_ch().wait(20.0)
         assert resp.handle.wait_result().exit_code == 0
+
+    def test_kill_stops_and_destroys_container(self, tmp_path, monkeypatch):
+        """Kill must take down the container itself, not just the
+        lxc-start monitor (lxc.go:388 h.container.Stop()): after the
+        grace period the handle force-stops (-k) and destroys."""
+        import time
+
+        bindir = tmp_path / "bin"
+        bindir.mkdir()
+        stopped = tmp_path / "stopped"
+        destroyed = tmp_path / "destroyed"
+        (bindir / "lxc-create").write_text("#!/bin/sh\nexit 0\n")
+        (bindir / "lxc-start").write_text("#!/bin/sh\nsleep 30\n")
+        (bindir / "lxc-stop").write_text(
+            "#!/bin/sh\nprintf '%s ' \"$@\" > " + str(stopped) + "\nexit 0\n")
+        (bindir / "lxc-destroy").write_text(
+            "#!/bin/sh\nprintf '%s ' \"$@\" > " + str(destroyed) +
+            "\nexit 0\n")
+        for f in bindir.iterdir():
+            f.chmod(f.stat().st_mode | stat.S_IEXEC)
+        monkeypatch.setenv(
+            "PATH", f"{bindir}{os.pathsep}{os.environ['PATH']}")
+        d = LxcDriver(mk_ctx("lxc"))
+        env = TaskEnv(env_map={"PATH": os.environ["PATH"]})
+        ectx = mk_exec_ctx(tmp_path, env)
+        task = mk_task("lxc", {"template": "busybox"})
+        resp = d.start(ectx, task)
+        name = d.container_name(ectx, task)
+        assert resp.handle.container_name == name
+        # Fresh task dir ⇒ no previous launch, so start() must not have
+        # touched the teardown binaries: what lands in the markers below
+        # is attributable to kill() alone.
+        assert not stopped.exists() and not destroyed.exists()
+        resp.handle.kill()
+        assert resp.handle.wait_ch().wait(20.0)
+        deadline = time.time() + 20.0
+        while time.time() < deadline and not destroyed.exists():
+            time.sleep(0.2)
+        assert stopped.read_text().split() == ["-n", name, "-k"]
+        assert destroyed.read_text().split() == ["-n", name, "-f"]
+
+    def test_fingerprint_broken_binary_pops_attrs(self, tmp_path,
+                                                  monkeypatch):
+        """A present-but-broken binary must stop advertising the driver
+        (ADVICE r4): previously only the absent branch popped attrs."""
+        import subprocess as sp
+
+        lxc = tmp_path / "lxc-start"
+        lxc.write_text("#!/bin/sh\necho 2.0.8\n")
+        lxc.chmod(lxc.stat().st_mode | stat.S_IEXEC)
+        monkeypatch.setenv("PATH", str(tmp_path))
+        node = mock.node()
+        node.attributes["driver.lxc"] = "1"
+        node.attributes["driver.lxc.version"] = "2.0.8"
+
+        def boom(*a, **k):
+            raise sp.SubprocessError("broken")
+
+        monkeypatch.setattr(sp, "run", boom)
+        d = LxcDriver(mk_ctx("lxc", {LXC_ENABLE_OPTION: "1"}))
+        assert d.fingerprint(node) is False
+        assert "driver.lxc" not in node.attributes
+        assert "driver.lxc.version" not in node.attributes
