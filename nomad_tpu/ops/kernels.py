@@ -44,6 +44,69 @@ from .encode import (
 NEG_INF = -1e30
 
 
+def jitter_seed(rng_key: jnp.ndarray) -> jnp.ndarray:
+    """One uint32 tie-break seed from a PRNG key (a single scalar draw;
+    the per-(u, n) values come from the counter-based hash below)."""
+    return jax.random.bits(rng_key, (), jnp.uint32)
+
+
+def tie_jitter(seed: jnp.ndarray, u: jnp.ndarray,
+               node_idx: jnp.ndarray) -> jnp.ndarray:
+    """Deterministic per-(spec, node) tie-break jitter in [0, 1e-3).
+
+    murmur3-style integer mix (fmix32) over (seed, u, node index): ~6
+    integer ops per element versus ~48 for threefry — the full-matrix
+    ``jax.random.uniform([U, N])`` this replaced cost 2.6s and a 256MB
+    HBM buffer at the 1024x65536 mega-batch shape, dominating the whole
+    device pass; now each committing spec hashes only its own row.
+
+    Keyed on the GLOBAL node index, so a node shard computing its slice
+    (parallel/sharded.py) gets bit-identical values to the single-chip
+    kernel.  Decorrelates ties exactly like the reference's node
+    shuffling (util.go:325) — magnitude too small to reorder materially
+    different scores; avalanche quality is ample for tie-breaking.
+    """
+    x = (node_idx.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+         + u.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B) + seed)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return (x >> 8).astype(jnp.float32) * jnp.float32(1e-3 / (1 << 24))
+
+
+def _byte_histogram_dense(cand: jnp.ndarray, byte: jnp.ndarray
+                          ) -> jnp.ndarray:
+    """hist[b] = #cand nodes whose current byte == b, as a [256, N]
+    compare-and-reduce with N minor — a dense VPU reduction, the right
+    shape for the TPU's lane-parallel units (no scatter, which the TPU
+    backend serializes)."""
+    bins = jnp.arange(256, dtype=jnp.uint32)
+    return jnp.sum(cand[None, :] & (byte[None, :] == bins[:, None]),
+                   axis=1, dtype=jnp.int32)
+
+
+def _byte_histogram_scatter(cand: jnp.ndarray, byte: jnp.ndarray
+                            ) -> jnp.ndarray:
+    """Same histogram as a 256-bin scatter-add: N index-adds instead of
+    256·N compares — 55x faster than the dense form on the CPU backend
+    (measured 1.9ms vs 105ms per 4-pass select at N=65536), where
+    scatter lowers to efficient serial stores."""
+    return jnp.zeros(256, dtype=jnp.int32).at[byte.astype(jnp.int32)].add(
+        cand.astype(jnp.int32))
+
+
+def _byte_histogram(cand: jnp.ndarray, byte: jnp.ndarray) -> jnp.ndarray:
+    """Backend-dispatched at trace time (jit caches are per-backend, so
+    the choice is consistent for the lifetime of a compiled program).
+    Both forms are exact, so placements are bit-identical either way —
+    pinned by tests/test_tpu_kernels.py."""
+    if jax.default_backend() == "tpu":
+        return _byte_histogram_dense(cand, byte)
+    return _byte_histogram_scatter(cand, byte)
+
+
 def _select_top_k(scored: jnp.ndarray, ok: jnp.ndarray,
                   k: jnp.ndarray) -> jnp.ndarray:
     """Boolean mask of the k highest-scored ok nodes, without a sort.
@@ -51,9 +114,9 @@ def _select_top_k(scored: jnp.ndarray, ok: jnp.ndarray,
     Exact radix-quantile select on the monotone bit-space image of f32:
     IEEE-754 floats map to uint32 such that float order == unsigned
     order (set the sign bit for non-negatives, invert negatives), then
-    the k-th largest value T is found byte-by-byte — 4 passes, each one
-    [N, 256] compare-and-reduce (a dense TPU reduction; no scatter, no
-    data-dependent loop), versus the 45 sequential threshold-bisection
+    the k-th largest value T is found byte-by-byte — 4 histogram passes
+    (dense compare-and-reduce on TPU, scatter-add on CPU; see
+    _byte_histogram), versus the 45 sequential threshold-bisection
     reduce passes this replaced (each a loop-carried [N] pass — latency-
     bound at ~2.7ms/select, the dominant device cost at N ≈ 50k).
 
@@ -66,14 +129,10 @@ def _select_top_k(scored: jnp.ndarray, ok: jnp.ndarray,
     bits = lax.bitcast_convert_type(scored, jnp.uint32)
     ordered = jnp.where((bits >> 31) == 0,
                         bits | jnp.uint32(0x80000000), ~bits)
-    bins = jnp.arange(256, dtype=jnp.uint32)
     bins_i = jnp.arange(256, dtype=jnp.int32)
 
     def radix_pass(cand, byte, above):
-        # hist[b] = #cand nodes whose current byte == b; [256, N] with N
-        # minor so the reduce runs along lanes (TPU-friendly layout).
-        hist = jnp.sum(cand[None, :] & (byte[None, :] == bins[:, None]),
-                       axis=1, dtype=jnp.int32)
+        hist = _byte_histogram(cand, byte)
         cnt_ge = above + jnp.cumsum(hist[::-1])[::-1]
         # cnt_ge is non-increasing in b and cnt_ge[0] >= k (the top-k all
         # carry the known prefix or better), so the threshold byte is the
@@ -324,10 +383,7 @@ def _placement_rounds_impl(
     u_pad, n_pad = feas.shape
     v_pad = dp.used0.shape[1]
 
-    # Deterministic per-(u,n) jitter decorrelates ties exactly like the
-    # reference's node shuffling (util.go:325) — magnitude too small to
-    # reorder materially different scores.
-    jitter = jax.random.uniform(rng_key, (u_pad, n_pad), dtype=jnp.float32) * 1e-3
+    jit_seed = jitter_seed(rng_key)
     node_idx = jnp.arange(n_pad, dtype=jnp.int32)
     big_idx = jnp.int32(n_pad + 1)
 
@@ -379,7 +435,7 @@ def _placement_rounds_impl(
              commit_coll, slots) = carry
             base_score = _score_fit(used, ask[u], denom)
             score = base_score - penalty[u] * collisions.astype(jnp.float32)
-            score = score + jitter[u]
+            score = score + tie_jitter(jit_seed, u, node_idx)
             scored = jnp.where(ok, score, NEG_INF)
 
             # Threshold bisection instead of a full argsort: same

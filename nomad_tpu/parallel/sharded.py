@@ -23,7 +23,14 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.kernels import DPTensors, NetTensors, PlacementResult, _score_fit
+from ..ops.kernels import (
+    DPTensors,
+    NetTensors,
+    PlacementResult,
+    _score_fit,
+    jitter_seed,
+    tie_jitter,
+)
 from ..ops.encode import MISSING
 
 NEG_INF = -1e30
@@ -212,16 +219,17 @@ def sharded_placement_rounds(
         )
     v_pad = dp.used0.shape[1]
 
-    # Identical jitter to the single-chip kernel (same key, same shape) so
-    # placements are bit-compatible; sharded on N by the in_spec.
-    jitter = jax.random.uniform(rng_key, (u_pad, n_pad), dtype=jnp.float32) * 1e-3
+    # Identical tie-break jitter to the single-chip kernel: the hash is
+    # keyed on the GLOBAL node index, so each shard computes its slice
+    # directly — no [U, N] matrix to materialize or shard.
+    jit_seed = jitter_seed(rng_key)
 
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
         in_specs=(P(None, NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS),
                   P(NODE_AXIS), P(None), P(None), P(None), P(None),
-                  P(None), P(None, NODE_AXIS), P(None, NODE_AXIS),
+                  P(None), P(None, NODE_AXIS), P(),
                   # net: per-spec replicated, per-node sharded
                   P(None), P(None), P(None), P(None),
                   P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS),
@@ -230,7 +238,7 @@ def sharded_placement_rounds(
         out_specs=(P(None, NODE_AXIS), P(None), P(NODE_AXIS), P()),
     )
     def _run(feas_l, used_l, cap_l, denom_l, ask_r, count_r, penalty_r,
-             dh_r, job_index_r, jc_l, jitter_l,
+             dh_r, job_index_r, jc_l, jit_seed_r,
              net_active_r, net_mbits_r, dyn_need_r, resv_words_r,
              bw_cap_l, bw_used_l0, dyn_free_l0, port_words_l0,
              dp_col_r, dp_active_r, dp_used0_r, dp_attr_l):
@@ -266,7 +274,7 @@ def sharded_placement_rounds(
 
             score = _score_fit(used, ask_r[u], denom_l)
             score = score - penalty_r[u] * collisions.astype(jnp.float32)
-            score = score + jitter_l[u]
+            score = score + tie_jitter(jit_seed_r, u, gidx)
             scored = jnp.where(ok, score, NEG_INF)
 
             # Local top-k_cand, then the ICI all-gather: the only
@@ -359,7 +367,7 @@ def sharded_placement_rounds(
 
     placements, unplaced, used_after, rounds = _run(
         feas, used0, capacity, denom, ask, count, penalty, distinct_hosts,
-        job_index, job_counts0, jitter,
+        job_index, job_counts0, jit_seed,
         net.active, net.mbits, net.dyn_need, net.resv_words,
         net.bw_cap, net.bw_used, net.dyn_free, net.port_words,
         dp.col, dp.active, dp.used0, dp.attr_values)

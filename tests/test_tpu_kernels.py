@@ -401,3 +401,57 @@ class TestEmptyCluster:
         assert updated and updated[-1].failed_tg_allocs
         m = updated[-1].failed_tg_allocs["web"]
         assert m.nodes_evaluated == 0 and m.nodes_filtered == 0
+
+
+class TestSelectTopK:
+    """The radix-quantile select must be exact and identical across its
+    backend-dispatched histogram forms (kernels._byte_histogram): the
+    dense [256, N] compare (TPU) and the scatter-add (CPU) must give
+    bit-identical masks, and both must match a stable argsort."""
+
+    CASES = [
+        ("uniform", lambda rng, n: rng.random(n).astype(np.float32), 0.9),
+        ("heavy-ties", lambda rng, n: (np.round(
+            rng.random(n).astype(np.float32) * 4) / 4), 0.5),
+        ("all-equal", lambda rng, n: np.full(n, 1.25, np.float32), 1.0),
+        ("negatives", lambda rng, n: rng.standard_normal(n).astype(
+            np.float32), 0.7),
+    ]
+
+    @pytest.mark.parametrize("name,gen,p_ok", CASES)
+    def test_hist_forms_identical_and_exact(self, name, gen, p_ok):
+        from nomad_tpu.ops import kernels as K
+
+        import zlib
+
+        n = 4096
+        rng = np.random.default_rng(zlib.crc32(name.encode()) & 0xFFFF)
+        scores = gen(rng, n)
+        ok = rng.random(n) < p_ok
+        scored = np.where(ok, scores, K.NEG_INF).astype(np.float32)
+
+        def run(hist_fn, k):
+            orig = K._byte_histogram
+            K._byte_histogram = hist_fn
+            try:
+                f = jax.jit(lambda s_, o_, k_: K._select_top_k(s_, o_, k_))
+                return np.asarray(f(jnp.asarray(scored), jnp.asarray(ok),
+                                    jnp.int32(k)))
+            finally:
+                K._byte_histogram = orig
+
+        for k_raw in (1, 37, 1000, n):
+            # The kernel's contract (commit in placement_rounds) clamps
+            # k to the feasible count before selecting.
+            k = min(k_raw, int(ok.sum()))
+            if k == 0:
+                continue
+            dense = run(K._byte_histogram_dense, k)
+            scat = run(K._byte_histogram_scatter, k)
+            assert (dense == scat).all(), f"{name} k={k}: forms diverge"
+            # Exactness vs a stable argsort over (-score, node index).
+            want = np.zeros(n, dtype=bool)
+            order = np.lexsort((np.arange(n), -scored))
+            take = [i for i in order if ok[i]][:k]
+            want[take] = True
+            assert (dense == want).all(), f"{name} k={k}: not exact"
