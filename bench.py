@@ -56,10 +56,15 @@ sys.path.insert(0, str(Path(__file__).parent))
 # a CHILD process (per-phase SIGALRM for Python-level slowness, partial
 # results flushed to disk after every phase) while the PARENT enforces a
 # hard deadline and emits the line from partials if the child wedges.
-TOTAL_BUDGET_S = 450           # child budget for all phases
+TOTAL_BUDGET_S = 450           # child budget for all phases (TPU run)
+DEGRADED_BUDGET_S = 360        # tighter when on the CPU fallback: the
+                               # parent keeps headroom for a mid-round TPU
+                               # liveness probe + a TPU re-run child
 PARENT_DEADLINE_S = 510        # parent kills the child after this
 CHILD_ENV = "NOMAD_TPU_BENCH_CHILD"
 PARTIAL_ENV = "NOMAD_TPU_BENCH_PARTIAL"
+TPU_RETRY_ENV = "NOMAD_TPU_BENCH_TPU_RETRY"   # child 2: core phases on TPU
+BUDGET_ENV = "NOMAD_TPU_BENCH_BUDGET_S"
 
 N_NODES = 10_000
 N_JOBS = 100
@@ -237,19 +242,77 @@ def bench_score_delta(oracle_score_sum: float, oracle_placed: int):
                      "score_regression_exact is the like-for-like check")}
 
 
-def bench_score_exact():
-    """The like-for-like fidelity check behind the ≤0.5% budget: the
-    oracle's LimitIterator samples max(2, log2 N) candidates per
-    placement (select.go:5-44, stack.go:124-137), so its final-state
-    ScoreFit SUM is inflated by accidental spreading (10^freeFrac is
-    convex — spreading raises the sum while packing worse).  Removing
-    the limit turns the oracle into true greedy best-fit — the device
-    kernel's exact objective — and the two must agree within the budget.
-    Runs at 1k-node scale where the O(N·allocs) Python loop is feasible
-    (measured: the aggregates come out bit-identical)."""
-    from nomad_tpu.scheduler import select as select_mod
+def numpy_unlimited_oracle(h, jobs):
+    """Vectorized twin of the UNLIMITED-candidate oracle: true greedy
+    best-fit with the exact reference objective — ScoreFit
+    (funcs.go:123) minus the 20.0 job-anti-affinity penalty per
+    same-job alloc (rank.go:146, encode.py anti_affinity_penalty) —
+    scoring EVERY feasible node per placement, jobs in registration
+    order.  This is what the LimitIterator-patched oracle chain
+    computes, but with the per-placement node loop in numpy + an
+    incremental score update (only the committed node's binpack score
+    changes between placements), so it reaches bench scale (10k nodes x
+    100k tgs in ~1s) where the Python chain would take hours.  Its
+    fidelity to the REAL chain is asserted every run at 1k x 1k
+    (``validation_delta_pct`` must be ~0).
 
-    n, j, c = 1_000, 10, 100
+    Returns (scorefit_sum, nodes_used, placed)."""
+    import numpy as np
+
+    nodes = list(h.state.nodes(None))
+    cap = np.array(
+        [[n.resources.cpu - (n.reserved.cpu if n.reserved else 0),
+          n.resources.memory_mb - (n.reserved.memory_mb if n.reserved else 0)]
+         for n in nodes], dtype=np.float64)
+    used = np.zeros_like(cap)
+    has_alloc = np.zeros(len(nodes), dtype=bool)
+    placed = 0
+
+    def binpack(u):
+        frac = 1.0 - u / cap
+        raw = 20.0 - (10.0 ** frac[:, 0] + 10.0 ** frac[:, 1])
+        return np.clip(raw, 0.0, 18.0)
+
+    for job in jobs:
+        for tg in job.task_groups:
+            ask = np.array(
+                [sum(t.resources.cpu for t in tg.tasks),
+                 sum(t.resources.memory_mb for t in tg.tasks)],
+                dtype=np.float64)
+            # Score of each node AFTER hypothetically adding the ask;
+            # recomputed in full per task group, then incrementally per
+            # placement (only the committed node changes).
+            after = used + ask
+            fits = np.all(after <= cap, axis=1)
+            base = binpack(after)
+            jobcnt = np.zeros(len(nodes), dtype=np.float64)
+            for _ in range(tg.count):
+                eff = np.where(fits, base - 20.0 * jobcnt, -np.inf)
+                i = int(np.argmax(eff))
+                if not np.isfinite(eff[i]):
+                    break
+                used[i] += ask
+                has_alloc[i] = True
+                jobcnt[i] += 1.0
+                placed += 1
+                after_i = used[i] + ask
+                fits[i] = np.all(after_i <= cap[i])
+                frac_i = 1.0 - after_i / cap[i]
+                base[i] = float(np.clip(
+                    20.0 - (10.0 ** frac_i[0] + 10.0 ** frac_i[1]),
+                    0.0, 18.0))
+    frac = 1.0 - used / cap
+    raw = 20.0 - (10.0 ** frac[:, 0] + 10.0 ** frac[:, 1])
+    final = np.where(has_alloc, np.clip(raw, 0.0, 18.0), 0.0)
+    return float(final.sum()), int(has_alloc.sum()), placed
+
+
+def _run_real_unlimited_oracle(n, j, c):
+    """The REAL oracle chain with the LimitIterator candidate cap
+    removed (select.go:5-44, stack.go:124-137): true greedy best-fit
+    through the full iterator stack.  O(N · placements) in Python, so
+    only feasible at small scale."""
+    from nomad_tpu.scheduler import select as select_mod
 
     h, jobs, evals = build_problem(n, j, c)
     patched = select_mod.LimitIterator.set_limit
@@ -269,25 +332,135 @@ def bench_score_exact():
         # oracle" would silently be the sampled one — fail loudly.
         raise RuntimeError("LimitIterator.set_limit never called; "
                            "exact-oracle patch had no effect")
-    oracle_placed = total_placed(h, jobs)
-    o_sum, o_mean, o_used = binpack_scores(h)
+    placed = total_placed(h, jobs)
+    score_sum, _, nodes_used = binpack_scores(h)
+    return score_sum, nodes_used, placed
 
-    h2, jobs2, evals2 = build_problem(n, j, c)
+
+def bench_score_exact():
+    """The like-for-like fidelity check behind the ≤0.5% budget, AT
+    BENCH SCALE (VERDICT r4 #3): the sampled-candidate oracle's
+    ScoreFit sum is inflated by accidental spreading (10^freeFrac is
+    convex), so the honest comparison is against the unlimited-candidate
+    oracle — the kernel's exact objective.  Two-link evidence chain:
+
+      (1) at 1k x 1k, the REAL unlimited oracle chain and its numpy
+          twin must agree (validation_delta_pct ~ 0) — and both match
+          the kernel;
+      (2) at 10k nodes x 100k tgs (the config (b) bench shape), the
+          validated twin vs the kernel proves the budget where the
+          Python chain cannot run (hours).
+    """
+    # Link 1: real chain vs numpy twin vs kernel, 1k x 1k.
+    n1, j1, c1 = 1_000, 10, 100
+    ro_sum, ro_used, ro_placed = _run_real_unlimited_oracle(n1, j1, c1)
+    hv, jobsv, _ = build_problem(n1, j1, c1)
+    nv_sum, nv_used, nv_placed = numpy_unlimited_oracle(hv, jobsv)
+    val_delta = (100.0 * (ro_sum - nv_sum) / ro_sum) if ro_sum else 0.0
+
+    h2, jobs2, evals2 = build_problem(n1, j1, c1)
     run_tpu_batch(h2, evals2)
-    placed = total_placed(h2, jobs2)
-    t_sum, t_mean, t_used = binpack_scores(h2)
-    delta_pct = 100.0 * (o_sum - t_sum) / o_sum if o_sum else 0.0
-    log(f"score-exact: unlimited-oracle sum {o_sum:.1f} mean {o_mean:.4f} "
-        f"nodes {o_used} vs tpu sum {t_sum:.1f} mean {t_mean:.4f} nodes "
-        f"{t_used} → delta {delta_pct:+.3f}% (budget ≤0.5%)")
-    return {"scale": f"{n} nodes x {j*c} tgs",
+    t1_sum, _, t1_used = binpack_scores(h2)
+    delta_1k = (100.0 * (ro_sum - t1_sum) / ro_sum) if ro_sum else 0.0
+    log(f"score-exact 1k: real-chain sum {ro_sum:.1f} ({ro_used} nodes) "
+        f"vs numpy twin {nv_sum:.1f} ({nv_used}) [delta {val_delta:+.4f}%] "
+        f"vs tpu {t1_sum:.1f} ({t1_used}) [delta {delta_1k:+.3f}%]")
+
+    # Link 2: numpy twin vs kernel at the config (b) bench shape.
+    ns, js, cs = N_NODES, N_JOBS, COUNT_PER_JOB
+    ho, jobso, _ = build_problem(ns, js, cs)
+    o_sum, o_used, o_placed = numpy_unlimited_oracle(ho, jobso)
+    ht, jobst, evalst = build_problem(ns, js, cs)
+    run_tpu_batch(ht, evalst)
+    t_placed = total_placed(ht, jobst)
+    t_sum, _, t_used = binpack_scores(ht)
+    delta_pct = (100.0 * (o_sum - t_sum) / o_sum) if o_sum else 0.0
+    log(f"score-exact at scale: twin sum {o_sum:.1f} ({o_used} nodes, "
+        f"{o_placed} placed) vs tpu {t_sum:.1f} ({t_used} nodes, "
+        f"{t_placed} placed) → delta {delta_pct:+.3f}% (budget ≤0.5%)")
+    return {"scale": f"{ns} nodes x {js*cs} tgs",
             "oracle_scorefit_sum": round(o_sum, 1),
             "tpu_scorefit_sum": round(t_sum, 1),
             "oracle_nodes_used": o_used, "tpu_nodes_used": t_used,
             "score_delta_pct": round(delta_pct, 3),
             "budget_pct": 0.5,
             "budget_met": abs(delta_pct) <= 0.5,
-            "oracle_placed": oracle_placed, "tpu_placed": placed}
+            "oracle_placed": o_placed, "tpu_placed": t_placed,
+            "oracle_impl": ("numpy exact-greedy twin of the "
+                            "unlimited-candidate oracle chain, validated "
+                            "against the real chain at 1k x 1k each run"),
+            "validation_1k": {
+                "real_chain_sum": round(ro_sum, 1),
+                "numpy_twin_sum": round(nv_sum, 1),
+                "validation_delta_pct": round(val_delta, 4),
+                "tpu_sum": round(t1_sum, 1),
+                "tpu_delta_pct": round(delta_1k, 3),
+                "real_chain_placed": ro_placed,
+                "numpy_twin_placed": nv_placed}}
+
+
+def bench_single_eval_latency():
+    """Interactive single-eval latency (VERDICT r4 weak-6): ONE eval
+    (one tg, count 1) submitted ~50 times through a LIVE server worker
+    path — end-to-end from job_register to the alloc appearing in
+    state.  Measured for both the TPU BatchWorker and the per-eval
+    oracle Worker on an identical 100-node cluster.
+
+    Dequeue-window note: the BatchWorker adds NO batching delay for a
+    lone eval — EvalBroker.dequeue_batch blocks only until the FIRST
+    eval is ready, then drains whatever else is already queued without
+    waiting (eval_broker.py dequeue_batch), so its single-eval p50 is
+    the scheduler invocation cost, not a batching window.  Reference
+    per-eval loop: nomad/worker.go:106."""
+    from nomad_tpu import mock
+    from nomad_tpu.server import Server, ServerConfig
+
+    def make_node():
+        n = mock.node()
+        n.resources.networks = []
+        n.reserved.networks = []
+        return n
+
+    def one_job():
+        job = make_job(1)
+        return job
+
+    out = {}
+    for key, use_batch in (("tpu_batch_worker", True),
+                           ("oracle_worker", False)):
+        srv = Server(ServerConfig(num_schedulers=1,
+                                  use_tpu_batch_worker=use_batch,
+                                  batch_size=8))
+        srv.start()
+        try:
+            for _ in range(100):
+                srv.node_register(make_node())
+            lat = []
+            runs = 53  # 3 warm-up (first pays XLA compile), 50 measured
+            for i in range(runs):
+                job = one_job()
+                t0 = time.monotonic()
+                srv.job_register(job)
+                deadline = t0 + 30.0
+                while time.monotonic() < deadline:
+                    if srv.state.allocs_by_job(None, job.id, True):
+                        break
+                    time.sleep(0.0005)
+                lat.append(time.monotonic() - t0)
+            lat = sorted(lat[3:])
+            p50 = lat[len(lat) // 2]
+            p95 = lat[int(len(lat) * 0.95)]
+            out[key] = {"p50_ms": round(p50 * 1000, 2),
+                        "p95_ms": round(p95 * 1000, 2),
+                        "evals": len(lat)}
+            log(f"single-eval latency ({key}): p50 {p50*1000:.1f}ms "
+                f"p95 {p95*1000:.1f}ms over {len(lat)} evals")
+        finally:
+            srv.shutdown()
+    out["dequeue_window"] = ("none: dequeue_batch returns on the first "
+                             "ready eval and drains only already-queued "
+                             "work (no batching delay for a lone eval)")
+    return out
 
 
 def bench_system(n_nodes: int):
@@ -547,9 +720,10 @@ class _Budget:
 
 def _child_main():
     partial_path = os.environ.get(PARTIAL_ENV, "")
+    tpu_retry = os.environ.get(TPU_RETRY_ENV) == "1"
 
     detail = {}
-    budget = _Budget(TOTAL_BUDGET_S)
+    budget_s = float(os.environ.get(BUDGET_ENV, 0) or 0)
 
     def flush():
         if not partial_path:
@@ -569,11 +743,17 @@ def _child_main():
 
         jax.config.update("jax_platforms", "cpu")
         detail["degraded"] = ("default backend failed init/probe; cpu "
-                              "fallback, 1 trial per config (north star: 3)")
+                              "fallback (parent re-probes mid-round)")
         log("backend probe FAILED; degrading to CPU")
     detail["platform_probe"] = platform or "unreachable"
     flush()
-    trials = 1 if degraded else 3
+    if not budget_s:
+        budget_s = DEGRADED_BUDGET_S if degraded else TOTAL_BUDGET_S
+    budget = _Budget(budget_s)
+    # Median-of-3 for EVERY config phase (VERDICT r4 #9): the
+    # shared-tenant timing noise applies to all shapes, and the kernel
+    # is now fast enough that 3 trials fit the degraded budget too.
+    trials = 3
 
     def phase(key, seconds, fn, *args, **kwargs):
         """Deadline-bounded, budget-aware phase; failures are recorded,
@@ -600,6 +780,42 @@ def _child_main():
             return None
         flush()
         return result
+
+    if tpu_retry:
+        # Child 2 (TPU came back mid-round): just the primary device
+        # metrics, highest-value first — north star, headline, mega.
+        # The chip answered the PARENT's probe; if it wedged again before
+        # OUR probe, refuse to run — a silent CPU fallback here would be
+        # labeled as TPU numbers by the merge.
+        if degraded:
+            detail["tpu_rerun_aborted"] = (
+                "TPU answered the recovery probe but not the re-run "
+                "child's own probe; no phases run (CPU numbers must not "
+                "masquerade as TPU)")
+            flush()
+            return 0
+        ns = phase("config_northstar_10k_x_1m", 150, run_config, N_NODES,
+                   NS_N_JOBS, COUNT_PER_JOB, "config-northstar", trials=3)
+        if ns is not None:
+            rate_ns, detail_ns = ns
+            detail_ns["target_s"] = 2.0
+            detail_ns["target_met"] = detail_ns["elapsed_s"] < 2.0
+            detail_ns["target_hardware"] = "tpu v5e-1"
+            detail["config_northstar_10k_x_1m"] = detail_ns
+        b = phase("config_b", 100, run_config, N_NODES, N_JOBS,
+                  COUNT_PER_JOB, "config-b", trials=3)
+        if b is not None:
+            rate_b, detail_b = b
+            detail["config_b"] = detail_b
+            detail["headline_rate"] = round(rate_b, 1)
+        e = phase("config_e_50k_nodes_1m_tgs", 120, run_config, E_N_NODES,
+                  E_N_JOBS, COUNT_PER_JOB, "config-e", trials=3)
+        if e is not None:
+            rate_e, detail_e = e
+            detail["config_e_50k_nodes_1m_tgs"] = detail_e
+            detail["config_e_placed_per_s"] = round(rate_e, 1)
+        flush()
+        return 0
 
     # Oracle + score budget first: pure host python, cheap, and they are
     # the baseline every other number is compared against.
@@ -646,6 +862,10 @@ def _child_main():
     if d is not None:
         detail["config_d_system_10k_nodes"] = d
 
+    lat = phase("single_eval_latency_ms", 120, bench_single_eval_latency)
+    if lat is not None:
+        detail["single_eval_latency_ms"] = lat
+
     # The literal BASELINE.json north star: 1M pending task-groups across
     # 10k nodes, target < 2s end to end — before stretch config (e) so a
     # tight budget drops (e), never the north star.
@@ -679,7 +899,8 @@ def _child_main():
         detail["config_e_placed_per_s"] = round(rate_e, 1)
 
     flush()
-    print(json.dumps(_assemble(detail)), flush=True)
+    # The parent assembles and prints the ONE JSON line (it may merge a
+    # TPU re-run on top of these CPU numbers first).
     # rc 0 as long as SOMETHING was measured; non-zero only for a total
     # wipeout (VERDICT r3 weak-2: degraded beats dead).
     measured = rate_b > 0 or oracle_rate > 0
@@ -704,46 +925,128 @@ def _assemble(detail: dict) -> dict:
     return out
 
 
-def main():
-    if os.environ.get(CHILD_ENV) == "1":
-        sys.exit(_child_main())
-
-    # Parent: run the phases in a child with a hard wall-clock backstop.
+def _spawn_child(partial: str, budget_s: float = 0,
+                 tpu_retry: bool = False):
     import subprocess
-    import tempfile
 
-    fd, partial = tempfile.mkstemp(prefix="nomad_tpu_bench_", suffix=".json")
-    os.close(fd)
     env = dict(os.environ)
     env[CHILD_ENV] = "1"
     env[PARTIAL_ENV] = partial
-    proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
+    if budget_s:
+        env[BUDGET_ENV] = str(int(budget_s))
+    if tpu_retry:
+        env[TPU_RETRY_ENV] = "1"
+    return subprocess.Popen([sys.executable, os.path.abspath(__file__)],
                             env=env, start_new_session=True)
+
+
+def _wait_or_kill(proc, timeout: float):
+    """(rc, killed) — SIGKILLs the child's whole session on timeout (a
+    wedged TPU backend sits in C calls no signal can interrupt)."""
+    import subprocess
+
     try:
-        rc = proc.wait(timeout=PARENT_DEADLINE_S)
-        sys.exit(rc)
+        return proc.wait(timeout=max(1, timeout)), False
     except subprocess.TimeoutExpired:
         try:
             os.killpg(proc.pid, signal.SIGKILL)
         except OSError:
             proc.kill()
         proc.wait()
-        try:
-            with open(partial) as fh:
-                detail = json.load(fh)
-        except (OSError, ValueError):
-            detail = {}
+        return None, True
+
+
+def _read_partial(path: str) -> dict:
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return {}
+
+
+def main():
+    if os.environ.get(CHILD_ENV) == "1":
+        sys.exit(_child_main())
+
+    # Parent: phases run in a child with a hard wall-clock backstop; the
+    # parent owns the TPU chip-recovery path (VERDICT r4 #1) — if the
+    # start probe degraded the child to CPU, re-probe mid-round and, if
+    # the chip answers, re-run the core device phases on it.  Every
+    # probe outcome is recorded in ``tpu_probe_history`` so a dead chip
+    # leaves evidence, not absence.
+    import tempfile
+
+    t_start = time.monotonic()
+
+    def elapsed():
+        return time.monotonic() - t_start
+
+    fd, partial = tempfile.mkstemp(prefix="nomad_tpu_bench_", suffix=".json")
+    os.close(fd)
+    partial2 = ""
+    try:
+        proc = _spawn_child(partial)
+        rc, killed = _wait_or_kill(proc, PARENT_DEADLINE_S - 20)
+        detail = _read_partial(partial)
+        probe_history = [{
+            "at_s": 0, "stage": "bench-start",
+            "platform": detail.get("platform_probe", "not-recorded")}]
+        err = None
+        if killed:
+            err = (f"bench child killed at {PARENT_DEADLINE_S - 20}s "
+                   "wall-clock backstop; detail holds completed phases")
+            log("bench child exceeded hard deadline; emitting partials")
+
+        remaining = PARENT_DEADLINE_S - elapsed()
+        if detail.get("degraded") and remaining > 110:
+            # Mid-round recovery probe: cheap, deadline-bounded, and in a
+            # throwaway subprocess so a still-wedged chip costs one
+            # timeout, never a hang.
+            probe_s = int(min(60, remaining - 50))
+            plat = _probe_backend(probe_s)
+            probe_history.append({
+                "at_s": round(elapsed(), 1), "stage": "mid-round-recovery",
+                "platform": plat or "unreachable"})
+            if plat == "tpu":
+                log("TPU answered mid-round; re-running core phases on it")
+                fd2, partial2 = tempfile.mkstemp(
+                    prefix="nomad_tpu_bench_tpu_", suffix=".json")
+                os.close(fd2)
+                remaining = PARENT_DEADLINE_S - elapsed()
+                proc2 = _spawn_child(partial2, budget_s=remaining - 25,
+                                     tpu_retry=True)
+                _, killed2 = _wait_or_kill(proc2, remaining - 10)
+                d2 = _read_partial(partial2)
+                took = {k for k in d2
+                        if k not in ("platform_probe", "degraded")}
+                for k in took:
+                    detail[k] = d2[k]
+                detail["tpu_rerun_phases"] = sorted(
+                    took - {"tpu_rerun_aborted"})
+                if killed2:
+                    detail["tpu_rerun_note"] = (
+                        "TPU re-run child hit the wall-clock backstop; "
+                        "phases listed are the ones that completed")
+        detail["tpu_probe_history"] = probe_history
+
         out = _assemble(detail)
-        out["error"] = (f"bench child killed at {PARENT_DEADLINE_S}s "
-                        "wall-clock backstop; detail holds completed phases")
+        if err:
+            out["error"] = err
         print(json.dumps(out), flush=True)
-        log("bench child exceeded hard deadline; emitted partial results")
-        sys.exit(0)
+        # rc contract (VERDICT r3 weak-2): 0 as long as SOMETHING was
+        # measured; 1 only for a total wipeout.  The child's rc carries
+        # that verdict; a killed child counts as measured if any phase
+        # landed a headline or oracle number in the partial.
+        measured = bool(detail.get("headline_rate")
+                        or detail.get("oracle_placed_per_s"))
+        sys.exit(0 if (rc == 0 or measured) else 1)
     finally:
-        try:
-            os.unlink(partial)
-        except OSError:
-            pass
+        for p in (partial, partial2):
+            if p:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
 
 
 if __name__ == "__main__":
