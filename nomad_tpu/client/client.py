@@ -71,6 +71,8 @@ class Client:
             derive_fn=self._derive_vault_tokens,
             renew_fn=(vault_api.renew_token if vault_api is not None
                       else None),
+            unwrap_fn=(vault_api.unwrap if vault_api is not None
+                       else None),
             logger=self.logger.getChild("vault"))
 
         if not self.config.alloc_dir:

@@ -19,9 +19,11 @@ class ClientVaultClient:
     Vault directly (the reference client renews against Vault itself)."""
 
     def __init__(self, derive_fn: Callable, renew_fn: Optional[Callable],
-                 logger: Optional[logging.Logger] = None):
+                 logger: Optional[logging.Logger] = None,
+                 unwrap_fn: Optional[Callable] = None):
         self.derive_fn = derive_fn
         self.renew_fn = renew_fn
+        self.unwrap_fn = unwrap_fn
         self.logger = logger or logging.getLogger("nomad_tpu.vaultclient")
         self._l = threading.Lock()
         self._heap: List = []          # (due_time, seq, token, ttl)
@@ -44,7 +46,21 @@ class ClientVaultClient:
 
     def derive_token(self, alloc_id: str, task_names: List[str]
                      ) -> Dict[str, Dict]:
-        return self.derive_fn(alloc_id, task_names)
+        out = self.derive_fn(alloc_id, task_names)
+        # Servers response-wrap derived tokens (vault.go getWrappingFn):
+        # unwrap the single-use cubbyhole here so task runners see the
+        # plain {token, accessor, ttl} shape.
+        unwrapped: Dict[str, Dict] = {}
+        for task, info in out.items():
+            if "wrapped_token" in info:
+                if self.unwrap_fn is None:
+                    raise RuntimeError(
+                        "received a wrapped Vault token but no unwrap "
+                        "transport is configured (vault_addr)")
+                unwrapped[task] = self.unwrap_fn(info["wrapped_token"])
+            else:
+                unwrapped[task] = info
+        return unwrapped
 
     # -- renewal heap (vaultclient.go renewal loop) ----------------------
 

@@ -28,16 +28,21 @@ from collections import defaultdict
 from typing import Dict, List, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..scheduler.generic import GenericScheduler
 from ..scheduler.scheduler import register_scheduler
 from ..scheduler.util import AllocTuple, ready_nodes_in_dcs, set_status
 from ..structs import structs as s
-from . import encode, xfer
+from . import encode, kernels, xfer
 from .kernels import device_pass, summary_layout
 
 logger = logging.getLogger("nomad_tpu.ops.batch_sched")
+
+# Count of placement passes that ran node-sharded over a Mesh (test /
+# telemetry introspection for the multi-slice path).
+MESH_PASSES = 0
 
 # Static cluster-tensor cache: (nodes index, attr targets, literals,
 # with_networks) → finalized ClusterTensors (see _place_on_device).
@@ -160,10 +165,16 @@ class TPUBatchScheduler:
     drains the broker into.
     """
 
-    def __init__(self, logger_: logging.Logger, state, planner):
+    def __init__(self, logger_: logging.Logger, state, planner, mesh=None):
         self.logger = logger_
         self.state = state
         self.planner = planner
+        # Optional jax.sharding.Mesh: when set, the placement loop runs
+        # node-sharded over THIS scheduler's device slice
+        # (parallel/sharded.py) — each federated region schedules on its
+        # own mesh, the device-level twin of multi-region federation
+        # (SURVEY §2.9 last row; reference nomad/rpc.go:263).
+        self.mesh = mesh
         _ensure_compile_cache()
 
     # -- single-eval compatibility ----------------------------------------
@@ -392,6 +403,15 @@ class TPUBatchScheduler:
                 idx = node_index.get(node_id)
                 if idx is not None:
                     jc_entries[(j, idx)] = jc_entries.get((j, idx), 0) + 1
+        if self.mesh is not None:
+            if ct.n_pad % self.mesh.devices.size == 0:
+                return self._place_on_mesh(
+                    spec_list, all_nodes, ct, st, jc_entries,
+                    with_networks, t0)
+            self.logger.warning(
+                "mesh size %d does not divide node pad %d; using the "
+                "single-chip path", self.mesh.devices.size, ct.n_pad)
+
         k_jc = encode.pow2_bucket(max(1, len(jc_entries)), minimum=8)
         jc_rows = np.full(k_jc, -1, dtype=np.int32)
         jc_cols = np.zeros(k_jc, dtype=np.int32)
@@ -602,6 +622,94 @@ class TPUBatchScheduler:
                 coo_scores = np.zeros(len(coo), dtype=np.float32)
                 coo_coll = np.zeros(len(coo), dtype=np.int32)
 
+        return self._finalize_device_outputs(
+            spec_list, all_nodes, ct, st, feas, unplaced_arr, feas_count,
+            coo_rows, coo_cols, coo_counts, coo_scores, coo_coll,
+            rounds, with_scores, encode_seconds, t1)
+
+    def _place_on_mesh(self, spec_list, all_nodes, ct, st, jc_entries,
+                       with_networks, t0):
+        """Node-sharded placement over this scheduler's own Mesh
+        (parallel/sharded.py sharded_placement_rounds): feasibility is
+        computed once, the multi-round capacity loop runs with the node
+        axis split across the mesh's devices (local top-k + ICI
+        all-gather per commit), and the shared post-processing consumes
+        the gathered placements.  Bit-identical semantics to the
+        single-chip kernel (pinned by tests/test_parallel.py); the
+        packed-buffer link optimizations of the single-chip path don't
+        apply — each shard holds only its node slice."""
+        global MESH_PASSES
+        from ..parallel.sharded import (
+            DPTensors as SDPTensors,
+            NetTensors as SNetTensors,
+            sharded_placement_rounds,
+        )
+
+        u_pad, n_pad = st.u_pad, ct.n_pad
+        jc = np.zeros((u_pad, n_pad), dtype=np.int32)
+        for (j, nidx), v in jc_entries.items():
+            jc[j, nidx] = v
+        with_dp = any(sp.dp_target is not None for sp in spec_list)
+
+        encode_seconds = time.monotonic() - t0
+        t1 = time.monotonic()
+        feas = kernels.feasibility_matrix(
+            jnp.asarray(ct.attr_values), jnp.asarray(ct.eligible),
+            jnp.asarray(ct.dc_code), jnp.asarray(st.constraint_attr),
+            jnp.asarray(st.constraint_op), jnp.asarray(st.constraint_rhs),
+            jnp.asarray(st.dc_mask), jnp.asarray(st.precomp))
+        net = None
+        if with_networks:
+            net = SNetTensors(
+                active=jnp.asarray(st.net_active),
+                mbits=jnp.asarray(st.net_mbits),
+                dyn_need=jnp.asarray(st.dyn_need),
+                resv_words=jnp.asarray(st.resv_words),
+                bw_cap=jnp.asarray(ct.bw_cap),
+                bw_used=jnp.asarray(ct.bw_used),
+                dyn_free=jnp.asarray(ct.dyn_free),
+                port_words=jnp.asarray(ct.port_words))
+        dp = None
+        if with_dp:
+            dp = SDPTensors(
+                col=jnp.asarray(st.dp_col),
+                active=jnp.asarray(st.dp_active),
+                used0=jnp.asarray(st.dp_used),
+                attr_values=jnp.asarray(ct.attr_values))
+        seed = (int.from_bytes(s.generate_uuid()[:8].encode(), "big")
+                & 0x7FFFFFFF)
+        result = sharded_placement_rounds(
+            self.mesh, feas,
+            jnp.asarray(ct.used.astype(np.int32)),
+            jnp.asarray(ct.capacity.astype(np.int32)),
+            jnp.asarray(ct.score_denom),
+            jnp.asarray(st.ask.astype(np.int32)),
+            jnp.asarray(st.count), jnp.asarray(st.penalty),
+            jnp.asarray(st.distinct_hosts), jnp.asarray(st.job_index),
+            jnp.asarray(jc), jax.random.PRNGKey(seed),
+            net=net, dp=dp)
+        placements = np.asarray(result.placements)
+        unplaced_arr = np.asarray(result.unplaced)
+        rounds = int(result.rounds)
+        feas_count = np.asarray(jnp.sum(feas, axis=1))
+        coo_rows, coo_cols = np.nonzero(placements)
+        coo_counts = placements[coo_rows, coo_cols]
+        coo_scores = np.zeros(len(coo_rows), dtype=np.float32)
+        coo_coll = np.zeros(len(coo_rows), dtype=np.int32)
+        MESH_PASSES += 1
+        return self._finalize_device_outputs(
+            spec_list, all_nodes, ct, st, feas, unplaced_arr, feas_count,
+            coo_rows, coo_cols, coo_counts, coo_scores, coo_coll,
+            rounds, with_scores=False, encode_seconds=encode_seconds,
+            t1=t1)
+
+    def _finalize_device_outputs(self, spec_list, all_nodes, ct, st, feas,
+                                 unplaced_arr, feas_count, coo_rows,
+                                 coo_cols, coo_counts, coo_scores, coo_coll,
+                                 rounds, with_scores, encode_seconds, t1):
+        """Shared device→host post-processing for the single-chip and
+        mesh placement paths: lazy failure-forensics row fetch, COO →
+        per-spec slots, AllocMetric assembly."""
         # Feasibility rows are fetched lazily, only for failed specs whose
         # feasible count is below their EVALUATED count (= ready nodes in
         # their DCs) — i.e. some constraint actually filtered a node.  The

@@ -102,7 +102,9 @@ def _byte_histogram(cand: jnp.ndarray, byte: jnp.ndarray) -> jnp.ndarray:
     the choice is consistent for the lifetime of a compiled program).
     Both forms are exact, so placements are bit-identical either way —
     pinned by tests/test_tpu_kernels.py."""
-    if jax.default_backend() == "tpu":
+    from ..utils.platform import is_tpu_platform
+
+    if is_tpu_platform(jax.default_backend()):
         return _byte_histogram_dense(cand, byte)
     return _byte_histogram_scatter(cand, byte)
 

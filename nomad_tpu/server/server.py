@@ -50,8 +50,18 @@ class ServerConfig:
     num_schedulers: int = 1
     use_tpu_batch_worker: bool = False
     batch_size: int = 64
+    # Optional jax.sharding.Mesh this region's batch scheduler shards its
+    # node axis over — each federated region owns its device slice (the
+    # multi-slice/DCN story, SURVEY §2.9 last row): requests forward
+    # between regions host-side (rpc.go:263), and each region's placement
+    # loop runs on its OWN mesh with ICI collectives inside the slice.
+    device_mesh: object = None
     eval_nack_timeout: float = 60.0
     eval_delivery_limit: int = 3
+    # Retry cadence for queued (failed) Vault revocations
+    # (vault.go:1104 revokeDaemon — 5 minutes there; shorter default so
+    # a failed revoke clears quickly and tests can observe it).
+    vault_revoke_interval: float = 5.0
     min_heartbeat_ttl: float = 10.0
     max_heartbeats_per_second: float = 50.0
     failed_eval_unblock_interval: float = 60.0
@@ -177,6 +187,10 @@ class Server:
         if self.rpc is not None:
             self.rpc.start()
             self._merge_members([self._self_member()])
+        # Every server keeps its own Vault token alive regardless of
+        # leadership (vault.go:467 renewalLoop starts at construction).
+        if self.vault.enabled and (self.config.vault or VaultConfig()).token:
+            self.vault.start_renewal()
         if isinstance(self.raft, MultiRaft):
             self.raft.start()
             self._maybe_bootstrap()
@@ -195,7 +209,8 @@ class Server:
                     blocked_evals=self.blocked_evals, logger=self.logger,
                     time_table=self.time_table,
                     metrics=self.metrics,
-                    max_batch=self.config.batch_size)
+                    max_batch=self.config.batch_size,
+                    mesh=self.config.device_mesh)
             else:
                 worker = Worker(
                     self.eval_broker, self.plan_queue, self.raft,
@@ -439,15 +454,18 @@ class Server:
         self._restore_evals()
         self._restore_periodic_dispatcher()
         self._start_reapers()
+        # Vault activates with leadership (vault.go:290 SetActive): the
+        # revocation queue is ours to drain now; on loss it clears.
+        self.vault.set_active(True)
         self._restore_revoking_accessors()
         # Reconcile voters with members discovered while we were a
         # follower (leader.go establishes raft config on leadership).
         self._maybe_bootstrap()
 
     def _restore_revoking_accessors(self) -> None:
-        """Revoke accessors whose allocation is already terminal or gone —
-        the previous leader may have died mid-revocation
-        (leader.go:221-260 restoreRevokingAccessors)."""
+        """Revoke accessors whose allocation OR node is already terminal
+        or gone — the previous leader may have died mid-revocation
+        (leader.go:221-260 restoreRevokingAccessors checks both)."""
         if not self.vault.enabled:
             return
         stale = []
@@ -455,12 +473,17 @@ class Server:
             alloc = self.state.alloc_by_id(None, acc.alloc_id)
             if alloc is None or alloc.terminal_status():
                 stale.append(acc)
+                continue
+            node = self.state.node_by_id(None, acc.node_id)
+            if node is None or node.terminal_status():
+                stale.append(acc)
         if stale:
             threading.Thread(target=self._revoke_accessors,
                              args=(stale,), daemon=True).start()
 
     def _revoke_leadership(self) -> None:
         self._leader = False
+        self.vault.set_active(False)
         self.eval_broker.set_enabled(False)
         self.plan_queue.set_enabled(False)
         self.blocked_evals.set_enabled(False)
@@ -521,7 +544,24 @@ class Server:
                                  s.CORE_JOB_NODE_GC):
                     self._create_core_eval(core_job)
 
-        for target in (dup_reaper, failed_unblocker, gc_scheduler):
+        def vault_revoke_daemon():
+            # Retry failed revocations until the token TTLs out
+            # (vault.go:1104 revokeDaemon; 5-min cadence there, shorter
+            # here so tests observe it).
+            while self._leader and not self._shutdown.is_set():
+                self._shutdown.wait(self.config.vault_revoke_interval)
+                if not (self._leader and not self._shutdown.is_set()):
+                    return
+                try:
+                    done = self.vault.tick_revocations()
+                except Exception:
+                    self.logger.exception("vault revoke daemon")
+                    continue
+                if done:
+                    self._deregister_accessor_rows(done)
+
+        for target in (dup_reaper, failed_unblocker, gc_scheduler,
+                       vault_revoke_daemon):
             t = threading.Thread(target=target, daemon=True)
             t.start()
             self._reaper_threads.append(t)
@@ -601,6 +641,11 @@ class Server:
 
     def _revoke_accessors(self, accessors) -> None:
         done = self.vault.revoke_accessors([a.accessor for a in accessors])
+        # Failed revocations queue for retry until the token TTLs out
+        # (vault.go storeForRevocation; drained by vault_revoke_daemon).
+        failed = [a for a in accessors if a.accessor not in done]
+        if failed:
+            self.vault.store_for_revocation([a.accessor for a in failed])
         if not done:
             return
         to_remove = [a for a in accessors if a.accessor in done]
@@ -609,6 +654,19 @@ class Server:
                             {"accessors": to_remove})
         except NotLeaderError:
             pass  # new leader's restore pass re-revokes (idempotent)
+
+    def _deregister_accessor_rows(self, accessor_ids) -> None:
+        """Drop accessor rows for ids revoked by the retry daemon."""
+        wanted = set(accessor_ids)
+        rows = [a for a in self.state.vault_accessors(None)
+                if a.accessor in wanted]
+        if not rows:
+            return
+        try:
+            self.raft.apply(MessageType.VAULT_ACCESSOR_DEREGISTER,
+                            {"accessors": rows})
+        except NotLeaderError:
+            pass
 
     # -- heartbeat / periodic callbacks ------------------------------------
 
@@ -1039,6 +1097,9 @@ class Server:
             return self._forward("Node.Deregister", {"NodeID": node_id})["Index"]
         self.heartbeat.clear_heartbeat_timer(node_id)
         self._create_node_evals(node_id, index)
+        # Deregistered node: same revocation sweep as the down
+        # transition (node_endpoint.go:254-264).
+        self._revoke_node_accessors(node_id)
         return index
 
     def node_update_status(self, node_id: str, status: str) -> Tuple[int, float]:
@@ -1066,7 +1127,19 @@ class Server:
             ttl = self.heartbeat.reset_heartbeat_timer(node_id)
         else:
             self.heartbeat.clear_heartbeat_timer(node_id)
+            # A down node's tasks can no longer guard their secrets:
+            # revoke every accessor derived for allocs on it
+            # (node_endpoint.go:339-351).
+            self._revoke_node_accessors(node_id)
         return index, ttl
+
+    def _revoke_node_accessors(self, node_id: str) -> None:
+        if not self.vault.enabled:
+            return
+        accessors = self.state.vault_accessors_by_node(None, node_id)
+        if accessors:
+            threading.Thread(target=self._revoke_accessors,
+                             args=(accessors,), daemon=True).start()
 
     @staticmethod
     def _should_create_node_evals(old: str, new: str) -> bool:
@@ -1155,7 +1228,11 @@ class Server:
         if alloc.job is None:
             alloc = alloc.copy()
             alloc.job = self.state.job_by_id(None, alloc.job_id)
-        tokens = self.vault.derive_token(alloc, task_names)
+        # Response-wrapped (vault.go getWrappingFn): the client receives
+        # a single-use wrapping token, never the raw secret on the wire;
+        # the accessor still registers server-side BEFORE distribution so
+        # failover revocation works even if the client never unwraps.
+        tokens = self.vault.derive_token(alloc, task_names, wrapped=True)
         accessors = [VaultAccessor(
             accessor=info["accessor"], alloc_id=alloc_id,
             node_id=alloc.node_id, task=task,

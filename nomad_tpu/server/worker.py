@@ -207,9 +207,12 @@ class BatchWorker(Worker):
     'tpu-system' pass; core evals stay on the oracle path.
     """
 
-    def __init__(self, *args, max_batch: int = 64, **kwargs):
+    def __init__(self, *args, max_batch: int = 64, mesh=None, **kwargs):
         super().__init__(*args, **kwargs)
         self.max_batch = max_batch
+        # Optional device mesh: placement passes run node-sharded over it
+        # (each federated region schedules on its own slice).
+        self.mesh = mesh
 
     def sched_name(self, ev: s.Evaluation) -> str:
         if ev.type == s.JOB_TYPE_SYSTEM:
@@ -276,7 +279,7 @@ class BatchWorker(Worker):
                 p.reblock_eval(ev)
 
         mux = _MuxPlanner(self, batch)
-        sched = TPUBatchScheduler(self.logger, snap, mux)
+        sched = TPUBatchScheduler(self.logger, snap, mux, mesh=self.mesh)
         try:
             sched.schedule_batch([ev for ev, _ in batch])
             for ev, token in batch:

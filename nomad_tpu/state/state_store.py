@@ -1017,6 +1017,7 @@ class StateStore:
             return [self.vault_accessors_table[a] for a in self._idx_get(self._vault_by_alloc, alloc_id)
                     if a in self.vault_accessors_table]
 
+
     def vault_accessors_by_node(self, ws: Optional[WatchSet], node_id: str) -> List[VaultAccessor]:
         if ws is not None:
             ws.add(self, "vault_accessors")

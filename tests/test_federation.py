@@ -181,3 +181,72 @@ class TestRegionReads:
         listed, _idx = global_srv.job_list(prefix="read-", region="eu")
         assert [j.id for j in listed] == ["read-routed"]
         assert global_srv.job_get("read-routed") is None
+
+
+class TestMultiSliceMesh:
+    """The device-level twin of multi-region federation (SURVEY §2.9
+    last row, VERDICT r4 #4): each region's server owns its OWN device
+    mesh — a disjoint slice of the 8 virtual CPU devices — and its batch
+    scheduler runs the placement loop node-sharded over that mesh
+    (ops/batch_sched._place_on_mesh → parallel/sharded.py).  A job
+    targeting region B submitted to region A forwards host-side
+    (rpc.go:263) and schedules on B's mesh."""
+
+    def test_two_meshes_cross_region(self):
+        import jax
+
+        from nomad_tpu.ops import batch_sched
+        from nomad_tpu.parallel import make_node_mesh
+
+        devs = jax.devices()
+        assert len(devs) >= 8, "conftest must provide the 8-device CPU mesh"
+        mesh_a = make_node_mesh(devs[:4])
+        mesh_b = make_node_mesh(devs[4:8])
+
+        global_srv = Server(ServerConfig(
+            region="global", node_name="global-mesh-1", enable_rpc=True,
+            num_schedulers=1, use_tpu_batch_worker=True,
+            device_mesh=mesh_a))
+        global_srv.start()
+        eu_srv = Server(ServerConfig(
+            region="eu", node_name="eu-mesh-1", enable_rpc=True,
+            num_schedulers=1, use_tpu_batch_worker=True,
+            device_mesh=mesh_b,
+            wan_join=[global_srv.config.rpc_advertise]))
+        eu_srv.start()
+        try:
+            assert wait_until(lambda: len(global_srv.members()) == 2)
+
+            for _ in range(4):
+                node = mock.node()
+                node.resources.networks = []
+                node.reserved.networks = []
+                eu_srv.node_register(node)
+
+            passes_before = batch_sched.MESH_PASSES
+            job = make_job("eu")
+            job.task_groups[0].count = 6
+            index, eval_id = global_srv.job_register(job)
+            assert eval_id
+            # Forwarded: the job lives in eu's state, not global's.
+            assert eu_srv.state.job_by_id(None, job.id) is not None
+            assert global_srv.state.job_by_id(None, job.id) is None
+
+            # Scheduled on B's mesh: all 6 allocs placed...
+            assert wait_until(lambda: len(
+                eu_srv.state.allocs_by_job(None, job.id, True)) == 6,
+                timeout=60.0)
+            # ...by a mesh placement pass, not the single-chip path.
+            assert batch_sched.MESH_PASSES > passes_before
+            # Placements verified: every alloc on a registered eu node,
+            # anti-affinity spread across the 4 nodes (count 6 on 4
+            # nodes → max 2 per node), no overcommit.
+            allocs = eu_srv.state.allocs_by_job(None, job.id, True)
+            per_node = {}
+            for a in allocs:
+                assert eu_srv.state.node_by_id(None, a.node_id) is not None
+                per_node[a.node_id] = per_node.get(a.node_id, 0) + 1
+            assert max(per_node.values()) <= 2 and len(per_node) == 4
+        finally:
+            eu_srv.shutdown()
+            global_srv.shutdown()

@@ -182,3 +182,248 @@ class TestVaultEndToEnd:
                           node_id="gone-node", task="t")]})
         srv._restore_revoking_accessors()
         assert wait_until(lambda: fv.is_revoked(out["accessor"]), 10.0)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class TestSelfTokenRenewal:
+    """The server's own token renewal loop (vault.go:467-567
+    renewalLoop/renew), driven tick-by-tick under a controlled clock."""
+
+    def make(self, ttl=60.0):
+        clock = FakeClock()
+        fv = FakeVault(clock=clock)
+        rec = fv.create_token(["root"], ttl, {})
+        vc = ServerVaultClient(
+            VaultConfig(enabled=True, token=rec["token"]), api=fv,
+            clock=clock, rand=lambda: 0.5)
+        vc.creation_ttl = ttl
+        vc.last_renewed = clock()
+        return clock, fv, vc
+
+    def test_renew_scheduled_at_half_time_to_expiry(self):
+        clock, fv, vc = self.make(ttl=60.0)
+        delay = vc.renewal_tick()
+        assert delay == pytest.approx(30.0)
+        assert fv.renew_calls == 1
+        # Later ticks keep renewing BEFORE expiry: delay is always half
+        # the remaining lease, never past it.
+        for _ in range(5):
+            clock.advance(delay)
+            remaining = vc.last_renewed + vc.creation_ttl - clock()
+            assert remaining > 0, "renewal scheduled past expiry"
+            delay = vc.renewal_tick()
+            assert delay == pytest.approx(30.0)
+
+    def test_error_backoff_ordering_and_cap(self):
+        clock, fv, vc = self.make(ttl=200.0)
+        # Break renewal: revoke the server token.
+        fv.revoke_accessor(fv.tokens[vc.config.token]["accessor"])
+        delays = []
+        for _ in range(6):
+            d = vc.renewal_tick()
+            assert d is not None
+            delays.append(d)
+            clock.advance(min(d, 5.0))
+        # 5 * 1.5 jitter, then *1.25 growth: strictly increasing until
+        # the 30s cap region, and never more than half the remaining
+        # lease (vault.go:498-537).
+        assert delays[0] == pytest.approx(7.5)
+        assert delays[1] == pytest.approx(7.5 * 1.25)
+        for d in delays:
+            remaining = vc.last_renewed + vc.creation_ttl - clock()
+            assert d <= max(remaining / 2.0 + 5.0, 45.0)
+
+    def test_gives_up_past_expiration(self):
+        clock, fv, vc = self.make(ttl=10.0)
+        fv.revoke_accessor(fv.tokens[vc.config.token]["accessor"])
+        clock.advance(11.0)  # past the lease
+        assert vc.renewal_tick() is None
+        assert vc.connection_lost
+
+
+class TestWrappedTokens:
+    """Response-wrapped derive (vault.go:28 vaultTokenCreateTTL +
+    getWrappingFn): single-use cubbyhole, short wrap TTL."""
+
+    def make_alloc(self):
+        job = mock.job()
+        job.task_groups[0].tasks[0].vault = s.Vault(policies=["p1"])
+        alloc = mock.alloc()
+        alloc.job = job
+        alloc.task_group = job.task_groups[0].name
+        return alloc
+
+    def test_wrapped_derive_and_single_use_unwrap(self):
+        clock = FakeClock()
+        fv = FakeVault(clock=clock)
+        vc = ServerVaultClient(VaultConfig(enabled=True), api=fv,
+                               clock=clock)
+        out = vc.derive_token(self.make_alloc(), ["web"], wrapped=True)
+        info = out["web"]
+        assert "token" not in info, "raw secret leaked alongside wrapper"
+        assert info["wrapped_token"].startswith("w.")
+        # The accessor is known BEFORE distribution (failover revoke).
+        assert info["accessor"].startswith("a.")
+        secret = fv.unwrap(info["wrapped_token"])
+        assert fv.lookup_token(secret["token"])["policies"] == ["p1"]
+        with pytest.raises(VaultError):
+            fv.unwrap(info["wrapped_token"])  # single use
+
+    def test_wrapper_expires(self):
+        clock = FakeClock()
+        fv = FakeVault(clock=clock)
+        out = fv.create_token(["p"], 60.0, {}, wrap_ttl=120.0)
+        clock.advance(121.0)
+        with pytest.raises(VaultError):
+            fv.unwrap(out["wrapped_token"])
+
+
+class TestRevocationRetry:
+    """storeForRevocation + revokeDaemon (vault.go:1027, 1104): failed
+    revokes queue and retry until the token TTL; deactivation clears."""
+
+    def test_retry_until_success(self):
+        clock = FakeClock()
+        fv = FakeVault(clock=clock)
+        rec = fv.create_token(["p"], 60.0, {})
+        vc = ServerVaultClient(VaultConfig(enabled=True), api=fv,
+                               clock=clock)
+        fv.fail_revokes = 1
+        assert vc.revoke_accessors([rec["accessor"]]) == []
+        vc.store_for_revocation([rec["accessor"]], ttl=60.0)
+        assert vc.num_revoking() == 1
+        fv.fail_revokes = 1
+        assert vc.tick_revocations() == []      # still failing
+        assert vc.num_revoking() == 1
+        assert vc.tick_revocations() == [rec["accessor"]]
+        assert fv.is_revoked(rec["accessor"])
+        assert vc.num_revoking() == 0
+
+    def test_queue_drops_past_token_ttl(self):
+        clock = FakeClock()
+        fv = FakeVault(clock=clock)
+        vc = ServerVaultClient(VaultConfig(enabled=True), api=fv,
+                               clock=clock)
+        vc.store_for_revocation(["a.dead"], ttl=30.0)
+        clock.advance(31.0)
+        assert vc.tick_revocations() == []
+        assert vc.num_revoking() == 0           # dropped, not revoked
+        assert not fv.is_revoked("a.dead")
+
+    def test_deactivation_clears_queue(self):
+        fv = FakeVault()
+        vc = ServerVaultClient(VaultConfig(enabled=True), api=fv)
+        vc.store_for_revocation(["a.x"], ttl=60.0)
+        vc.set_active(False)                    # another leader takes over
+        assert vc.num_revoking() == 0
+        assert vc.tick_revocations() == []
+
+
+class TestVaultFailureModes:
+    """revoke-on-node-down and restore-after-failover (VERDICT r4 #7)."""
+
+    def test_revoke_on_node_down(self, tmp_path):
+        """Node goes down → its allocs are lost (terminal) → the leader
+        revokes every accessor derived for them (vault.go RevokeTokens
+        via the alloc-terminal hook; leader.go restore checks nodes)."""
+        fv = FakeVault()
+        srv = Server(ServerConfig(num_schedulers=1,
+                                  vault=VaultConfig(enabled=True)),
+                     vault_api=fv)
+        srv.start()
+        cfg = ClientConfig(alloc_dir=str(tmp_path / "allocs"),
+                           state_dir=str(tmp_path / "state"))
+        client = Client(cfg, rpc=srv, vault_api=fv)
+        client.start()
+        try:
+            assert wait_until(
+                lambda: srv.node_get(client.node.id) is not None
+                and srv.node_get(client.node.id).status == "ready")
+            job = mock.job()
+            tg = job.task_groups[0]
+            tg.count = 1
+            tg.restart_policy = s.RestartPolicy(attempts=0, mode="fail")
+            for t in tg.tasks:
+                t.driver = "mock_driver"
+                t.config = {"run_for": "60s"}
+                t.resources.networks = []
+                t.services = []
+                t.vault = s.Vault(policies=["task-policy"])
+            srv.job_register(job)
+            assert wait_until(lambda: any(
+                a.client_status == s.ALLOC_CLIENT_STATUS_RUNNING
+                for a in srv.job_allocations(job.id)))
+            alloc = srv.job_allocations(job.id)[0]
+            assert wait_until(lambda: len(
+                srv.state.vault_accessors_by_alloc(None, alloc.id)) == 1)
+            acc = srv.state.vault_accessors_by_alloc(None, alloc.id)[0]
+
+            # Stop the client's heartbeats, then force the node down.
+            client.shutdown()
+            srv.node_update_status(client.node.id, s.NODE_STATUS_DOWN)
+            assert wait_until(lambda: fv.is_revoked(acc.accessor), 20.0), \
+                "accessor not revoked after node down"
+        finally:
+            srv.shutdown()
+
+    def test_restore_after_failover(self, tmp_path):
+        """A stale accessor registered through the log is revoked by the
+        NEW leader after the old one dies (leader.go:219
+        restoreRevokingAccessors on leadership establishment)."""
+        from nomad_tpu.server.fsm import MessageType
+        from nomad_tpu.state.state_store import VaultAccessor
+
+        fv = FakeVault()
+        servers = []
+        first_addr = None
+        for i in range(3):
+            cfg = ServerConfig(
+                node_name=f"vault-s{i+1}",
+                data_dir=str(tmp_path / f"s{i+1}"),
+                enable_rpc=True, bootstrap_expect=3,
+                start_join=[first_addr] if first_addr else [],
+                num_schedulers=0,
+                vault=VaultConfig(enabled=True))
+            srv = Server(cfg, vault_api=fv)
+            if first_addr is None:
+                first_addr = srv.config.rpc_advertise
+            servers.append(srv)
+        for srv in servers:
+            srv.start()
+        try:
+            assert wait_until(lambda: any(
+                srv.is_leader() for srv in servers), 30.0)
+            leader = next(srv for srv in servers if srv.is_leader())
+
+            # Register an accessor whose alloc does not exist — as if the
+            # old leader died between minting and revoking.
+            out = fv.create_token(["p"], 3600.0, {})
+            leader.raft.apply(
+                MessageType.VAULT_ACCESSOR_REGISTER,
+                {"accessors": [VaultAccessor(
+                    accessor=out["accessor"], alloc_id="gone",
+                    node_id="gone-node", task="t")]})
+            followers = [srv for srv in servers if srv is not leader]
+            assert wait_until(lambda: all(
+                len(srv.state.vault_accessors(None)) == 1
+                for srv in followers), 10.0)
+
+            leader.shutdown()
+            assert wait_until(lambda: any(
+                srv.is_leader() for srv in followers), 30.0)
+            # The new leader's establish hook sweeps and revokes.
+            assert wait_until(lambda: fv.is_revoked(out["accessor"]),
+                              20.0), "new leader did not revoke"
+        finally:
+            for srv in servers:
+                srv.shutdown()
