@@ -117,11 +117,120 @@ class AgentConfig:
         return cfg
 
 
+def expand_env(value: str) -> str:
+    """Environment-variable interpolation for agent config VALUES
+    (the reference expands on parsed values, never raw file bytes — a
+    value containing quotes must not be able to corrupt or inject
+    config syntax): ``${VAR}`` and ``$VAR`` are replaced when VAR is
+    set; unknown names are left untouched so runtime placeholders
+    (e.g. jobspec-style ``${node.class}`` in client meta) survive."""
+    import os
+    import re
+
+    def sub(m):
+        name = m.group(1) or m.group(2)
+        val = os.environ.get(name)
+        return val if val is not None else m.group(0)
+
+    return re.sub(r"\$\{(\w+)\}|\$(\w+)", sub, value)
+
+
+def _interface_ip(name: str) -> str:
+    """IPv4 address of a named interface (SIOCGIFADDR)."""
+    import fcntl
+    import socket
+    import struct
+
+    sk = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        packed = fcntl.ioctl(
+            sk.fileno(), 0x8915,  # SIOCGIFADDR
+            struct.pack("256s", name.encode()[:15]))
+        return socket.inet_ntoa(packed[20:24])
+    finally:
+        sk.close()
+
+
+def _all_interface_ips() -> List[str]:
+    import socket
+
+    out = []
+    for _, name in socket.if_nameindex():
+        try:
+            out.append(_interface_ip(name))
+        except OSError:
+            continue
+    return out
+
+
+def _is_private(ip: str) -> bool:
+    import ipaddress
+
+    try:
+        return ipaddress.ip_address(ip).is_private
+    except ValueError:
+        return False
+
+
+def parse_ip_template(tmpl: str) -> str:
+    """go-sockaddr single-IP template subset for address fields
+    (config.go:787 parseSingleIPTemplate): ``{{ GetPrivateIP }}``,
+    ``{{ GetPublicIP }}``, ``{{ GetInterfaceIP "name" }}``; plain
+    addresses pass through.  Like the reference, resolving to zero or
+    multiple addresses is an error."""
+    import re
+
+    m = re.fullmatch(r"\s*\{\{\s*(\w+)(?:\s+\"([^\"]+)\")?\s*\}\}\s*",
+                     tmpl)
+    if m is None:
+        if "{{" in tmpl:
+            raise ValueError(f"unable to parse address template {tmpl!r}")
+        return tmpl
+    fn, arg = m.group(1), m.group(2)
+    import sys as _sys
+
+    if _sys.platform != "linux":
+        # The interface enumeration uses the Linux SIOCGIFADDR ioctl;
+        # TPU hosts are Linux.  Fail with a clear message elsewhere.
+        raise ValueError(
+            "go-sockaddr address templates are supported on linux only; "
+            "configure a literal address")
+    if fn == "GetInterfaceIP":
+        if not arg:
+            raise ValueError("GetInterfaceIP requires an interface name")
+        try:
+            return _interface_ip(arg)
+        except OSError as e:
+            raise ValueError(
+                f"unable to resolve interface {arg!r}: {e}") from e
+    if fn in ("GetPrivateIP", "GetPublicIP"):
+        want_private = fn == "GetPrivateIP"
+        ips = sorted({ip for ip in _all_interface_ips()
+                      if ip != "127.0.0.1"
+                      and _is_private(ip) == want_private})
+        if not ips:
+            raise ValueError(
+                f"no addresses found for {fn}, please configure one")
+        if len(ips) > 1:
+            # Like the reference (config.go:800): ambiguity is an
+            # error, never a silent first-interface guess.
+            raise ValueError(
+                f"multiple addresses found for {fn} ({', '.join(ips)}), "
+                "please configure one")
+        return ips[0]
+    raise ValueError(f"unsupported address template function {fn!r}")
+
+
+def _expand(v):
+    """Env expansion on a parsed VALUE (strings only)."""
+    return expand_env(v) if isinstance(v, str) else v
+
+
 def _scalar(blk: Block, key: str, default=None):
     e = blk.one(key)
     if e is None or isinstance(e.value, Block):
         return default
-    return e.value
+    return _expand(e.value)
 
 
 def _str_list(blk: Block, key: str) -> List[str]:
@@ -129,22 +238,28 @@ def _str_list(blk: Block, key: str) -> List[str]:
     if e is None or isinstance(e.value, Block):
         return []
     v = e.value
-    return [str(x) for x in v] if isinstance(v, list) else [str(v)]
+    return ([str(_expand(x)) for x in v] if isinstance(v, list)
+            else [str(_expand(v))])
 
 
 def _str_map(blk: Block, key: str) -> Dict[str, str]:
     e = blk.one(key)
     if e is None or not isinstance(e.value, Block):
         return {}
-    return {x.key: str(x.value) for x in e.value.entries
+    return {x.key: str(_expand(x.value)) for x in e.value.entries
             if not isinstance(x.value, Block)}
 
 
 def parse_config(src: str) -> AgentConfig:
-    """Parse an HCL (or JSON) agent config file into AgentConfig."""
+    """Parse an HCL (or JSON) agent config file into AgentConfig.
+    Parsed string values pass through env-var expansion, and address
+    fields accept go-sockaddr templates (config_parse.go +
+    config.go:787)."""
     src_stripped = src.lstrip()
     if src_stripped.startswith("{"):
-        return _from_json(json.loads(src))
+        cfg = _from_json(json.loads(src))
+        cfg.bind_addr = parse_ip_template(cfg.bind_addr)
+        return cfg
     root = parse_hcl(src)
     cfg = AgentConfig()
     cfg.region = str(_scalar(root, "region", cfg.region))
@@ -152,7 +267,8 @@ def parse_config(src: str) -> AgentConfig:
     cfg.name = str(_scalar(root, "name", cfg.name))
     cfg.data_dir = str(_scalar(root, "data_dir", cfg.data_dir))
     cfg.log_level = str(_scalar(root, "log_level", cfg.log_level))
-    cfg.bind_addr = str(_scalar(root, "bind_addr", cfg.bind_addr))
+    cfg.bind_addr = parse_ip_template(
+        str(_scalar(root, "bind_addr", cfg.bind_addr)))
     cfg.enable_debug = bool(_scalar(root, "enable_debug", False))
 
     pe = root.one("ports")
@@ -216,7 +332,7 @@ def _from_json(data: dict) -> AgentConfig:
     for k in ("region", "datacenter", "name", "data_dir", "log_level",
               "bind_addr"):
         if k in data:
-            setattr(cfg, k, data[k])
+            setattr(cfg, k, _expand(data[k]))
     ports = data.get("ports") or {}
     for k in ("http", "rpc", "serf"):
         if k in ports:
@@ -225,7 +341,7 @@ def _from_json(data: dict) -> AgentConfig:
         blk = data.get(blk_name) or {}
         for k, v in blk.items():
             if hasattr(target, k):
-                setattr(target, k, v)
+                setattr(target, k, _expand(v))
     return cfg
 
 

@@ -112,6 +112,7 @@ class HTTPServer:
         r("/v1/status/leader", self.status_leader_request)
         r("/v1/status/peers", self.status_peers_request)
         r("/v1/operator/raft/configuration", self.operator_raft_conf_request)
+        r("/v1/operator/raft/peer", self.operator_raft_peer_request)
         r("/v1/system/gc", self.system_gc_request)
         r("/v1/system/reconcile/summaries", self.system_reconcile_request)
         r("/v1/catalog/services", self.catalog_services_request)
@@ -843,6 +844,23 @@ class HTTPServer:
 
     def operator_raft_conf_request(self, req, query):
         return self.server.raft_configuration(), None
+
+    def operator_raft_peer_request(self, req, query):
+        """DELETE /v1/operator/raft/peer?address=ip:port
+        (operator_endpoint.go OperatorRequest)."""
+        if req.command != "DELETE":
+            raise CodedError(405, "Invalid method")
+        address = query.get("address") or ""
+        if not address:
+            raise CodedError(400, "missing address parameter")
+        try:
+            self.server.operator_raft_remove_peer(address)
+        except KeyError as e:
+            # str(KeyError) reprs its argument (stray quotes).
+            raise CodedError(404, str(e.args[0]) if e.args else "not found")
+        except ValueError as e:
+            raise CodedError(400, str(e))
+        return None, None
 
     def system_gc_request(self, req, query):
         if req.command not in ("PUT", "POST"):

@@ -390,6 +390,11 @@ class Operator:
         obj, _ = self.c.get("/v1/operator/raft/configuration")
         return obj
 
+    def raft_remove_peer_by_address(self, address: str) -> None:
+        """(api/operator.go:69 RaftRemovePeerByAddress)."""
+        self.c.delete("/v1/operator/raft/peer",
+                      QueryOptions(params={"address": address}))
+
 
 class Status:
     """api/status.go."""

@@ -291,6 +291,9 @@ def cmd_status(args, out) -> int:
     api = _api(args)
     if not args.job_id:
         jobs, _ = api.jobs.list()
+        if getattr(args, "json", False):
+            out.write(json.dumps(jobs, indent=4, sort_keys=True) + "\n")
+            return 0
         if not jobs:
             out.write("No running jobs\n")
             return 0
@@ -313,6 +316,10 @@ def cmd_status(args, out) -> int:
         else:
             out.write(f'No job(s) with prefix or id "{args.job_id}" found\n')
             return 1
+    if getattr(args, "json", False):
+        # -json: the raw API representation (command/status.go -json).
+        out.write(json.dumps(to_wire(job), indent=4, sort_keys=True) + "\n")
+        return 0
     periodic = job.is_periodic()
     kv = [
         f"ID|{job.id}", f"Name|{job.name}", f"Type|{job.type}",
@@ -367,6 +374,9 @@ def cmd_node_status(args, out) -> int:
     api = _api(args)
     if not args.node_id:
         nodes, _ = api.nodes.list()
+        if getattr(args, "json", False):
+            out.write(json.dumps(nodes, indent=4, sort_keys=True) + "\n")
+            return 0
         if not nodes:
             out.write("No nodes registered\n")
             return 0
@@ -387,6 +397,9 @@ def cmd_node_status(args, out) -> int:
             out.write(f"  {n['ID']}\n")
         return 1
     node, _ = api.nodes.info(nodes[0]["ID"])
+    if getattr(args, "json", False):
+        out.write(json.dumps(to_wire(node), indent=4, sort_keys=True) + "\n")
+        return 0
     kv = [
         f"ID|{node.id}", f"Name|{node.name}", f"Class|{node.node_class}",
         f"DC|{node.datacenter}", f"Drain|{str(node.drain).lower()}",
@@ -459,6 +472,9 @@ def cmd_alloc_status(args, out) -> int:
             out.write(f"  {a['ID']}\n")
         return 1
     alloc, _ = api.allocations.info(allocs[0]["ID"])
+    if getattr(args, "json", False):
+        out.write(json.dumps(to_wire(alloc), indent=4, sort_keys=True) + "\n")
+        return 0
     kv = [
         f"ID|{alloc.id}", f"Eval ID|{limit(alloc.eval_id)}",
         f"Name|{alloc.name}", f"Node ID|{limit(alloc.node_id)}",
@@ -783,6 +799,18 @@ def cmd_operator_raft(args, out) -> int:
     return 0
 
 
+def cmd_operator_raft_remove(args, out) -> int:
+    """command/operator_raft_remove.go — remove a raft peer by address."""
+    api = _api(args)
+    try:
+        api.operator.raft_remove_peer_by_address(args.peer_address)
+    except APIError as e:
+        out.write(f"Error removing peer: {e}\n")
+        return 1
+    out.write(f"Removed peer with address \"{args.peer_address}\"\n")
+    return 0
+
+
 def cmd_agent(args, out) -> int:
     """command/agent/command.go — run an agent until signalled."""
     from ..agent import Agent, AgentConfig, load_config_file
@@ -900,18 +928,21 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("-detach", action="store_true")))
     add("status", cmd_status, lambda sp: (
         sp.add_argument("job_id", nargs="?", default=""),
-        sp.add_argument("-short", action="store_true")))
+        sp.add_argument("-short", action="store_true"),
+        sp.add_argument("-json", dest="json", action="store_true")))
     add("inspect", cmd_inspect, lambda sp: sp.add_argument("job_id"))
     add("node-status", cmd_node_status, lambda sp: (
         sp.add_argument("node_id", nargs="?", default=""),
-        sp.add_argument("-short", action="store_true")))
+        sp.add_argument("-short", action="store_true"),
+        sp.add_argument("-json", dest="json", action="store_true")))
     add("node-drain", cmd_node_drain, lambda sp: (
         sp.add_argument("node_id"),
         sp.add_argument("-enable", action="store_true"),
         sp.add_argument("-disable", action="store_true")))
     add("alloc-status", cmd_alloc_status, lambda sp: (
         sp.add_argument("alloc_id"),
-        sp.add_argument("-verbose", action="store_true")))
+        sp.add_argument("-verbose", action="store_true"),
+        sp.add_argument("-json", dest="json", action="store_true")))
     add("eval-status", cmd_eval_status, lambda sp: sp.add_argument("eval_id"))
     add("logs", cmd_logs, lambda sp: (
         sp.add_argument("alloc_id"),
@@ -948,6 +979,8 @@ def build_parser() -> argparse.ArgumentParser:
     add("init", cmd_init)
     add("version", cmd_version)
     add("operator-raft-list", cmd_operator_raft)
+    add("operator-raft-remove-peer", cmd_operator_raft_remove, lambda sp:
+        sp.add_argument("-peer-address", dest="peer_address", required=True))
     add("agent", cmd_agent, lambda sp: (
         sp.add_argument("-dev", action="store_true"),
         sp.add_argument("-config", default=""),

@@ -39,6 +39,23 @@ def pallas_enabled() -> bool:
     return os.environ.get("NOMAD_TPU_PALLAS", "") in ("1", "true")
 
 
+def _masked_fit_score(feas_row, used, cap, denom, ask):
+    """Shared kernel body: capacity-fit mask + ScoreFit over one node
+    block, term-for-term with ops/kernels.py:_score_fit.  Both pallas
+    kernels call this so the expression exists exactly once.
+
+    Returns (ok[Nb] bool, score[Nb] f32)."""
+    fits = jnp.all(ask[:, None] <= cap - used, axis=0)
+    ok = (feas_row != 0) & fits
+    after = used[:2].astype(jnp.float32) + ask[:2].astype(jnp.float32)[:, None]
+    safe_denom = jnp.where(denom == 0.0, 1.0, denom)
+    frac = 1.0 - after / safe_denom
+    frac = jnp.where(denom == 0.0, -jnp.inf, frac)
+    total = jnp.power(10.0, frac[0]) + jnp.power(10.0, frac[1])
+    score = jnp.nan_to_num(20.0 - total, nan=0.0, posinf=18.0, neginf=0.0)
+    return ok, jnp.clip(score, 0.0, 18.0)
+
+
 def _score_kernel(feas_ref, used_ref, cap_ref, denom_ref, ask_ref, out_ref):
     """One (spec row, node block): fused fit mask + ScoreFit.
 
@@ -49,24 +66,9 @@ def _score_kernel(feas_ref, used_ref, cap_ref, denom_ref, ask_ref, out_ref):
     ask_ref   [1, 4]  int32  — this spec's ask
     out_ref   [1, Nb] f32    — masked score (NEG_INF where infeasible)
     """
-    used = used_ref[...]                                   # [4, Nb]
-    cap = cap_ref[...]
-    ask = ask_ref[0, :]                                    # [4]
-    denom = denom_ref[...]                                 # [2, Nb]
-
-    fits = jnp.all(ask[:, None] <= cap - used, axis=0)     # [Nb]
-    ok = (feas_ref[0, :] != 0) & fits
-
-    # ScoreFit, term-for-term with ops/kernels.py:_score_fit.
-    after = used[:2].astype(jnp.float32) + ask[:2].astype(jnp.float32)[:, None]
-    safe_denom = jnp.where(denom == 0.0, 1.0, denom)
-    frac = 1.0 - after / safe_denom
-    frac = jnp.where(denom == 0.0, -jnp.inf, frac)
-    total = jnp.power(10.0, frac[0]) + jnp.power(10.0, frac[1])
-    score = 20.0 - total
-    score = jnp.nan_to_num(score, nan=0.0, posinf=18.0, neginf=0.0)
-    score = jnp.clip(score, 0.0, 18.0)
-
+    ok, score = _masked_fit_score(feas_ref[0, :], used_ref[...],
+                                  cap_ref[...], denom_ref[...],
+                                  ask_ref[0, :])
     out_ref[0, :] = jnp.where(ok, score, jnp.float32(NEG_INF))
 
 
@@ -107,6 +109,118 @@ def _masked_score_matrix_impl(feas, used_t, cap_t, denom_t, ask,
         out_shape=out_shape,
         interpret=interpret,
     )(feas, used_t, cap_t, denom_t, ask)
+
+
+def _scored_row_kernel(feas_ref, used_ref, cap_ref, denom_ref, ask_ref,
+                       pen_ref, coll_ref, misc_ref, out_ref):
+    """One (spec row, node block) of the COMPLETE commit-time scoring
+    expression from the placement loop (ops/kernels.py commit):
+
+        scored = where(ok, ScoreFit − penalty·collisions + tie_jitter,
+                       NEG_INF)
+
+    feas_ref  [1, Nb] int8   — static feasibility for this spec
+    used_ref  [4, Nb] int32  — node usage, SoA
+    cap_ref   [4, Nb] int32  — capacity, SoA
+    denom_ref [2, Nb] f32    — cpu/mem denominators, SoA
+    ask_ref   [1, 4]  int32  — this spec's ask
+    pen_ref   [1, 1]  f32    — this spec's anti-affinity penalty
+    coll_ref  [1, Nb] int32  — same-job allocs per node (collisions)
+    misc_ref  [1, 4]  int32  — [jit_seed, u_offset, n_offset, 0]
+    out_ref   [1, Nb] f32
+    """
+    ok, score = _masked_fit_score(feas_ref[0, :], used_ref[...],
+                                  cap_ref[...], denom_ref[...],
+                                  ask_ref[0, :])
+    score = score - pen_ref[0, 0] * coll_ref[0, :].astype(jnp.float32)
+
+    # tie_jitter (ops/kernels.py), term-for-term: fmix32 over
+    # (seed, global spec index, global node index).
+    seed = misc_ref[0, 0]
+    u_glob = misc_ref[0, 1] + pl.program_id(0).astype(jnp.uint32)
+    n_glob = (misc_ref[0, 2]
+              + pl.program_id(1).astype(jnp.uint32) * jnp.uint32(NODE_BLOCK)
+              + jnp.arange(NODE_BLOCK, dtype=jnp.uint32))
+    x = (n_glob * jnp.uint32(0x9E3779B9)
+         + u_glob * jnp.uint32(0x85EBCA6B) + seed)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    score = score + (x >> 8).astype(jnp.float32) * jnp.float32(
+        1e-3 / (1 << 24))
+
+    out_ref[0, :] = jnp.where(ok, score, jnp.float32(NEG_INF))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _scored_rows_impl(feas, used_t, cap_t, denom_t, ask, penalty, coll,
+                      misc, interpret: bool):
+    u, n_pad = feas.shape
+    grid = (u, n_pad // NODE_BLOCK)
+    return pl.pallas_call(
+        _scored_row_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, NODE_BLOCK), lambda iu, ib: (iu, ib)),
+            pl.BlockSpec((4, NODE_BLOCK), lambda iu, ib: (0, ib)),
+            pl.BlockSpec((4, NODE_BLOCK), lambda iu, ib: (0, ib)),
+            pl.BlockSpec((2, NODE_BLOCK), lambda iu, ib: (0, ib)),
+            pl.BlockSpec((1, 4), lambda iu, ib: (iu, 0)),
+            pl.BlockSpec((1, 1), lambda iu, ib: (iu, 0)),
+            pl.BlockSpec((1, NODE_BLOCK), lambda iu, ib: (iu, ib)),
+            pl.BlockSpec((1, 4), lambda iu, ib: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, NODE_BLOCK), lambda iu, ib: (iu, ib)),
+        out_shape=jax.ShapeDtypeStruct((u, n_pad), jnp.float32),
+        interpret=interpret,
+    )(feas, used_t, cap_t, denom_t, ask, penalty, coll, misc)
+
+
+def scored_rows(
+    feas: jax.Array,       # [U, N] bool
+    used: jax.Array,       # [N, 4] int32
+    capacity: jax.Array,   # [N, 4] int32
+    denom: jax.Array,      # [N, 2] float32
+    ask: jax.Array,        # [U, 4] int32
+    penalty: jax.Array,    # [U] float32
+    collisions: jax.Array, # [U, N] int32 — same-job alloc counts
+    jit_seed,              # uint32 scalar (kernels.jitter_seed)
+    u_offset: int = 0,     # global index of feas row 0 (shard offset)
+    n_offset: int = 0,     # global index of node column 0
+    interpret: "bool | None" = None,
+) -> jax.Array:            # [U, N] float32, NEG_INF where infeasible
+    """The complete per-spec commit scoring pass as ONE fused HBM sweep:
+    capacity fit + static feasibility + ScoreFit + anti-affinity
+    penalty + tie-break jitter — differential-tested against the jnp
+    composition in ops/kernels.py's commit (bit-identical except
+    ulp-scale FMA-ordering differences in the penalty term where
+    collisions are nonzero; strictly below the 1e-3 tie-jitter that
+    decides ties).  The jitter hash is keyed on GLOBAL spec/node indices
+    (u_offset/n_offset) so shard slices tile to the single-chip matrix.
+    """
+    u, n = feas.shape
+    n_pad = -(-n // NODE_BLOCK) * NODE_BLOCK
+    pad = n_pad - n
+    feas_i8 = feas.astype(jnp.int8)
+    if pad:
+        feas_i8 = jnp.pad(feas_i8, ((0, 0), (0, pad)))
+        used = jnp.pad(used, ((0, pad), (0, 0)))
+        capacity = jnp.pad(capacity, ((0, pad), (0, 0)))
+        denom = jnp.pad(denom, ((0, pad), (0, 0)))
+        collisions = jnp.pad(collisions, ((0, 0), (0, pad)))
+    if interpret is None:
+        interpret = not is_tpu_platform(jax.default_backend())
+    misc = jnp.stack(
+        [jnp.asarray(jit_seed, jnp.uint32),
+         jnp.uint32(u_offset), jnp.uint32(n_offset),
+         jnp.uint32(0)]).reshape(1, 4)
+    out = _scored_rows_impl(
+        feas_i8, used.T, capacity.T, denom.T, ask,
+        penalty.reshape(-1, 1).astype(jnp.float32),
+        collisions.astype(jnp.int32), misc, interpret)
+    return out[:, :n]
 
 
 def masked_score_matrix(

@@ -269,8 +269,14 @@ def register_endpoints(server, rpc) -> None:
     def operator_raft_config(body):
         return server.raft_configuration()
 
+    def operator_raft_remove_peer(body):
+        server.operator_raft_remove_peer(body.get("Address", ""))
+        return {}
+
     rpc.register("Region.List", region_list)
     rpc.register("Operator.RaftGetConfiguration", operator_raft_config)
+    rpc.register("Operator.RaftRemovePeerByAddress",
+                 operator_raft_remove_peer)
 
     # -- Alloc -------------------------------------------------------------
 

@@ -1051,6 +1051,37 @@ class Server:
             return list(self.raft.peers)
         return [self.config.rpc_advertise]
 
+    def operator_raft_remove_peer(self, address: str) -> None:
+        """Remove a (possibly dead) server from the raft voter set
+        (operator_endpoint.go RaftRemovePeerByAddress →
+        api/operator.go:69): forwards to the leader, which replicates a
+        new configuration without the peer."""
+        if not address:
+            raise ValueError("missing peer address")
+        if not self._leader:
+            try:
+                self._forward("Operator.RaftRemovePeerByAddress",
+                              {"Address": address})
+            except Exception as e:
+                # Re-raise the leader's typed errors so the HTTP layer
+                # maps them to 404/400 regardless of which server served
+                # the request.
+                msg = str(e)
+                if "peer not found" in msg:
+                    raise KeyError(f"peer not found: {address}") from e
+                if "refusing to remove" in msg or "missing peer" in msg:
+                    raise ValueError(msg) from e
+                raise
+            return
+        if address == self.config.rpc_advertise:
+            raise ValueError(
+                "refusing to remove the current leader; remove it from "
+                "another server after leadership moves")
+        peers = [p for p in self.raft.peers if p != address]
+        if len(peers) == len(self.raft.peers):
+            raise KeyError(f"peer not found: {address}")
+        self.raft.propose_config(peers)
+
     def raft_configuration(self) -> Dict:
         leader = self.leader_address()
         servers = []

@@ -357,6 +357,48 @@ client {
         assert cfg.ports.http == 7777
         assert cfg.server.enabled is True
 
+    def test_env_var_interpolation(self, monkeypatch):
+        """config_parse.go: values expand ${VAR}/$VAR from the
+        environment; unknown names stay verbatim (VERDICT r4 #8)."""
+        monkeypatch.setenv("NOMAD_TEST_REGION", "apse")
+        monkeypatch.setenv("NOMAD_TEST_DATA", "/srv/nomad")
+        cfg = parse_config('''
+region   = "${NOMAD_TEST_REGION}"
+data_dir = "$NOMAD_TEST_DATA/agent"
+client {
+  enabled = true
+  meta {
+    placeholder = "${NOT_SET_ANYWHERE_XYZ}"
+  }
+}
+''')
+        assert cfg.region == "apse"
+        assert cfg.data_dir == "/srv/nomad/agent"
+        # Unknown names survive so runtime-interpolated strings pass
+        # through the agent config unharmed.
+        assert cfg.client.meta["placeholder"] == "${NOT_SET_ANYWHERE_XYZ}"
+
+    def test_env_value_cannot_inject_config(self, monkeypatch):
+        """Expansion happens on parsed VALUES, never raw file bytes: a
+        value full of quotes/newlines/braces lands verbatim in the
+        field instead of corrupting or injecting config syntax."""
+        evil = 'x" }\nserver { enabled = true }\nregion = "pwned'
+        monkeypatch.setenv("NOMAD_TEST_EVIL", evil)
+        cfg = parse_config('datacenter = "${NOMAD_TEST_EVIL}"')
+        assert cfg.datacenter == evil
+        assert cfg.server.enabled is False
+        assert cfg.region == "global"
+
+    def test_sockaddr_template_bind_addr(self):
+        """config.go:787 parseSingleIPTemplate subset: bind_addr
+        accepts go-sockaddr templates."""
+        cfg = parse_config('bind_addr = "{{ GetInterfaceIP \\"lo\\" }}"')
+        assert cfg.bind_addr == "127.0.0.1"
+        # Plain addresses pass through untouched.
+        assert parse_config('bind_addr = "0.0.0.0"').bind_addr == "0.0.0.0"
+        with pytest.raises(ValueError):
+            parse_config('bind_addr = "{{ GetMagicIP }}"')
+
 
 class TestAgentMonitor:
     def test_monitor_streams_backlog_and_live_lines(self, agent):

@@ -398,3 +398,40 @@ class TestWireEndpointSurface:
         finally:
             pool.close()
             srv.shutdown()
+
+
+class TestOperatorRemovePeer:
+    """operator raft remove-peer end-to-end (api/operator.go:69
+    RaftRemovePeerByAddress → Operator endpoint → raft config change)."""
+
+    def test_remove_dead_peer_via_follower_forward(self, tmp_path):
+        servers = make_cluster(tmp_path, 3)
+        pool = ConnPool()
+        try:
+            leader = wait_for_leader(servers)
+            followers = [srv for srv in servers if srv is not leader]
+            dead, alive = followers
+            dead_addr = dead.config.rpc_advertise
+            dead.shutdown()
+
+            # Drive the RPC through the SURVIVING FOLLOWER: it must
+            # forward to the leader (rpc.go:178) before mutating.
+            pool.call(alive.config.rpc_advertise,
+                      "Operator.RaftRemovePeerByAddress",
+                      {"Address": dead_addr})
+            assert dead_addr not in leader.raft.peers
+            assert set(leader.raft.peers) == {
+                leader.config.rpc_advertise, alive.config.rpc_advertise}
+            # The new configuration replicates to the survivor.
+            assert wait_until(
+                lambda: dead_addr not in alive.raft.peers, 10.0)
+
+            # Removing an unknown peer errors instead of proposing.
+            with pytest.raises(Exception):
+                pool.call(leader.config.rpc_advertise,
+                          "Operator.RaftRemovePeerByAddress",
+                          {"Address": "10.0.0.9:4647"})
+        finally:
+            pool.close()
+            for srv in servers:
+                srv.shutdown()

@@ -96,3 +96,91 @@ def test_mesh_path_pallas_equals_xla():
                                           use_pallas=True)
     np.testing.assert_array_equal(np.asarray(s_xla), np.asarray(s_pl))
     np.testing.assert_array_equal(np.asarray(i_xla), np.asarray(i_pl))
+
+
+# -- scored_rows: the COMPLETE commit-time scoring expression -------------
+
+def _reference_scored_rows(feas, used, capacity, denom, ask, penalty,
+                           coll, seed, u_offset=0, n_offset=0):
+    from nomad_tpu.ops.kernels import tie_jitter
+
+    u, n = feas.shape
+    node_idx = jnp.arange(n_offset, n_offset + n, dtype=jnp.int32)
+    rows = []
+    for i in range(u):
+        cap_left = capacity - used
+        fits = jnp.all(jnp.asarray(ask[i])[None, :] <= cap_left, axis=1)
+        ok = jnp.asarray(feas[i]) & fits
+        score = _score_fit(jnp.asarray(used), jnp.asarray(ask[i]),
+                           jnp.asarray(denom))
+        score = score - penalty[i] * jnp.asarray(coll[i], jnp.float32)
+        score = score + tie_jitter(jnp.uint32(seed),
+                                   jnp.int32(u_offset + i), node_idx)
+        rows.append(jnp.where(ok, score, jnp.float32(NEG_INF)))
+    return np.asarray(jnp.stack(rows))
+
+
+@pytest.mark.parametrize("n,u,seed,u_off,n_off", [
+    (512, 4, 7, 0, 0),
+    (1024, 8, 11, 0, 0),
+    (700, 3, 13, 0, 0),       # padded node axis
+    (512, 4, 17, 32, 2048),   # shard offsets: global-index jitter keying
+])
+def test_scored_rows_matches_commit_expression(n, u, seed, u_off, n_off):
+    """scored_rows fuses fit+feas+ScoreFit+penalty+jitter; must be
+    bit-identical to the placement loop's commit composition."""
+    from nomad_tpu.ops.pallas_score import scored_rows
+
+    feas, used, capacity, denom, ask = _mk(n, u, seed)
+    rng = np.random.default_rng(seed + 1)
+    penalty = rng.uniform(0.0, 25.0, u).astype(np.float32)
+    coll = (rng.random((u, n)) < 0.1).astype(np.int32) * rng.integers(
+        1, 4, (u, n)).astype(np.int32)
+    got = np.asarray(scored_rows(
+        jnp.asarray(feas), jnp.asarray(used), jnp.asarray(capacity),
+        jnp.asarray(denom), jnp.asarray(ask), jnp.asarray(penalty),
+        jnp.asarray(coll), np.uint32(seed * 2654435761 % (2**32)),
+        u_offset=u_off, n_offset=n_off, interpret=True))
+    want = _reference_scored_rows(
+        feas, used, capacity, denom, ask, penalty, coll,
+        np.uint32(seed * 2654435761 % (2**32)), u_offset=u_off,
+        n_offset=n_off)
+    assert got.shape == want.shape
+    # Bit-identical wherever the penalty term is inactive; where
+    # collisions are nonzero the (score − pen·coll + jitter) chain may
+    # FMA-fuse differently between program shapes — ulp-scale only,
+    # orders of magnitude below the 1e-3 tie-jitter that decides ties.
+    inactive = coll == 0
+    assert (got[inactive] == want[inactive]).all()
+    assert np.allclose(got, want, rtol=0, atol=1e-5), (
+        f"max abs diff {np.abs(got - want).max()}")
+
+
+def test_scored_rows_shard_offsets_tile_global_matrix():
+    """Two shards computing their slices with u/n offsets must tile to
+    exactly the single-chip full matrix (the multichip contract)."""
+    from nomad_tpu.ops.pallas_score import scored_rows
+
+    n, u, seed = 1024, 4, 23
+    feas, used, capacity, denom, ask = _mk(n, u, seed)
+    rng = np.random.default_rng(seed)
+    penalty = rng.uniform(0.0, 25.0, u).astype(np.float32)
+    coll = np.zeros((u, n), np.int32)
+    kw = dict(interpret=True)
+    full = np.asarray(scored_rows(
+        jnp.asarray(feas), jnp.asarray(used), jnp.asarray(capacity),
+        jnp.asarray(denom), jnp.asarray(ask), jnp.asarray(penalty),
+        jnp.asarray(coll), np.uint32(99), **kw))
+    half = n // 2
+    left = np.asarray(scored_rows(
+        jnp.asarray(feas[:, :half]), jnp.asarray(used[:half]),
+        jnp.asarray(capacity[:half]), jnp.asarray(denom[:half]),
+        jnp.asarray(ask), jnp.asarray(penalty),
+        jnp.asarray(coll[:, :half]), np.uint32(99), n_offset=0, **kw))
+    right = np.asarray(scored_rows(
+        jnp.asarray(feas[:, half:]), jnp.asarray(used[half:]),
+        jnp.asarray(capacity[half:]), jnp.asarray(denom[half:]),
+        jnp.asarray(ask), jnp.asarray(penalty),
+        jnp.asarray(coll[:, half:]), np.uint32(99), n_offset=half, **kw))
+    tiled = np.concatenate([left, right], axis=1)
+    assert (tiled == full).all()
