@@ -163,13 +163,19 @@ def _all_interface_ips() -> List[str]:
     return out
 
 
-def _is_private(ip: str) -> bool:
+def _classify(ip: str):
+    """True = private candidate, False = public candidate, None =
+    excluded (loopback/link-local — neither, matching go-sockaddr's
+    GetPrivateIP/GetPublicIP semantics)."""
     import ipaddress
 
     try:
-        return ipaddress.ip_address(ip).is_private
+        a = ipaddress.ip_address(ip)
     except ValueError:
-        return False
+        return None
+    if a.is_loopback or a.is_link_local:
+        return None
+    return a.is_private
 
 
 def parse_ip_template(tmpl: str) -> str:
@@ -206,8 +212,7 @@ def parse_ip_template(tmpl: str) -> str:
     if fn in ("GetPrivateIP", "GetPublicIP"):
         want_private = fn == "GetPrivateIP"
         ips = sorted({ip for ip in _all_interface_ips()
-                      if ip != "127.0.0.1"
-                      and _is_private(ip) == want_private})
+                      if _classify(ip) is want_private})
         if not ips:
             raise ValueError(
                 f"no addresses found for {fn}, please configure one")
@@ -222,8 +227,16 @@ def parse_ip_template(tmpl: str) -> str:
 
 
 def _expand(v):
-    """Env expansion on a parsed VALUE (strings only)."""
-    return expand_env(v) if isinstance(v, str) else v
+    """Env expansion on a parsed VALUE — recursive, so JSON configs with
+    nested lists/maps (client.servers, client.meta) expand the same way
+    the HCL helpers do."""
+    if isinstance(v, str):
+        return expand_env(v)
+    if isinstance(v, list):
+        return [_expand(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _expand(x) for k, x in v.items()}
+    return v
 
 
 def _scalar(blk: Block, key: str, default=None):

@@ -1058,21 +1058,30 @@ class Server:
         new configuration without the peer."""
         if not address:
             raise ValueError("missing peer address")
-        if not self._leader:
+        if self._leader:
             try:
-                self._forward("Operator.RaftRemovePeerByAddress",
-                              {"Address": address})
-            except Exception as e:
-                # Re-raise the leader's typed errors so the HTTP layer
-                # maps them to 404/400 regardless of which server served
-                # the request.
-                msg = str(e)
-                if "peer not found" in msg:
-                    raise KeyError(f"peer not found: {address}") from e
-                if "refusing to remove" in msg or "missing peer" in msg:
-                    raise ValueError(msg) from e
-                raise
-            return
+                self._remove_peer_as_leader(address)
+                return
+            except NotLeaderError:
+                pass  # stepped down mid-flight: forward like everyone else
+        try:
+            self._forward("Operator.RaftRemovePeerByAddress",
+                          {"Address": address})
+        except Exception as e:
+            # The wire encodes errors as "<TypeName>: <message>"
+            # (rpc.py): re-raise the leader's typed errors by TYPE so
+            # the HTTP layer maps them to 404/400 regardless of which
+            # server served the request (message wording may change;
+            # the type prefix is the contract).
+            msg = str(e)
+            if msg.startswith("KeyError"):
+                raise KeyError(f"peer not found: {address}") from e
+            if msg.startswith("ValueError"):
+                raise ValueError(msg.split(": ", 1)[-1]) from e
+            raise
+        return
+
+    def _remove_peer_as_leader(self, address: str) -> None:
         if address == self.config.rpc_advertise:
             raise ValueError(
                 "refusing to remove the current leader; remove it from "

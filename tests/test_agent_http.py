@@ -378,6 +378,18 @@ client {
         # through the agent config unharmed.
         assert cfg.client.meta["placeholder"] == "${NOT_SET_ANYWHERE_XYZ}"
 
+    def test_json_nested_values_expand(self, monkeypatch):
+        """JSON configs expand env vars inside nested lists/maps the
+        same as the HCL helpers."""
+        monkeypatch.setenv("NOMAD_TEST_SRV", "10.1.2.3")
+        monkeypatch.setenv("NOMAD_TEST_RACK", "r9")
+        cfg = parse_config(
+            '{"client": {"enabled": true,'
+            ' "servers": ["${NOMAD_TEST_SRV}:4647"],'
+            ' "meta": {"rack": "$NOMAD_TEST_RACK"}}}')
+        assert cfg.client.servers == ["10.1.2.3:4647"]
+        assert cfg.client.meta["rack"] == "r9"
+
     def test_env_value_cannot_inject_config(self, monkeypatch):
         """Expansion happens on parsed VALUES, never raw file bytes: a
         value full of quotes/newlines/braces lands verbatim in the

@@ -12,6 +12,9 @@ from nomad_tpu import mock
 from nomad_tpu.client import Client, ClientConfig
 from nomad_tpu.server.server import Server, ServerConfig
 
+# Heavy integration/differential module: quick tier skips it (pytest.ini).
+pytestmark = pytest.mark.slow
+
 
 def wait_until(pred, timeout=15.0, interval=0.05):
     deadline = time.time() + timeout

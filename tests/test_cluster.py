@@ -278,6 +278,7 @@ class TestDurableVotes:
         r2.close()
 
 
+@pytest.mark.slow
 class TestClientOverTCP:
     """A client connected to a server purely over the RPC wire — the
     reference's normal client↔server path (client/client.go:465 RPC via

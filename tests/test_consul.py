@@ -13,6 +13,9 @@ from nomad_tpu.consul import CatalogEntry, ServiceCatalog, ServiceClient
 from nomad_tpu.consul.catalog import CHECK_CRITICAL, CHECK_PASSING
 from nomad_tpu.structs import structs as s
 
+# Heavy integration/differential module: quick tier skips it (pytest.ini).
+pytestmark = pytest.mark.slow
+
 
 def wait_until(pred, timeout=15.0, interval=0.05):
     deadline = time.time() + timeout

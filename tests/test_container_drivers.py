@@ -22,6 +22,9 @@ from nomad_tpu.client.driver.driver import (
 from nomad_tpu.client.driver.env import TaskEnv
 from nomad_tpu.structs import structs as s
 
+# Heavy integration/differential module: quick tier skips it (pytest.ini).
+pytestmark = pytest.mark.slow
+
 
 class FakeConfig:
     def __init__(self, options=None):

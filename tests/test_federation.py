@@ -183,6 +183,7 @@ class TestRegionReads:
         assert global_srv.job_get("read-routed") is None
 
 
+@pytest.mark.slow
 class TestMultiSliceMesh:
     """The device-level twin of multi-region federation (SURVEY §2.9
     last row, VERDICT r4 #4): each region's server owns its OWN device

@@ -13,6 +13,9 @@ from nomad_tpu import mock
 from nomad_tpu.client.fs_stream import stream_file_frames, stream_log_frames
 from nomad_tpu.structs import structs as s
 
+# Heavy integration/differential module: quick tier skips it (pytest.ini).
+pytestmark = pytest.mark.slow
+
 
 def collect_frames(gen, n, timeout=10.0):
     """Pull up to n frames from a generator in a worker thread."""

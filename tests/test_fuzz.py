@@ -26,6 +26,9 @@ from nomad_tpu.scheduler import Harness, new_scheduler, new_service_scheduler
 from nomad_tpu.structs import structs as s
 from nomad_tpu.structs.funcs import allocs_fit
 
+# Heavy integration/differential module: quick tier skips it (pytest.ini).
+pytestmark = pytest.mark.slow
+
 
 def make_script(seed: int, steps: int):
     """A deterministic mutation script both engines replay."""

@@ -11,6 +11,9 @@ from nomad_tpu.agent.agent import Agent
 from nomad_tpu.agent.config import AgentConfig
 from nomad_tpu.structs import structs as s
 
+# Heavy integration/differential module: quick tier skips it (pytest.ini).
+pytestmark = pytest.mark.slow
+
 
 def wait_until(pred, timeout=60.0, interval=0.05):
     # 60s default: liveness bound only — the full cluster round-trip

@@ -9,6 +9,9 @@ import pytest
 from nomad_tpu.ops.kernels import _score_fit
 from nomad_tpu.ops.pallas_score import NEG_INF, masked_score_matrix
 
+# Heavy integration/differential module: quick tier skips it (pytest.ini).
+pytestmark = pytest.mark.slow
+
 
 def _reference(feas, used, capacity, denom, ask):
     u = feas.shape[0]

@@ -13,6 +13,9 @@ from nomad_tpu.parallel import (
 )
 from nomad_tpu.ops.kernels import _score_fit, placement_rounds
 
+# Heavy integration/differential module: quick tier skips it (pytest.ini).
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def mesh():

@@ -17,9 +17,11 @@ from nomad_tpu.server import (
 from nomad_tpu.structs import structs as s
 
 
-def wait_until(predicate, timeout=30.0, interval=0.02):
+def wait_until(predicate, timeout=60.0, interval=0.02):
     """Generous default: the first tpu-batch placement in a process pays
-    the XLA compile, which under full-suite load can take >10s."""
+    the XLA compile, which under load can take >10s — and in the quick
+    tier (-m "not slow") no earlier kernel module has warmed the
+    in-process cache, so this file's first placement pays it all."""
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if predicate():

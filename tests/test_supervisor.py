@@ -15,6 +15,9 @@ from nomad_tpu.client.driver.executor import (
     attach_supervised,
 )
 
+# Heavy integration/differential module: quick tier skips it (pytest.ini).
+pytestmark = pytest.mark.slow
+
 
 def _wait_until(fn, timeout=10.0, interval=0.05):
     deadline = time.monotonic() + timeout

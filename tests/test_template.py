@@ -19,6 +19,9 @@ from nomad_tpu.consul import ServiceCatalog
 from nomad_tpu.consul.catalog import CatalogEntry
 from nomad_tpu.structs import structs as s
 
+# Heavy integration/differential module: quick tier skips it (pytest.ini).
+pytestmark = pytest.mark.slow
+
 
 def wait_until(pred, timeout=15.0, interval=0.05):
     deadline = time.time() + timeout

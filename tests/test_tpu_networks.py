@@ -17,6 +17,9 @@ from nomad_tpu.structs.network import (
     NetworkIndex,
 )
 
+# Heavy integration/differential module: quick tier skips it (pytest.ini).
+pytestmark = pytest.mark.slow
+
 
 def reg_eval(job):
     return s.Evaluation(

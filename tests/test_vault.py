@@ -105,6 +105,7 @@ class TestRenewalHeap:
             cvc.stop()
 
 
+@pytest.mark.slow
 class TestVaultEndToEnd:
     """Task gets a derived token; the accessor is registered through the
     log and revoked when the alloc stops (VERDICT r1 #7 'Done' criteria)."""
@@ -329,6 +330,7 @@ class TestRevocationRetry:
         assert vc.tick_revocations() == []
 
 
+@pytest.mark.slow
 class TestVaultFailureModes:
     """revoke-on-node-down and restore-after-failover (VERDICT r4 #7)."""
 
