@@ -79,7 +79,7 @@ def log(*args):
     print(*args, file=sys.stderr, flush=True)
 
 
-def build_cluster(h, n_nodes):
+def build_cluster(h, n_nodes, n_dcs: int = 1):
     from nomad_tpu import mock
 
     base = mock.node()
@@ -90,16 +90,20 @@ def build_cluster(h, n_nodes):
         node.resources.networks = []
         if node.reserved:
             node.reserved.networks = []
+        if n_dcs > 1:
+            node.datacenter = f"dc{i % n_dcs}"
         node.computed_class = base.computed_class or "v1:bench"
         h.state.upsert_node(h.next_index(), node)
 
 
-def make_job(count, constrained=False):
+def make_job(count, constrained=False, datacenters=None):
     from nomad_tpu import mock
     from nomad_tpu.structs import structs as s
 
     job = mock.job()
     job.task_groups[0].count = count
+    if datacenters:
+        job.datacenters = list(datacenters)
     for tg in job.task_groups:
         for t in tg.tasks:
             t.resources.networks = []
@@ -161,14 +165,26 @@ def binpack_scores(h):
 
 
 def build_problem(n_nodes: int, n_jobs: int, count_per_job: int,
-                  constrained: bool = False):
-    """Shared scaffolding: harness + cluster + jobs + register evals."""
+                  constrained: bool = False, n_dcs: int = 1):
+    """Shared scaffolding: harness + cluster + jobs + register evals.
+
+    ``n_dcs > 1`` is the BASELINE config (e) shape ("multi-datacenter +
+    anti-affinity soft scores"): nodes stripe across datacenters and
+    each job targets a deterministic pair of them, so the kernel's
+    dc-mask feasibility runs at bench scale.  (The anti-affinity soft
+    score is active in every config: count>1 service jobs carry the
+    20.0 collision penalty.)"""
     from nomad_tpu.scheduler import Harness
 
     h = Harness()
-    build_cluster(h, n_nodes)
-    jobs = [make_job(count_per_job, constrained=constrained)
-            for _ in range(n_jobs)]
+    build_cluster(h, n_nodes, n_dcs=n_dcs)
+    jobs = []
+    for i in range(n_jobs):
+        dcs = None
+        if n_dcs > 1:
+            dcs = [f"dc{i % n_dcs}", f"dc{(i + 1) % n_dcs}"]
+        jobs.append(make_job(count_per_job, constrained=constrained,
+                             datacenters=dcs))
     for j in jobs:
         h.state.upsert_job(h.next_index(), j)
     return h, jobs, [reg_eval(j) for j in jobs]
@@ -561,7 +577,7 @@ def bench_reschedule(h, jobs):
 
 def run_config(n_nodes: int, n_jobs: int, count_per_job: int, label: str,
                constrained: bool = False, trials: int = 3,
-               keep_state: bool = False):
+               keep_state: bool = False, n_dcs: int = 1):
     """Warm-compiled tpu-batch runs; MEDIAN of ``trials`` (fresh state
     each) headlines — the tunneled host↔device link adds 50-300ms of
     latency jitter per transfer, so a single sample can swing the rate
@@ -574,7 +590,7 @@ def run_config(n_nodes: int, n_jobs: int, count_per_job: int, label: str,
 
     def build():
         return build_problem(n_nodes, n_jobs, count_per_job,
-                             constrained=constrained)
+                             constrained=constrained, n_dcs=n_dcs)
 
     h, jobs, evals = build()
     # Warm-up on the FULL eval set against a snapshot + null planner: state
@@ -620,6 +636,11 @@ def run_config(n_nodes: int, n_jobs: int, count_per_job: int, label: str,
         "rounds": stats.rounds,
         "platform": str(jax.devices()[0].platform),
     }
+    if n_dcs > 1:
+        detail["n_dcs"] = n_dcs
+        detail["note"] = (f"multi-datacenter: {n_dcs} DCs, each job "
+                          "targets 2; anti-affinity soft score active "
+                          "(BASELINE config e)")
     if keep_state:
         return rate, detail, (h, jobs)
     return rate, detail
@@ -809,7 +830,7 @@ def _child_main():
             detail["config_b"] = detail_b
             detail["headline_rate"] = round(rate_b, 1)
         e = phase("config_e_50k_nodes_1m_tgs", 120, run_config, E_N_NODES,
-                  E_N_JOBS, COUNT_PER_JOB, "config-e", trials=3)
+                  E_N_JOBS, COUNT_PER_JOB, "config-e", trials=3, n_dcs=4)
         if e is not None:
             rate_e, detail_e = e
             detail["config_e_50k_nodes_1m_tgs"] = detail_e
@@ -891,8 +912,10 @@ def _child_main():
     if se is not None:
         detail["score_regression_exact"] = se
 
+    # BASELINE config (e) literally: multi-datacenter (4 DCs, jobs
+    # spanning 2) + the anti-affinity soft score.
     e = phase("config_e_50k_nodes_1m_tgs", 120, run_config, E_N_NODES,
-              E_N_JOBS, COUNT_PER_JOB, "config-e", trials=trials)
+              E_N_JOBS, COUNT_PER_JOB, "config-e", trials=trials, n_dcs=4)
     if e is not None:
         rate_e, detail_e = e
         detail["config_e_50k_nodes_1m_tgs"] = detail_e

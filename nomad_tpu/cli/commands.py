@@ -512,6 +512,9 @@ def cmd_eval_status(args, out) -> int:
             out.write(f"  {e.id}\n")
         return 1
     ev = evals[0]
+    if getattr(args, "json", False):
+        out.write(json.dumps(to_wire(ev), indent=4, sort_keys=True) + "\n")
+        return 0
     kv = [
         f"ID|{ev.id}", f"Status|{ev.status}", f"Type|{ev.type}",
         f"TriggeredBy|{ev.triggered_by}", f"Job ID|{ev.job_id}",
@@ -720,6 +723,18 @@ def cmd_server_members(args, out) -> int:
     """command/server_members.go."""
     api = _api(args)
     members = api.agent.members().get("Members", [])
+    if getattr(args, "json", False):
+        out.write(json.dumps(members, indent=4, sort_keys=True) + "\n")
+        return 0
+    if getattr(args, "detailed", False):
+        # (server_members.go -detailed): every gossip tag.
+        rows = ["Name|Address|Port|Tags"]
+        for m in members:
+            tags = ",".join(f"{k}={v}" for k, v in
+                            sorted((m.get("Tags") or {}).items()))
+            rows.append(f"{m['Name']}|{m['Addr']}|{m['Port']}|{tags}")
+        out.write(format_list(rows) + "\n")
+        return 0
     rows = ["Name|Address|Port|Status|Region|DC"]
     for m in members:
         tags = m.get("Tags", {})
@@ -943,7 +958,9 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("alloc_id"),
         sp.add_argument("-verbose", action="store_true"),
         sp.add_argument("-json", dest="json", action="store_true")))
-    add("eval-status", cmd_eval_status, lambda sp: sp.add_argument("eval_id"))
+    add("eval-status", cmd_eval_status, lambda sp: (
+        sp.add_argument("eval_id"),
+        sp.add_argument("-json", dest="json", action="store_true")))
     add("logs", cmd_logs, lambda sp: (
         sp.add_argument("alloc_id"),
         sp.add_argument("task"),
@@ -956,7 +973,9 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("-stat", action="store_true"),
         sp.add_argument("-cat", action="store_true"),
         sp.add_argument("-f", dest="follow", action="store_true")))
-    add("server-members", cmd_server_members)
+    add("server-members", cmd_server_members, lambda sp: (
+        sp.add_argument("-detailed", action="store_true"),
+        sp.add_argument("-json", dest="json", action="store_true")))
     add("server-join", cmd_server_join, lambda sp: sp.add_argument(
         "addresses", nargs="+"))
     add("server-force-leave", cmd_server_force_leave, lambda sp:

@@ -300,9 +300,12 @@ class SupervisedExecutor(Executor):
             stderr=subprocess.DEVNULL, start_new_session=True)
         self.supervisor_pid = proc.pid
         self._sup_proc = proc
-        # Wait for the task pid (or an immediate launch failure).
+        # Wait for the task pid (or an immediate launch failure).  The
+        # supervisor is a fresh interpreter: its startup alone costs
+        # 2-4s on this image (jax pre-import), and full-suite load can
+        # multiply that — a 15s bound flaked roughly once per suite run.
         pid_path = os.path.join(self.ctl_dir, "task.pid")
-        deadline = time.time() + 15.0
+        deadline = time.time() + 45.0
         while time.time() < deadline:
             if os.path.exists(pid_path):
                 with open(pid_path) as fh:

@@ -1075,7 +1075,9 @@ class Server:
             # the type prefix is the contract).
             msg = str(e)
             if msg.startswith("KeyError"):
-                raise KeyError(f"peer not found: {address}") from e
+                # Preserve the leader's message (it may be a different
+                # KeyError than the peer-membership check).
+                raise KeyError(msg.split(": ", 1)[-1].strip("'")) from e
             if msg.startswith("ValueError"):
                 raise ValueError(msg.split(": ", 1)[-1]) from e
             raise

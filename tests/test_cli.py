@@ -350,3 +350,29 @@ class TestOperatorRemovePeerCLI:
                              agent.server.config.rpc_advertise])
         assert code == 1
         assert "Error removing peer" in out
+
+
+class TestMoreJsonAndDetailedFlags:
+    def test_eval_status_json(self, addr, jobfile):
+        import json as json_mod
+
+        from nomad_tpu.api import NomadAPI
+        run_cli(["run", "-address", addr, jobfile])
+        allocs, _ = NomadAPI(addr).jobs.allocations("cli-demo")
+        eval_id = allocs[0]["EvalID"]
+        code, out = run_cli(["eval-status", "-address", addr, "-json",
+                             eval_id])
+        assert code == 0, out
+        assert json_mod.loads(out)["ID"] == eval_id
+
+    def test_server_members_detailed_and_json(self, addr):
+        import json as json_mod
+
+        code, out = run_cli(["server-members", "-address", addr,
+                             "-detailed"])
+        assert code == 0
+        assert "Tags" in out and "region=" in out
+        code, out = run_cli(["server-members", "-address", addr, "-json"])
+        assert code == 0
+        members = json_mod.loads(out)
+        assert members and members[0]["Name"]
