@@ -45,13 +45,15 @@ def _masked_fit_score(feas_row, used, cap, denom, ask):
     kernels call this so the expression exists exactly once.
 
     Returns (ok[Nb] bool, score[Nb] f32)."""
+    from .kernels import _pow10
+
     fits = jnp.all(ask[:, None] <= cap - used, axis=0)
     ok = (feas_row != 0) & fits
     after = used[:2].astype(jnp.float32) + ask[:2].astype(jnp.float32)[:, None]
     safe_denom = jnp.where(denom == 0.0, 1.0, denom)
     frac = 1.0 - after / safe_denom
     frac = jnp.where(denom == 0.0, -jnp.inf, frac)
-    total = jnp.power(10.0, frac[0]) + jnp.power(10.0, frac[1])
+    total = _pow10(frac[0]) + _pow10(frac[1])
     score = jnp.nan_to_num(20.0 - total, nan=0.0, posinf=18.0, neginf=0.0)
     return ok, jnp.clip(score, 0.0, 18.0)
 
