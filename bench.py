@@ -575,6 +575,110 @@ def bench_reschedule(h, jobs):
             "replaced_per_s": round(rate, 1)}
 
 
+def bench_preempt():
+    """config_preempt: priority-tier preemption at scale — 10k nodes
+    filled to ~93% with low-priority work (tiers 10 and 30, mixed sizes)
+    plus 50k high-priority task groups whose ask does NOT fit the free
+    headroom: every placement must evict lower-priority allocs via the
+    batched eviction-set kernel (ops/preempt.py).  Reports placements
+    won by preemption, evicted allocs, the kernel-vs-oracle eviction-set
+    agreement (acceptance bar: 100%), the never-evict-priority->= check,
+    and the blocked evals created for the evicted jobs."""
+    from nomad_tpu.ops.batch_sched import TPUBatchScheduler
+    from nomad_tpu.scheduler import Harness
+    from nomad_tpu.structs import structs as s
+
+    n_nodes = 10_000
+    n_hi_jobs = 50
+    count_per_hi_job = 1_000          # 50k high-priority task groups
+
+    h = Harness()
+    build_cluster(h, n_nodes)
+    # Two filler tiers so eviction order (priority asc, largest-first)
+    # matters; 7 x (520 cpu, 1060 mb) per node = ~93% of the usable
+    # 3900/7936 — the free 260 cpu cannot fit a 500-cpu ask, one
+    # eviction can.
+    fillers = []
+    for prio in (10, 30):
+        fj = make_job(0)
+        fj.priority = prio
+        h.state.upsert_job(h.next_index(), fj)
+        fillers.append(fj)
+    filler_allocs = []
+    for i in range(n_nodes):
+        nid = f"node-{i:06d}"
+        for k in range(7):
+            fj = fillers[k % 2]
+            filler_allocs.append(s.Allocation(
+                id=s.generate_uuid(), job_id=fj.id, job=fj, node_id=nid,
+                task_group="web", name=f"{fj.name}.web[{k}]",
+                resources=s.Resources(cpu=520, memory_mb=1060)))
+    h.state.upsert_allocs(h.next_index(), filler_allocs)
+
+    jobs = []
+    for _ in range(n_hi_jobs):
+        job = make_job(count_per_hi_job)
+        job.priority = 70
+        for t in job.task_groups[0].tasks:
+            t.resources = s.Resources(cpu=500, memory_mb=256)
+        jobs.append(job)
+        h.state.upsert_job(h.next_index(), job)
+    evals = [reg_eval(j) for j in jobs]
+
+    # Warm pass (XLA compile for the placement + eviction kernels)
+    # against a snapshot + null planner; timed run on live state.
+    warm = TPUBatchScheduler(h.logger, h.snapshot(), NullPlanner(),
+                             preemption_enabled=True)
+    t0 = time.monotonic()
+    warm.schedule_batch(evals)
+    compile_s = time.monotonic() - t0
+
+    sched = TPUBatchScheduler(h.logger, h.snapshot(), h,
+                              preemption_enabled=True)
+    t0 = time.monotonic()
+    stats = sched.schedule_batch(evals)
+    elapsed = time.monotonic() - t0
+
+    placed_total = total_placed(h, jobs)
+    evicted = [a for a in h.state.allocs(None)
+               if a.desired_status == s.ALLOC_DESIRED_STATUS_EVICT]
+    evicted_jobs = {a.job_id for a in evicted}
+    preempt_evals = [ev for ev in h.create_evals
+                     if ev.triggered_by == s.EVAL_TRIGGER_PREEMPTION]
+    agreement_pct = (100.0 * stats.preempt_agree / stats.preempt_checked
+                     if stats.preempt_checked else 0.0)
+    # Invariant sweep: no evicted alloc may be at priority >= 70.
+    victim_prios = {h.state.job_by_id(None, jid).priority
+                    for jid in evicted_jobs}
+    log(f"config-preempt: {stats!r}")
+    log(f"config-preempt: {stats.preempt_placed} placed via preemption "
+        f"({placed_total} total), {stats.preempt_evicted} evicted, "
+        f"agreement {agreement_pct:.1f}% "
+        f"({stats.preempt_agree}/{stats.preempt_checked}), "
+        f"{len(preempt_evals)} blocked evals for {len(evicted_jobs)} "
+        f"evicted jobs, in {elapsed:.2f}s")
+    return {
+        "nodes": n_nodes,
+        "high_priority_taskgroups": n_hi_jobs * count_per_hi_job,
+        "placed_via_preemption": stats.preempt_placed,
+        "evicted_allocs": stats.preempt_evicted,
+        "kernel_oracle_agreement_pct": round(agreement_pct, 2),
+        "agreement_checked": stats.preempt_checked,
+        "max_victim_priority": max(victim_prios) if victim_prios else None,
+        "no_eviction_of_priority_ge_placing": (
+            all(p < 70 for p in victim_prios)),
+        "blocked_evals_for_evicted_jobs": len(preempt_evals),
+        "evicted_jobs": len(evicted_jobs),
+        "blocked_evals_cover_all_evicted_jobs": (
+            {ev.job_id for ev in preempt_evals} >= evicted_jobs),
+        "total_placed": placed_total,
+        "elapsed_s": round(elapsed, 3),
+        "compile_warmup_s": round(compile_s, 3),
+        "preempt_placed_per_s": round(
+            stats.preempt_placed / elapsed, 1) if elapsed else 0.0,
+    }
+
+
 def run_config(n_nodes: int, n_jobs: int, count_per_job: int, label: str,
                constrained: bool = False, trials: int = 3,
                keep_state: bool = False, n_dcs: int = 1):
@@ -654,7 +758,8 @@ class NullPlanner:
 
         return s.PlanResult(node_update=plan.node_update,
                             node_allocation=plan.node_allocation,
-                            alloc_slabs=plan.alloc_slabs), None
+                            alloc_slabs=plan.alloc_slabs,
+                            node_preemptions=plan.node_preemptions), None
 
     def update_eval(self, ev):
         pass
@@ -871,6 +976,10 @@ def _child_main():
         r = phase("reschedule", 90, bench_reschedule, h_b, jobs_b)
         if r is not None:
             detail["reschedule"] = r
+
+    p = phase("config_preempt", 90, bench_preempt)
+    if p is not None:
+        detail["config_preempt"] = p
 
     c = phase("config_c", 90, run_config, 5_000, 50, COUNT_PER_JOB,
               "config-c", constrained=True, trials=trials)

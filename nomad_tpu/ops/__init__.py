@@ -21,3 +21,4 @@ from .kernels import (
     feasibility_matrix,
     placement_rounds,
 )
+from .preempt import encode_alloc_tensors, eviction_sets

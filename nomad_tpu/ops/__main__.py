@@ -1,0 +1,33 @@
+"""`python -m nomad_tpu.ops --selfcheck`: fast oracle/kernel agreement
+checks runnable without a test harness (CI smoke; seconds on CPU).
+
+Currently covers the preemption subsystem: the batched eviction-set
+kernel (ops/preempt.py) must produce exactly the oracle's
+(scheduler/preempt.py) eviction set for every (task-group, node) pair
+of a seeded random 64x64 cluster.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .preempt import selfcheck
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m nomad_tpu.ops")
+    parser.add_argument("--selfcheck", action="store_true",
+                        help="run the oracle-vs-kernel agreement checks")
+    parser.add_argument("--nodes", type=int, default=64)
+    parser.add_argument("--specs", type=int, default=64)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+    if not args.selfcheck:
+        parser.print_help()
+        return 2
+    ok = selfcheck(n_nodes=args.nodes, n_specs=args.specs, seed=args.seed)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

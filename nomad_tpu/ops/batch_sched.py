@@ -165,7 +165,8 @@ class TPUBatchScheduler:
     drains the broker into.
     """
 
-    def __init__(self, logger_: logging.Logger, state, planner, mesh=None):
+    def __init__(self, logger_: logging.Logger, state, planner, mesh=None,
+                 preemption_enabled: Optional[bool] = None):
         self.logger = logger_
         self.state = state
         self.planner = planner
@@ -175,6 +176,20 @@ class TPUBatchScheduler:
         # own mesh, the device-level twin of multi-region federation
         # (SURVEY §2.9 last row; reference nomad/rpc.go:263).
         self.mesh = mesh
+        if preemption_enabled is None:
+            from ..scheduler.preempt import preemption_enabled_default
+
+            preemption_enabled = preemption_enabled_default()
+        # Priority-tier preemption (scheduler/preempt.py semantics, batched
+        # by ops/preempt.py): when the main placement pass leaves
+        # high-priority asks unplaced, a second device pass computes
+        # eviction sets over strictly-lower-priority allocs.
+        self.preemption_enabled = preemption_enabled
+        # Per-batch preemption commits: (job, tg) key → list of
+        # (node_id, victim allocs) consumed by _finalize.
+        self._preempt_plan: Dict[Tuple[str, str],
+                                 List[Tuple[str, List[s.Allocation]]]] = {}
+        self._allocs_by_node: Dict[str, List[s.Allocation]] = {}
         _ensure_compile_cache()
 
     # -- single-eval compatibility ----------------------------------------
@@ -189,6 +204,7 @@ class TPUBatchScheduler:
         all of them, then finalize plans/statuses per eval."""
         stats = BatchStats()
         t0 = time.monotonic()
+        self._preempt_plan = {}
 
         # Phase 1: host reconciliation per eval (shared oracle code).
         t_phase1 = time.monotonic()
@@ -246,7 +262,8 @@ class TPUBatchScheduler:
                         "batch: eval %s routed through oracle", ev.id)
                     oracle = GenericScheduler(
                         self.logger, self.state, self.planner,
-                        batch=(ev.type == s.JOB_TYPE_BATCH))
+                        batch=(ev.type == s.JOB_TYPE_BATCH),
+                        preemption_enabled=self.preemption_enabled)
                     oracle.process(ev)
                 else:
                     kept.append((ev, sched))
@@ -271,6 +288,10 @@ class TPUBatchScheduler:
             stats.encode_seconds = kstats["encode_seconds"]
             stats.metrics_seconds = kstats["metrics_seconds"]
             stats.rounds = kstats["rounds"]
+            stats.preempt_placed = kstats.get("preempt_placed", 0)
+            stats.preempt_evicted = kstats.get("preempt_evicted", 0)
+            stats.preempt_checked = kstats.get("preempt_checked", 0)
+            stats.preempt_agree = kstats.get("preempt_agree", 0)
 
         # Phase 3: materialize allocs into each eval's plan and submit.
         t_final = time.monotonic()
@@ -355,6 +376,7 @@ class TPUBatchScheduler:
                 if not alloc.terminal_status():
                     allocs_by_node[alloc.node_id].append(alloc)
 
+        self._allocs_by_node = allocs_by_node
         with_networks = any(sp.net_active for sp in spec_list)
         # Static cluster tensors are cached across batches keyed by the
         # nodes-table raft index (+ the constraint vocabulary): a stable
@@ -782,6 +804,21 @@ class TPUBatchScheduler:
                           vcnt.astype(np.int64)[:, None]
                           * np.asarray(st.ask)[vr.astype(np.int64)])
 
+        # Priority-tier preemption: a second device pass over the specs
+        # the capacity loop could NOT place, evicting strictly-lower-
+        # priority allocs to make room (ops/preempt.py kernel; committed
+        # sets recorded in self._preempt_plan for _finalize, unplaced_arr
+        # decremented so the failure forensics below see the post-
+        # preemption truth).
+        preempt_stats = {}
+        if (self.preemption_enabled and used_after is not None
+                and len(self._allocs_by_node)):
+            # Writable copy: the fetched summary buffer is read-only, and
+            # the pass decrements the counts it fills.
+            unplaced_arr = np.array(unplaced_arr)
+            preempt_stats = self._preempt_pass(
+                spec_list, ct, st, feas, unplaced_arr, used_after)
+
         expanded: Dict[Tuple[str, str], List[str]] = {}
         unplaced: Dict[Tuple[str, str], int] = {}
         metrics: Dict[Tuple[str, str], s.AllocMetric] = {}
@@ -849,7 +886,144 @@ class TPUBatchScheduler:
             "metrics_seconds": time.monotonic() - t_metrics,
             "rounds": rounds,
         }
+        kstats.update(preempt_stats)
         return expanded, unplaced, metrics, kstats
+
+    # -- preemption pass ----------------------------------------------------
+
+    def _preempt_pass(self, spec_list, ct, st, feas,
+                      unplaced_arr, used_after) -> Dict[str, int]:
+        """Batched eviction-set pass for the asks the capacity loop left
+        unplaced: ONE kernel invocation computes, for every still-failing
+        (task-group, node) pair, the minimal set of strictly-lower-
+        priority allocs to evict and the post-eviction fit score
+        (ops/preempt.py — the device twin of scheduler/preempt.py).
+
+        The host then commits greedily in the batch's priority order:
+        best effective score (post-eviction binpack minus the preemption
+        discount) first, at most ONE preempting placement per node per
+        batch — a second eviction on the same node would need the
+        post-first-eviction state the kernel did not see.  Every commit
+        is cross-checked against the scalar oracle on identical inputs;
+        the agreement counters surface in BatchStats (the bench's
+        kernel-vs-oracle eviction-set agreement metric).
+
+        Specs with network asks, distinct_hosts, or distinct_property
+        keep the no-preemption result: their feasibility state after an
+        eviction is not expressible in this kernel's inputs."""
+        from ..scheduler import preempt as preempt_oracle
+        from . import preempt as preempt_ops
+
+        pu = [u for u in range(st.u_real)
+              if unplaced_arr[u] > 0
+              and spec_list[u].priority > 0
+              and not spec_list[u].net_active
+              and spec_list[u].dp_target is None
+              and not spec_list[u].distinct_hosts]
+        if not pu:
+            return {}
+
+        state = self.state
+
+        def prio_of(a: s.Allocation) -> int:
+            return preempt_oracle.alloc_priority(a, state)
+
+        # Materialized candidate rows, NOT self._allocs_by_node: the
+        # usage-encoding rows are shared slab PROTOS for slab-backed
+        # allocs (state.alloc_rows contract) — one object with no id —
+        # while a victim must carry its real id/node_id/modify_index or
+        # the plan applier's staleness fence rejects every commit.  Paid
+        # only when preemption actually has unplaced high-priority work.
+        allocs_by_node = {
+            nid: state.allocs_by_node_terminal(None, nid, False)
+            for nid in self._allocs_by_node
+        }
+        prio, sizes, sorted_allocs = preempt_ops.encode_alloc_tensors(
+            ct.node_ids, allocs_by_node, prio_of, n_pad=ct.n_pad)
+        capacity = np.asarray(ct.capacity, dtype=np.int64)
+        free = np.clip(capacity - used_after, -(2 ** 31), 2 ** 31 - 1)
+        denom = np.asarray(ct.score_denom, dtype=np.float32)
+        ask = np.asarray(st.ask, dtype=np.int64)[pu].astype(np.int32)
+        jp = np.array([spec_list[u].priority for u in pu], dtype=np.int32)
+
+        # One fetch round: kernel outputs + the static-feasibility rows
+        # of the preempting specs (constraints/dc/eligibility still bind
+        # a preempting placement).
+        pu_idx = jnp.asarray(np.array(pu, dtype=np.int32))
+        (mask_np, feasible, n_evict, score), feas_rows = jax.device_get(
+            (preempt_ops.eviction_sets(
+                jnp.asarray(free.astype(np.int32)),
+                jnp.asarray(used_after.astype(np.int32)),
+                jnp.asarray(denom),
+                jnp.asarray(prio), jnp.asarray(sizes),
+                jnp.asarray(ask), jnp.asarray(jp)),
+             feas[pu_idx]))
+        mask_np = np.asarray(mask_np)
+        feasible = np.asarray(feasible) & np.asarray(feas_rows)
+        n_evict = np.asarray(n_evict)
+        eff = np.asarray(score) - (
+            preempt_oracle.PREEMPTION_SCORE_PENALTY
+            + preempt_oracle.PREEMPTION_PER_ALLOC_PENALTY * n_evict)
+
+        placed = evicted = checked = agree = 0
+        dirty = np.zeros(ct.n_pad, dtype=bool)
+        for p, u in enumerate(pu):
+            sp = spec_list[u]
+            key = (sp.job.id, sp.tg.name)
+            need = int(unplaced_arr[u])
+            ok = feasible[p] & ~dirty
+            ok[ct.n_real:] = False
+            n_ok = int(ok.sum())
+            if need <= 0 or n_ok == 0:
+                continue
+            cand = np.nonzero(ok)[0]
+            order = cand[np.argsort(-eff[p][cand], kind="stable")]
+            commits = self._preempt_plan.setdefault(key, [])
+            for i in order[:need].tolist():
+                victims = [sorted_allocs[i][a]
+                           for a in np.nonzero(mask_np[p, i])[0]]
+                checked += 1
+                if self._preempt_oracle_agrees(
+                        sorted_allocs[i], free[i], ask[p], int(jp[p]),
+                        victims, prio_of):
+                    agree += 1
+                else:  # pragma: no cover — differential safety net
+                    self.logger.warning(
+                        "preempt kernel/oracle disagreement on node %s; "
+                        "skipping commit", ct.node_ids[i])
+                    continue
+                commits.append((ct.node_ids[i], victims))
+                dirty[i] = True
+                placed += 1
+                evicted += len(victims)
+                # Keep the forensics usage honest: the ask lands, the
+                # victims leave.
+                used_after[i] += ask[p].astype(np.int64)
+                for v in victims:
+                    used_after[i] -= np.array(
+                        preempt_oracle.alloc_size(v), dtype=np.int64)
+                unplaced_arr[u] -= 1
+
+        return {"preempt_placed": placed, "preempt_evicted": evicted,
+                "preempt_checked": checked, "preempt_agree": agree}
+
+    @staticmethod
+    def _preempt_oracle_agrees(node_allocs_sorted, free_vec, ask_vec,
+                               priority, kernel_victims, prio_of) -> bool:
+        """Replay the scalar oracle (scheduler/preempt.py greedy prefix +
+        reverse trim) on EXACTLY the kernel's inputs and compare sets."""
+        from ..scheduler import preempt as preempt_oracle
+
+        cand = [a for a in node_allocs_sorted if prio_of(a) < priority]
+        free = tuple(int(x) for x in free_vec)
+        ask = tuple(int(x) for x in ask_vec)
+        if all(ask[d] <= free[d] for d in range(4)):
+            return False  # fits without eviction — kernel must not commit
+        chosen = preempt_oracle.select_eviction_prefix(
+            free, ask, [preempt_oracle.alloc_size(a) for a in cand])
+        if not chosen:
+            return False
+        return [cand[j].id for j in chosen] == [a.id for a in kernel_victims]
 
     def _fill_failure_metrics(self, m, sp, nodes, ct, feas_row, placed_row,
                               used_after, node_facts) -> None:
@@ -1133,6 +1307,31 @@ class TPUBatchScheduler:
                         alloc.previous_allocation = prevs[i]
                     append(alloc)
                     appended += 1
+            # Placements won by the preemption pass: explicit allocs (not
+            # slab rows — each carries eviction dependencies), with the
+            # victims staged into Plan.node_preemptions so the applier
+            # commits evict + place atomically and can reject on a stale
+            # victim.
+            extra = self._preempt_plan.get(key) or []
+            if extra:
+                take = min(len(extra), n_asks - appended)
+                base = appended
+                extra_ids = s.generate_uuids(take)
+                for i in range(take):
+                    node_id, victims = extra[i]
+                    alloc = fast_copy(proto)
+                    alloc.id = extra_ids[i]
+                    alloc.name = (names[base + i] if names is not None
+                                  else f"{sched.job.name}.{tg.name}"
+                                       f"[{base + i}]")
+                    alloc.node_id = node_id
+                    if prevs is not None and prevs[base + i]:
+                        alloc.previous_allocation = prevs[base + i]
+                    for victim in victims:
+                        sched.plan.append_preempted_alloc(victim)
+                    sched.plan.append_alloc(alloc)
+                    appended += 1
+
             # Any slot that did not yield a plan alloc — including a failed
             # host-side network offer — is a placement failure and must
             # produce a blocked eval (generic_sched.go:218), not a silent
@@ -1172,7 +1371,8 @@ class TPUBatchScheduler:
             self.logger.info("batch plan conflict for eval %s; oracle retry", ev.id)
             retry_state = new_state if new_state is not None else self.state
             oracle = GenericScheduler(self.logger, retry_state, self.planner,
-                                      batch=(ev.type == s.JOB_TYPE_BATCH))
+                                      batch=(ev.type == s.JOB_TYPE_BATCH),
+                                      preemption_enabled=self.preemption_enabled)
             oracle.process(ev)
             return
 
@@ -1205,8 +1405,20 @@ class BatchStats:
         self.finalize_seconds = 0.0
         self.total_seconds = 0.0
         self.rounds = 0
+        # Preemption pass counters (batch_sched._preempt_pass): placements
+        # won by eviction, allocs evicted, and the kernel-vs-oracle
+        # eviction-set agreement tally.
+        self.preempt_placed = 0
+        self.preempt_evicted = 0
+        self.preempt_checked = 0
+        self.preempt_agree = 0
 
     def __repr__(self) -> str:
+        extra = ""
+        if self.preempt_checked:
+            extra = (f" preempt={self.preempt_placed}p/"
+                     f"{self.preempt_evicted}e "
+                     f"agree={self.preempt_agree}/{self.preempt_checked}")
         return (f"BatchStats(evals={self.num_evals} specs={self.num_specs} "
                 f"asks={self.num_asks} phase1={self.phase1_seconds:.3f}s "
                 f"phase2={self.phase2_seconds:.3f}s "
@@ -1215,7 +1427,7 @@ class BatchStats:
                 f"metrics={self.metrics_seconds:.3f}s "
                 f"finalize={self.finalize_seconds:.3f}s "
                 f"total={self.total_seconds:.3f}s "
-                f"rounds={self.rounds})")
+                f"rounds={self.rounds}{extra})")
 
 
 def new_tpu_batch_scheduler(logger_, state, planner) -> TPUBatchScheduler:

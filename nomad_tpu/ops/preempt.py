@@ -1,0 +1,270 @@
+"""Batched eviction-set kernel: the device twin of the preemption oracle
+(nomad_tpu/scheduler/preempt.py).
+
+For every (task-group, node) pair at once, compute WHICH
+strictly-lower-priority allocations must be evicted for the ask to fit
+and the post-eviction bin-pack score — the preemption analogue of the
+feasibility/scoring matrices in ops/kernels.py.
+
+The oracle's sequential algorithm vectorizes cleanly because the
+candidate order is fixed host-side (sort_candidates: priority asc,
+largest-resource-first) and eviction capacity is monotone along it:
+
+- greedy prefix  → an inclusive cumsum over the alloc axis plus one
+  monotone-boolean count gives k* (the prefix length) for ALL pairs;
+- reverse trim   → one lax.scan over the alloc axis (back to front)
+  with a [U, N, 4] freed-capacity carry replays the oracle's
+  drop-if-still-fits walk exactly.
+
+Everything is integer arithmetic on the same sorted inputs, so the masks
+are bit-identical to the oracle's sets — pinned by the --selfcheck
+entry (python -m nomad_tpu.ops) and the test_preempt.py fuzz case.
+
+Memory: the kernel materializes [U, N, A] booleans and an [A, U, N]
+scan output (A = max candidate allocs per node, pow2-padded).  At the
+bench shape (64 specs x 10k nodes x 16 allocs) that is ~10MB per
+buffer; callers with larger spec axes should chunk U.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..scheduler.preempt import (
+    PRIORITY_SENTINEL,
+    alloc_size,
+    sort_candidates,
+)
+from ..structs import structs as s
+from .encode import RES_DIMS, pow2_bucket
+
+
+def encode_alloc_tensors(
+    node_ids: List[str],
+    allocs_by_node: Dict[str, List[s.Allocation]],
+    prio_of: Callable[[s.Allocation], int],
+    n_pad: Optional[int] = None,
+) -> Tuple[np.ndarray, np.ndarray, List[List[s.Allocation]]]:
+    """Per-node candidate tensors in the SHARED oracle order
+    (sort_candidates), sentinel-padded:
+
+      prio  [n_pad, A] int32 — PRIORITY_SENTINEL padding is never below
+                               any real job priority, so padding rows
+                               can never enter a candidate prefix;
+      sizes [n_pad, A, 4] int32;
+      sorted_allocs — per node, the allocs in tensor order (host side,
+                      for decoding masks back to allocations).
+    """
+    n = len(node_ids)
+    if n_pad is None:
+        n_pad = n
+    sorted_allocs: List[List[s.Allocation]] = []
+    max_a = 1
+    for nid in node_ids:
+        cand = sort_candidates(allocs_by_node.get(nid, []), prio_of)
+        sorted_allocs.append(cand)
+        max_a = max(max_a, len(cand))
+    a_pad = pow2_bucket(max_a, minimum=2)
+
+    prio = np.full((n_pad, a_pad), PRIORITY_SENTINEL, dtype=np.int32)
+    sizes = np.zeros((n_pad, a_pad, RES_DIMS), dtype=np.int32)
+    for i, cand in enumerate(sorted_allocs):
+        for a, alloc in enumerate(cand):
+            prio[i, a] = prio_of(alloc)
+            sizes[i, a] = alloc_size(alloc)
+    return prio, sizes, sorted_allocs
+
+
+@jax.jit
+def eviction_sets(
+    free: jnp.ndarray,      # [N, 4] int32 — capacity − used (post main pass)
+    used: jnp.ndarray,      # [N, 4] int32 — usage incl. reserved
+    denom: jnp.ndarray,     # [N, 2] float32 — cpu/mem capacity minus reserved
+    prio: jnp.ndarray,      # [N, A] int32 — sorted candidates, sentinel pad
+    sizes: jnp.ndarray,     # [N, A, 4] int32
+    ask: jnp.ndarray,       # [U, 4] int32
+    job_prio: jnp.ndarray,  # [U] int32
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """For every (spec u, node n): the minimal eviction mask over the
+    node's sorted candidates, whether preemption makes the ask fit, the
+    eviction count, and the post-eviction bin-pack score.
+
+    Returns (mask [U,N,A] bool, feasible [U,N] bool, n_evict [U,N] i32,
+    score [U,N] f32).  ``feasible`` is False both when the ask already
+    fits with no eviction (the main placement pass owns that case) and
+    when even evicting every lower-priority alloc is not enough.
+    """
+    n, a = prio.shape
+    u = ask.shape[0]
+
+    cum = jnp.cumsum(sizes, axis=1)                       # [N, A, 4]
+    need = ask[:, None, :] - free[None, :, :]             # [U, N, 4]
+    # fits after evicting the k-prefix: k=0 uses freed 0, k>=1 uses
+    # cum[k-1].  Monotone in k (sizes are non-negative), so the count of
+    # non-fitting prefixes IS k*.
+    fits0 = jnp.all(need <= 0, axis=-1)                   # [U, N]
+    fits_k = jnp.all(need[:, :, None, :] <= cum[None, :, :, :], axis=-1)
+    kstar = (a + 1) - (fits0.astype(jnp.int32)
+                       + jnp.sum(fits_k, axis=-1, dtype=jnp.int32))
+    ncand = jnp.sum(prio[None, :, :] < job_prio[:, None, None],
+                    axis=-1, dtype=jnp.int32)             # [U, N]
+    feasible = (kstar >= 1) & (kstar <= ncand)
+
+    arange_a = jnp.arange(a, dtype=jnp.int32)
+    m0 = arange_a[None, None, :] < kstar[:, :, None]      # [U, N, A]
+    m0 = m0 & feasible[:, :, None]
+    freed0 = jnp.einsum("una,nad->und", m0.astype(jnp.int32), sizes)
+
+    def trim(freed, t):
+        idx = a - 1 - t
+        in_set = m0[:, :, idx]                            # [U, N]
+        size_i = sizes[:, idx, :][None, :, :]             # [1, N, 4]
+        drop = in_set & jnp.all(need <= freed - size_i, axis=-1)
+        freed = freed - drop[:, :, None] * size_i
+        return freed, drop
+
+    freed_final, drops = lax.scan(trim, freed0, jnp.arange(a))
+    # drops is stacked in scan order (alloc axis reversed) → [U, N, A].
+    mask = m0 & ~jnp.flip(jnp.transpose(drops, (1, 2, 0)), axis=-1)
+    n_evict = jnp.sum(mask, axis=-1, dtype=jnp.int32)
+
+    # Post-eviction ScoreFit: usage after evicting the set and placing
+    # the ask, flattened to rows so kernels._score_fit (the ONE home of
+    # the 10^freeFrac expression and its measured-fusion caveats) scores
+    # every (spec, node) pair.
+    from .kernels import _score_fit
+
+    after = (used[None, :, :] - freed_final
+             + ask[:, None, :]).reshape(u * n, 4)
+    denom_rows = jnp.broadcast_to(denom[None, :, :], (u, n, 2)
+                                  ).reshape(u * n, 2)
+    score = _score_fit(after, jnp.zeros(4, dtype=jnp.int32),
+                       denom_rows).reshape(u, n)
+
+    return mask, feasible, n_evict, score
+
+
+def random_cluster(n_nodes: int, n_specs: int, seed: int = 0):
+    """Seeded random preemption problem for agreement checks: nodes at
+    high utilization with mixed-priority, mixed-size allocs, plus
+    high-priority asks that mostly need eviction to fit."""
+    rng = np.random.RandomState(seed)
+    nodes: List[s.Node] = []
+    allocs_by_node: Dict[str, List[s.Allocation]] = {}
+    for i in range(n_nodes):
+        node = s.Node(
+            id=f"n{i:04d}",
+            datacenter="dc1",
+            resources=s.Resources(cpu=4000, memory_mb=8192,
+                                  disk_mb=100 * 1024, iops=150),
+            reserved=s.Resources(cpu=100, memory_mb=256),
+            status=s.NODE_STATUS_READY,
+        )
+        nodes.append(node)
+        allocs = []
+        for a in range(int(rng.randint(0, 9))):
+            job = s.Job(id=f"filler-{i}-{a}",
+                        priority=int(rng.randint(1, 80)))
+            allocs.append(s.Allocation(
+                id=f"a{i:04d}-{a}",
+                job_id=job.id,
+                job=job,
+                node_id=node.id,
+                resources=s.Resources(
+                    cpu=int(rng.randint(100, 900)),
+                    memory_mb=int(rng.randint(128, 1800)),
+                    disk_mb=int(rng.randint(0, 2000)),
+                    iops=int(rng.randint(0, 20))),
+            ))
+        allocs_by_node[node.id] = allocs
+    asks = [s.Resources(cpu=int(rng.randint(500, 3000)),
+                        memory_mb=int(rng.randint(512, 6000)),
+                        disk_mb=int(rng.randint(0, 4000)),
+                        iops=int(rng.randint(0, 40)))
+            for _ in range(n_specs)]
+    priorities = [int(rng.randint(10, 100)) for _ in range(n_specs)]
+    return nodes, allocs_by_node, asks, priorities
+
+
+def agreement_check(nodes, allocs_by_node, asks, priorities,
+                    prio_of=None) -> Tuple[int, int, List[str]]:
+    """Run kernel and oracle over every (spec, node) pair; returns
+    (pairs_checked, mismatches, first few mismatch descriptions)."""
+    from ..scheduler.preempt import alloc_priority, find_eviction_set
+
+    if prio_of is None:
+        prio_of = alloc_priority
+    node_ids = [n.id for n in nodes]
+    prio, sizes, sorted_allocs = encode_alloc_tensors(
+        node_ids, allocs_by_node, prio_of)
+
+    free = np.zeros((len(nodes), RES_DIMS), dtype=np.int32)
+    used = np.zeros((len(nodes), RES_DIMS), dtype=np.int32)
+    denom = np.ones((len(nodes), 2), dtype=np.float32)
+    for i, node in enumerate(nodes):
+        cap = np.array([node.resources.cpu, node.resources.memory_mb,
+                        node.resources.disk_mb, node.resources.iops],
+                       dtype=np.int64)
+        u = np.zeros(RES_DIMS, dtype=np.int64)
+        if node.reserved is not None:
+            rv = node.reserved
+            u += (rv.cpu, rv.memory_mb, rv.disk_mb, rv.iops)
+        for a in allocs_by_node.get(node.id, []):
+            u += np.array(alloc_size(a), dtype=np.int64)
+        free[i] = cap - u
+        used[i] = u
+        denom[i] = (cap[0] - (node.reserved.cpu if node.reserved else 0),
+                    cap[1] - (node.reserved.memory_mb
+                              if node.reserved else 0))
+
+    ask_arr = np.array([[r.cpu, r.memory_mb, r.disk_mb, r.iops]
+                        for r in asks], dtype=np.int32)
+    jp = np.array(priorities, dtype=np.int32)
+    mask, feasible, n_evict, _score = jax.device_get(eviction_sets(
+        jnp.asarray(free), jnp.asarray(used), jnp.asarray(denom),
+        jnp.asarray(prio), jnp.asarray(sizes),
+        jnp.asarray(ask_arr), jnp.asarray(jp)))
+
+    checked = 0
+    n_mismatch = 0
+    mismatches: List[str] = []
+    for u in range(len(asks)):
+        for i, node in enumerate(nodes):
+            checked += 1
+            oracle = find_eviction_set(
+                node, allocs_by_node.get(node.id, []), asks[u],
+                priorities[u], prio_of)
+            kernel_ids = ([sorted_allocs[i][a].id
+                           for a in np.nonzero(mask[u, i])[0]]
+                          if feasible[u, i] else None)
+            oracle_ids = [a.id for a in oracle] if oracle else None
+            if kernel_ids != oracle_ids:
+                n_mismatch += 1
+                if len(mismatches) < 5:
+                    mismatches.append(
+                        f"spec {u} node {node.id}: kernel={kernel_ids} "
+                        f"oracle={oracle_ids}")
+    return checked, n_mismatch, mismatches
+
+
+def selfcheck(n_nodes: int = 64, n_specs: int = 64, seed: int = 0,
+              log=print) -> bool:
+    """Oracle-vs-kernel eviction-set agreement on a seeded random
+    cluster; the CI smoke behind `python -m nomad_tpu.ops --selfcheck`."""
+    nodes, allocs_by_node, asks, priorities = random_cluster(
+        n_nodes, n_specs, seed)
+    checked, n_mismatch, mismatches = agreement_check(
+        nodes, allocs_by_node, asks, priorities)
+    if n_mismatch:
+        log(f"preempt selfcheck: FAIL — {n_mismatch} of {checked} "
+            "pairs disagree; first few:")
+        for m in mismatches:
+            log(f"  {m}")
+        return False
+    log(f"preempt selfcheck: OK — kernel == oracle on all {checked} "
+        f"(spec, node) pairs ({n_specs} specs x {n_nodes} nodes)")
+    return True

@@ -64,7 +64,8 @@ class EvalContext:
         placements, deduped by alloc ID (context.go:109)."""
         existing = self.state.allocs_by_node_terminal(None, node_id, False)
         proposed = existing
-        update = self.plan.node_update.get(node_id, [])
+        update = (self.plan.node_update.get(node_id, [])
+                  + self.plan.node_preemptions.get(node_id, []))
         if update:
             proposed = remove_allocs(existing, update)
         by_id = {a.id: a for a in proposed}

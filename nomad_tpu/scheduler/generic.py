@@ -44,12 +44,18 @@ class GenericScheduler:
     (generic_sched.go:57)."""
 
     def __init__(self, logger: logging.Logger, state, planner, batch: bool,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None,
+                 preemption_enabled: Optional[bool] = None):
         self.logger = logger
         self.state = state
         self.planner = planner
         self.batch = batch
         self.rng = rng
+        if preemption_enabled is None:
+            from .preempt import preemption_enabled_default
+
+            preemption_enabled = preemption_enabled_default()
+        self.preemption_enabled = preemption_enabled
 
         self.eval: Optional[s.Evaluation] = None
         self.job: Optional[s.Job] = None
@@ -130,7 +136,8 @@ class GenericScheduler:
         self.plan = self.eval.make_plan(self.job)
         self.failed_tg_allocs = None
         self.ctx = EvalContext(self.state, self.plan, self.logger, rng=self.rng)
-        self.stack = GenericStack(self.batch, self.ctx)
+        self.stack = GenericStack(self.batch, self.ctx,
+                                  preemption_enabled=self.preemption_enabled)
         if self.job is not None and not self.job.stopped():
             self.stack.set_job(self.job)
 
@@ -283,6 +290,13 @@ class GenericScheduler:
                 )
                 if missing.alloc is not None:
                     alloc.previous_allocation = missing.alloc.id
+                if option.preempted_allocs:
+                    # Evictions the fit depends on commit with (and
+                    # gate) the placement; clear the marker so a reused
+                    # RankedNode cannot leak victims into later picks.
+                    for victim in option.preempted_allocs:
+                        self.plan.append_preempted_alloc(victim)
+                    option.preempted_allocs = None
                 self.plan.append_alloc(alloc)
             else:
                 if self.failed_tg_allocs is None:

@@ -10,8 +10,9 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 from ..structs import structs as s
-from ..structs.funcs import allocs_fit, score_fit
+from ..structs.funcs import allocs_fit, remove_allocs, score_fit
 from ..structs.network import NetworkIndex
+from . import preempt
 from .context import EvalContext
 
 
@@ -19,13 +20,17 @@ class RankedNode:
     """A node plus its accumulated score and per-task resources
     (rank.go:12-45)."""
 
-    __slots__ = ("node", "score", "task_resources", "proposed")
+    __slots__ = ("node", "score", "task_resources", "proposed",
+                 "preempted_allocs")
 
     def __init__(self, node: s.Node):
         self.node = node
         self.score = 0.0
         self.task_resources: Dict[str, s.Resources] = {}
         self.proposed: Optional[List[s.Allocation]] = None
+        # Lower-priority allocs whose eviction this option depends on
+        # (preempt.py); staged into Plan.node_preemptions on selection.
+        self.preempted_allocs: Optional[List[s.Allocation]] = None
 
     def __repr__(self) -> str:
         return f"<Node: {self.node.id} Score: {self.score:.3f}>"
@@ -85,11 +90,24 @@ class BinPackIterator:
     """Scores nodes by best-fit bin packing after assigning task networks
     (rank.go:130-240)."""
 
-    def __init__(self, ctx: EvalContext, source, evict: bool, priority: int):
+    def __init__(self, ctx: EvalContext, source, evict: bool, priority: int,
+                 preemption_enabled: bool = False):
         self.ctx = ctx
         self.source = source
-        self.evict = evict  # reserved; eviction unimplemented in reference too
+        # evict + priority gate the preemption path: a node that cannot
+        # fit the task group may still rank if evicting strictly-lower-
+        # priority allocs makes room (preempt.py).  preemption_enabled
+        # is the operator switch; with it off, evict is recorded but
+        # inert — the reference ships the same dormant flag.
+        self.evict = evict
         self.priority = priority
+        self.preemption_enabled = preemption_enabled
+        # Set by the stack for its SECOND select pass only (no node fits
+        # without eviction).  Preempting options must never compete with
+        # normally-fitting nodes inside the LimitIterator's small sample
+        # — a full-but-preemptible node would consume a candidate slot
+        # and could win on score while free capacity exists elsewhere.
+        self.allow_preempt = False
         self.task_group: Optional[s.TaskGroup] = None
 
     def set_priority(self, priority: int) -> None:
@@ -128,16 +146,63 @@ class BinPackIterator:
             if not network_ok:
                 continue
 
-            candidate = proposed + [s.Allocation(id="_binpack_probe", resources=total)]
+            probe = s.Allocation(id="_binpack_probe", resources=total)
+            candidate = proposed + [probe]
             fit, dim, util = allocs_fit(option.node, candidate, net_idx)
             if not fit:
-                self.ctx.metrics.exhausted_node(option.node, dim)
+                if (self.allow_preempt and self.evict
+                        and self.preemption_enabled and self.priority > 0
+                        and self._try_preempt(option, proposed, probe,
+                                              total)):
+                    return option
+                if not self.allow_preempt:
+                    # The preempt pass re-walks nodes the first pass
+                    # already attributed; don't double-count exhaustion.
+                    self.ctx.metrics.exhausted_node(option.node, dim)
                 continue
 
             fitness = score_fit(option.node, util)
             option.score += fitness
             self.ctx.metrics.score_node(option.node, "binpack", fitness)
             return option
+
+    def _try_preempt(self, option: RankedNode,
+                     proposed: List[s.Allocation], probe: s.Allocation,
+                     total: s.Resources) -> bool:
+        """Rank the node anyway if evicting strictly-lower-priority
+        allocs makes the task group fit (preempt.py oracle).  The score
+        carries a discount so any node that fits WITHOUT eviction
+        outranks a preempting one; ties among preempting nodes prefer
+        the smaller eviction set."""
+        state = self.ctx.state
+
+        def prio_of(a: s.Allocation) -> int:
+            return preempt.alloc_priority(a, state)
+
+        victims = preempt.find_eviction_set(
+            option.node, proposed, total, self.priority, prio_of)
+        if not victims:
+            return False
+        survivors = remove_allocs(proposed, victims)
+        # Full re-check over the survivors with a rebuilt NetworkIndex:
+        # the scalar-dimension oracle freed enough cpu/mem/disk/iops,
+        # but ports/bandwidth held by non-evicted allocs still bind.
+        net_idx = NetworkIndex()
+        net_idx.set_node(option.node)
+        net_idx.add_allocs(survivors)
+        for res in option.task_resources.values():
+            for offer in res.networks or []:
+                net_idx.add_reserved(offer)
+        fit, _, util = allocs_fit(option.node, survivors + [probe], net_idx)
+        if not fit:
+            return False
+        fitness = score_fit(option.node, util)
+        penalty = preempt.preemption_score_penalty(len(victims))
+        option.score += fitness - penalty
+        option.preempted_allocs = victims
+        self.ctx.metrics.score_node(option.node, "binpack", fitness)
+        self.ctx.metrics.score_node(option.node, "preemption", -penalty)
+        return True
 
     def reset(self) -> None:
         self.source.reset()

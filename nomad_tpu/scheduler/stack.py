@@ -39,7 +39,8 @@ BATCH_JOB_ANTI_AFFINITY_PENALTY = 10.0
 class GenericStack:
     """Service/batch placement stack (stack.go:37-115)."""
 
-    def __init__(self, batch: bool, ctx: EvalContext):
+    def __init__(self, batch: bool, ctx: EvalContext,
+                 preemption_enabled: bool = False):
         self.batch = batch
         self.ctx = ctx
 
@@ -56,8 +57,11 @@ class GenericStack:
         self.distinct_property_constraint = DistinctPropertyIterator(
             ctx, self.distinct_hosts_constraint)
         rank_source = FeasibleRankIterator(ctx, self.distinct_property_constraint)
-        # Eviction is only enabled for service (reserved, unimplemented).
-        self.bin_pack = BinPackIterator(ctx, rank_source, evict=not batch, priority=0)
+        # Eviction is only offered to service jobs; it only actually
+        # preempts when the operator enables preemption (rank.py).
+        self.bin_pack = BinPackIterator(ctx, rank_source, evict=not batch,
+                                        priority=0,
+                                        preemption_enabled=preemption_enabled)
         penalty = BATCH_JOB_ANTI_AFFINITY_PENALTY if batch else SERVICE_JOB_ANTI_AFFINITY_PENALTY
         self.job_anti_aff = JobAntiAffinityIterator(ctx, self.bin_pack, penalty, "")
         self.limit = LimitIterator(ctx, self.job_anti_aff, 2)
@@ -99,6 +103,19 @@ class GenericStack:
         self.bin_pack.set_task_group(tg)
 
         option = self.max_score.next_option()
+        if (option is None and self.bin_pack.preemption_enabled
+                and self.bin_pack.evict and self.bin_pack.priority > 0):
+            # Preemption is strictly a last resort: only when NO node
+            # fits without eviction does a second pass rank preempting
+            # options (rank.py allow_preempt) — so a preemptible-but-
+            # full node can never beat free capacity inside the
+            # LimitIterator's small candidate sample.
+            self.max_score.reset()
+            self.bin_pack.allow_preempt = True
+            try:
+                option = self.max_score.next_option()
+            finally:
+                self.bin_pack.allow_preempt = False
 
         if option is not None and len(option.task_resources) != len(tg.tasks):
             for task in tg.tasks:
@@ -122,7 +139,7 @@ class GenericStack:
 class SystemStack:
     """System placement stack: evaluates every node (stack.go:195-286)."""
 
-    def __init__(self, ctx: EvalContext):
+    def __init__(self, ctx: EvalContext, preemption_enabled: bool = False):
         self.ctx = ctx
         self.source = StaticIterator(ctx, [])
         self.job_constraint = ConstraintChecker(ctx)
@@ -134,7 +151,9 @@ class SystemStack:
         )
         self.distinct_property_constraint = DistinctPropertyIterator(ctx, self.wrapped_checks)
         rank_source = FeasibleRankIterator(ctx, self.distinct_property_constraint)
-        self.bin_pack = BinPackIterator(ctx, rank_source, evict=True, priority=0)
+        self.bin_pack = BinPackIterator(ctx, rank_source, evict=True,
+                                        priority=0,
+                                        preemption_enabled=preemption_enabled)
 
     def set_nodes(self, base_nodes: List[s.Node]) -> None:
         self.source.set_nodes(base_nodes)
@@ -157,6 +176,15 @@ class SystemStack:
         self.bin_pack.set_task_group(tg)
 
         option = self.bin_pack.next_option()
+        if (option is None and self.bin_pack.preemption_enabled
+                and self.bin_pack.evict and self.bin_pack.priority > 0):
+            # Same last-resort second pass as GenericStack.select.
+            self.bin_pack.reset()
+            self.bin_pack.allow_preempt = True
+            try:
+                option = self.bin_pack.next_option()
+            finally:
+                self.bin_pack.allow_preempt = False
 
         if option is not None and len(option.task_resources) != len(tg.tasks):
             for task in tg.tasks:

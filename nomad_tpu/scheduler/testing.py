@@ -64,6 +64,7 @@ class Harness:
                 node_update=plan.node_update,
                 node_allocation=plan.node_allocation,
                 alloc_slabs=plan.alloc_slabs,
+                node_preemptions=plan.node_preemptions,
                 alloc_index=index,
             )
 
@@ -72,10 +73,17 @@ class Harness:
                 allocs.extend(update_list)
             for alloc_list in plan.node_allocation.values():
                 allocs.extend(alloc_list)
+            preempted: List[s.Allocation] = []
+            for evicted_list in plan.node_preemptions.values():
+                allocs.extend(evicted_list)
+                preempted.extend(evicted_list)
 
             if plan.job is not None:
+                # Same guard as upsert_plan_results: never stamp the
+                # plan's job onto terminal allocs — an evicted victim
+                # belongs to its OWN (lower-priority) job.
                 for alloc in allocs:
-                    if alloc.job is None:
+                    if alloc.job is None and not alloc.terminal_status():
                         alloc.job = plan.job
                 for slab in plan.alloc_slabs:
                     if slab.proto.job is None:
@@ -84,6 +92,15 @@ class Harness:
             self.state.upsert_allocs(index, allocs, owned=True)
             if plan.alloc_slabs:
                 self.state.upsert_slabs(index, plan.alloc_slabs)
+            if preempted:
+                # Mirror the real plan applier: every evicted alloc's job
+                # gets ONE blocked follow-up eval so the displaced work
+                # reschedules (plan_apply.py / blocked_evals.py).
+                for ev in s.preemption_follow_up_evals(
+                        preempted, index,
+                        job_lookup=lambda jid: self.state.job_by_id(None, jid)):
+                    self.state.upsert_evals(self.next_index(), [ev])
+                    self.create_evals.append(ev)
             return result, None
 
     def update_eval(self, ev: s.Evaluation) -> None:

@@ -98,6 +98,16 @@ class BlockedEvals:
             return True
         return False
 
+    def block_preempted(self, evals: List[s.Evaluation]) -> None:
+        """Track the follow-up evals of preempted jobs (the plan applier
+        calls this right after committing a preemption plan).  The
+        standard block path applies unchanged: the evals carry no class
+        eligibility, so any capacity change re-admits them, and the
+        missed-unblock check covers capacity that arrived between the
+        plan's raft apply (their snapshot_index) and this registration."""
+        for ev in evals:
+            self._process_block(ev, "")
+
     def untrack(self, job_id: str) -> None:
         """Stop tracking after a successful eval (blocked_evals.go:247)."""
         with self._l:

@@ -170,6 +170,12 @@ class FSM:
     def _apply_plan_results(self, index: int, req: dict):
         self.state.upsert_plan_results(index, req.get("job"), req["allocs"],
                                        req.get("slabs"))
+        # Preemption follow-up evals commit with the evict+place they
+        # belong to (plan_apply.py builds them); the applier hands them
+        # to BlockedEvals after this apply returns.
+        evals = req.get("preemption_evals")
+        if evals:
+            self.state.upsert_evals(index, evals)
 
     # -- summaries / vault / periodic --------------------------------------
 

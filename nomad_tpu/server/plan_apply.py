@@ -34,10 +34,13 @@ VECTORIZE_THRESHOLD = 64
 class PlanApplier:
     def __init__(self, plan_queue: PlanQueue, raft: RaftLog,
                  logger: Optional[logging.Logger] = None,
-                 metrics=None):
+                 metrics=None, blocked_evals=None):
         self.plan_queue = plan_queue
         self.raft = raft
         self.metrics = metrics if metrics is not None else NULL_TELEMETRY
+        # Preempted jobs' follow-up evals are handed here after a
+        # preemption plan commits, so displaced work reschedules.
+        self.blocked_evals = blocked_evals
         self.logger = logger or logging.getLogger("nomad_tpu.plan_apply")
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -108,7 +111,8 @@ class PlanApplier:
         Columnar alloc slabs (the TPU batch path) are kept whole on a full
         commit and filtered per node on a partial one."""
         result = s.PlanResult(node_update={}, node_allocation={})
-        touched = {*plan.node_update, *plan.node_allocation}
+        touched = {*plan.node_update, *plan.node_allocation,
+                   *plan.node_preemptions}
         for slab in plan.alloc_slabs:
             touched.update(slab.node_ids)
         node_ids = list(touched)
@@ -134,6 +138,11 @@ class PlanApplier:
                 result.node_update[node_id] = plan.node_update[node_id]
             if plan.node_allocation.get(node_id):
                 result.node_allocation[node_id] = plan.node_allocation[node_id]
+            if plan.node_preemptions.get(node_id):
+                result.node_preemptions[node_id] = plan.node_preemptions[node_id]
+
+        if gang_failed:
+            result.node_preemptions = {}
 
         if not gang_failed:
             for slab in plan.alloc_slabs:
@@ -167,9 +176,24 @@ class PlanApplier:
         return {nid: self._evaluate_node_plan(snap, plan, nid, slab_adds)
                 for nid in node_ids}
 
+    def _preemptions_fresh(self, snap, plan: s.Plan, node_id: str) -> bool:
+        """Optimistic-concurrency fence for preemption: every alloc the
+        plan evicts must still exist, still be live, and be UNCHANGED
+        (modify_index) since the scheduler's snapshot — a concurrent
+        client update, stop, or re-plan rejects this node's commit and
+        the scheduler replans against fresh state."""
+        for preempted in plan.node_preemptions.get(node_id, []):
+            existing = snap.alloc_by_id(None, preempted.id)
+            if (existing is None or existing.terminal_status()
+                    or existing.modify_index != preempted.modify_index):
+                return False
+        return True
+
     def _evaluate_node_plan(self, snap, plan: s.Plan, node_id: str,
                             slab_adds: Optional[Dict] = None) -> bool:
         """(plan_apply.go:327 evaluateNodePlan)."""
+        if not self._preemptions_fresh(snap, plan, node_id):
+            return False
         slab_here = (slab_adds or {}).get(node_id, [])
         if not plan.node_allocation.get(node_id) and not slab_here:
             return True  # evict-only always fits
@@ -178,6 +202,7 @@ class PlanApplier:
             return False
         existing = snap.allocs_by_node_terminal(None, node_id, False)
         remove = list(plan.node_update.get(node_id, []))
+        remove.extend(plan.node_preemptions.get(node_id, []))
         remove.extend(plan.node_allocation.get(node_id, []))
         proposed = remove_allocs(existing, remove)
         proposed = proposed + list(plan.node_allocation.get(node_id, []))
@@ -213,6 +238,12 @@ class PlanApplier:
         alloc_only: List[bool] = []
         scalar_fallback: Dict[str, bool] = {}
         for i, node_id in enumerate(node_ids):
+            if not self._preemptions_fresh(snap, plan, node_id):
+                # Stale preempted alloc: the staleness fence stays
+                # host-side (by-id lookups), only the fit math vectorizes.
+                alloc_only.append(False)
+                ok_static[i] = False
+                continue
             slab_here = slab_adds.get(node_id, [])
             if not plan.node_allocation.get(node_id) and not slab_here:
                 alloc_only.append(True)
@@ -227,6 +258,7 @@ class PlanApplier:
                 used[i] += res_vec(node.reserved)
             existing = snap.allocs_by_node_terminal(None, node_id, False)
             remove = list(plan.node_update.get(node_id, []))
+            remove.extend(plan.node_preemptions.get(node_id, []))
             remove.extend(plan.node_allocation.get(node_id, []))
             proposed = remove_allocs(existing, remove)
             proposed = proposed + list(plan.node_allocation.get(node_id, []))
@@ -276,6 +308,10 @@ class PlanApplier:
             allocs.extend(update_list)
         for alloc_list in result.node_allocation.values():
             allocs.extend(alloc_list)
+        preempted: List[s.Allocation] = []
+        for evicted_list in result.node_preemptions.values():
+            allocs.extend(evicted_list)
+            preempted.extend(evicted_list)
         now = _time.time()
         for alloc in allocs:
             if alloc.create_time == 0:
@@ -287,5 +323,19 @@ class PlanApplier:
         payload = {"job": plan.job, "allocs": allocs}
         if result.alloc_slabs:
             payload["slabs"] = result.alloc_slabs
+        preemption_evals: List[s.Evaluation] = []
+        if preempted:
+            # ONE raft apply carries the evictions, the placements, and
+            # the preempted jobs' follow-up evals — evict + place land
+            # atomically with the reschedule breadcrumb.
+            preemption_evals = s.preemption_follow_up_evals(
+                preempted, snap.latest_index(),
+                job_lookup=lambda jid: snap.job_by_id(None, jid))
+            payload["preemption_evals"] = preemption_evals
         _, index = self.raft.apply(MessageType.APPLY_PLAN_RESULTS, payload)
+        if preemption_evals:
+            for ev in preemption_evals:
+                ev.snapshot_index = index
+            if self.blocked_evals is not None:
+                self.blocked_evals.block_preempted(preemption_evals)
         return index

@@ -167,7 +167,8 @@ class Server:
                 self.rpc.raft_handler = self.raft.handle_message
 
         self.plan_applier = PlanApplier(self.plan_queue, self.raft, self.logger,
-                                        metrics=self.metrics)
+                                        metrics=self.metrics,
+                                        blocked_evals=self.blocked_evals)
         self.heartbeat = HeartbeatTimers(
             on_expire=self._heartbeat_expired,
             min_ttl=self.config.min_heartbeat_ttl,

@@ -34,10 +34,18 @@ class WorkerPlanner:
     """The scheduler.Planner implementation workers hand to schedulers
     (worker.go:300-499)."""
 
-    def __init__(self, worker: "Worker", ev: s.Evaluation, token: str):
+    def __init__(self, worker: "Worker", ev: s.Evaluation, token: str,
+                 snapshot_index: Optional[int] = None):
         self.worker = worker
         self.eval = ev
         self.token = token
+        # The applied index captured WHEN the scheduler's snapshot was
+        # taken (worker.go:262 w.snapshotIndex).  Blocked evals must
+        # carry this — not the apply index at creation time — or a
+        # capacity change landing while the eval is inside the scheduler
+        # looks already-seen to BlockedEvals._missed_unblock and the
+        # eval sleeps forever.
+        self.snapshot_index = snapshot_index
 
     def submit_plan(self, plan: s.Plan):
         """(worker.go:300 SubmitPlan) — pause the nack timer while in the
@@ -68,15 +76,20 @@ class WorkerPlanner:
     def update_eval(self, ev: s.Evaluation) -> None:
         self.worker.raft.apply(MessageType.EVAL_UPDATE, {"evals": [ev]})
 
+    def _snapshot_index(self) -> int:
+        if self.snapshot_index is not None:
+            return self.snapshot_index
+        return self.worker.raft.applied_index()
+
     def create_eval(self, ev: s.Evaluation) -> None:
-        ev.snapshot_index = self.worker.raft.applied_index()
+        ev.snapshot_index = self._snapshot_index()
         self.worker.raft.apply(MessageType.EVAL_UPDATE, {"evals": [ev]})
 
     def reblock_eval(self, ev: s.Evaluation) -> None:
         """(worker.go:470 ReblockEval) — update snapshot index and hand it
         to the blocked tracker via the broker requeue path."""
         w = self.worker
-        ev.snapshot_index = w.raft.applied_index()
+        ev.snapshot_index = self._snapshot_index()
         w.raft.apply(MessageType.EVAL_UPDATE, {"evals": [ev]})
         if w.blocked_evals is not None:
             w.blocked_evals.reblock(ev, self.token)
@@ -186,8 +199,13 @@ class Worker:
 
     def invoke_scheduler(self, ev: s.Evaluation, token: str) -> None:
         """(worker.go:262): snapshot state, instantiate by eval type."""
+        # Index first, snapshot second: the snapshot then holds AT LEAST
+        # everything up to the recorded index, so a blocked eval's
+        # snapshot_index never overstates what the scheduler saw.
+        snapshot_index = self.raft.applied_index()
         snap = self.raft.fsm.state.snapshot()
-        planner = WorkerPlanner(self, ev, token)
+        planner = WorkerPlanner(self, ev, token,
+                                snapshot_index=snapshot_index)
         sched_name = self.sched_name(ev)
         if ev.type == s.JOB_TYPE_CORE:
             from .core_sched import CoreScheduler
@@ -249,6 +267,7 @@ class BatchWorker(Worker):
     def process_batch(self, batch: List[Tuple[s.Evaluation, str]]) -> None:
         max_index = max(ev.modify_index for ev, _ in batch)
         self.wait_for_index(max_index, RAFT_SYNC_LIMIT)
+        snapshot_index = self.raft.applied_index()
         snap = self.raft.fsm.state.snapshot()
 
         # One scheduler instance per batch; per-eval planners for correct
@@ -260,7 +279,9 @@ class BatchWorker(Worker):
 
             def __init__(self, worker, batch):
                 self.planners = {
-                    ev.id: WorkerPlanner(worker, ev, token) for ev, token in batch}
+                    ev.id: WorkerPlanner(worker, ev, token,
+                                         snapshot_index=snapshot_index)
+                    for ev, token in batch}
                 self._by_plan_eval = self.planners
 
             def submit_plan(self, plan):

@@ -75,6 +75,9 @@ EVAL_TRIGGER_NODE_UPDATE = "node-update"
 EVAL_TRIGGER_SCHEDULED = "scheduled"
 EVAL_TRIGGER_ROLLING_UPDATE = "rolling-update"
 EVAL_TRIGGER_MAX_PLANS = "max-plan-attempts"
+EVAL_TRIGGER_PREEMPTION = "preemption"
+
+ALLOC_PREEMPTED = "preempted by a higher-priority allocation"
 
 # Constraint operands (structs.go:3286-3294)
 CONSTRAINT_DISTINCT_PROPERTY = "distinct_property"
@@ -1090,6 +1093,34 @@ class Evaluation:
         )
 
 
+def preemption_follow_up_evals(
+    preempted: List["Allocation"], snapshot_index: int,
+    job_lookup=None,
+) -> List["Evaluation"]:
+    """One BLOCKED follow-up eval per distinct evicted job, so preempted
+    work re-enters the scheduler when capacity appears (the plan-apply /
+    Harness halves share this so their eval shapes agree).  job_lookup
+    (job_id -> Job) recovers priority/type; plan copies strip the job."""
+    seen: Dict[str, Evaluation] = {}
+    for alloc in preempted:
+        if alloc.job_id in seen:
+            continue
+        job = alloc.job
+        if job is None and job_lookup is not None:
+            job = job_lookup(alloc.job_id)
+        seen[alloc.job_id] = Evaluation(
+            id=generate_uuid(),
+            priority=job.priority if job is not None else JOB_DEFAULT_PRIORITY,
+            type=job.type if job is not None else JOB_TYPE_SERVICE,
+            triggered_by=EVAL_TRIGGER_PREEMPTION,
+            job_id=alloc.job_id,
+            status=EVAL_STATUS_BLOCKED,
+            status_description=ALLOC_PREEMPTED,
+            snapshot_index=snapshot_index,
+        )
+    return list(seen.values())
+
+
 # ---------------------------------------------------------------------------
 # Plan
 # ---------------------------------------------------------------------------
@@ -1312,6 +1343,11 @@ class Plan:
     node_update: Dict[str, List[Allocation]] = field(default_factory=dict)
     node_allocation: Dict[str, List[Allocation]] = field(default_factory=dict)
     alloc_slabs: List[AllocSlab] = field(default_factory=list)
+    # Evictions of strictly-lower-priority allocs this plan makes room
+    # with (scheduler/preempt.py): committed atomically with the
+    # placements, rejected if a preempted alloc changed underneath
+    # (plan_apply.py optimistic-concurrency re-check).
+    node_preemptions: Dict[str, List[Allocation]] = field(default_factory=dict)
     annotations: Optional["PlanAnnotations"] = None
 
     def append_update(
@@ -1349,16 +1385,28 @@ class Plan:
     def append_alloc(self, alloc: Allocation) -> None:
         self.node_allocation.setdefault(alloc.node_id, []).append(alloc)
 
+    def append_preempted_alloc(self, alloc: Allocation) -> None:
+        """Stage an eviction that makes room for a higher-priority
+        placement.  The copy keeps the victim's modify_index — the plan
+        applier's staleness fence (reject if it moved underneath)."""
+        new_alloc = alloc.copy()
+        new_alloc.job = None
+        new_alloc.resources = None
+        new_alloc.desired_status = ALLOC_DESIRED_STATUS_EVICT
+        new_alloc.desired_description = ALLOC_PREEMPTED
+        self.node_preemptions.setdefault(alloc.node_id, []).append(new_alloc)
+
     def append_slab(self, slab: AllocSlab) -> None:
         self.alloc_slabs.append(slab)
 
     def is_no_op(self) -> bool:
         return (not self.node_update and not self.node_allocation
-                and not self.alloc_slabs)
+                and not self.alloc_slabs and not self.node_preemptions)
 
     def total_allocs(self) -> int:
         return (sum(len(v) for v in self.node_allocation.values())
                 + sum(len(v) for v in self.node_update.values())
+                + sum(len(v) for v in self.node_preemptions.values())
                 + sum(len(sl) for sl in self.alloc_slabs))
 
 
@@ -1369,6 +1417,7 @@ class PlanResult:
     node_update: Dict[str, List[Allocation]] = field(default_factory=dict)
     node_allocation: Dict[str, List[Allocation]] = field(default_factory=dict)
     alloc_slabs: List[AllocSlab] = field(default_factory=list)
+    node_preemptions: Dict[str, List[Allocation]] = field(default_factory=dict)
     refresh_index: int = 0
     alloc_index: int = 0
 
@@ -1382,6 +1431,9 @@ class PlanResult:
         for node, allocs in plan.node_allocation.items():
             expected += len(allocs)
             actual += len(self.node_allocation.get(node, []))
+        for node, allocs in plan.node_preemptions.items():
+            expected += len(allocs)
+            actual += len(self.node_preemptions.get(node, []))
         expected += sum(len(sl) for sl in plan.alloc_slabs)
         actual += sum(len(sl) for sl in self.alloc_slabs)
         return actual == expected, expected, actual

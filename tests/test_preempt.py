@@ -1,0 +1,449 @@
+"""Priority-tier preemption: oracle eviction-set selection, the batched
+device twin, scheduler integration, and the plan-apply staleness fence
+(scheduler/preempt.py, ops/preempt.py, server/plan_apply.py)."""
+import logging
+
+import numpy as np
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.scheduler import Harness
+from nomad_tpu.scheduler.generic import GenericScheduler
+from nomad_tpu.scheduler import preempt as oracle
+from nomad_tpu.structs import structs as s
+
+
+def make_node(cpu=4000, mem=8192):
+    n = mock.node()
+    n.resources = s.Resources(cpu=cpu, memory_mb=mem,
+                              disk_mb=100 * 1024, iops=150)
+    n.reserved = None
+    n.resources.networks = []
+    return n
+
+
+def make_alloc(node, prio, cpu, mem, job=None):
+    if job is None:
+        job = mock.job()
+        job.priority = prio
+        job.task_groups[0].count = 0
+    a = s.Allocation(
+        id=s.generate_uuid(), job_id=job.id, job=job, node_id=node.id,
+        task_group="web", name=f"{job.name}.web[0]",
+        resources=s.Resources(cpu=cpu, memory_mb=mem))
+    return a
+
+
+def assert_inclusion_minimal(node, allocs, ask, victims):
+    """No member of the eviction set can be spared: removing any single
+    victim from the set breaks the fit."""
+    from nomad_tpu.structs.funcs import remove_allocs
+
+    survivors = remove_allocs(allocs, victims)
+    probe = s.Allocation(id="_probe", resources=ask)
+    from nomad_tpu.structs.funcs import allocs_fit
+
+    fit, _, _ = allocs_fit(node, survivors + [probe])
+    assert fit, "eviction set does not make the ask fit"
+    for spared in victims:
+        kept = [v for v in victims if v.id != spared.id]
+        survivors2 = remove_allocs(allocs, kept)
+        fit2, _, _ = allocs_fit(node, survivors2 + [probe])
+        assert not fit2, f"victim {spared.id} was unnecessary"
+
+
+# -- oracle ----------------------------------------------------------------
+
+
+def test_oracle_minimality_trims_unneeded_victims():
+    # Greedy prefix picks the big-memory prio-10 alloc first, but the ask
+    # only needs cpu — the reverse trim must drop it.
+    node = make_node(cpu=1000, mem=8192)
+    mem_hog = make_alloc(node, 10, cpu=0, mem=6000)
+    cpu_hog = make_alloc(node, 20, cpu=900, mem=100)
+    allocs = [mem_hog, cpu_hog]
+    ask = s.Resources(cpu=800, memory_mb=100)
+    victims = oracle.find_eviction_set(node, allocs, ask, priority=50)
+    assert victims is not None
+    assert [v.id for v in victims] == [cpu_hog.id]
+    assert_inclusion_minimal(node, allocs, ask, victims)
+
+
+def test_oracle_orders_priority_then_largest_first():
+    node = make_node(cpu=4000, mem=8192)
+    small_low = make_alloc(node, 10, cpu=500, mem=500)
+    big_low = make_alloc(node, 10, cpu=1500, mem=1500)
+    mid = make_alloc(node, 30, cpu=2000, mem=2000)
+    allocs = [small_low, mid, big_low]
+    # Needs 1500 cpu freed: one eviction of the LARGEST prio-10 alloc
+    # suffices; evicting prio-30 work or both prio-10 allocs would not
+    # be minimal-cheapest.
+    ask = s.Resources(cpu=1500, memory_mb=1500)
+    victims = oracle.find_eviction_set(node, allocs, ask, priority=50)
+    assert [v.id for v in victims] == [big_low.id]
+    assert_inclusion_minimal(node, allocs, ask, victims)
+
+
+def test_oracle_never_evicts_equal_or_higher_priority():
+    node = make_node(cpu=1000, mem=1000)
+    peer = make_alloc(node, 50, cpu=900, mem=900)
+    ask = s.Resources(cpu=500, memory_mb=500)
+    # Same tier: nothing to evict.
+    assert oracle.find_eviction_set(node, [peer], ask, priority=50) is None
+    higher = make_alloc(node, 80, cpu=900, mem=900)
+    assert oracle.find_eviction_set(node, [higher], ask, priority=50) is None
+    # Strictly lower: allowed.
+    victims = oracle.find_eviction_set(node, [peer], ask, priority=51)
+    assert [v.id for v in victims] == [peer.id]
+
+
+def test_oracle_fit_without_eviction_returns_empty():
+    node = make_node()
+    low = make_alloc(node, 10, cpu=100, mem=100)
+    ask = s.Resources(cpu=500, memory_mb=500)
+    assert oracle.find_eviction_set(node, [low], ask, priority=50) == []
+
+
+def test_oracle_infeasible_when_all_candidates_insufficient():
+    node = make_node(cpu=1000, mem=1000)
+    low = make_alloc(node, 10, cpu=300, mem=300)
+    high = make_alloc(node, 90, cpu=600, mem=600)
+    # Evicting the only candidate (prio 10) frees 300: 100 free + 300
+    # < 500 cpu — and the prio-90 alloc is untouchable.
+    ask = s.Resources(cpu=500, memory_mb=500)
+    assert oracle.find_eviction_set(node, [low, high], ask,
+                                    priority=50) is None
+
+
+# -- scheduler integration (the evict/priority flags are consumed) ---------
+
+
+def fill_cluster(h, n_nodes=3, per_node=3, filler_prio=20,
+                 alloc_cpu=1200, alloc_mem=2500):
+    filler = mock.job()
+    filler.priority = filler_prio
+    filler.task_groups[0].count = 0
+    h.state.upsert_job(h.next_index(), filler)
+    nodes = []
+    for i in range(n_nodes):
+        n = mock.node()
+        n.resources.networks = []
+        n.reserved.networks = []
+        h.state.upsert_node(h.next_index(), n)
+        nodes.append(n)
+        for k in range(per_node):
+            a = s.Allocation(
+                id=s.generate_uuid(), job_id=filler.id, job=filler,
+                node_id=n.id, task_group="web", name=f"f.web[{k}]",
+                resources=s.Resources(cpu=alloc_cpu, memory_mb=alloc_mem))
+            h.state.upsert_allocs(h.next_index(), [a])
+    return filler, nodes
+
+
+def high_prio_job(count=2, prio=70, cpu=1000, mem=2000):
+    job = mock.job()
+    job.priority = prio
+    job.task_groups[0].count = count
+    for t in job.task_groups[0].tasks:
+        t.resources = s.Resources(cpu=cpu, memory_mb=mem)
+    return job
+
+
+def register_eval(job):
+    return s.Evaluation(
+        id=s.generate_uuid(), priority=job.priority, type=job.type,
+        triggered_by=s.EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
+        status=s.EVAL_STATUS_PENDING)
+
+
+def test_oracle_scheduler_preempts_when_enabled():
+    h = Harness()
+    filler, _ = fill_cluster(h)
+    job = high_prio_job()
+    h.state.upsert_job(h.next_index(), job)
+    sched = GenericScheduler(h.logger, h.snapshot(), h, batch=False,
+                             preemption_enabled=True)
+    sched.process(register_eval(job))
+
+    plan = h.plans[0]
+    placed = [a for l in plan.node_allocation.values() for a in l]
+    evicted = [a for l in plan.node_preemptions.values() for a in l]
+    assert len(placed) == 2
+    assert evicted and all(a.desired_status == s.ALLOC_DESIRED_STATUS_EVICT
+                           for a in evicted)
+    assert all(a.desired_description == s.ALLOC_PREEMPTED for a in evicted)
+    # The no-eviction-of-equal-or-higher-priority invariant, end to end.
+    for a in evicted:
+        victim_job = h.state.job_by_id(None, a.job_id)
+        assert victim_job.priority < job.priority
+    # Evicted jobs get a blocked follow-up eval so they reschedule.
+    pe = [e for e in h.create_evals
+          if e.triggered_by == s.EVAL_TRIGGER_PREEMPTION]
+    assert len(pe) == 1
+    assert pe[0].job_id == filler.id
+    assert pe[0].status == s.EVAL_STATUS_BLOCKED
+
+
+def test_oracle_scheduler_without_preemption_blocks():
+    h = Harness()
+    fill_cluster(h)
+    job = high_prio_job()
+    h.state.upsert_job(h.next_index(), job)
+    sched = GenericScheduler(h.logger, h.snapshot(), h, batch=False,
+                             preemption_enabled=False)
+    sched.process(register_eval(job))
+    assert h.plans == []
+    blocked = [e for e in h.create_evals
+               if e.status == s.EVAL_STATUS_BLOCKED]
+    assert blocked, "disabled preemption must leave a blocked eval"
+
+
+def test_batch_scheduler_preempt_pass():
+    from nomad_tpu.ops.batch_sched import TPUBatchScheduler
+
+    h = Harness()
+    filler, _ = fill_cluster(h, n_nodes=4)
+    job = high_prio_job(count=3)
+    h.state.upsert_job(h.next_index(), job)
+    sched = TPUBatchScheduler(h.logger, h.snapshot(), h,
+                              preemption_enabled=True)
+    stats = sched.schedule_batch([register_eval(job)])
+
+    assert stats.preempt_placed == 3
+    assert stats.preempt_checked == 3
+    assert stats.preempt_agree == stats.preempt_checked
+    plan = h.plans[0]
+    evicted = [a for l in plan.node_preemptions.values() for a in l]
+    assert stats.preempt_evicted == len(evicted) > 0
+    assert len(h.state.allocs_by_job(None, job.id, True)) == 3
+    pe = [e for e in h.create_evals
+          if e.triggered_by == s.EVAL_TRIGGER_PREEMPTION]
+    assert len(pe) == 1 and pe[0].job_id == filler.id
+
+
+def test_batch_preempt_evicts_slab_backed_allocs():
+    """Steady-state clusters hold SLAB-backed allocs (the TPU placement
+    path); victims must be materialized rows with real ids, not shared
+    slab protos, or the plan applier's staleness fence rejects every
+    preemption commit."""
+    from nomad_tpu.ops.batch_sched import TPUBatchScheduler
+
+    h = Harness()
+    for i in range(3):
+        n = mock.node()
+        n.resources.networks = []
+        n.reserved.networks = []
+        h.state.upsert_node(h.next_index(), n)
+    # Fill via the batch scheduler itself so state holds AllocSlabs.
+    filler = mock.job()
+    filler.priority = 20
+    filler.task_groups[0].count = 9
+    for t in filler.task_groups[0].tasks:
+        t.resources = s.Resources(cpu=1200, memory_mb=2500)
+    h.state.upsert_job(h.next_index(), filler)
+    TPUBatchScheduler(h.logger, h.snapshot(), h).schedule_batch(
+        [register_eval(filler)])
+    # NO state reads between fill and preempt: a by-id/by-job read would
+    # materialize the slab rows and hide the shared-proto hazard this
+    # test exists to pin.
+
+    job = high_prio_job(count=2)
+    h.state.upsert_job(h.next_index(), job)
+    sched = TPUBatchScheduler(h.logger, h.snapshot(), h,
+                              preemption_enabled=True)
+    stats = sched.schedule_batch([register_eval(job)])
+
+    assert stats.preempt_placed == 2
+    assert stats.preempt_agree == stats.preempt_checked == 2
+    plan = h.plans[-1]
+    evicted = [a for l in plan.node_preemptions.values() for a in l]
+    assert evicted and all(a.id for a in evicted)
+    # The evictions landed on the REAL state rows.
+    evicted_state = [a for a in h.state.allocs_by_job(None, filler.id, True)
+                     if a.desired_status == s.ALLOC_DESIRED_STATUS_EVICT]
+    assert {a.id for a in evicted_state} == {a.id for a in evicted}
+    assert len(h.state.allocs_by_job(None, job.id, True)) == 2
+
+
+def test_batch_scheduler_preempt_disabled_is_inert():
+    from nomad_tpu.ops.batch_sched import TPUBatchScheduler
+
+    h = Harness()
+    fill_cluster(h, n_nodes=2)
+    job = high_prio_job(count=1)
+    h.state.upsert_job(h.next_index(), job)
+    sched = TPUBatchScheduler(h.logger, h.snapshot(), h,
+                              preemption_enabled=False)
+    stats = sched.schedule_batch([register_eval(job)])
+    assert stats.preempt_placed == 0
+    assert not h.state.allocs_by_job(None, job.id, True)
+
+
+# -- kernel/oracle agreement ------------------------------------------------
+
+
+def test_selfcheck_small_cluster():
+    from nomad_tpu.ops.preempt import selfcheck
+
+    assert selfcheck(n_nodes=16, n_specs=8, seed=3, log=lambda *a: None)
+
+
+def test_kernel_invariant_no_high_priority_eviction():
+    from nomad_tpu.ops.preempt import (
+        encode_alloc_tensors, eviction_sets, random_cluster)
+    import jax.numpy as jnp
+
+    nodes, allocs_by_node, asks, priorities = random_cluster(24, 12, seed=7)
+    prio_np, sizes, sorted_allocs = encode_alloc_tensors(
+        [n.id for n in nodes], allocs_by_node, oracle.alloc_priority)
+    free = np.zeros((len(nodes), 4), dtype=np.int32)
+    used = np.zeros((len(nodes), 4), dtype=np.int32)
+    denom = np.ones((len(nodes), 2), dtype=np.float32)
+    for i, n in enumerate(nodes):
+        cap = np.array([n.resources.cpu, n.resources.memory_mb,
+                        n.resources.disk_mb, n.resources.iops])
+        u = np.array([n.reserved.cpu, n.reserved.memory_mb,
+                      n.reserved.disk_mb, n.reserved.iops])
+        for a in allocs_by_node[n.id]:
+            u = u + np.array(oracle.alloc_size(a))
+        free[i], used[i] = cap - u, u
+        denom[i] = (cap[0] - n.reserved.cpu, cap[1] - n.reserved.memory_mb)
+    ask_arr = np.array([[r.cpu, r.memory_mb, r.disk_mb, r.iops]
+                        for r in asks], dtype=np.int32)
+    jp = np.array(priorities, dtype=np.int32)
+    mask, feasible, n_evict, _ = (np.asarray(x) for x in eviction_sets(
+        jnp.asarray(free), jnp.asarray(used), jnp.asarray(denom),
+        jnp.asarray(prio_np), jnp.asarray(sizes),
+        jnp.asarray(ask_arr), jnp.asarray(jp)))
+    # Masked allocs always have strictly lower priority than the spec.
+    for u in range(len(asks)):
+        sel = mask[u]                                   # [N, A]
+        assert not np.any(sel & (prio_np >= jp[u])), u
+        assert np.array_equal(sel.sum(axis=1), n_evict[u])
+        assert not np.any(n_evict[u][~feasible[u]]), "mask outside feasible"
+
+
+@pytest.mark.slow
+def test_fuzz_kernel_matches_oracle():
+    from nomad_tpu.ops.preempt import agreement_check, random_cluster
+
+    for seed in (1, 2, 3, 4):
+        nodes, allocs_by_node, asks, priorities = random_cluster(
+            48, 24, seed=seed)
+        checked, n_mismatch, mismatches = agreement_check(
+            nodes, allocs_by_node, asks, priorities)
+        assert checked == 48 * 24
+        assert n_mismatch == 0, mismatches
+
+
+# -- plan apply: optimistic concurrency over preempted allocs ---------------
+
+
+def make_applier():
+    from nomad_tpu.server import (
+        BlockedEvals, EvalBroker, FSM, InmemLog, PlanApplier, PlanQueue)
+
+    fsm = FSM(logger=logging.getLogger("test-preempt"))
+    raft = InmemLog(fsm)
+    broker = EvalBroker()
+    broker.set_enabled(True)
+    blocked = BlockedEvals(broker)
+    blocked.set_enabled(True)
+    pq = PlanQueue()
+    pq.set_enabled(True)
+    return PlanApplier(pq, raft, blocked_evals=blocked), raft, blocked
+
+
+def seed_victim(raft):
+    from nomad_tpu.server import MessageType
+
+    node = mock.node()
+    node.resources.networks = []
+    node.reserved.networks = []
+    raft.apply(MessageType.NODE_REGISTER, {"node": node})
+    filler = mock.job()
+    filler.priority = 20
+    filler.task_groups[0].count = 0
+    raft.apply(MessageType.JOB_REGISTER, {"job": filler})
+    victim = s.Allocation(
+        id=s.generate_uuid(), job_id=filler.id, node_id=node.id,
+        task_group="web", name="f.web[0]",
+        resources=s.Resources(cpu=3000, memory_mb=6000))
+    raft.apply(MessageType.ALLOC_UPDATE, {"allocs": [victim],
+                                          "job": filler})
+    return node, filler, victim
+
+
+def preempt_plan(snap, node, victim, hi_job):
+    plan = s.Plan(eval_id=s.generate_uuid(), priority=hi_job.priority,
+                  job=hi_job)
+    plan.append_preempted_alloc(snap.alloc_by_id(None, victim.id))
+    placed = s.Allocation(
+        id=s.generate_uuid(), job_id=hi_job.id, node_id=node.id,
+        task_group="web", name="hi.web[0]",
+        resources=s.Resources(cpu=2000, memory_mb=4000))
+    plan.append_alloc(placed)
+    return plan, placed
+
+
+def test_plan_apply_commits_evict_and_place_atomically():
+    applier, raft, blocked = make_applier()
+    node, filler, victim = seed_victim(raft)
+    hi = mock.job()
+    hi.priority = 80
+    snap = raft.fsm.state.snapshot()
+    plan, placed = preempt_plan(snap, node, victim, hi)
+
+    result = applier.evaluate_plan(snap, plan)
+    assert result.node_preemptions
+    assert result.full_commit(plan)[0]
+    applier.apply_plan(plan, result, snap)
+
+    state = raft.fsm.state
+    assert (state.alloc_by_id(None, victim.id).desired_status
+            == s.ALLOC_DESIRED_STATUS_EVICT)
+    assert state.alloc_by_id(None, placed.id) is not None
+    evs = [e for e in state.evals(None)
+           if e.triggered_by == s.EVAL_TRIGGER_PREEMPTION]
+    assert len(evs) == 1
+    assert evs[0].job_id == filler.id
+    assert evs[0].status == s.EVAL_STATUS_BLOCKED
+    assert blocked.stats()["total_blocked"] == 1
+
+
+def test_plan_apply_rejects_stale_preempted_alloc():
+    from nomad_tpu.server import MessageType
+
+    applier, raft, _ = make_applier()
+    node, filler, victim = seed_victim(raft)
+    hi = mock.job()
+    hi.priority = 80
+    snap = raft.fsm.state.snapshot()
+    plan, _ = preempt_plan(snap, node, victim, hi)
+
+    # Concurrent state change to the victim AFTER the scheduler's
+    # snapshot: the client reports it running, bumping modify_index.
+    upd = s._fast_copy(victim)
+    upd.client_status = s.ALLOC_CLIENT_STATUS_RUNNING
+    raft.apply(MessageType.ALLOC_CLIENT_UPDATE, {"allocs": [upd]})
+
+    fresh_snap = raft.fsm.state.snapshot()
+    result = applier.evaluate_plan(fresh_snap, plan)
+    assert not result.node_preemptions
+    assert not result.node_allocation
+    assert result.refresh_index > 0, "rejection must force a state refresh"
+    # The victim is untouched.
+    assert (raft.fsm.state.alloc_by_id(None, victim.id).desired_status
+            == s.ALLOC_DESIRED_STATUS_RUN)
+
+
+def test_plan_apply_rejects_vanished_preempted_alloc():
+    applier, raft, _ = make_applier()
+    node, filler, victim = seed_victim(raft)
+    hi = mock.job()
+    hi.priority = 80
+    snap = raft.fsm.state.snapshot()
+    plan, _ = preempt_plan(snap, node, victim, hi)
+    plan.node_preemptions[node.id][0].id = "no-such-alloc"
+    result = applier.evaluate_plan(snap, plan)
+    assert not result.node_preemptions and not result.node_allocation
