@@ -18,7 +18,9 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from .. import fault
 from ..structs import structs as s
+from ..utils.backoff import Backoff
 from .alloc_runner import AllocRunner
 from .config import ClientConfig
 from .fingerprint import fingerprint_node
@@ -170,8 +172,26 @@ class Client:
             r.save_state()
 
     # -- registration + heartbeat (client.go:1031) -------------------------
+    @staticmethod
+    def _client_rpc_fault(method: str) -> None:
+        """Client-side ``rpc.send`` fault point: the agent's logical
+        server calls pass through here even when the transport is an
+        in-process Server (dev/test), so scenarios can drop or delay a
+        client's registration/heartbeat/watch traffic deterministically
+        regardless of wiring.  drop/error/truncate all surface as the
+        exception the surrounding retry loop already handles."""
+        act = fault.faultpoint("rpc.send", method=method, side="client")
+        if act is None:
+            return
+        if act.kind == "delay":
+            time.sleep(act.delay)
+            return
+        if act.kind in ("drop", "truncate", "error", "crash"):
+            act.raise_injected()
+
     def _try_register(self) -> bool:
         try:
+            self._client_rpc_fault("Node.Register")
             _index, ttl = self.rpc.node_register(self.node.copy())
             self.heartbeat_ttl = ttl or self.heartbeat_ttl
             self.node.status = s.NODE_STATUS_READY
@@ -209,12 +229,18 @@ class Client:
         return True
 
     def _register_and_heartbeat(self) -> None:
+        # Jittered exponential backoff between registration attempts: a
+        # fleet re-registering after a server restart must spread out
+        # rather than re-dial on one fixed 15s boundary.
+        register_backoff = Backoff(base=0.5,
+                                   max_delay=REGISTER_RETRY_INTERVAL)
         while not self._shutdown.is_set():
             if self._try_register():
                 break
             if self._consul_discover_servers():
+                register_backoff.reset()
                 continue  # fresh servers — retry immediately
-            if self._shutdown.wait(REGISTER_RETRY_INTERVAL):
+            if self._shutdown.wait(register_backoff.next_delay()):
                 return
         # Heartbeat at TTL/2-ish like the reference's jittered resend
         while not self._shutdown.is_set():
@@ -222,6 +248,7 @@ class Client:
             if self._shutdown.wait(wait):
                 return
             try:
+                self._client_rpc_fault("Node.UpdateStatus")
                 _index, ttl = self.rpc.node_update_status(
                     self.node.id, s.NODE_STATUS_READY)
                 if ttl:
@@ -237,16 +264,19 @@ class Client:
     # -- allocation watching (client.go:1364 watchAllocations) -------------
     def _watch_allocations(self) -> None:
         self._registered.wait()
+        watch_backoff = Backoff(base=0.25, max_delay=5.0)
         while not self._shutdown.is_set():
             try:
+                self._client_rpc_fault("Node.GetClientAllocs")
                 allocs, index = self.rpc.node_get_client_allocs(
                     self.node.id, min_index=self._latest_alloc_index,
                     max_wait=5.0)
             except Exception as e:
                 self.logger.warning("client: alloc watch failed: %s", e)
-                if self._shutdown.wait(1.0):
+                if self._shutdown.wait(watch_backoff.next_delay()):
                     return
                 continue
+            watch_backoff.reset()
             if index <= self._latest_alloc_index:
                 continue
             self._latest_alloc_index = index

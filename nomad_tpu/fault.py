@@ -1,0 +1,230 @@
+"""Deterministic, seeded fault-injection plane.
+
+Production control planes are judged by what happens when things break:
+leaders crash mid-plan, nodes go silent, frames get truncated, kernels
+misbehave.  The recovery machinery (nack timers, heartbeat TTLs,
+lost-alloc rescheduling, the TPU-path circuit breaker) exists — this
+module is how tests *exercise* it deterministically.
+
+Model
+-----
+Code under test is threaded with named **fault points**::
+
+    act = fault.faultpoint("rpc.send", method="Node.Register")
+    if act is not None:
+        ...interpret act.kind ("drop" / "delay" / "truncate" / ...)
+
+A disarmed plane (the default, and the only production state) costs one
+module-global load and a ``None`` check per call — no locks, no dict
+lookups, nothing to configure off.
+
+Tests arm a **scenario**: a seed plus a list of rules.  Each rule names a
+point (exact or ``fnmatch`` glob), an action, and firing conditions::
+
+    fault.arm({"seed": 7, "faults": [
+        {"point": "heartbeat.deliver", "action": "drop", "times": 3},
+        {"point": "raft.apply", "action": "crash",
+         "match": {"msg_type": "APPLY_PLAN_RESULTS"}, "after": 1},
+        {"point": "rpc.send", "action": "truncate", "prob": 0.2},
+    ]})
+
+Determinism: every rule owns a private RNG derived from
+``(scenario seed, rule index, point)``, and per-rule hit counters are
+taken under one lock — the decision sequence *per rule* is a pure
+function of the seed and the order of matching calls.  The plane records
+every fire in ``trace()`` so a test can assert "same seed → same trace".
+
+Fault-point catalog (kept in sync with README "Fault model"):
+
+=====================  ====================================================
+point                  armed at
+=====================  ====================================================
+``rpc.send``           every wire frame send (server/rpc.py) and the
+                       client agent's logical server calls
+                       (client/client.py); actions: drop, delay, dup,
+                       truncate, error
+``raft.apply``         leader log append (server/raft.py RaftLog.apply /
+                       MultiRaft.apply); actions: crash, step_down,
+                       delay, error
+``heartbeat.deliver``  leader-side TTL reset (server/heartbeat.py);
+                       actions: drop (silence the heartbeat), delay
+``plan.apply``         plan applier commit path (server/plan_apply.py);
+                       actions: crash, error, delay
+``ops.kernel_result``  device→host kernel outputs (ops/batch_sched.py);
+                       actions: corrupt (hands the site a seeded RNG)
+=====================  ====================================================
+"""
+from __future__ import annotations
+
+import fnmatch
+import random
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "FaultAction", "FaultRule", "FaultPlane", "InjectedFault",
+    "arm", "disarm", "armed", "faultpoint", "scenario", "trace",
+]
+
+ACTIONS = ("drop", "delay", "dup", "truncate", "error", "crash",
+           "step_down", "corrupt")
+
+
+class InjectedFault(Exception):
+    """An error deliberately raised by a fault point (``error`` / ``crash``
+    actions).  Distinct type so tests can tell injected failures from real
+    bugs surfacing mid-scenario."""
+
+
+class FaultRule:
+    """One scenario rule; see module docstring for field semantics."""
+
+    __slots__ = ("point", "action", "prob", "after", "times", "delay",
+                 "match", "message", "seen", "fired", "rng", "index")
+
+    def __init__(self, spec: Dict[str, Any], index: int, seed: int):
+        self.point: str = spec["point"]
+        self.action: str = spec["action"]
+        if self.action not in ACTIONS:
+            raise ValueError(f"unknown fault action {self.action!r}")
+        self.prob: float = float(spec.get("prob", 1.0))
+        self.after: int = int(spec.get("after", 0))
+        times = spec.get("times")
+        self.times: Optional[int] = None if times is None else int(times)
+        self.delay: float = float(spec.get("delay", 0.05))
+        self.match: Dict[str, Any] = dict(spec.get("match") or {})
+        self.message: str = spec.get(
+            "error", f"injected {self.action} at {self.point}")
+        self.index = index
+        # Private, reproducible stream: str seeding hashes via sha512
+        # (CPython seeding version 2), immune to PYTHONHASHSEED.
+        self.rng = random.Random(f"{seed}/{index}/{self.point}")
+        self.seen = 0    # matching calls observed
+        self.fired = 0   # times the action actually fired
+
+    def matches(self, name: str, ctx: Dict[str, Any]) -> bool:
+        if name != self.point and not fnmatch.fnmatchcase(name, self.point):
+            return False
+        for key, want in self.match.items():
+            if ctx.get(key) != want:
+                return False
+        return True
+
+
+class FaultAction:
+    """What a fault point should do right now.  ``rng`` is the owning
+    rule's private stream — ``corrupt`` sites draw from it so the damage
+    is a pure function of the scenario seed."""
+
+    __slots__ = ("kind", "delay", "message", "rng", "rule")
+
+    def __init__(self, rule: FaultRule):
+        self.kind = rule.action
+        self.delay = rule.delay
+        self.message = rule.message
+        self.rng = rule.rng
+        self.rule = rule
+
+    def raise_injected(self) -> None:
+        raise InjectedFault(self.message)
+
+    def __repr__(self) -> str:  # pragma: no cover — debugging aid
+        return f"FaultAction({self.kind!r} from rule {self.rule.index})"
+
+
+class FaultPlane:
+    """One armed scenario: rules + counters + the fire trace."""
+
+    def __init__(self, rules: List[Dict[str, Any]], seed: int = 0):
+        self.seed = seed
+        self.rules = [FaultRule(spec, i, seed)
+                      for i, spec in enumerate(rules)]
+        self._l = threading.Lock()
+        self._trace: List[Tuple[str, int, str]] = []
+
+    def fire(self, name: str, ctx: Dict[str, Any]) -> Optional[FaultAction]:
+        """First matching rule that decides to fire wins; counters and the
+        probability draw happen under the lock so the per-rule decision
+        sequence is deterministic in call order."""
+        for rule in self.rules:
+            if not rule.matches(name, ctx):
+                continue
+            with self._l:
+                rule.seen += 1
+                if rule.seen <= rule.after:
+                    continue
+                if rule.times is not None and rule.fired >= rule.times:
+                    continue
+                if rule.prob < 1.0 and rule.rng.random() >= rule.prob:
+                    continue
+                rule.fired += 1
+                self._trace.append((name, rule.index, rule.action))
+            return FaultAction(rule)
+        return None
+
+    def trace(self) -> List[Tuple[str, int, str]]:
+        with self._l:
+            return list(self._trace)
+
+
+# -- process-wide arming -----------------------------------------------------
+
+# The single global the hot path reads.  ``None`` ⇒ disarmed ⇒ every
+# faultpoint() call is one load + one comparison.
+_PLANE: Optional[FaultPlane] = None
+
+
+def faultpoint(name: str, **ctx: Any) -> Optional[FaultAction]:
+    """The hook threaded through production code.  Returns ``None`` when
+    disarmed or when no armed rule fires."""
+    plane = _PLANE
+    if plane is None:
+        return None
+    return plane.fire(name, ctx)
+
+
+def arm(scenario_cfg, seed: Optional[int] = None) -> FaultPlane:
+    """Arm a scenario.  ``scenario_cfg`` is either a list of rule dicts or
+    a dict ``{"seed": int, "faults": [rules...]}``; an explicit ``seed``
+    argument overrides the config's."""
+    global _PLANE
+    if isinstance(scenario_cfg, dict):
+        rules = scenario_cfg.get("faults") or []
+        cfg_seed = int(scenario_cfg.get("seed", 0))
+    else:
+        rules = list(scenario_cfg)
+        cfg_seed = 0
+    plane = FaultPlane(rules, seed=cfg_seed if seed is None else int(seed))
+    _PLANE = plane
+    return plane
+
+
+def disarm() -> None:
+    global _PLANE
+    _PLANE = None
+
+
+def armed() -> bool:
+    return _PLANE is not None
+
+
+def trace() -> List[Tuple[str, int, str]]:
+    plane = _PLANE
+    return plane.trace() if plane is not None else []
+
+
+class scenario:
+    """Context manager: ``with fault.scenario(cfg, seed=7) as plane: ...``
+    — always disarms on exit, even when the chaos leaks an exception."""
+
+    def __init__(self, scenario_cfg, seed: Optional[int] = None):
+        self.cfg = scenario_cfg
+        self.seed = seed
+        self.plane: Optional[FaultPlane] = None
+
+    def __enter__(self) -> FaultPlane:
+        self.plane = arm(self.cfg, seed=self.seed)
+        return self.plane
+
+    def __exit__(self, *exc) -> None:
+        disarm()

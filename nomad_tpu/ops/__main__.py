@@ -1,10 +1,16 @@
 """`python -m nomad_tpu.ops --selfcheck`: fast oracle/kernel agreement
 checks runnable without a test harness (CI smoke; seconds on CPU).
 
-Currently covers the preemption subsystem: the batched eviction-set
-kernel (ops/preempt.py) must produce exactly the oracle's
-(scheduler/preempt.py) eviction set for every (task-group, node) pair
-of a seeded random 64x64 cluster.
+Covers:
+
+- the preemption subsystem: the batched eviction-set kernel
+  (ops/preempt.py) must produce exactly the oracle's
+  (scheduler/preempt.py) eviction set for every (task-group, node) pair
+  of a seeded random 64x64 cluster;
+- the degradation plane: a breaker drill injects one corrupted kernel
+  batch (fault point ``ops.kernel_result``) and asserts the circuit
+  breaker trips, every eval still completes via the CPU oracle, and a
+  clean half-open probe restores the device path.
 """
 from __future__ import annotations
 
@@ -12,6 +18,83 @@ import argparse
 import sys
 
 from .preempt import selfcheck
+
+
+def breaker_drill(seed: int = 0, log=print) -> bool:
+    """Inject one corrupted kernel batch; assert trip → oracle fallback →
+    recovery.  Uses a private breaker with a fake clock so the drill is
+    instant and never touches the process-wide breaker."""
+    from .. import fault, mock
+    from ..scheduler import Harness
+    from ..structs import structs as s
+    from .batch_sched import TPUBatchScheduler
+    from .breaker import KernelCircuitBreaker
+
+    clock = [0.0]
+    brk = KernelCircuitBreaker(threshold=0.9, window=8, min_checks=1,
+                               cooldown=5.0, clock=lambda: clock[0])
+    h = Harness()
+    for _ in range(8):
+        node = mock.node()
+        node.resources.networks = []
+        node.reserved.networks = []
+        node.compute_class()
+        h.state.upsert_node(h.next_index(), node)
+
+    def run_batch():
+        jobs = []
+        for _ in range(2):
+            job = mock.job()
+            for tg in job.task_groups:
+                for t in tg.tasks:
+                    t.resources.networks = []
+            job.task_groups[0].count = 2
+            h.state.upsert_job(h.next_index(), job)
+            jobs.append(job)
+        evals = [s.Evaluation(
+            id=s.generate_uuid(), priority=j.priority, type=j.type,
+            triggered_by=s.EVAL_TRIGGER_JOB_REGISTER, job_id=j.id,
+            status=s.EVAL_STATUS_PENDING) for j in jobs]
+        sched = TPUBatchScheduler(h.logger, h.snapshot(), h, breaker=brk)
+        stats = sched.schedule_batch(evals)
+        placed = all(
+            len([a for a in h.state.allocs_by_job(None, j.id, True)
+                 if not a.terminal_status()]) == 2 for j in jobs)
+        return stats, placed
+
+    def check(cond, msg):
+        if not cond:
+            log(f"breaker drill: FAIL — {msg}")
+        return cond
+
+    with fault.scenario({"seed": seed, "faults": [
+            {"point": "ops.kernel_result", "action": "corrupt",
+             "times": 1}]}):
+        stats, placed = run_batch()
+    if not (check(stats.kernel_rejects == 1, "corrupt batch not rejected")
+            and check(placed, "oracle fallback did not place the batch")
+            and check(brk.state == "open",
+                      f"breaker {brk.state!r}, expected open")):
+        return False
+
+    stats2, placed2 = run_batch()
+    if not (check(stats2.oracle_routed > 0, "open breaker did not route "
+                                            "evals through the oracle")
+            and check(placed2, "oracle-routed batch did not place")):
+        return False
+
+    clock[0] += 10.0  # past cooldown: next batch is the half-open probe
+    stats3, placed3 = run_batch()
+    if not (check(stats3.oracle_routed == 0, "probe batch did not take "
+                                             "the device path")
+            and check(placed3, "probe batch did not place")
+            and check(brk.state == "closed",
+                      f"breaker {brk.state!r} after clean probe")):
+        return False
+    log(f"breaker drill: OK — trip on corrupt batch (seed {seed}), "
+        "oracle fallback placed everything, clean probe re-closed "
+        f"(trips={brk.trips})")
+    return True
 
 
 def main(argv=None) -> int:
@@ -26,6 +109,7 @@ def main(argv=None) -> int:
         parser.print_help()
         return 2
     ok = selfcheck(n_nodes=args.nodes, n_specs=args.specs, seed=args.seed)
+    ok = breaker_drill(seed=args.seed) and ok
     return 0 if ok else 1
 
 

@@ -31,11 +31,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import fault
 from ..scheduler.generic import GenericScheduler
 from ..scheduler.scheduler import register_scheduler
 from ..scheduler.util import AllocTuple, ready_nodes_in_dcs, set_status
 from ..structs import structs as s
+from . import breaker as breaker_mod
 from . import encode, kernels, xfer
+from .breaker import HALF_OPEN, KernelIntegrityError
 from .kernels import device_pass, summary_layout
 
 logger = logging.getLogger("nomad_tpu.ops.batch_sched")
@@ -85,6 +88,73 @@ def _ensure_compile_cache() -> None:
         os.environ.get("NOMAD_TPU_COMPILE_CACHE_DIR",
                        os.path.expanduser("~/.cache/nomad_tpu/xla")))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def validate_device_outputs(spec_list, ct, unplaced_arr, coo_rows,
+                            coo_cols, coo_counts) -> Optional[str]:
+    """Structural-invariant check on kernel outputs, run before any
+    placement is materialized into a plan.  A healthy kernel satisfies
+    all of these by construction; a corrupted result (bad HBM, a
+    miscompiled shape bucket, an injected ``ops.kernel_result`` fault)
+    breaks at least one.  Returns a description of the first violation,
+    or None.  Cost: a few O(U + nnz) numpy passes — noise next to the
+    device round-trip."""
+    n_specs = len(spec_list)
+    counts = np.array([sp.count for sp in spec_list], dtype=np.int64)
+    up = np.asarray(unplaced_arr[:n_specs], dtype=np.int64)
+    if up.shape[0] < n_specs:
+        return f"unplaced vector too short ({up.shape[0]} < {n_specs})"
+    if (up < 0).any():
+        u = int(np.argmax(up < 0))
+        return f"negative unplaced count ({int(up[u])}) for spec {u}"
+    if (up > counts).any():
+        u = int(np.argmax(up > counts))
+        return (f"unplaced {int(up[u])} exceeds ask count "
+                f"{int(counts[u])} for spec {u}")
+    cr = np.asarray(coo_rows, dtype=np.int64)
+    cc = np.asarray(coo_cols, dtype=np.int64)
+    cv = np.asarray(coo_counts, dtype=np.int64)
+    live = (cr >= 0) & (cr < n_specs)
+    # A negative node index on a live row would WRAP via Python negative
+    # indexing downstream (all_nodes[i] / node_ids[i]) and silently land
+    # allocations on a node that never passed feasibility — reject it
+    # explicitly instead of letting the placed-sum check infer it.
+    if (live & (cc < 0)).any():
+        i = int(np.argmax(live & (cc < 0)))
+        return (f"negative node index ({int(cc[i])}) in placement "
+                f"output for spec {int(cr[i])}")
+    valid = live & (cc < ct.n_real)
+    if (cv[valid] < 0).any():
+        return "negative commit count in placement output"
+    placed = np.zeros(n_specs, dtype=np.int64)
+    if valid.any():
+        np.add.at(placed, cr[valid], cv[valid])
+    bad = placed + up != counts
+    if bad.any():
+        u = int(np.argmax(bad))
+        return (f"placed ({int(placed[u])}) + unplaced ({int(up[u])}) != "
+                f"asks ({int(counts[u])}) for spec {u}")
+    return None
+
+
+def _corrupt_outputs(rng, spec_list, unplaced_arr, coo_counts):
+    """``ops.kernel_result`` corrupt action: seeded, detectable damage to
+    the device outputs (the chaos twin of a flaky accelerator).  Returns
+    writable, corrupted copies."""
+    unplaced_arr = np.array(unplaced_arr)
+    coo_counts = np.array(coo_counts)
+    u = rng.randrange(len(spec_list))
+    mode = rng.randrange(3)
+    if mode == 0:
+        unplaced_arr[u] = -3
+    elif mode == 1:
+        unplaced_arr[u] = spec_list[u].count + 5
+    elif len(coo_counts):
+        i = rng.randrange(len(coo_counts))
+        coo_counts[i] = coo_counts[i] + spec_list[u].count + 1
+    else:
+        unplaced_arr[u] = -1
+    return unplaced_arr, coo_counts
 
 
 class _CollectingScheduler(GenericScheduler):
@@ -166,7 +236,7 @@ class TPUBatchScheduler:
     """
 
     def __init__(self, logger_: logging.Logger, state, planner, mesh=None,
-                 preemption_enabled: Optional[bool] = None):
+                 preemption_enabled: Optional[bool] = None, breaker=None):
         self.logger = logger_
         self.state = state
         self.planner = planner
@@ -190,6 +260,10 @@ class TPUBatchScheduler:
         self._preempt_plan: Dict[Tuple[str, str],
                                  List[Tuple[str, List[s.Allocation]]]] = {}
         self._allocs_by_node: Dict[str, List[s.Allocation]] = {}
+        # TPU-path circuit breaker (ops/breaker.py): process-wide by
+        # default so trips survive the per-batch scheduler construction;
+        # tests inject their own instance.
+        self.breaker = breaker if breaker is not None else breaker_mod.BREAKER
         _ensure_compile_cache()
 
     # -- single-eval compatibility ----------------------------------------
@@ -260,11 +334,7 @@ class TPUBatchScheduler:
                 if ev.id in oracle_eval_ids:
                     self.logger.info(
                         "batch: eval %s routed through oracle", ev.id)
-                    oracle = GenericScheduler(
-                        self.logger, self.state, self.planner,
-                        batch=(ev.type == s.JOB_TYPE_BATCH),
-                        preemption_enabled=self.preemption_enabled)
-                    oracle.process(ev)
+                    self._route_through_oracle([(ev, sched)])
                 else:
                     kept.append((ev, sched))
             scheds = kept
@@ -282,8 +352,62 @@ class TPUBatchScheduler:
         per_spec_metrics: Dict[Tuple[str, str], s.AllocMetric] = {}
 
         if spec_list:
-            expanded, unplaced, per_spec_metrics, kstats = self._place_on_device(
-                spec_list)
+            # Circuit breaker gate: while OPEN every eval takes the CPU
+            # oracle (correct, slower); HALF-OPEN lets this one batch
+            # probe the device path and its verdict resolves the probe.
+            if not self.breaker.allow_kernel():
+                stats.breaker_state = self.breaker.state
+                stats.oracle_routed = len(scheds)
+                self.logger.info(
+                    "batch: kernel breaker %s; routing %d evals through "
+                    "the CPU oracle", stats.breaker_state, len(scheds))
+                self._route_through_oracle(scheds)
+                stats.total_seconds = time.monotonic() - t0
+                stats.num_evals = len(evals)
+                return stats
+            probe = self.breaker.state == HALF_OPEN
+            try:
+                expanded, unplaced, per_spec_metrics, kstats = \
+                    self._place_on_device(spec_list)
+            except KernelIntegrityError as e:
+                # Corrupt kernel output: reject the whole device result,
+                # feed the breaker, and degrade this batch to the oracle
+                # — scheduling continues, nothing mis-places.
+                self.breaker.record(False)
+                if probe:
+                    self.breaker.on_probe(False)
+                self.logger.error(
+                    "batch: kernel output rejected (%s); routing %d evals "
+                    "through the CPU oracle", e, len(scheds))
+                stats.kernel_rejects = 1
+                stats.oracle_routed = len(scheds)
+                stats.breaker_state = self.breaker.state
+                self._route_through_oracle(scheds)
+                stats.total_seconds = time.monotonic() - t0
+                stats.num_evals = len(evals)
+                return stats
+            except Exception:
+                # A raw device error (OOM, XLA failure — what a genuinely
+                # flaky accelerator throws) keeps its existing propagate-
+                # to-worker/nack semantics, but must still feed the
+                # breaker and resolve an outstanding probe — otherwise a
+                # probe batch dying here wedges the breaker half-open.
+                self.breaker.record(False)
+                if probe:
+                    self.breaker.on_probe(False)
+                raise
+            # Validation passed ⇒ one clean check; every preemption
+            # kernel-vs-oracle comparison feeds the same window.
+            self.breaker.record(True)
+            agree = kstats.get("preempt_agree", 0)
+            disagree = kstats.get("preempt_checked", 0) - agree
+            if agree:
+                self.breaker.record(True, n=agree)
+            if disagree:
+                self.breaker.record(False, n=disagree)
+            if probe:
+                self.breaker.on_probe(disagree == 0)
+            stats.breaker_state = self.breaker.state
             stats.device_seconds = kstats["device_seconds"]
             stats.encode_seconds = kstats["encode_seconds"]
             stats.metrics_seconds = kstats["metrics_seconds"]
@@ -304,6 +428,18 @@ class TPUBatchScheduler:
         stats.total_seconds = time.monotonic() - t0
         stats.num_evals = len(evals)
         return stats
+
+    def _route_through_oracle(self, scheds) -> None:
+        """Degraded path: process each eval with the CPU GenericScheduler
+        against live state — identical semantics to the per-eval gate
+        fallback, used when the breaker is open or a kernel result was
+        rejected."""
+        for ev, _sched in scheds:
+            oracle = GenericScheduler(
+                self.logger, self.state, self.planner,
+                batch=(ev.type == s.JOB_TYPE_BATCH),
+                preemption_enabled=self.preemption_enabled)
+            oracle.process(ev)
 
     # -- gating + distinct_property context --------------------------------
 
@@ -732,6 +868,18 @@ class TPUBatchScheduler:
         """Shared device→host post-processing for the single-chip and
         mesh placement paths: lazy failure-forensics row fetch, COO →
         per-spec slots, AllocMetric assembly."""
+        # Chaos hook: corrupt the fetched kernel outputs (the damage a
+        # flaky accelerator / bad HBM would do), THEN validate — the
+        # validation below is exactly what protects production from the
+        # real version of this fault.
+        act = fault.faultpoint("ops.kernel_result")
+        if act is not None and act.kind == "corrupt":
+            unplaced_arr, coo_counts = _corrupt_outputs(
+                act.rng, spec_list, unplaced_arr, coo_counts)
+        problem = validate_device_outputs(
+            spec_list, ct, unplaced_arr, coo_rows, coo_cols, coo_counts)
+        if problem is not None:
+            raise KernelIntegrityError(problem)
         # Feasibility rows are fetched lazily, only for failed specs whose
         # feasible count is below their EVALUATED count (= ready nodes in
         # their DCs) — i.e. some constraint actually filtered a node.  The
@@ -1412,6 +1560,12 @@ class BatchStats:
         self.preempt_evicted = 0
         self.preempt_checked = 0
         self.preempt_agree = 0
+        # Degradation counters (ops/breaker.py): evals routed through the
+        # CPU oracle by the breaker/integrity check, kernel results
+        # rejected by validation, and the breaker state after this batch.
+        self.oracle_routed = 0
+        self.kernel_rejects = 0
+        self.breaker_state = "closed"
 
     def __repr__(self) -> str:
         extra = ""
@@ -1419,6 +1573,9 @@ class BatchStats:
             extra = (f" preempt={self.preempt_placed}p/"
                      f"{self.preempt_evicted}e "
                      f"agree={self.preempt_agree}/{self.preempt_checked}")
+        if self.oracle_routed or self.breaker_state != "closed":
+            extra += (f" breaker={self.breaker_state}"
+                      f" oracle_routed={self.oracle_routed}")
         return (f"BatchStats(evals={self.num_evals} specs={self.num_specs} "
                 f"asks={self.num_asks} phase1={self.phase1_seconds:.3f}s "
                 f"phase2={self.phase2_seconds:.3f}s "

@@ -1,0 +1,176 @@
+"""TPU-path circuit breaker: graceful degradation from kernel to oracle.
+
+The batch scheduler cross-checks device results against the CPU oracle
+wherever both exist (preemption eviction sets) and validates structural
+invariants of every kernel output (ops/batch_sched.py
+``validate_device_outputs``).  Those checks feed this breaker; when
+agreement over a sliding window drops below threshold, the breaker
+**trips open** and every eval routes through the CPU ``GenericScheduler``
+oracle — scheduling slows down but never stops or mis-places.  After a
+cooldown the breaker goes **half-open**: exactly one batch probes the
+kernel path; a clean probe closes the breaker, a dirty one re-opens it.
+
+The breaker is process-wide (module singleton): ``BatchWorker``
+constructs a fresh ``TPUBatchScheduler`` per batch, and a breaker that
+forgot its state between batches would never hold open.
+
+Env knobs (README "Fault model & degradation"):
+
+- ``NOMAD_TPU_BREAKER_THRESHOLD``  — min agreement ratio (default 0.9)
+- ``NOMAD_TPU_BREAKER_WINDOW``     — sliding window size in checks (64)
+- ``NOMAD_TPU_BREAKER_MIN_CHECKS`` — checks required before tripping (8)
+- ``NOMAD_TPU_BREAKER_COOLDOWN``   — seconds open before a probe (10)
+- ``NOMAD_TPU_BREAKER_DISABLE``    — 1 ⇒ never trip (kernel always runs)
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+logger = logging.getLogger("nomad_tpu.ops.breaker")
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half-open"
+
+
+class KernelIntegrityError(Exception):
+    """Kernel outputs failed structural validation (corrupt device
+    results): the batch must not be materialized into plans."""
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class KernelCircuitBreaker:
+    def __init__(self, threshold: Optional[float] = None,
+                 window: Optional[int] = None,
+                 min_checks: Optional[int] = None,
+                 cooldown: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.threshold = (threshold if threshold is not None else
+                          _env_float("NOMAD_TPU_BREAKER_THRESHOLD", 0.9))
+        self.window = int(window if window is not None else
+                          _env_float("NOMAD_TPU_BREAKER_WINDOW", 64))
+        self.min_checks = int(min_checks if min_checks is not None else
+                              _env_float("NOMAD_TPU_BREAKER_MIN_CHECKS", 8))
+        self.cooldown = (cooldown if cooldown is not None else
+                         _env_float("NOMAD_TPU_BREAKER_COOLDOWN", 10.0))
+        self.disabled = os.environ.get(
+            "NOMAD_TPU_BREAKER_DISABLE", "").strip().lower() in (
+            "1", "true", "yes")
+        self.clock = clock
+        self._l = threading.Lock()
+        self._state = CLOSED
+        self._checks: deque = deque(maxlen=max(1, self.window))
+        self._tripped_at = 0.0
+        self._probe_started = 0.0
+        self.trips = 0  # lifetime trip count (telemetry / tests)
+
+    # -- observations ------------------------------------------------------
+
+    def record(self, ok: bool, n: int = 1) -> None:
+        """Record ``n`` agreement checks with one outcome.  A kernel batch
+        contributes its structural-validation verdict plus one check per
+        preemption kernel/oracle comparison."""
+        if self.disabled or n <= 0:
+            return
+        with self._l:
+            self._checks.extend([bool(ok)] * min(n, self._checks.maxlen))
+            if self._state != CLOSED:
+                return
+            total = len(self._checks)
+            if total < self.min_checks:
+                return
+            ratio = sum(self._checks) / total
+            if ratio < self.threshold:
+                self._state = OPEN
+                self._tripped_at = self.clock()
+                self.trips += 1
+                logger.warning(
+                    "kernel circuit breaker OPEN: agreement %.2f < %.2f "
+                    "over %d checks; routing evals through the CPU oracle "
+                    "for %.1fs", ratio, self.threshold, total, self.cooldown)
+
+    # -- gating ------------------------------------------------------------
+
+    def allow_kernel(self) -> bool:
+        """May the next batch take the device path?  While open, False
+        until the cooldown elapses; then exactly one caller gets True as
+        the half-open probe and everyone else stays on the oracle until
+        ``on_probe`` resolves it."""
+        if self.disabled:
+            return True
+        with self._l:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN and (
+                    self.clock() - self._tripped_at >= self.cooldown):
+                self._state = HALF_OPEN
+                self._probe_started = self.clock()
+                logger.info("kernel circuit breaker HALF-OPEN: probing the "
+                            "device path with one batch")
+                return True
+            if self._state == HALF_OPEN and (
+                    self.clock() - self._probe_started >= self.cooldown):
+                # The outstanding probe never resolved (its batch died on
+                # an unrelated exception, or the thread was lost): grant a
+                # fresh probe rather than wedging on the oracle forever.
+                self._probe_started = self.clock()
+                logger.warning("kernel circuit breaker: probe expired "
+                               "unresolved; granting a new probe batch")
+                return True
+            return False
+
+    def on_probe(self, ok: bool) -> None:
+        """Resolve a half-open probe: clean ⇒ close (fresh window), dirty
+        ⇒ re-open and restart the cooldown."""
+        with self._l:
+            if self._state != HALF_OPEN:
+                return
+            if ok:
+                self._state = CLOSED
+                self._checks.clear()
+                logger.info("kernel circuit breaker CLOSED: probe batch "
+                            "agreed; device path restored")
+            else:
+                self._state = OPEN
+                self._tripped_at = self.clock()
+                logger.warning("kernel circuit breaker RE-OPEN: probe batch "
+                               "disagreed; staying on the CPU oracle")
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._l:
+            return self._state
+
+    def agreement(self) -> float:
+        with self._l:
+            return (sum(self._checks) / len(self._checks)
+                    if self._checks else 1.0)
+
+    def reset(self) -> None:
+        with self._l:
+            self._state = CLOSED
+            self._checks.clear()
+            self._tripped_at = 0.0
+
+
+# Process-wide breaker shared by every TPUBatchScheduler instance.
+BREAKER = KernelCircuitBreaker()
+
+
+def reset_for_tests() -> None:
+    """Fresh process-wide breaker (re-reads env knobs)."""
+    global BREAKER
+    BREAKER = KernelCircuitBreaker()

@@ -162,12 +162,25 @@ class SetStatusError(Exception):
         self.eval_status = eval_status
 
 
-def retry_max(max_attempts: int, cb, reset=None) -> None:
+def retry_max(max_attempts: int, cb, reset=None,
+              max_total: Optional[int] = None) -> None:
     """Retry cb until done, resetting the budget when progress is made
-    (util.go:262)."""
+    (util.go:262).
+
+    ``max_total`` caps TOTAL attempts regardless of progress resets: a
+    plan that keeps getting partially committed (e.g. staleness fences
+    rejecting a few nodes every round under churn) makes "progress" each
+    time and would otherwise resubmit forever — a plan-resubmission
+    storm against the single-threaded applier.  Defaults to
+    ``8 × max_attempts``; the eval fails (→ blocked, retried later)
+    rather than hammering the plan queue."""
+    if max_total is None:
+        max_total = max_attempts * 8
     attempts = 0
-    while attempts < max_attempts:
+    total = 0
+    while attempts < max_attempts and total < max_total:
         done = cb()
+        total += 1
         if done:
             return
         if reset is not None and reset():
@@ -175,7 +188,8 @@ def retry_max(max_attempts: int, cb, reset=None) -> None:
         else:
             attempts += 1
     raise SetStatusError(
-        f"maximum attempts reached ({max_attempts})", s.EVAL_STATUS_FAILED)
+        f"maximum attempts reached ({max_attempts}/{total} total)",
+        s.EVAL_STATUS_FAILED)
 
 
 def progress_made(result: Optional[s.PlanResult]) -> bool:

@@ -243,6 +243,12 @@ class EvalBroker:
 
     # -- outstanding / ack / nack -----------------------------------------
 
+    def delivery_attempts(self, eval_id: str) -> int:
+        """How many times this eval has been dequeued (the delivery-limit
+        counter); 0 for evals the broker isn't tracking."""
+        with self._l:
+            return self.evals.get(eval_id, 0)
+
     def outstanding(self, eval_id: str) -> Tuple[str, bool]:
         with self._l:
             unack = self.unack.get(eval_id)

@@ -10,7 +10,10 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Callable, Dict, Optional
+
+from .. import fault
 
 MIN_HEARTBEAT_TTL = 10.0
 MAX_HEARTBEATS_PER_SECOND = 50.0
@@ -45,6 +48,18 @@ class HeartbeatTimers:
 
     def reset_heartbeat_timer(self, node_id: str) -> float:
         """(heartbeat.go:40 resetHeartbeatTimer) — returns the TTL granted."""
+        act = fault.faultpoint("heartbeat.deliver", node_id=node_id)
+        if act is not None:
+            if act.kind == "drop":
+                # Heartbeat blackout: the node's liveness signal is lost
+                # before it reaches the timer — the running TTL keeps
+                # counting down toward expiry (node → down).
+                with self._l:
+                    return self.min_ttl
+            if act.kind == "delay":
+                time.sleep(act.delay)
+            elif act.kind in ("error", "crash"):
+                act.raise_injected()
         with self._l:
             if not self._enabled:
                 return self.min_ttl

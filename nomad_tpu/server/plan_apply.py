@@ -20,6 +20,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import fault
 from ..structs import structs as s
 from ..structs.funcs import allocs_fit, remove_allocs
 from .fsm import MessageType
@@ -302,6 +303,18 @@ class PlanApplier:
         """Commit the result through the log (plan_apply.go:123-175
         applyPlan)."""
         import time as _time
+
+        # Fault point BEFORE the raft commit: an injected crash here is a
+        # leader dying mid-plan-apply.  Nothing has been accepted yet, so
+        # the invariant under test is that the submitting worker nacks,
+        # the eval redelivers, and the replan commits everything — no
+        # accepted placement is ever lost, no placement double-applies.
+        act = fault.faultpoint("plan.apply", eval_id=plan.eval_id)
+        if act is not None:
+            if act.kind == "delay":
+                _time.sleep(act.delay)
+            elif act.kind in ("error", "crash", "step_down"):
+                act.raise_injected()
 
         allocs: List[s.Allocation] = []
         for update_list in result.node_update.values():

@@ -29,8 +29,30 @@ import struct
 import threading
 from typing import Callable, List, Optional, Tuple
 
+from .. import fault
 from .fsm import FSM, MessageType
 from .log_codec import decode_payload, encode_payload
+
+
+def _fire_apply_fault(index: int, msg_type) -> Optional[str]:
+    """``raft.apply`` fault point, shared by the single-voter and
+    multi-voter apply paths.  Returns "step_down" for the caller to
+    translate into its own leadership demotion; crash/error raise here;
+    delay sleeps here.  Ctx exposed to rules: the prospective log
+    ``index`` and the message type name (e.g. ``"APPLY_PLAN_RESULTS"``)."""
+    act = fault.faultpoint(
+        "raft.apply", index=index,
+        msg_type=getattr(msg_type, "name", str(msg_type)))
+    if act is None:
+        return None
+    if act.kind == "delay":
+        import time as _time
+        _time.sleep(act.delay)
+        return None
+    if act.kind == "step_down":
+        return "step_down"
+    act.raise_injected()
+    return None
 
 
 def _encode_entry(index, msg_type, payload):
@@ -95,6 +117,11 @@ class RaftLog:
         with self._l:
             if not self._leader:
                 raise NotLeaderError("not the leader")
+            # Fault point BEFORE append: an injected crash here models the
+            # leader dying before the entry commits — nothing persists,
+            # nothing applies, and the caller's retry path must cope.
+            if _fire_apply_fault(self._last_index + 1, msg_type) is not None:
+                raise NotLeaderError("injected step-down")
             self._last_index += 1
             index = self._last_index
             self._persist(index, msg_type, payload)
@@ -1174,6 +1201,12 @@ class MultiRaft(RaftLog):
         from .log_codec import encode_payload
         with self._l:
             if self.state != "leader":
+                raise NotLeaderError(self.leader_addr or "")
+            if _fire_apply_fault(self._last_log_index() + 1,
+                                 msg_type) is not None:
+                # Injected step-down: a real demotion — the cluster
+                # re-elects (possibly us) via the normal election timer.
+                self._step_down(self.term)
                 raise NotLeaderError(self.leader_addr or "")
             blob = encode_payload(payload)
             index = self._last_log_index() + 1

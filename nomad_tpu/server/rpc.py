@@ -20,9 +20,12 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import msgpack
+
+from .. import fault
 
 # Protocol bytes (rpc.go:23-30)
 RPC_NOMAD = 0x01
@@ -56,6 +59,32 @@ class NoLeaderError(RPCError):
 
 def _send_frame(sock: socket.socket, obj: Any) -> None:
     data = msgpack.packb(obj, use_bin_type=True)
+    act = fault.faultpoint("rpc.send")
+    if act is not None:
+        if act.kind == "drop":
+            return  # frame lost on the wire; the peer's read times out
+        if act.kind == "delay":
+            time.sleep(act.delay)
+        elif act.kind == "dup":
+            sock.sendall(_LEN.pack(len(data)) + data)
+        elif act.kind == "truncate":
+            # Ship the length prefix + a partial payload, then sever the
+            # connection: the peer reads EOF mid-frame (the torn-write
+            # shape _recv_exact must surface as TransportError).
+            cut = max(1, len(data) // 2)
+            sock.sendall(_LEN.pack(len(data)) + data[:cut])
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            sock.close()
+            raise ConnectionError(act.message)
+        elif act.kind in ("error", "crash"):
+            # Surface as the transport failure a real broken wire raises,
+            # so the fault exercises the SAME classify/discard/retry
+            # machinery production errors take (ConnPool wraps this into
+            # TransportError; RemoteServerRPC demotes and retries).
+            raise ConnectionError(act.message)
     sock.sendall(_LEN.pack(len(data)) + data)
 
 
@@ -64,7 +93,14 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     while len(buf) < n:
         chunk = sock.recv(n - len(buf))
         if not chunk:
-            raise ConnectionError("connection closed")
+            # EOF mid-frame is a transport failure, not a decode problem:
+            # surfacing it as TransportError (with how much arrived) keeps
+            # a truncated frame from propagating as a confusing
+            # struct/msgpack error further up.
+            if buf:
+                raise TransportError(
+                    f"connection closed mid-frame ({len(buf)}/{n} bytes)")
+            raise TransportError("connection closed")
         buf += chunk
     return buf
 
@@ -72,7 +108,9 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 def _recv_frame(sock: socket.socket) -> Any:
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
     if n > 64 << 20:
-        raise RPCError(f"frame too large: {n}")
+        # A ludicrous length prefix means the stream is desynchronized
+        # (or hostile): transport-level, the connection must be discarded.
+        raise TransportError(f"frame too large: {n}")
     return msgpack.unpackb(_recv_exact(sock, n), raw=False)
 
 
@@ -131,7 +169,7 @@ class RPCServer:
                 try:
                     try:
                         prefix = _recv_exact(sock, 1)[0]
-                    except (ConnectionError, OSError):
+                    except (TransportError, ConnectionError, OSError):
                         return
                     if prefix == RPC_NOMAD:
                         outer._serve_nomad(sock)
@@ -190,7 +228,7 @@ class RPCServer:
         while True:
             try:
                 seq, method, body = _recv_frame(sock)
-            except (ConnectionError, OSError, ValueError):
+            except (TransportError, ConnectionError, OSError, ValueError):
                 return
             fn = self.methods.get(method)
             if fn is None:
@@ -211,7 +249,7 @@ class RPCServer:
         while True:
             try:
                 seq, _method, body = _recv_frame(sock)
-            except (ConnectionError, OSError, ValueError):
+            except (TransportError, ConnectionError, OSError, ValueError):
                 return
             handler = self.raft_handler
             if handler is None:
@@ -300,7 +338,15 @@ class ConnPool:
                 raise DialError(f"rpc to {addr} failed: {e}") from e
         try:
             reply = conn.call(method, body, timeout)
+        except TransportError:
+            # Already classified (EOF mid-frame, oversized/desynced
+            # frame): the socket is poisoned — discard, never re-pool.
+            conn.close()
+            raise
         except (ConnectionError, OSError) as e:
+            # Includes socket.timeout: a reply may still be in flight, so
+            # releasing this connection would hand the NEXT caller a stale
+            # response (sequence mismatch at best).  Discard.
             conn.close()
             raise TransportError(f"rpc to {addr} failed: {e}") from e
         except RPCError:
@@ -337,28 +383,73 @@ class RemoteServerRPC:
     (node_register / node_update_status / node_get_client_allocs /
     node_update_allocs), carried over the wire to a server — what the
     reference client does via msgpack-RPC (client/rpc via
-    client.go:465 Client.RPC).  Retries across the server list.
+    client.go:465 Client.RPC).
+
+    Retries across the server list with bounded rounds and jittered
+    exponential backoff between them (a fleet of clients whose leader
+    died must not re-dial in lockstep).  A ``NoLeaderError`` reply
+    carries the responding follower's best-known leader address — that
+    server is promoted to the front of the list so the next attempt goes
+    straight at the leader instead of re-walking stale entries.
     """
 
-    def __init__(self, servers: List[str], pool: Optional[ConnPool] = None):
+    MAX_ROUNDS = 3
+
+    def __init__(self, servers: List[str], pool: Optional[ConnPool] = None,
+                 max_rounds: Optional[int] = None, sleep=time.sleep):
         from ..api.codec import from_wire, to_wire
+        from ..utils.backoff import Backoff
         self._to_wire = to_wire
         self._from_wire = from_wire
         self.servers = list(servers)
         self.pool = pool or ConnPool()
+        self.max_rounds = max_rounds or self.MAX_ROUNDS
+        self._sleep = sleep
+        self._backoff_factory = lambda: Backoff(base=0.05, max_delay=2.0)
+
+    @staticmethod
+    def _looks_like_addr(hint: str) -> bool:
+        """A NoLeaderError message is only a usable leader hint when it is
+        an actual host:port — during elections servers reply with prose
+        ('no cluster leader', 'not the leader'), and promoting that into
+        the server list would poison every later dial."""
+        host, sep, port = hint.rpartition(":")
+        return bool(sep) and bool(host) and port.isdigit()
+
+    def _promote(self, addr: str) -> None:
+        if addr in self.servers:
+            self.servers.remove(addr)
+        self.servers.insert(0, addr)
+
+    def _demote(self, addr: str) -> None:
+        if addr in self.servers:
+            self.servers.remove(addr)
+            self.servers.append(addr)
 
     def _call(self, method: str, body: Any) -> Any:
         last: Optional[Exception] = None
-        for addr in list(self.servers):
-            try:
-                return self.pool.call(addr, method, body)
-            except (RPCError, OSError) as e:
-                last = e
-                # demote failed server
-                if addr in self.servers:
-                    self.servers.remove(addr)
-                    self.servers.append(addr)
-        raise RPCError(f"no servers reachable: {last}")
+        bo = self._backoff_factory()
+        for round_no in range(self.max_rounds):
+            if round_no:
+                self._sleep(bo.next_delay())
+            for addr in list(self.servers):
+                try:
+                    return self.pool.call(addr, method, body)
+                except NoLeaderError as e:
+                    # The server answered but isn't leader: re-resolve.
+                    # Its reply names the leader when it knows one — aim
+                    # the next attempt there rather than round-robining.
+                    last = e
+                    leader = str(e).strip()
+                    if (leader != addr and self._looks_like_addr(leader)):
+                        self._promote(leader)
+                        break  # restart the scan at the leader
+                    self._demote(addr)
+                except (RPCError, OSError) as e:
+                    last = e
+                    self._demote(addr)
+        raise RPCError(
+            f"no servers reachable after {self.max_rounds} rounds: {last}")
 
     def node_register(self, node):
         reply = self._call("Node.Register", {"Node": self._to_wire(node)})

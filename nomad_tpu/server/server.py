@@ -260,15 +260,22 @@ class Server:
     def join(self, addresses: List[str]) -> int:
         """Operator-initiated join (agent_endpoint.go Join → serf.Join):
         dial each address's Serf.Join, merge the replies; returns how many
-        answered."""
+        answered.  Each dial gets two backed-off retries — Serf.Join is an
+        idempotent membership merge, and `nomad server-join` should
+        survive one transient dial failure."""
+        from ..utils.backoff import Backoff, retry
+
         if self.pool is None:
             raise ValueError("RPC is not enabled")
         me = self._self_member()
         joined = 0
         for addr in addresses:
             try:
-                reply = self.pool.call(addr, "Serf.Join", {"Member": me},
-                                       timeout=2.0)
+                reply = retry(
+                    lambda a=addr: self.pool.call(a, "Serf.Join",
+                                                  {"Member": me},
+                                                  timeout=2.0),
+                    retries=2, backoff=Backoff(base=0.1, max_delay=0.5))
                 self._merge_members(reply.get("Members") or [])
                 joined += 1
             except Exception as e:

@@ -18,6 +18,7 @@ from typing import List, Optional, Tuple
 
 from ..scheduler import new_scheduler
 from ..structs import structs as s
+from ..utils.backoff import Backoff, wait_until
 from ..utils.telemetry import NULL_TELEMETRY
 from .eval_broker import EvalBroker, EvalBrokerError
 from .fsm import MessageType
@@ -122,6 +123,10 @@ class Worker:
         self._paused = False
         self._pause_cond = threading.Condition()
         self._thread: Optional[threading.Thread] = None
+        # Jittered idle backoff for a disabled broker (follower workers):
+        # a fixed 50ms nap synchronized every worker's retry into one
+        # thundering dequeue per tick.
+        self._idle_backoff = Backoff(base=0.02, max_delay=0.5)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -162,8 +167,9 @@ class Worker:
         try:
             ev, token = self.broker.dequeue(self.schedulers, DEQUEUE_TIMEOUT)
         except EvalBrokerError:
-            time.sleep(0.05)
+            time.sleep(self._idle_backoff.next_delay())
             return None
+        self._idle_backoff.reset()
         if ev is None:
             return None
         return ev, token
@@ -176,21 +182,49 @@ class Worker:
             with self.metrics.measure(f"worker.invoke_scheduler.{ev.type}"):
                 self.invoke_scheduler(ev, token)
             self.broker.ack(ev.id, token)
-        except Exception:
+        except Exception as exc:
             self.logger.exception("eval %s failed; nacking", ev.id)
+            self.record_eval_failure(ev, exc)
             try:
                 self.broker.nack(ev.id, token)
             except EvalBrokerError:
                 pass
 
+    def record_eval_failure(self, ev: s.Evaluation, exc: Exception) -> None:
+        self.record_eval_failures([ev], exc)
+
+    def record_eval_failures(self, evs: List[s.Evaluation],
+                             exc: Exception) -> None:
+        """Persist WHY these delivery attempts burned onto the evals, so
+        ``eval-status`` shows it — without this, the worker-side traceback
+        is the only artifact of a nacked attempt.  One raft apply for the
+        whole batch (the FSM handler takes a list), and recorded BEFORE
+        the nacks: while an eval is outstanding the broker's enqueue
+        dedup ignores the status write's enqueue hook, so the update
+        can't double-queue it."""
+        failed = []
+        for ev in evs:
+            attempt = self.broker.delivery_attempts(ev.id)
+            f = ev.copy()
+            f.status_description = (
+                f"scheduler error on delivery attempt {attempt}: "
+                f"{type(exc).__name__}: {exc}")
+            failed.append(f)
+        try:
+            self.raft.apply(MessageType.EVAL_UPDATE, {"evals": failed})
+        except Exception:
+            # Recording forensics must never mask the nack itself (e.g.
+            # leadership was lost — the next leader redelivers anyway).
+            self.logger.debug("could not record failure reason for %d "
+                              "evals", len(failed), exc_info=True)
+
     def wait_for_index(self, index: int, timeout: float) -> bool:
-        """Spin-wait for log catch-up (worker.go:229)."""
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            if self.raft.applied_index() >= index:
-                return True
-            time.sleep(0.005)
-        return self.raft.applied_index() >= index
+        """Wait for log catch-up (worker.go:229).  Backed-off polling:
+        sub-millisecond first checks for the common just-behind case,
+        ramping to a coarse interval so a genuinely stalled log doesn't
+        pin a core."""
+        return wait_until(lambda: self.raft.applied_index() >= index,
+                          timeout, initial=0.0005, max_interval=0.005)
 
     def sched_name(self, ev: s.Evaluation) -> str:
         """Scheduler-registry name for an eval (overridable: the batch
@@ -249,8 +283,9 @@ class BatchWorker(Worker):
                     [s.JOB_TYPE_SERVICE, s.JOB_TYPE_BATCH],
                     self.max_batch, DEQUEUE_TIMEOUT)
             except EvalBrokerError:
-                time.sleep(0.05)
+                time.sleep(self._idle_backoff.next_delay())
                 continue
+            self._idle_backoff.reset()
             if batch:
                 with self.metrics.measure("worker.invoke_scheduler.batch"):
                     self.process_batch(batch)
@@ -308,8 +343,9 @@ class BatchWorker(Worker):
                     self.broker.ack(ev.id, token)
                 except EvalBrokerError:
                     pass
-        except Exception:
+        except Exception as exc:
             self.logger.exception("batch scheduling failed; nacking batch")
+            self.record_eval_failures([ev for ev, _ in batch], exc)
             for ev, token in batch:
                 try:
                     self.broker.nack(ev.id, token)

@@ -1,0 +1,208 @@
+"""utils/backoff.py + the retry loops that consume it
+(RemoteServerRPC leader re-resolution, scheduler retry_max storm cap).
+"""
+import random
+
+import pytest
+
+from nomad_tpu.server.rpc import NoLeaderError, RemoteServerRPC, RPCError
+from nomad_tpu.structs import structs as s
+from nomad_tpu.utils.backoff import Backoff, retry, wait_until
+
+
+class TestBackoff:
+    def test_exponential_schedule_without_jitter(self):
+        b = Backoff(base=0.1, factor=2.0, max_delay=1.0, jitter=0.0)
+        assert [round(b.next_delay(), 6) for _ in range(6)] == [
+            0.1, 0.2, 0.4, 0.8, 1.0, 1.0]
+        b.reset()
+        assert b.next_delay() == pytest.approx(0.1)
+
+    def test_jitter_bounded_and_seeded(self):
+        b1 = Backoff(base=0.1, max_delay=2.0, rng=random.Random(7))
+        b2 = Backoff(base=0.1, max_delay=2.0, rng=random.Random(7))
+        d1 = [b1.next_delay() for _ in range(8)]
+        d2 = [b2.next_delay() for _ in range(8)]
+        assert d1 == d2  # seeded ⇒ reproducible
+        for i, d in enumerate(d1):
+            assert 0.0 <= d <= min(2.0, 0.1 * 2 ** i) + 1e-9
+        # full jitter actually jitters
+        assert len({round(d, 9) for d in d1}) > 1
+
+    def test_base_must_be_positive(self):
+        with pytest.raises(ValueError):
+            Backoff(base=0.0)
+
+
+class TestRetry:
+    def test_succeeds_after_transient_failures(self):
+        calls = []
+        sleeps = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise IOError("transient")
+            return "ok"
+
+        assert retry(flaky, retries=5, retry_on=(IOError,),
+                     sleep=sleeps.append,
+                     backoff=Backoff(base=0.01, jitter=0.0)) == "ok"
+        assert len(calls) == 3
+        assert sleeps == [0.01, 0.02]
+
+    def test_budget_exhausted_reraises(self):
+        observed = []
+
+        def always_fails():
+            raise IOError("down")
+
+        with pytest.raises(IOError):
+            retry(always_fails, retries=2, retry_on=(IOError,),
+                  sleep=lambda d: None,
+                  on_retry=lambda e, n: observed.append(n))
+        assert observed == [0, 1]
+
+    def test_unlisted_exception_escapes_immediately(self):
+        def typo():
+            raise KeyError("not retryable")
+
+        with pytest.raises(KeyError):
+            retry(typo, retries=5, retry_on=(IOError,),
+                  sleep=lambda d: None)
+
+
+class TestWaitUntil:
+    def test_true_immediately_no_sleep(self):
+        sleeps = []
+        assert wait_until(lambda: True, 1.0, sleep=sleeps.append)
+        assert sleeps == []
+
+    def test_ramps_interval_until_true(self):
+        state = {"n": 0}
+        sleeps = []
+
+        def pred():
+            state["n"] += 1
+            return state["n"] > 4
+
+        clock = {"t": 0.0}
+
+        def fake_sleep(d):
+            sleeps.append(d)
+            clock["t"] += d
+
+        assert wait_until(pred, 10.0, initial=0.001, max_interval=0.01,
+                          factor=2.0, sleep=fake_sleep,
+                          clock=lambda: clock["t"])
+        assert sleeps == [0.001, 0.002, 0.004, 0.008]
+
+    def test_timeout_returns_false(self):
+        clock = {"t": 0.0}
+
+        def fake_sleep(d):
+            clock["t"] += d
+
+        assert not wait_until(lambda: False, 0.05, sleep=fake_sleep,
+                              clock=lambda: clock["t"])
+
+
+class _FakePool:
+    """Scripted ConnPool: addr → list of outcomes (exception or value),
+    consumed per call."""
+
+    def __init__(self, script):
+        self.script = {k: list(v) for k, v in script.items()}
+        self.calls = []
+
+    def call(self, addr, method, body, **kw):
+        self.calls.append(addr)
+        outcomes = self.script.get(addr)
+        if not outcomes:
+            raise OSError(f"connection refused: {addr}")
+        out = outcomes.pop(0)
+        if isinstance(out, Exception):
+            raise out
+        return out
+
+
+class TestRemoteRPCRetries:
+    def test_no_leader_reply_promotes_hinted_leader(self):
+        """A follower's NoLeaderError names the leader; the next attempt
+        must go straight there instead of re-walking the stale list."""
+        pool = _FakePool({
+            "10.0.0.1:4647": [NoLeaderError("10.0.0.3:4647")],
+            "10.0.0.3:4647": [{"Index": 7, "HeartbeatTTL": 10.0}],
+        })
+        rpc = RemoteServerRPC(["10.0.0.1:4647", "10.0.0.2:4647"],
+                              pool=pool, sleep=lambda d: None)
+        index, ttl = rpc.node_update_status("n1", "ready")
+        assert (index, ttl) == (7, 10.0)
+        assert pool.calls == ["10.0.0.1:4647", "10.0.0.3:4647"]
+        assert rpc.servers[0] == "10.0.0.3:4647"  # leader stays preferred
+
+    def test_bounded_rounds_with_backoff_then_raise(self):
+        pool = _FakePool({})  # everything refuses
+        sleeps = []
+        rpc = RemoteServerRPC(["a:1", "b:2"], pool=pool, max_rounds=3,
+                              sleep=sleeps.append)
+        with pytest.raises(RPCError, match="no servers reachable"):
+            rpc._call("Node.Register", {})
+        assert len(pool.calls) == 6          # 2 servers × 3 rounds
+        assert len(sleeps) == 2              # backoff between rounds
+        assert all(d > 0 for d in sleeps)
+
+    def test_prose_no_leader_reply_never_pollutes_server_list(self):
+        """During elections servers reply NoLeaderError('no cluster
+        leader') / 'not the leader' / '' — prose, not an address.  It
+        must be treated as a plain failure (demote + retry), never
+        inserted into the server list as a dial target."""
+        pool = _FakePool({
+            "a:1": [NoLeaderError("no cluster leader"),
+                    {"Index": 2, "HeartbeatTTL": 5.0}],
+            "b:2": [NoLeaderError("")],
+        })
+        rpc = RemoteServerRPC(["a:1", "b:2"], pool=pool,
+                              sleep=lambda d: None)
+        index, _ = rpc.node_update_status("n1", "ready")
+        assert index == 2
+        assert sorted(rpc.servers) == ["a:1", "b:2"]  # nothing bogus
+
+    def test_failed_server_demoted(self):
+        pool = _FakePool({
+            "a:1": [OSError("refused"), {"Index": 1, "HeartbeatTTL": 5.0}],
+            "b:2": [{"Index": 1, "HeartbeatTTL": 5.0}],
+        })
+        rpc = RemoteServerRPC(["a:1", "b:2"], pool=pool,
+                              sleep=lambda d: None)
+        rpc.node_update_status("n1", "ready")
+        assert pool.calls == ["a:1", "b:2"]
+        assert rpc.servers == ["b:2", "a:1"]  # a demoted behind b
+
+
+class TestRetryMaxStormCap:
+    def test_progress_resets_but_total_is_capped(self):
+        """A plan that makes token progress every attempt (staleness
+        rejections under churn) must not resubmit forever."""
+        from nomad_tpu.scheduler.util import SetStatusError, retry_max
+
+        calls = []
+        with pytest.raises(SetStatusError, match="maximum attempts"):
+            # progress "made" every time ⇒ attempts always reset; only
+            # the total cap (3 × 8 = 24) stops the storm
+            retry_max(3, lambda: (calls.append(1), False)[1],
+                      reset=lambda: True)
+        assert len(calls) == 24
+
+        calls.clear()
+        with pytest.raises(SetStatusError):
+            retry_max(3, lambda: (calls.append(1), False)[1],
+                      reset=lambda: True, max_total=5)
+        assert len(calls) == 5
+
+    def test_done_short_circuits(self):
+        from nomad_tpu.scheduler.util import retry_max
+
+        calls = []
+        retry_max(3, lambda: (calls.append(1), True)[1])
+        assert len(calls) == 1

@@ -1,0 +1,694 @@
+"""Seeded end-to-end chaos scenarios (nomad_tpu/fault.py).
+
+Every scenario is reproducible from one RNG seed: the fault plane's
+per-rule RNGs and hit counters make the fire trace a pure function of
+(seed, call order), and each test pins the seed.  Fast fixed-seed
+scenarios run in tier-1; the probabilistic RPC sweep is marked slow.
+"""
+import socket
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import fault, mock
+from nomad_tpu.server import EvalBroker, Server, ServerConfig
+from nomad_tpu.server.rpc import (
+    ConnPool,
+    RPCServer,
+    TransportError,
+    _recv_frame,
+    _send_frame,
+)
+from nomad_tpu.structs import structs as s
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    """No scenario may leak into another test (or into tier-1 at large)."""
+    yield
+    fault.disarm()
+
+
+def wait_until(predicate, timeout=60.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def make_node():
+    n = mock.node()
+    n.resources.networks = []
+    n.reserved.networks = []
+    return n
+
+
+def make_job(count=2):
+    j = mock.job()
+    j.task_groups[0].count = count
+    for t in j.task_groups[0].tasks:
+        t.resources.networks = []
+    return j
+
+
+# ---------------------------------------------------------------------------
+# the fault plane itself
+# ---------------------------------------------------------------------------
+
+
+class TestFaultPlane:
+    def test_disarmed_is_inert(self):
+        assert not fault.armed()
+        assert fault.faultpoint("rpc.send") is None
+        assert fault.trace() == []
+
+    def test_same_seed_same_trace(self):
+        """Probabilistic rules replay identically for one seed and
+        diverge for another — the reproducibility contract chaos debugging
+        rests on."""
+        cfg = {"faults": [{"point": "p.q", "action": "drop", "prob": 0.5}]}
+
+        def run(seed):
+            with fault.scenario(cfg, seed=seed) as plane:
+                for _ in range(64):
+                    fault.faultpoint("p.q")
+                return plane.trace()
+
+        t_a, t_b, t_c = run(11), run(11), run(12)
+        assert t_a == t_b
+        assert 0 < len(t_a) < 64  # prob actually probabilistic
+        assert t_a != t_c
+
+    def test_after_times_and_match_gates(self):
+        fault.arm({"seed": 0, "faults": [
+            {"point": "a.b", "action": "delay", "after": 2, "times": 2,
+             "match": {"index": 7}}]})
+        fired = []
+        for i in range(8):
+            # non-matching ctx never fires and never consumes the budget
+            assert fault.faultpoint("a.b", index=3) is None
+            act = fault.faultpoint("a.b", index=7)
+            fired.append(act is not None)
+        # calls 1-2 skipped by `after`, 3-4 fire, budget exhausted after
+        assert fired == [False, False, True, True, False, False, False,
+                         False]
+
+    def test_glob_points_and_error_action(self):
+        fault.arm([{"point": "rpc.*", "action": "error",
+                    "error": "boom injected"}])
+        act = fault.faultpoint("rpc.send")
+        with pytest.raises(fault.InjectedFault, match="boom injected"):
+            act.raise_injected()
+
+
+# ---------------------------------------------------------------------------
+# transport: truncation mid-read, poisoned-connection discard
+# ---------------------------------------------------------------------------
+
+
+class TestTransportFaults:
+    def test_recv_mid_frame_eof_is_transport_error(self):
+        """A torn frame must surface as TransportError, not a confusing
+        struct/msgpack decode error."""
+        a, b = socket.socketpair()
+        try:
+            # length prefix promising 100 bytes, then only 3, then EOF
+            a.sendall((100).to_bytes(4, "little") + b"abc")
+            a.close()
+            with pytest.raises(TransportError, match="mid-frame"):
+                _recv_frame(b)
+        finally:
+            b.close()
+
+    def test_oversized_frame_is_transport_error(self):
+        a, b = socket.socketpair()
+        try:
+            a.sendall((1 << 30).to_bytes(4, "little"))
+            with pytest.raises(TransportError, match="frame too large"):
+                _recv_frame(b)
+        finally:
+            a.close()
+            b.close()
+
+    def _echo_server(self):
+        srv = RPCServer()
+        srv.register("Echo", lambda body: body)
+        srv.start()
+        return srv
+
+    def test_pool_discards_poisoned_conn_after_truncation(self):
+        srv = self._echo_server()
+        pool = ConnPool(timeout=5.0)
+        try:
+            assert pool.call(srv.address, "Echo", {"x": 1}) == {"x": 1}
+            # One frame send gets truncated: the connection is severed
+            # mid-frame.  Whichever side it hits (request or reply), the
+            # caller must see TransportError and the pool must NOT
+            # re-pool the socket.
+            with fault.scenario({"seed": 5, "faults": [
+                    {"point": "rpc.send", "action": "truncate",
+                     "times": 1}]}):
+                with pytest.raises(TransportError):
+                    pool.call(srv.address, "Echo", {"x": 2})
+            assert all(not bucket for bucket in pool._idle.values()), \
+                "poisoned connection re-entered the pool"
+            # fresh dial works immediately after the scenario
+            assert pool.call(srv.address, "Echo", {"x": 3}) == {"x": 3}
+        finally:
+            pool.close()
+            srv.shutdown()
+
+    def test_pool_discards_conn_after_reply_truncation(self):
+        """`after: 1` skips the client's request send so the SERVER's
+        reply frame is the one truncated — the client reads EOF mid-frame
+        (the `_recv_exact` satellite fix) and the socket is discarded."""
+        srv = self._echo_server()
+        pool = ConnPool(timeout=5.0)
+        try:
+            with fault.scenario({"seed": 5, "faults": [
+                    {"point": "rpc.send", "action": "truncate",
+                     "after": 1, "times": 1}]}):
+                with pytest.raises(TransportError):
+                    pool.call(srv.address, "Echo", {"x": 2})
+            assert all(not bucket for bucket in pool._idle.values())
+            assert pool.call(srv.address, "Echo", {"x": 3}) == {"x": 3}
+        finally:
+            pool.close()
+            srv.shutdown()
+
+    def test_delay_is_benign(self):
+        srv = self._echo_server()
+        pool = ConnPool(timeout=5.0)
+        try:
+            with fault.scenario({"seed": 9, "faults": [
+                    {"point": "rpc.send", "action": "delay", "delay": 0.01,
+                     "times": 4}]}):
+                for i in range(6):
+                    assert pool.call(srv.address, "Echo",
+                                     {"i": i}) == {"i": i}
+        finally:
+            pool.close()
+            srv.shutdown()
+
+    def test_dup_is_detected_never_misdelivered(self):
+        """A duplicated frame desynchronizes the sequential stream; the
+        seq fence must DETECT it (TransportError + connection discard) —
+        what must never happen is a stale reply delivered as if it were
+        the answer to a later request."""
+        srv = self._echo_server()
+        pool = ConnPool(timeout=5.0)
+        try:
+            desyncs = 0
+            with fault.scenario({"seed": 9, "faults": [
+                    {"point": "rpc.send", "action": "dup", "times": 1}]}):
+                for i in range(4):
+                    try:
+                        assert pool.call(srv.address, "Echo",
+                                         {"i": i}) == {"i": i}
+                    except TransportError:
+                        desyncs += 1
+            assert desyncs <= 1
+            for i in range(5):
+                assert pool.call(srv.address, "Echo", {"i": i}) == {"i": i}
+        finally:
+            pool.close()
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# leader crash during plan apply
+# ---------------------------------------------------------------------------
+
+
+class TestPlanApplyCrash:
+    def test_crash_then_redelivery_loses_no_placements(self):
+        """An injected leader crash mid-plan-apply (before the raft
+        commit) nacks the eval; the broker redelivers and the replan
+        places everything exactly once."""
+        srv = Server(ServerConfig(num_schedulers=1))
+        # fast redelivery: first nack re-enqueues after initial_nack_delay
+        srv.eval_broker.initial_nack_delay = 0.1
+        srv.start()
+        try:
+            for _ in range(3):
+                srv.node_register(make_node())
+            fault.arm({"seed": 21, "faults": [
+                {"point": "plan.apply", "action": "crash", "times": 1}]})
+            job = make_job(3)
+            _, eval_id = srv.job_register(job)
+
+            # the crash fired exactly once, then the redelivered eval
+            # completed with every placement intact
+            assert wait_until(
+                lambda: srv.state.eval_by_id(None, eval_id).status
+                == s.EVAL_STATUS_COMPLETE, timeout=30.0)
+            assert fault.trace() == [("plan.apply", 0, "crash")]
+            allocs = [a for a in srv.state.allocs_by_job(None, job.id, True)
+                      if not a.terminal_status()]
+            assert len(allocs) == 3
+            assert len({a.id for a in allocs}) == 3
+            assert len({a.name for a in allocs}) == 3  # no double-place
+        finally:
+            srv.shutdown()
+
+    def test_failure_reason_recorded_on_eval(self):
+        """A burned delivery attempt leaves WHY on the eval
+        (worker.record_eval_failure) — visible to `eval-status` instead
+        of only a server-side traceback."""
+        srv = Server(ServerConfig(num_schedulers=1))
+        srv.eval_broker.initial_nack_delay = 0.1
+        srv.start()
+        try:
+            srv.node_register(make_node())
+            fault.arm({"seed": 3, "faults": [
+                {"point": "plan.apply", "action": "error",
+                 "error": "injected applier fault", "times": 1}]})
+            job = make_job(1)
+            _, eval_id = srv.job_register(job)
+            assert wait_until(
+                lambda: "injected applier fault" in (
+                    srv.state.eval_by_id(None, eval_id).status_description
+                    or ""), timeout=30.0)
+            desc = srv.state.eval_by_id(None, eval_id).status_description
+            assert "scheduler error on delivery attempt 1" in desc
+            # the retry then completes and clears the forensics
+            assert wait_until(
+                lambda: srv.state.eval_by_id(None, eval_id).status
+                == s.EVAL_STATUS_COMPLETE, timeout=30.0)
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# raft: crash at a chosen log index
+# ---------------------------------------------------------------------------
+
+
+class TestRaftApplyFaults:
+    def test_crash_at_chosen_index(self):
+        """A rule matched on the prospective log index crashes exactly
+        that apply; the entry is never persisted and the index is reused
+        by the next successful apply."""
+        srv = Server(ServerConfig(num_schedulers=0))
+        srv.start()
+        try:
+            fault.arm({"seed": 1, "faults": [
+                {"point": "raft.apply", "action": "crash",
+                 "match": {"index": 2}}]})
+            srv.node_register(make_node())            # index 1: fine
+            victim = make_node()
+            with pytest.raises(fault.InjectedFault):
+                srv.node_register(victim)             # index 2: crashes
+            assert srv.state.node_by_id(None, victim.id) is None
+            assert srv.raft.applied_index() == 1
+            assert fault.trace() == [("raft.apply", 0, "crash")]
+            fault.disarm()
+            n3 = make_node()
+            srv.node_register(n3)                     # index 2 again, ok
+            assert srv.state.node_by_id(None, n3.id) is not None
+            assert srv.raft.applied_index() == 2
+        finally:
+            srv.shutdown()
+
+    def test_step_down_surfaces_as_not_leader(self):
+        from nomad_tpu.server.raft import NotLeaderError
+
+        srv = Server(ServerConfig(num_schedulers=0))
+        srv.start()
+        try:
+            fault.arm({"seed": 2, "faults": [
+                {"point": "raft.apply", "action": "step_down", "times": 1,
+                 "match": {"msg_type": "NODE_REGISTER"}}]})
+            with pytest.raises(NotLeaderError):
+                srv.node_register(make_node())
+            fault.disarm()
+            n2 = make_node()
+            srv.node_register(n2)  # transient: the next apply succeeds
+            assert srv.state.node_by_id(None, n2.id) is not None
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# heartbeat blackout → node down → allocs lost → rescheduled
+# ---------------------------------------------------------------------------
+
+
+class TestHeartbeatBlackout:
+    def test_blackout_marks_down_loses_allocs_reschedules(self):
+        srv = Server(ServerConfig(num_schedulers=1, min_heartbeat_ttl=0.3,
+                                  max_heartbeats_per_second=1000.0))
+        srv.heartbeat.grace = 0.2
+        srv.start()
+        stop = threading.Event()
+        try:
+            nodes = [make_node() for _ in range(2)]
+            for n in nodes:
+                srv.node_register(n)
+                srv.node_update_status(n.id, s.NODE_STATUS_READY)
+
+            def heartbeater():
+                """Plays the node agents' heartbeat loop, routed through
+                the client-side rpc.send fault point: a dropped frame
+                never reaches the server (the real blackout shape) rather
+                than arriving and resetting state."""
+                while not stop.is_set():
+                    for n in nodes:
+                        act = fault.faultpoint(
+                            "rpc.send", method="Node.UpdateStatus",
+                            node_id=n.id, side="client")
+                        if act is not None and act.kind == "drop":
+                            continue  # frame lost on the wire
+                        try:
+                            srv.node_update_status(n.id, s.NODE_STATUS_READY)
+                        except Exception:
+                            pass
+                    stop.wait(0.1)
+
+            t = threading.Thread(target=heartbeater, daemon=True)
+            t.start()
+
+            job = make_job(1)
+            srv.job_register(job)
+            assert wait_until(lambda: [
+                a for a in srv.state.allocs_by_job(None, job.id, True)
+                if not a.terminal_status()], timeout=30.0)
+            victim_alloc = [
+                a for a in srv.state.allocs_by_job(None, job.id, True)
+                if not a.terminal_status()][0]
+            victim = victim_alloc.node_id
+            other = next(n.id for n in nodes if n.id != victim)
+
+            # blackout: the victim's heartbeats keep being SENT but every
+            # frame is dropped on the wire — the TTL runs out server-side
+            fault.arm({"seed": 13, "faults": [
+                {"point": "rpc.send", "action": "drop",
+                 "match": {"node_id": victim}}]})
+
+            assert wait_until(
+                lambda: srv.state.node_by_id(None, victim).status
+                == s.NODE_STATUS_DOWN, timeout=10.0)
+
+            def recovered():
+                allocs = srv.state.allocs_by_job(None, job.id, True)
+                lost = [a for a in allocs
+                        if a.client_status == s.ALLOC_CLIENT_STATUS_LOST]
+                live = [a for a in allocs if not a.terminal_status()
+                        and a.client_status != s.ALLOC_CLIENT_STATUS_LOST]
+                return (len(lost) == 1 and len(live) == 1
+                        and live[0].node_id == other)
+
+            assert wait_until(recovered, timeout=30.0)
+        finally:
+            stop.set()
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# nack redelivery after a worker dies mid-eval
+# ---------------------------------------------------------------------------
+
+
+class TestNackRedelivery:
+    def test_dead_worker_eval_redelivers_after_nack_timeout(self):
+        broker = EvalBroker(nack_timeout=0.25, initial_nack_delay=0.0,
+                            delivery_limit=3)
+        broker.set_enabled(True)
+        ev = mock.eval()
+        broker.enqueue(ev)
+        got, token = broker.dequeue([ev.type], 1.0)
+        assert got.id == ev.id
+        assert broker.delivery_attempts(ev.id) == 1
+        # the worker holding `token` dies here: no ack, no nack —
+        # the nack timer must fire and redeliver
+        got2, token2 = broker.dequeue([ev.type], 5.0)
+        assert got2 is not None and got2.id == ev.id
+        assert token2 != token
+        assert broker.delivery_attempts(ev.id) == 2
+        broker.ack(ev.id, token2)
+        assert broker.stats()["total_ready"] == 0
+        assert broker.stats()["total_unacked"] == 0
+
+
+# ---------------------------------------------------------------------------
+# kernel corruption → breaker trips → oracle carries → probe recovers
+# ---------------------------------------------------------------------------
+
+
+class TestKernelOutputValidation:
+    """Unit coverage for the structural validator that feeds the
+    breaker (ops/batch_sched.validate_device_outputs)."""
+
+    class _SP:
+        def __init__(self, count):
+            self.count = count
+
+    class _CT:
+        n_real = 4
+
+    def _run(self, counts, up, rows, cols, cnt):
+        import numpy as np
+
+        from nomad_tpu.ops.batch_sched import validate_device_outputs
+        return validate_device_outputs(
+            [self._SP(c) for c in counts], self._CT,
+            np.asarray(up), np.asarray(rows), np.asarray(cols),
+            np.asarray(cnt))
+
+    def test_healthy_output_passes(self):
+        assert self._run([2, 1], [0, 0], [0, 0, 1], [1, 2, 3],
+                         [1, 1, 1]) is None
+
+    def test_negative_unplaced_rejected(self):
+        assert "negative unplaced" in self._run([2], [-3], [], [], [])
+
+    def test_unplaced_exceeding_asks_rejected(self):
+        assert "exceeds ask count" in self._run([2], [7], [], [], [])
+
+    def test_negative_node_index_rejected(self):
+        assert "negative node index" in self._run(
+            [2], [0], [0, 0], [1, -2], [1, 1])
+
+    def test_placed_unplaced_mismatch_rejected(self):
+        assert "!=" in self._run([2], [0], [0], [1], [5])
+
+
+class TestKernelCorruptionBreaker:
+    def _run_scenario(self, seed):
+        from nomad_tpu.ops.batch_sched import TPUBatchScheduler
+        from nomad_tpu.ops.breaker import KernelCircuitBreaker
+        from nomad_tpu.scheduler import Harness
+
+        clock = [0.0]
+        brk = KernelCircuitBreaker(threshold=0.9, window=8, min_checks=1,
+                                   cooldown=5.0, clock=lambda: clock[0])
+        h = Harness()
+        for _ in range(6):
+            node = make_node()
+            node.compute_class()
+            h.state.upsert_node(h.next_index(), node)
+
+        def batch(n_jobs=2):
+            jobs = []
+            for _ in range(n_jobs):
+                job = make_job(2)
+                h.state.upsert_job(h.next_index(), job)
+                jobs.append(job)
+            evals = [s.Evaluation(
+                id=s.generate_uuid(), priority=j.priority, type=j.type,
+                triggered_by=s.EVAL_TRIGGER_JOB_REGISTER, job_id=j.id,
+                status=s.EVAL_STATUS_PENDING) for j in jobs]
+            sched = TPUBatchScheduler(h.logger, h.snapshot(), h, breaker=brk)
+            stats = sched.schedule_batch(evals)
+            placed = all(len([
+                a for a in h.state.allocs_by_job(None, j.id, True)
+                if not a.terminal_status()]) == 2 for j in jobs)
+            return stats, placed
+
+        out = {}
+        with fault.scenario({"seed": seed, "faults": [
+                {"point": "ops.kernel_result", "action": "corrupt",
+                 "times": 1}]}) as plane:
+            out["s1"], out["p1"] = batch()
+            out["state1"] = brk.state
+            out["s2"], out["p2"] = batch()      # breaker open → oracle
+            out["state2"] = brk.state
+            clock[0] += 10.0                    # past cooldown
+            out["s3"], out["p3"] = batch()      # half-open probe, clean
+            out["state3"] = brk.state
+            out["trace"] = plane.trace()
+        return out
+
+    def test_trip_oracle_fallback_and_recovery(self):
+        r = self._run_scenario(seed=42)
+        # corrupted batch: rejected, fell back to oracle, still placed
+        assert r["s1"].kernel_rejects == 1
+        assert r["s1"].oracle_routed == 2
+        assert r["p1"]
+        assert r["state1"] == "open"
+        # while open: every eval routed through the oracle, all complete
+        assert r["s2"].oracle_routed == 2
+        assert r["p2"]
+        assert r["state2"] == "open"
+        # after cooldown: clean probe closes the breaker, kernel path back
+        assert r["s3"].oracle_routed == 0
+        assert r["p3"]
+        assert r["state3"] == "closed"
+
+    def test_same_seed_same_chaos_trace(self):
+        a = self._run_scenario(seed=7)
+        b = self._run_scenario(seed=7)
+        assert a["trace"] == b["trace"] == [
+            ("ops.kernel_result", 0, "corrupt")]
+        assert (a["state1"], a["state2"], a["state3"]) == \
+               (b["state1"], b["state2"], b["state3"])
+
+    def test_unresolved_probe_expires_and_regrants(self):
+        """A probe batch that dies without resolving must not wedge the
+        breaker half-open forever: after another cooldown a new probe is
+        granted."""
+        from nomad_tpu.ops.breaker import KernelCircuitBreaker
+
+        clock = [0.0]
+        brk = KernelCircuitBreaker(threshold=0.9, window=8, min_checks=1,
+                                   cooldown=5.0, clock=lambda: clock[0])
+        brk.record(False)
+        assert brk.state == "open"
+        clock[0] = 6.0
+        assert brk.allow_kernel()       # half-open probe granted
+        assert brk.state == "half-open"
+        assert not brk.allow_kernel()   # concurrent batch stays on oracle
+        clock[0] = 12.0                 # probe never resolved → expired
+        assert brk.allow_kernel()       # fresh probe granted
+        brk.on_probe(True)
+        assert brk.state == "closed"
+
+    def test_probe_device_exception_resolves_probe(self, monkeypatch):
+        """A raw device error (not an integrity rejection) during the
+        probe batch must re-open the breaker, not strand it half-open."""
+        from nomad_tpu.ops.batch_sched import TPUBatchScheduler
+        from nomad_tpu.ops.breaker import KernelCircuitBreaker
+        from nomad_tpu.scheduler import Harness
+
+        clock = [0.0]
+        brk = KernelCircuitBreaker(threshold=0.9, window=8, min_checks=1,
+                                   cooldown=5.0, clock=lambda: clock[0])
+        brk.record(False)               # tripped open
+        clock[0] = 6.0                  # next batch is the probe
+        h = Harness()
+        for _ in range(3):
+            node = make_node()
+            node.compute_class()
+            h.state.upsert_node(h.next_index(), node)
+        job = make_job(1)
+        h.state.upsert_job(h.next_index(), job)
+        ev = s.Evaluation(
+            id=s.generate_uuid(), priority=job.priority, type=job.type,
+            triggered_by=s.EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
+            status=s.EVAL_STATUS_PENDING)
+        sched = TPUBatchScheduler(h.logger, h.snapshot(), h, breaker=brk)
+
+        def blow_up(spec_list):
+            raise RuntimeError("xla device died")
+
+        monkeypatch.setattr(sched, "_place_on_device", blow_up)
+        with pytest.raises(RuntimeError, match="xla device died"):
+            sched.schedule_batch([ev])
+        assert brk.state == "open"      # probe resolved dirty, not wedged
+
+    def test_breaker_trips_through_real_batch_worker(self, monkeypatch):
+        """End-to-end through Server + BatchWorker: a corrupted kernel
+        batch trips the process-wide breaker; later jobs complete via the
+        oracle while open; the breaker probes closed after cooldown."""
+        from nomad_tpu.ops import breaker as breaker_mod
+
+        monkeypatch.setenv("NOMAD_TPU_BREAKER_MIN_CHECKS", "1")
+        monkeypatch.setenv("NOMAD_TPU_BREAKER_COOLDOWN", "0.5")
+        breaker_mod.reset_for_tests()
+        srv = Server(ServerConfig(num_schedulers=1,
+                                  use_tpu_batch_worker=True, batch_size=8))
+        srv.start()
+        try:
+            for _ in range(4):
+                srv.node_register(make_node())
+            fault.arm({"seed": 33, "faults": [
+                {"point": "ops.kernel_result", "action": "corrupt",
+                 "times": 1}]})
+            job1 = make_job(2)
+            srv.job_register(job1)
+            assert wait_until(lambda: len([
+                a for a in srv.state.allocs_by_job(None, job1.id, True)
+                if not a.terminal_status()]) == 2, timeout=60.0)
+            assert breaker_mod.BREAKER.trips >= 1
+            # while open/after: scheduling keeps working
+            job2 = make_job(2)
+            srv.job_register(job2)
+            assert wait_until(lambda: len([
+                a for a in srv.state.allocs_by_job(None, job2.id, True)
+                if not a.terminal_status()]) == 2, timeout=60.0)
+            # cooldown passes; a probe batch restores the kernel path
+            time.sleep(0.6)
+            job3 = make_job(2)
+            srv.job_register(job3)
+            assert wait_until(lambda: len([
+                a for a in srv.state.allocs_by_job(None, job3.id, True)
+                if not a.terminal_status()]) == 2, timeout=60.0)
+            assert wait_until(
+                lambda: breaker_mod.BREAKER.state == "closed", timeout=30.0)
+        finally:
+            srv.shutdown()
+            monkeypatch.delenv("NOMAD_TPU_BREAKER_MIN_CHECKS")
+            monkeypatch.delenv("NOMAD_TPU_BREAKER_COOLDOWN")
+            breaker_mod.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# deep probabilistic sweep (slow tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestDeepRPCSweep:
+    @pytest.mark.parametrize("seed", [101, 202, 303])
+    def test_lossy_wire_never_wedges_the_server(self, seed):
+        """Probabilistic drop/dup/delay/truncate over a real RPC server:
+        every call either succeeds or fails with a classified RPC error,
+        and the server keeps answering cleanly after the storm."""
+        from nomad_tpu.server.rpc import RPCError
+
+        srv = RPCServer()
+        srv.register("Echo", lambda body: body)
+        srv.start()
+        pool = ConnPool(timeout=0.5)
+        try:
+            ok = failed = 0
+            with fault.scenario({"seed": seed, "faults": [
+                    {"point": "rpc.send", "action": "truncate",
+                     "prob": 0.10},
+                    {"point": "rpc.send", "action": "dup", "prob": 0.10},
+                    {"point": "rpc.send", "action": "delay",
+                     "delay": 0.005, "prob": 0.10}]}):
+                for i in range(120):
+                    try:
+                        assert pool.call(srv.address, "Echo",
+                                         {"i": i}) == {"i": i}
+                        ok += 1
+                    except (RPCError, OSError):
+                        failed += 1
+            assert ok > 0 and failed > 0  # the storm was real, not fatal
+            # A dup that fired on the storm's LAST successful call can
+            # leave its stale extra reply buffered in a released conn;
+            # the first post-storm use would detect the desync and
+            # discard it.  Drop all idle conns so the post-storm check
+            # exercises fresh connections only.
+            pool.close()
+            for i in range(10):
+                assert pool.call(srv.address, "Echo", {"i": i}) == {"i": i}
+        finally:
+            pool.close()
+            srv.shutdown()

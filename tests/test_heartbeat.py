@@ -1,0 +1,207 @@
+"""Dedicated coverage for server/heartbeat.py (previously untested):
+TTL scaling with fleet size, expiry → on_expire, timer lifecycle, and
+the end-to-end expiry → node down → non-terminal allocs → lost chain.
+"""
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import fault, mock
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.server.heartbeat import HeartbeatTimers
+from nomad_tpu.structs import structs as s
+
+
+def wait_until(predicate, timeout=30.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    yield
+    fault.disarm()
+
+
+class TestTTLScaling:
+    def test_small_fleet_gets_min_ttl(self):
+        h = HeartbeatTimers(on_expire=lambda nid: None, min_ttl=10.0,
+                            max_per_second=50.0, grace=10.0)
+        h.set_enabled(True)
+        try:
+            assert h.reset_heartbeat_timer("n1") == 10.0
+            assert h.reset_heartbeat_timer("n2") == 10.0
+        finally:
+            h.set_enabled(False)
+
+    def test_ttl_scales_with_fleet_size(self):
+        """ttl = max(min_ttl, nodes / max_heartbeats_per_second)
+        (config.go:185-197): a 500-node fleet at 50 hb/s spreads
+        heartbeats over ≥10s each."""
+        h = HeartbeatTimers(on_expire=lambda nid: None, min_ttl=1.0,
+                            max_per_second=10.0, grace=60.0)
+        h.set_enabled(True)
+        try:
+            for i in range(100):
+                h.reset_heartbeat_timer(f"node-{i}")
+            assert h.active() == 100
+            # 100 tracked timers / 10 per second ⇒ 10s TTL
+            assert h.reset_heartbeat_timer("node-next") == pytest.approx(10.0)
+            # fleet shrink ⇒ TTL shrinks back to the min_ttl floor
+            for i in range(100):
+                h.clear_heartbeat_timer(f"node-{i}")
+            assert h.reset_heartbeat_timer("node-next") == pytest.approx(1.0)
+        finally:
+            h.set_enabled(False)
+
+    def test_disabled_grants_min_ttl_without_tracking(self):
+        h = HeartbeatTimers(on_expire=lambda nid: None, min_ttl=3.0)
+        assert h.reset_heartbeat_timer("n1") == 3.0
+        assert h.active() == 0
+
+
+class TestExpiry:
+    def test_expiry_fires_on_expire_once(self):
+        expired = []
+        done = threading.Event()
+
+        def on_expire(nid):
+            expired.append(nid)
+            done.set()
+
+        h = HeartbeatTimers(on_expire=on_expire, min_ttl=0.05,
+                            max_per_second=1000.0, grace=0.05)
+        h.set_enabled(True)
+        try:
+            h.reset_heartbeat_timer("n1")
+            assert done.wait(5.0)
+            time.sleep(0.15)  # no double fire
+            assert expired == ["n1"]
+            assert h.active() == 0
+        finally:
+            h.set_enabled(False)
+
+    def test_reset_before_expiry_keeps_node_alive(self):
+        expired = []
+        h = HeartbeatTimers(on_expire=expired.append, min_ttl=0.15,
+                            max_per_second=1000.0, grace=0.05)
+        h.set_enabled(True)
+        try:
+            h.reset_heartbeat_timer("n1")
+            for _ in range(5):
+                time.sleep(0.05)
+                h.reset_heartbeat_timer("n1")  # keep beating at TTL/3
+            assert expired == []
+            assert h.active() == 1
+        finally:
+            h.set_enabled(False)
+
+    def test_clear_cancels_pending_expiry(self):
+        expired = []
+        h = HeartbeatTimers(on_expire=expired.append, min_ttl=0.05,
+                            max_per_second=1000.0, grace=0.02)
+        h.set_enabled(True)
+        try:
+            h.reset_heartbeat_timer("n1")
+            h.clear_heartbeat_timer("n1")
+            time.sleep(0.2)
+            assert expired == []
+        finally:
+            h.set_enabled(False)
+
+    def test_disable_cancels_all_timers(self):
+        expired = []
+        h = HeartbeatTimers(on_expire=expired.append, min_ttl=0.05,
+                            max_per_second=1000.0, grace=0.02)
+        h.set_enabled(True)
+        for i in range(5):
+            h.reset_heartbeat_timer(f"n{i}")
+        h.set_enabled(False)
+        assert h.active() == 0
+        time.sleep(0.2)
+        assert expired == []
+
+    def test_on_expire_exception_does_not_propagate(self):
+        done = threading.Event()
+
+        def bad_hook(nid):
+            done.set()
+            raise RuntimeError("hook blew up")
+
+        h = HeartbeatTimers(on_expire=bad_hook, min_ttl=0.05,
+                            max_per_second=1000.0, grace=0.02)
+        h.set_enabled(True)
+        try:
+            h.reset_heartbeat_timer("n1")
+            assert done.wait(5.0)  # fired, exception swallowed + logged
+        finally:
+            h.set_enabled(False)
+
+    def test_fault_point_drop_suppresses_reset(self):
+        """An armed ``heartbeat.deliver`` drop swallows the TTL reset:
+        the previously started timer keeps running and expires."""
+        expired = []
+        done = threading.Event()
+        h = HeartbeatTimers(
+            on_expire=lambda nid: (expired.append(nid), done.set()),
+            min_ttl=0.1, max_per_second=1000.0, grace=0.05)
+        h.set_enabled(True)
+        try:
+            h.reset_heartbeat_timer("n1")
+            fault.arm([{"point": "heartbeat.deliver", "action": "drop",
+                        "match": {"node_id": "n1"}}])
+            # "heartbeats" keep arriving but delivery is dropped
+            for _ in range(6):
+                h.reset_heartbeat_timer("n1")
+                time.sleep(0.05)
+            assert done.wait(5.0)
+            assert expired == ["n1"]
+        finally:
+            h.set_enabled(False)
+
+
+class TestEndToEndExpiry:
+    def test_expiry_node_down_allocs_lost(self):
+        """TTL expiry → on_expire → node down → node-update eval →
+        non-terminal allocs transition to lost (the full chain the
+        81-line module anchors)."""
+        srv = Server(ServerConfig(num_schedulers=1, min_heartbeat_ttl=0.3,
+                                  max_heartbeats_per_second=1000.0))
+        srv.heartbeat.grace = 0.2
+        srv.start()
+        try:
+            node = mock.node()
+            node.resources.networks = []
+            node.reserved.networks = []
+            srv.node_register(node)
+            srv.node_update_status(node.id, s.NODE_STATUS_READY)
+
+            job = mock.job()
+            job.task_groups[0].count = 2
+            for t in job.task_groups[0].tasks:
+                t.resources.networks = []
+            srv.job_register(job)
+            assert wait_until(lambda: len([
+                a for a in srv.state.allocs_by_job(None, job.id, True)
+                if not a.terminal_status()]) == 2, timeout=60.0)
+
+            # stop heartbeating: TTL 0.3 + grace 0.2 ⇒ down ≈ 0.5s later
+            assert wait_until(
+                lambda: srv.state.node_by_id(None, node.id).status
+                == s.NODE_STATUS_DOWN, timeout=10.0)
+            assert wait_until(lambda: len([
+                a for a in srv.state.allocs_by_job(None, job.id, True)
+                if a.client_status == s.ALLOC_CLIENT_STATUS_LOST]) == 2,
+                timeout=30.0)
+            # desired status flips to stop for the lost copies
+            lost = [a for a in srv.state.allocs_by_job(None, job.id, True)
+                    if a.client_status == s.ALLOC_CLIENT_STATUS_LOST]
+            assert all(a.desired_status == s.ALLOC_DESIRED_STATUS_STOP
+                       for a in lost)
+        finally:
+            srv.shutdown()
