@@ -430,6 +430,7 @@ def bench_single_eval_latency():
     per-eval loop: nomad/worker.go:106."""
     from nomad_tpu import mock
     from nomad_tpu.server import Server, ServerConfig
+    from nomad_tpu.utils.telemetry import InmemSink
 
     def make_node():
         n = mock.node()
@@ -451,7 +452,10 @@ def bench_single_eval_latency():
         try:
             for _ in range(100):
                 srv.node_register(make_node())
-            lat = []
+            # Percentiles come from the telemetry histogram sink (the
+            # same estimator /v1/metrics?format=prometheus serves), not
+            # hand-rolled sorted-list math.
+            sink = InmemSink(interval=3600.0)
             runs = 53  # 3 warm-up (first pays XLA compile), 50 measured
             for i in range(runs):
                 job = one_job()
@@ -462,15 +466,15 @@ def bench_single_eval_latency():
                     if srv.state.allocs_by_job(None, job.id, True):
                         break
                     time.sleep(0.0005)
-                lat.append(time.monotonic() - t0)
-            lat = sorted(lat[3:])
-            p50 = lat[len(lat) // 2]
-            p95 = lat[int(len(lat) * 0.95)]
-            out[key] = {"p50_ms": round(p50 * 1000, 2),
-                        "p95_ms": round(p95 * 1000, 2),
-                        "evals": len(lat)}
-            log(f"single-eval latency ({key}): p50 {p50*1000:.1f}ms "
-                f"p95 {p95*1000:.1f}ms over {len(lat)} evals")
+                if i >= 3:
+                    sink.add_sample("bench.single_eval_latency",
+                                    (time.monotonic() - t0) * 1000.0)
+            samp = sink.latest()["Samples"]["bench.single_eval_latency"]
+            out[key] = {"p50_ms": round(samp["p50"], 2),
+                        "p95_ms": round(samp["p95"], 2),
+                        "evals": samp["count"]}
+            log(f"single-eval latency ({key}): p50 {samp['p50']:.1f}ms "
+                f"p95 {samp['p95']:.1f}ms over {samp['count']} evals")
         finally:
             srv.shutdown()
     out["dequeue_window"] = ("none: dequeue_batch returns on the first "

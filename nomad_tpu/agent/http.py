@@ -44,6 +44,16 @@ class StreamResponse:
         self.frames = frames
 
 
+class TextResponse:
+    """Marker return value: raw text body with an explicit content type
+    (the Prometheus exposition endpoint — scrapers don't speak JSON)."""
+
+    def __init__(self, text: str,
+                 content_type: str = "text/plain; version=0.0.4"):
+        self.text = text
+        self.content_type = content_type
+
+
 class HTTPServer:
     """Routes /v1 requests onto an Agent's server/client."""
 
@@ -118,6 +128,8 @@ class HTTPServer:
         r("/v1/catalog/services", self.catalog_services_request)
         r("/v1/catalog/service/(?P<name>[^/]+)", self.catalog_service_request)
         r("/v1/metrics", self.metrics_request)
+        r("/v1/traces", self.traces_request)
+        r("/v1/trace/eval/(?P<id>[^/]+)", self.trace_eval_request)
         r("/v1/kv/(?P<key>.*)", self.kv_request)
         # Debug/profiling surface, gated by enable_debug — the reference
         # mounts net/http/pprof the same way (command/agent/http.go:173).
@@ -151,6 +163,8 @@ class HTTPServer:
                 return
             if isinstance(obj, StreamResponse):
                 self._reply_stream(req, obj)
+            elif isinstance(obj, TextResponse):
+                self._reply_text(req, obj)
             else:
                 self._reply_json(req, obj, index)
             return
@@ -205,6 +219,14 @@ class HTTPServer:
             req.send_header("X-Nomad-Index", str(index))
             req.send_header("X-Nomad-KnownLeader", "true")
             req.send_header("X-Nomad-LastContact", "0")
+        req.end_headers()
+        req.wfile.write(body)
+
+    def _reply_text(self, req, resp: TextResponse) -> None:
+        body = resp.text.encode()
+        req.send_response(200)
+        req.send_header("Content-Type", resp.content_type)
+        req.send_header("Content-Length", str(len(body)))
         req.end_headers()
         req.wfile.write(body)
 
@@ -676,8 +698,44 @@ class HTTPServer:
 
     def metrics_request(self, req, query):
         """In-memory telemetry aggregates (the reference's go-metrics
-        inventory; names per telemetry.html.md)."""
+        inventory; names per telemetry.html.md).  ``?format=prometheus``
+        renders the newest interval as text exposition (gauges, counters,
+        and sample summaries with p50/p95/p99 quantiles)."""
+        if query.get("format") == "prometheus":
+            from ..utils.telemetry import render_prometheus
+
+            sink = self.server.metrics.sink
+            if not hasattr(sink, "latest"):
+                raise CodedError(400, "metrics sink has no interval data")
+            return TextResponse(render_prometheus(sink.latest())), None
         return self.server.metrics.sink.data(), None
+
+    # -- eval-lifecycle tracing (utils/tracing.py) ---------------------
+
+    def traces_request(self, req, query):
+        """Recent completed spans: /v1/traces?recent=N (newest last).
+        Body always carries Enabled so a disarmed plane reads as such
+        instead of as an empty cluster."""
+        from ..utils import tracing
+
+        n = min(int(query.get("recent", 100) or 100), 1000)
+        return {"Enabled": tracing.enabled(),
+                "Spans": tracing.recent(n)}, None
+
+    def trace_eval_request(self, req, query, id: str):
+        """Full lifecycle timeline of one evaluation:
+        /v1/trace/eval/<id> — every span tagged with the eval id,
+        sorted by monotonic start time."""
+        from ..utils import tracing
+
+        if not tracing.enabled():
+            raise CodedError(
+                404, "tracing disabled (set NOMAD_TPU_TRACE=1 or call "
+                     "tracing.enable())")
+        spans = tracing.trace_for_eval(id)
+        if not spans:
+            raise CodedError(404, f"no trace recorded for eval {id!r}")
+        return {"EvalID": id, "Spans": spans}, None
 
     # -- debug / profiling (pprof equivalent) --------------------------
 

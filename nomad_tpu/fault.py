@@ -159,6 +159,12 @@ class FaultPlane:
                     continue
                 rule.fired += 1
                 self._trace.append((name, rule.index, rule.action))
+            # Mirror the fire into the tracing plane (a `fault.fire` span
+            # under whatever the current thread is doing) so an eval's
+            # timeline names the injection that shaped it.  Import here:
+            # fires are rare, and the hot disarmed path must not pay it.
+            from .utils import tracing
+            tracing.note_fault(name, rule.index, rule.action)
             return FaultAction(rule)
         return None
 

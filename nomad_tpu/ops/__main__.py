@@ -97,6 +97,108 @@ def breaker_drill(seed: int = 0, log=print) -> bool:
     return True
 
 
+def tracing_drill(seed: int = 0, log=print) -> bool:
+    """Run one batch with tracing armed and assert the span tree: the
+    batch.schedule root must contain encode/device/finalize phase spans
+    with monotonic timestamps and an eval-id index entry per eval; then
+    a breaker-tripped (corrupted) batch must produce an
+    ``batch.oracle_routed`` span.  Always disarms tracing on exit."""
+    from .. import fault, mock
+    from ..scheduler import Harness
+    from ..structs import structs as s
+    from ..utils import tracing
+    from .batch_sched import TPUBatchScheduler
+    from .breaker import KernelCircuitBreaker
+
+    def check(cond, msg):
+        if not cond:
+            log(f"tracing drill: FAIL — {msg}")
+        return cond
+
+    brk = KernelCircuitBreaker(threshold=0.9, window=8, min_checks=1,
+                               cooldown=3600.0)
+    h = Harness()
+    for _ in range(8):
+        node = mock.node()
+        node.resources.networks = []
+        node.reserved.networks = []
+        node.compute_class()
+        h.state.upsert_node(h.next_index(), node)
+
+    def run_batch():
+        job = mock.job()
+        for tg in job.task_groups:
+            for t in tg.tasks:
+                t.resources.networks = []
+        job.task_groups[0].count = 2
+        h.state.upsert_job(h.next_index(), job)
+        ev = s.Evaluation(
+            id=s.generate_uuid(), priority=job.priority, type=job.type,
+            triggered_by=s.EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
+            status=s.EVAL_STATUS_PENDING)
+        sched = TPUBatchScheduler(h.logger, h.snapshot(), h, breaker=brk)
+        sched.schedule_batch([ev])
+        return ev
+
+    tracing.enable()
+    try:
+        ev = run_batch()
+        spans = tracing.trace_for_eval(ev.id)
+        names = [sp["Name"] for sp in spans]
+        roots = [sp for sp in spans if sp["Name"] == "batch.schedule"]
+        if not (check(roots, "no batch.schedule root span")
+                and check(all(n in names for n in
+                              ("batch.encode", "batch.device",
+                               "batch.finalize")),
+                          f"phase spans missing from {names}")):
+            return False
+        by_name = {sp["Name"]: sp for sp in spans}
+        root_id = roots[0]["SpanID"]
+        if not (check(all(by_name[n]["ParentID"] == root_id for n in
+                          ("batch.encode", "batch.device",
+                           "batch.finalize")),
+                      "phase spans not parented under batch.schedule")
+                and check(by_name["batch.encode"]["Start"]
+                          <= by_name["batch.device"]["Start"]
+                          <= by_name["batch.finalize"]["Start"],
+                          "phase timestamps not monotonic")):
+            return False
+
+        with fault.scenario({"seed": seed, "faults": [
+                {"point": "ops.kernel_result", "action": "corrupt",
+                 "times": 1}]}):
+            ev2 = run_batch()
+        spans2 = tracing.trace_for_eval(ev2.id)
+        routed = [sp for sp in spans2
+                  if sp["Name"] == "batch.oracle_routed"]
+        fires = [sp for sp in spans2 if sp["Name"] == "fault.fire"]
+        if not (check(routed, "corrupted batch produced no "
+                              "batch.oracle_routed span")
+                and check(routed[0]["Attrs"].get("reason")
+                          == "kernel_reject", f"bad attrs {routed[0]}")
+                and check(brk.state == "open",
+                          f"breaker {brk.state!r}, expected open")
+                and check(fires, "fault.fire span not correlated into "
+                                 "the eval trace")):
+            return False
+
+        ev3 = run_batch()  # breaker open: routed through the oracle
+        routed3 = [sp for sp in tracing.trace_for_eval(ev3.id)
+                   if sp["Name"] == "batch.oracle_routed"]
+        if not (check(routed3, "open-breaker batch produced no "
+                               "batch.oracle_routed span")
+                and check(routed3[0]["Attrs"].get("reason")
+                          == "breaker_open", f"bad attrs {routed3[0]}")):
+            return False
+    finally:
+        tracing.disable()
+    log(f"tracing drill: OK — span tree has encode/device/finalize under "
+        f"batch.schedule ({len(spans)} spans for one eval), corrupt batch "
+        "traced as oracle_routed(kernel_reject) + fault.fire, open "
+        "breaker traced as oracle_routed(breaker_open)")
+    return True
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="python -m nomad_tpu.ops")
     parser.add_argument("--selfcheck", action="store_true",
@@ -110,6 +212,7 @@ def main(argv=None) -> int:
         return 2
     ok = selfcheck(n_nodes=args.nodes, n_specs=args.specs, seed=args.seed)
     ok = breaker_drill(seed=args.seed) and ok
+    ok = tracing_drill(seed=args.seed) and ok
     return 0 if ok else 1
 
 

@@ -33,6 +33,8 @@ import numpy as np
 
 from .. import fault
 from ..scheduler.generic import GenericScheduler
+from ..utils import tracing
+from ..utils.telemetry import NULL_TELEMETRY
 from ..scheduler.scheduler import register_scheduler
 from ..scheduler.util import AllocTuple, ready_nodes_in_dcs, set_status
 from ..structs import structs as s
@@ -236,10 +238,12 @@ class TPUBatchScheduler:
     """
 
     def __init__(self, logger_: logging.Logger, state, planner, mesh=None,
-                 preemption_enabled: Optional[bool] = None, breaker=None):
+                 preemption_enabled: Optional[bool] = None, breaker=None,
+                 metrics=None):
         self.logger = logger_
         self.state = state
         self.planner = planner
+        self.metrics = metrics if metrics is not None else NULL_TELEMETRY
         # Optional jax.sharding.Mesh: when set, the placement loop runs
         # node-sharded over THIS scheduler's device slice
         # (parallel/sharded.py) — each federated region schedules on its
@@ -275,7 +279,60 @@ class TPUBatchScheduler:
 
     def schedule_batch(self, evals: List[s.Evaluation]) -> "BatchStats":
         """Run the host phase for every eval, one device placement pass for
-        all of them, then finalize plans/statuses per eval."""
+        all of them, then finalize plans/statuses per eval.  Wraps the
+        batch in a `batch.schedule` span and bridges the resulting
+        BatchStats into telemetry (the nomad.worker.invoke_scheduler.*
+        family + breaker counters) so the repr is no longer the only
+        artifact of a batch."""
+        tr = tracing.TRACER
+        if tr is None:
+            stats = self._schedule_batch(evals)
+        else:
+            with tr.span("batch.schedule",
+                         num_evals=len(evals),
+                         **tracing.eval_id_attrs(evals, len(evals))) as sp:
+                stats = self._schedule_batch(evals)
+                sp.set(num_specs=stats.num_specs, num_asks=stats.num_asks,
+                       breaker_state=stats.breaker_state,
+                       oracle_routed=stats.oracle_routed)
+        self._emit_batch_stats(stats)
+        return stats
+
+    def _emit_batch_stats(self, stats: "BatchStats") -> None:
+        m = self.metrics
+        # All timing samples in milliseconds, like every measure_since
+        # sibling in the family (DEFAULT_BUCKETS is ms-calibrated).
+        m.add_sample("worker.invoke_scheduler",
+                     stats.total_seconds * 1000.0)
+        m.add_sample("worker.invoke_scheduler.phase1",
+                     stats.phase1_seconds * 1000.0)
+        m.add_sample("worker.invoke_scheduler.phase2",
+                     stats.phase2_seconds * 1000.0)
+        # Device-path phases only when the kernel actually ran: oracle-
+        # routed or ask-less batches would otherwise flood the percentile
+        # windows with zeros exactly when the device path is degraded.
+        if stats.device_ran:
+            m.add_sample("worker.invoke_scheduler.encode",
+                         stats.encode_seconds * 1000.0)
+            m.add_sample("worker.invoke_scheduler.device",
+                         stats.device_seconds * 1000.0)
+            m.add_sample("worker.invoke_scheduler.rounds", stats.rounds)
+        if not stats.oracle_routed:
+            m.add_sample("worker.invoke_scheduler.finalize",
+                         stats.finalize_seconds * 1000.0)
+        m.add_sample("worker.invoke_scheduler.asks", stats.num_asks)
+        m.set_gauge("breaker.trips", self.breaker.trips)
+        # Live breaker, not stats.breaker_state: batches that never reach
+        # the breaker gate (empty spec_list) leave stats at the "closed"
+        # default and must not report healthy while the breaker is open.
+        m.set_gauge("breaker.state",
+                    breaker_mod.STATE_CODE.get(self.breaker.state, 0))
+        if stats.oracle_routed:
+            m.incr_counter("breaker.oracle_routed", stats.oracle_routed)
+        if stats.kernel_rejects:
+            m.incr_counter("breaker.kernel_rejects", stats.kernel_rejects)
+
+    def _schedule_batch(self, evals: List[s.Evaluation]) -> "BatchStats":
         stats = BatchStats()
         t0 = time.monotonic()
         self._preempt_plan = {}
@@ -303,6 +360,11 @@ class TPUBatchScheduler:
             sched._compute_job_allocs()
             scheds.append((ev, sched))
         stats.phase1_seconds = time.monotonic() - t_phase1
+        tr = tracing.TRACER
+        if tr is not None:
+            tr.record("batch.phase1", t_phase1,
+                      t_phase1 + stats.phase1_seconds,
+                      num_evals=len(evals))
         t_phase2 = time.monotonic()
 
         # Phase 2: dedup placement asks into specs.
@@ -344,6 +406,10 @@ class TPUBatchScheduler:
         stats.num_specs = len(spec_list)
         stats.num_asks = sum(sp.count for sp in spec_list)
         stats.phase2_seconds = time.monotonic() - t_phase2
+        if tr is not None:
+            tr.record("batch.phase2", t_phase2,
+                      t_phase2 + stats.phase2_seconds,
+                      num_specs=stats.num_specs, num_asks=stats.num_asks)
 
         # Per-spec flat slot lists (node id per placement), expanded on
         # the numpy side in _place_on_device.
@@ -361,6 +427,9 @@ class TPUBatchScheduler:
                 self.logger.info(
                     "batch: kernel breaker %s; routing %d evals through "
                     "the CPU oracle", stats.breaker_state, len(scheds))
+                tracing.event("batch.oracle_routed", reason="breaker_open",
+                              breaker_state=stats.breaker_state,
+                              num_evals=len(scheds))
                 self._route_through_oracle(scheds)
                 stats.total_seconds = time.monotonic() - t0
                 stats.num_evals = len(evals)
@@ -382,6 +451,9 @@ class TPUBatchScheduler:
                 stats.kernel_rejects = 1
                 stats.oracle_routed = len(scheds)
                 stats.breaker_state = self.breaker.state
+                tracing.event("batch.oracle_routed", reason="kernel_reject",
+                              breaker_state=stats.breaker_state,
+                              num_evals=len(scheds), detail=str(e))
                 self._route_through_oracle(scheds)
                 stats.total_seconds = time.monotonic() - t0
                 stats.num_evals = len(evals)
@@ -408,6 +480,7 @@ class TPUBatchScheduler:
             if probe:
                 self.breaker.on_probe(disagree == 0)
             stats.breaker_state = self.breaker.state
+            stats.device_ran = True
             stats.device_seconds = kstats["device_seconds"]
             stats.encode_seconds = kstats["encode_seconds"]
             stats.metrics_seconds = kstats["metrics_seconds"]
@@ -424,6 +497,9 @@ class TPUBatchScheduler:
             self._finalize(ev, sched, specs, expanded, unplaced,
                            per_spec_metrics, net_index_cache)
         stats.finalize_seconds = time.monotonic() - t_final
+        if tr is not None:
+            tr.record("batch.finalize", t_final,
+                      t_final + stats.finalize_seconds)
 
         stats.total_seconds = time.monotonic() - t0
         stats.num_evals = len(evals)
@@ -434,12 +510,17 @@ class TPUBatchScheduler:
         against live state — identical semantics to the per-eval gate
         fallback, used when the breaker is open or a kernel result was
         rejected."""
+        tr = tracing.TRACER
         for ev, _sched in scheds:
             oracle = GenericScheduler(
                 self.logger, self.state, self.planner,
                 batch=(ev.type == s.JOB_TYPE_BATCH),
                 preemption_enabled=self.preemption_enabled)
-            oracle.process(ev)
+            if tr is None:
+                oracle.process(ev)
+            else:
+                with tr.span("oracle.process", eval_id=ev.id):
+                    oracle.process(ev)
 
     # -- gating + distinct_property context --------------------------------
 
@@ -964,8 +1045,9 @@ class TPUBatchScheduler:
             # Writable copy: the fetched summary buffer is read-only, and
             # the pass decrements the counts it fills.
             unplaced_arr = np.array(unplaced_arr)
-            preempt_stats = self._preempt_pass(
-                spec_list, ct, st, feas, unplaced_arr, used_after)
+            with tracing.span("batch.preempt"):
+                preempt_stats = self._preempt_pass(
+                    spec_list, ct, st, feas, unplaced_arr, used_after)
 
         expanded: Dict[Tuple[str, str], List[str]] = {}
         unplaced: Dict[Tuple[str, str], int] = {}
@@ -1035,6 +1117,16 @@ class TPUBatchScheduler:
             "rounds": rounds,
         }
         kstats.update(preempt_stats)
+        tr = tracing.TRACER
+        if tr is not None:
+            # Phase spans from the timers already taken above: t1 marks
+            # the encode→device boundary, t_metrics the device→host one.
+            tr.record("batch.encode", t1 - encode_seconds, t1)
+            tr.record("batch.device", t1, t1 + device_seconds,
+                      rounds=rounds)
+            tr.record("batch.metrics", t_metrics,
+                      t_metrics + kstats["metrics_seconds"],
+                      preempt_placed=kstats.get("preempt_placed", 0))
         return expanded, unplaced, metrics, kstats
 
     # -- preemption pass ----------------------------------------------------
@@ -1566,6 +1658,9 @@ class BatchStats:
         self.oracle_routed = 0
         self.kernel_rejects = 0
         self.breaker_state = "closed"
+        # True only when _place_on_device ran to completion — gates the
+        # encode/device/rounds telemetry samples.
+        self.device_ran = False
 
     def __repr__(self) -> str:
         extra = ""

@@ -31,11 +31,17 @@ import time
 from collections import deque
 from typing import Callable, Optional
 
+from ..utils import tracing
+
 logger = logging.getLogger("nomad_tpu.ops.breaker")
 
 CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half-open"
+
+# Numeric encoding for the `nomad.breaker.state` gauge (telemetry can
+# only carry numbers; 0 = healthy, rising = degraded).
+STATE_CODE = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
 
 
 class KernelIntegrityError(Exception):
@@ -95,6 +101,8 @@ class KernelCircuitBreaker:
                 self._state = OPEN
                 self._tripped_at = self.clock()
                 self.trips += 1
+                tracing.event("breaker.transition", frm=CLOSED, to=OPEN,
+                              agreement=round(ratio, 4), trips=self.trips)
                 logger.warning(
                     "kernel circuit breaker OPEN: agreement %.2f < %.2f "
                     "over %d checks; routing evals through the CPU oracle "
@@ -116,6 +124,7 @@ class KernelCircuitBreaker:
                     self.clock() - self._tripped_at >= self.cooldown):
                 self._state = HALF_OPEN
                 self._probe_started = self.clock()
+                tracing.event("breaker.transition", frm=OPEN, to=HALF_OPEN)
                 logger.info("kernel circuit breaker HALF-OPEN: probing the "
                             "device path with one batch")
                 return True
@@ -139,11 +148,13 @@ class KernelCircuitBreaker:
             if ok:
                 self._state = CLOSED
                 self._checks.clear()
+                tracing.event("breaker.transition", frm=HALF_OPEN, to=CLOSED)
                 logger.info("kernel circuit breaker CLOSED: probe batch "
                             "agreed; device path restored")
             else:
                 self._state = OPEN
                 self._tripped_at = self.clock()
+                tracing.event("breaker.transition", frm=HALF_OPEN, to=OPEN)
                 logger.warning("kernel circuit breaker RE-OPEN: probe batch "
                                "disagreed; staying on the CPU oracle")
 

@@ -20,6 +20,8 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from ..structs import structs as s
+from ..utils import tracing
+from ..utils.telemetry import NULL_TELEMETRY
 
 FAILED_QUEUE = "_failed"
 
@@ -58,7 +60,9 @@ class EvalBroker:
         initial_nack_delay: float = 1.0,
         subsequent_nack_delay: float = 20.0,
         delivery_limit: int = 3,
+        metrics=None,
     ):
+        self.metrics = metrics if metrics is not None else NULL_TELEMETRY
         if nack_timeout < 0:
             raise ValueError("timeout cannot be negative")
         self.nack_timeout = nack_timeout
@@ -119,6 +123,16 @@ class EvalBroker:
             return
         elif self._enabled:
             self.evals[ev.id] = 0
+            # The shared choke point — instrumented here, after the
+            # dedup check and only while enabled, so every actual
+            # admission (enqueue, enqueue_all via blocked-eval unblock,
+            # post-ack requeue) records exactly one broker.enqueue;
+            # duplicates and drops by a disabled broker record none.
+            tr = tracing.TRACER
+            if tr is not None:
+                tr.event("broker.enqueue", eval_id=ev.id, job_id=ev.job_id,
+                         eval_type=ev.type, priority=ev.priority)
+            self.metrics.incr_counter("broker.enqueue")
 
         if ev.wait > 0:
             self._process_waiting_enqueue(ev)
@@ -228,6 +242,11 @@ class EvalBroker:
         if timer is not None:
             timer.start()
         self.evals[ev.id] = self.evals.get(ev.id, 0) + 1
+        tr = tracing.TRACER
+        if tr is not None:
+            tr.event("broker.dequeue", eval_id=ev.id, job_id=ev.job_id,
+                     eval_type=ev.type, attempt=self.evals[ev.id])
+        self.metrics.incr_counter("broker.dequeue")
         return ev, token
 
     def _nack_timeout_fire(self, eval_id: str, token: str) -> None:
@@ -288,6 +307,11 @@ class EvalBroker:
                 if unack.timer is not None:
                     unack.timer.cancel()
                 job_id = unack.eval.job_id
+                tr = tracing.TRACER
+                if tr is not None:
+                    tr.event("broker.ack", eval_id=eval_id, job_id=job_id,
+                             attempts=self.evals.get(eval_id, 0))
+                self.metrics.incr_counter("broker.ack")
 
                 del self.unack[eval_id]
                 self.evals.pop(eval_id, None)
@@ -318,14 +342,22 @@ class EvalBroker:
 
             dequeues = self.evals.get(eval_id, 0)
             if dequeues >= self.delivery_limit:
+                outcome, wait = "failed", 0.0
                 self._enqueue_locked(unack.eval, FAILED_QUEUE)
             else:
                 ev = unack.eval
                 ev.wait = self._nack_reenqueue_delay(dequeues)
+                outcome, wait = "requeue", ev.wait
                 if ev.wait > 0:
                     self._process_waiting_enqueue(ev)
                 else:
                     self._enqueue_locked(ev, ev.type)
+            tr = tracing.TRACER
+            if tr is not None:
+                tr.event("broker.nack", eval_id=eval_id,
+                         job_id=unack.eval.job_id, attempts=dequeues,
+                         outcome=outcome, wait=wait)
+            self.metrics.incr_counter("broker.nack")
 
     def _nack_reenqueue_delay(self, prev_dequeues: int) -> float:
         if prev_dequeues <= 0:

@@ -14,6 +14,8 @@ import time
 from typing import Callable, Dict, Optional
 
 from .. import fault
+from ..utils import tracing
+from ..utils.telemetry import NULL_TELEMETRY
 
 MIN_HEARTBEAT_TTL = 10.0
 MAX_HEARTBEATS_PER_SECOND = 50.0
@@ -28,7 +30,9 @@ class HeartbeatTimers:
         max_per_second: float = MAX_HEARTBEATS_PER_SECOND,
         grace: float = HEARTBEAT_GRACE,
         logger: Optional[logging.Logger] = None,
+        metrics=None,
     ):
+        self.metrics = metrics if metrics is not None else NULL_TELEMETRY
         self.on_expire = on_expire
         self.min_ttl = min_ttl
         self.max_per_second = max_per_second
@@ -63,6 +67,7 @@ class HeartbeatTimers:
         with self._l:
             if not self._enabled:
                 return self.min_ttl
+            self.metrics.incr_counter("heartbeat.reset")
             ttl = max(self.min_ttl, len(self._timers) / self.max_per_second)
             existing = self._timers.get(node_id)
             if existing is not None:
@@ -80,6 +85,8 @@ class HeartbeatTimers:
             if not self._enabled:
                 return
         self.logger.warning("node %s heartbeat missed; marking down", node_id)
+        self.metrics.incr_counter("heartbeat.invalidate")
+        tracing.event("heartbeat.expire", node_id=node_id)
         try:
             self.on_expire(node_id)
         except Exception:

@@ -22,6 +22,7 @@ import numpy as np
 
 from .. import fault
 from ..structs import structs as s
+from ..utils import tracing
 from ..structs.funcs import allocs_fit, remove_allocs
 from .fsm import MessageType
 from .plan_queue import PlanFuture, PlanQueue
@@ -81,8 +82,13 @@ class PlanApplier:
                 continue
             snap = self.raft.fsm.state.snapshot()
 
+            # Branch before building span attrs (the disarmed per-plan
+            # path pays one load + comparison only).
+            tr = tracing.TRACER
             try:
-                with self.metrics.measure("plan.evaluate"):
+                ev_span = tracing.NOOP if tr is None else tr.span(
+                    "plan.evaluate", eval_id=plan.eval_id)
+                with self.metrics.measure("plan.evaluate"), ev_span:
                     result = self.evaluate_plan(snap, plan)
             except Exception as exc:  # pragma: no cover — defensive
                 self.logger.exception("plan evaluation failed")
@@ -91,7 +97,9 @@ class PlanApplier:
 
             if result.node_update or result.node_allocation or result.alloc_slabs:
                 try:
-                    with self.metrics.measure("plan.apply"):
+                    ap_span = tracing.NOOP if tr is None else tr.span(
+                        "plan.apply", eval_id=plan.eval_id)
+                    with self.metrics.measure("plan.apply"), ap_span:
                         index = self.apply_plan(plan, result, snap)
                     result.alloc_index = index
                     if result.refresh_index:

@@ -27,9 +27,12 @@ from __future__ import annotations
 import os
 import struct
 import threading
+import time
 from typing import Callable, List, Optional, Tuple
 
 from .. import fault
+from ..utils import tracing
+from ..utils.telemetry import NULL_TELEMETRY
 from .fsm import FSM, MessageType
 from .log_codec import decode_payload, encode_payload
 
@@ -76,6 +79,10 @@ SNAPSHOTS_RETAINED = 2
 class RaftLog:
     """Single-voter commit path: append → fsync (durable impls) → apply."""
 
+    # Telemetry handle, assigned by the owning Server after construction
+    # (class default keeps standalone/test construction zero-config).
+    metrics = NULL_TELEMETRY
+
     def __init__(self, fsm: FSM):
         self.fsm = fsm
         # RLock: fsm.apply runs under this lock and its hooks may consult
@@ -114,6 +121,7 @@ class RaftLog:
         The FSM apply runs under the log lock so entries reach the state
         store in strict index order and applied_index() never reports an
         entry whose state is not yet visible."""
+        t0 = time.monotonic()
         with self._l:
             if not self._leader:
                 raise NotLeaderError("not the leader")
@@ -126,6 +134,13 @@ class RaftLog:
             index = self._last_index
             self._persist(index, msg_type, payload)
             result = self.fsm.apply(index, msg_type, payload)
+        self.metrics.measure_since("raft.apply", t0)
+        # Branch before building attrs: the disarmed commit path pays
+        # one load + comparison, no getattr/dict/timestamp.
+        tr = tracing.TRACER
+        if tr is not None:
+            tr.record("raft.apply", t0, time.monotonic(), index=index,
+                      msg_type=getattr(msg_type, "name", str(msg_type)))
         return result, index
 
     def _persist(self, index: int, msg_type: MessageType, payload: dict) -> None:
@@ -1199,6 +1214,7 @@ class MultiRaft(RaftLog):
 
     def apply(self, msg_type: MessageType, payload: dict):
         from .log_codec import encode_payload
+        t0 = time.monotonic()
         with self._l:
             if self.state != "leader":
                 raise NotLeaderError(self.leader_addr or "")
@@ -1218,4 +1234,9 @@ class MultiRaft(RaftLog):
             self._advance_commit()  # single-voter clusters commit here
         self._kick_replicators()
         result = fut.wait(self.APPLY_TIMEOUT)
+        self.metrics.measure_since("raft.apply", t0)
+        tr = tracing.TRACER
+        if tr is not None:
+            tr.record("raft.apply", t0, time.monotonic(), index=index,
+                      msg_type=getattr(msg_type, "name", str(msg_type)))
         return result, index

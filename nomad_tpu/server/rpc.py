@@ -26,6 +26,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import msgpack
 
 from .. import fault
+from ..utils import tracing
+from ..utils.telemetry import NULL_TELEMETRY
 
 # Protocol bytes (rpc.go:23-30)
 RPC_NOMAD = 0x01
@@ -129,8 +131,9 @@ class RPCServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  logger: Optional[logging.Logger] = None,
-                 tls_context=None):
+                 tls_context=None, metrics=None):
         self.logger = logger or logging.getLogger("nomad_tpu.rpc")
+        self.metrics = metrics if metrics is not None else NULL_TELEMETRY
         self.methods: Dict[str, Callable[[Any], Any]] = {}
         self.raft_handler: Optional[Callable[[Any], Any]] = None
         self.tls_context = tls_context
@@ -230,16 +233,28 @@ class RPCServer:
                 seq, method, body = _recv_frame(sock)
             except (TransportError, ConnectionError, OSError, ValueError):
                 return
+            self.metrics.incr_counter("rpc.request")
             fn = self.methods.get(method)
             if fn is None:
+                # Unknown methods are rejected traffic, not silence.
+                self.metrics.incr_counter("rpc.request_error")
                 reply = [seq, f"rpc: can't find method {method}", None]
             else:
+                t0 = time.monotonic()
+                # Branch before building the span attrs: the disarmed
+                # per-request path pays one load + comparison only.
+                tr = tracing.TRACER
+                req_span = tracing.NOOP if tr is None else tr.span(
+                    "rpc.request", method=method)
                 try:
-                    reply = [seq, None, fn(body)]
+                    with req_span:
+                        reply = [seq, None, fn(body)]
                 except NoLeaderError as e:
                     reply = [seq, f"__no_leader__:{e}", None]
                 except Exception as e:  # error string back to caller
+                    self.metrics.incr_counter("rpc.request_error")
                     reply = [seq, f"{type(e).__name__}: {e}", None]
+                self.metrics.measure_since(f"rpc.request.{method}", t0)
             try:
                 _send_frame(sock, reply)
             except (ConnectionError, OSError):

@@ -8,12 +8,14 @@ from __future__ import annotations
 
 import logging
 import os
+import sys
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from ..structs import structs as s
+from ..utils import tracing
 from ..utils.telemetry import Telemetry
 from .blocked_evals import BlockedEvals
 from .core_sched import CoreScheduler
@@ -84,6 +86,13 @@ class Server:
         # agent-info + /v1/metrics; hot paths measure through it
         # (server.go:292-305 periodic emitters + MeasureSince call sites).
         self.metrics = Telemetry()
+        # Opt-in eval-lifecycle tracing (utils/tracing.py): process-wide,
+        # off by default; NOMAD_TPU_TRACE=1 arms it at construction so
+        # /v1/trace/* works without code changes.
+        if not tracing.enabled() and os.environ.get(
+                "NOMAD_TPU_TRACE", "").strip().lower() in ("1", "true",
+                                                           "yes"):
+            tracing.enable()
         # Vault client (nomad/vault.go:234); vault_api injects the fake
         # in tests (vault_testing.go role).
         self.vault = ServerVaultClient(self.config.vault or VaultConfig(),
@@ -96,7 +105,8 @@ class Server:
 
         self.eval_broker = EvalBroker(
             nack_timeout=self.config.eval_nack_timeout,
-            delivery_limit=self.config.eval_delivery_limit)
+            delivery_limit=self.config.eval_delivery_limit,
+            metrics=self.metrics)
         self.blocked_evals = BlockedEvals(self.eval_broker)
         self.plan_queue = PlanQueue()
         self.time_table = TimeTable()
@@ -131,7 +141,8 @@ class Server:
             self.rpc = RPCServer(host=self.config.rpc_bind,
                                  port=self.config.rpc_port,
                                  logger=self.logger.getChild("rpc"),
-                                 tls_context=server_context(tls_cfg))
+                                 tls_context=server_context(tls_cfg),
+                                 metrics=self.metrics)
             # Advertise the configured host (never a wildcard bind) with
             # the actually-bound port (config.go AdvertiseAddrs).
             adv_host = ""
@@ -159,6 +170,8 @@ class Server:
         else:
             self.raft = InmemLog(self.fsm)
 
+        self.raft.metrics = self.metrics
+
         if self.rpc is not None:
             from .endpoints import register_endpoints
 
@@ -173,7 +186,8 @@ class Server:
             on_expire=self._heartbeat_expired,
             min_ttl=self.config.min_heartbeat_ttl,
             max_per_second=self.config.max_heartbeats_per_second,
-            logger=self.logger)
+            logger=self.logger,
+            metrics=self.metrics)
         self.periodic = PeriodicDispatch(self._periodic_dispatch, self.logger)
 
         self.workers: List[Worker] = []
@@ -598,6 +612,18 @@ class Server:
                                        self.heartbeat.active())
                 self.metrics.set_gauge("raft.applied_index",
                                        self.raft.applied_index())
+                # Breaker state must survive interval rolls while evals
+                # are quiet — the open-and-idle window is exactly the
+                # one worth observing.  sys.modules, not an import: the
+                # ops package drags in jax, which an oracle-only server
+                # never needs.
+                brk_mod = sys.modules.get("nomad_tpu.ops.breaker")
+                if brk_mod is not None:
+                    self.metrics.set_gauge(
+                        "breaker.state",
+                        brk_mod.STATE_CODE.get(brk_mod.BREAKER.state, 0))
+                    self.metrics.set_gauge("breaker.trips",
+                                           brk_mod.BREAKER.trips)
             except Exception:  # never kill the emitter
                 self.logger.exception("metrics emit failed")
             self._shutdown.wait(interval)

@@ -18,6 +18,7 @@ from typing import List, Optional, Tuple
 
 from ..scheduler import new_scheduler
 from ..structs import structs as s
+from ..utils import tracing
 from ..utils.backoff import Backoff, wait_until
 from ..utils.telemetry import NULL_TELEMETRY
 from .eval_broker import EvalBroker, EvalBrokerError
@@ -58,8 +59,12 @@ class WorkerPlanner:
         except EvalBrokerError:
             pass
         try:
-            future = w.plan_queue.enqueue(plan)
-            result = future.wait()
+            tr = tracing.TRACER
+            submit_span = tracing.NOOP if tr is None else tr.span(
+                "worker.submit_plan", eval_id=self.eval.id)
+            with submit_span:
+                future = w.plan_queue.enqueue(plan)
+                result = future.wait()
         finally:
             try:
                 w.broker.resume_nack_timeout(self.eval.id, self.token)
@@ -174,21 +179,43 @@ class Worker:
             return None
         return ev, token
 
+    # The unit of the UNSUFFIXED worker.invoke_scheduler histogram is one
+    # scheduler invocation.  For this worker that's one eval; BatchWorker
+    # overrides to False because its invocations are whole batches
+    # (emitted by TPUBatchScheduler._emit_batch_stats) and mixing its
+    # per-eval system/core timings into the same key would conflate two
+    # units of work in one percentile window.
+    unsuffixed_invoke_sample = True
+
     def process_eval(self, ev: s.Evaluation, token: str) -> None:
         """Dequeue→schedule→ack cycle (worker.go:106-227)."""
-        try:
-            with self.metrics.measure("worker.wait_for_index"):
-                self.wait_for_index(ev.modify_index, RAFT_SYNC_LIMIT)
-            with self.metrics.measure(f"worker.invoke_scheduler.{ev.type}"):
-                self.invoke_scheduler(ev, token)
-            self.broker.ack(ev.id, token)
-        except Exception as exc:
-            self.logger.exception("eval %s failed; nacking", ev.id)
-            self.record_eval_failure(ev, exc)
+        # Branch on the tracer before building attrs: delivery_attempts
+        # takes the broker lock, which the disarmed path must not pay.
+        tr = tracing.TRACER
+        attempt_span = tracing.NOOP if tr is None else tr.span(
+            "worker.attempt", eval_id=ev.id, eval_type=ev.type,
+            attempt=self.broker.delivery_attempts(ev.id))
+        unsuffixed = (self.metrics if self.unsuffixed_invoke_sample
+                      else NULL_TELEMETRY)
+        with attempt_span as sp:
             try:
-                self.broker.nack(ev.id, token)
-            except EvalBrokerError:
-                pass
+                with self.metrics.measure("worker.wait_for_index"), \
+                        tracing.span("worker.wait_for_index"):
+                    self.wait_for_index(ev.modify_index, RAFT_SYNC_LIMIT)
+                with unsuffixed.measure("worker.invoke_scheduler"), \
+                        self.metrics.measure(
+                            f"worker.invoke_scheduler.{ev.type}"), \
+                        tracing.span("worker.invoke_scheduler"):
+                    self.invoke_scheduler(ev, token)
+                self.broker.ack(ev.id, token)
+            except Exception as exc:
+                self.logger.exception("eval %s failed; nacking", ev.id)
+                sp.set(nack_reason=f"{type(exc).__name__}: {exc}")
+                self.record_eval_failure(ev, exc)
+                try:
+                    self.broker.nack(ev.id, token)
+                except EvalBrokerError:
+                    pass
 
     def record_eval_failure(self, ev: s.Evaluation, exc: Exception) -> None:
         self.record_eval_failures([ev], exc)
@@ -259,6 +286,10 @@ class BatchWorker(Worker):
     'tpu-system' pass; core evals stay on the oracle path.
     """
 
+    # Batch invocations own the unsuffixed worker.invoke_scheduler key
+    # (see Worker.unsuffixed_invoke_sample).
+    unsuffixed_invoke_sample = False
+
     def __init__(self, *args, max_batch: int = 64, mesh=None, **kwargs):
         super().__init__(*args, **kwargs)
         self.max_batch = max_batch
@@ -300,8 +331,20 @@ class BatchWorker(Worker):
                 self.process_eval(ev, token)
 
     def process_batch(self, batch: List[Tuple[s.Evaluation, str]]) -> None:
+        tr = tracing.TRACER
+        if tr is None:
+            self._process_batch(batch)
+            return
+        with tr.span("worker.process_batch",
+                     num_evals=len(batch),
+                     **tracing.eval_id_attrs(
+                         (ev for ev, _ in batch), len(batch))):
+            self._process_batch(batch)
+
+    def _process_batch(self, batch: List[Tuple[s.Evaluation, str]]) -> None:
         max_index = max(ev.modify_index for ev, _ in batch)
-        self.wait_for_index(max_index, RAFT_SYNC_LIMIT)
+        with tracing.span("worker.wait_for_index"):
+            self.wait_for_index(max_index, RAFT_SYNC_LIMIT)
         snapshot_index = self.raft.applied_index()
         snap = self.raft.fsm.state.snapshot()
 
@@ -335,19 +378,48 @@ class BatchWorker(Worker):
                 p.reblock_eval(ev)
 
         mux = _MuxPlanner(self, batch)
-        sched = TPUBatchScheduler(self.logger, snap, mux, mesh=self.mesh)
+        sched = TPUBatchScheduler(self.logger, snap, mux, mesh=self.mesh,
+                                  metrics=self.metrics)
+        tr = tracing.TRACER
+        # Attempt numbers belong to THIS delivery, so capture them before
+        # scheduling: a nack-timeout firing mid-batch redelivers the eval
+        # and bumps the counter, and reading it afterwards would stamp
+        # this delivery's marker with the next delivery's number.
+        attempts = {} if tr is None else {
+            ev.id: self.broker.delivery_attempts(ev.id)
+            for ev, _ in batch}
         try:
             sched.schedule_batch([ev for ev, _ in batch])
-            for ev, token in batch:
-                try:
-                    self.broker.ack(ev.id, token)
-                except EvalBrokerError:
-                    pass
         except Exception as exc:
             self.logger.exception("batch scheduling failed; nacking batch")
             self.record_eval_failures([ev for ev, _ in batch], exc)
             for ev, token in batch:
+                if tr is not None:
+                    # Per-eval attempt marker with the nack reason: the
+                    # batch path's twin of the worker.attempt span, so a
+                    # redelivered eval's trace explains every burn.
+                    tr.event("worker.attempt", eval_id=ev.id,
+                             attempt=attempts[ev.id],
+                             nack_reason=f"{type(exc).__name__}: {exc}")
                 try:
                     self.broker.nack(ev.id, token)
                 except EvalBrokerError:
                     pass
+            return
+        for ev, token in batch:
+            try:
+                self.broker.ack(ev.id, token)
+            except EvalBrokerError as exc:
+                # The delivery burned anyway (typically a nack timeout
+                # redelivered the eval mid-batch) — the marker must say
+                # so, not read as a clean success.
+                if tr is not None:
+                    tr.event("worker.attempt", eval_id=ev.id,
+                             attempt=attempts[ev.id],
+                             nack_reason=f"ack failed: {exc}")
+            else:
+                if tr is not None:
+                    # One worker.attempt marker per delivery, same as the
+                    # per-eval Worker's span.
+                    tr.event("worker.attempt", eval_id=ev.id,
+                             attempt=attempts[ev.id])

@@ -59,8 +59,9 @@ def cpu_profile(seconds: float = 1.0, sort: str = "cumulative",
                 first = True
                 while f is not None and depth < 64:
                     code = f.f_code
+                    # co_qualname is 3.11+; co_name on older runtimes
                     key = (code.co_filename, code.co_firstlineno,
-                           code.co_qualname)
+                           getattr(code, "co_qualname", code.co_name))
                     inclusive[key] = inclusive.get(key, 0) + 1
                     if first:
                         leaf[key] = leaf.get(key, 0) + 1

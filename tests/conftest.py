@@ -23,6 +23,58 @@ jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import pytest  # noqa: E402
+
+# -- chaos trace dumps --------------------------------------------------------
+# Chaos scenarios (`@pytest.mark.chaos`) run with the eval-lifecycle
+# tracing plane armed; when one fails — the probabilistic sweeps fail
+# rarely and only under particular seeds — the recent span timeline is
+# dumped (bounded) to stderr so the failure is diagnosable from the
+# pytest log alone, without re-running the seed locally.
+
+CHAOS_DUMP_SPANS = 120
+
+
+@pytest.fixture(autouse=True)
+def _chaos_tracing(request):
+    if request.node.get_closest_marker("chaos") is None:
+        yield
+        return
+    from nomad_tpu.utils import tracing
+
+    tracing.enable()
+    yield
+    tracing.disable()
+
+
+def _format_trace(spans):
+    t0 = min(sp["Start"] for sp in spans)
+    lines = []
+    for sp in spans:
+        lines.append(
+            "  +{:10.2f}ms {:9.2f}ms  {:<26} {}".format(
+                (sp["Start"] - t0) * 1000.0, sp["DurationMs"],
+                sp["Name"], sp["Attrs"]))
+    return "\n".join(lines)
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    rep = outcome.get_result()
+    # After the call phase, before fixture teardown disarms the tracer.
+    if (rep.when == "call" and rep.failed
+            and item.get_closest_marker("chaos") is not None):
+        from nomad_tpu.utils import tracing
+
+        spans = tracing.recent(CHAOS_DUMP_SPANS)
+        print(f"\n-- chaos trace timeline for {item.nodeid} "
+              f"(last {len(spans)} spans) --", file=sys.__stderr__)
+        if spans:
+            print(_format_trace(spans), file=sys.__stderr__)
+        else:
+            print("  (no spans recorded)", file=sys.__stderr__)
+
 
 def dev_test_config():
     """AgentConfig.dev() with an ephemeral HTTP port: dev() binds the

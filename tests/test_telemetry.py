@@ -7,7 +7,8 @@ import conftest
 from nomad_tpu import mock
 from nomad_tpu.server import Server, ServerConfig
 from nomad_tpu.structs import structs as s
-from nomad_tpu.utils.telemetry import InmemSink, Telemetry
+from nomad_tpu.utils.telemetry import (EXACT_WINDOW, InmemSink, Telemetry,
+                                       _Histogram, render_prometheus)
 
 
 def wait_until(pred, timeout=20.0, interval=0.05):
@@ -50,6 +51,157 @@ class TestSink:
             time.sleep(0.06)
         data = sink.data()
         assert len(data) <= 3
+
+
+class TestHistogramPercentiles:
+    def test_small_n_quantiles_are_exact(self):
+        h = _Histogram()
+        for v in range(1, 101):  # 1..100, well inside the exact window
+            h.add(float(v))
+        assert h.percentile(0.50) == 51.0
+        assert h.percentile(0.95) == 96.0
+        assert h.percentile(0.99) == 100.0
+
+    def test_large_n_quantiles_bounded_by_bucket_width(self):
+        h = _Histogram()
+        n = EXACT_WINDOW * 8  # force the bucketed estimator
+        for i in range(n):
+            h.add(100.0 * (i + 1) / n)  # uniform on (0, 100]
+        # true p50/p95 are 50/95; the containing buckets are (25, 50]
+        # and (50, 100], so the estimate may be off by a bucket width
+        # but must stay inside the containing bucket's bounds.
+        assert 25.0 <= h.percentile(0.50) <= 50.0
+        assert 50.0 <= h.percentile(0.95) <= 100.0
+        # quantiles never escape the observed range
+        assert h.min <= h.percentile(0.01) <= h.percentile(0.99) <= h.max
+
+    def test_summary_carries_quantiles_through_sink(self):
+        sink = InmemSink(interval=60.0)
+        t = Telemetry(sink)
+        for v in (1.0, 2.0, 3.0, 4.0, 100.0):
+            t.add_sample("plan.evaluate", v)
+        samp = sink.latest()["Samples"]["nomad.plan.evaluate"]
+        for q in ("p50", "p95", "p99"):
+            assert q in samp
+        assert samp["p50"] == 3.0
+        assert samp["p99"] == 100.0
+
+    def test_empty_histogram_percentiles(self):
+        h = _Histogram()
+        assert h.percentile(0.5) == 0.0
+
+
+def parse_prometheus(text):
+    """Parse exposition text into {name: value} + {name: type}; quantile
+    series keep their label in the key (`name{quantile="0.5"}`)."""
+    values, types = {}, {}
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, typ = line.split()
+            types[name] = typ
+            continue
+        assert not line.startswith("#"), line
+        key, val = line.rsplit(" ", 1)
+        values[key] = float(val)
+    return values, types
+
+
+class TestPrometheusRendering:
+    def test_render_gauges_counters_summaries(self):
+        sink = InmemSink(interval=60.0)
+        t = Telemetry(sink)
+        t.set_gauge("broker.total_ready", 3)
+        t.incr_counter("rpc.request", 2)
+        t.incr_counter("rpc.request", 1)
+        for v in (5.0, 10.0, 15.0):
+            t.add_sample("plan.evaluate", v)
+        values, types = parse_prometheus(render_prometheus(sink.latest()))
+
+        assert values["nomad_broker_total_ready"] == 3.0
+        assert types["nomad_broker_total_ready"] == "gauge"
+        assert values["nomad_rpc_request_total"] == 3.0
+        assert types["nomad_rpc_request_total"] == "counter"
+        assert types["nomad_plan_evaluate"] == "summary"
+        assert values['nomad_plan_evaluate{quantile="0.5"}'] == 10.0
+        assert values["nomad_plan_evaluate_sum"] == 30.0
+        assert values["nomad_plan_evaluate_count"] == 3.0
+
+    def test_counters_and_sample_totals_monotonic_across_rolls(self):
+        """Scrapers need monotonic series: counter totals and summary
+        _sum/_count must accumulate across interval rolls even though
+        the interval aggregates reset."""
+        sink = InmemSink(interval=0.05, retain=2)
+        t = Telemetry(sink)
+        t.incr_counter("rpc.request", 5)
+        t.add_sample("plan.evaluate", 10.0)
+        time.sleep(0.07)  # force an interval roll
+        t.incr_counter("rpc.request", 2)
+        t.add_sample("plan.evaluate", 30.0)
+        values, _ = parse_prometheus(render_prometheus(sink.latest()))
+        assert values["nomad_rpc_request_total"] == 7.0
+        assert values["nomad_plan_evaluate_count"] == 2.0
+        assert values["nomad_plan_evaluate_sum"] == 40.0
+        # the quantile estimate itself is interval-local (newest only)
+        assert values['nomad_plan_evaluate{quantile="0.5"}'] == 30.0
+        # a key whose interval rolled quiet keeps its _sum/_count series
+        time.sleep(0.07)
+        sink.set_gauge("g", 1)  # rolls the interval; no fresh samples
+        values, _ = parse_prometheus(render_prometheus(sink.latest()))
+        assert values["nomad_plan_evaluate_count"] == 2.0
+        assert values["nomad_plan_evaluate_sum"] == 40.0
+        assert 'nomad_plan_evaluate{quantile="0.5"}' not in values
+
+    def test_metric_names_sanitized(self):
+        sink = InmemSink(interval=60.0)
+        sink.set_gauge("worker.invoke_scheduler._core", 1.0)
+        values, _ = parse_prometheus(render_prometheus(sink.latest()))
+        assert values["worker_invoke_scheduler__core"] == 1.0
+
+    def test_http_prometheus_endpoint(self):
+        """Acceptance: /v1/metrics?format=prometheus serves valid
+        exposition including p50/p95/p99 for nomad.plan.evaluate and
+        nomad.worker.invoke_scheduler, plus the broker gauges."""
+        import urllib.request
+
+        from nomad_tpu.agent.agent import Agent
+
+        cfg = conftest.dev_test_config()
+        cfg.client.enabled = False
+        agent = Agent(cfg)
+        agent.start()
+        try:
+            # Quantiles render from the newest sink interval only; stretch
+            # it so a slow CI box can't roll the scheduling samples out of
+            # the window before the scrape below.
+            agent.server.metrics.sink.interval = 3600.0
+            node = mock.node()
+            node.resources.networks = []
+            node.reserved.networks = []
+            agent.server.node_register(node)
+            job = mock.job()
+            for t in job.task_groups[0].tasks:
+                t.resources.networks = []
+            agent.server.job_register(job)
+            assert wait_until(lambda: agent.server.state.allocs_by_job(
+                None, job.id, True))
+            assert wait_until(lambda: "nomad.broker.total_ready"
+                              in agent.server.metrics.sink.latest()["Gauges"])
+
+            with urllib.request.urlopen(
+                    agent.http.address
+                    + "/v1/metrics?format=prometheus") as resp:
+                assert resp.headers["Content-Type"].startswith("text/plain")
+                values, types = parse_prometheus(resp.read().decode())
+
+            assert "nomad_broker_total_ready" in values
+            for base in ("nomad_plan_evaluate",
+                         "nomad_worker_invoke_scheduler"):
+                assert types[base] == "summary"
+                for q in ("0.5", "0.95", "0.99"):
+                    assert f'{base}{{quantile="{q}"}}' in values, (base, q)
+                assert values[f"{base}_count"] >= 1.0
+        finally:
+            agent.shutdown()
 
 
 class TestServerEmitters:

@@ -1,0 +1,280 @@
+"""Eval-lifecycle tracing plane (nomad_tpu/utils/tracing.py): span
+mechanics, the end-to-end trace of an eval through the TPU batch
+pipeline, the HTTP query surface, and the chaos-correlation contract
+(nack-redelivered evals show per-attempt spans with the nack reason)."""
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import conftest
+
+from nomad_tpu import fault, mock
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.structs import structs as s
+from nomad_tpu.utils import tracing
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracer():
+    """Every test gets its own armed store; nothing leaks into tier-1."""
+    tracing.enable()
+    yield
+    tracing.disable()
+    fault.disarm()
+
+
+def wait_until(predicate, timeout=60.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def make_node():
+    n = mock.node()
+    n.resources.networks = []
+    n.reserved.networks = []
+    return n
+
+
+def make_job(count=2):
+    j = mock.job()
+    j.task_groups[0].count = count
+    for t in j.task_groups[0].tasks:
+        t.resources.networks = []
+    return j
+
+
+class TestTracerMechanics:
+    def test_disabled_is_inert(self):
+        tracing.disable()
+        assert not tracing.enabled()
+        with tracing.span("anything", eval_id="e1") as sp:
+            sp.set(k="v")  # the no-op singleton tolerates attrs
+        tracing.event("thing", eval_id="e1")
+        tracing.record("thing", 0.0, 1.0, eval_id="e1")
+        assert tracing.recent(10) == []
+        assert tracing.trace_for_eval("e1") == []
+
+    def test_nesting_parents_and_eval_inheritance(self):
+        with tracing.span("outer", eval_id="e1") as outer:
+            with tracing.span("inner") as inner:
+                pass
+            tracing.event("marker")
+        spans = tracing.trace_for_eval("e1")
+        by_name = {sp["Name"]: sp for sp in spans}
+        # children inherit the eval id and parent pointer
+        assert set(by_name) == {"outer", "inner", "marker"}
+        assert by_name["inner"]["ParentID"] == by_name["outer"]["SpanID"]
+        assert by_name["marker"]["ParentID"] == by_name["outer"]["SpanID"]
+        assert by_name["outer"]["ParentID"] == 0
+        for sp in spans:
+            assert sp["End"] >= sp["Start"]
+
+    def test_batch_eval_ids_index_under_every_member(self):
+        with tracing.span("batch", eval_ids=["a", "b"]):
+            pass
+        assert [sp["Name"] for sp in tracing.trace_for_eval("a")] == ["batch"]
+        assert [sp["Name"] for sp in tracing.trace_for_eval("b")] == ["batch"]
+
+    def test_eval_ids_capped_per_span(self):
+        ids = [f"e{i}" for i in range(200)]
+        with tracing.span("batch", eval_ids=ids):
+            pass
+        (sp,) = tracing.trace_for_eval("e0")
+        assert len(sp["Attrs"]["eval_ids"]) == tracing.MAX_EVAL_IDS_PER_SPAN
+        assert sp["Attrs"]["eval_ids_elided"] == 200 - \
+            tracing.MAX_EVAL_IDS_PER_SPAN
+        # ids past the cap are not indexed; ids within it are
+        assert tracing.trace_for_eval("e199") == []
+        assert tracing.trace_for_eval(
+            f"e{tracing.MAX_EVAL_IDS_PER_SPAN - 1}")
+
+    def test_exception_recorded_on_span(self):
+        with pytest.raises(ValueError):
+            with tracing.span("boom", eval_id="e2"):
+                raise ValueError("kapow")
+        (sp,) = tracing.trace_for_eval("e2")
+        assert sp["Attrs"]["error"] == "ValueError"
+        assert "kapow" in sp["Attrs"]["error_detail"]
+
+    def test_store_is_bounded(self):
+        tr = tracing.enable(capacity=32, max_evals=4)
+        for i in range(100):
+            tr.event("tick", eval_id=f"e{i}")
+        assert len(tr.recent(1000)) <= 32
+        # LRU eval index: only the newest ids are retained
+        assert tracing.trace_for_eval("e0") == []
+        assert tracing.trace_for_eval("e99")
+
+    def test_fault_fire_correlation(self):
+        with fault.scenario({"seed": 3, "faults": [
+                {"point": "heartbeat.deliver", "action": "drop",
+                 "times": 1}]}):
+            with tracing.span("lifecycle", eval_id="e3"):
+                fault.faultpoint("heartbeat.deliver", node_id="n1")
+        spans = tracing.trace_for_eval("e3")
+        fires = [sp for sp in spans if sp["Name"] == "fault.fire"]
+        assert len(fires) == 1
+        assert fires[0]["Attrs"] == {"point": "heartbeat.deliver",
+                                     "rule": 0, "action": "drop",
+                                     "eval_id": "e3"}
+
+
+class TestEvalLifecycleTrace:
+    def test_single_eval_batch_pipeline_trace(self):
+        """Acceptance: one eval through TPUBatchScheduler yields a
+        queryable trace covering enqueue → dequeue → batch phases →
+        plan-submit → apply, with monotonic timestamps."""
+        srv = Server(ServerConfig(num_schedulers=1,
+                                  use_tpu_batch_worker=True,
+                                  batch_size=8))
+        srv.start()
+        try:
+            for _ in range(3):
+                srv.node_register(make_node())
+            job = make_job(2)
+            _, eval_id = srv.job_register(job)
+            assert wait_until(
+                lambda: srv.state.eval_by_id(None, eval_id) is not None
+                and srv.state.eval_by_id(None, eval_id).status
+                == s.EVAL_STATUS_COMPLETE, timeout=30.0)
+            assert wait_until(
+                lambda: len(srv.state.allocs_by_job(None, job.id, True))
+                == 2, timeout=30.0)
+            # the ack event lands just after the status write — wait for it
+            assert wait_until(
+                lambda: any(sp["Name"] == "broker.ack"
+                            for sp in tracing.trace_for_eval(eval_id)),
+                timeout=10.0)
+
+            spans = tracing.trace_for_eval(eval_id)
+            names = [sp["Name"] for sp in spans]
+            for expected in ("broker.enqueue", "broker.dequeue",
+                             "batch.schedule", "batch.phase1",
+                             "batch.finalize", "worker.submit_plan",
+                             "plan.evaluate", "plan.apply", "broker.ack"):
+                assert expected in names, (expected, names)
+            by_name = {sp["Name"]: sp for sp in spans}
+            # timestamps are monotonic along the lifecycle ordering
+            order = ["broker.enqueue", "broker.dequeue", "batch.schedule",
+                     "worker.submit_plan", "plan.evaluate", "plan.apply"]
+            starts = [by_name[n]["Start"] for n in order]
+            assert starts == sorted(starts), list(zip(order, starts))
+            for sp in spans:
+                assert sp["End"] >= sp["Start"]
+            # phases are parented under the batch.schedule root
+            root = by_name["batch.schedule"]["SpanID"]
+            assert by_name["batch.phase1"]["ParentID"] == root
+            assert by_name["batch.finalize"]["ParentID"] == root
+        finally:
+            srv.shutdown()
+
+
+class TestTraceHTTP:
+    def test_trace_endpoints(self):
+        from nomad_tpu.agent.agent import Agent
+
+        cfg = conftest.dev_test_config()
+        cfg.client.enabled = False
+        agent = Agent(cfg)
+        agent.start()
+        try:
+            agent.server.node_register(make_node())
+            job = make_job(1)
+            _, eval_id = agent.server.job_register(job)
+            assert wait_until(
+                lambda: agent.server.state.allocs_by_job(None, job.id,
+                                                         True), timeout=30.0)
+            assert wait_until(
+                lambda: tracing.trace_for_eval(eval_id), timeout=10.0)
+
+            with urllib.request.urlopen(
+                    agent.http.address + f"/v1/trace/eval/{eval_id}") as r:
+                body = json.loads(r.read())
+            assert body["EvalID"] == eval_id
+            assert any(sp["Name"] == "broker.enqueue"
+                       for sp in body["Spans"])
+            assert all("DurationMs" in sp for sp in body["Spans"])
+
+            with urllib.request.urlopen(
+                    agent.http.address + "/v1/traces?recent=5") as r:
+                body = json.loads(r.read())
+            assert body["Enabled"] is True
+            assert 0 < len(body["Spans"]) <= 5
+
+            # unknown eval → 404
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    agent.http.address + "/v1/trace/eval/nope")
+            assert exc.value.code == 404
+        finally:
+            agent.shutdown()
+
+    def test_traces_endpoint_reports_disabled(self):
+        from nomad_tpu.agent.agent import Agent
+
+        tracing.disable()
+        cfg = conftest.dev_test_config()
+        cfg.client.enabled = False
+        agent = Agent(cfg)
+        agent.start()
+        try:
+            with urllib.request.urlopen(
+                    agent.http.address + "/v1/traces") as r:
+                body = json.loads(r.read())
+            assert body == {"Enabled": False, "Spans": []}
+        finally:
+            agent.shutdown()
+
+
+@pytest.mark.chaos
+class TestChaosTraceCorrelation:
+    def test_nack_redelivery_shows_two_attempts_with_reason(self):
+        """A plan-apply crash burns delivery attempt 1; the broker
+        redelivers and attempt 2 completes.  The eval's trace must show
+        BOTH worker attempt spans, the first carrying the nack reason."""
+        srv = Server(ServerConfig(num_schedulers=1))
+        srv.eval_broker.initial_nack_delay = 0.1
+        srv.start()
+        try:
+            for _ in range(3):
+                srv.node_register(make_node())
+            fault.arm({"seed": 21, "faults": [
+                {"point": "plan.apply", "action": "crash", "times": 1}]})
+            job = make_job(2)
+            _, eval_id = srv.job_register(job)
+            assert wait_until(
+                lambda: srv.state.eval_by_id(None, eval_id).status
+                == s.EVAL_STATUS_COMPLETE, timeout=30.0)
+            assert fault.trace() == [("plan.apply", 0, "crash")]
+            # attempt spans finish just after the status write
+            assert wait_until(
+                lambda: sum(sp["Name"] == "worker.attempt"
+                            for sp in tracing.trace_for_eval(eval_id))
+                >= 2, timeout=10.0)
+
+            spans = tracing.trace_for_eval(eval_id)
+            attempts = [sp for sp in spans
+                        if sp["Name"] == "worker.attempt"]
+            assert len(attempts) == 2, [sp["Name"] for sp in spans]
+            attempts.sort(key=lambda sp: sp["Start"])
+            assert attempts[0]["Attrs"]["attempt"] == 1
+            assert attempts[1]["Attrs"]["attempt"] == 2
+            assert "InjectedFault" in attempts[0]["Attrs"]["nack_reason"]
+            assert "nack_reason" not in attempts[1]["Attrs"]
+            # the broker recorded the redelivery decision too
+            nacks = [sp for sp in spans if sp["Name"] == "broker.nack"]
+            assert len(nacks) == 1
+            assert nacks[0]["Attrs"]["outcome"] == "requeue"
+            # and the injected fault itself is correlated into the trace
+            fires = [sp for sp in spans if sp["Name"] == "fault.fire"]
+            assert len(fires) == 1
+            assert fires[0]["Attrs"]["point"] == "plan.apply"
+        finally:
+            srv.shutdown()
