@@ -1100,7 +1100,128 @@ def _read_partial(path: str) -> dict:
         return {}
 
 
+def _extract_baseline_numbers(doc: dict):
+    """(northstar_median_s, single_eval_p95_ms) from one BENCH_r*.json
+    trajectory doc.  Those files keep only a truncated tail of the bench
+    JSON line (and ``parsed`` is often null), so fall back to regexing
+    the decoded tail string."""
+    import re
+
+    ns = p95 = None
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict):
+        det = parsed.get("detail") or parsed
+        ns = (det.get("config_northstar_10k_x_1m") or {}).get("elapsed_s")
+        p95 = ((det.get("single_eval_latency_ms") or {})
+               .get("tpu_batch_worker") or {}).get("p95_ms")
+    tail = doc.get("tail") or ""
+    if ns is None:
+        m = re.search(r'"config_northstar_10k_x_1m":\s*\{[^{}]*?'
+                      r'"elapsed_s":\s*([0-9.]+)', tail)
+        ns = float(m.group(1)) if m else None
+    if p95 is None:
+        m = re.search(r'"single_eval_latency_ms":\s*\{"tpu_batch_worker":'
+                      r'\s*\{[^{}]*?"p95_ms":\s*([0-9.]+)', tail)
+        p95 = float(m.group(1)) if m else None
+    return ns, p95
+
+
+def _latest_bench_baseline():
+    """Newest BENCH_r*.json with parseable numbers → (name, ns_s, p95_ms)."""
+    import glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    for path in sorted(glob.glob(os.path.join(here, "BENCH_r*.json")),
+                       reverse=True):
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        ns, p95 = _extract_baseline_numbers(doc)
+        if ns is not None or p95 is not None:
+            return os.path.basename(path), ns, p95
+    return None, None, None
+
+
+CHECK_THRESHOLD_DEFAULT = 1.5
+
+
+def _check_main(argv) -> int:
+    """``python bench.py --check``: regression guard for the verify/CI
+    loop.  Re-measures the two primary metrics — north-star median
+    (config_northstar_10k_x_1m, median of 3) and interactive single-eval
+    p95 — and compares against the latest BENCH_r*.json trajectory
+    file.  Exits nonzero when either regresses past the threshold
+    (``--threshold 1.5`` = 50% slower, or
+    NOMAD_TPU_BENCH_CHECK_THRESHOLD), so perf regressions surface in
+    the loop instead of only in the next trajectory round.  Platform
+    note: thresholds compare like-for-like only when the baseline and
+    the check ran on the same backend; the emitted JSON records the
+    current platform for the reader."""
+    threshold = 0.0
+    for i, arg in enumerate(argv):
+        if arg == "--threshold" and i + 1 < len(argv):
+            threshold = float(argv[i + 1])
+        elif arg.startswith("--threshold="):
+            threshold = float(arg.split("=", 1)[1])
+    if not threshold:
+        threshold = float(os.environ.get(
+            "NOMAD_TPU_BENCH_CHECK_THRESHOLD", 0) or CHECK_THRESHOLD_DEFAULT)
+
+    baseline_file, base_ns, base_p95 = _latest_bench_baseline()
+    out = {"check": "bench-regression", "baseline": baseline_file,
+           "threshold": threshold}
+    if baseline_file is None:
+        out["result"] = ("skipped: no BENCH_r*.json baseline with "
+                         "parseable numbers")
+        print(json.dumps(out), flush=True)
+        return 0
+
+    import jax
+    out["platform"] = jax.devices()[0].platform
+    failures = []
+    if base_ns is not None:
+        try:
+            with _deadline(240, "check_northstar"):
+                _rate, det = run_config(N_NODES, NS_N_JOBS, COUNT_PER_JOB,
+                                        "config-northstar", trials=3)
+            cur = float(det["elapsed_s"])
+            out["northstar_median_s"] = {
+                "baseline": base_ns, "current": cur,
+                "ratio": round(cur / base_ns, 3)}
+            if cur > base_ns * threshold:
+                failures.append(
+                    f"north-star median {cur:.3f}s exceeds "
+                    f"{threshold}x baseline {base_ns:.3f}s")
+        except Exception as exc:
+            out["northstar_median_s"] = {"error": repr(exc)}
+            failures.append(f"north-star phase failed: {exc!r}")
+    if base_p95 is not None:
+        try:
+            with _deadline(180, "check_single_eval"):
+                lat = bench_single_eval_latency()
+            cur95 = float(lat["tpu_batch_worker"]["p95_ms"])
+            out["single_eval_p95_ms"] = {
+                "baseline": base_p95, "current": cur95,
+                "ratio": round(cur95 / base_p95, 3)}
+            if cur95 > base_p95 * threshold:
+                failures.append(
+                    f"single-eval p95 {cur95:.2f}ms exceeds "
+                    f"{threshold}x baseline {base_p95:.2f}ms")
+        except Exception as exc:
+            out["single_eval_p95_ms"] = {"error": repr(exc)}
+            failures.append(f"single-eval phase failed: {exc!r}")
+
+    out["failures"] = failures
+    out["result"] = "fail" if failures else "ok"
+    print(json.dumps(out), flush=True)
+    return 1 if failures else 0
+
+
 def main():
+    if "--check" in sys.argv[1:]:
+        sys.exit(_check_main(sys.argv[1:]))
     if os.environ.get(CHILD_ENV) == "1":
         sys.exit(_child_main())
 

@@ -10,7 +10,7 @@ from typing import Dict, List, Optional
 from ..client import Client, ClientConfig
 from ..server.server import Server, ServerConfig
 from .. import __version__ as VERSION
-from .config import AgentConfig
+from .config import AgentConfig, split_host_port
 from .http import HTTPServer
 
 
@@ -71,18 +71,25 @@ class Agent:
         if not self.config.server.enabled:
             return
         sb = self.config.server
+        # Advertise resolution (agent.go:336 + config.go AdvertiseAddrs):
+        # an explicit advertise.rpc wins (port defaulting to ports.rpc),
+        # else the (per-service or global) bind address.
+        rpc_bind = self.config.addresses.rpc or self.config.bind_addr
+        adv_host, adv_port = split_host_port(
+            self.config.advertise.rpc or rpc_bind, self.config.ports.rpc)
+        rpc_advertise = f"{adv_host}:{adv_port}"
         scfg = ServerConfig(
             region=self.config.region,
             datacenter=self.config.datacenter,
             node_name=self.config.name or "server-1",
-            rpc_advertise=f"{self.config.bind_addr}:{self.config.ports.rpc}",
+            rpc_advertise=rpc_advertise,
             data_dir=sb.data_dir or (
                 "" if self.config.dev_mode else self.config.data_dir),
             # Server agents always listen on ports.rpc (agent.go:336
             # setupServer → server.go:250 setupRPC); dev mode takes an
             # ephemeral port.
             enable_rpc=True,
-            rpc_bind=self.config.bind_addr,
+            rpc_bind=rpc_bind,
             rpc_port=0 if self.config.dev_mode else self.config.ports.rpc,
             bootstrap_expect=sb.bootstrap_expect,
             start_join=list(sb.start_join),
@@ -156,11 +163,13 @@ class Agent:
         # Bind HTTP first: the client advertises its HTTP address on the
         # node (structs Node.HTTPAddr) so peers can pull sticky-disk
         # snapshots from it (client.go:1743 migrateRemoteAllocDir).
-        self.http = HTTPServer(self, host=self.config.bind_addr,
+        self.http = HTTPServer(self,
+                               host=(self.config.addresses.http
+                                     or self.config.bind_addr),
                                port=self.config.ports.http)
         if self.client is not None:
-            self.client.node.http_addr = (
-                f"{_advertisable(self.config.bind_addr)}:{self.http.port}")
+            host, port = self._http_advertise()
+            self.client.node.http_addr = f"{host}:{port}"
         if self.server is not None:
             self.server.start()
         if self.client is not None:
@@ -175,10 +184,20 @@ class Agent:
             self.consul_service_client.register_agent(
                 "server", _advertisable(host), int(port), tags=["rpc"])
         if self.client is not None:
+            host, port = self._http_advertise()
             self.consul_service_client.register_agent(
-                "client", _advertisable(self.config.bind_addr),
-                self.http.port, tags=["http"])
+                "client", host, port, tags=["http"])
         self.logger.info("agent: started (http=%s)", self.http.address)
+
+    def _http_advertise(self) -> tuple:
+        """(host, port) peers are told to dial for this agent's HTTP API:
+        ``advertise { http }`` (NAT/multi-homed override, optionally with
+        its own port) > ``addresses { http }`` (the bind) > bind_addr
+        (agent.go advertise-address resolution order)."""
+        adv = self.config.advertise.http or \
+            self.config.addresses.http or self.config.bind_addr
+        host, port = split_host_port(adv, self.http.port)
+        return _advertisable(host), port
 
     def shutdown(self) -> None:
         self.logger.removeHandler(self.log_ring)

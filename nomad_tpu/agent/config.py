@@ -8,7 +8,7 @@ from __future__ import annotations
 
 import json
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..jobspec.hcl import Block, parse_hcl
 
@@ -89,6 +89,28 @@ class VaultBlock:
 
 
 @dataclass
+class AddressesBlock:
+    """Per-service bind overrides (config.go Addresses): empty fields
+    fall back to bind_addr.  Values accept go-sockaddr templates."""
+
+    http: str = ""
+    rpc: str = ""
+    serf: str = ""
+
+
+@dataclass
+class AdvertiseBlock:
+    """Per-service advertise addresses (config.go AdvertiseAddrs):
+    what peers/clients are told to dial, which may differ from the bind
+    (NAT, multi-homed hosts).  Values accept go-sockaddr templates,
+    optionally with a ``:port`` suffix."""
+
+    http: str = ""
+    rpc: str = ""
+    serf: str = ""
+
+
+@dataclass
 class AgentConfig:
     region: str = "global"
     datacenter: str = "dc1"
@@ -98,6 +120,8 @@ class AgentConfig:
     bind_addr: str = "127.0.0.1"
     enable_debug: bool = False
     ports: Ports = field(default_factory=Ports)
+    addresses: AddressesBlock = field(default_factory=AddressesBlock)
+    advertise: AdvertiseBlock = field(default_factory=AdvertiseBlock)
     server: ServerBlock = field(default_factory=ServerBlock)
     client: ClientBlock = field(default_factory=ClientBlock)
     vault: VaultBlock = field(default_factory=VaultBlock)
@@ -226,6 +250,44 @@ def parse_ip_template(tmpl: str) -> str:
     raise ValueError(f"unsupported address template function {fn!r}")
 
 
+def split_host_port(addr: str, default_port: int) -> Tuple[str, int]:
+    """``host[:port]`` → ``(host, port)``, falling back to
+    ``default_port`` when the suffix is absent or non-numeric
+    (advertise values are full dial addresses, optionally without the
+    port) — the one splitter for every advertise/address consumer."""
+    host, sep, port = addr.rpartition(":")
+    if sep and port.isdigit():
+        return host, int(port)
+    return addr, default_port
+
+
+def resolve_addr_template(value: str) -> str:
+    """parse_ip_template over an address field that may carry a
+    ``:port`` suffix after the template (advertise blocks are full
+    dial addresses, e.g. ``{{ GetPrivateIP }}:4647``)."""
+    if "{{" not in value:
+        return value
+    host, port = split_host_port(value, -1)
+    if port >= 0 and "}}" in host:
+        return f"{parse_ip_template(host)}:{port}"
+    return parse_ip_template(value)
+
+
+def _resolve_address_fields(cfg: "AgentConfig") -> "AgentConfig":
+    """Run go-sockaddr template resolution over every address-valued
+    field — bind_addr plus the addresses{} and advertise{} blocks, in
+    BOTH the HCL and JSON paths (config_parse.go / config.go:787 does
+    the same; a templated advertise address must never pass through
+    literally and fail later at bind/gossip time)."""
+    cfg.bind_addr = parse_ip_template(cfg.bind_addr)
+    for blk in (cfg.addresses, cfg.advertise):
+        for field_name in ("http", "rpc", "serf"):
+            v = getattr(blk, field_name)
+            if v:
+                setattr(blk, field_name, resolve_addr_template(str(v)))
+    return cfg
+
+
 def _expand(v):
     """Env expansion on a parsed VALUE — recursive, so JSON configs with
     nested lists/maps (client.servers, client.meta) expand the same way
@@ -270,9 +332,7 @@ def parse_config(src: str) -> AgentConfig:
     config.go:787)."""
     src_stripped = src.lstrip()
     if src_stripped.startswith("{"):
-        cfg = _from_json(json.loads(src))
-        cfg.bind_addr = parse_ip_template(cfg.bind_addr)
-        return cfg
+        return _resolve_address_fields(_from_json(json.loads(src)))
     root = parse_hcl(src)
     cfg = AgentConfig()
     cfg.region = str(_scalar(root, "region", cfg.region))
@@ -280,8 +340,7 @@ def parse_config(src: str) -> AgentConfig:
     cfg.name = str(_scalar(root, "name", cfg.name))
     cfg.data_dir = str(_scalar(root, "data_dir", cfg.data_dir))
     cfg.log_level = str(_scalar(root, "log_level", cfg.log_level))
-    cfg.bind_addr = parse_ip_template(
-        str(_scalar(root, "bind_addr", cfg.bind_addr)))
+    cfg.bind_addr = str(_scalar(root, "bind_addr", cfg.bind_addr))
     cfg.enable_debug = bool(_scalar(root, "enable_debug", False))
 
     pe = root.one("ports")
@@ -289,6 +348,15 @@ def parse_config(src: str) -> AgentConfig:
         cfg.ports.http = int(_scalar(pe.value, "http", cfg.ports.http))
         cfg.ports.rpc = int(_scalar(pe.value, "rpc", cfg.ports.rpc))
         cfg.ports.serf = int(_scalar(pe.value, "serf", cfg.ports.serf))
+
+    for blk_key, target in (("addresses", cfg.addresses),
+                            ("advertise", cfg.advertise)):
+        be = root.one(blk_key)
+        if be is not None and isinstance(be.value, Block):
+            for k in ("http", "rpc", "serf"):
+                v = _scalar(be.value, k, "")
+                if v:
+                    setattr(target, k, str(v))
 
     se = root.one("server")
     if se is not None and isinstance(se.value, Block):
@@ -337,7 +405,7 @@ def parse_config(src: str) -> AgentConfig:
         cfg.vault.token = str(_scalar(vb, "token", ""))
         cfg.vault.task_token_ttl = str(_scalar(vb, "task_token_ttl", ""))
 
-    return cfg
+    return _resolve_address_fields(cfg)
 
 
 def _from_json(data: dict) -> AgentConfig:
@@ -350,7 +418,9 @@ def _from_json(data: dict) -> AgentConfig:
     for k in ("http", "rpc", "serf"):
         if k in ports:
             setattr(cfg.ports, k, int(ports[k]))
-    for blk_name, target in (("server", cfg.server), ("client", cfg.client)):
+    for blk_name, target in (("server", cfg.server), ("client", cfg.client),
+                             ("addresses", cfg.addresses),
+                             ("advertise", cfg.advertise)):
         blk = data.get(blk_name) or {}
         for k, v in blk.items():
             if hasattr(target, k):

@@ -128,6 +128,7 @@ class HTTPServer:
         r("/v1/catalog/services", self.catalog_services_request)
         r("/v1/catalog/service/(?P<name>[^/]+)", self.catalog_service_request)
         r("/v1/metrics", self.metrics_request)
+        r("/v1/event/stream", self.event_stream_request)
         r("/v1/traces", self.traces_request)
         r("/v1/trace/eval/(?P<id>[^/]+)", self.trace_eval_request)
         r("/v1/kv/(?P<key>.*)", self.kv_request)
@@ -709,6 +710,63 @@ class HTTPServer:
                 raise CodedError(400, "metrics sink has no interval data")
             return TextResponse(render_prometheus(sink.latest())), None
         return self.server.metrics.sink.data(), None
+
+    # -- cluster event stream (server/event_broker.py) -----------------
+
+    def event_stream_request(self, req, query):
+        """Chunked JSON-lines feed of cluster state-change events
+        (event_endpoint.go /v1/event/stream).
+
+        Query params:
+          ``topic=``  comma-separated ``Topic`` or ``Topic:key`` filters
+                      (default: every topic);
+          ``index=``  resume point — replays buffered events with raft
+                      index >= N, 400 with the oldest buffered index when
+                      N has already been evicted from the ring;
+          ``follow=`` ``false`` dumps the buffered backlog and closes
+                      (the forensic/CLI no-follow mode); default ``true``
+                      keeps streaming, emitting ``{}`` heartbeat lines
+                      while idle.
+        """
+        from ..server.event_broker import EventIndexError, parse_topic_filter
+
+        if req.command != "GET":
+            raise CodedError(405, "Invalid method")
+        topics = parse_topic_filter(query.get("topic", ""))
+        index = int(query.get("index", 0) or 0)
+        follow = query.get("follow", "true").lower() != "false"
+        # No-follow with no explicit index dumps whatever the ring still
+        # buffers — no gap check, since the consumer asked for "what you
+        # have", not "everything since N".
+        replay_all = not follow and index <= 0
+        try:
+            sub = self.server.event_stream_subscribe(topics=topics,
+                                                     from_index=index,
+                                                     replay_all=replay_all)
+        except EventIndexError as e:
+            raise CodedError(400, str(e))
+
+        def frames():
+            try:
+                while True:
+                    ev = sub.next(timeout=10.0 if follow else 0.05)
+                    if ev is not None:
+                        yield ev.to_wire_dict()
+                        continue
+                    if sub.closed:
+                        if sub.close_error:
+                            yield {"Error": sub.close_error}
+                        return
+                    if not follow:
+                        return  # backlog drained
+                    # Idle heartbeat: keeps the chunked stream alive and
+                    # makes a vanished consumer fail the next write so
+                    # the subscription is reaped.
+                    yield {}
+            finally:
+                sub.close()
+
+        return StreamResponse(frames()), None
 
     # -- eval-lifecycle tracing (utils/tracing.py) ---------------------
 

@@ -61,6 +61,7 @@ class NomadAPI:
         self.system = System(self)
         self.operator = Operator(self)
         self.status = Status(self)
+        self.events = Events(self)
 
     # -- raw transport -----------------------------------------------------
 
@@ -365,6 +366,57 @@ class AgentAPI:
         obj, _ = self.c.get(f"/v1/client/fs/stat/{alloc_id}",
                             QueryOptions(params={"path": path}))
         return obj or {}
+
+
+class Events:
+    """api/event.go (the 1.0 event stream consumer handle)."""
+
+    def __init__(self, c: NomadAPI):
+        self.c = c
+
+    def stream(self, topics: Optional[List[str]] = None, index: int = 0,
+               follow: bool = True):
+        """Consume /v1/event/stream: a generator of event dicts
+        ({Topic, Type, Key, Index, Payload, EvalID, SpanID, Wall}).
+        ``topics`` entries are ``Topic`` or ``Topic:key``; ``index``
+        resumes from a raft index (events with Index >= index replay
+        from the server's ring); ``follow=False`` drains the buffered
+        backlog and returns.  Idle-heartbeat frames (``{}``) are
+        filtered out.  An out-of-ring resume surfaces as APIError 400
+        carrying the oldest buffered index; an in-band server error
+        frame (e.g. the slow-subscriber shed) raises APIError too, so
+        every yielded value is a real event dict."""
+        params: Dict[str, str] = {
+            "follow": "true" if follow else "false"}
+        if topics:
+            params["topic"] = ",".join(topics)
+        if index:
+            params["index"] = str(index)
+        url = self.c._url("/v1/event/stream", QueryOptions(params=params))
+        req = urllib.request.Request(url)
+        try:
+            resp = urllib.request.urlopen(
+                req, timeout=None if follow else self.c.timeout)
+        except urllib.error.HTTPError as e:
+            raise APIError(e.code, e.read().decode("utf-8", "replace")) from e
+        except urllib.error.URLError as e:
+            raise APIError(0, f"failed to reach agent at "
+                              f"{self.c.address}: {e.reason}") from e
+        try:
+            for line in resp:
+                line = line.strip()
+                if not line:
+                    continue
+                event = json.loads(line)
+                if not event:
+                    continue  # idle heartbeat
+                if "Error" in event and "Topic" not in event:
+                    raise APIError(0, event["Error"])
+                yield event
+        except OSError as e:
+            raise APIError(0, f"event stream interrupted: {e}") from e
+        finally:
+            resp.close()
 
 
 class System:

@@ -705,6 +705,38 @@ def cmd_monitor(args, out) -> int:
         return 1
 
 
+def cmd_events(args, out) -> int:
+    """command/event.go-style follow mode over /v1/event/stream: one
+    line per cluster state-change event, with -topic filters and -index
+    resume.  -no-follow dumps the server's buffered backlog and exits
+    (incident forensics after the fact)."""
+    api = _api(args)
+    topics = list(args.topic or [])
+    try:
+        for ev in api.events.stream(topics=topics,
+                                    index=int(args.index or 0),
+                                    follow=not args.no_follow):
+            if getattr(args, "json", False):
+                out.write(json.dumps(ev) + "\n")
+            else:
+                extra = ""
+                if ev.get("EvalID"):
+                    extra = f" eval={limit(ev['EvalID'])}"
+                payload = ev.get("Payload") or {}
+                out.write(f"{ev.get('Index', 0):>8}  "
+                          f"{ev.get('Topic', '')}/{ev.get('Type', '')}  "
+                          f"{limit(ev.get('Key', ''))}{extra}  "
+                          f"{json.dumps(payload, sort_keys=True)}\n")
+            if hasattr(out, "flush"):
+                out.flush()
+    except APIError as e:
+        out.write(f"Error streaming events: {e}\n")
+        return 1
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def cmd_check(args, out) -> int:
     """command/check.go: agent health probe — exit 0 healthy, 1 not."""
     api = _api(args)
@@ -982,6 +1014,15 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("node"))
     add("keygen", cmd_keygen)
     add("agent-monitor", cmd_monitor)
+    add("events", cmd_events, lambda sp: (
+        sp.add_argument("-topic", action="append", default=[],
+                        help='filter: "Topic" or "Topic:key", repeatable'),
+        sp.add_argument("-index", type=int, default=0,
+                        help="resume from this raft index"),
+        sp.add_argument("-no-follow", dest="no_follow",
+                        action="store_true",
+                        help="dump the buffered backlog and exit"),
+        sp.add_argument("-json", dest="json", action="store_true")))
     add("check", cmd_check)
     add("keyring", cmd_keyring, lambda sp: (
         sp.add_argument("-data-dir", dest="data_dir", default=""),

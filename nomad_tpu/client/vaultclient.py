@@ -57,7 +57,14 @@ class ClientVaultClient:
                     raise RuntimeError(
                         "received a wrapped Vault token but no unwrap "
                         "transport is configured (vault_addr)")
-                unwrapped[task] = self.unwrap_fn(info["wrapped_token"])
+                plain = dict(self.unwrap_fn(info["wrapped_token"]))
+                if float(plain.get("ttl") or 0.0) <= 0.0:
+                    # The unwrap response omitted lease_duration: fall
+                    # back to the envelope's requested task-token TTL so
+                    # the renewal heap gets a real deadline instead of a
+                    # ttl=0 immediate-renewal churn loop.
+                    plain["ttl"] = float(info.get("ttl") or 0.0)
+                unwrapped[task] = plain
             else:
                 unwrapped[task] = info
         return unwrapped
@@ -65,7 +72,15 @@ class ClientVaultClient:
     # -- renewal heap (vaultclient.go renewal loop) ----------------------
 
     def renew_token(self, token: str, ttl: float) -> None:
-        """Track ``token`` for periodic renewal at ttl/2 cadence."""
+        """Track ``token`` for periodic renewal at ttl/2 cadence.
+        ``ttl <= 0`` is refused outright: a zero deadline would schedule
+        the token for immediate, never-ending renewal churn."""
+        if ttl <= 0:
+            self.logger.warning(
+                "vault: refusing to track token with non-positive ttl "
+                "%.1fs (missing lease_duration?); it will not be renewed",
+                ttl)
+            return
         if self.renew_fn is None:
             # Without a Vault transport the heap cannot actually renew —
             # say so instead of silently letting the token expire at TTL.

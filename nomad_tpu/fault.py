@@ -165,6 +165,11 @@ class FaultPlane:
             # fires are rare, and the hot disarmed path must not pay it.
             from .utils import tracing
             tracing.note_fault(name, rule.index, rule.action)
+            # ...and into the cluster event stream, so chaos forensics
+            # can interleave injections with the state changes they
+            # caused.
+            note_event_stream("Fault", "FaultFired", name,
+                              {"Rule": rule.index, "Action": rule.action})
             return FaultAction(rule)
         return None
 
@@ -178,6 +183,20 @@ class FaultPlane:
 # The single global the hot path reads.  ``None`` ⇒ disarmed ⇒ every
 # faultpoint() call is one load + one comparison.
 _PLANE: Optional[FaultPlane] = None
+
+
+def note_event_stream(topic: str, etype: str, key: str,
+                      payload: Optional[Dict[str, Any]] = None,
+                      eval_id: str = "") -> None:
+    """Mirror a cross-cutting occurrence (fault fire, breaker
+    transition) into the cluster event stream without importing the
+    server package: sys.modules — if event_broker was never loaded, no
+    broker can be armed anyway."""
+    import sys
+
+    mod = sys.modules.get("nomad_tpu.server.event_broker")
+    if mod is not None:
+        mod.note_external(topic, etype, key, payload, eval_id)
 
 
 def faultpoint(name: str, **ctx: Any) -> Optional[FaultAction]:

@@ -49,6 +49,12 @@ logger = logging.getLogger("nomad_tpu.ops.batch_sched")
 # telemetry introspection for the multi-slice path).
 MESH_PASSES = 0
 
+# Mesh passes that silently dropped per-node AllocMetric scores at a
+# scale where the single-chip path would have carried them; logged once,
+# exported as the batch.mesh_score_gap_passes gauge (ADVICE r5).
+MESH_SCORE_GAP_PASSES = 0
+_mesh_score_gap_logged = False
+
 # Static cluster-tensor cache: (nodes index, attr targets, literals,
 # with_networks) → finalized ClusterTensors (see _place_on_device).
 _CLUSTER_CACHE: Dict[Tuple, "encode.ClusterTensors"] = {}
@@ -321,6 +327,9 @@ class TPUBatchScheduler:
             m.add_sample("worker.invoke_scheduler.finalize",
                          stats.finalize_seconds * 1000.0)
         m.add_sample("worker.invoke_scheduler.asks", stats.num_asks)
+        if MESH_SCORE_GAP_PASSES:
+            m.set_gauge("batch.mesh_score_gap_passes",
+                        MESH_SCORE_GAP_PASSES)
         m.set_gauge("breaker.trips", self.breaker.trips)
         # Live breaker, not stats.breaker_state: batches that never reach
         # the breaker gate (empty spec_list) leave stats at the "closed"
@@ -889,6 +898,21 @@ class TPUBatchScheduler:
         for (j, nidx), v in jc_entries.items():
             jc[j, nidx] = v
         with_dp = any(sp.dp_target is not None for sp in spec_list)
+        # The sharded kernel returns placements without per-commit score
+        # side-outputs, so AllocMetric.scores stay empty on this path even
+        # at scales where the single-chip path would populate them
+        # (u_pad*n_pad <= 16M).  Make the gap observable: a one-time log
+        # plus a pass counter the telemetry bridge exports.
+        global MESH_SCORE_GAP_PASSES, _mesh_score_gap_logged
+        if u_pad * n_pad <= 16_000_000:
+            MESH_SCORE_GAP_PASSES += 1
+            if not _mesh_score_gap_logged:
+                _mesh_score_gap_logged = True
+                self.logger.warning(
+                    "mesh scheduling drops per-node AllocMetric scores "
+                    "(%d x %d would carry them on the single-chip path); "
+                    "counts stay exact, score forensics are unavailable "
+                    "while a device_mesh is configured", u_pad, n_pad)
 
         encode_seconds = time.monotonic() - t0
         t1 = time.monotonic()

@@ -31,6 +31,7 @@ import time
 from collections import deque
 from typing import Callable, Optional
 
+from .. import fault
 from ..utils import tracing
 
 logger = logging.getLogger("nomad_tpu.ops.breaker")
@@ -38,6 +39,13 @@ logger = logging.getLogger("nomad_tpu.ops.breaker")
 CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half-open"
+
+
+def _stream_transition(frm: str, to: str, **payload) -> None:
+    """Mirror a breaker transition into the cluster event stream
+    (fault.note_event_stream avoids importing the server package)."""
+    fault.note_event_stream("Breaker", "BreakerTransition", to,
+                            dict(payload, From=frm, To=to))
 
 # Numeric encoding for the `nomad.breaker.state` gauge (telemetry can
 # only carry numbers; 0 = healthy, rising = degraded).
@@ -103,6 +111,9 @@ class KernelCircuitBreaker:
                 self.trips += 1
                 tracing.event("breaker.transition", frm=CLOSED, to=OPEN,
                               agreement=round(ratio, 4), trips=self.trips)
+                _stream_transition(CLOSED, OPEN,
+                                   Agreement=round(ratio, 4),
+                                   Trips=self.trips)
                 logger.warning(
                     "kernel circuit breaker OPEN: agreement %.2f < %.2f "
                     "over %d checks; routing evals through the CPU oracle "
@@ -125,6 +136,7 @@ class KernelCircuitBreaker:
                 self._state = HALF_OPEN
                 self._probe_started = self.clock()
                 tracing.event("breaker.transition", frm=OPEN, to=HALF_OPEN)
+                _stream_transition(OPEN, HALF_OPEN)
                 logger.info("kernel circuit breaker HALF-OPEN: probing the "
                             "device path with one batch")
                 return True
@@ -149,12 +161,14 @@ class KernelCircuitBreaker:
                 self._state = CLOSED
                 self._checks.clear()
                 tracing.event("breaker.transition", frm=HALF_OPEN, to=CLOSED)
+                _stream_transition(HALF_OPEN, CLOSED)
                 logger.info("kernel circuit breaker CLOSED: probe batch "
                             "agreed; device path restored")
             else:
                 self._state = OPEN
                 self._tripped_at = self.clock()
                 tracing.event("breaker.transition", frm=HALF_OPEN, to=OPEN)
+                _stream_transition(HALF_OPEN, OPEN)
                 logger.warning("kernel circuit breaker RE-OPEN: probe batch "
                                "disagreed; staying on the CPU oracle")
 

@@ -54,6 +54,14 @@ class _Unack:
 
 
 class EvalBroker:
+    # Owning server's event broker, attached by Server.enable_event_stream.
+    # The broker is per-server (unlike the process-wide breaker/fault
+    # plane), so ack/nack events must not fan out through the global
+    # note_external hook: in multi-server processes that would mirror
+    # every server's evals onto every stream, stamped with the wrong
+    # applied index.  Disarmed cost: one attribute load + branch.
+    event_broker = None
+
     def __init__(
         self,
         nack_timeout: float = 60.0,
@@ -312,6 +320,13 @@ class EvalBroker:
                     tr.event("broker.ack", eval_id=eval_id, job_id=job_id,
                              attempts=self.evals.get(eval_id, 0))
                 self.metrics.incr_counter("broker.ack")
+                eb = self.event_broker
+                if eb is not None:
+                    eb.publish_external(
+                        "Eval", "EvalAcked", eval_id,
+                        {"JobID": job_id,
+                         "Attempts": self.evals.get(eval_id, 0)},
+                        eval_id=eval_id)
 
                 del self.unack[eval_id]
                 self.evals.pop(eval_id, None)
@@ -358,6 +373,12 @@ class EvalBroker:
                          job_id=unack.eval.job_id, attempts=dequeues,
                          outcome=outcome, wait=wait)
             self.metrics.incr_counter("broker.nack")
+            eb = self.event_broker
+            if eb is not None:
+                eb.publish_external(
+                    "Eval", "EvalNacked", eval_id,
+                    {"JobID": unack.eval.job_id, "Attempts": dequeues,
+                     "Outcome": outcome}, eval_id=eval_id)
 
     def _nack_reenqueue_delay(self, prev_dequeues: int) -> float:
         if prev_dequeues <= 0:

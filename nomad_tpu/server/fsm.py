@@ -60,6 +60,10 @@ class FSM:
         # Vault revocation trigger (vault.go RevokeTokens via fsm alloc
         # client updates): called with the alloc id on terminal transition.
         self.on_alloc_terminal = on_alloc_terminal
+        # Cluster event broker (server/event_broker.py): remembered here
+        # so restore() can re-attach it to the replacement state store —
+        # a snapshot install must not silently disarm the event stream.
+        self.event_broker = None
 
     # -- apply -------------------------------------------------------------
 
@@ -169,7 +173,8 @@ class FSM:
 
     def _apply_plan_results(self, index: int, req: dict):
         self.state.upsert_plan_results(index, req.get("job"), req["allocs"],
-                                       req.get("slabs"))
+                                       req.get("slabs"),
+                                       eval_id=req.get("eval_id", ""))
         # Preemption follow-up evals commit with the evict+place they
         # belong to (plan_apply.py builds them); the applier hands them
         # to BlockedEvals after this apply returns.
@@ -205,6 +210,12 @@ class FSM:
     def restore(self, blob: bytes) -> None:
         """(fsm.go:582) — replaces the state store wholesale."""
         self.state = StateStore.restore(blob)
+        if self.event_broker is not None:
+            self.state.event_broker = self.event_broker
+            # The snapshot's writes were never published into the ring:
+            # raise the gap horizon so a resume inside that range errors
+            # instead of silently replaying nothing.
+            self.event_broker.mark_armed(self.state.latest_index())
 
     _DISPATCH: Dict[MessageType, Callable] = {
         MessageType.NODE_REGISTER: _apply_node_register,

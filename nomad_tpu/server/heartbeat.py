@@ -23,6 +23,12 @@ HEARTBEAT_GRACE = 10.0
 
 
 class HeartbeatTimers:
+    # Owning server's event broker (attached by Server.enable_event_stream):
+    # expiry events are per-server, not process-wide, so they must not go
+    # through the global note_external hook — in multi-server processes
+    # that would mirror them onto every stream with the wrong index.
+    event_broker = None
+
     def __init__(
         self,
         on_expire: Callable[[str], None],
@@ -87,6 +93,12 @@ class HeartbeatTimers:
         self.logger.warning("node %s heartbeat missed; marking down", node_id)
         self.metrics.incr_counter("heartbeat.invalidate")
         tracing.event("heartbeat.expire", node_id=node_id)
+        # Event-stream mirror of the expiry (the NodeStatusUpdated the
+        # expiry *causes* is published by the state store; this marks the
+        # cause itself).  One branch while no broker is armed.
+        eb = self.event_broker
+        if eb is not None:
+            eb.publish_external("Node", "NodeHeartbeatExpired", node_id)
         try:
             self.on_expire(node_id)
         except Exception:

@@ -341,7 +341,11 @@ class PlanApplier:
             if slab.proto.create_time == 0:
                 slab.proto.create_time = now
 
-        payload = {"job": plan.job, "allocs": allocs}
+        # eval_id rides the payload for event-stream correlation: stop/
+        # evict/lost updates keep their ORIGINAL placement eval on the
+        # alloc row (AppendUpdate), so the driving eval travels separately.
+        payload = {"job": plan.job, "allocs": allocs,
+                   "eval_id": plan.eval_id}
         if result.alloc_slabs:
             payload["slabs"] = result.alloc_slabs
         preemption_evals: List[s.Evaluation] = []
@@ -354,6 +358,25 @@ class PlanApplier:
                 job_lookup=lambda jid: snap.job_by_id(None, jid))
             payload["preemption_evals"] = preemption_evals
         _, index = self.raft.apply(MessageType.APPLY_PLAN_RESULTS, payload)
+        eb = self.raft.fsm.state.event_broker
+        if eb is not None:
+            # One plan-level summary on top of the per-alloc/slab events
+            # the state store published during the apply: the decision
+            # record (what this eval's plan did), keyed by eval.  This
+            # publish runs after raft.apply returns, outside the
+            # raft-serialized apply path, so a concurrent apply may have
+            # already published a higher index — clamp keeps the stream
+            # monotonic; PlanIndex preserves the true apply index.
+            placed = (sum(len(v) for v in result.node_allocation.values())
+                      + sum(len(sl.ids) for sl in result.alloc_slabs))
+            eb.publish_one(
+                s.TOPIC_PLAN, "PlanApplied", plan.eval_id, index,
+                {"Placed": placed,
+                 "Updated": sum(len(v) for v in result.node_update.values()),
+                 "Preempted": len(preempted),
+                 "Partial": bool(result.refresh_index),
+                 "PlanIndex": index},
+                eval_id=plan.eval_id, clamp=True)
         if preemption_evals:
             for ev in preemption_evals:
                 ev.snapshot_index = index

@@ -17,9 +17,11 @@ from typing import Dict, List, Optional, Tuple
 from ..structs import structs as s
 from ..utils import tracing
 from ..utils.telemetry import Telemetry
+from . import event_broker as event_stream
 from .blocked_evals import BlockedEvals
 from .core_sched import CoreScheduler
 from .eval_broker import EvalBroker
+from .event_broker import EventBroker
 from .fsm import FSM, MessageType, TimeTable
 from .heartbeat import HeartbeatTimers
 from .periodic import PeriodicDispatch, derive_job
@@ -179,6 +181,18 @@ class Server:
             if isinstance(self.raft, MultiRaft):
                 self.rpc.raft_handler = self.raft.handle_message
 
+        # Cluster event stream (event_broker.py): constructed always,
+        # armed (attached to the state store + global registry) only via
+        # NOMAD_TPU_EVENTS=1 or the first /v1/event/stream subscriber —
+        # disarmed, every state write pays one attribute load + branch.
+        self.event_broker = EventBroker(
+            metrics=self.metrics, index_source=self.raft.applied_index)
+        self._events_enabled = False
+        self._events_lock = threading.Lock()
+        if os.environ.get("NOMAD_TPU_EVENTS", "").strip().lower() in (
+                "1", "true", "yes"):
+            self.enable_event_stream()
+
         self.plan_applier = PlanApplier(self.plan_queue, self.raft, self.logger,
                                         metrics=self.metrics,
                                         blocked_evals=self.blocked_evals)
@@ -188,6 +202,8 @@ class Server:
             max_per_second=self.config.max_heartbeats_per_second,
             logger=self.logger,
             metrics=self.metrics)
+        if self._events_enabled:
+            self.heartbeat.event_broker = self.event_broker
         self.periodic = PeriodicDispatch(self._periodic_dispatch, self.logger)
 
         self.workers: List[Worker] = []
@@ -238,9 +254,56 @@ class Server:
         for worker in self.workers:
             worker.start()
 
+    # -- cluster event stream ----------------------------------------------
+
+    def enable_event_stream(self) -> None:
+        """Arm the event broker: attach it to the state store write path
+        and the process-wide external-publisher registry.  Idempotent;
+        stays armed for the server's lifetime so a subscriber that
+        disconnects can resume against a ring that kept buffering."""
+        with self._events_lock:
+            if self._events_enabled:
+                return
+            self._events_enabled = True
+            self.fsm.event_broker = self.event_broker
+            self.fsm.state.event_broker = self.event_broker
+            # Writes applied before arming were never buffered: raise
+            # the broker's gap horizon so a stale resume errors with the
+            # oldest index instead of silently replaying nothing.  Attach
+            # BEFORE reading the horizon — applied_index() serializes on
+            # the raft lock the FSM applies under, so any apply that
+            # missed the just-attached broker is ≤ the index read here
+            # (an apply that both published and landed ≤ horizon only
+            # costs a false resume error, never a silent gap).
+            self.event_broker.mark_armed(self.raft.applied_index())
+            # Per-server publishers get this server's broker directly
+            # (note_external is only for genuinely process-wide sources:
+            # the breaker and the fault plane).  heartbeat may not exist
+            # yet on the NOMAD_TPU_EVENTS=1 construction path; __init__
+            # re-attaches it right after construction.
+            self.eval_broker.event_broker = self.event_broker
+            hb = getattr(self, "heartbeat", None)
+            if hb is not None:
+                hb.event_broker = self.event_broker
+            event_stream.register(self.event_broker)
+
+    def event_stream_subscribe(self, topics=None, from_index: int = 0,
+                               replay_all: bool = False):
+        """Subscribe to the cluster event stream (Event.Stream /
+        /v1/event/stream).  Arms the broker on first use.  Raises
+        event_broker.EventIndexError when ``from_index`` is below the
+        ring's buffered horizon; ``replay_all`` is the no-gap-check
+        backlog dump (whatever the ring still holds)."""
+        self.enable_event_stream()
+        return self.event_broker.subscribe(topics=topics,
+                                           from_index=from_index,
+                                           replay_all=replay_all)
+
     def shutdown(self) -> None:
         self._shutdown.set()
         self._leader = False
+        event_stream.unregister(self.event_broker)
+        self.event_broker.close()
         for worker in self.workers:
             worker.stop()
         self.plan_applier.stop()
@@ -612,6 +675,16 @@ class Server:
                                        self.heartbeat.active())
                 self.metrics.set_gauge("raft.applied_index",
                                        self.raft.applied_index())
+                if self._events_enabled:
+                    es = self.event_broker.stats()
+                    self.metrics.set_gauge("events.ring_depth",
+                                           es["depth"])
+                    self.metrics.set_gauge("events.subscribers",
+                                           es["subscribers"])
+                    self.metrics.set_gauge("events.dropped",
+                                           es["evicted"])
+                    self.metrics.set_gauge("events.max_subscriber_lag",
+                                           es["max_subscriber_lag"])
                 # Breaker state must survive interval rolls while evals
                 # are quiet — the open-and-idle window is exactly the
                 # one worth observing.  sys.modules, not an import: the
@@ -1432,6 +1505,8 @@ class Server:
             "plan_queue_depth": self.plan_queue.depth(),
             "heartbeat_active": self.heartbeat.active(),
         }
+        if self._events_enabled:
+            out["events"] = self.event_broker.stats()
         sink = self.metrics.sink
         if hasattr(sink, "latest"):
             latest = sink.latest()

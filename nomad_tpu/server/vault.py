@@ -203,9 +203,14 @@ class HTTPVault(VaultAPI):
         out = self._call("POST", "/v1/sys/wrapping/unwrap", {},
                          token_override=wrapping_token)
         auth = out.get("auth") or {}
+        # lease_duration may be absent (or 0) in the unwrap response;
+        # emit 0.0 and let the consumer (ClientVaultClient.derive_token)
+        # fall back to the wrapped envelope's requested TTL — a 0 TTL
+        # must never reach the renewal heap (it would schedule immediate
+        # never-ending renewal churn).
         return {"token": auth.get("client_token", ""),
                 "accessor": auth.get("accessor", ""),
-                "ttl": float(auth.get("lease_duration", 0.0))}
+                "ttl": float(auth.get("lease_duration") or 0.0)}
 
     def renew_token(self, token, increment):
         out = self._call("POST", "/v1/auth/token/renew", {

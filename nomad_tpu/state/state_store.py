@@ -113,6 +113,13 @@ class WatchSet:
 class StateStore:
     """The authoritative in-memory database of cluster state."""
 
+    # Cluster event stream (server/event_broker.py): attached by the
+    # Server when streaming is armed, None otherwise — every write path
+    # below pays one attribute load + branch while disarmed (the
+    # fault.py cost discipline).  Class attribute so snapshots created
+    # via __new__ read None without per-snapshot bookkeeping.
+    event_broker = None
+
     TABLES = (
         "nodes",
         "jobs",
@@ -193,6 +200,9 @@ class StateStore:
             snap._pending_slabs = list(self._pending_slabs)
             snap._pending_by_job = {k: list(v)
                                     for k, v in self._pending_by_job.items()}
+            # Writes to a snapshot (job_plan dry runs, scheduler harness
+            # worlds) are hypothetical: they must never publish events.
+            snap.event_broker = None
             return snap
 
     # -- immutable index-set updates ---------------------------------------
@@ -349,6 +359,13 @@ class StateStore:
             node.modify_index = index
             self.nodes_table[node.id] = node
             self._bump("nodes", index)
+        eb = self.event_broker
+        if eb is not None:
+            eb.publish_one(
+                s.TOPIC_NODE,
+                "NodeRegistered" if existing is None else "NodeUpdated",
+                node.id, index,
+                {"Status": node.status, "Datacenter": node.datacenter})
         self._notify()
 
     def delete_node(self, index: int, node_id: str) -> None:
@@ -357,6 +374,9 @@ class StateStore:
                 raise KeyError(f"node not found: {node_id}")
             del self.nodes_table[node_id]
             self._bump("nodes", index)
+        eb = self.event_broker
+        if eb is not None:
+            eb.publish_one(s.TOPIC_NODE, "NodeDeregistered", node_id, index)
         self._notify()
 
     def update_node_status(self, index: int, node_id: str, status: str) -> None:
@@ -370,6 +390,10 @@ class StateStore:
             node.modify_index = index
             self.nodes_table[node_id] = node
             self._bump("nodes", index)
+        eb = self.event_broker
+        if eb is not None:
+            eb.publish_one(s.TOPIC_NODE, "NodeStatusUpdated", node_id, index,
+                           {"Status": status, "Previous": existing.status})
         self._notify()
 
     def update_node_drain(self, index: int, node_id: str, drain: bool) -> None:
@@ -383,6 +407,10 @@ class StateStore:
             node.modify_index = index
             self.nodes_table[node_id] = node
             self._bump("nodes", index)
+        eb = self.event_broker
+        if eb is not None:
+            eb.publish_one(s.TOPIC_NODE, "NodeDrainUpdated", node_id, index,
+                           {"Drain": drain})
         self._notify()
 
     def node_by_id(self, ws: Optional[WatchSet], node_id: str) -> Optional[s.Node]:
@@ -427,6 +455,11 @@ class StateStore:
             self._upsert_job_version(index, job)
             self.jobs_table[job.id] = job
             self._bump("jobs", index)
+        eb = self.event_broker
+        if eb is not None:
+            eb.publish_one(s.TOPIC_JOB, "JobRegistered", job.id, index,
+                           {"Type": job.type, "Status": job.status,
+                            "Version": job.version, "Stop": job.stop})
         self._notify()
 
     def _upsert_job_version(self, index: int, job: s.Job) -> None:
@@ -446,6 +479,9 @@ class StateStore:
             self.periodic_launch_table.pop(job_id, None)
             self._bump("jobs", index)
             self._bump("job_summary", index)
+        eb = self.event_broker
+        if eb is not None:
+            eb.publish_one(s.TOPIC_JOB, "JobDeregistered", job_id, index)
         self._notify()
 
     def job_by_id(self, ws: Optional[WatchSet], job_id: str) -> Optional[s.Job]:
@@ -593,6 +629,13 @@ class StateStore:
                 jobs.setdefault(ev.job_id, "")
             self._set_job_statuses(index, jobs, eval_delete=False)
             self._bump("evals", index)
+        eb = self.event_broker
+        if eb is not None:
+            eb.publish([eb.make_event(
+                s.TOPIC_EVAL, "EvalUpdated", ev.id, index,
+                {"Status": ev.status, "JobID": ev.job_id,
+                 "TriggeredBy": ev.triggered_by, "NodeID": ev.node_id},
+                eval_id=ev.id) for ev in evals])
         self._notify()
 
     def _nested_upsert_eval(self, index: int, ev: s.Evaluation) -> None:
@@ -634,6 +677,7 @@ class StateStore:
 
     def delete_eval(self, index: int, eval_ids: List[str], alloc_ids: List[str]) -> None:
         """(state_store.go:1235) — GC path for evals + their allocs."""
+        deleted: List[str] = []
         with self._lock:
             jobs: Dict[str, str] = {}
             for eid in eval_ids:
@@ -642,11 +686,17 @@ class StateStore:
                     continue
                 self._idx_discard(self._evals_by_job, ev.job_id, eid)
                 jobs.setdefault(ev.job_id, "")
+                deleted.append(eid)
             for aid in alloc_ids:
                 self._remove_alloc(aid)
             self._bump("evals", index)
             self._bump("allocs", index)
             self._set_job_statuses(index, jobs, eval_delete=True)
+        eb = self.event_broker
+        if eb is not None and deleted:
+            eb.publish([eb.make_event(s.TOPIC_EVAL, "EvalDeleted", eid,
+                                      index, eval_id=eid)
+                        for eid in deleted])
         self._notify()
 
     def eval_by_id(self, ws: Optional[WatchSet], eval_id: str) -> Optional[s.Evaluation]:
@@ -681,12 +731,34 @@ class StateStore:
         """(state_store.go:1435).  ``owned=True`` means the caller hands the
         objects over (plan apply constructs fresh allocs): the store inserts
         them directly, exactly like go-memdb inserting the FSM's pointers."""
+        eb = self.event_broker
+        events: Optional[List[s.Event]] = [] if eb is not None else None
         with self._lock:
-            self._upsert_allocs_impl(index, allocs, owned)
+            self._upsert_allocs_impl(index, allocs, owned, events=events)
+        if events:
+            eb.publish(events)
         self._notify()
 
+    @staticmethod
+    def _alloc_event_type(alloc: s.Allocation,
+                          existing: Optional[s.Allocation]) -> str:
+        """Event type for one alloc write: the transition an operator
+        cares about, not the table mechanics."""
+        if alloc.client_status == s.ALLOC_CLIENT_STATUS_LOST:
+            return "AllocLost"
+        if alloc.desired_status == s.ALLOC_DESIRED_STATUS_EVICT:
+            return "AllocEvicted"
+        if alloc.desired_status == s.ALLOC_DESIRED_STATUS_STOP:
+            return "AllocStopped"
+        if existing is None:
+            return "AllocPlaced"
+        return "AllocUpdated"
+
     def _upsert_allocs_impl(self, index: int, allocs: List[s.Allocation],
-                            owned: bool = False) -> None:
+                            owned: bool = False,
+                            events: Optional[List[s.Event]] = None,
+                            plan_eval_id: str = "") -> None:
+        eb = self.event_broker
         jobs: Dict[str, str] = {}
         summary_cache: Dict[str, s.JobSummary] = {}
         for alloc in allocs:
@@ -716,6 +788,15 @@ class StateStore:
             if alloc.job is None and existing is not None:
                 alloc.job = existing.job
             self.allocs_table[alloc.id] = alloc
+            if events is not None:
+                events.append(eb.make_event(
+                    s.TOPIC_ALLOC, self._alloc_event_type(alloc, existing),
+                    alloc.id, index,
+                    {"JobID": alloc.job_id, "NodeID": alloc.node_id,
+                     "TaskGroup": alloc.task_group,
+                     "DesiredStatus": alloc.desired_status,
+                     "ClientStatus": alloc.client_status},
+                    eval_id=plan_eval_id or alloc.eval_id))
             # Index only keys that actually changed: _idx_add's copy-on-
             # write set union is O(|index|), so the previously
             # unconditional re-add of 10k evictions against a 70k-alloc
@@ -747,6 +828,8 @@ class StateStore:
 
     def update_allocs_from_client(self, index: int, allocs: List[s.Allocation]) -> None:
         """Merge client-authoritative fields (state_store.go:1367)."""
+        eb = self.event_broker
+        events: Optional[List[s.Event]] = [] if eb is not None else None
         with self._lock:
             for client_alloc in allocs:
                 existing = self._get_alloc(client_alloc.id)
@@ -761,9 +844,19 @@ class StateStore:
                 updated.modify_index = index
                 self._update_summary_with_alloc(index, updated, existing)
                 self.allocs_table[client_alloc.id] = updated
+                if events is not None:
+                    events.append(eb.make_event(
+                        s.TOPIC_ALLOC, "AllocClientUpdated", updated.id,
+                        index,
+                        {"JobID": updated.job_id, "NodeID": updated.node_id,
+                         "ClientStatus": updated.client_status,
+                         "Previous": existing.client_status},
+                        eval_id=updated.eval_id))
                 forced = "" if updated.terminal_status() else s.JOB_STATUS_RUNNING
                 self._set_job_statuses(index, {existing.job_id: forced}, eval_delete=False)
             self._bump("allocs", index)
+        if events:
+            eb.publish(events)
         self._notify()
 
     def _remove_alloc(self, alloc_id: str) -> None:
@@ -941,6 +1034,7 @@ class StateStore:
         """(state_store.go:221 UpsertDeployment).  cancel_prior marks any
         other ACTIVE deployment of the same job cancelled
         (state_store.go:266 cancelPriorDeployments)."""
+        cancelled: List[str] = []
         with self._lock:
             d = deployment.copy()
             existing = self.deployments_table.get(d.id)
@@ -959,8 +1053,19 @@ class StateStore:
                             "made obsolete by a newer deployment")
                         upd.modify_index = index
                         self.deployments_table[other.id] = upd
+                        cancelled.append(other.id)
             self.deployments_table[d.id] = d
             self._bump("deployment", index)
+        eb = self.event_broker
+        if eb is not None:
+            events = [eb.make_event(
+                s.TOPIC_DEPLOYMENT, "DeploymentUpserted", d.id, index,
+                {"JobID": d.job_id, "Status": d.status})]
+            events.extend(eb.make_event(
+                s.TOPIC_DEPLOYMENT, "DeploymentStatusUpdated", did, index,
+                {"Status": s.DEPLOYMENT_STATUS_CANCELLED})
+                for did in cancelled)
+            eb.publish(events)
         self._notify()
 
     def update_deployment_status(self, index: int,
@@ -976,6 +1081,11 @@ class StateStore:
             d.modify_index = index
             self.deployments_table[d.id] = d
             self._bump("deployment", index)
+        eb = self.event_broker
+        if eb is not None:
+            eb.publish_one(s.TOPIC_DEPLOYMENT, "DeploymentStatusUpdated",
+                           d.id, index,
+                           {"JobID": d.job_id, "Status": d.status})
         self._notify()
 
     def deployment_by_id(self, ws: Optional[WatchSet],
@@ -1046,11 +1156,18 @@ class StateStore:
 
     def upsert_plan_results(self, index: int, job: Optional[s.Job],
                             allocs: List[s.Allocation],
-                            slabs: Optional[List[s.AllocSlab]] = None) -> None:
+                            slabs: Optional[List[s.AllocSlab]] = None,
+                            eval_id: str = "") -> None:
         """Apply a committed plan: denormalize the job onto allocs, rebuild
         combined resources, and upsert (state_store.go:89).  Columnar
         alloc slabs (the TPU batch path's bulk placements) are inserted in
-        O(columns) — see _upsert_slabs_impl."""
+        O(columns) — see _upsert_slabs_impl.  ``eval_id`` is the DRIVING
+        eval of the plan: stop/evict/lost updates keep the original
+        placement eval on the alloc row itself (AppendUpdate semantics),
+        so the event stream needs the driving eval passed explicitly to
+        correlate "which eval did this" across the incident timeline."""
+        eb = self.event_broker
+        events: Optional[List[s.Event]] = [] if eb is not None else None
         with self._lock:
             for alloc in allocs:
                 if alloc.job is None and not alloc.terminal_status():
@@ -1063,22 +1180,30 @@ class StateStore:
                     alloc.resources = total
             # Plan-result allocs are owned by the state store from here on
             # (the FSM decoded/constructed them; nothing else mutates them).
-            self._upsert_allocs_impl(index, allocs, owned=True)
+            self._upsert_allocs_impl(index, allocs, owned=True,
+                                     events=events, plan_eval_id=eval_id)
             if slabs:
                 for slab in slabs:
                     p = slab.proto
                     if p.job is None and not p.terminal_status():
                         p.job = job
-                self._upsert_slabs_impl(index, slabs)
+                self._upsert_slabs_impl(index, slabs, events=events)
+        if events:
+            eb.publish(events)
         self._notify()
 
     def upsert_slabs(self, index: int, slabs: List[s.AllocSlab]) -> None:
         """Bulk columnar insert (the TPU batch placement path)."""
+        eb = self.event_broker
+        events: Optional[List[s.Event]] = [] if eb is not None else None
         with self._lock:
-            self._upsert_slabs_impl(index, slabs)
+            self._upsert_slabs_impl(index, slabs, events=events)
+        if events:
+            eb.publish(events)
         self._notify()
 
-    def _upsert_slabs_impl(self, index: int, slabs: List[s.AllocSlab]) -> None:
+    def _upsert_slabs_impl(self, index: int, slabs: List[s.AllocSlab],
+                           events: Optional[List[s.Event]] = None) -> None:
         """Insert a fresh-allocation slab: the table value for each alloc
         id is the slab OBJECT itself (no per-alloc wrapper), per-alloc
         work is three index inserts, and everything else (summary, job
@@ -1102,6 +1227,14 @@ class StateStore:
             # largest host cost of the whole scheduling pass at 1M asks.
             self._pending_slabs.append(slab)
             self._pending_by_job.setdefault(proto.job_id, []).append(slab)
+            if events is not None:
+                # ONE event per slab, not per alloc: a 1M-ask batch must
+                # not turn into 1M ring entries.  The count + job/eval
+                # keys are what incident reconstruction needs.
+                events.append(self.event_broker.make_event(
+                    s.TOPIC_ALLOC, "AllocPlacedBulk", proto.job_id, index,
+                    {"JobID": proto.job_id, "TaskGroup": proto.task_group,
+                     "Count": len(ids)}, eval_id=proto.eval_id))
             self._update_summary_bulk(index, proto, len(ids))
             if proto.job is not None:
                 forced = ("" if proto.terminal_status()

@@ -1565,5 +1565,45 @@ class JobChildrenSummary:
     dead: int = 0
 
 
+# -- cluster event stream (reference: nomad/stream, the 1.0 event broker) ----
+
+TOPIC_NODE = "Node"
+TOPIC_JOB = "Job"
+TOPIC_EVAL = "Eval"
+TOPIC_ALLOC = "Alloc"
+TOPIC_DEPLOYMENT = "Deployment"
+TOPIC_PLAN = "Plan"
+TOPIC_BREAKER = "Breaker"
+TOPIC_FAULT = "Fault"
+
+EVENT_TOPICS = (TOPIC_NODE, TOPIC_JOB, TOPIC_EVAL, TOPIC_ALLOC,
+                TOPIC_DEPLOYMENT, TOPIC_PLAN, TOPIC_BREAKER, TOPIC_FAULT)
+
+
+@dataclass
+class Event:
+    """One structured state-change event (structs/event.go Event): a
+    (topic, type, key) triple stamped with the raft index of the write
+    that produced it, a payload stub, and — when the write happened
+    under a traced span — the correlating eval/span ids from the
+    tracing plane, so an event timeline joins against
+    ``/v1/trace/eval/<id>``."""
+
+    topic: str = ""
+    type: str = ""
+    key: str = ""
+    index: int = 0
+    payload: Dict[str, object] = field(default_factory=dict)
+    eval_id: str = ""
+    span_id: int = 0
+    wall: float = 0.0
+
+    def to_wire_dict(self) -> Dict[str, object]:
+        return {"Topic": self.topic, "Type": self.type, "Key": self.key,
+                "Index": self.index, "Payload": self.payload,
+                "EvalID": self.eval_id, "SpanID": self.span_id,
+                "Wall": self.wall}
+
+
 def now() -> float:
     return time.time()

@@ -33,6 +33,7 @@ import pytest  # noqa: E402
 # pytest log alone, without re-running the seed locally.
 
 CHAOS_DUMP_SPANS = 120
+CHAOS_DUMP_EVENTS = 80
 
 
 @pytest.fixture(autouse=True)
@@ -40,10 +41,22 @@ def _chaos_tracing(request):
     if request.node.get_closest_marker("chaos") is None:
         yield
         return
+    from nomad_tpu.server import event_broker
     from nomad_tpu.utils import tracing
 
     tracing.enable()
+    # Arm the cluster event stream for every server the test constructs
+    # (NOMAD_TPU_EVENTS is read at Server construction) and clear the
+    # process-global forensic tail so a failure dump shows THIS test's
+    # incident, not the previous one's.
+    prev = os.environ.get("NOMAD_TPU_EVENTS")
+    os.environ["NOMAD_TPU_EVENTS"] = "1"
+    event_broker.clear_recent()
     yield
+    if prev is None:
+        os.environ.pop("NOMAD_TPU_EVENTS", None)
+    else:
+        os.environ["NOMAD_TPU_EVENTS"] = prev
     tracing.disable()
 
 
@@ -65,6 +78,7 @@ def pytest_runtest_makereport(item, call):
     # After the call phase, before fixture teardown disarms the tracer.
     if (rep.when == "call" and rep.failed
             and item.get_closest_marker("chaos") is not None):
+        from nomad_tpu.server import event_broker
         from nomad_tpu.utils import tracing
 
         spans = tracing.recent(CHAOS_DUMP_SPANS)
@@ -74,6 +88,19 @@ def pytest_runtest_makereport(item, call):
             print(_format_trace(spans), file=sys.__stderr__)
         else:
             print("  (no spans recorded)", file=sys.__stderr__)
+        # The cluster event timeline next to the trace: spans say where
+        # time went, events say what the cluster state DID.
+        events = event_broker.recent(CHAOS_DUMP_EVENTS)
+        print(f"-- chaos event timeline for {item.nodeid} "
+              f"(last {len(events)} events) --", file=sys.__stderr__)
+        if events:
+            for ev in events:
+                extra = f" eval={ev.eval_id[:8]}" if ev.eval_id else ""
+                print(f"  @{ev.index:<6} {ev.topic}/{ev.type:<22} "
+                      f"{ev.key[:16]}{extra} {ev.payload}",
+                      file=sys.__stderr__)
+        else:
+            print("  (no events recorded)", file=sys.__stderr__)
 
 
 def dev_test_config():

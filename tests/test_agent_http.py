@@ -411,6 +411,48 @@ client {
         with pytest.raises(ValueError):
             parse_config('bind_addr = "{{ GetMagicIP }}"')
 
+    def test_sockaddr_template_advertise_and_addresses(self):
+        """ADVICE r5 config.py:274: templates resolve in the
+        advertise{} and addresses{} blocks too (config_parse.go runs
+        parseSingleIPTemplate over all of them), in both the HCL and
+        JSON paths — a templated advertise address must never pass
+        through literally to bind/gossip time."""
+        cfg = parse_config('''
+addresses {
+  http = "{{ GetInterfaceIP \\"lo\\" }}"
+}
+advertise {
+  rpc  = "{{ GetInterfaceIP \\"lo\\" }}:4647"
+  serf = "10.9.8.7:4648"
+}
+''')
+        assert cfg.addresses.http == "127.0.0.1"
+        assert cfg.advertise.rpc == "127.0.0.1:4647"
+        assert cfg.advertise.serf == "10.9.8.7:4648"  # literal untouched
+        jcfg = parse_config(
+            '{"advertise": {"rpc": "{{ GetInterfaceIP \\"lo\\" }}:4647"},'
+            ' "addresses": {"http": "{{ GetInterfaceIP \\"lo\\" }}"}}')
+        assert jcfg.advertise.rpc == "127.0.0.1:4647"
+        assert jcfg.addresses.http == "127.0.0.1"
+        with pytest.raises(ValueError):
+            parse_config('advertise { rpc = "{{ GetMagicIP }}:4647" }')
+
+    def test_advertise_rpc_feeds_server_config(self):
+        """An explicit advertise.rpc becomes the server's advertised RPC
+        address (agent.go setupServer + config.go AdvertiseAddrs)."""
+        from nomad_tpu.agent import Agent
+
+        cfg = conftest.dev_test_config()
+        cfg.client.enabled = False
+        cfg.advertise.rpc = "127.0.0.1"  # port defaults from ports.rpc
+        a = Agent(cfg)
+        a.start()
+        try:
+            host = a.server.config.rpc_advertise.rsplit(":", 1)[0]
+            assert host == "127.0.0.1"
+        finally:
+            a.shutdown()
+
 
 class TestAgentMonitor:
     def test_monitor_streams_backlog_and_live_lines(self, agent):

@@ -1,0 +1,470 @@
+"""Cluster event stream tests (server/event_broker.py +
+/v1/event/stream + the `nomad-tpu events` consumer path).
+
+Covers the broker mechanics (topic/key filters, bounded ring, index
+resume, out-of-ring error, slow-subscriber shedding), the write-path
+publishers (monotonic raft-index order across tables, eval/span
+correlation with the PR 3 tracing plane), the HTTP/API surface, and —
+the acceptance scenario — a chaos node-blackout→lost→reschedule
+incident reconstructed from the event stream output alone.
+"""
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import fault, mock
+from nomad_tpu.api import APIError, NomadAPI
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.server.event_broker import (
+    EventBroker,
+    EventIndexError,
+    parse_topic_filter,
+)
+from nomad_tpu.structs import structs as s
+
+
+def wait_until(predicate, timeout=60.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def make_node():
+    n = mock.node()
+    n.resources.networks = []
+    n.reserved.networks = []
+    return n
+
+
+def make_job(count=1):
+    j = mock.job()
+    j.task_groups[0].count = count
+    for t in j.task_groups[0].tasks:
+        t.resources.networks = []
+    return j
+
+
+def drain(sub, timeout=0.2):
+    out = []
+    while True:
+        ev = sub.next(timeout=timeout)
+        if ev is None:
+            return out
+        out.append(ev)
+
+
+def mk_event(broker, index, topic="Node", etype="NodeUpdated", key="n1"):
+    return broker.make_event(topic, etype, key, index)
+
+
+# ---------------------------------------------------------------------------
+# broker mechanics
+# ---------------------------------------------------------------------------
+
+
+class TestBrokerMechanics:
+    def test_topic_and_key_filters(self):
+        b = EventBroker(ring_size=64)
+        every = b.subscribe()
+        nodes = b.subscribe(topics=parse_topic_filter("Node"))
+        one_key = b.subscribe(topics=parse_topic_filter("Node:n2,Eval"))
+        b.publish([mk_event(b, 1, "Node", "NodeUpdated", "n1"),
+                   mk_event(b, 2, "Node", "NodeUpdated", "n2"),
+                   mk_event(b, 3, "Eval", "EvalUpdated", "e1"),
+                   mk_event(b, 4, "Alloc", "AllocPlaced", "a1")])
+        assert len(drain(every)) == 4
+        assert [e.key for e in drain(nodes)] == ["n1", "n2"]
+        assert [(e.topic, e.key) for e in drain(one_key)] == [
+            ("Node", "n2"), ("Eval", "e1")]
+
+    def test_parse_topic_filter_shapes(self):
+        assert parse_topic_filter("") is None
+        assert parse_topic_filter("*") is None
+        assert parse_topic_filter("Node") == {"Node": set()}
+        assert parse_topic_filter("Node:a,Node:b") == {"Node": {"a", "b"}}
+        # A bare topic wins over a keyed entry regardless of order.
+        assert parse_topic_filter("Node:a,Node") == {"Node": set()}
+        assert parse_topic_filter("Node,Node:a") == {"Node": set()}
+
+    def test_index_resume_replays_buffered(self):
+        b = EventBroker(ring_size=64)
+        for i in range(1, 11):
+            b.publish([mk_event(b, i)])
+        sub = b.subscribe(from_index=4)
+        got = drain(sub)
+        assert [e.index for e in got] == list(range(4, 11))
+        # live events continue after the replay, in order
+        b.publish([mk_event(b, 11)])
+        assert [e.index for e in drain(sub)] == [11]
+
+    def test_out_of_ring_resume_errors_with_oldest(self):
+        b = EventBroker(ring_size=8)  # 8 is the broker's floor
+        for i in range(1, 13):  # ring holds 5..12, evicted through 4
+            b.publish([mk_event(b, i)])
+        assert b.oldest_buffered_index() == 5
+        with pytest.raises(EventIndexError) as exc:
+            b.subscribe(from_index=3)
+        assert exc.value.oldest == 5
+        assert "oldest buffered index is 5" in str(exc.value)
+        # The first still-fully-buffered index works.
+        sub = b.subscribe(from_index=5)
+        assert [e.index for e in drain(sub)] == list(range(5, 13))
+
+    def test_lagging_subscriber_is_shed(self):
+        b = EventBroker(ring_size=1024)
+        sub = b.subscribe(max_pending=8)
+        for i in range(1, 20):
+            b.publish([mk_event(b, i)])
+        # Overflowed: closed with a lag error instead of unbounded growth;
+        # the broker itself keeps publishing.
+        assert sub.closed
+        assert "lagging" in (sub.close_error or "")
+        assert b.stats()["published"] == 19
+
+    def test_eval_correlation_from_tracing_span(self):
+        from nomad_tpu.utils import tracing
+
+        b = EventBroker(ring_size=16)
+        tracing.enable()
+        try:
+            tr = tracing.TRACER
+            with tr.span("worker.attempt", eval_id="ev-123"):
+                b.publish_one("Alloc", "AllocPlaced", "a1", 5)
+        finally:
+            tracing.disable()
+        ev = b.buffered()[0]
+        assert ev.eval_id == "ev-123"
+        assert ev.span_id > 0
+
+
+# ---------------------------------------------------------------------------
+# write-path publishers on a live server
+# ---------------------------------------------------------------------------
+
+
+class TestServerEventPublish:
+    def test_disarmed_by_default_and_armed_on_subscribe(self):
+        srv = Server(ServerConfig(num_schedulers=0))
+        srv.start()
+        try:
+            assert srv.state.event_broker is None
+            n = make_node()
+            srv.node_register(n)
+            assert srv.event_broker.buffered() == []
+            sub = srv.event_stream_subscribe()
+            assert srv.state.event_broker is srv.event_broker
+            srv.node_update_status(n.id, s.NODE_STATUS_DOWN)
+            got = drain(sub)
+            assert [(e.topic, e.type) for e in got] == [
+                ("Node", "NodeStatusUpdated")]
+        finally:
+            srv.shutdown()
+
+    def test_full_lifecycle_monotonic_and_correlated(self, monkeypatch):
+        monkeypatch.setenv("NOMAD_TPU_EVENTS", "1")
+        srv = Server(ServerConfig(num_schedulers=1))
+        srv.start()
+        try:
+            n = make_node()
+            srv.node_register(n)
+            srv.node_update_status(n.id, s.NODE_STATUS_READY)
+            job = make_job()
+            _, eval_id = srv.job_register(job)
+            assert wait_until(lambda: any(
+                e.topic == s.TOPIC_EVAL and e.key == eval_id
+                and e.payload.get("Status") == s.EVAL_STATUS_COMPLETE
+                for e in srv.event_broker.buffered()), timeout=30.0)
+            events = srv.event_broker.buffered()
+            indexes = [e.index for e in events]
+            assert indexes == sorted(indexes)
+            pairs = [(e.topic, e.type) for e in events]
+            assert ("Node", "NodeRegistered") in pairs
+            assert ("Job", "JobRegistered") in pairs
+            assert ("Alloc", "AllocPlaced") in pairs
+            assert ("Plan", "PlanApplied") in pairs
+            assert ("Eval", "EvalAcked") in pairs
+            # The placement event carries the eval id that caused it.
+            placed = next(e for e in events if e.type == "AllocPlaced")
+            assert placed.eval_id == eval_id
+            plan = next(e for e in events if e.type == "PlanApplied")
+            assert plan.eval_id == eval_id and plan.payload["Placed"] == 1
+        finally:
+            srv.shutdown()
+
+    def test_snapshot_writes_do_not_publish(self, monkeypatch):
+        monkeypatch.setenv("NOMAD_TPU_EVENTS", "1")
+        srv = Server(ServerConfig(num_schedulers=0))
+        srv.start()
+        try:
+            snap = srv.state.snapshot()
+            assert snap.event_broker is None
+            snap.upsert_job(99, make_job())
+            assert srv.event_broker.buffered() == []
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# HTTP + api client + CLI surface
+# ---------------------------------------------------------------------------
+
+
+def _server_agent_config():
+    from nomad_tpu.agent import AgentConfig
+
+    cfg = AgentConfig()
+    cfg.dev_mode = True            # ephemeral RPC port
+    cfg.server.enabled = True
+    cfg.ports.http = 0
+    return cfg
+
+
+class TestEventStreamHTTP:
+    @pytest.fixture()
+    def agent(self, monkeypatch):
+        monkeypatch.setenv("NOMAD_TPU_EVENTS", "1")
+        from nomad_tpu.agent import Agent
+
+        a = Agent(_server_agent_config())
+        a.start()
+        yield a
+        a.shutdown()
+
+    def test_backlog_dump_filters_and_resume(self, agent):
+        api = NomadAPI(agent.http.address)
+        srv = agent.server
+        nodes = [make_node() for _ in range(3)]
+        for n in nodes:
+            srv.node_register(n)
+            srv.node_update_status(n.id, s.NODE_STATUS_READY)
+        job = make_job()
+        _, eval_id = srv.job_register(job)
+        assert wait_until(
+            lambda: srv.state.allocs_by_job(None, job.id, True), timeout=30.0)
+        assert wait_until(lambda: any(
+            e.type == "EvalAcked" for e in srv.event_broker.buffered()),
+            timeout=10.0)
+
+        events = list(api.events.stream(follow=False))
+        assert events, "no-follow dump returned nothing"
+        indexes = [e["Index"] for e in events]
+        assert indexes == sorted(indexes)
+        types = {e["Type"] for e in events}
+        assert {"NodeRegistered", "JobRegistered", "AllocPlaced",
+                "PlanApplied"} <= types
+        # topic filter: Node events only
+        node_events = list(api.events.stream(topics=["Node"], follow=False))
+        assert node_events and all(e["Topic"] == "Node"
+                                   for e in node_events)
+        # index resume over HTTP: no gaps at/after the resume point
+        mid = events[len(events) // 2]["Index"]
+        resumed = list(api.events.stream(index=mid, follow=False))
+        want = [(e["Index"], e["Topic"], e["Type"], e["Key"])
+                for e in events if e["Index"] >= mid]
+        got = [(e["Index"], e["Topic"], e["Type"], e["Key"])
+               for e in resumed]
+        assert set(want) <= set(got)
+
+    def test_follow_mode_streams_live_events(self, agent):
+        api = NomadAPI(agent.http.address)
+        srv = agent.server
+        got = []
+        done = threading.Event()
+
+        def consume():
+            for ev in api.events.stream(topics=["Node"]):
+                got.append(ev)
+                if len(got) >= 2:
+                    break
+            done.set()
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.3)  # let the subscription attach
+        n = make_node()
+        srv.node_register(n)
+        srv.node_update_status(n.id, s.NODE_STATUS_DOWN)
+        assert done.wait(10.0)
+        assert [e["Type"] for e in got] == ["NodeRegistered",
+                                           "NodeStatusUpdated"]
+
+    def test_out_of_ring_resume_is_400_with_oldest(self, monkeypatch):
+        monkeypatch.setenv("NOMAD_TPU_EVENTS", "1")
+        monkeypatch.setenv("NOMAD_TPU_EVENTS_RING", "8")
+        from nomad_tpu.agent import Agent
+
+        a = Agent(_server_agent_config())
+        a.start()
+        try:
+            srv = a.server
+            for _ in range(6):
+                n = make_node()
+                srv.node_register(n)
+                srv.node_update_status(n.id, s.NODE_STATUS_DOWN)
+                srv.node_update_status(n.id, s.NODE_STATUS_READY)
+            assert srv.event_broker.stats()["evicted"] > 0
+            api = NomadAPI(a.http.address)
+            with pytest.raises(APIError) as exc:
+                list(api.events.stream(index=1, follow=False))
+            assert exc.value.code == 400
+            assert "oldest buffered index" in str(exc.value)
+        finally:
+            a.shutdown()
+
+    def test_cli_events_no_follow(self, agent):
+        import io
+
+        from nomad_tpu.cli.commands import main as cli_main
+
+        srv = agent.server
+        n = make_node()
+        srv.node_register(n)
+        out = io.StringIO()
+        rc = cli_main(["events", "-no-follow", "-topic", "Node",
+                       "-address", agent.http.address], out)
+        assert rc == 0
+        text = out.getvalue()
+        assert "Node/NodeRegistered" in text
+        out_json = io.StringIO()
+        rc = cli_main(["events", "-no-follow", "-json",
+                       "-address", agent.http.address], out_json)
+        assert rc == 0
+        first = json.loads(out_json.getvalue().splitlines()[0])
+        assert {"Topic", "Type", "Key", "Index", "Payload"} <= set(first)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance scenario: chaos incident reconstruction from the stream
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestChaosIncidentReconstruction:
+    def test_blackout_lost_reschedule_from_event_stream_alone(self):
+        """A node blackout → down → allocs lost → rescheduled incident,
+        reconstructed end-to-end from /v1/event/stream output ALONE: the
+        heartbeat expiry, the down transition, the lost alloc, the
+        node-update eval, and the replacement placement on the surviving
+        node — in monotonic raft-index order, with a mid-incident
+        disconnect+resume observing no gaps."""
+        from nomad_tpu.agent import Agent
+
+        a = Agent(_server_agent_config())
+        srv = a.server
+        srv.heartbeat.min_ttl = 0.3
+        srv.heartbeat.max_per_second = 1000.0
+        srv.heartbeat.grace = 0.2
+        a.start()
+        stop = threading.Event()
+        try:
+            api = NomadAPI(a.http.address)
+            nodes = [make_node() for _ in range(2)]
+            for n in nodes:
+                srv.node_register(n)
+                srv.node_update_status(n.id, s.NODE_STATUS_READY)
+
+            def heartbeater():
+                while not stop.is_set():
+                    for n in nodes:
+                        act = fault.faultpoint(
+                            "rpc.send", method="Node.UpdateStatus",
+                            node_id=n.id, side="client")
+                        if act is not None and act.kind == "drop":
+                            continue
+                        try:
+                            srv.node_update_status(n.id,
+                                                   s.NODE_STATUS_READY)
+                        except Exception:
+                            pass
+                    stop.wait(0.1)
+
+            threading.Thread(target=heartbeater, daemon=True).start()
+
+            job = make_job(1)
+            srv.job_register(job)
+            assert wait_until(lambda: [
+                a_ for a_ in srv.state.allocs_by_job(None, job.id, True)
+                if not a_.terminal_status()], timeout=30.0)
+            victim = [a_ for a_ in srv.state.allocs_by_job(None, job.id,
+                                                           True)
+                      if not a_.terminal_status()][0].node_id
+            other = next(n.id for n in nodes if n.id != victim)
+
+            fault.arm({"seed": 13, "faults": [
+                {"point": "rpc.send", "action": "drop",
+                 "match": {"node_id": victim}}]})
+
+            def recovered():
+                allocs = srv.state.allocs_by_job(None, job.id, True)
+                lost = [x for x in allocs
+                        if x.client_status == s.ALLOC_CLIENT_STATUS_LOST]
+                live = [x for x in allocs if not x.terminal_status()
+                        and x.client_status != s.ALLOC_CLIENT_STATUS_LOST]
+                return (len(lost) == 1 and len(live) == 1
+                        and live[0].node_id == other)
+
+            assert wait_until(recovered, timeout=30.0)
+            fault.disarm()
+            stop.set()
+
+            # ---- reconstruction, from the HTTP stream alone ----
+            events = list(api.events.stream(follow=False))
+            indexes = [e["Index"] for e in events]
+            assert indexes == sorted(indexes), \
+                "events must arrive in monotonic raft-index order"
+
+            def first(pred):
+                return next(i for i, e in enumerate(events) if pred(e))
+
+            expired_i = first(
+                lambda e: e["Type"] == "NodeHeartbeatExpired"
+                and e["Key"] == victim)
+            down_i = first(
+                lambda e: e["Type"] == "NodeStatusUpdated"
+                and e["Key"] == victim
+                and e["Payload"].get("Status") == s.NODE_STATUS_DOWN
+                and e["Payload"].get("Previous") == s.NODE_STATUS_READY)
+            lost_i = first(
+                lambda e: e["Type"] == "AllocLost"
+                and e["Payload"].get("NodeID") == victim
+                and e["Payload"].get("JobID") == job.id)
+            placed_i = first(
+                lambda e: e["Type"] == "AllocPlaced"
+                and e["Payload"].get("NodeID") == other
+                and e["Payload"].get("JobID") == job.id)
+            # The lost/placed writes correlate (via EvalID) to node-update
+            # evals for the blacked-out node, and those evals' creation
+            # events sit between the down transition and the plan writes.
+            node_eval_ids = {
+                e["Key"] for e in events
+                if e["Type"] == "EvalUpdated"
+                and e["Payload"].get("TriggeredBy")
+                == s.EVAL_TRIGGER_NODE_UPDATE
+                and e["Payload"].get("NodeID") == victim}
+            assert events[lost_i]["EvalID"] in node_eval_ids
+            assert events[placed_i]["EvalID"] in node_eval_ids
+            eval_i = first(
+                lambda e: e["Type"] == "EvalUpdated"
+                and e["Key"] == events[lost_i]["EvalID"])
+            assert expired_i < down_i < eval_i
+            assert eval_i < lost_i
+            assert down_i < placed_i
+
+            # ---- disconnect + resume: no gaps while buffered ----
+            mid = events[down_i]["Index"]
+            resumed = list(api.events.stream(index=mid, follow=False))
+            want = [(e["Index"], e["Topic"], e["Type"], e["Key"])
+                    for e in events if e["Index"] >= mid]
+            got = [(e["Index"], e["Topic"], e["Type"], e["Key"])
+                   for e in resumed]
+            assert set(want) <= set(got), "resume observed a gap"
+        finally:
+            stop.set()
+            fault.disarm()
+            a.shutdown()

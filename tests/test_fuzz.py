@@ -376,3 +376,26 @@ class TestDifferentialFuzz:
         # convergence is already pinned per-world by I4.
         assert worlds["oracle"].placed_counts(service_only=True) == \
             worlds["tpu-batch"].placed_counts(service_only=True)
+
+    @pytest.mark.parametrize("seed", [7, 23])
+    def test_fuzz_interleaved_replay_tight(self, seed):
+        """Tight deterministic I5 variant (ADVICE r5): the sequential
+        replay above keeps a loose 60% pre-drain bound because the
+        RNG-cascade noise compounds across a whole run per world; the
+        SAME script applied op-by-op to both worlds interleaved keeps
+        each divergence local to one step and converges near-exactly
+        (measured: diff 0 for seed 7, 1 for seed 23) — so the original
+        tight max(4, 0.2·max) bound holds and the differential keeps a
+        real oracle-vs-kernel signal, not just a dead-engine check."""
+        script = make_script(seed, steps=60)
+        worlds = {kind: FuzzWorld(kind) for kind in ("oracle", "tpu-batch")}
+        for op in script:
+            for w in worlds.values():
+                w.apply(op)
+        counts = {kind: w.placed_counts() for kind, w in worlds.items()}
+        a = sum(counts["oracle"].values())
+        b = sum(counts["tpu-batch"].values())
+        assert abs(a - b) <= max(4, 0.2 * max(a, b)), \
+            (counts["oracle"], counts["tpu-batch"])
+        for w in worlds.values():
+            w.check_invariants()

@@ -88,6 +88,43 @@ class TestRenewalHeap:
         finally:
             cvc.stop()
 
+    def test_zero_ttl_never_enters_heap(self):
+        """ADVICE r5 vault.py:208: a missing lease_duration used to land
+        a ttl=0.0 token in the renewal heap — an immediate, never-ending
+        renewal churn loop.  ttl<=0 is now refused outright."""
+        fv = FakeVault()
+        out = fv.create_token(["p"], 60.0, {})
+        cvc = ClientVaultClient(derive_fn=None, renew_fn=fv.renew_token)
+        cvc.start()
+        try:
+            cvc.renew_token(out["token"], 0.0)
+            cvc.renew_token(out["token"], -1.0)
+            assert cvc.num_tracked() == 0
+            time.sleep(0.3)
+            assert fv.renew_calls == 0
+        finally:
+            cvc.stop()
+
+    def test_unwrap_without_lease_falls_back_to_envelope_ttl(self):
+        """ADVICE r5: when the unwrap response omits lease_duration, the
+        derived-token dict falls back to the wrapped envelope's
+        requested TTL instead of 0.0."""
+        fv = FakeVault()
+
+        def derive_fn(alloc_id, tasks):
+            out = fv.create_token(["p"], 42.0, {}, wrap_ttl=60.0)
+            return {"web": out}
+
+        def unwrap_no_lease(wrapping_token):
+            secret = fv.unwrap(wrapping_token)
+            return {"token": secret["token"],
+                    "accessor": secret["accessor"], "ttl": 0.0}
+
+        cvc = ClientVaultClient(derive_fn=derive_fn, renew_fn=None,
+                                unwrap_fn=unwrap_no_lease)
+        out = cvc.derive_token("a1", ["web"])
+        assert out["web"]["ttl"] == 42.0
+
     def test_stop_renew_stops(self):
         fv = FakeVault()
         out = fv.create_token(["p"], 0.2, {})
