@@ -10,7 +10,12 @@ Covers:
 - the degradation plane: a breaker drill injects one corrupted kernel
   batch (fault point ``ops.kernel_result``) and asserts the circuit
   breaker trips, every eval still completes via the CPU oracle, and a
-  clean half-open probe restores the device path.
+  clean half-open probe restores the device path;
+- the device-resident node-state cache: encode → delta-apply →
+  differential verify against a fresh full encode (the guard, armed at
+  every hit) → staleness-fence fallback for an old snapshot → breaker
+  trip on injected resident corruption (fault point
+  ``ops.resident_state``).
 """
 from __future__ import annotations
 
@@ -199,6 +204,124 @@ def tracing_drill(seed: int = 0, log=print) -> bool:
     return True
 
 
+def residency_drill(seed: int = 0, log=print) -> bool:
+    """Device-resident cache drill: cold encode installs the mirror, a
+    second batch takes the delta path with the differential guard armed
+    at EVERY hit (so delta-apply is verified against a fresh full
+    encode), a stale snapshot falls back over the staleness fence, and
+    injected resident corruption trips a private breaker."""
+    import os
+
+    from .. import fault, mock
+    from ..scheduler import Harness
+    from ..structs import structs as s
+    from . import resident
+    from .batch_sched import TPUBatchScheduler
+    from .breaker import KernelCircuitBreaker
+
+    def check(cond, msg):
+        if not cond:
+            log(f"residency drill: FAIL — {msg}")
+        return cond
+
+    saved = {k: os.environ.get(k) for k in
+             ("NOMAD_TPU_RESIDENT", "NOMAD_TPU_RESIDENT_GUARD_EVERY")}
+    os.environ["NOMAD_TPU_RESIDENT"] = "1"
+    os.environ["NOMAD_TPU_RESIDENT_GUARD_EVERY"] = "1"
+    resident.reset_counters()
+    brk = KernelCircuitBreaker(threshold=0.9, window=8, min_checks=1,
+                               cooldown=3600.0)
+    try:
+        h = Harness()
+        for _ in range(8):
+            node = mock.node()
+            node.resources.networks = []
+            node.reserved.networks = []
+            node.compute_class()
+            h.state.upsert_node(h.next_index(), node)
+
+        def make_batch_job():
+            job = mock.job()
+            for tg in job.task_groups:
+                for t in tg.tasks:
+                    t.resources.networks = []
+            job.task_groups[0].count = 2
+            h.state.upsert_job(h.next_index(), job)
+            return job
+
+        def run_batch(state=None, job=None):
+            if job is None:
+                job = make_batch_job()
+            ev = s.Evaluation(
+                id=s.generate_uuid(), priority=job.priority, type=job.type,
+                triggered_by=s.EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
+                status=s.EVAL_STATUS_PENDING)
+            sched = TPUBatchScheduler(
+                h.logger, state if state is not None else h.snapshot(),
+                h, breaker=brk)
+            stats = sched.schedule_batch([ev])
+            placed = len([a for a in
+                          h.state.allocs_by_job(None, job.id, True)
+                          if not a.terminal_status()]) == 2
+            return stats, placed
+
+        s1, p1 = run_batch()
+        if not (check(s1.full_reencodes == 1 and not s1.resident_hits,
+                      f"cold batch should full-encode ({s1!r})")
+                and check(p1, "cold batch did not place")):
+            return False
+        s2, p2 = run_batch()
+        if not (check(s2.resident_hits == 1,
+                      f"second batch should take the delta path ({s2!r})")
+                and check(p2, "delta batch did not place")
+                and check(resident.GUARD_RUNS >= 1
+                          and resident.GUARD_MISMATCHES == 0,
+                          "differential guard did not verify the delta "
+                          "apply against a fresh encode")):
+            return False
+
+        # Staleness fence: a snapshot two batches old must full-encode
+        # without touching the (newer) mirror.  The fence job registers
+        # BEFORE the snapshot so the stale world can see it.
+        fence_job = make_batch_job()
+        stale = h.snapshot()
+        run_batch()
+        run_batch()
+        cached = resident._STATE.alloc_index
+        s3, p3 = run_batch(state=stale, job=fence_job)
+        if not (check(s3.staleness_fences == 1 and s3.full_reencodes == 1,
+                      f"stale snapshot did not take the fence ({s3!r})")
+                and check(p3, "fenced batch did not place")
+                and check(resident._STATE.alloc_index == cached,
+                          "fence regressed the resident mirror")):
+            return False
+
+        # Injected resident corruption: guard catches it, breaker trips,
+        # the batch still places from the fresh full encode.
+        with fault.scenario({"seed": seed, "faults": [
+                {"point": "ops.resident_state", "action": "corrupt",
+                 "times": 1}]}):
+            s4, p4 = run_batch()
+        if not (check(resident.GUARD_MISMATCHES == 1,
+                      "guard missed the injected corruption")
+                and check(brk.state == "open",
+                          f"breaker {brk.state!r}, expected open")
+                and check(p4, "corrupted-mirror batch did not place")):
+            return False
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        resident.reset_counters()
+    log("residency drill: OK — cold encode installed the mirror, delta "
+        "apply verified bit-identical by the guard, stale snapshot took "
+        "the fence, injected corruption tripped the breaker "
+        f"(guard runs={resident.GUARD_RUNS or 'reset'})")
+    return True
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="python -m nomad_tpu.ops")
     parser.add_argument("--selfcheck", action="store_true",
@@ -213,6 +336,7 @@ def main(argv=None) -> int:
     ok = selfcheck(n_nodes=args.nodes, n_specs=args.specs, seed=args.seed)
     ok = breaker_drill(seed=args.seed) and ok
     ok = tracing_drill(seed=args.seed) and ok
+    ok = residency_drill(seed=args.seed) and ok
     return 0 if ok else 1
 
 

@@ -40,6 +40,7 @@ from ..scheduler.util import AllocTuple, ready_nodes_in_dcs, set_status
 from ..structs import structs as s
 from . import breaker as breaker_mod
 from . import encode, kernels, xfer
+from . import resident
 from .breaker import HALF_OPEN, KernelIntegrityError
 from .kernels import device_pass, summary_layout
 
@@ -235,6 +236,26 @@ class _CollectingScheduler(GenericScheduler):
             for tg, names, prevs in order]
 
 
+class _PreparedBatch:
+    """One batch between prepare and complete: the host-phase outputs
+    plus the in-flight device handle (schedule_stream pipelining keeps
+    at most one of these between dispatch and complete)."""
+
+    __slots__ = ("evals", "scheds", "specs", "spec_list", "stats", "t0",
+                 "handle", "probe", "routed")
+
+    def __init__(self, evals):
+        self.evals = evals
+        self.scheds = []
+        self.specs = {}
+        self.spec_list = []
+        self.stats = BatchStats()
+        self.t0 = time.monotonic()
+        self.handle = None      # _dispatch_device output (device in flight)
+        self.probe = False      # this batch is the breaker's half-open probe
+        self.routed = False     # breaker-open: already oracle-processed
+
+
 class TPUBatchScheduler:
     """Factory-registered 'tpu-batch' scheduler.
 
@@ -245,10 +266,14 @@ class TPUBatchScheduler:
 
     def __init__(self, logger_: logging.Logger, state, planner, mesh=None,
                  preemption_enabled: Optional[bool] = None, breaker=None,
-                 metrics=None):
+                 metrics=None, snapshot_index: Optional[int] = None):
         self.logger = logger_
         self.state = state
         self.planner = planner
+        # Raft applied index captured when ``state`` was snapshotted
+        # (worker plumbing): rides the batch.schedule span so residency
+        # fence events can be lined up against plan-apply indexes.
+        self.snapshot_index = snapshot_index
         self.metrics = metrics if metrics is not None else NULL_TELEMETRY
         # Optional jax.sharding.Mesh: when set, the placement loop runs
         # node-sharded over THIS scheduler's device slice
@@ -300,7 +325,11 @@ class TPUBatchScheduler:
                 stats = self._schedule_batch(evals)
                 sp.set(num_specs=stats.num_specs, num_asks=stats.num_asks,
                        breaker_state=stats.breaker_state,
-                       oracle_routed=stats.oracle_routed)
+                       oracle_routed=stats.oracle_routed,
+                       resident_hits=stats.resident_hits,
+                       delta_rows=stats.delta_rows)
+                if self.snapshot_index is not None:
+                    sp.set(snapshot_index=self.snapshot_index)
         self._emit_batch_stats(stats)
         return stats
 
@@ -327,6 +356,21 @@ class TPUBatchScheduler:
             m.add_sample("worker.invoke_scheduler.finalize",
                          stats.finalize_seconds * 1000.0)
         m.add_sample("worker.invoke_scheduler.asks", stats.num_asks)
+        # Residency counters: per-batch samples plus the process-lifetime
+        # gauges (ops/resident.py module counters).
+        if stats.resident_hits:
+            m.incr_counter("batch.resident_hits", stats.resident_hits)
+            m.add_sample("batch.delta_rows", stats.delta_rows)
+        if stats.full_reencodes:
+            m.incr_counter("batch.full_reencodes", stats.full_reencodes)
+        if stats.staleness_fences:
+            m.incr_counter("batch.staleness_fences", stats.staleness_fences)
+        if stats.pipeline_overlap_s:
+            m.add_sample("batch.pipeline_overlap",
+                         stats.pipeline_overlap_s * 1000.0)
+        if resident.GUARD_MISMATCHES:
+            m.set_gauge("batch.resident_guard_mismatches",
+                        resident.GUARD_MISMATCHES)
         if MESH_SCORE_GAP_PASSES:
             m.set_gauge("batch.mesh_score_gap_passes",
                         MESH_SCORE_GAP_PASSES)
@@ -342,9 +386,91 @@ class TPUBatchScheduler:
             m.incr_counter("breaker.kernel_rejects", stats.kernel_rejects)
 
     def _schedule_batch(self, evals: List[s.Evaluation]) -> "BatchStats":
-        stats = BatchStats()
-        t0 = time.monotonic()
-        self._preempt_plan = {}
+        """Serial path: prepare → dispatch → complete in one call.  The
+        double-buffered schedule_stream() drives the same three phases
+        with batch k+1's prepare overlapping batch k's device pass."""
+        prep = self._prepare_batch(evals)
+        self._dispatch_prepared(prep)
+        return self._complete_prepared(prep)
+
+    # -- pipelined batch API -----------------------------------------------
+
+    def schedule_stream(self, batches, state_source=None) -> List["BatchStats"]:
+        """Async double-buffered pipeline over a stream of eval batches:
+        batch k's device pass is dispatched without blocking (JAX async
+        dispatch), batch k+1's host reconciliation/spec phases run while
+        k computes, then k is fetched + finalized before k+1's usage
+        delta is built and dispatched — so the delta feed always reflects
+        k's applied plans (no optimistic usage).
+
+        ``state_source`` (callable → state snapshot) is re-invoked before
+        each prepare and again before each dispatch, so the dispatch-time
+        encode sees every plan the previous batch applied.  Instance
+        bookkeeping (_preempt_plan, _allocs_by_node) is per-batch-in-
+        flight: the prepare(k+1) → complete(k) → dispatch(k+1) ordering
+        keeps at most one batch between dispatch and complete.
+
+        Exceptions propagate after the in-flight batch is completed;
+        callers that need per-batch nack semantics (the BatchWorker)
+        drive _prepare_batch/_dispatch_prepared/_complete_prepared
+        directly.
+
+        Accounting note: a pipelined batch's ``total_seconds`` is its
+        wall-clock LATENCY (prepare → finalize), which includes the
+        neighbor batches' host phases interleaved on this thread — the
+        per-batch samples measure what an eval experiences, and their
+        sum exceeds the stream's wall time by design.  Throughput claims
+        come from the stream's own elapsed time (bench config_steady's
+        sustained placed/s), never from summing batch totals."""
+        out: List[BatchStats] = []
+        pending = None
+        try:
+            for evals in batches:
+                if state_source is not None:
+                    self.state = state_source()
+                t_prep = time.monotonic()
+                prep = self._prepare_batch(evals)
+                overlap = (time.monotonic() - t_prep
+                           if pending is not None else 0.0)
+                if pending is not None:
+                    out.append(self._finish_stream(pending))
+                    pending = None
+                if state_source is not None:
+                    self.state = state_source()
+                prep.stats.pipeline_overlap_s = overlap
+                self._dispatch_prepared(prep)
+                pending = prep
+        except BaseException:
+            # A later batch's prepare/dispatch failing must not strand
+            # the dispatched in-flight batch: its device results would
+            # never be fetched, its plans never submitted, and a
+            # half-open probe it carries never resolved.
+            if pending is not None:
+                try:
+                    out.append(self._finish_stream(pending))
+                except Exception:
+                    self.logger.exception(
+                        "in-flight batch failed during stream unwind")
+            raise
+        if pending is not None:
+            out.append(self._finish_stream(pending))
+        return out
+
+    def _finish_stream(self, prep) -> "BatchStats":
+        stats = self._complete_prepared(prep)
+        tr = tracing.TRACER
+        if tr is not None:
+            tr.record("batch.schedule", prep.t0, time.monotonic(),
+                      num_evals=stats.num_evals, num_specs=stats.num_specs,
+                      resident_hits=stats.resident_hits,
+                      pipeline_overlap_s=round(stats.pipeline_overlap_s, 4),
+                      **tracing.eval_id_attrs(prep.evals, len(prep.evals)))
+        self._emit_batch_stats(stats)
+        return stats
+
+    def _prepare_batch(self, evals: List[s.Evaluation]) -> "_PreparedBatch":
+        prep = _PreparedBatch(evals)
+        stats = prep.stats
 
         # Phase 1: host reconciliation per eval (shared oracle code).
         t_phase1 = time.monotonic()
@@ -420,33 +546,72 @@ class TPUBatchScheduler:
                       t_phase2 + stats.phase2_seconds,
                       num_specs=stats.num_specs, num_asks=stats.num_asks)
 
+        prep.evals = evals
+        prep.scheds = scheds
+        prep.specs = specs
+        prep.spec_list = spec_list
+        return prep
+
+    def _dispatch_prepared(self, prep: "_PreparedBatch") -> None:
+        """Stage 2: breaker gate + encode/delta-build + async device
+        dispatch.  On return the device pass is in flight (or the batch
+        was routed to the oracle / has no asks); nothing has blocked on
+        device results yet."""
+        stats = prep.stats
+        self._preempt_plan = {}
+        if not prep.spec_list:
+            return
+
+        # Circuit breaker gate: while OPEN every eval takes the CPU
+        # oracle (correct, slower); HALF-OPEN lets this one batch
+        # probe the device path and its verdict resolves the probe.
+        if not self.breaker.allow_kernel():
+            stats.breaker_state = self.breaker.state
+            stats.oracle_routed = len(prep.scheds)
+            self.logger.info(
+                "batch: kernel breaker %s; routing %d evals through "
+                "the CPU oracle", stats.breaker_state, len(prep.scheds))
+            tracing.event("batch.oracle_routed", reason="breaker_open",
+                          breaker_state=stats.breaker_state,
+                          num_evals=len(prep.scheds))
+            self._route_through_oracle(prep.scheds)
+            prep.routed = True
+            return
+        prep.probe = self.breaker.state == HALF_OPEN
+        try:
+            prep.handle = self._dispatch_device(prep.spec_list)
+        except Exception:
+            # A host-side encode/upload error must still feed the
+            # breaker and resolve an outstanding probe before
+            # propagating (the worker nacks the batch).
+            self.breaker.record(False)
+            if prep.probe:
+                self.breaker.on_probe(False)
+            raise
+
+    def _complete_prepared(self, prep: "_PreparedBatch") -> "BatchStats":
+        """Stage 3: blocking fetch of the device results, breaker
+        bookkeeping, and per-eval plan finalize/submit."""
+        stats = prep.stats
+        evals, scheds = prep.evals, prep.scheds
+        tr = tracing.TRACER
+
+        if prep.routed:
+            stats.total_seconds = time.monotonic() - prep.t0
+            stats.num_evals = len(evals)
+            return stats
+
         # Per-spec flat slot lists (node id per placement), expanded on
-        # the numpy side in _place_on_device.
+        # the numpy side in _fetch_device.
         expanded: Dict[Tuple[str, str], List[str]] = {}
         unplaced: Dict[Tuple[str, str], int] = {}
         per_spec_metrics: Dict[Tuple[str, str], s.AllocMetric] = {}
 
-        if spec_list:
-            # Circuit breaker gate: while OPEN every eval takes the CPU
-            # oracle (correct, slower); HALF-OPEN lets this one batch
-            # probe the device path and its verdict resolves the probe.
-            if not self.breaker.allow_kernel():
-                stats.breaker_state = self.breaker.state
-                stats.oracle_routed = len(scheds)
-                self.logger.info(
-                    "batch: kernel breaker %s; routing %d evals through "
-                    "the CPU oracle", stats.breaker_state, len(scheds))
-                tracing.event("batch.oracle_routed", reason="breaker_open",
-                              breaker_state=stats.breaker_state,
-                              num_evals=len(scheds))
-                self._route_through_oracle(scheds)
-                stats.total_seconds = time.monotonic() - t0
-                stats.num_evals = len(evals)
-                return stats
-            probe = self.breaker.state == HALF_OPEN
+        if prep.handle is not None:
+            probe = prep.probe
             try:
                 expanded, unplaced, per_spec_metrics, kstats = \
-                    self._place_on_device(spec_list)
+                    self._fetch_device(prep.handle)
             except KernelIntegrityError as e:
                 # Corrupt kernel output: reject the whole device result,
                 # feed the breaker, and degrade this batch to the oracle
@@ -460,11 +625,16 @@ class TPUBatchScheduler:
                 stats.kernel_rejects = 1
                 stats.oracle_routed = len(scheds)
                 stats.breaker_state = self.breaker.state
+                # The encode DID run (and may have consumed/advanced the
+                # resident mirror) — the degraded batch must still report
+                # its residency truthfully.
+                self._apply_resident_stats(
+                    stats, prep.handle.get("resident") or {})
                 tracing.event("batch.oracle_routed", reason="kernel_reject",
                               breaker_state=stats.breaker_state,
                               num_evals=len(scheds), detail=str(e))
                 self._route_through_oracle(scheds)
-                stats.total_seconds = time.monotonic() - t0
+                stats.total_seconds = time.monotonic() - prep.t0
                 stats.num_evals = len(evals)
                 return stats
             except Exception:
@@ -498,21 +668,29 @@ class TPUBatchScheduler:
             stats.preempt_evicted = kstats.get("preempt_evicted", 0)
             stats.preempt_checked = kstats.get("preempt_checked", 0)
             stats.preempt_agree = kstats.get("preempt_agree", 0)
+            self._apply_resident_stats(stats, kstats.get("resident") or {})
 
         # Phase 3: materialize allocs into each eval's plan and submit.
         t_final = time.monotonic()
         net_index_cache: Dict[str, "NetworkIndex"] = {}
         for ev, sched in scheds:
-            self._finalize(ev, sched, specs, expanded, unplaced,
+            self._finalize(ev, sched, prep.specs, expanded, unplaced,
                            per_spec_metrics, net_index_cache)
         stats.finalize_seconds = time.monotonic() - t_final
         if tr is not None:
             tr.record("batch.finalize", t_final,
                       t_final + stats.finalize_seconds)
 
-        stats.total_seconds = time.monotonic() - t0
+        stats.total_seconds = time.monotonic() - prep.t0
         stats.num_evals = len(evals)
         return stats
+
+    @staticmethod
+    def _apply_resident_stats(stats: "BatchStats", res_info: Dict) -> None:
+        stats.resident_hits = 1 if res_info.get("resident_hit") else 0
+        stats.delta_rows = res_info.get("delta_rows", 0)
+        stats.full_reencodes = 1 if res_info.get("full_reencode") else 0
+        stats.staleness_fences = 1 if res_info.get("fence") else 0
 
     def _route_through_oracle(self, scheds) -> None:
         """Degraded path: process each eval with the CPU GenericScheduler
@@ -586,11 +764,12 @@ class TPUBatchScheduler:
     # -- device pass -------------------------------------------------------
 
     def _place_on_device(self, spec_list: List[encode.PlacementSpec]):
-        t0 = time.monotonic()
-        # All DCs across the batch: nodes are encoded once.
-        all_nodes = [n for n in self.state.nodes(None)]
+        return self._fetch_device(self._dispatch_device(spec_list))
 
-        attr_targets, literals = encode.collect_attr_targets(spec_list)
+    def _live_allocs_by_node(self) -> Dict[str, List[s.Allocation]]:
+        """Full state walk: every live alloc row grouped by node — the
+        reference usage basis (and the resident cache's rebuild/guard
+        input)."""
         allocs_by_node: Dict[str, List[s.Allocation]] = defaultdict(list)
         alloc_rows = getattr(self.state, "alloc_rows", None)
         if alloc_rows is not None:
@@ -601,8 +780,18 @@ class TPUBatchScheduler:
             for alloc in self.state.allocs(None):
                 if not alloc.terminal_status():
                     allocs_by_node[alloc.node_id].append(alloc)
+        return allocs_by_node
 
-        self._allocs_by_node = allocs_by_node
+    def _dispatch_device(self, spec_list: List[encode.PlacementSpec]):
+        """Host encode + async device dispatch: everything up to (but
+        not including) the blocking fetch.  Returns the in-flight handle
+        _fetch_device consumes — the split point the double-buffered
+        pipeline overlaps across batches."""
+        t0 = time.monotonic()
+        # All DCs across the batch: nodes are encoded once.
+        all_nodes = [n for n in self.state.nodes(None)]
+
+        attr_targets, literals = encode.collect_attr_targets(spec_list)
         with_networks = any(sp.net_active for sp in spec_list)
         # Static cluster tensors are cached across batches keyed by the
         # nodes-table raft index (+ the constraint vocabulary): a stable
@@ -615,6 +804,8 @@ class TPUBatchScheduler:
         if table_index is not None and store_uid is not None:
             lit_key = tuple(sorted(
                 (t, tuple(sorted(vs))) for t, vs in literals.items()))
+            # Slot layout (store_uid, nodes_index, ...) is relied on by
+            # ops/resident.py's old-nodes-index staleness fence.
             cache_key = (store_uid, table_index("nodes"),
                          tuple(attr_targets), lit_key, with_networks)
             base = _CLUSTER_CACHE.pop(cache_key, None)
@@ -628,15 +819,42 @@ class TPUBatchScheduler:
                 _CLUSTER_CACHE[cache_key] = base
                 while len(_CLUSTER_CACHE) > 4:
                     _CLUSTER_CACHE.pop(next(iter(_CLUSTER_CACHE)))
-        ct = (encode.apply_alloc_usage(base, allocs_by_node)
-              if allocs_by_node else base)
+        node_index = base._node_index  # type: ignore[attr-defined]
+
+        # Usage rows: device-resident delta path (ops/resident.py) when
+        # eligible — O(changed allocs) via the state store's usage-delta
+        # feed — otherwise the full O(cluster) walk + layer.
+        resident_info: Dict = {}
+        use_resident = (resident.enabled() and not with_networks
+                        and cache_key is not None
+                        and getattr(self.state, "allocs_since", None)
+                        is not None)
+        if use_resident:
+            # The usage mirror depends only on the node set, not the
+            # batch's constraint vocabulary — key it by (store lineage,
+            # nodes index) so residency survives vocabulary changes.
+            used, touched, resident_info = resident.acquire(
+                self.state, cache_key[:2], base, self._live_allocs_by_node,
+                breaker=self.breaker)
+            ct = encode.with_usage(base, used)
+            # The preemption pass only needs WHICH nodes may carry live
+            # allocs (it re-materializes candidate rows from state);
+            # avoid the full row walk the resident path just saved.
+            self._allocs_by_node = {base.node_ids[i]: True for i in touched}
+        else:
+            allocs_by_node = self._live_allocs_by_node()
+            self._allocs_by_node = allocs_by_node
+            ct = (encode.apply_alloc_usage(base, allocs_by_node)
+                  if allocs_by_node else base)
+            touched = sorted(i for i in (node_index.get(nid)
+                                         for nid in allocs_by_node)
+                             if i is not None)
         st = encode.encode_specs(spec_list, ct, all_nodes)
 
         # Existing per-(job, node) alloc counts for anti-affinity/distinct,
         # uploaded SPARSE and scattered dense on device: the dense U×N
         # matrix is mostly zeros and the tunneled host↔device link is the
         # bottleneck at scale.
-        node_index = {nid: i for i, nid in enumerate(ct.node_ids)}
         jc_entries: Dict[Tuple[int, int], int] = {}
         rows_by_job = getattr(self.state, "alloc_rows_by_job", None)
         for j, job_id in enumerate(st.job_ids):
@@ -653,9 +871,12 @@ class TPUBatchScheduler:
                     jc_entries[(j, idx)] = jc_entries.get((j, idx), 0) + 1
         if self.mesh is not None:
             if ct.n_pad % self.mesh.devices.size == 0:
-                return self._place_on_mesh(
+                # The sharded kernel blocks internally (gathered results);
+                # wrap the finished tuple so _fetch_device is a no-op.
+                done = self._place_on_mesh(
                     spec_list, all_nodes, ct, st, jc_entries,
                     with_networks, t0)
+                return {"done": done, "resident": resident_info}
             self.logger.warning(
                 "mesh size %d does not divide node pad %d; using the "
                 "single-chip path", self.mesh.devices.size, ct.n_pad)
@@ -685,10 +906,9 @@ class TPUBatchScheduler:
                           port_words_base=base.port_words)
 
         # Sparse usage deltas over the static reserved-only baseline: one
-        # row per node carrying live allocs this batch.
-        touched = sorted(i for i in (node_index.get(nid)
-                                     for nid in allocs_by_node)
-                         if i is not None)
+        # row per node carrying live allocs this batch (``touched`` comes
+        # from the resident cache on the delta path, from the full walk
+        # otherwise).
         k_u = encode.pow2_bucket(max(1, len(touched)), minimum=8)
         u_rows = np.full(k_u, -1, dtype=np.int32)
         u_vals = np.zeros((k_u, 4), dtype=np.int32)
@@ -792,6 +1012,34 @@ class TPUBatchScheduler:
                 meta_d=meta_d, u_pad=st.u_pad, n_pad=ct.n_pad,
                 with_networks=with_networks, with_dp=with_dp,
                 with_scores=with_scores, max_nnz=max_nnz, slot_m=slot_m)
+        # Device pass is dispatched (JAX async); the blocking fetch lives
+        # in _fetch_device so a pipelining caller can overlap host work.
+        return {
+            "spec_list": spec_list, "all_nodes": all_nodes, "ct": ct,
+            "st": st, "feas": feas, "summary_buf": summary_buf,
+            "coo_mat": coo_mat, "slot_m": slot_m,
+            "with_scores": with_scores, "max_nnz": max_nnz,
+            "encode_seconds": encode_seconds, "t1": t1,
+            "resident": resident_info,
+        }
+
+    def _fetch_device(self, handle):
+        """Blocking fetch + decode + shared post-processing of an
+        in-flight _dispatch_device handle."""
+        done = handle.get("done")
+        if done is not None:
+            expanded, unplaced, metrics, kstats = done
+            kstats.setdefault("resident", handle.get("resident") or {})
+            return expanded, unplaced, metrics, kstats
+        spec_list = handle["spec_list"]
+        all_nodes = handle["all_nodes"]
+        ct, st = handle["ct"], handle["st"]
+        feas = handle["feas"]
+        summary_buf, coo_mat = handle["summary_buf"], handle["coo_mat"]
+        slot_m = handle["slot_m"]
+        with_scores = handle["with_scores"]
+        max_nnz = handle["max_nnz"]
+
         t_disp = time.monotonic()
         dbg = os.environ.get("NOMAD_TPU_TIMING")
         if slot_m:
@@ -870,10 +1118,12 @@ class TPUBatchScheduler:
                 coo_scores = np.zeros(len(coo), dtype=np.float32)
                 coo_coll = np.zeros(len(coo), dtype=np.int32)
 
-        return self._finalize_device_outputs(
+        expanded, unplaced, metrics, kstats = self._finalize_device_outputs(
             spec_list, all_nodes, ct, st, feas, unplaced_arr, feas_count,
             coo_rows, coo_cols, coo_counts, coo_scores, coo_coll,
-            rounds, with_scores, encode_seconds, t1)
+            rounds, with_scores, handle["encode_seconds"], handle["t1"])
+        kstats["resident"] = handle.get("resident") or {}
+        return expanded, unplaced, metrics, kstats
 
     def _place_on_mesh(self, spec_list, all_nodes, ct, st, jc_entries,
                        with_networks, t0):
@@ -1685,6 +1935,18 @@ class BatchStats:
         # True only when _place_on_device ran to completion — gates the
         # encode/device/rounds telemetry samples.
         self.device_ran = False
+        # Device-resident node-state cache (ops/resident.py): whether the
+        # usage rows came from the delta path this batch, how many feed
+        # entries were applied, full re-encodes (cold/key-change/feed-gap/
+        # guard-mismatch) and staleness-fence fallbacks.
+        self.resident_hits = 0
+        self.delta_rows = 0
+        self.full_reencodes = 0
+        self.staleness_fences = 0
+        # Host time of THIS batch's prepare phase that ran while the
+        # previous batch's device pass was still in flight
+        # (schedule_stream double-buffering; 0 on the serial path).
+        self.pipeline_overlap_s = 0.0
 
     def __repr__(self) -> str:
         extra = ""
@@ -1695,6 +1957,14 @@ class BatchStats:
         if self.oracle_routed or self.breaker_state != "closed":
             extra += (f" breaker={self.breaker_state}"
                       f" oracle_routed={self.oracle_routed}")
+        if self.resident_hits or self.full_reencodes or self.staleness_fences:
+            extra += (f" resident={'hit' if self.resident_hits else 'miss'}"
+                      f" delta_rows={self.delta_rows}"
+                      f" full_reencodes={self.full_reencodes}")
+            if self.staleness_fences:
+                extra += f" fences={self.staleness_fences}"
+        if self.pipeline_overlap_s:
+            extra += f" overlap={self.pipeline_overlap_s:.3f}s"
         return (f"BatchStats(evals={self.num_evals} specs={self.num_specs} "
                 f"asks={self.num_asks} phase1={self.phase1_seconds:.3f}s "
                 f"phase2={self.phase2_seconds:.3f}s "

@@ -267,9 +267,12 @@ def apply_alloc_usage(
     """Layer live-allocation usage onto (a shallow copy of) the static
     cluster tensors — the cached static part is never mutated.
 
-    Resource usage adds each alloc's combined (or per-task) resources;
-    network accounting re-derives each TOUCHED node's used-port set from
-    reserved + alloc networks, exactly like the fused loop this replaces."""
+    Resource usage adds each alloc's combined (or per-task) resources —
+    the numpy twin of structs.alloc_usage_vec (the delta feed's canonical
+    basis; the resident differential guard pins their bit-equality, so a
+    change to either must land in both); network accounting re-derives
+    each TOUCHED node's used-port set from reserved + alloc networks,
+    exactly like the fused loop this replaces."""
     import dataclasses as _dc
 
     new = _dc.replace(
@@ -324,6 +327,22 @@ def apply_alloc_usage(
             in_dyn = sum(1 for p in used_ports
                          if MIN_DYNAMIC_PORT <= p < MAX_DYNAMIC_PORT)
             new.dyn_free[i] = (MAX_DYNAMIC_PORT - MIN_DYNAMIC_PORT) - in_dyn
+    return new
+
+
+def with_usage(ct: ClusterTensors, used) -> ClusterTensors:
+    """Clone the static cluster tensors with a caller-provided usage
+    matrix — the device-resident delta path's twin of apply_alloc_usage
+    (ops/resident.py maintains ``used`` incrementally instead of walking
+    every live alloc).  Network accounting keeps the static baseline;
+    the resident path is gated to batches without network asks."""
+    import dataclasses as _dc
+
+    new = _dc.replace(ct, used=used)
+    for attr in ("_raw_rows", "_value_sets", "_class_codebook", "_nodes",
+                 "_with_networks", "_node_index"):
+        if hasattr(ct, attr):
+            setattr(new, attr, getattr(ct, attr))
     return new
 
 

@@ -1,0 +1,306 @@
+"""Device-resident cluster node-state cache (PR 5 tentpole).
+
+The batch scheduler used to rebuild per-node alloc USAGE from a full
+state-store walk every ``schedule_batch`` — O(cluster) host work per
+batch even when only a handful of allocs changed since the last one.
+This module keeps the usage matrix RESIDENT between batches, keyed by
+the same static-cluster cache key batch_sched already maintains
+(store lineage + nodes-table raft index + constraint vocabulary), and
+catches it up with the state store's usage-delta feed
+(``StateStore.allocs_since``) — O(changed allocs) per batch, the
+Megatron/Pathways persistent-device-state trick applied to the
+scheduler's cluster mirror.
+
+Correctness machinery:
+
+- **Staleness fence**: a scheduler running against a snapshot OLDER
+  than the resident state (its allocs index is behind the cached one —
+  e.g. a replayed eval or a harness snapshot) full re-encodes from its
+  own snapshot and leaves the resident state untouched.
+- **Feed gap**: when ``allocs_since`` cannot answer (the cached index
+  fell off the bounded log, or a restore reset the feed) the cache is
+  rebuilt from a full walk and the event stream gets a
+  ``NodeStateDelta`` summary so operators see residency churn.
+- **Differential guard**: every ``NOMAD_TPU_RESIDENT_GUARD_EVERY``
+  delta hits (default 64) the full walk runs anyway and must match the
+  resident matrix bit-for-bit.  A mismatch feeds the PR 2 circuit
+  breaker (``record(False)``), invalidates the cache, publishes the
+  mismatch on the event stream, and the batch proceeds on the fresh
+  full encode — corruption degrades, never mis-places.
+
+Scope: usage rows only (capacity/attrs/eligibility invalidate via the
+nodes-table index in the cache key), and only batches WITHOUT network
+asks — port-bitmap deltas are not expressible in the feed, so network
+batches keep the full-encode path.
+
+Env knobs:
+
+- ``NOMAD_TPU_RESIDENT``              — 0 disables residency (full
+  re-encode every batch; the bench's residency-off baseline)
+- ``NOMAD_TPU_RESIDENT_GUARD_EVERY``  — differential-guard cadence in
+  delta hits (0 disables the guard)
+- ``NOMAD_TPU_ALLOC_LOG_CAP``         — state-store feed bound (see
+  state/state_store.py)
+
+Fault point: ``ops.resident_state`` (action ``corrupt``) perturbs one
+resident usage row after a delta apply — the chaos twin of device/host
+mirror drift, caught by the differential guard.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .. import fault
+from ..utils import tracing
+
+logger = logging.getLogger("nomad_tpu.ops.resident")
+
+RES_DIMS = 4
+
+
+def enabled() -> bool:
+    return os.environ.get("NOMAD_TPU_RESIDENT", "1").strip().lower() not in (
+        "0", "false", "no")
+
+
+def guard_every() -> int:
+    try:
+        return int(os.environ.get("NOMAD_TPU_RESIDENT_GUARD_EVERY", "64"))
+    except ValueError:
+        return 64
+
+
+class ResidentState:
+    """One cached (static key → usage matrix) residency slot."""
+
+    __slots__ = ("key", "used", "alloc_index", "touched", "hits",
+                 "delta_rows", "since_guard")
+
+    def __init__(self, key: Tuple, used: np.ndarray, alloc_index: int,
+                 touched: set):
+        self.key = key
+        self.used = used                # [n_pad, 4] int64, owned by us
+        self.alloc_index = alloc_index  # allocs-table raft index mirrored
+        self.touched = touched          # rows that may differ from base
+        self.hits = 0
+        self.delta_rows = 0
+        self.since_guard = 0
+
+
+# Single residency slot (the steady-state workload schedules one cluster
+# shape; a key change — node churn, new constraint vocabulary — replaces
+# it wholesale), guarded by a lock: BatchWorker pipelining keeps batches
+# ordered, but tests/harnesses may race schedulers.
+_STATE: Optional[ResidentState] = None
+_LOCK = threading.Lock()
+
+# Module counters (telemetry bridge + tests).
+HITS = 0
+FULL_REENCODES = 0
+STALENESS_FALLBACKS = 0
+GUARD_RUNS = 0
+GUARD_MISMATCHES = 0
+
+# Last plan-apply index noted by the plan applier (server/plan_apply.py
+# index plumbing): rides the NodeStateDelta event payloads so operators
+# can line residency churn up against plan traffic.
+LAST_PLAN_INDEX = 0
+
+
+def note_plan_applied(index: int) -> None:
+    """Plan-applier hook: record the newest apply index.  The resident
+    fence itself keys off the snapshot's allocs-table index (the delta
+    feed is raft-index addressed); this breadcrumb is observability."""
+    global LAST_PLAN_INDEX
+    if index > LAST_PLAN_INDEX:
+        LAST_PLAN_INDEX = index
+
+
+def invalidate() -> None:
+    global _STATE
+    with _LOCK:
+        _STATE = None
+
+
+def reset_counters() -> None:
+    """Test helper: zero the module counters and drop the cache."""
+    global HITS, FULL_REENCODES, STALENESS_FALLBACKS, GUARD_RUNS
+    global GUARD_MISMATCHES
+    invalidate()
+    HITS = FULL_REENCODES = STALENESS_FALLBACKS = 0
+    GUARD_RUNS = GUARD_MISMATCHES = 0
+
+
+def _publish(etype_reason: str, **payload) -> None:
+    """NodeStateDelta summary on the PR 4 event stream (one branch while
+    disarmed, via the fault-module indirection that avoids importing the
+    server package)."""
+    fault.note_event_stream(
+        "Node", "NodeStateDelta", etype_reason,
+        dict(payload, Reason=etype_reason, PlanIndex=LAST_PLAN_INDEX))
+
+
+def _full_usage(base, rows_fn) -> Tuple[np.ndarray, set]:
+    """The reference rebuild: base reserved-only usage + every live
+    alloc row from a full state walk, on the canonical
+    structs.alloc_usage_vec basis (the same one the delta feed logs).
+    Returns (used int64, touched)."""
+    from ..structs.structs import alloc_usage_vec
+
+    used = np.asarray(base.used, dtype=np.int64).copy()
+    touched: set = set()
+    node_index = base._node_index  # type: ignore[attr-defined]
+    for nid, rows in rows_fn().items():
+        i = node_index.get(nid)
+        if i is None:
+            continue
+        for row in rows:
+            c, m, d, io = alloc_usage_vec(row)
+            used[i, 0] += c
+            used[i, 1] += m
+            used[i, 2] += d
+            used[i, 3] += io
+        touched.add(i)
+    return used, touched
+
+
+def acquire(state, cache_key: Tuple, base, rows_fn,
+            breaker=None) -> Tuple[np.ndarray, List[int], Dict]:
+    """Produce the live usage matrix for this batch.
+
+    ``state`` is the scheduler's snapshot, ``cache_key`` the residency
+    key ``(store_uid, nodes_table_index)`` — the usage matrix depends
+    only on the node set, NOT the batch's constraint vocabulary, so the
+    mirror survives vocabulary changes that re-key the static tensor
+    cache — ``base`` the finalized static ClusterTensors, ``rows_fn`` a
+    callable returning {node_id: [live alloc rows]} for the full-walk
+    fallback.
+
+    Returns ``(used int64 [n_pad, 4], touched_rows sorted list, info)``
+    where info carries the BatchStats counters:
+    ``resident_hit``/``delta_rows``/``full_reencode``/``fence``/
+    ``guard_ran``/``guard_mismatch``.
+    """
+    global _STATE, HITS, FULL_REENCODES, STALENESS_FALLBACKS
+    global GUARD_RUNS, GUARD_MISMATCHES
+
+    info = {"resident_hit": False, "delta_rows": 0, "full_reencode": False,
+            "fence": False, "guard_ran": False, "guard_mismatch": False}
+    snap_index = state.table_index("allocs")
+
+    with _LOCK:
+        st = _STATE
+        if (st is not None and st.key != cache_key
+                and st.key[0] == cache_key[0]
+                and cache_key[1] < st.key[1]):
+            # Key mismatch because the SNAPSHOT's nodes-table index is
+            # older than the mirror's (a replayed eval against a
+            # pre-node-churn world): same staleness fence as below — a
+            # one-off full encode that must NOT clobber the newer mirror.
+            STALENESS_FALLBACKS += 1
+            info["fence"] = True
+            info["full_reencode"] = True
+            used, touched = _full_usage(base, rows_fn)
+            tracing.event("resident.fence", snap_nodes_index=cache_key[1],
+                          cached_nodes_index=st.key[1])
+            _publish("staleness_fence", SnapshotNodesIndex=cache_key[1],
+                     CachedNodesIndex=st.key[1])
+            return used, sorted(touched), info
+        if st is not None and st.key == cache_key:
+            if snap_index < st.alloc_index:
+                # Staleness fence: this snapshot predates the resident
+                # mirror — serve it a one-off full encode and leave the
+                # cache at its newer position.
+                STALENESS_FALLBACKS += 1
+                info["fence"] = True
+                info["full_reencode"] = True
+                used, touched = _full_usage(base, rows_fn)
+                tracing.event("resident.fence", snap_index=snap_index,
+                              cached_index=st.alloc_index)
+                _publish("staleness_fence", SnapshotIndex=snap_index,
+                         CachedIndex=st.alloc_index)
+                return used, sorted(touched), info
+
+            deltas = (state.allocs_since(st.alloc_index)
+                      if snap_index > st.alloc_index else [])
+            if deltas is not None:
+                node_index = base._node_index  # type: ignore[attr-defined]
+                used = st.used
+                for nid, vec in deltas:
+                    i = node_index.get(nid)
+                    if i is None:
+                        continue
+                    used[i, 0] += vec[0]
+                    used[i, 1] += vec[1]
+                    used[i, 2] += vec[2]
+                    used[i, 3] += vec[3]
+                    st.touched.add(i)
+                st.alloc_index = snap_index
+                st.hits += 1
+                st.delta_rows += len(deltas)
+                st.since_guard += 1
+                HITS += 1
+                info["resident_hit"] = True
+                info["delta_rows"] = len(deltas)
+
+                act = fault.faultpoint("ops.resident_state")
+                if act is not None and act.kind == "corrupt":
+                    row = (sorted(st.touched)[act.rng.randrange(
+                        len(st.touched))] if st.touched
+                        else act.rng.randrange(used.shape[0]))
+                    used[row, act.rng.randrange(RES_DIMS)] += 1 + \
+                        act.rng.randrange(1000)
+                    st.touched.add(row)
+
+                every = guard_every()
+                if every > 0 and st.since_guard >= every:
+                    st.since_guard = 0
+                    GUARD_RUNS += 1
+                    info["guard_ran"] = True
+                    ref_used, ref_touched = _full_usage(base, rows_fn)
+                    if not np.array_equal(used, ref_used):
+                        GUARD_MISMATCHES += 1
+                        info["guard_mismatch"] = True
+                        bad = int((used != ref_used).any(axis=1).sum())
+                        logger.error(
+                            "resident usage mirror diverged from full "
+                            "re-encode on %d node rows; invalidating and "
+                            "feeding the breaker", bad)
+                        tracing.event("resident.guard_mismatch", rows=bad)
+                        _publish("guard_mismatch", Rows=bad,
+                                 AllocIndex=snap_index)
+                        if breaker is not None:
+                            breaker.record(False)
+                        _STATE = None
+                        info["resident_hit"] = False
+                        info["full_reencode"] = True
+                        return ref_used, sorted(ref_touched), info
+                    if breaker is not None:
+                        breaker.record(True)
+                    # Guard pass doubles as touched-set compaction:
+                    # rows whose allocs all stopped drop out.
+                    st.touched = set(ref_touched)
+
+                # Hand the caller a copy: the resident matrix keeps
+                # advancing under later batches while the device pass /
+                # forensics of THIS batch still read their snapshot.
+                return used.copy(), sorted(st.touched), info
+
+        # Miss, key change, or feed gap: full rebuild + (re)install.
+        reason = ("feed_gap" if st is not None and st.key == cache_key
+                  else ("key_change" if st is not None else "cold"))
+        FULL_REENCODES += 1
+        info["full_reencode"] = True
+        used, touched = _full_usage(base, rows_fn)
+        _STATE = ResidentState(cache_key, used, snap_index, set(touched))
+        tracing.event("resident.full_reencode", reason=reason,
+                      alloc_index=snap_index)
+        if reason != "cold":
+            _publish(reason, AllocIndex=snap_index,
+                     Nodes=int(base.n_real))
+        return used.copy(), sorted(touched), info

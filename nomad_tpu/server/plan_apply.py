@@ -358,6 +358,16 @@ class PlanApplier:
                 job_lookup=lambda jid: snap.job_by_id(None, jid))
             payload["preemption_evals"] = preemption_evals
         _, index = self.raft.apply(MessageType.APPLY_PLAN_RESULTS, payload)
+        # Residency index plumbing (ops/resident.py): record the newest
+        # plan-apply index so NodeStateDelta events can line residency
+        # churn up against plan traffic.  sys.modules lookup keeps the
+        # server import-light — if the ops package (and jax) was never
+        # loaded, there is no resident cache to notify.
+        import sys as _sys
+
+        res_mod = _sys.modules.get("nomad_tpu.ops.resident")
+        if res_mod is not None:
+            res_mod.note_plan_applied(index)
         eb = self.raft.fsm.state.event_broker
         if eb is not None:
             # One plan-level summary on top of the per-alloc/slab events

@@ -1377,11 +1377,15 @@ class Server:
         if alloc.job is None:
             alloc = alloc.copy()
             alloc.job = self.state.job_by_id(None, alloc.job_id)
-        # Response-wrapped (vault.go getWrappingFn): the client receives
-        # a single-use wrapping token, never the raw secret on the wire;
-        # the accessor still registers server-side BEFORE distribution so
-        # failover revocation works even if the client never unwraps.
-        tokens = self.vault.derive_token(alloc, task_names, wrapped=True)
+        # Response-wrapped by default (vault.go getWrappingFn): the client
+        # receives a single-use wrapping token, never the raw secret on
+        # the wire; the accessor still registers server-side BEFORE
+        # distribution so failover revocation works even if the client
+        # never unwraps.  VaultConfig.wrap_derived_tokens=False restores
+        # plain tokens for non-embedded clients that have no vault_addr
+        # to unwrap against (ADVICE r5).
+        wrapped = getattr(self.vault.config, "wrap_derived_tokens", True)
+        tokens = self.vault.derive_token(alloc, task_names, wrapped=wrapped)
         accessors = [VaultAccessor(
             accessor=info["accessor"], alloc_id=alloc_id,
             node_id=alloc.node_id, task=task,

@@ -31,6 +31,13 @@ class VaultConfig:
     token: str = ""
     task_token_ttl: float = 72 * 3600.0
     allow_unauthenticated: bool = True
+    # Response-wrap derived task tokens (vault.go getWrappingFn).  ON by
+    # default: clients receive a single-use wrapping token, never the
+    # raw secret on the wire.  Non-embedded clients WITHOUT a
+    # ``vault_addr`` cannot unwrap — set this off for them (or configure
+    # vault_addr); see README "Vault" upgrade note (ADVICE r5
+    # server.py:1277).
+    wrap_derived_tokens: bool = True
 
 
 # Wrapping TTL for derived task tokens (vault.go:28 vaultTokenCreateTTL):

@@ -12,6 +12,7 @@ BatchWorker   — the TPU-native replacement: drains the broker into
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import List, Optional, Tuple
@@ -278,6 +279,60 @@ class Worker:
         sched.process(ev)
 
 
+class _MuxPlanner:
+    """Routes planner calls to the owning eval's WorkerPlanner."""
+
+    def __init__(self, worker: "Worker", batch, snapshot_index: int):
+        self.planners = {
+            ev.id: WorkerPlanner(worker, ev, token,
+                                 snapshot_index=snapshot_index)
+            for ev, token in batch}
+        self._by_plan_eval = self.planners
+
+    def submit_plan(self, plan):
+        return self.planners[plan.eval_id].submit_plan(plan)
+
+    def update_eval(self, ev):
+        p = self.planners.get(ev.id) or next(iter(self.planners.values()))
+        p.update_eval(ev)
+
+    def create_eval(self, ev):
+        p = self.planners.get(ev.previous_eval) or next(iter(self.planners.values()))
+        p.create_eval(ev)
+
+    def reblock_eval(self, ev):
+        p = self.planners.get(ev.id) or next(iter(self.planners.values()))
+        p.reblock_eval(ev)
+
+
+class _BatchCtx:
+    """One in-flight batch of the pipelined drain: broker tokens +
+    scheduler + its prepared/dispatched state."""
+
+    __slots__ = ("batch", "sched", "prep", "attempts", "t0")
+
+    def __init__(self, batch, sched, prep, attempts, t0):
+        self.batch = batch
+        self.sched = sched
+        self.prep = prep
+        self.attempts = attempts
+        # Start of the batch's PROCESSING (before wait_for_index /
+        # snapshot / prepare), so the pipelined latency samples cover
+        # the same window the serial path's measure() does.
+        self.t0 = t0
+
+
+def pipeline_enabled() -> bool:
+    """Opt-in double-buffered batch drain (NOMAD_TPU_PIPELINE=1): while
+    batch k's device pass is in flight the worker dequeues + runs the
+    host phases of batch k+1, then finalizes k before k+1's usage delta
+    is built — see ops/batch_sched.schedule_stream for the ordering
+    argument.  Off by default: the serial drain is the long-soaked
+    path."""
+    return os.environ.get("NOMAD_TPU_PIPELINE", "").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
 class BatchWorker(Worker):
     """Drains evals in batches into the TPU batch scheduler.
 
@@ -307,6 +362,7 @@ class BatchWorker(Worker):
     def run(self) -> None:
         from ..ops import batch_sched  # noqa: F401 — registers 'tpu-batch'
 
+        pipelined = pipeline_enabled()
         while not self._stop.is_set():
             self._check_paused()
             try:
@@ -318,17 +374,27 @@ class BatchWorker(Worker):
                 continue
             self._idle_backoff.reset()
             if batch:
-                with self.metrics.measure("worker.invoke_scheduler.batch"):
-                    self.process_batch(batch)
+                if pipelined:
+                    # Per-batch latency samples are taken at each batch's
+                    # finish (one drain spans many batches — a single
+                    # measure() here would corrupt the histogram).
+                    self._process_batches_pipelined(batch)
+                else:
+                    with self.metrics.measure(
+                            "worker.invoke_scheduler.batch"):
+                        self.process_batch(batch)
             # Always also poll system/core (zero timeout) so a sustained
             # service/batch stream cannot starve them.
-            try:
-                ev, token = self.broker.dequeue(
-                    [s.JOB_TYPE_SYSTEM, s.JOB_TYPE_CORE], 0)
-            except EvalBrokerError:
-                continue
-            if ev is not None:
-                self.process_eval(ev, token)
+            self._poll_system_core()
+
+    def _poll_system_core(self) -> None:
+        try:
+            ev, token = self.broker.dequeue(
+                [s.JOB_TYPE_SYSTEM, s.JOB_TYPE_CORE], 0)
+        except EvalBrokerError:
+            return
+        if ev is not None:
+            self.process_eval(ev, token)
 
     def process_batch(self, batch: List[Tuple[s.Evaluation, str]]) -> None:
         tr = tracing.TRACER
@@ -352,34 +418,10 @@ class BatchWorker(Worker):
         # token fencing on ack/nack.
         from ..ops.batch_sched import TPUBatchScheduler
 
-        class _MuxPlanner:
-            """Routes planner calls to the owning eval's WorkerPlanner."""
-
-            def __init__(self, worker, batch):
-                self.planners = {
-                    ev.id: WorkerPlanner(worker, ev, token,
-                                         snapshot_index=snapshot_index)
-                    for ev, token in batch}
-                self._by_plan_eval = self.planners
-
-            def submit_plan(self, plan):
-                return self.planners[plan.eval_id].submit_plan(plan)
-
-            def update_eval(self, ev):
-                p = self.planners.get(ev.id) or next(iter(self.planners.values()))
-                p.update_eval(ev)
-
-            def create_eval(self, ev):
-                p = self.planners.get(ev.previous_eval) or next(iter(self.planners.values()))
-                p.create_eval(ev)
-
-            def reblock_eval(self, ev):
-                p = self.planners.get(ev.id) or next(iter(self.planners.values()))
-                p.reblock_eval(ev)
-
-        mux = _MuxPlanner(self, batch)
+        mux = _MuxPlanner(self, batch, snapshot_index)
         sched = TPUBatchScheduler(self.logger, snap, mux, mesh=self.mesh,
-                                  metrics=self.metrics)
+                                  metrics=self.metrics,
+                                  snapshot_index=snapshot_index)
         tr = tracing.TRACER
         # Attempt numbers belong to THIS delivery, so capture them before
         # scheduling: a nack-timeout firing mid-batch redelivers the eval
@@ -423,3 +465,130 @@ class BatchWorker(Worker):
                     # per-eval Worker's span.
                     tr.event("worker.attempt", eval_id=ev.id,
                              attempt=attempts[ev.id])
+
+    # -- pipelined drain (NOMAD_TPU_PIPELINE=1) ----------------------------
+    #
+    # The double-buffered twin of _process_batch built on the split-phase
+    # TPUBatchScheduler API: while batch k's device pass is in flight the
+    # broker is polled for batch k+1, whose host phases (wait-for-index,
+    # snapshot, reconciliation, spec dedup) run during k's device time.
+    # k is then fetched + finalized + acked BEFORE k+1's usage delta is
+    # built from a fresh snapshot, so the resident delta feed always
+    # reflects k's applied plans.  Per-batch failures nack that batch
+    # only, exactly like the serial path.
+
+    def _process_batches_pipelined(
+            self, batch: List[Tuple[s.Evaluation, str]]) -> None:
+        pending = self._pipeline_start(batch)
+        while pending is not None and not self._stop.is_set():
+            if self._paused:
+                # Honor a pause request mid-stream: settle the in-flight
+                # batch and hand control back to run()'s pause wait.
+                break
+            try:
+                nxt = self.broker.dequeue_batch(
+                    [s.JOB_TYPE_SERVICE, s.JOB_TYPE_BATCH],
+                    self.max_batch, 0)
+            except EvalBrokerError:
+                nxt = None
+            if not nxt:
+                break
+            ctx = self._pipeline_prepare(nxt)   # overlaps pending's device
+            self._pipeline_finish(pending)
+            # Anti-starvation between pipelined batches: a sustained
+            # service/batch stream must not lock out system/core evals
+            # (same guarantee the serial run() loop gives per batch).
+            self._poll_system_core()
+            pending = (self._pipeline_dispatch(ctx)
+                       if ctx is not None else None)
+        if pending is not None:  # drain done / stop / pause
+            self._pipeline_finish(pending)
+
+    def _pipeline_start(self, batch) -> Optional[_BatchCtx]:
+        ctx = self._pipeline_prepare(batch)
+        if ctx is None:
+            return None
+        return self._pipeline_dispatch(ctx)
+
+    def _pipeline_prepare(self, batch) -> Optional[_BatchCtx]:
+        from ..ops.batch_sched import TPUBatchScheduler
+
+        t0 = time.monotonic()
+        tr = tracing.TRACER
+        attempts = {} if tr is None else {
+            ev.id: self.broker.delivery_attempts(ev.id)
+            for ev, _ in batch}
+        try:
+            max_index = max(ev.modify_index for ev, _ in batch)
+            self.wait_for_index(max_index, RAFT_SYNC_LIMIT)
+            snapshot_index = self.raft.applied_index()
+            snap = self.raft.fsm.state.snapshot()
+            mux = _MuxPlanner(self, batch, snapshot_index)
+            sched = TPUBatchScheduler(self.logger, snap, mux,
+                                      mesh=self.mesh, metrics=self.metrics,
+                                      snapshot_index=snapshot_index)
+            prep = sched._prepare_batch([ev for ev, _ in batch])
+            return _BatchCtx(batch, sched, prep, attempts, t0)
+        except Exception as exc:
+            self._nack_batch(batch, attempts, exc)
+            return None
+
+    def _pipeline_dispatch(self, ctx: _BatchCtx) -> Optional[_BatchCtx]:
+        try:
+            # Fresh snapshot for the usage delta: the previous batch's
+            # plans are applied by now (its _pipeline_finish ran first).
+            ctx.sched.state = self.raft.fsm.state.snapshot()
+            ctx.sched._dispatch_prepared(ctx.prep)
+            return ctx
+        except Exception as exc:
+            self._nack_batch(ctx.batch, ctx.attempts, exc)
+            return None
+
+    def _pipeline_finish(self, ctx: _BatchCtx) -> None:
+        tr = tracing.TRACER
+        try:
+            stats = ctx.sched._complete_prepared(ctx.prep)
+        except Exception as exc:
+            self._nack_batch(ctx.batch, ctx.attempts, exc)
+            return
+        ctx.sched._emit_batch_stats(stats)
+        # Wall-clock latency of THIS batch (dequeue → acked), which in a
+        # pipelined drain includes neighbor batches' host phases
+        # interleaved on this thread — an eval-experienced latency, same
+        # spirit as the serial measure() but not directly comparable to
+        # it under sustained overlap.
+        self.metrics.add_sample("worker.invoke_scheduler.batch",
+                                (time.monotonic() - ctx.t0) * 1000.0)
+        if tr is not None:
+            # Retroactive span (the pipelined phases interleave batches,
+            # so a nested context-managed span would mis-stack).
+            tr.record("worker.process_batch", ctx.t0, time.monotonic(),
+                      num_evals=len(ctx.batch), pipelined=True,
+                      **tracing.eval_id_attrs(
+                          (ev for ev, _ in ctx.batch), len(ctx.batch)))
+        for ev, token in ctx.batch:
+            try:
+                self.broker.ack(ev.id, token)
+            except EvalBrokerError as exc:
+                if tr is not None:
+                    tr.event("worker.attempt", eval_id=ev.id,
+                             attempt=ctx.attempts[ev.id],
+                             nack_reason=f"ack failed: {exc}")
+            else:
+                if tr is not None:
+                    tr.event("worker.attempt", eval_id=ev.id,
+                             attempt=ctx.attempts[ev.id])
+
+    def _nack_batch(self, batch, attempts, exc: Exception) -> None:
+        tr = tracing.TRACER
+        self.logger.exception("batch scheduling failed; nacking batch")
+        self.record_eval_failures([ev for ev, _ in batch], exc)
+        for ev, token in batch:
+            if tr is not None:
+                tr.event("worker.attempt", eval_id=ev.id,
+                         attempt=attempts.get(ev.id, 0),
+                         nack_reason=f"{type(exc).__name__}: {exc}")
+            try:
+                self.broker.nack(ev.id, token)
+            except EvalBrokerError:
+                pass

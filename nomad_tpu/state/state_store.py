@@ -19,6 +19,7 @@ sync boundary that ops/encode.py mirrors into device tensors.
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 from collections import defaultdict
 from dataclasses import dataclass, field
@@ -28,6 +29,13 @@ from ..structs import structs as s
 
 # Shared immutable empty result for index misses (never mutated).
 _EMPTY_SET: Set[str] = set()
+
+# Usage-delta log bound (ops/resident.py delta feed): entries beyond the
+# cap are trimmed oldest-first and the floor rises, forcing consumers
+# whose cached index fell off to full re-encode.  Counted in alloc rows
+# (a slab entry weighs len(slab)).
+ALLOC_LOG_CAP = int(os.environ.get("NOMAD_TPU_ALLOC_LOG_CAP", "262144")
+                    or 262144)
 
 # Number of historical job versions retained (reference: structs.go
 # JobTrackedVersions = 6).
@@ -162,6 +170,26 @@ class StateStore:
         # indexing cost lands on the first reader that needs it.
         self._pending_slabs: List[s.AllocSlab] = []
         self._pending_by_job: Dict[str, List[s.AllocSlab]] = {}
+        # Usage-delta log (the ops/resident.py delta feed): every alloc
+        # write appends the per-node resource-usage delta it caused, so a
+        # consumer holding a device-resident usage mirror at raft index K
+        # can catch up with allocs_since(K) — O(changed) instead of a
+        # full O(cluster) table walk.  Entries are immutable tuples
+        # (index, node_id, (cpu, mem, disk, iops)) for single rows or
+        # (index, slab) for bulk slab inserts (expanded lazily at read).
+        # _alloc_log_floor is the highest index whose deltas are NO
+        # LONGER fully present; allocs_since(i) answers None for
+        # i < floor.  The list is SHARED with snapshots behind a length
+        # cursor (_alloc_log_len): appends past a snapshot's cursor are
+        # invisible to it, writes by a non-owning store copy-on-write
+        # first, and trims replace the list object (copy-on-trim) so
+        # cursors into the old one stay valid — snapshot() stays O(1)
+        # for the feed instead of copying up to ALLOC_LOG_CAP entries.
+        self._alloc_log: List[tuple] = []
+        self._alloc_log_len: int = 0
+        self._alloc_log_owned: bool = True
+        self._alloc_log_floor: int = 0
+        self._alloc_log_weight: int = 0
 
     # -- snapshot ----------------------------------------------------------
 
@@ -200,6 +228,16 @@ class StateStore:
             snap._pending_slabs = list(self._pending_slabs)
             snap._pending_by_job = {k: list(v)
                                     for k, v in self._pending_by_job.items()}
+            # Usage-delta log: share the list behind a length cursor
+            # (entries are immutable; parent appends land past the
+            # cursor, parent trims replace the list object, and a
+            # snapshot write copies its prefix first) — O(1) instead of
+            # copying up to ALLOC_LOG_CAP entries per snapshot.
+            snap._alloc_log = self._alloc_log
+            snap._alloc_log_len = self._alloc_log_len
+            snap._alloc_log_owned = False
+            snap._alloc_log_floor = self._alloc_log_floor
+            snap._alloc_log_weight = self._alloc_log_weight
             # Writes to a snapshot (job_plan dry runs, scheduler harness
             # worlds) are hypothetical: they must never publish events.
             snap.event_broker = None
@@ -688,7 +726,7 @@ class StateStore:
                 jobs.setdefault(ev.job_id, "")
                 deleted.append(eid)
             for aid in alloc_ids:
-                self._remove_alloc(aid)
+                self._remove_alloc(aid, index)
             self._bump("evals", index)
             self._bump("allocs", index)
             self._set_job_statuses(index, jobs, eval_delete=True)
@@ -787,6 +825,7 @@ class StateStore:
             self._update_summary_with_alloc(index, alloc, existing, summary_cache)
             if alloc.job is None and existing is not None:
                 alloc.job = existing.job
+            self._log_transition(index, existing, alloc)
             self.allocs_table[alloc.id] = alloc
             if events is not None:
                 events.append(eb.make_event(
@@ -843,6 +882,7 @@ class StateStore:
                 }
                 updated.modify_index = index
                 self._update_summary_with_alloc(index, updated, existing)
+                self._log_transition(index, existing, updated)
                 self.allocs_table[client_alloc.id] = updated
                 if events is not None:
                     events.append(eb.make_event(
@@ -859,7 +899,7 @@ class StateStore:
             eb.publish(events)
         self._notify()
 
-    def _remove_alloc(self, alloc_id: str) -> None:
+    def _remove_alloc(self, alloc_id: str, index: int = 0) -> None:
         if self._pending_slabs:
             self._materialize_pending()
         alloc = self.allocs_table.pop(alloc_id, None)
@@ -869,8 +909,13 @@ class StateStore:
             node_id = alloc.node_ids[alloc.id_index(alloc_id)]
             proto = alloc.proto
             job_id, eval_id = proto.job_id, proto.eval_id
+            row = proto
         else:
             node_id, job_id, eval_id = alloc.node_id, alloc.job_id, alloc.eval_id
+            row = alloc
+        if index and not row.terminal_status():
+            c, m, d, i = self._usage_vec(row)
+            self._log_usage(index, node_id, (-c, -m, -d, -i))
         self._idx_discard(self._allocs_by_node, node_id, alloc_id)
         self._idx_discard(self._allocs_by_job, job_id, alloc_id)
         self._idx_discard(self._allocs_by_eval, eval_id, alloc_id)
@@ -1001,6 +1046,112 @@ class StateStore:
                     out.append((v.node_ids[v.id_index(aid)], v.proto))
                 else:
                     out.append((v.node_id, v))
+            return out
+
+    # -- usage-delta feed (ops/resident.py) --------------------------------
+    #
+    # Caller holds the lock for every _log_* helper.  The vectors use
+    # the canonical structs.alloc_usage_vec basis (same as
+    # ops/encode.apply_alloc_usage's numpy twin), so a consumer
+    # replaying the feed lands on bit-identical usage rows.
+
+    _usage_vec = staticmethod(s.alloc_usage_vec)
+
+    def _log_ensure_owned(self) -> None:
+        """Copy-on-write for a snapshot's shared log prefix: the first
+        write by a non-owning store takes a private copy so the parent's
+        feed never sees hypothetical (dry-run) deltas."""
+        if not self._alloc_log_owned:
+            self._alloc_log = self._alloc_log[:self._alloc_log_len]
+            self._alloc_log_owned = True
+
+    def _log_trim(self) -> None:
+        if self._alloc_log_weight <= ALLOC_LOG_CAP:
+            return
+        # Drop the oldest half (by weight) and raise the floor to the
+        # last dropped entry's index: a consumer cached at/under the
+        # floor can no longer be answered and must full re-encode.
+        # Copy-on-trim: the survivor slice is a NEW list, so snapshot
+        # cursors into the old object stay valid.
+        target = ALLOC_LOG_CAP // 2
+        log = self._alloc_log
+        drop = 0
+        while drop < len(log) and self._alloc_log_weight > target:
+            entry = log[drop]
+            self._alloc_log_weight -= (len(entry[1].ids)
+                                       if len(entry) == 2 else 1)
+            self._alloc_log_floor = max(self._alloc_log_floor, entry[0])
+            drop += 1
+        self._alloc_log = log[drop:]
+        self._alloc_log_len = len(self._alloc_log)
+
+    def _log_usage(self, index: int, node_id: str,
+                   delta: Tuple[int, int, int, int]) -> None:
+        if delta == (0, 0, 0, 0) or not node_id:
+            return
+        self._log_ensure_owned()
+        self._alloc_log.append((index, node_id, delta))
+        self._alloc_log_len += 1
+        self._alloc_log_weight += 1
+        self._log_trim()
+
+    def _log_slab(self, index: int, slab: s.AllocSlab) -> None:
+        if not slab.ids:
+            return
+        self._log_ensure_owned()
+        self._alloc_log.append((index, slab))
+        self._alloc_log_len += 1
+        self._alloc_log_weight += len(slab.ids)
+        self._log_trim()
+
+    def _log_transition(self, index: int, existing: Optional[s.Allocation],
+                        updated: s.Allocation) -> None:
+        """Log the usage delta of one alloc write (old row → new row),
+        including node moves."""
+        old_live = existing is not None and not existing.terminal_status()
+        new_live = not updated.terminal_status()
+        if old_live and new_live and existing.node_id == updated.node_id:
+            ov, nv = self._usage_vec(existing), self._usage_vec(updated)
+            self._log_usage(index, updated.node_id,
+                            (nv[0] - ov[0], nv[1] - ov[1],
+                             nv[2] - ov[2], nv[3] - ov[3]))
+            return
+        if old_live:
+            c, m, d, i = self._usage_vec(existing)
+            self._log_usage(index, existing.node_id, (-c, -m, -d, -i))
+        if new_live:
+            self._log_usage(index, updated.node_id, self._usage_vec(updated))
+
+    def allocs_since(self, index: int
+                     ) -> Optional[List[Tuple[str, Tuple[int, int, int, int]]]]:
+        """Per-node usage deltas for every alloc write with raft index
+        > ``index`` — the delta feed behind the device-resident node-state
+        cache.  Returns None when the log can no longer answer (the
+        requested index fell below the trim floor, or predates this
+        store's log), which forces the consumer to full re-encode."""
+        import bisect
+
+        with self._lock:
+            if index < self._alloc_log_floor:
+                return None
+            # Entries are appended with non-decreasing raft indexes, so
+            # the skip to the first relevant entry is a bisect, not a
+            # full O(log-size) scan.  Iteration is bounded by this
+            # store's length cursor: a shared parent list may have grown
+            # past it (those entries belong to a newer world).
+            log, n = self._alloc_log, self._alloc_log_len
+            start = bisect.bisect_right(log, index, 0, n,
+                                        key=lambda e: e[0])
+            out: List[Tuple[str, Tuple[int, int, int, int]]] = []
+            for entry in log[start:n]:
+                if len(entry) == 2:  # (index, slab): expand per node
+                    slab = entry[1]
+                    vec = self._usage_vec(slab.proto)
+                    for nid, cnt in slab.node_counts().items():
+                        out.append((nid, (vec[0] * cnt, vec[1] * cnt,
+                                          vec[2] * cnt, vec[3] * cnt)))
+                else:
+                    out.append((entry[1], entry[2]))
             return out
 
     # -- vault accessors ---------------------------------------------------
@@ -1225,6 +1376,9 @@ class StateStore:
             # (_materialize_pending): bulk batch commits never query
             # their own slabs in-batch, and this loop was the single
             # largest host cost of the whole scheduling pass at 1M asks.
+            # The usage log gets ONE entry per slab for the same reason
+            # (expanded lazily by allocs_since readers).
+            self._log_slab(index, slab)
             self._pending_slabs.append(slab)
             self._pending_by_job.setdefault(proto.job_id, []).append(slab)
             if events is not None:
@@ -1524,6 +1678,10 @@ class StateStore:
         for acc in store.vault_accessors_table.values():
             store._vault_by_alloc[acc.alloc_id].add(acc.accessor)
             store._vault_by_node[acc.node_id].add(acc.accessor)
+        # The usage-delta log is not persisted: the restored store starts
+        # an empty log with the floor at the restored allocs index, so
+        # any resident consumer from before the restore full re-encodes.
+        store._alloc_log_floor = store._indexes.get("allocs", 0)
         return store
 
 

@@ -167,6 +167,29 @@ def _fast_copy(obj):
     return new
 
 
+def alloc_usage_vec(alloc) -> "Tuple[int, int, int, int]":
+    """The CANONICAL per-alloc usage basis, (cpu, memory_mb, disk_mb,
+    iops): combined ``resources`` when present, ``shared_resources`` +
+    per-task resources otherwise.  The state store's usage-delta feed
+    and the device-resident mirror (ops/resident.py) both use this
+    function; ops/encode.apply_alloc_usage is its numpy twin and the
+    resident differential guard pins their equality bit-for-bit — any
+    change here must land there too."""
+    r = alloc.resources
+    if r is not None:
+        return (r.cpu, r.memory_mb, r.disk_mb, r.iops)
+    cpu = mem = disk = iops = 0
+    sr = alloc.shared_resources
+    if sr is not None:
+        cpu, mem, disk, iops = sr.cpu, sr.memory_mb, sr.disk_mb, sr.iops
+    for tr in alloc.task_resources.values():
+        cpu += tr.cpu
+        mem += tr.memory_mb
+        disk += tr.disk_mb
+        iops += tr.iops
+    return (cpu, mem, disk, iops)
+
+
 @dataclass
 class Port:
     label: str = ""

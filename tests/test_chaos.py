@@ -596,10 +596,24 @@ class TestKernelCorruptionBreaker:
         def blow_up(spec_list):
             raise RuntimeError("xla device died")
 
-        monkeypatch.setattr(sched, "_place_on_device", blow_up)
+        # The split batch pipeline dispatches and fetches separately; a
+        # raw device error can surface at either stage and both must
+        # resolve the probe.  Dispatch-stage here; fetch-stage below.
+        monkeypatch.setattr(sched, "_dispatch_device", blow_up)
         with pytest.raises(RuntimeError, match="xla device died"):
             sched.schedule_batch([ev])
         assert brk.state == "open"      # probe resolved dirty, not wedged
+
+        clock[0] += 6.0                 # past cooldown: probe again
+        monkeypatch.setattr(sched, "_dispatch_device",
+                            lambda spec_list: {"fetch": "boom"})
+        monkeypatch.setattr(
+            sched, "_fetch_device",
+            lambda handle: (_ for _ in ()).throw(
+                RuntimeError("xla device died on fetch")))
+        with pytest.raises(RuntimeError, match="xla device died on fetch"):
+            sched.schedule_batch([ev])
+        assert brk.state == "open"
 
     def test_breaker_trips_through_real_batch_worker(self, monkeypatch):
         """End-to-end through Server + BatchWorker: a corrupted kernel

@@ -1,0 +1,346 @@
+"""Device-resident node-state cache tests (PR 5 tentpole).
+
+Differential coverage: the resident usage mirror updated from the state
+store's usage-delta feed (``allocs_since``) must stay BIT-IDENTICAL to a
+full re-encode across randomized sequences of plan applies, evictions,
+client terminations, node drains, and node registrations — asserted by
+arming the built-in differential guard at every batch.  Plus the
+staleness fence, the feed-gap fallback (with its NodeStateDelta event),
+and the breaker trip on injected resident corruption (fault.py
+``ops.resident_state``).
+"""
+import random
+
+import numpy as np
+import pytest
+
+from nomad_tpu import fault, mock
+from nomad_tpu.ops import resident
+from nomad_tpu.ops.batch_sched import TPUBatchScheduler
+from nomad_tpu.ops.breaker import KernelCircuitBreaker
+from nomad_tpu.scheduler import Harness
+from nomad_tpu.server import event_broker
+from nomad_tpu.structs import structs as s
+
+
+def make_node():
+    node = mock.node()
+    node.resources.networks = []
+    node.reserved.networks = []
+    node.compute_class()
+    return node
+
+
+def make_job(count, prio=50):
+    job = mock.job()
+    job.priority = prio
+    job.task_groups[0].count = count
+    for tg in job.task_groups:
+        for t in tg.tasks:
+            t.resources.networks = []
+    return job
+
+
+def reg_eval(job):
+    return s.Evaluation(
+        id=s.generate_uuid(), priority=job.priority, type=job.type,
+        triggered_by=s.EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
+        status=s.EVAL_STATUS_PENDING)
+
+
+def schedule(h, jobs, register=True, **sched_kwargs):
+    if register:
+        for j in jobs:
+            h.state.upsert_job(h.next_index(), j)
+    evals = [reg_eval(j) for j in jobs]
+    sched = TPUBatchScheduler(h.logger, h.snapshot(), h, **sched_kwargs)
+    return sched.schedule_batch(evals)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_resident(monkeypatch):
+    """Each test starts with an empty resident cache, residency forced
+    on, and the differential guard armed at EVERY delta hit — the guard
+    IS the bit-identity assertion."""
+    monkeypatch.setenv("NOMAD_TPU_RESIDENT", "1")
+    monkeypatch.setenv("NOMAD_TPU_RESIDENT_GUARD_EVERY", "1")
+    resident.reset_counters()
+    yield
+    resident.reset_counters()
+
+
+class TestDeltaFeed:
+    """StateStore.allocs_since — the usage-delta log."""
+
+    def test_upsert_update_evict_and_slab_deltas(self):
+        h = Harness()
+        st = h.state
+        node = make_node()
+        st.upsert_node(1, node)
+        job = make_job(1)
+        st.upsert_job(2, job)
+
+        a = s.Allocation(id=s.generate_uuid(), job_id=job.id, job=job,
+                         node_id=node.id, task_group="web",
+                         resources=s.Resources(cpu=100, memory_mb=200))
+        st.upsert_allocs(3, [a])
+        assert st.allocs_since(2) == [(node.id, (100, 200, 0, 0))]
+        assert st.allocs_since(3) == []
+
+        # Client completion: live → terminal subtracts the usage.
+        done = s._fast_copy(a)
+        done.client_status = s.ALLOC_CLIENT_STATUS_COMPLETE
+        st.update_allocs_from_client(4, [done])
+        assert st.allocs_since(3) == [(node.id, (-100, -200, 0, 0))]
+
+        # Slab insert expands lazily, one feed entry per node row.
+        proto = s.Allocation(job_id=job.id, job=job, task_group="web",
+                             resources=s.Resources(cpu=10, memory_mb=20))
+        slab = s.AllocSlab(proto=proto, ids=[s.generate_uuid() for _ in range(3)],
+                           names=["a", "b", "c"],
+                           node_ids=[node.id, node.id, node.id])
+        st.upsert_slabs(5, [slab])
+        assert st.allocs_since(4) == [(node.id, (30, 60, 0, 0))]
+
+        # Pre-floor queries answer None after a restore-style reset.
+        st._alloc_log_floor = 10
+        assert st.allocs_since(4) is None
+
+    def test_snapshot_has_independent_feed(self):
+        """The log is shared behind a length cursor: parent appends are
+        invisible to the snapshot, a snapshot write (dry-run world)
+        copies first and never leaks into the parent's feed, and a
+        parent trim leaves the snapshot's view intact."""
+        h = Harness()
+        st = h.state
+        node = make_node()
+        st.upsert_node(1, node)
+        snap = st.snapshot()
+        a = s.Allocation(id=s.generate_uuid(), job_id="j", node_id=node.id,
+                         task_group="web",
+                         resources=s.Resources(cpu=5, memory_mb=5))
+        st.upsert_allocs(2, [a])
+        assert st.allocs_since(1) and snap.allocs_since(1) == []
+
+        # Snapshot write: copy-on-write, nothing leaks to the parent.
+        b = s.Allocation(id=s.generate_uuid(), job_id="j", node_id=node.id,
+                         task_group="web",
+                         resources=s.Resources(cpu=7, memory_mb=7))
+        snap.upsert_allocs(3, [b])
+        assert snap.allocs_since(1) == [(node.id, (7, 7, 0, 0))]
+        assert st.allocs_since(2) == []
+
+        # Parent trim replaces the list object; an older snapshot's
+        # cursor into the pre-trim list stays valid.
+        snap2 = st.snapshot()
+        st._alloc_log_weight = 10 ** 9          # force next append to trim
+        st.upsert_allocs(4, [s._fast_copy(a)])  # no-op delta, then a real one
+        c = s.Allocation(id=s.generate_uuid(), job_id="j", node_id=node.id,
+                         task_group="web",
+                         resources=s.Resources(cpu=9, memory_mb=9))
+        st.upsert_allocs(5, [c])
+        assert snap2.allocs_since(1) == [(node.id, (5, 5, 0, 0))]
+
+
+class TestResidentDifferential:
+    def test_randomized_sequence_bit_identical(self):
+        """Randomized plan applies / evictions / terminations / drains /
+        node registrations: with the guard armed at every hit, any drift
+        between the resident mirror and a full re-encode trips
+        GUARD_MISMATCHES — which must stay zero."""
+        rng = random.Random(7)
+        h = Harness()
+        for _ in range(24):
+            h.state.upsert_node(h.next_index(), make_node())
+
+        placed_jobs = []
+        for round_no in range(12):
+            op = rng.randrange(5)
+            if op == 0 and placed_jobs:
+                # Evict some of a job's allocs (plan-apply eviction twin).
+                job = rng.choice(placed_jobs)
+                victims = [a for a in
+                           h.state.allocs_by_job(None, job.id, True)
+                           if not a.terminal_status()][:2]
+                updates = []
+                for v in victims:
+                    ev = s._fast_copy(v)
+                    ev.desired_status = s.ALLOC_DESIRED_STATUS_EVICT
+                    updates.append(ev)
+                if updates:
+                    h.state.upsert_allocs(h.next_index(), updates)
+            elif op == 1 and placed_jobs:
+                # Client-side termination frees capacity.
+                job = rng.choice(placed_jobs)
+                live = [a for a in
+                        h.state.allocs_by_job(None, job.id, True)
+                        if not a.terminal_status()][:3]
+                updates = []
+                for a in live:
+                    u = s._fast_copy(a)
+                    u.client_status = s.ALLOC_CLIENT_STATUS_COMPLETE
+                    updates.append(u)
+                if updates:
+                    h.state.update_allocs_from_client(h.next_index(),
+                                                      updates)
+            elif op == 2:
+                # Node registration: nodes-table index changes, so the
+                # static key changes → full re-encode path.
+                h.state.upsert_node(h.next_index(), make_node())
+            elif op == 3:
+                node = rng.choice(h.state.nodes(None))
+                h.state.update_node_drain(h.next_index(), node.id,
+                                          not node.drain)
+
+            jobs = [make_job(rng.randrange(1, 4)) for _ in range(2)]
+            stats = schedule(h, jobs)
+            assert stats.num_evals == 2
+            placed_jobs.extend(jobs)
+
+        assert resident.GUARD_MISMATCHES == 0
+        assert resident.GUARD_RUNS > 0
+        assert resident.HITS > 0, "delta path never exercised"
+        assert resident.FULL_REENCODES > 1, (
+            "node churn should have forced key-change re-encodes")
+
+    def test_staleness_fence_serves_old_snapshot_without_regressing(self):
+        h = Harness()
+        for _ in range(8):
+            h.state.upsert_node(h.next_index(), make_node())
+        schedule(h, [make_job(2)])      # cold install
+        schedule(h, [make_job(2)])      # delta hit advances the mirror
+
+        # A scheduler handed an OLD snapshot must full re-encode from it
+        # (fence) and leave the newer resident mirror untouched.
+        job = make_job(1)
+        h.state.upsert_job(h.next_index(), job)
+        stale = h.snapshot()            # knows the job
+        # The mirror sits at each batch's PRE-batch allocs index, so two
+        # more batches push it past ``stale``'s view.
+        schedule(h, [make_job(2)])
+        schedule(h, [make_job(2)])
+        cached = resident._STATE.alloc_index
+
+        sched = TPUBatchScheduler(h.logger, stale, h)
+        stats = sched.schedule_batch([reg_eval(job)])
+        assert stats.staleness_fences == 1
+        assert stats.full_reencodes == 1
+        assert stats.resident_hits == 0
+        assert resident._STATE.alloc_index == cached
+        assert len(h.state.allocs_by_job(None, job.id, True)) == 1
+
+    def test_feed_gap_forces_full_reencode_and_event(self):
+        h = Harness()
+        for _ in range(8):
+            h.state.upsert_node(h.next_index(), make_node())
+        schedule(h, [make_job(2)])
+        assert resident._STATE is not None
+
+        broker = event_broker.EventBroker(
+            index_source=lambda: h.state.latest_index())
+        event_broker.register(broker)
+        event_broker.clear_recent()
+        try:
+            # Simulate the log trimming past the cached index.
+            h.state._alloc_log_floor = resident._STATE.alloc_index + 10
+            h.state._alloc_log.clear()
+            stats = schedule(h, [make_job(2)])
+            assert stats.full_reencodes == 1 and stats.resident_hits == 0
+            deltas = [e for e in event_broker.recent()
+                      if e.type == "NodeStateDelta"]
+            assert deltas and deltas[-1].payload["Reason"] == "feed_gap"
+        finally:
+            event_broker.unregister(broker)
+            event_broker.clear_recent()
+
+    def test_injected_corruption_trips_breaker(self):
+        """fault.py ``ops.resident_state`` corrupt: the guard detects the
+        perturbed row, feeds the breaker, invalidates, and the batch
+        still places correctly from the fresh full encode."""
+        brk = KernelCircuitBreaker(threshold=0.9, window=8, min_checks=1,
+                                   cooldown=3600.0)
+        h = Harness()
+        for _ in range(8):
+            h.state.upsert_node(h.next_index(), make_node())
+        schedule(h, [make_job(2)], breaker=brk)   # cold install
+
+        with fault.scenario({"seed": 3, "faults": [
+                {"point": "ops.resident_state", "action": "corrupt",
+                 "times": 1}]}):
+            job = make_job(2)
+            stats = schedule(h, [job], breaker=brk)
+
+        assert resident.GUARD_MISMATCHES == 1
+        assert resident._STATE is None or resident._STATE.hits == 0
+        assert stats.full_reencodes == 1
+        assert brk.state == "open", brk.state
+        # Scheduling stayed correct: the batch ran on the fresh encode.
+        assert len([a for a in h.state.allocs_by_job(None, job.id, True)
+                    if not a.terminal_status()]) == 2
+
+    def test_residency_off_env_disables_delta_path(self, monkeypatch):
+        monkeypatch.setenv("NOMAD_TPU_RESIDENT", "0")
+        h = Harness()
+        for _ in range(8):
+            h.state.upsert_node(h.next_index(), make_node())
+        schedule(h, [make_job(2)])
+        stats = schedule(h, [make_job(2)])
+        assert stats.resident_hits == 0 and stats.delta_rows == 0
+        assert resident.HITS == 0
+
+
+class TestPipelinedStream:
+    def test_stream_matches_serial_placements(self):
+        """schedule_stream (double-buffered) places exactly what the
+        serial per-batch path would: every job fully placed, usage mirror
+        clean (guard at every hit)."""
+        h = Harness()
+        for _ in range(16):
+            h.state.upsert_node(h.next_index(), make_node())
+        batches, all_jobs = [], []
+        for _ in range(5):
+            jobs = [make_job(2) for _ in range(2)]
+            for j in jobs:
+                h.state.upsert_job(h.next_index(), j)
+            all_jobs.extend(jobs)  # registered above; stream runs below
+            batches.append([reg_eval(j) for j in jobs])
+        sched = TPUBatchScheduler(h.logger, h.snapshot(), h)
+        stats = sched.schedule_stream(batches,
+                                      state_source=lambda: h.snapshot())
+        assert len(stats) == 5
+        for job in all_jobs:
+            live = [a for a in h.state.allocs_by_job(None, job.id, True)
+                    if not a.terminal_status()]
+            assert len(live) == 2, (job.id, len(live))
+        assert resident.GUARD_MISMATCHES == 0
+        assert sum(st.resident_hits for st in stats) >= 4
+
+    def test_pipelined_batch_worker_places(self, monkeypatch):
+        """NOMAD_TPU_PIPELINE=1: the BatchWorker's split-phase drain
+        places a stream of jobs end-to-end through a live server."""
+        monkeypatch.setenv("NOMAD_TPU_PIPELINE", "1")
+        import time
+
+        from nomad_tpu.server import Server, ServerConfig
+
+        srv = Server(ServerConfig(num_schedulers=1,
+                                  use_tpu_batch_worker=True, batch_size=8))
+        srv.start()
+        try:
+            for _ in range(12):
+                srv.node_register(make_node())
+            jobs = []
+            for _ in range(9):
+                job = make_job(2)
+                srv.job_register(job)
+                jobs.append(job)
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                if all(len(srv.state.allocs_by_job(None, j.id, True)) == 2
+                       for j in jobs):
+                    break
+                time.sleep(0.05)
+            for j in jobs:
+                assert len(srv.state.allocs_by_job(None, j.id, True)) == 2
+        finally:
+            srv.shutdown()

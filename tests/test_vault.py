@@ -325,6 +325,40 @@ class TestWrappedTokens:
         with pytest.raises(VaultError):
             fv.unwrap(out["wrapped_token"])
 
+    def test_wrap_derived_tokens_flag_disables_wrapping(self):
+        """VaultConfig.wrap_derived_tokens=False (ADVICE r5 server:1277):
+        the server RPC hands out PLAIN tokens again, so non-embedded
+        clients without a vault_addr keep working across the upgrade."""
+        fv = FakeVault()
+        for flag, want_plain in ((False, True), (True, False)):
+            srv = Server(ServerConfig(
+                num_schedulers=0,
+                vault=VaultConfig(enabled=True,
+                                  wrap_derived_tokens=flag)),
+                vault_api=fv)
+            srv.start()
+            try:
+                assert wait_until(srv.is_leader)
+                job = mock.job()
+                job.task_groups[0].tasks[0].vault = s.Vault(policies=["p1"])
+                alloc = mock.alloc()
+                alloc.job = job
+                alloc.job_id = job.id
+                alloc.task_group = job.task_groups[0].name
+                srv.state.upsert_job(srv.raft.applied_index() + 1, job)
+                srv.state.upsert_allocs(srv.raft.applied_index() + 2,
+                                        [alloc])
+                out = srv.derive_vault_token(alloc.id, ["web"])
+                info = out["web"]
+                assert ("token" in info) == want_plain, (flag, info)
+                assert ("wrapped_token" in info) == (not want_plain)
+                # Revocation accessors register either way.
+                assert wait_until(lambda: len(
+                    srv.state.vault_accessors_by_alloc(
+                        None, alloc.id)) == 1)
+            finally:
+                srv.shutdown()
+
 
 class TestRevocationRetry:
     """storeForRevocation + revokeDaemon (vault.go:1027, 1104): failed
