@@ -415,6 +415,54 @@ def bench_score_exact():
                 "numpy_twin_placed": nv_placed}}
 
 
+def bench_fused_delta():
+    """Fused-path score discipline (PR 6): the single-dispatch fused
+    score-and-commit program and the two-phase schedule/compact split
+    must produce the IDENTICAL aggregate bin-pack score on the identical
+    problem (same scan, same compaction expression — bit-identical by
+    construction; this measures it end-to-end through plan apply).
+    Quantized resource rows are exact-or-absent, so the budget here is
+    0.0%, not the 0.5% oracle budget.  The tie-break jitter seed is
+    pinned (NOMAD_TPU_RNG_SEED) so both runs resolve equal-score ties
+    identically — bit-identity is only defined under a shared seed."""
+    saved = {k: os.environ.get(k)
+             for k in ("NOMAD_TPU_FUSED", "NOMAD_TPU_RNG_SEED")}
+    try:
+        os.environ["NOMAD_TPU_RNG_SEED"] = "1234567"
+        os.environ["NOMAD_TPU_FUSED"] = "1"
+        hf, jobsf, evalsf = build_problem(N_NODES, ORACLE_SAMPLE_JOBS,
+                                          COUNT_PER_JOB)
+        run_tpu_batch(hf, evalsf)
+        fused_sum, _, fused_nodes = binpack_scores(hf)
+        fused_placed = total_placed(hf, jobsf)
+
+        os.environ["NOMAD_TPU_FUSED"] = "0"
+        ht, jobst, evalst = build_problem(N_NODES, ORACLE_SAMPLE_JOBS,
+                                          COUNT_PER_JOB)
+        run_tpu_batch(ht, evalst)
+        two_sum, _, two_nodes = binpack_scores(ht)
+        two_placed = total_placed(ht, jobst)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    delta_pct = (100.0 * (two_sum - fused_sum) / two_sum
+                 if two_sum else 0.0)
+    log(f"fused-delta: fused ScoreFit sum {fused_sum:.1f} "
+        f"({fused_placed} placed, {fused_nodes} nodes) vs two-phase "
+        f"{two_sum:.1f} ({two_placed} placed, {two_nodes} nodes) → "
+        f"delta {delta_pct:+.4f}% (budget 0.0%)")
+    return {"fused_scorefit_sum": round(fused_sum, 1),
+            "two_phase_scorefit_sum": round(two_sum, 1),
+            "fused_placed": fused_placed, "two_phase_placed": two_placed,
+            "fused_score_delta_pct": round(delta_pct, 4),
+            "budget_pct": 0.0,
+            "budget_met": abs(delta_pct) < 1e-6 and
+                          fused_placed == two_placed}
+
+
 def bench_single_eval_latency():
     """Interactive single-eval latency (VERDICT r4 weak-6): ONE eval
     (one tg, count 1) submitted ~50 times through a LIVE server worker
@@ -888,6 +936,29 @@ def run_config(n_nodes: int, n_jobs: int, count_per_job: int, label: str,
         "compile_warmup_s": round(compile_s, 3),
         "rounds": stats.rounds,
         "platform": str(jax.devices()[0].platform),
+        # Host-vs-device split of the median trial (PR 6): host phases
+        # (reconciliation + spec dedup), encode (tensor build + pack),
+        # dispatch (host async-dispatch overhead before the blocking
+        # fetch — device compute drains INSIDE the fetch), commit
+        # (dispatch point → result transfer complete: the fused
+        # score-and-commit program's whole wall cost), fetch (blocking
+        # fetch wall time incl. any forensics fetch), metrics + finalize
+        # (host decode/plan materialization).
+        "time_split": {
+            "phase1_s": round(stats.phase1_seconds, 3),
+            "phase2_s": round(stats.phase2_seconds, 3),
+            "encode_s": round(stats.encode_seconds, 3),
+            "dispatch_s": round(stats.dispatch_seconds, 3),
+            "commit_s": round(stats.commit_seconds, 3),
+            "fetch_s": round(stats.fetch_seconds, 3),
+            "metrics_s": round(stats.metrics_seconds, 3),
+            "finalize_s": round(stats.finalize_seconds, 3),
+        },
+        "commit_fetch_s": round(
+            stats.commit_seconds + stats.fetch_seconds, 3),
+        "fetch_bytes": stats.fetch_bytes,
+        "fused": stats.fused,
+        "quantized": stats.quantized,
     }
     if n_dcs > 1:
         detail["n_dcs"] = n_dcs
@@ -1113,6 +1184,12 @@ def _child_main():
         if sd is not None:
             detail["score_regression"] = sd
 
+    # Fused vs two-phase differential (PR 6): same problem through both
+    # device programs; the delta must be exactly 0.0%.
+    fd = phase("fused_vs_two_phase", 90, bench_fused_delta)
+    if fd is not None:
+        detail["fused_vs_two_phase"] = fd
+
     a = phase("config_a_100n_x_1k_jobs", 90, bench_config_a)
     if a is not None:
         detail["config_a_100n_x_1k_jobs"] = a
@@ -1256,13 +1333,13 @@ def _read_partial(path: str) -> dict:
 
 def _extract_baseline_numbers(doc: dict):
     """(northstar_median_s, single_eval_p95_ms, config_e_elapsed_s,
-    steady_placed_per_s) from one BENCH_r*.json trajectory doc.  Those
-    files keep only a truncated tail of the bench JSON line (and
-    ``parsed`` is often null), so fall back to regexing the decoded tail
-    string."""
+    steady_placed_per_s, northstar_commit_fetch_s) from one
+    BENCH_r*.json trajectory doc.  Those files keep only a truncated
+    tail of the bench JSON line (and ``parsed`` is often null), so fall
+    back to regexing the decoded tail string."""
     import re
 
-    ns = p95 = ce = steady = None
+    ns = p95 = ce = steady = cf = None
     parsed = doc.get("parsed")
     if isinstance(parsed, dict):
         det = parsed.get("detail") or parsed
@@ -1272,6 +1349,8 @@ def _extract_baseline_numbers(doc: dict):
         ce = (det.get("config_e_50k_nodes_1m_tgs") or {}).get("elapsed_s")
         steady = (det.get("config_steady")
                   or {}).get("sustained_placed_per_s")
+        cf = (det.get("config_northstar_10k_x_1m")
+              or {}).get("commit_fetch_s")
     tail = doc.get("tail") or ""
     if ns is None:
         m = re.search(r'"config_northstar_10k_x_1m":\s*\{[^{}]*?'
@@ -1289,12 +1368,20 @@ def _extract_baseline_numbers(doc: dict):
         m = re.search(r'"config_steady":\s*\{[^{}]*?'
                       r'"sustained_placed_per_s":\s*([0-9.]+)', tail)
         steady = float(m.group(1)) if m else None
-    return ns, p95, ce, steady
+    if cf is None:
+        # commit_fetch_s sits after the nested time_split object, so the
+        # [^{}] idiom can't reach it; the non-greedy cross-brace match
+        # finds the FIRST occurrence after the north-star key (its own).
+        m = re.search(r'"config_northstar_10k_x_1m":.*?'
+                      r'"commit_fetch_s":\s*([0-9.]+)', tail, re.DOTALL)
+        cf = float(m.group(1)) if m else None
+    return ns, p95, ce, steady, cf
 
 
 def _latest_bench_baseline():
     """Newest BENCH_r*.json with parseable numbers →
-    (name, ns_s, p95_ms, config_e_s, steady_placed_per_s)."""
+    (name, ns_s, p95_ms, config_e_s, steady_placed_per_s,
+    northstar_commit_fetch_s)."""
     import glob
 
     here = os.path.dirname(os.path.abspath(__file__))
@@ -1308,7 +1395,7 @@ def _latest_bench_baseline():
         nums = _extract_baseline_numbers(doc)
         if any(v is not None for v in nums):
             return (os.path.basename(path),) + nums
-    return None, None, None, None, None
+    return None, None, None, None, None, None
 
 
 CHECK_THRESHOLD_DEFAULT = 1.5
@@ -1336,7 +1423,7 @@ def _check_main(argv) -> int:
         threshold = float(os.environ.get(
             "NOMAD_TPU_BENCH_CHECK_THRESHOLD", 0) or CHECK_THRESHOLD_DEFAULT)
 
-    baseline_file, base_ns, base_p95, base_ce, base_steady = \
+    baseline_file, base_ns, base_p95, base_ce, base_steady, base_cf = \
         _latest_bench_baseline()
     out = {"check": "bench-regression", "baseline": baseline_file,
            "threshold": threshold}
@@ -1362,9 +1449,39 @@ def _check_main(argv) -> int:
                 failures.append(
                     f"north-star median {cur:.3f}s exceeds "
                     f"{threshold}x baseline {base_ns:.3f}s")
+            # Device-side commit+fetch guard (PR 6): rides the same
+            # north-star measurement; skipped when the baseline predates
+            # the split (this run's BENCH file will carry it forward).
+            cur_cf = det.get("commit_fetch_s")
+            if cur_cf is not None:
+                out["northstar_commit_fetch_s"] = {
+                    "baseline": base_cf, "current": cur_cf,
+                    "ratio": (round(cur_cf / base_cf, 3)
+                              if base_cf else None)}
+                if base_cf is not None and cur_cf > base_cf * threshold:
+                    failures.append(
+                        f"north-star commit+fetch {cur_cf:.3f}s exceeds "
+                        f"{threshold}x baseline {base_cf:.3f}s")
         except Exception as exc:
             out["northstar_median_s"] = {"error": repr(exc)}
             failures.append(f"north-star phase failed: {exc!r}")
+
+    # Fused-path score discipline: measured fresh (needs no baseline) —
+    # the fused and two-phase programs must agree exactly.
+    try:
+        with _deadline(180, "check_fused_delta"):
+            fd = bench_fused_delta()
+        out["fused_score_delta_pct"] = {
+            "current": fd["fused_score_delta_pct"], "budget_pct": 0.0}
+        if not fd["budget_met"]:
+            failures.append(
+                f"fused-vs-two-phase score delta "
+                f"{fd['fused_score_delta_pct']}% (placed "
+                f"{fd['fused_placed']} vs {fd['two_phase_placed']}) — "
+                "the fused path must be exact")
+    except Exception as exc:
+        out["fused_score_delta_pct"] = {"error": repr(exc)}
+        failures.append(f"fused-delta phase failed: {exc!r}")
     if base_p95 is not None:
         try:
             with _deadline(180, "check_single_eval"):

@@ -322,6 +322,200 @@ def residency_drill(seed: int = 0, log=print) -> bool:
     return True
 
 
+def fused_drill(seed: int = 0, log=print) -> bool:
+    """Fused score-and-commit drill (PR 6): a cold batch through the
+    fused single-dispatch path must place with exactly ONE ``batch.fetch``
+    span; the identical problem through the CPU oracle must place the
+    same per-job counts with no node overcommitted; quantized resource
+    rows must round-trip bit-exactly (and a corrupted codebook must be
+    caught); a corrupted fused result buffer must trip the breaker and
+    route the batch to the oracle."""
+    import os
+
+    import numpy as np
+
+    from .. import fault, mock
+    from ..scheduler import Harness
+    from ..scheduler.generic import GenericScheduler
+    from ..structs import structs as s
+    from ..utils import tracing
+    from . import encode, resident
+    from .batch_sched import TPUBatchScheduler
+    from .breaker import KernelCircuitBreaker
+
+    def check(cond, msg):
+        if not cond:
+            log(f"fused drill: FAIL — {msg}")
+        return cond
+
+    saved = {k: os.environ.get(k)
+             for k in ("NOMAD_TPU_FUSED", "NOMAD_TPU_QUANT")}
+    os.environ["NOMAD_TPU_FUSED"] = "1"
+    os.environ["NOMAD_TPU_QUANT"] = "1"
+    brk = KernelCircuitBreaker(threshold=0.9, window=8, min_checks=1,
+                               cooldown=3600.0)
+    try:
+        # Twin harnesses over an identical fleet + identical jobs: one
+        # scheduled by the fused device path, one by the oracle.
+        nodes = []
+        for _ in range(8):
+            node = mock.node()
+            node.resources.networks = []
+            node.reserved.networks = []
+            node.compute_class()
+            nodes.append(node)
+        h_dev, h_orc = Harness(), Harness()
+        for node in nodes:
+            h_dev.state.upsert_node(h_dev.next_index(), node.copy())
+            h_orc.state.upsert_node(h_orc.next_index(), node.copy())
+        jobs = []
+        for _ in range(3):
+            job = mock.job()
+            for tg in job.task_groups:
+                for t in tg.tasks:
+                    t.resources.networks = []
+            job.task_groups[0].count = 2
+            jobs.append(job)
+        for h in (h_dev, h_orc):
+            for job in jobs:
+                h.state.upsert_job(h.next_index(), job)
+
+        def mk_evals():
+            return [s.Evaluation(
+                id=s.generate_uuid(), priority=j.priority, type=j.type,
+                triggered_by=s.EVAL_TRIGGER_JOB_REGISTER, job_id=j.id,
+                status=s.EVAL_STATUS_PENDING) for j in jobs]
+
+        # 1. Cold fused batch, tracing armed: one batch.fetch span, the
+        # batch placed, and the stats say fused ran.
+        evals = mk_evals()
+        tracing.enable()
+        try:
+            sched = TPUBatchScheduler(h_dev.logger, h_dev.snapshot(),
+                                      h_dev, breaker=brk)
+            stats = sched.schedule_batch(evals)
+            fetches = [sp for sp in tracing.trace_for_eval(evals[0].id)
+                       if sp["Name"] == "batch.fetch"]
+        finally:
+            tracing.disable()
+        if not (check(stats.fused == 1, f"batch did not run fused ({stats!r})")
+                and check(len(fetches) == 1,
+                          f"{len(fetches)} batch.fetch spans, expected "
+                          "exactly 1 (single-transfer contract)")):
+            return False
+
+        # 2. Oracle parity on the twin harness: same per-job placement
+        # counts, no node overcommitted on either side.
+        for ev in mk_evals():
+            GenericScheduler(h_orc.logger, h_orc.snapshot(),
+                             h_orc, batch=False).process(ev)
+        for job in jobs:
+            n_dev = len([a for a in
+                         h_dev.state.allocs_by_job(None, job.id, True)
+                         if not a.terminal_status()])
+            n_orc = len([a for a in
+                         h_orc.state.allocs_by_job(None, job.id, True)
+                         if not a.terminal_status()])
+            if not check(n_dev == n_orc == 2,
+                         f"placement parity broke for {job.id}: fused "
+                         f"{n_dev} vs oracle {n_orc} (want 2)"):
+                return False
+        for h in (h_dev, h_orc):
+            for node in h.state.nodes(None):
+                used = np.zeros(2, dtype=np.int64)
+                for a in h.state.allocs_by_node(None, node.id):
+                    if a.terminal_status():
+                        continue
+                    res = a.resources
+                    if res is None:
+                        # Oracle-path allocs carry per-task resources
+                        # only (the combined total is filled at apply).
+                        used += (
+                            sum(t.cpu for t in a.task_resources.values()),
+                            sum(t.memory_mb
+                                for t in a.task_resources.values()))
+                    else:
+                        used += (res.cpu, res.memory_mb)
+                if not check(
+                        used[0] <= node.resources.cpu
+                        and used[1] <= node.resources.memory_mb,
+                        f"node {node.id} overcommitted ({used})"):
+                    return False
+
+        # 3. Quantization round-trip bound: the bench-shape rows must
+        # quantize exactly; a corrupted codebook must be caught and feed
+        # the breaker.
+        resident.reset_counters()
+        cap = np.tile(np.array([4000, 8192, 102400, 150]), (8, 1))
+        base_used = np.tile(np.array([100, 128, 0, 0]), (8, 1))
+        q = encode.quantize_resource_rows(cap, base_used)
+        if not (check(q is not None, "bench-shape rows did not quantize")
+                and check(resident.check_quant_roundtrip(
+                              cap, q.cap_q, q.scale, what="capacity"),
+                          "exact quantization failed the round-trip bound")
+                and check(np.array_equal(
+                              encode.dequantize_rows(q.used_q, q.scale),
+                              base_used),
+                          "used baseline did not round-trip")):
+            return False
+        bad_brk = KernelCircuitBreaker(threshold=0.9, window=8,
+                                       min_checks=1, cooldown=3600.0)
+        corrupt = np.array(q.cap_q)
+        corrupt[0, 0] += 1
+        if not (check(not resident.check_quant_roundtrip(
+                          cap, corrupt, q.scale, breaker=bad_brk,
+                          what="capacity"),
+                      "corrupted codebook passed the round-trip bound")
+                and check(resident.QUANT_MISMATCHES == 1,
+                          "quant mismatch counter did not move")
+                and check(bad_brk.agreement() < 1.0,
+                          "quant mismatch did not feed the breaker")):
+            return False
+
+        # 4. Corrupted fused result buffer: validation rejects it, the
+        # breaker trips, the oracle carries the batch.  Fresh jobs — the
+        # step-1 jobs already placed, so their evals would be no-ops.
+        jobs2 = []
+        for _ in range(2):
+            job = mock.job()
+            for tg in job.task_groups:
+                for t in tg.tasks:
+                    t.resources.networks = []
+            job.task_groups[0].count = 1
+            jobs2.append(job)
+            h_dev.state.upsert_job(h_dev.next_index(), job)
+        evals2 = [s.Evaluation(
+            id=s.generate_uuid(), priority=j.priority, type=j.type,
+            triggered_by=s.EVAL_TRIGGER_JOB_REGISTER, job_id=j.id,
+            status=s.EVAL_STATUS_PENDING) for j in jobs2]
+        with fault.scenario({"seed": seed, "faults": [
+                {"point": "ops.kernel_result", "action": "corrupt",
+                 "times": 1}]}):
+            sched = TPUBatchScheduler(h_dev.logger, h_dev.snapshot(),
+                                      h_dev, breaker=brk)
+            stats2 = sched.schedule_batch(evals2)
+        if not (check(stats2.kernel_rejects == 1,
+                      f"corrupt fused batch not rejected ({stats2!r})")
+                and check(stats2.oracle_routed == len(jobs2),
+                          "rejected fused batch did not route to the "
+                          "oracle")
+                and check(brk.state == "open",
+                          f"breaker {brk.state!r}, expected open")):
+            return False
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        resident.reset_counters()
+    log("fused drill: OK — single-fetch fused batch placed with oracle "
+        "parity and no overcommit, quantized rows round-tripped exactly "
+        "(corruption caught), corrupt fused buffer tripped the breaker "
+        "and the oracle carried the batch")
+    return True
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="python -m nomad_tpu.ops")
     parser.add_argument("--selfcheck", action="store_true",
@@ -337,6 +531,7 @@ def main(argv=None) -> int:
     ok = breaker_drill(seed=args.seed) and ok
     ok = tracing_drill(seed=args.seed) and ok
     ok = residency_drill(seed=args.seed) and ok
+    ok = fused_drill(seed=args.seed) and ok
     return 0 if ok else 1
 
 

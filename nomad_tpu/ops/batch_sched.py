@@ -70,6 +70,18 @@ _DEVICE_STATIC_CACHE: Dict[Tuple, object] = {}
 _cache_configured = False
 
 
+def fused_enabled() -> bool:
+    """NOMAD_TPU_FUSED (default ON): score + capacity-feedback commit +
+    result compaction run as ONE device dispatch whose whole output —
+    summary, placements, AllocMetric scores — crosses the link in a
+    single transfer (kernels.fused_pass).  0/false keeps the two-phase
+    schedule/compact split as the fallback; both paths are bit-identical
+    by construction (same scan, same compaction expression)."""
+    from ..utils.flags import env_flag
+
+    return env_flag("NOMAD_TPU_FUSED", True)
+
+
 def _ensure_compile_cache() -> None:
     """Enable JAX's persistent compilation cache for the scheduling
     programs: they cost tens of seconds of XLA compile per shape bucket,
@@ -352,6 +364,18 @@ class TPUBatchScheduler:
             m.add_sample("worker.invoke_scheduler.device",
                          stats.device_seconds * 1000.0)
             m.add_sample("worker.invoke_scheduler.rounds", stats.rounds)
+            m.add_sample("worker.invoke_scheduler.commit",
+                         stats.commit_seconds * 1000.0)
+            m.add_sample("worker.invoke_scheduler.fetch",
+                         stats.fetch_seconds * 1000.0)
+            # Bytes are a COUNTER (rate-derivable total), not a sample:
+            # the percentile histogram's buckets are ms-calibrated and
+            # would quantize MB-scale values into the top bucket.
+            m.incr_counter("batch.fetch_bytes", stats.fetch_bytes)
+            if stats.fused:
+                m.incr_counter("batch.fused", stats.fused)
+            if stats.quantized:
+                m.incr_counter("batch.quantized", stats.quantized)
         if not stats.oracle_routed:
             m.add_sample("worker.invoke_scheduler.finalize",
                          stats.finalize_seconds * 1000.0)
@@ -664,6 +688,12 @@ class TPUBatchScheduler:
             stats.encode_seconds = kstats["encode_seconds"]
             stats.metrics_seconds = kstats["metrics_seconds"]
             stats.rounds = kstats["rounds"]
+            stats.commit_seconds = kstats.get("commit_seconds", 0.0)
+            stats.dispatch_seconds = kstats.get("dispatch_seconds", 0.0)
+            stats.fetch_seconds = kstats.get("fetch_seconds", 0.0)
+            stats.fetch_bytes = kstats.get("fetch_bytes", 0)
+            stats.fused = kstats.get("fused", 0)
+            stats.quantized = kstats.get("quantized", 0)
             stats.preempt_placed = kstats.get("preempt_placed", 0)
             stats.preempt_evicted = kstats.get("preempt_evicted", 0)
             stats.preempt_checked = kstats.get("preempt_checked", 0)
@@ -896,10 +926,39 @@ class TPUBatchScheduler:
         # MB/s, so transfer bytes are the limit (measured — bench.py).
         static = {
             "attr": ct.attr_values, "elig": ct.eligible, "dc": ct.dc_code,
-            "cap": ct.capacity.astype(np.int32),
             "denom": ct.score_denom,
-            "used_base": base.used.astype(np.int32),
         }
+        # Quantized resource rows (encode.quantize_resource_rows): the
+        # two [n_pad, 4] matrices ship int16/int8 + a per-dimension scale
+        # codebook when exactly representable — half/quarter the link
+        # bytes and device HBM for the resident static mirror.  Memoized
+        # on the cached static tensors; the round-trip bound check
+        # (resident.check_quant_roundtrip) runs once per static encode
+        # and on mismatch the batch falls back to exact int32 rows.
+        # quant_enabled() is re-read EVERY batch (the runtime kill-switch
+        # convention fused_enabled()/resident.enabled() follow); only the
+        # computed rows are memoized on the cached static tensors.
+        quant = None
+        if encode.quant_enabled():
+            quant = getattr(base, "_quant_rows", False)
+            if quant is False:
+                quant = encode.quantize_resource_rows(ct.capacity,
+                                                      base.used)
+                if quant is not None and not (
+                        resident.check_quant_roundtrip(
+                            ct.capacity, quant.cap_q, quant.scale,
+                            breaker=self.breaker, what="capacity")
+                        and resident.check_quant_roundtrip(
+                            base.used, quant.used_q, quant.scale,
+                            breaker=self.breaker, what="used baseline")):
+                    quant = None
+                base._quant_rows = quant  # type: ignore[attr-defined]
+        if quant is not None:
+            static.update(cap_q=quant.cap_q, used_base_q=quant.used_q,
+                          res_scale=quant.scale)
+        else:
+            static.update(cap=ct.capacity.astype(np.int32),
+                          used_base=base.used.astype(np.int32))
         if with_networks:
             static.update(bw_cap=ct.bw_cap, bw_used_base=base.bw_used,
                           dyn_free_base=base.dyn_free,
@@ -927,9 +986,16 @@ class TPUBatchScheduler:
             "ji": st.job_index,
             "jc_rows": jc_rows, "jc_cols": jc_cols, "jc_vals": jc_vals,
             "u_rows": u_rows, "u_vals": u_vals,
+            # Tie-break jitter seed: random per batch, overridable with
+            # NOMAD_TPU_RNG_SEED for deterministic placement reproduction
+            # (the fused-vs-two-phase differential tests pin placements
+            # bit-identical under a fixed seed).
             "rng_seed": np.array(
-                [int.from_bytes(s.generate_uuid()[:8].encode(), "big")
-                 & 0x7FFFFFFF], dtype=np.int32),
+                [(int(os.environ["NOMAD_TPU_RNG_SEED"])
+                  if os.environ.get("NOMAD_TPU_RNG_SEED")
+                  else int.from_bytes(s.generate_uuid()[:8].encode(),
+                                      "big")) & 0x7FFFFFFF],
+                dtype=np.int32),
         }
         if with_networks:
             u_bw = np.zeros(k_u, dtype=np.int32)
@@ -963,23 +1029,34 @@ class TPUBatchScheduler:
         while len(_DEVICE_STATIC_CACHE) > 4:
             _DEVICE_STATIC_CACHE.pop(next(iter(_DEVICE_STATIC_CACHE)))
 
-        # Commit-score side-outputs cost two [U, N] carry buffers; beyond
-        # ~16M cells the HBM + compile cost outweighs score forensics
-        # (counts stay exact either way).
+        # Commit-score side-outputs: [U, M] commit-aligned slot buffers
+        # in slot mode (cheap), two [U, N] carries otherwise — beyond
+        # ~16M cells the HBM + compile cost of the matrix form outweighs
+        # score forensics (counts stay exact either way).
         with_scores = st.u_pad * ct.n_pad <= 16_000_000
         total_asks = int(sum(sp.count for sp in spec_list))
-        max_nnz = encode.pow2_bucket(
-            max(8, min(total_asks, st.u_pad * ct.n_pad)), minimum=8)
-        # Slot mode (score-less mega-batches): the kernel records each
-        # commit's node indices into a compact [U, M] matrix during the
-        # scan, so no [U, N] compaction program runs and summary+slots
-        # come back in ONE blocking fetch.
+        # Slot mode: the kernel records each commit's node index (and,
+        # with scores, its binpack score + collisions) into compact
+        # [U, M] matrices during the scan, so the COO payload is built
+        # with one U×M pass instead of a nonzero over the U×N matrix
+        # (0.5s → ~50ms at the 1024×10048 north-star shape).  The slot
+        # buffers are HBM-only (the link carries COO), so the budget is
+        # an HBM/compile bound, not a link bound.
         slot_m = 0
-        if not with_scores and ct.n_pad <= 65536:
+        if ct.n_pad <= 65536:
             max_count = max((sp.count for sp in spec_list), default=1)
             m_b = encode.pow2_bucket(max(8, max_count), minimum=8)
-            if st.u_pad * m_b * 2 <= (8 << 20):
+            slot_bytes = 4 + (8 if with_scores else 0)
+            if st.u_pad * m_b * slot_bytes <= (64 << 20):
                 slot_m = m_b
+        # COO capacity: per-(spec, node) pairs on the matrix path, but
+        # per-ALLOC entries on the slot path (a node committed in two
+        # rounds appears twice), so slot mode sizes by total asks alone.
+        max_nnz = encode.pow2_bucket(
+            max(8, total_asks if slot_m
+                else min(total_asks, st.u_pad * ct.n_pad)), minimum=8)
+        fused_buf = fused_meta = fused_overflow = None
+        summary_buf = coo_mat = None
         if os.environ.get("NOMAD_TPU_TIMING") == "2":
             # Staged sync (diagnostics only): force the schedule program
             # to finish before compaction dispatch so the log splits
@@ -1006,6 +1083,17 @@ class TPUBatchScheduler:
             jax.device_get(summary_buf[:4])
             logger.warning("timing2: compact %.3fs",
                            time.monotonic() - t_s1)
+        elif fused_enabled():
+            # Tentpole path: score + commit + compaction as ONE device
+            # dispatch emitting ONE packed result buffer, fetched in a
+            # single transfer by _fetch_device (the aux overflow source
+            # stays device-resident, touched only on window overflow).
+            fused_buf, fused_aux, feas, fused_meta = kernels.fused_pass(
+                static_dev, jax.device_put(dbuf), meta_s=meta_s,
+                meta_d=meta_d, u_pad=st.u_pad, n_pad=ct.n_pad,
+                with_networks=with_networks, with_dp=with_dp,
+                with_scores=with_scores, max_nnz=max_nnz, slot_m=slot_m)
+            fused_overflow = ("slots" if slot_m else "coo", fused_aux)
         else:
             summary_buf, coo_mat, feas = device_pass(
                 static_dev, jax.device_put(dbuf), meta_s=meta_s,
@@ -1018,6 +1106,9 @@ class TPUBatchScheduler:
             "spec_list": spec_list, "all_nodes": all_nodes, "ct": ct,
             "st": st, "feas": feas, "summary_buf": summary_buf,
             "coo_mat": coo_mat, "slot_m": slot_m,
+            "fused_buf": fused_buf, "fused_meta": fused_meta,
+            "fused_overflow": fused_overflow,
+            "quantized": 0 if quant is None else 1,
             "with_scores": with_scores, "max_nnz": max_nnz,
             "encode_seconds": encode_seconds, "t1": t1,
             "resident": resident_info,
@@ -1036,21 +1127,58 @@ class TPUBatchScheduler:
         ct, st = handle["ct"], handle["st"]
         feas = handle["feas"]
         summary_buf, coo_mat = handle["summary_buf"], handle["coo_mat"]
-        slot_m = handle["slot_m"]
         with_scores = handle["with_scores"]
         max_nnz = handle["max_nnz"]
 
         t_disp = time.monotonic()
         dbg = os.environ.get("NOMAD_TPU_TIMING")
-        if slot_m:
-            # One blocking round: summary (KBs) + slot matrix together.
-            sraw, slots_np = jax.device_get((summary_buf, coo_mat))
-            summary = xfer.unpack_host(np.asarray(sraw),
-                                       summary_layout(st.u_pad, ct.n_pad))
+        fetch_bytes = 0
+        if handle.get("fused_buf") is not None:
+            # Fused path: the WHOLE batch result — summary + COO
+            # placement payload + score side-outputs — in ONE device
+            # transfer (the tentpole contract; the "exactly one
+            # batch.fetch span per batch" tracing assertion pins it).
+            # Only when nnz overflows the payload window (>8MB of
+            # placements) does a second fetch of the overflow source
+            # run, inside the same span.
+            with tracing.span("batch.fetch", fused=1):
+                raw = np.asarray(jax.device_get(handle["fused_buf"]))
+                fetch_bytes = raw.nbytes
+                summary = xfer.unpack_host(raw, handle["fused_meta"])
+                nnz = int(summary["scalars"][0])
+                coo_win = summary["coo"]
+                if nnz <= coo_win.shape[0]:
+                    coo = coo_win[:nnz]
+                else:
+                    kind, aux = handle["fused_overflow"]
+                    logger.info(
+                        "fused fetch overflow: nnz %d > window %d; one "
+                        "extra %s fetch", nnz, coo_win.shape[0], kind)
+                    if kind == "coo":
+                        nnz_b = min(max_nnz,
+                                    encode.pow2_bucket(nnz, minimum=8))
+                        coo = np.asarray(
+                            jax.device_get(aux[:nnz_b]))[:nnz]
+                        fetch_bytes += (nnz_b * coo.shape[1]
+                                        * coo.dtype.itemsize)
+                    else:
+                        # Slot mode: dispatch a right-sized slot→COO
+                        # gather over the device-resident record and
+                        # prefix-fetch it — bytes proportional to the
+                        # actual placements, not the [U, M] record.
+                        nnz_b = min(max_nnz,
+                                    encode.pow2_bucket(nnz, minimum=8))
+                        slots_d, sscores_d, scoll_d = aux
+                        ov_coo, _ = kernels.slots_to_coo(
+                            slots_d, sscores_d, scoll_d, out_rows=nnz_b,
+                            with_scores=with_scores,
+                            compact_u16=coo_win.dtype == np.uint16)
+                        coo = np.asarray(jax.device_get(ov_coo))[:nnz]
+                        fetch_bytes += (nnz_b * coo.shape[1]
+                                        * coo.dtype.itemsize)
             if dbg:
-                logger.warning(
-                    "timing: summary+slots fetch %.3fs ([%d, %d] u16)",
-                    time.monotonic() - t_disp, st.u_pad, slot_m)
+                logger.warning("timing: fused fetch %.3fs (%d B)",
+                               time.monotonic() - t_disp, fetch_bytes)
         else:
             ncols = 5 if with_scores else 3
             # dtype truth comes from the device array itself (uint16 when
@@ -1061,67 +1189,77 @@ class TPUBatchScheduler:
             # power-of-two bucketed [nnz_b, C] prefix — the bucket keeps
             # the slice shape stable across batches (a raw [:nnz] slice
             # would trace+compile a fresh program per distinct nnz).
+            # Both rounds live under ONE batch.fetch span: this is the
+            # non-fused fallback's one logical batched fetch.
             if max_nnz * ncols * isz <= (4 << 20):
-                sraw, coo_full = jax.device_get((summary_buf, coo_mat))
+                with tracing.span("batch.fetch"):
+                    sraw, coo_full = jax.device_get((summary_buf, coo_mat))
                 summary = xfer.unpack_host(
                     np.asarray(sraw), summary_layout(st.u_pad, ct.n_pad))
                 nnz = int(summary["scalars"][0])
                 coo = np.asarray(coo_full[:nnz])
+                fetch_bytes = (np.asarray(sraw).nbytes
+                               + np.asarray(coo_full).nbytes)
                 if dbg:
                     logger.warning("timing: summary+coo fetch %.3fs",
                                    time.monotonic() - t_disp)
             else:
-                summary = xfer.unpack_host(
-                    np.asarray(jax.device_get(summary_buf)),
-                    summary_layout(st.u_pad, ct.n_pad))
-                t_sum = time.monotonic()
-                nnz = int(summary["scalars"][0])
-                if nnz:
-                    nnz_b = min(max_nnz,
-                                encode.pow2_bucket(nnz, minimum=8))
-                    coo = np.asarray(jax.device_get(coo_mat[:nnz_b]))[:nnz]
-                else:
-                    coo = np.zeros((0, ncols),
-                                   dtype=np.dtype(coo_mat.dtype))
+                with tracing.span("batch.fetch"):
+                    sraw = np.asarray(jax.device_get(summary_buf))
+                    summary = xfer.unpack_host(
+                        sraw, summary_layout(st.u_pad, ct.n_pad))
+                    t_sum = time.monotonic()
+                    nnz = int(summary["scalars"][0])
+                    if nnz:
+                        nnz_b = min(max_nnz,
+                                    encode.pow2_bucket(nnz, minimum=8))
+                        coo = np.asarray(
+                            jax.device_get(coo_mat[:nnz_b]))[:nnz]
+                        fetch_bytes = sraw.nbytes + nnz_b * ncols * isz
+                    else:
+                        coo = np.zeros((0, ncols),
+                                       dtype=np.dtype(coo_mat.dtype))
+                        fetch_bytes = sraw.nbytes
                 if dbg:
                     logger.warning(
                         "timing: summary fetch (compute wait) %.3fs; coo "
                         "fetch %.3fs (%d entries x %d cols x %d B)",
                         t_sum - t_disp, time.monotonic() - t_sum, nnz,
                         ncols, isz)
+        # Wall time of the whole score-and-commit dispatch: upload +
+        # device compute + the result transfer (t1 marks the post-encode
+        # dispatch point in _dispatch_device).  dispatch_seconds is the
+        # host-side gap between that point and the start of the blocking
+        # fetch — the async-dispatch overhead; device compute itself
+        # drains inside the blocking fetch.
+        commit_seconds = time.monotonic() - handle["t1"]
+        fetch_seconds = time.monotonic() - t_disp
+        dispatch_seconds = max(0.0, commit_seconds - fetch_seconds)
         rounds = int(summary["scalars"][1])
         unplaced_arr = summary["unplaced"]
         feas_count = summary["feas_count"]
-        if slot_m:
-            # Decode slots → flat (row, col) pairs, one per alloc, in
-            # per-spec commit order: the shared downstream path (extent
-            # slices, id expansion, metrics) is unchanged with counts=1.
-            placed_arr = np.array(
-                [sp.count for sp in spec_list], dtype=np.int64)
-            placed_arr -= unplaced_arr[:st.u_real].astype(np.int64)
-            np.clip(placed_arr, 0, None, out=placed_arr)
-            mask = (np.arange(slot_m, dtype=np.int64)[None, :]
-                    < placed_arr[:, None])
-            coo_rows = np.repeat(
-                np.arange(len(spec_list), dtype=np.int64), placed_arr)
-            coo_cols = np.asarray(slots_np[:len(spec_list)])[mask].astype(
-                np.int64)
-            coo_counts = np.ones(len(coo_cols), dtype=np.int32)
-            coo_scores = np.zeros(len(coo_cols), dtype=np.float32)
-            coo_coll = np.zeros(len(coo_cols), dtype=np.int32)
+        # Unified COO decode (slot mode arrives as per-alloc COO with
+        # counts ≡ 1, built on device from the commit-aligned slot
+        # record; matrix mode as per-(spec, node) aggregates).
+        coo_rows, coo_cols, coo_counts = coo[:, 0], coo[:, 1], coo[:, 2]
+        if with_scores:
+            coo_scores = np.ascontiguousarray(coo[:, 3]).view(np.float32)
+            coo_coll = coo[:, 4]
         else:
-            coo_rows, coo_cols, coo_counts = coo[:, 0], coo[:, 1], coo[:, 2]
-            if with_scores:
-                coo_scores = np.ascontiguousarray(coo[:, 3]).view(np.float32)
-                coo_coll = coo[:, 4]
-            else:
-                coo_scores = np.zeros(len(coo), dtype=np.float32)
-                coo_coll = np.zeros(len(coo), dtype=np.int32)
+            coo_scores = np.zeros(len(coo), dtype=np.float32)
+            coo_coll = np.zeros(len(coo), dtype=np.int32)
 
         expanded, unplaced, metrics, kstats = self._finalize_device_outputs(
             spec_list, all_nodes, ct, st, feas, unplaced_arr, feas_count,
             coo_rows, coo_cols, coo_counts, coo_scores, coo_coll,
             rounds, with_scores, handle["encode_seconds"], handle["t1"])
+        kstats["commit_seconds"] = commit_seconds
+        kstats["dispatch_seconds"] = dispatch_seconds
+        kstats["fetch_seconds"] = (fetch_seconds
+                                   + kstats.get("fetch_seconds", 0.0))
+        kstats["fetch_bytes"] = fetch_bytes + kstats.get("fetch_bytes", 0)
+        kstats["fused"] = 1 if handle.get("fused_buf") is not None else 0
+        kstats["quantized"] = handle.get("quantized", 0)
         kstats["resident"] = handle.get("resident") or {}
         return expanded, unplaced, metrics, kstats
 
@@ -1235,14 +1373,56 @@ class TPUBatchScheduler:
             spec_list, ct, unplaced_arr, coo_rows, coo_cols, coo_counts)
         if problem is not None:
             raise KernelIntegrityError(problem)
+        # COO → per-spec placement slots, vectorized: nonzero emits rows
+        # in ascending order, so per-spec extents are searchsorted slices;
+        # slot node-ids come from ONE fancy-index over the interned id
+        # array + np.repeat of the counts — no per-entry python tuples.
+        valid = (coo_rows >= 0) & (coo_cols < ct.n_real)
+        vr, vc = coo_rows[valid], coo_cols[valid]
+        vcnt, vsc, vco = coo_counts[valid], coo_scores[valid], coo_coll[valid]
+        u_lo = np.searchsorted(vr, np.arange(len(spec_list)), side="left")
+        u_hi = np.searchsorted(vr, np.arange(len(spec_list)), side="right")
+        node_id_arr = np.array(ct.node_ids, dtype=object)
+        rep_ids = node_id_arr[np.repeat(vc, vcnt)]
+        csum = np.concatenate([[0], np.cumsum(vcnt, dtype=np.int64)])
+
+        # used_after is reconstructed host-side from used0 + committed
+        # placements × asks — exact (integer adds, same order-free sum the
+        # kernel computes) and ~1MB of link traffic cheaper than shipping
+        # the [N, 4] matrix in the summary.  Only failure forensics needs
+        # it (cap_left attribution in _fill_failure_metrics).
+        failed_u = np.nonzero(unplaced_arr[:st.u_real] > 0)[0]
+        used_after = None
+        if len(failed_u):
+            used_after = np.asarray(ct.used, dtype=np.int64).copy()
+            if len(vr):
+                np.add.at(used_after, vc.astype(np.int64),
+                          vcnt.astype(np.int64)[:, None]
+                          * np.asarray(st.ask)[vr.astype(np.int64)])
+
+        # Priority-tier preemption dispatch: the eviction-set kernel for
+        # the asks the capacity loop left unplaced goes in flight NOW so
+        # its outputs ride the SAME device fetch as the lazy feasibility
+        # forensics rows below — at most ONE extra transfer per batch
+        # beyond the main result fetch, even on the fallback path.
+        preempt_stats = {}
+        preempt_ctx = None
+        if (self.preemption_enabled and used_after is not None
+                and len(self._allocs_by_node)):
+            # Writable copy: the fetched summary buffer is read-only, and
+            # the commit pass decrements the counts it fills.
+            unplaced_arr = np.array(unplaced_arr)
+            preempt_ctx = self._preempt_dispatch(
+                spec_list, ct, st, feas, unplaced_arr, used_after)
+
         # Feasibility rows are fetched lazily, only for failed specs whose
         # feasible count is below their EVALUATED count (= ready nodes in
         # their DCs) — i.e. some constraint actually filtered a node.  The
         # common capacity-exhaustion failure derives everything from
         # placements without moving a row across the link.
-        failed_u = np.nonzero(unplaced_arr[:st.u_real] > 0)[0]
         feas_rows: Dict[int, np.ndarray] = {}
         node_facts = None
+        need_rows: List[int] = []
         if len(failed_u):
             # Explicit dtypes: np.array([]) would default to float64 on an
             # empty cluster and break the boolean mask math.
@@ -1273,55 +1453,46 @@ class TPUBatchScheduler:
 
             need_rows = [int(u) for u in failed_u
                          if feas_count[u] < _evaluated_count(spec_list[u])]
+
+        # ONE batched device fetch for everything this phase still needs
+        # from the device: forensics feasibility rows AND the preemption
+        # kernel outputs, together (span: batch.fetch_forensics — the
+        # main result already came back under the batch.fetch span).
+        kstats_fetch_s = 0.0
+        kstats_fetch_b = 0
+        if need_rows or preempt_ctx is not None:
+            gets = {}
             if need_rows:
-                fetched = np.asarray(jax.device_get(
-                    feas[jax.numpy.asarray(
-                        np.array(need_rows, dtype=np.int32))]))
-                feas_rows = {u: fetched[i] for i, u in enumerate(need_rows)}
+                gets["feas_rows"] = feas[jnp.asarray(
+                    np.array(need_rows, dtype=np.int32))]
+            if preempt_ctx is not None:
+                gets["preempt"] = preempt_ctx["dev"]
+            t_fx = time.monotonic()
+            with tracing.span("batch.fetch_forensics",
+                              feas_rows=len(need_rows),
+                              preempt=int(preempt_ctx is not None)):
+                fetched = jax.device_get(gets)
+            kstats_fetch_s = time.monotonic() - t_fx
+            if need_rows:
+                rows_np = np.asarray(fetched["feas_rows"])
+                kstats_fetch_b += rows_np.nbytes
+                feas_rows = {u: rows_np[i]
+                             for i, u in enumerate(need_rows)}
+            if preempt_ctx is not None:
+                kstats_fetch_b += sum(
+                    np.asarray(a).nbytes
+                    for a in jax.tree_util.tree_leaves(fetched["preempt"]))
         device_seconds = time.monotonic() - t1
         t_metrics = time.monotonic()
 
-        # COO → per-spec placement slots, vectorized: nonzero emits rows
-        # in ascending order, so per-spec extents are searchsorted slices;
-        # slot node-ids come from ONE fancy-index over the interned id
-        # array + np.repeat of the counts — no per-entry python tuples.
-        valid = (coo_rows >= 0) & (coo_cols < ct.n_real)
-        vr, vc = coo_rows[valid], coo_cols[valid]
-        vcnt, vsc, vco = coo_counts[valid], coo_scores[valid], coo_coll[valid]
-        u_lo = np.searchsorted(vr, np.arange(len(spec_list)), side="left")
-        u_hi = np.searchsorted(vr, np.arange(len(spec_list)), side="right")
-        node_id_arr = np.array(ct.node_ids, dtype=object)
-        rep_ids = node_id_arr[np.repeat(vc, vcnt)]
-        csum = np.concatenate([[0], np.cumsum(vcnt, dtype=np.int64)])
-
-        # used_after is reconstructed host-side from used0 + committed
-        # placements × asks — exact (integer adds, same order-free sum the
-        # kernel computes) and ~1MB of link traffic cheaper than shipping
-        # the [N, 4] matrix in the summary.  Only failure forensics needs
-        # it (cap_left attribution in _fill_failure_metrics).
-        used_after = None
-        if len(failed_u):
-            used_after = np.asarray(ct.used, dtype=np.int64).copy()
-            if len(vr):
-                np.add.at(used_after, vc.astype(np.int64),
-                          vcnt.astype(np.int64)[:, None]
-                          * np.asarray(st.ask)[vr.astype(np.int64)])
-
-        # Priority-tier preemption: a second device pass over the specs
-        # the capacity loop could NOT place, evicting strictly-lower-
-        # priority allocs to make room (ops/preempt.py kernel; committed
-        # sets recorded in self._preempt_plan for _finalize, unplaced_arr
-        # decremented so the failure forensics below see the post-
-        # preemption truth).
-        preempt_stats = {}
-        if (self.preemption_enabled and used_after is not None
-                and len(self._allocs_by_node)):
-            # Writable copy: the fetched summary buffer is read-only, and
-            # the pass decrements the counts it fills.
-            unplaced_arr = np.array(unplaced_arr)
+        # Preemption commit (host greedy pass over the fetched eviction
+        # sets; mutates unplaced_arr/used_after so the failure forensics
+        # below see the post-preemption truth).
+        if preempt_ctx is not None:
             with tracing.span("batch.preempt"):
-                preempt_stats = self._preempt_pass(
-                    spec_list, ct, st, feas, unplaced_arr, used_after)
+                preempt_stats = self._preempt_commit(
+                    preempt_ctx, fetched["preempt"], spec_list, ct,
+                    unplaced_arr, used_after)
 
         expanded: Dict[Tuple[str, str], List[str]] = {}
         unplaced: Dict[Tuple[str, str], int] = {}
@@ -1365,9 +1536,18 @@ class TPUBatchScheduler:
             # Commit-time scores per placed node — the oracle's pure
             # binpack entry (rank.go:139) plus a separate anti-affinity
             # entry when the node had same-job collisions (rank.go:167).
+            # Slot-mode COO carries one entry per ALLOC, so a node
+            # committed in multiple rounds appears several times —
+            # dedupe keeping the LAST commit's score (matrix-mode
+            # semantics: commit_scores[u, n] was overwritten per
+            # commit), since score_node ADDS and summed per-commit
+            # scores would break the 0-18 ScoreFit bound.
             if with_scores:
+                last: Dict[int, Tuple[float, int]] = {}
                 for i, sc, co in zip(vc[lo:hi].tolist(), vsc[lo:hi].tolist(),
                                      vco[lo:hi].tolist()):
+                    last[i] = (sc, co)
+                for i, (sc, co) in last.items():
                     m.score_node(all_nodes[i], "binpack", sc)
                     if co > 0:
                         m.score_node(
@@ -1389,6 +1569,8 @@ class TPUBatchScheduler:
             "encode_seconds": encode_seconds,
             "metrics_seconds": time.monotonic() - t_metrics,
             "rounds": rounds,
+            "fetch_seconds": kstats_fetch_s,
+            "fetch_bytes": kstats_fetch_b,
         }
         kstats.update(preempt_stats)
         tr = tracing.TRACER
@@ -1405,22 +1587,20 @@ class TPUBatchScheduler:
 
     # -- preemption pass ----------------------------------------------------
 
-    def _preempt_pass(self, spec_list, ct, st, feas,
-                      unplaced_arr, used_after) -> Dict[str, int]:
+    def _preempt_dispatch(self, spec_list, ct, st, feas,
+                          unplaced_arr, used_after) -> Optional[Dict]:
         """Batched eviction-set pass for the asks the capacity loop left
         unplaced: ONE kernel invocation computes, for every still-failing
         (task-group, node) pair, the minimal set of strictly-lower-
         priority allocs to evict and the post-eviction fit score
         (ops/preempt.py — the device twin of scheduler/preempt.py).
 
-        The host then commits greedily in the batch's priority order:
-        best effective score (post-eviction binpack minus the preemption
-        discount) first, at most ONE preempting placement per node per
-        batch — a second eviction on the same node would need the
-        post-first-eviction state the kernel did not see.  Every commit
-        is cross-checked against the scalar oracle on identical inputs;
-        the agreement counters surface in BatchStats (the bench's
-        kernel-vs-oracle eviction-set agreement metric).
+        This half only DISPATCHES: the returned ctx's ``dev`` entry is
+        the in-flight device computation (eviction sets + the preempting
+        specs' static feasibility rows — constraints/dc/eligibility
+        still bind a preempting placement), which the caller fetches in
+        its single combined forensics fetch before _preempt_commit runs
+        the host greedy pass.  None when no spec qualifies.
 
         Specs with network asks, distinct_hosts, or distinct_property
         keep the no-preemption result: their feasibility state after an
@@ -1435,7 +1615,7 @@ class TPUBatchScheduler:
               and spec_list[u].dp_target is None
               and not spec_list[u].distinct_hosts]
         if not pu:
-            return {}
+            return None
 
         state = self.state
 
@@ -1460,18 +1640,38 @@ class TPUBatchScheduler:
         ask = np.asarray(st.ask, dtype=np.int64)[pu].astype(np.int32)
         jp = np.array([spec_list[u].priority for u in pu], dtype=np.int32)
 
-        # One fetch round: kernel outputs + the static-feasibility rows
-        # of the preempting specs (constraints/dc/eligibility still bind
-        # a preempting placement).
         pu_idx = jnp.asarray(np.array(pu, dtype=np.int32))
-        (mask_np, feasible, n_evict, score), feas_rows = jax.device_get(
-            (preempt_ops.eviction_sets(
-                jnp.asarray(free.astype(np.int32)),
-                jnp.asarray(used_after.astype(np.int32)),
-                jnp.asarray(denom),
-                jnp.asarray(prio), jnp.asarray(sizes),
-                jnp.asarray(ask), jnp.asarray(jp)),
-             feas[pu_idx]))
+        dev = (preempt_ops.eviction_sets(
+                   jnp.asarray(free.astype(np.int32)),
+                   jnp.asarray(used_after.astype(np.int32)),
+                   jnp.asarray(denom),
+                   jnp.asarray(prio), jnp.asarray(sizes),
+                   jnp.asarray(ask), jnp.asarray(jp)),
+               feas[pu_idx])
+        return {"pu": pu, "sorted_allocs": sorted_allocs,
+                "prio_of": prio_of, "free": free, "ask": ask, "jp": jp,
+                "dev": dev}
+
+    def _preempt_commit(self, ctx, fetched, spec_list, ct,
+                        unplaced_arr, used_after) -> Dict[str, int]:
+        """Host half of the preemption pass, over the FETCHED kernel
+        outputs: commit greedily in the batch's priority order — best
+        effective score (post-eviction binpack minus the preemption
+        discount) first, at most ONE preempting placement per node per
+        batch (a second eviction on the same node would need the
+        post-first-eviction state the kernel did not see).  Every commit
+        is cross-checked against the scalar oracle on identical inputs;
+        the agreement counters surface in BatchStats (the bench's
+        kernel-vs-oracle eviction-set agreement metric)."""
+        from ..scheduler import preempt as preempt_oracle
+
+        pu = ctx["pu"]
+        sorted_allocs = ctx["sorted_allocs"]
+        prio_of = ctx["prio_of"]
+        free = ctx["free"]
+        ask = ctx["ask"]
+        jp = ctx["jp"]
+        (mask_np, feasible, n_evict, score), feas_rows = fetched
         mask_np = np.asarray(mask_np)
         feasible = np.asarray(feasible) & np.asarray(feas_rows)
         n_evict = np.asarray(n_evict)
@@ -1919,6 +2119,21 @@ class BatchStats:
         self.finalize_seconds = 0.0
         self.total_seconds = 0.0
         self.rounds = 0
+        # Fused score-and-commit path (PR 6): whether this batch ran the
+        # single-dispatch/single-fetch program, the wall time of that
+        # dispatch (upload → device compute → result transfer), the wall
+        # time and bytes of all device→host fetches, and whether the
+        # static resource rows shipped quantized (int16/int8 + scale
+        # codebook, exact by construction).
+        self.fused = 0
+        self.quantized = 0
+        self.commit_seconds = 0.0
+        # Host-side async-dispatch gap between the post-encode dispatch
+        # point and the start of the blocking fetch (device compute
+        # drains inside the fetch, so this is pure host overhead).
+        self.dispatch_seconds = 0.0
+        self.fetch_seconds = 0.0
+        self.fetch_bytes = 0
         # Preemption pass counters (batch_sched._preempt_pass): placements
         # won by eviction, allocs evicted, and the kernel-vs-oracle
         # eviction-set agreement tally.
@@ -1965,6 +2180,11 @@ class BatchStats:
                 extra += f" fences={self.staleness_fences}"
         if self.pipeline_overlap_s:
             extra += f" overlap={self.pipeline_overlap_s:.3f}s"
+        if self.device_ran:
+            extra += (f" fused={self.fused} quantized={self.quantized} "
+                      f"commit={self.commit_seconds:.3f}s "
+                      f"fetch={self.fetch_seconds:.3f}s/"
+                      f"{self.fetch_bytes}B")
         return (f"BatchStats(evals={self.num_evals} specs={self.num_specs} "
                 f"asks={self.num_asks} phase1={self.phase1_seconds:.3f}s "
                 f"phase2={self.phase2_seconds:.3f}s "

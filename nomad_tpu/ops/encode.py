@@ -61,6 +61,74 @@ from ..structs.network import (  # noqa: E402
 PORT_WORDS = MAX_VALID_PORT // 32          # uint32 words per node bitmap
 
 
+# -- quantized resource rows (PR 6) -----------------------------------------
+#
+# The static cluster upload ships two [n_pad, 4] int32 resource matrices
+# (capacity + reserved-only usage baseline) over a single-digit-MB/s
+# tunneled link, and they sit in HBM for the life of the device cache.
+# Quantizing them to int16 (int8 where ranges allow) halves/quarters
+# both costs.  The scheme is EXACT or absent: a per-dimension power-of-
+# two scale codebook is chosen so every value is divisible by its scale
+# and the scaled value fits the narrow dtype; if any dimension cannot be
+# represented exactly, quantization is skipped for the whole matrix pair
+# (placements must stay bit-identical to the float/int32 oracle — the
+# ≤0.5%-target-0.0% score-delta discipline).  Dequantization on device
+# is one integer multiply fused into the unpack.
+
+def quant_enabled() -> bool:
+    from ..utils.flags import env_flag
+
+    return env_flag("NOMAD_TPU_QUANT", True)
+
+
+@dataclass
+class QuantizedRows:
+    """Exactly-quantized (capacity, used-baseline) resource rows plus the
+    per-dimension scale codebook.  ``tag`` is the xfer dtype tag the
+    quantized matrices ship as ("i16" or "i8")."""
+
+    cap_q: np.ndarray      # [n_pad, 4] int16/int8
+    used_q: np.ndarray     # [n_pad, 4] int16/int8
+    scale: np.ndarray      # [4] int32 — power-of-two per dimension
+    tag: str
+
+
+def quantize_resource_rows(capacity: np.ndarray,
+                           used: np.ndarray) -> Optional[QuantizedRows]:
+    """Quantize the [n, 4] capacity/used matrices to the narrowest exact
+    integer representation, or return None when exactness is impossible
+    (a value not divisible by the scale its range requires).  int8 is
+    chosen only when every dimension fits it under the same codebook."""
+    cap = np.asarray(capacity, dtype=np.int64)
+    use = np.asarray(used, dtype=np.int64)
+    if (cap < 0).any() or (use < 0).any():
+        return None
+    scale = np.ones(RES_DIMS, dtype=np.int64)
+    for d in range(RES_DIMS):
+        m = max(int(cap[:, d].max(initial=0)), int(use[:, d].max(initial=0)))
+        s_d = 1
+        while m // s_d > np.iinfo(np.int16).max:
+            s_d <<= 1
+        if s_d > 1 and ((cap[:, d] % s_d).any() or (use[:, d] % s_d).any()):
+            return None
+        scale[d] = s_d
+    cap_s = cap // scale
+    use_s = use // scale
+    if (cap_s.max(initial=0) <= np.iinfo(np.int8).max
+            and use_s.max(initial=0) <= np.iinfo(np.int8).max):
+        dt, tag = np.int8, "i8"
+    else:
+        dt, tag = np.int16, "i16"
+    return QuantizedRows(cap_q=cap_s.astype(dt), used_q=use_s.astype(dt),
+                         scale=scale.astype(np.int32), tag=tag)
+
+
+def dequantize_rows(q: np.ndarray, scale: np.ndarray) -> np.ndarray:
+    """Host-side inverse (the round-trip bound check and tests);
+    the device-side twin is one multiply in kernels._device_schedule."""
+    return q.astype(np.int64) * np.asarray(scale, dtype=np.int64)
+
+
 def _res_vec(r: Optional[s.Resources]) -> np.ndarray:
     if r is None:
         return np.zeros(RES_DIMS, dtype=np.int64)

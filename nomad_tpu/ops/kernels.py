@@ -259,10 +259,18 @@ class PlacementResult(NamedTuple):
     commit_scores: jnp.ndarray = None      # [U, N] float32
     commit_collisions: jnp.ndarray = None  # [U, N] int32
     # Compact slot record (slot_m > 0): slots[u, j] = node index of spec
-    # u's j-th committed alloc, appended in commit order — the device→
-    # host placement payload without any nonzero/compaction pass over
-    # the [U, N] matrix.  -1 padding beyond each spec's placed count.
+    # u's j-th committed alloc, appended in commit order — the COO
+    # payload is built from THIS (one pass over U×M cells) instead of a
+    # nonzero/compaction pass over the [U, N] matrix (measured 0.5s at
+    # the 1024×10048 north-star shape vs ~50ms from slots).  -1 padding
+    # beyond each spec's placed count.
     slots: jnp.ndarray = None              # [U, M] int32
+    # Commit-aligned score side-outputs (slot_m > 0 AND with_scores):
+    # the binpack score / collision count of each slot's commit — the
+    # [U, N] commit_scores/commit_collisions carries compile away
+    # entirely in this mode.
+    slot_scores: jnp.ndarray = None        # [U, M] float32
+    slot_coll: jnp.ndarray = None          # [U, M] int32
 
 
 class NetTensors(NamedTuple):
@@ -405,7 +413,7 @@ def _placement_rounds_impl(
         def try_place(carry):
             (used, job_counts, remaining_count, placements,
              bw_used, port_words, dyn_free, dp_used, commit_scores,
-             commit_coll, slots) = carry
+             commit_coll, slots, slot_scores, slot_coll) = carry
 
             cap_left = capacity - used                       # [N, 4]
             fits = jnp.all(ask[u][None, :] <= cap_left, axis=1)
@@ -446,7 +454,7 @@ def _placement_rounds_impl(
         def commit(carry, ok, collisions, code_c, k):
             (used, job_counts, remaining_count, placements,
              bw_used, port_words, dyn_free, dp_used, commit_scores,
-             commit_coll, slots) = carry
+             commit_coll, slots, slot_scores, slot_coll) = carry
             base_score = _score_fit(used, ask[u], denom)
             score = base_score - penalty[u] * collisions.astype(jnp.float32)
             score = score + tie_jitter(jit_seed, u, node_idx)
@@ -474,16 +482,27 @@ def _placement_rounds_impl(
             placed = jnp.sum(sel_i)
             used = used + sel_i[:, None] * ask[u][None, :]
             job_counts = job_counts.at[job_index[u]].add(sel_i)
-            placements = placements.at[u].add(sel_i)
+            if not slot_m:
+                # The dense [U, N] placement matrix only feeds the
+                # matrix-form compaction; in slot mode the slot record
+                # IS the placement output, so the carry compiles away.
+                placements = placements.at[u].add(sel_i)
 
             if slot_m:
                 # Compact slot record: append this commit's node indices
-                # to spec u's slot row in ascending-node order — the
-                # device→host payload needs no nonzero pass later.
+                # to spec u's slot row in ascending-node order — the COO
+                # payload is built from this, no nonzero pass later.
                 pos = jnp.cumsum(sel.astype(jnp.int32))
                 offset = count[u] - remaining_count[u]  # placed so far
                 dest = jnp.where(sel, offset + pos - 1, jnp.int32(slot_m))
                 slots = slots.at[u, dest].set(node_idx, mode="drop")
+                if with_scores:
+                    # Commit-aligned score record: same dest scatter, so
+                    # the [U, N] score carries below compile away.
+                    slot_scores = slot_scores.at[u, dest].set(
+                        base_score, mode="drop")
+                    slot_coll = slot_coll.at[u, dest].set(
+                        collisions, mode="drop")
 
             remaining_count = remaining_count.at[u].add(-placed)
 
@@ -502,14 +521,15 @@ def _placement_rounds_impl(
                 dp_used = dp_used.at[u].set(dp_used[u] | dp_upd)
             # Commit-time AllocMetric side-outputs: pure binpack score and
             # the collision count behind any anti-affinity penalty.
-            if with_scores:
+            if with_scores and not slot_m:
                 commit_scores = commit_scores.at[u].set(jnp.where(
                     sel, base_score, commit_scores[u]))
                 commit_coll = commit_coll.at[u].set(jnp.where(
                     sel, collisions, commit_coll[u]))
             return (used, job_counts, remaining_count, placements,
                     bw_used, port_words, dyn_free, dp_used,
-                    commit_scores, commit_coll, slots), placed
+                    commit_scores, commit_coll, slots, slot_scores,
+                    slot_coll), placed
 
         def skip(carry):
             return carry, jnp.int32(0)
@@ -530,27 +550,28 @@ def _placement_rounds_impl(
     def round_body(state):
         (used, job_counts, remaining_count, placements,
          bw_used, port_words, dyn_free, dp_used, commit_scores,
-         commit_coll, slots, _, rounds) = state
+         commit_coll, slots, slot_scores, slot_coll, _, rounds) = state
         carry, placed = lax.scan(
             place_one_spec,
             (used, job_counts, remaining_count, placements,
              bw_used, port_words, dyn_free, dp_used, commit_scores,
-             commit_coll, slots),
+             commit_coll, slots, slot_scores, slot_coll),
             jnp.arange(u_pad),
         )
         (used, job_counts, remaining_count, placements,
          bw_used, port_words, dyn_free, dp_used, commit_scores,
-         commit_coll, slots) = carry
+         commit_coll, slots, slot_scores, slot_coll) = carry
         progress = jnp.sum(placed)
         return (used, job_counts, remaining_count, placements,
                 bw_used, port_words, dyn_free, dp_used, commit_scores,
-                commit_coll, slots, progress, rounds + 1)
+                commit_coll, slots, slot_scores, slot_coll, progress,
+                rounds + 1)
 
     def round_cond(state):
         used = state[0]
         remaining_count = state[2]
-        progress = state[11]
-        rounds = state[12]
+        progress = state[13]
+        rounds = state[14]
         go = ((progress > 0) & (jnp.sum(remaining_count) > 0)
               & (rounds < max_rounds))
         # Capacity early-exit: if no node can fit even the SMALLEST
@@ -567,19 +588,27 @@ def _placement_rounds_impl(
                                    axis=1))
         return go & fits_any
 
-    placements0 = jnp.zeros((u_pad, n_pad), dtype=jnp.int32)
-    score_shape = (u_pad, n_pad) if with_scores else (1, 1)
+    placements0 = jnp.zeros((u_pad, n_pad) if not slot_m else (1, 1),
+                            dtype=jnp.int32)
+    # Matrix-form score carries only when scores are wanted AND no slot
+    # record exists (slot mode carries commit-aligned [U, M] scores
+    # instead — two dense [U, N] buffers cheaper).
+    score_shape = ((u_pad, n_pad) if with_scores and not slot_m
+                   else (1, 1))
     scores0 = jnp.zeros(score_shape, dtype=jnp.float32)
     coll0 = jnp.zeros(score_shape, dtype=jnp.int32)
     slots0 = jnp.full((u_pad, slot_m) if slot_m else (1, 1), -1,
                       dtype=jnp.int32)
+    sscore_shape = (u_pad, slot_m) if with_scores and slot_m else (1, 1)
+    sscores0 = jnp.zeros(sscore_shape, dtype=jnp.float32)
+    scoll0 = jnp.zeros(sscore_shape, dtype=jnp.int32)
     state = (used0, job_counts0, count, placements0,
              net.bw_used, net.port_words, net.dyn_free, dp.used0, scores0,
-             coll0, slots0,
+             coll0, slots0, sscores0, scoll0,
              jnp.array(1, dtype=jnp.int32), jnp.array(0, dtype=jnp.int32))
     (used, job_counts, remaining, placements,
-     _bw, _pw, _df, _dpu, commit_scores, commit_coll, slots, _,
-     rounds) = lax.while_loop(round_cond, round_body, state)
+     _bw, _pw, _df, _dpu, commit_scores, commit_coll, slots, slot_scores,
+     slot_coll, _, rounds) = lax.while_loop(round_cond, round_body, state)
 
     return PlacementResult(
         placements=placements,
@@ -589,6 +618,8 @@ def _placement_rounds_impl(
         commit_scores=commit_scores,
         commit_collisions=commit_coll,
         slots=slots,
+        slot_scores=slot_scores,
+        slot_coll=slot_coll,
     )
 
 
@@ -638,6 +669,16 @@ def _device_schedule(
 
     d = xfer.unpack_device(static_buf, meta_s)
     d.update(xfer.unpack_device(dyn_buf, meta_d))
+    # Quantized resource rows (ops/encode.py quantize_resource_rows):
+    # the static buffer carries int16/int8 capacity + used-baseline plus
+    # a per-dimension power-of-two scale codebook; dequantization is one
+    # exact integer multiply, so the placement math below is bit-
+    # identical to the int32 path.  Keyed on the (static) meta, so the
+    # branch specializes at trace time.
+    if "res_scale" in d:
+        scale = d.pop("res_scale")[None, :]
+        d["cap"] = d.pop("cap_q").astype(jnp.int32) * scale
+        d["used_base"] = d.pop("used_base_q").astype(jnp.int32) * scale
     # Materialize the unpacked arrays before they enter the placement
     # while/scan: without the barrier XLA fuses the slice+bitcast decode
     # of the packed buffer into the loop BODY and re-decodes the whole
@@ -684,42 +725,71 @@ def _device_schedule(
     return result, feas
 
 
-@jax.jit
-def _device_slots_pack(result: PlacementResult, feas: jnp.ndarray):
-    """Dispatch 2 (slot mode): summary pack only — placements already
-    live in the compact [U, M] slot matrix recorded during the scan, so
-    no nonzero/compaction pass over the [U, N] matrix runs at all (that
-    pass measured 0.6s at 1024×50048).  Slots ship as uint16 (node
-    index < 65536; -1 padding wraps to 65535 but the host reads only
-    each spec's placed-count prefix)."""
-    from . import xfer
+def _slots_coo_gather(slots: jnp.ndarray, slot_scores: jnp.ndarray,
+                      slot_coll: jnp.ndarray, *, out_rows: int,
+                      with_scores: bool, compact_u16: bool):
+    """COO from the commit-aligned slot record: a GATHER over the output
+    rows (searchsorted on the per-spec prefix sums) instead of a nonzero
+    over the U×N placement matrix — 0.5s → ~15ms at the 1024×10048
+    north-star shape; a scatter formulation of the same thing measured
+    0.26s (XLA CPU scatters are serial and bounds-checked).
 
-    feas_count = jnp.sum(feas, axis=1).astype(jnp.int32)
-    summary, _ = xfer.pack_device({
-        "unplaced": result.unplaced,
-        "feas_count": feas_count,
-        "scalars": jnp.stack(
-            [jnp.int32(0), result.rounds]).astype(jnp.int32),
-    })
-    return summary, result.slots.astype(jnp.uint16)
+    Entries are per-ALLOC (counts ≡ 1, so a node committed in two
+    rounds appears twice), rows ascending by construction (per-spec
+    contiguous slot prefixes in spec order), scores aligned with their
+    commits.  Rows beyond nnz are -1 padding (the host reads only the
+    [:nnz] prefix).  Returns (coo [out_rows, C], nnz)."""
+    u_pad, m = slots.shape
+    valid_src = slots >= 0                          # [U, M] — contiguous
+    placed = jnp.sum(valid_src, axis=1).astype(jnp.int32)
+    csum = jnp.cumsum(placed)                       # [U]
+    nnz = csum[-1]
+    i = jnp.arange(out_rows, dtype=jnp.int32)
+    u = jnp.searchsorted(csum, i, side="right").astype(jnp.int32)
+    offs = csum - placed                            # per-spec start
+    uc = jnp.clip(u, 0, u_pad - 1)
+    j = jnp.clip(i - offs[uc], 0, m - 1)
+    valid = i < nnz
+    rows = jnp.where(valid, uc, -1)
+    cols = jnp.where(valid, slots[uc, j], 0)
+    counts = valid.astype(jnp.int32)
+    dt = jnp.uint16 if compact_u16 else jnp.int32
+    coo_cols = [rows.astype(dt), cols.astype(dt), counts.astype(dt)]
+    if with_scores:
+        sc = jnp.where(valid, slot_scores[uc, j], 0.0)
+        co = jnp.where(valid, slot_coll[uc, j], 0)
+        coo_cols += [lax.bitcast_convert_type(sc, jnp.int32), co]
+    return jnp.stack(coo_cols, axis=1), nnz
 
 
-@functools.partial(jax.jit, static_argnames=("with_scores", "max_nnz",
+@functools.partial(jax.jit, static_argnames=("out_rows", "with_scores",
                                              "compact_u16"))
-def _device_compact(result: PlacementResult, feas: jnp.ndarray,
-                    *, with_scores: bool, max_nnz: int,
-                    compact_u16: bool = False):
-    """Dispatch 2: COO compaction + packed summary (device-resident
-    inputs, so the extra dispatch costs no link traffic — and keeping it
-    out of the scheduling program keeps XLA compile time sane).
+def slots_to_coo(slots: jnp.ndarray, slot_scores: jnp.ndarray,
+                 slot_coll: jnp.ndarray, *, out_rows: int,
+                 with_scores: bool, compact_u16: bool):
+    """Standalone jitted slot→COO gather for the fused overflow path:
+    when nnz exceeds the payload window, the host dispatches this over
+    the device-resident slot record and prefix-fetches exactly the rows
+    it needs — fetch bytes stay proportional to placements, not to the
+    [U, M] record size."""
+    return _slots_coo_gather(slots, slot_scores, slot_coll,
+                             out_rows=out_rows, with_scores=with_scores,
+                             compact_u16=compact_u16)
 
-    compact_u16 halves the COO bytes on the link (row/col/count as
-    uint16) — valid only without scores and when U/N fit in 16 bits;
-    safe because the host only ever reads the valid [:nnz] prefix (the
-    -1 fill would wrap)."""
-    from . import xfer
 
-    u_pad, n_pad = feas.shape
+def _compact_from_slots(result: PlacementResult, *, out_rows: int,
+                        with_scores: bool, compact_u16: bool):
+    return _slots_coo_gather(result.slots, result.slot_scores,
+                             result.slot_coll, out_rows=out_rows,
+                             with_scores=with_scores,
+                             compact_u16=compact_u16)
+
+
+def _compact_coo(result: PlacementResult, *, u_pad: int, n_pad: int,
+                 with_scores: bool, max_nnz: int, compact_u16: bool):
+    """Shared COO compaction expression (the two-phase _device_compact
+    and the fused single-buffer program must emit byte-identical
+    triplets).  Returns (coo [max_nnz, C], nnz scalar)."""
     rows, cols = jnp.nonzero(result.placements, size=max_nnz, fill_value=-1)
     valid = rows >= 0
     nnz = jnp.sum(valid.astype(jnp.int32))
@@ -732,8 +802,35 @@ def _device_compact(result: PlacementResult, feas: jnp.ndarray,
         sc = jnp.where(valid, result.commit_scores[r, c], 0.0)
         co = jnp.where(valid, result.commit_collisions[r, c], 0)
         coo_cols += [lax.bitcast_convert_type(sc, jnp.int32), co]
-    coo = jnp.stack(coo_cols, axis=1)
+    return jnp.stack(coo_cols, axis=1), nnz
 
+
+@functools.partial(jax.jit, static_argnames=("with_scores", "max_nnz",
+                                             "compact_u16", "slot_m"))
+def _device_compact(result: PlacementResult, feas: jnp.ndarray,
+                    *, with_scores: bool, max_nnz: int,
+                    compact_u16: bool = False, slot_m: int = 0):
+    """Dispatch 2: COO compaction + packed summary (device-resident
+    inputs, so the extra dispatch costs no link traffic — and keeping it
+    out of the scheduling program keeps XLA compile time sane).
+
+    With slot_m the COO comes from the commit-aligned slot record (one
+    U×M pass, per-alloc entries); otherwise from a nonzero over the
+    [U, N] matrix.  compact_u16 halves the COO bytes on the link
+    (row/col/count as uint16) — valid only without scores and when U/N
+    fit in 16 bits; safe because the host only ever reads the valid
+    [:nnz] prefix (the -1 fill would wrap)."""
+    from . import xfer
+
+    u_pad, n_pad = feas.shape
+    if slot_m:
+        coo, nnz = _compact_from_slots(
+            result, out_rows=max_nnz, with_scores=with_scores,
+            compact_u16=compact_u16)
+    else:
+        coo, nnz = _compact_coo(result, u_pad=u_pad, n_pad=n_pad,
+                                with_scores=with_scores, max_nnz=max_nnz,
+                                compact_u16=compact_u16)
     feas_count = jnp.sum(feas, axis=1).astype(jnp.int32)
     summary, _ = xfer.pack_device({
         "unplaced": result.unplaced,
@@ -770,15 +867,12 @@ def device_pass(
     the XLA optimization time of the big scheduling program from
     compounding with the compaction graph.
 
-    With slot_m > 0 (requires with_scores=False, n_pad <= 65536):
-    returns (summary_buf uint8, slots uint16[U, slot_m], feas) — the
-    placement payload recorded compactly during the scan, skipping the
-    [U, N] nonzero pass entirely.
-
-    Otherwise returns (summary_buf uint8, coo [max_nnz, C], feas);
+    Returns (summary_buf uint8, coo [max_nnz, C], feas);
     C = 5 with scores (int32: row, col, count, score-bits, collisions),
     else 3 (row, col, count — uint16 when U/N/rounds all fit 16 bits,
-    int32 otherwise; read the dtype off the array).  feas stays on
+    int32 otherwise; read the dtype off the array).  With slot_m > 0 the
+    COO is built from the scan's commit-aligned slot record (per-alloc
+    entries, counts ≡ 1) instead of a [U, N] nonzero.  feas stays on
     device for the rare lazy failure-forensics row fetch.
     """
     result, feas = _device_schedule(
@@ -786,9 +880,6 @@ def device_pass(
         u_pad=u_pad, n_pad=n_pad,
         with_networks=with_networks, with_dp=with_dp,
         with_scores=with_scores, max_rounds=max_rounds, slot_m=slot_m)
-    if slot_m:
-        summary, slots = _device_slots_pack(result, feas)
-        return summary, slots, feas
     # <= 65536: u16 stores values 0..65535 and row/col/count are all
     # strictly below their pad bound (a 65536-node bucket still has max
     # col 65535 — `< 65536` wrongly fell back to int32 exactly at the
@@ -797,8 +888,141 @@ def device_pass(
                    and max_rounds < 65536)
     summary, coo = _device_compact(
         result, feas, with_scores=with_scores, max_nnz=max_nnz,
-        compact_u16=compact_u16)
+        compact_u16=compact_u16, slot_m=slot_m)
     return summary, coo, feas
+
+
+# Fused result-buffer COO window: the single transfer carries at most
+# this many payload bytes; batches whose nnz exceeds the window (rare —
+# it takes >8MB of placements) pay one extra prefix fetch from the
+# device-resident full COO.
+FUSED_WINDOW_BYTES = 8 << 20
+
+
+def fused_window(max_nnz: int, *, with_scores: bool,
+                 compact_u16: bool) -> int:
+    bytes_per_row = (5 if with_scores else 3) * (2 if compact_u16 else 4)
+    window = max_nnz
+    while window * bytes_per_row > FUSED_WINDOW_BYTES and window > 8:
+        window //= 2
+    return window
+
+
+def fused_layout(u_pad: int, *, window_nnz: int, with_scores: bool,
+                 compact_u16: bool):
+    """Layout of the fused score-and-commit result buffer: summary
+    (unplaced + feas_count + [nnz, rounds]) AND the COO placement
+    payload window in ONE packed uint8 buffer, so the whole batch
+    result crosses the link in a single transfer (ops/xfer.py layout():
+    both sides compute the offsets independently)."""
+    from . import xfer
+
+    ncols = 5 if with_scores else 3
+    return xfer.layout({
+        "unplaced": ("i32", (u_pad,)),
+        "feas_count": ("i32", (u_pad,)),
+        "scalars": ("i32", (2,)),       # [nnz, rounds]
+        "coo": ("u16" if compact_u16 else "i32", (window_nnz, ncols)),
+    })
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "meta_s", "meta_d", "u_pad", "n_pad", "with_networks", "with_dp",
+    "with_scores", "max_nnz", "max_rounds", "slot_m", "compact_u16",
+    "window_nnz"))
+def _fused_score_commit(
+    static_buf: jnp.ndarray,
+    dyn_buf: jnp.ndarray,
+    *,
+    meta_s,
+    meta_d,
+    u_pad: int,
+    n_pad: int,
+    with_networks: bool,
+    with_dp: bool,
+    with_scores: bool,
+    max_nnz: int,
+    max_rounds: int = 256,
+    slot_m: int = 0,
+    compact_u16: bool = False,
+    window_nnz: int = 0,
+):
+    """ONE device dispatch for the whole batch: unpack (+ dequantize) →
+    feasibility → lax.scan capacity-feedback placement rounds → COO
+    compaction (from the commit-aligned slot record when slot_m) →
+    single packed result buffer.  The two-dispatch schedule/compact
+    split (device_pass) remains the fallback behind NOMAD_TPU_FUSED=0
+    and the diagnostics paths; placements are bit-identical between the
+    two by construction (same _device_schedule, same compaction
+    expressions)."""
+    result, feas = _device_schedule(
+        static_buf, dyn_buf, meta_s=meta_s, meta_d=meta_d,
+        u_pad=u_pad, n_pad=n_pad, with_networks=with_networks,
+        with_dp=with_dp, with_scores=with_scores, max_rounds=max_rounds,
+        slot_m=slot_m)
+    from . import xfer
+
+    feas_count = jnp.sum(feas, axis=1).astype(jnp.int32)
+    if slot_m:
+        # The payload window is gathered directly (no full-size COO is
+        # ever materialized); the raw slot record rides along as the
+        # overflow source — device-resident, fetched only when nnz
+        # exceeds the window.
+        coo_win, nnz = _compact_from_slots(
+            result, out_rows=window_nnz, with_scores=with_scores,
+            compact_u16=compact_u16)
+        aux = (result.slots, result.slot_scores, result.slot_coll)
+    else:
+        coo_full, nnz = _compact_coo(
+            result, u_pad=u_pad, n_pad=n_pad, with_scores=with_scores,
+            max_nnz=max_nnz, compact_u16=compact_u16)
+        coo_win = coo_full[:window_nnz]
+        aux = coo_full
+    buf, _ = xfer.pack_device({
+        "unplaced": result.unplaced,
+        "feas_count": feas_count,
+        "scalars": jnp.stack([nnz, result.rounds]).astype(jnp.int32),
+        "coo": coo_win,
+    })
+    return buf, aux, feas
+
+
+def fused_pass(
+    static_buf: jnp.ndarray,
+    dyn_buf: jnp.ndarray,
+    *,
+    meta_s,
+    meta_d,
+    u_pad: int,
+    n_pad: int,
+    with_networks: bool,
+    with_dp: bool,
+    with_scores: bool,
+    max_nnz: int,
+    max_rounds: int = 256,
+    slot_m: int = 0,
+):
+    """Fused score-and-commit entry: returns (packed result buffer,
+    full COO on device, feas on device, result layout meta).  The
+    caller fetches the packed buffer with ONE jax.device_get and
+    decodes host-side with xfer.unpack_host(buf, meta).  ``aux`` is the
+    device-resident overflow source — the full COO (matrix mode) or the
+    raw slot record triple (slot mode) — touched only when nnz
+    overflows the payload window; ``feas`` only for the rare lazy
+    failure-forensics rows."""
+    compact_u16 = (not with_scores and u_pad <= 65536
+                   and n_pad <= 65536 and max_rounds < 65536)
+    window_nnz = fused_window(max_nnz, with_scores=with_scores,
+                              compact_u16=compact_u16)
+    buf, aux, feas = _fused_score_commit(
+        static_buf, dyn_buf, meta_s=meta_s, meta_d=meta_d,
+        u_pad=u_pad, n_pad=n_pad, with_networks=with_networks,
+        with_dp=with_dp, with_scores=with_scores, max_nnz=max_nnz,
+        max_rounds=max_rounds, slot_m=slot_m, compact_u16=compact_u16,
+        window_nnz=window_nnz)
+    meta = fused_layout(u_pad, window_nnz=window_nnz,
+                        with_scores=with_scores, compact_u16=compact_u16)
+    return buf, aux, feas, meta
 
 
 @functools.partial(jax.jit, static_argnames=("max_nnz",))

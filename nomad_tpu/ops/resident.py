@@ -64,8 +64,9 @@ RES_DIMS = 4
 
 
 def enabled() -> bool:
-    return os.environ.get("NOMAD_TPU_RESIDENT", "1").strip().lower() not in (
-        "0", "false", "no")
+    from ..utils.flags import env_flag
+
+    return env_flag("NOMAD_TPU_RESIDENT", True)
 
 
 def guard_every() -> int:
@@ -105,6 +106,13 @@ FULL_REENCODES = 0
 STALENESS_FALLBACKS = 0
 GUARD_RUNS = 0
 GUARD_MISMATCHES = 0
+# Quantization round-trip guard (PR 6): every quantized static upload is
+# dequantized host-side and bit-compared against the exact rows before
+# the buffer ships — the mirror-drift guard extended to the narrow-dtype
+# wire representation.  A mismatch feeds the breaker and disables
+# quantization for that batch (the int32 path is always correct).
+QUANT_CHECKS = 0
+QUANT_MISMATCHES = 0
 
 # Last plan-apply index noted by the plan applier (server/plan_apply.py
 # index plumbing): rides the NodeStateDelta event payloads so operators
@@ -130,10 +138,38 @@ def invalidate() -> None:
 def reset_counters() -> None:
     """Test helper: zero the module counters and drop the cache."""
     global HITS, FULL_REENCODES, STALENESS_FALLBACKS, GUARD_RUNS
-    global GUARD_MISMATCHES
+    global GUARD_MISMATCHES, QUANT_CHECKS, QUANT_MISMATCHES
     invalidate()
     HITS = FULL_REENCODES = STALENESS_FALLBACKS = 0
     GUARD_RUNS = GUARD_MISMATCHES = 0
+    QUANT_CHECKS = QUANT_MISMATCHES = 0
+
+
+def check_quant_roundtrip(exact: np.ndarray, quantized: np.ndarray,
+                          scale: np.ndarray, breaker=None,
+                          what: str = "rows") -> bool:
+    """Bit-exact round-trip bound for quantized resource rows: the
+    dequantized matrix must equal the exact one (the quantizer only
+    quantizes when it can be exact, so any difference is corruption or a
+    codebook bug).  Mismatch ⇒ breaker feed + event, caller falls back
+    to the int32 wire path.  Cost: one [n, 4] integer compare."""
+    from .encode import dequantize_rows
+
+    global QUANT_CHECKS, QUANT_MISMATCHES
+    QUANT_CHECKS += 1
+    back = dequantize_rows(quantized, scale)
+    if np.array_equal(back, np.asarray(exact, dtype=np.int64)):
+        return True
+    QUANT_MISMATCHES += 1
+    bad = int((back != exact).any(axis=-1).sum())
+    logger.error(
+        "quantized %s failed the round-trip bound on %d rows; shipping "
+        "exact int32 rows and feeding the breaker", what, bad)
+    tracing.event("resident.quant_mismatch", rows=bad, what=what)
+    _publish("quant_mismatch", Rows=bad, What=what)
+    if breaker is not None:
+        breaker.record(False)
+    return False
 
 
 def _publish(etype_reason: str, **payload) -> None:

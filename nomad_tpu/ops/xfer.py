@@ -35,6 +35,9 @@ _DTYPES = {
     "i32": np.int32,
     "u32": np.uint32,
     "f32": np.float32,
+    "i16": np.int16,
+    "u16": np.uint16,
+    "i8": np.int8,
     "u8": np.uint8,
     "b1": np.bool_,
 }
@@ -45,16 +48,9 @@ Meta = Tuple[Tuple[str, str, Tuple[int, ...], int], ...]
 
 def _tag(dtype) -> str:
     dtype = np.dtype(dtype)
-    if dtype == np.int32:
-        return "i32"
-    if dtype == np.uint32:
-        return "u32"
-    if dtype == np.float32:
-        return "f32"
-    if dtype == np.uint8:
-        return "u8"
-    if dtype == np.bool_:
-        return "b1"
+    for tag, dt in _DTYPES.items():
+        if dtype == dt:
+            return tag
     raise TypeError(f"unsupported pack dtype {dtype}")
 
 
@@ -104,15 +100,22 @@ def unpack_device(buf: jnp.ndarray, meta: Meta) -> Dict[str, jnp.ndarray]:
     for name, tag, shape, off in meta:
         np_dtype = _DTYPES[tag]
         nbytes = _nbytes(tag, shape)
+        itemsize = np.dtype(np_dtype).itemsize
         if np_dtype in (np.uint8, np.bool_):
             arr = lax.slice(buf, (off,), (off + nbytes,))
             if np_dtype == np.bool_:
                 arr = arr.astype(jnp.bool_)
             out[name] = arr.reshape(shape)
+        elif itemsize == 1:   # int8: same-width bitcast, no regroup
+            raw = lax.slice(buf, (off,), (off + nbytes,))
+            out[name] = lax.bitcast_convert_type(
+                raw, jnp.dtype(np_dtype)).reshape(shape)
         else:
-            padded = nbytes + ((-nbytes) % 4)
-            raw = lax.slice(buf, (off,), (off + padded,))
-            words = raw.reshape(-1, 4)
+            # Group the bytes into itemsize-wide words and bitcast; the
+            # slice stays at nbytes (offsets are 4-aligned by layout(),
+            # and nbytes is always a multiple of itemsize).
+            raw = lax.slice(buf, (off,), (off + nbytes,))
+            words = raw.reshape(-1, itemsize)
             arr = lax.bitcast_convert_type(words, jnp.dtype(np_dtype))
             out[name] = arr.reshape(shape)
     return out

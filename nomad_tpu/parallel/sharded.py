@@ -33,6 +33,29 @@ from ..ops.kernels import (
 )
 from ..ops.encode import MISSING
 
+# shard_map moved to the jax top level (and check_rep became check_vma)
+# in newer releases; support both so the mesh path runs on whichever
+# jax the image bakes in.
+_SMAP_LEGACY = not hasattr(jax, "shard_map")
+if not _SMAP_LEGACY:
+    _shard_map = jax.shard_map
+    _SMAP_CHECK_OFF = {"check_vma": False}
+else:  # pragma: no cover — depends on installed jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+    # Legacy check_rep has no replication rule for while_loop at all, so
+    # the placement-rounds call site also needs it off (the new vma
+    # checker handles while fine and stays on there).
+    _SMAP_CHECK_OFF = {"check_rep": False}
+
+
+def _mark_varying(x):
+    """Mark a freshly-created array as node-axis-varying inside the
+    mapped function.  Only the new varying-manual-axes jax needs the
+    explicit cast; older shard_map has no vma tracking, so identity."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, (NODE_AXIS,), to="varying")
+    return x
+
 NEG_INF = -1e30
 
 # Mesh axis names: 'nodes' shards the node dimension of the score matrix
@@ -122,10 +145,10 @@ def sharded_candidate_scores(
         # Pallas interpret mode's internal block slicing carries no
         # varying-manual-axes info, which trips shard_map's vma checker
         # on CPU; the compiled TPU path keeps full checking.
-        smap_kwargs["check_vma"] = False
+        smap_kwargs.update(_SMAP_CHECK_OFF)
 
     @functools.partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(P(None, NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS),
                   P(NODE_AXIS), P(None)),
@@ -225,7 +248,7 @@ def sharded_placement_rounds(
     jit_seed = jitter_seed(rng_key)
 
     @functools.partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(P(None, NODE_AXIS), P(NODE_AXIS), P(NODE_AXIS),
                   P(NODE_AXIS), P(None), P(None), P(None), P(None),
@@ -236,6 +259,7 @@ def sharded_placement_rounds(
                   # dp: per-spec replicated, node attrs sharded
                   P(None), P(None), P(None), P(NODE_AXIS)),
         out_specs=(P(None, NODE_AXIS), P(None), P(NODE_AXIS), P()),
+        **(_SMAP_CHECK_OFF if _SMAP_LEGACY else {}),
     )
     def _run(feas_l, used_l, cap_l, denom_l, ask_r, count_r, penalty_r,
              dh_r, job_index_r, jc_l, jit_seed_r,
@@ -355,9 +379,8 @@ def sharded_placement_rounds(
             return ((progress > 0) & (jnp.sum(remaining) > 0)
                     & (rounds < max_rounds))
 
-        placements0 = lax.pcast(
-            jnp.zeros((u_pad, n_l), dtype=jnp.int32),
-            (NODE_AXIS,), to="varying")
+        placements0 = _mark_varying(
+            jnp.zeros((u_pad, n_l), dtype=jnp.int32))
         state = (used_l, jc_l, count_r, placements0,
                  bw_used_l0, port_words_l0, dyn_free_l0, dp_used0_r,
                  jnp.array(1, dtype=jnp.int32), jnp.array(0, dtype=jnp.int32))

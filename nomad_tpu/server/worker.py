@@ -404,10 +404,20 @@ class BatchWorker(Worker):
         with tr.span("worker.process_batch",
                      num_evals=len(batch),
                      **tracing.eval_id_attrs(
-                         (ev for ev, _ in batch), len(batch))):
-            self._process_batch(batch)
+                         (ev for ev, _ in batch), len(batch))) as sp:
+            stats = self._process_batch(batch)
+            if stats is not None and stats.device_ran:
+                # Fused-path forensics at the worker boundary: which
+                # program shape served the batch and what it cost on the
+                # link (the single-fetch contract is auditable per batch
+                # from the span tree alone).
+                sp.set(fused=stats.fused, quantized=stats.quantized,
+                       fetch_bytes=stats.fetch_bytes,
+                       commit_s=round(stats.commit_seconds, 4))
 
-    def _process_batch(self, batch: List[Tuple[s.Evaluation, str]]) -> None:
+    def _process_batch(self, batch: List[Tuple[s.Evaluation, str]]):
+        """Returns the batch's BatchStats, or None when the batch was
+        nacked."""
         max_index = max(ev.modify_index for ev, _ in batch)
         with tracing.span("worker.wait_for_index"):
             self.wait_for_index(max_index, RAFT_SYNC_LIMIT)
@@ -431,7 +441,7 @@ class BatchWorker(Worker):
             ev.id: self.broker.delivery_attempts(ev.id)
             for ev, _ in batch}
         try:
-            sched.schedule_batch([ev for ev, _ in batch])
+            stats = sched.schedule_batch([ev for ev, _ in batch])
         except Exception as exc:
             self.logger.exception("batch scheduling failed; nacking batch")
             self.record_eval_failures([ev for ev, _ in batch], exc)
@@ -447,7 +457,7 @@ class BatchWorker(Worker):
                     self.broker.nack(ev.id, token)
                 except EvalBrokerError:
                     pass
-            return
+            return None
         for ev, token in batch:
             try:
                 self.broker.ack(ev.id, token)
@@ -465,6 +475,7 @@ class BatchWorker(Worker):
                     # per-eval Worker's span.
                     tr.event("worker.attempt", eval_id=ev.id,
                              attempt=attempts[ev.id])
+        return stats
 
     # -- pipelined drain (NOMAD_TPU_PIPELINE=1) ----------------------------
     #
@@ -564,6 +575,7 @@ class BatchWorker(Worker):
             # so a nested context-managed span would mis-stack).
             tr.record("worker.process_batch", ctx.t0, time.monotonic(),
                       num_evals=len(ctx.batch), pipelined=True,
+                      fused=stats.fused, fetch_bytes=stats.fetch_bytes,
                       **tracing.eval_id_attrs(
                           (ev for ev, _ in ctx.batch), len(ctx.batch)))
         for ev, token in ctx.batch:

@@ -1,0 +1,25 @@
+"""Env-var kill-switch flags, one parser for every NOMAD_TPU_* knob.
+
+The codebase grew several inline copies of the ``.strip().lower() not in
+("0", "false", "no")`` idiom with subtly different empty-string
+semantics.  This is the one place that decides: an UNSET or EMPTY value
+means the default; otherwise anything except 0/false/no is true.
+"""
+from __future__ import annotations
+
+import os
+
+_FALSY = ("0", "false", "no")
+
+
+def env_flag(name: str, default: bool) -> bool:
+    """Boolean env knob, re-read on every call (runtime kill-switch —
+    flipping the variable takes effect on the next batch, never cached
+    at import)."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    raw = raw.strip().lower()
+    if raw == "":
+        return default
+    return raw not in _FALSY

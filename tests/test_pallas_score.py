@@ -1,7 +1,12 @@
 """Differential tests for the pallas fused scoring kernel
 (ops/pallas_score.py) against the jnp reference composition
 (ops/kernels.py:_score_fit + fit/feas masks). Runs in interpret mode on
-the CPU backend — identical semantics, no Mosaic."""
+the CPU backend — identical semantics, no Mosaic.
+
+Tier split (Pallas go/no-go follow-through, PR 6): the small-shape
+interpret-mode parity tests in TestInterpretParityQuick run UNMARKED so
+tier-1 exercises both pallas kernels on CPU every round; the heavy
+multi-block/mesh differentials keep the ``slow`` mark."""
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -9,8 +14,9 @@ import pytest
 from nomad_tpu.ops.kernels import _score_fit
 from nomad_tpu.ops.pallas_score import NEG_INF, masked_score_matrix
 
-# Heavy integration/differential module: quick tier skips it (pytest.ini).
-pytestmark = pytest.mark.slow
+# Heavy integration/differential sweeps: quick tier skips THEM (the
+# small-shape interpret parity class below stays tier-1).
+slow = pytest.mark.slow
 
 
 def _reference(feas, used, capacity, denom, ask):
@@ -49,6 +55,7 @@ def _mk(n, u, seed=0, zero_denom_frac=0.0):
     (700, 3, 2),     # padded node axis (700 → 1024)
     (64, 1, 3),      # single small padded block
 ])
+@slow
 def test_matches_reference_composition(n, u, seed):
     feas, used, capacity, denom, ask = _mk(n, u, seed)
     out = np.asarray(masked_score_matrix(
@@ -58,6 +65,7 @@ def test_matches_reference_composition(n, u, seed):
     np.testing.assert_array_equal(out, ref)
 
 
+@slow
 def test_zero_denom_and_full_nodes():
     """Degenerate capacity (denom 0 → ScoreFit 0) and fully-used nodes
     (no fit → NEG_INF) follow the reference bit-for-bit."""
@@ -71,6 +79,7 @@ def test_zero_denom_and_full_nodes():
     assert np.all(out[:, :64] == NEG_INF)
 
 
+@slow
 def test_padded_columns_never_leak():
     """Padded node columns must not appear as feasible candidates."""
     feas, used, capacity, denom, ask = _mk(130, 2, 11)
@@ -80,6 +89,7 @@ def test_padded_columns_never_leak():
     assert out.shape == (2, 130)
 
 
+@slow
 def test_mesh_path_pallas_equals_xla():
     """sharded_candidate_scores with the pallas kernel produces the
     identical candidate table to the default XLA path on the 8-device
@@ -129,6 +139,7 @@ def _reference_scored_rows(feas, used, capacity, denom, ask, penalty,
     (700, 3, 13, 0, 0),       # padded node axis
     (512, 4, 17, 32, 2048),   # shard offsets: global-index jitter keying
 ])
+@slow
 def test_scored_rows_matches_commit_expression(n, u, seed, u_off, n_off):
     """scored_rows fuses fit+feas+ScoreFit+penalty+jitter; must be
     bit-identical to the placement loop's commit composition."""
@@ -159,6 +170,7 @@ def test_scored_rows_matches_commit_expression(n, u, seed, u_off, n_off):
         f"max abs diff {np.abs(got - want).max()}")
 
 
+@slow
 def test_scored_rows_shard_offsets_tile_global_matrix():
     """Two shards computing their slices with u/n offsets must tile to
     exactly the single-chip full matrix (the multichip contract)."""
@@ -187,3 +199,39 @@ def test_scored_rows_shard_offsets_tile_global_matrix():
         jnp.asarray(coll[:, half:]), np.uint32(99), n_offset=half, **kw))
     tiled = np.concatenate([left, right], axis=1)
     assert (tiled == full).all()
+
+
+# -- tier-1 interpret-mode parity (Pallas go/no-go follow-through) ---------
+
+class TestInterpretParityQuick:
+    """Small-shape interpret-mode parity, UNMARKED so the quick tier
+    (`pytest -m "not slow"`) exercises both pallas kernels on the CPU
+    backend every round — the go/no-go decision's standing regression
+    evidence (README "Pallas go/no-go")."""
+
+    def test_masked_score_matrix_interpret_parity(self):
+        feas, used, capacity, denom, ask = _mk(512, 2, 41,
+                                               zero_denom_frac=0.2)
+        used[:16] = capacity[:16]  # saturated nodes: NEG_INF lane
+        out = np.asarray(masked_score_matrix(
+            jnp.asarray(feas), jnp.asarray(used), jnp.asarray(capacity),
+            jnp.asarray(denom), jnp.asarray(ask), interpret=True))
+        ref = _reference(feas, used, capacity, denom, ask)
+        np.testing.assert_array_equal(out, ref)
+        assert np.all(out[:, :16] == NEG_INF)
+
+    def test_scored_rows_interpret_parity(self):
+        from nomad_tpu.ops.pallas_score import scored_rows
+
+        feas, used, capacity, denom, ask = _mk(512, 2, 43)
+        rng = np.random.default_rng(43)
+        penalty = rng.uniform(0.0, 25.0, 2).astype(np.float32)
+        coll = np.zeros((2, 512), np.int32)  # penalty inactive: bit-exact
+        got = np.asarray(scored_rows(
+            jnp.asarray(feas), jnp.asarray(used), jnp.asarray(capacity),
+            jnp.asarray(denom), jnp.asarray(ask), jnp.asarray(penalty),
+            jnp.asarray(coll), np.uint32(77), interpret=True))
+        want = _reference_scored_rows(
+            feas, used, capacity, denom, ask, penalty, coll,
+            np.uint32(77))
+        np.testing.assert_array_equal(got, want)
