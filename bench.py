@@ -876,6 +876,47 @@ def bench_steady(n_nodes: int = E_N_NODES, n_batches: int = 200,
     }
 
 
+def bench_control_plane(nodes: int = 800, submissions: int = 800):
+    """config_control: sustained control-plane throughput (ISSUE 7) —
+    the loadgen harness drives the REAL server stack twice on the same
+    seeded burst: the serial single-worker baseline (fresh O(cluster)
+    snapshot per eval, the pre-ISSUE-7 discipline) and M=4
+    stale-snapshot workers.  Host-only (no device time); scaled down
+    from the full `baseline` scenario to fit the bench budget."""
+    from dataclasses import replace
+
+    from nomad_tpu.loadgen.harness import compare_workers
+    from nomad_tpu.loadgen.scenario import get_scenario
+
+    sc = replace(get_scenario("baseline"), num_nodes=nodes,
+                 max_submissions=submissions, subscribers=32,
+                 drain_s=45.0)
+    cmp = compare_workers(sc, [1, 4])
+    serial_label = next(lbl for lbl in cmp["evals_per_s"]
+                        if "baseline" in lbl)
+    m4 = cmp["runs"]["4"]
+    out = {
+        "nodes": nodes, "submissions": submissions,
+        "serial_evals_per_s": cmp["evals_per_s"][serial_label],
+        "m4_evals_per_s": cmp["evals_per_s"]["4"],
+        "speedup": cmp["speedup"],
+        "submit_to_running_p99_ms":
+            m4["latency_ms"]["submit_to_running"]["p99"],
+        "plan_apply_p99_ms":
+            (m4["latency_ms"]["plan_apply"] or {}).get("p99"),
+        "snapshot_reuse": m4["control_plane"]["snapshot_reuse"],
+        "plan_conflicts": m4["control_plane"]["plan_conflicts"],
+        "stragglers": m4["sustained"]["stragglers_after_drain"],
+        "event_fanout_us": (m4.get("event_fanout")
+                            or {}).get("us_per_event"),
+    }
+    log(f"  control-plane: serial {out['serial_evals_per_s']} evals/s, "
+        f"M=4 stale {out['m4_evals_per_s']} evals/s "
+        f"({out['speedup']}x), submit→running p99 "
+        f"{out['submit_to_running_p99_ms']}ms")
+    return out
+
+
 def run_config(n_nodes: int, n_jobs: int, count_per_job: int, label: str,
                constrained: bool = False, trials: int = 3,
                keep_state: bool = False, n_dcs: int = 1):
@@ -1184,6 +1225,12 @@ def _child_main():
         if sd is not None:
             detail["score_regression"] = sd
 
+    # Control-plane saturation (ISSUE 7): host-only, early so a budget
+    # squeeze drops device stretch configs before this guard's feed.
+    cp = phase("config_control", 150, bench_control_plane)
+    if cp is not None:
+        detail["config_control"] = cp
+
     # Fused vs two-phase differential (PR 6): same problem through both
     # device programs; the delta must be exactly 0.0%.
     fd = phase("fused_vs_two_phase", 90, bench_fused_delta)
@@ -1333,13 +1380,14 @@ def _read_partial(path: str) -> dict:
 
 def _extract_baseline_numbers(doc: dict):
     """(northstar_median_s, single_eval_p95_ms, config_e_elapsed_s,
-    steady_placed_per_s, northstar_commit_fetch_s) from one
-    BENCH_r*.json trajectory doc.  Those files keep only a truncated
-    tail of the bench JSON line (and ``parsed`` is often null), so fall
-    back to regexing the decoded tail string."""
+    steady_placed_per_s, northstar_commit_fetch_s, control_evals_per_s,
+    control_s2r_p99_ms) from one BENCH_r*.json trajectory doc.  Those
+    files keep only a truncated tail of the bench JSON line (and
+    ``parsed`` is often null), so fall back to regexing the decoded
+    tail string."""
     import re
 
-    ns = p95 = ce = steady = cf = None
+    ns = p95 = ce = steady = cf = ctl = ctl_p99 = None
     parsed = doc.get("parsed")
     if isinstance(parsed, dict):
         det = parsed.get("detail") or parsed
@@ -1351,6 +1399,9 @@ def _extract_baseline_numbers(doc: dict):
                   or {}).get("sustained_placed_per_s")
         cf = (det.get("config_northstar_10k_x_1m")
               or {}).get("commit_fetch_s")
+        ctl = (det.get("config_control") or {}).get("m4_evals_per_s")
+        ctl_p99 = (det.get("config_control")
+                   or {}).get("submit_to_running_p99_ms")
     tail = doc.get("tail") or ""
     if ns is None:
         m = re.search(r'"config_northstar_10k_x_1m":\s*\{[^{}]*?'
@@ -1375,13 +1426,22 @@ def _extract_baseline_numbers(doc: dict):
         m = re.search(r'"config_northstar_10k_x_1m":.*?'
                       r'"commit_fetch_s":\s*([0-9.]+)', tail, re.DOTALL)
         cf = float(m.group(1)) if m else None
-    return ns, p95, ce, steady, cf
+    if ctl is None:
+        m = re.search(r'"config_control":\s*\{[^{}]*?'
+                      r'"m4_evals_per_s":\s*([0-9.]+)', tail)
+        ctl = float(m.group(1)) if m else None
+    if ctl_p99 is None:
+        m = re.search(r'"config_control":\s*\{[^{}]*?'
+                      r'"submit_to_running_p99_ms":\s*([0-9.]+)', tail)
+        ctl_p99 = float(m.group(1)) if m else None
+    return ns, p95, ce, steady, cf, ctl, ctl_p99
 
 
 def _latest_bench_baseline():
     """Newest BENCH_r*.json with parseable numbers →
     (name, ns_s, p95_ms, config_e_s, steady_placed_per_s,
-    northstar_commit_fetch_s)."""
+    northstar_commit_fetch_s, control_evals_per_s,
+    control_s2r_p99_ms)."""
     import glob
 
     here = os.path.dirname(os.path.abspath(__file__))
@@ -1395,7 +1455,7 @@ def _latest_bench_baseline():
         nums = _extract_baseline_numbers(doc)
         if any(v is not None for v in nums):
             return (os.path.basename(path),) + nums
-    return None, None, None, None, None, None
+    return (None,) * 8
 
 
 CHECK_THRESHOLD_DEFAULT = 1.5
@@ -1423,8 +1483,8 @@ def _check_main(argv) -> int:
         threshold = float(os.environ.get(
             "NOMAD_TPU_BENCH_CHECK_THRESHOLD", 0) or CHECK_THRESHOLD_DEFAULT)
 
-    baseline_file, base_ns, base_p95, base_ce, base_steady, base_cf = \
-        _latest_bench_baseline()
+    (baseline_file, base_ns, base_p95, base_ce, base_steady, base_cf,
+     base_ctl, base_ctl_p99) = _latest_bench_baseline()
     out = {"check": "bench-regression", "baseline": baseline_file,
            "threshold": threshold}
     if baseline_file is None:
@@ -1540,6 +1600,43 @@ def _check_main(argv) -> int:
         except Exception as exc:
             out["config_steady_placed_per_s"] = {"error": repr(exc)}
             failures.append(f"config_steady phase failed: {exc!r}")
+
+    # Control-plane throughput guard (ISSUE 7): sustained end-to-end
+    # evals/s with M=4 stale-snapshot workers must not fall below
+    # baseline/threshold, and the client-visible submit→running p99
+    # must not blow out past baseline×threshold.  Measured fresh even
+    # when the baseline predates the metric (this run's BENCH file
+    # carries it forward); the hard ≥2×-vs-serial evidence lives in the
+    # recorded LOADGEN_r*.json runs — here the serial leg is scaled
+    # down, so only regression-vs-baseline is gated.
+    try:
+        with _deadline(240, "check_control_plane"):
+            ctl = bench_control_plane()
+        cur_ctl = float(ctl["m4_evals_per_s"])
+        cur_p99 = float(ctl["submit_to_running_p99_ms"])
+        out["control_plane_evals_per_s"] = {
+            "baseline": base_ctl, "current": cur_ctl,
+            "speedup_vs_serial": ctl["speedup"],
+            "ratio": (round(cur_ctl / base_ctl, 3) if base_ctl else None)}
+        out["control_plane_s2r_p99_ms"] = {
+            "baseline": base_ctl_p99, "current": cur_p99,
+            "ratio": (round(cur_p99 / base_ctl_p99, 3)
+                      if base_ctl_p99 else None)}
+        if base_ctl is not None and cur_ctl < base_ctl / threshold:
+            failures.append(
+                f"control-plane sustained {cur_ctl:.0f} evals/s is below "
+                f"baseline {base_ctl:.0f}/{threshold}")
+        if base_ctl_p99 is not None and cur_p99 > base_ctl_p99 * threshold:
+            failures.append(
+                f"control-plane submit→running p99 {cur_p99:.0f}ms "
+                f"exceeds {threshold}x baseline {base_ctl_p99:.0f}ms")
+        if ctl["stragglers"]:
+            failures.append(
+                f"control-plane run left {ctl['stragglers']} stragglers "
+                "after drain")
+    except Exception as exc:
+        out["control_plane_evals_per_s"] = {"error": repr(exc)}
+        failures.append(f"control-plane phase failed: {exc!r}")
 
     out["failures"] = failures
     out["result"] = "fail" if failures else "ok"

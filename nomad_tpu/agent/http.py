@@ -24,6 +24,7 @@ from urllib.parse import parse_qs, urlparse
 
 from ..api.codec import from_wire, to_wire
 from ..jobspec.parse import parse_duration
+from ..server.eval_broker import BrokerLimitError
 from ..state.state_store import WatchSet
 from ..structs import structs as s
 
@@ -31,9 +32,10 @@ MAX_BLOCKING_WAIT = 300.0  # 5m default / 10m cap like the reference
 
 
 class CodedError(Exception):
-    def __init__(self, code: int, message: str):
+    def __init__(self, code: int, message: str, headers=None):
         super().__init__(message)
         self.code = code
+        self.headers = headers or {}
 
 
 class StreamResponse:
@@ -128,6 +130,7 @@ class HTTPServer:
         r("/v1/catalog/services", self.catalog_services_request)
         r("/v1/catalog/service/(?P<name>[^/]+)", self.catalog_service_request)
         r("/v1/metrics", self.metrics_request)
+        r("/v1/broker/stats", self.broker_stats_request)
         r("/v1/event/stream", self.event_stream_request)
         r("/v1/traces", self.traces_request)
         r("/v1/trace/eval/(?P<id>[^/]+)", self.trace_eval_request)
@@ -153,7 +156,14 @@ class HTTPServer:
             try:
                 obj, index = fn(req, query, **m.groupdict())
             except CodedError as e:
-                self._reply_error(req, e.code, str(e))
+                self._reply_error(req, e.code, str(e), e.headers)
+                return
+            except BrokerLimitError as e:
+                # Admission NACK: 429 + Retry-After so well-behaved
+                # clients back off (jittered client-side) instead of
+                # retrying into the saturated broker.
+                self._reply_error(req, 429, str(e),
+                                  {"Retry-After": f"{e.retry_after:.2f}"})
                 return
             except (ValueError, KeyError) as e:
                 self._reply_error(req, 400, str(e))
@@ -231,11 +241,14 @@ class HTTPServer:
         req.end_headers()
         req.wfile.write(body)
 
-    def _reply_error(self, req, code: int, msg: str) -> None:
+    def _reply_error(self, req, code: int, msg: str,
+                     headers: Optional[dict] = None) -> None:
         body = msg.encode()
         req.send_response(code)
         req.send_header("Content-Type", "text/plain")
         req.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            req.send_header(k, str(v))
         req.end_headers()
         req.wfile.write(body)
 
@@ -710,6 +723,16 @@ class HTTPServer:
                 raise CodedError(400, "metrics sink has no interval data")
             return TextResponse(render_prometheus(sink.latest())), None
         return self.server.metrics.sink.data(), None
+
+    def broker_stats_request(self, req, query):
+        """Eval-broker saturation surface (/v1/broker/stats): pending by
+        state/priority, the delivery-attempts histogram, admission /
+        coalesce / shed counters, plan-queue depth.  What the load
+        harness polls; what an operator reads to tell busy from
+        melting."""
+        if req.command != "GET":
+            raise CodedError(405, "Invalid method")
+        return self.server.broker_stats(), None
 
     # -- cluster event stream (server/event_broker.py) -----------------
 

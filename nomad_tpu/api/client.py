@@ -25,9 +25,12 @@ from .codec import from_wire, to_wire
 
 
 class APIError(Exception):
-    def __init__(self, code: int, message: str):
+    def __init__(self, code: int, message: str, retry_after: float = 0.0):
         super().__init__(f"Unexpected response code: {code} ({message})")
         self.code = code
+        # 429 admission NACKs carry the server's Retry-After hint;
+        # callers feed it into their jittered-backoff retry loops.
+        self.retry_after = retry_after
 
 
 @dataclass
@@ -99,7 +102,12 @@ class NomadAPI:
                 obj = json.loads(raw) if raw else None
                 return obj, meta
         except urllib.error.HTTPError as e:
-            raise APIError(e.code, e.read().decode("utf-8", "replace")) from e
+            try:
+                retry_after = float(e.headers.get("Retry-After") or 0.0)
+            except (TypeError, ValueError):
+                retry_after = 0.0
+            raise APIError(e.code, e.read().decode("utf-8", "replace"),
+                           retry_after=retry_after) from e
         except urllib.error.URLError as e:
             # connection-level failure (agent down, bad address)
             raise APIError(0, f"failed to reach agent at "
@@ -430,6 +438,11 @@ class System:
 
     def reconcile_summaries(self) -> None:
         self.c.put("/v1/system/reconcile/summaries")
+
+    def broker_stats(self) -> dict:
+        """Eval-broker saturation surface (/v1/broker/stats)."""
+        obj, _ = self.c.get("/v1/broker/stats")
+        return obj or {}
 
 
 class Operator:

@@ -787,6 +787,42 @@ def cmd_agent_info(args, out) -> int:
     return 0
 
 
+def cmd_broker_status(args, out) -> int:
+    """Eval-broker saturation surface (/v1/broker/stats): admission /
+    coalesce counters, pending by state and priority, delivery-attempt
+    histogram, plan-queue depth."""
+    api = _api(args)
+    stats = api.system.broker_stats()
+    if getattr(args, "json", False):
+        out.write(json.dumps(stats, indent=4, sort_keys=True) + "\n")
+        return 0
+    out.write(format_kv([
+        f"Enabled|{stats.get('Enabled')}",
+        f"Pending|{stats.get('Pending')}",
+        f"Max Pending|{stats.get('MaxPending') or 'unbounded'}",
+        f"Plan Queue Depth|{stats.get('PlanQueueDepth')}",
+        f"Admission Rejects|{stats.get('AdmissionRejects')}",
+        f"Coalesced|{stats.get('CoalescedTotal')}",
+        f"Shed|{stats.get('ShedTotal')}",
+    ]) + "\n")
+    by_state = stats.get("ByState") or {}
+    if by_state:
+        out.write("\nPending by State\n")
+        for k, v in sorted(by_state.items()):
+            out.write(f"  {k} = {v}\n")
+    by_prio = stats.get("ByPriority") or {}
+    if by_prio:
+        out.write("\nPending by Priority\n")
+        for k, v in sorted(by_prio.items(), key=lambda kv: int(kv[0])):
+            out.write(f"  {k} = {v}\n")
+    attempts = stats.get("DeliveryAttempts") or {}
+    if attempts:
+        out.write("\nDelivery Attempts\n")
+        for k, v in sorted(attempts.items(), key=lambda kv: int(kv[0])):
+            out.write(f"  {k} = {v}\n")
+    return 0
+
+
 def cmd_job_dispatch(args, out) -> int:
     """command/job_dispatch.go."""
     api = _api(args)
@@ -1024,6 +1060,8 @@ def build_parser() -> argparse.ArgumentParser:
                         help="dump the buffered backlog and exit"),
         sp.add_argument("-json", dest="json", action="store_true")))
     add("check", cmd_check)
+    add("broker-status", cmd_broker_status, lambda sp:
+        sp.add_argument("-json", dest="json", action="store_true"))
     add("keyring", cmd_keyring, lambda sp: (
         sp.add_argument("-data-dir", dest="data_dir", default=""),
         sp.add_argument("-install", default=""),

@@ -1,0 +1,39 @@
+"""Closed-loop control-plane load harness (ISSUE 7 / ROADMAP item 2).
+
+With the device hot path at ~0.67s for the north-star shape, "millions of
+users" is bounded by the control plane: RPC/broker/plan-apply throughput
+and tail latency.  This package drives the **real** server stack — N
+simulated clients concurrently submitting jobs, heartbeating, watching
+their allocations, and following the event stream — under open-loop
+arrival rates from scenario specs, through a warmup/measure/drain phase
+protocol, and emits a machine-readable report:
+
+- sustained end-to-end evals/s and placed/s (completions during the
+  measure window, not one-shot batch numbers — the Gavel discipline of
+  measuring policy throughput under a continuous arrival stream);
+- submit→running p50/p95/p99 (job_register → plan applied);
+- plan-apply p50/p99, plan conflicts, snapshot reuse (the
+  stale-snapshot worker pool's telemetry);
+- broker admission-control counters (rejects/coalesced/shed) and
+  event-stream fan-out cost under K filtered subscribers.
+
+Usage::
+
+    python -m nomad_tpu.loadgen --scenario smoke
+    python -m nomad_tpu.loadgen --scenario baseline --workers 4
+    python -m nomad_tpu.loadgen --scenario baseline --compare-workers 1,4
+    python -m nomad_tpu.loadgen --spec my_scenario.json --out report.json
+
+The harness is deliberately in-process (the server's own RPC-facing
+methods, the same surface the HTTP handlers call): the quantities under
+test are broker/plan/worker throughput and tail latency, and an
+in-process driver measures them deterministically and without socket
+noise; the heartbeat, event-stream, and admission paths it exercises are
+the production code paths.
+"""
+from .harness import LoadHarness
+from .report import render_report, write_report
+from .scenario import BUILTIN_SCENARIOS, JobShape, Scenario
+
+__all__ = ["LoadHarness", "Scenario", "JobShape", "BUILTIN_SCENARIOS",
+           "render_report", "write_report"]

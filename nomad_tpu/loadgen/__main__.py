@@ -1,0 +1,90 @@
+"""CLI entry: ``python -m nomad_tpu.loadgen``.
+
+Prints ONE JSON line to stdout (the machine contract, like bench.py) and
+a human summary to stderr.  ``--smoke`` is the tier-1 fast path;
+``--compare-workers 1,4`` runs the same offered load at each worker
+count and reports the sustained-throughput speedup.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+
+from .harness import compare_workers, run_scenario
+from .report import render_report, write_report
+from .scenario import BUILTIN_SCENARIOS, get_scenario, load_scenario
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m nomad_tpu.loadgen",
+        description="closed-loop control-plane load harness")
+    p.add_argument("--scenario", default="",
+                   help="builtin scenario: "
+                        + ", ".join(sorted(BUILTIN_SCENARIOS)))
+    p.add_argument("--spec", default="",
+                   help="path to a scenario spec JSON file")
+    p.add_argument("--smoke", action="store_true",
+                   help="alias for --scenario smoke (tier-1 gate)")
+    p.add_argument("--workers", type=int, default=0,
+                   help="override scenario num_workers")
+    p.add_argument("--batch-worker", action="store_true",
+                   help="use the TPU batch worker")
+    p.add_argument("--compare-workers", default="",
+                   help="comma list, e.g. 1,4: run per worker count and "
+                        "report the speedup")
+    p.add_argument("--out", default="", help="write the JSON report here")
+    p.add_argument("--trace", action="store_true",
+                   help="arm the eval-lifecycle tracing plane (slow-tail "
+                        "report entries link /v1/trace/eval/<id>)")
+    p.add_argument("-v", "--verbose", action="store_true")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.WARNING,
+        stream=sys.stderr)
+    if args.trace:
+        from ..utils import tracing
+
+        tracing.enable()
+
+    if args.smoke:
+        sc = get_scenario("smoke")
+    elif args.spec:
+        sc = load_scenario(args.spec)
+    elif args.scenario:
+        sc = get_scenario(args.scenario)
+    else:
+        p.error("one of --scenario, --spec, --smoke is required")
+        return 2
+    from dataclasses import replace
+
+    if args.workers:
+        sc = replace(sc, num_workers=args.workers)
+    if args.batch_worker:
+        sc = replace(sc, use_tpu_batch_worker=True)
+
+    if args.compare_workers:
+        counts = [int(x) for x in args.compare_workers.split(",") if x]
+        report = compare_workers(sc, counts)
+    else:
+        report = run_scenario(sc)
+
+    render_report(report, sys.stderr)
+    if args.out:
+        write_report(report, args.out)
+    print(json.dumps(report))
+
+    # Exit contract for CI: nonzero only when the run measured nothing.
+    if "runs" in report:
+        measured = any(r["sustained"]["completed_total"]
+                       for r in report["runs"].values())
+    else:
+        measured = bool(report["sustained"]["completed_total"])
+    return 0 if measured else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

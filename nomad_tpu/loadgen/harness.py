@@ -1,0 +1,550 @@
+"""The closed-loop load harness: drives a real Server under a scenario.
+
+Phase protocol (Gavel-style sustained measurement, arxiv 2008.09213):
+
+  warmup    — offered load runs but nothing is scored (XLA/scheduler
+              caches warm, heartbeat timers spread out);
+  measure   — completions, placements, and latencies inside this window
+              produce the sustained numbers;
+  drain     — submission stops; the harness waits (bounded) for the
+              backlog so straggler accounting is exact.
+
+Simulated clients are threads sharing one open-loop arrival schedule:
+submission n fires at ``start + n/arrival_rate`` regardless of how long
+submission n−1 took (open-loop, so queueing delay is *visible* instead of
+self-throttled away).  Each client also renews heartbeats for its slice
+of the registered nodes and the harness keeps K event-stream
+subscriptions with per-job topic filters alive, so the server pays the
+full production fan-out/TTL bookkeeping while being measured.
+
+Backpressure contract: a 429-style ``BrokerLimitError`` NACK from
+admission control is retried with the server's ``retry_after`` hint plus
+client-side jitter (scenario.submit_retries times), then counted as
+dropped — exactly what a well-behaved SDK client does.
+"""
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from ..server import Server, ServerConfig
+from ..server.eval_broker import BrokerLimitError
+from ..structs import structs as s
+from ..utils import tracing
+from .scenario import JobShape, Scenario
+
+
+def _percentiles(values: List[float]) -> Dict[str, float]:
+    if not values:
+        return {"count": 0, "p50": 0.0, "p95": 0.0, "p99": 0.0,
+                "mean": 0.0, "max": 0.0}
+    ordered = sorted(values)
+
+    def pct(q: float) -> float:
+        return ordered[min(len(ordered) - 1, int(q * len(ordered)))]
+
+    return {"count": len(ordered),
+            "p50": round(pct(0.50) * 1000.0, 3),
+            "p95": round(pct(0.95) * 1000.0, 3),
+            "p99": round(pct(0.99) * 1000.0, 3),
+            "mean": round(sum(ordered) / len(ordered) * 1000.0, 3),
+            "max": round(ordered[-1] * 1000.0, 3)}
+
+
+class _Submission:
+    __slots__ = ("seq", "eval_id", "job_id", "priority", "submit_t",
+                 "running_t", "done_t", "rejected")
+
+    def __init__(self, seq: int, eval_id: str, job_id: str, priority: int,
+                 submit_t: float):
+        self.seq = seq
+        self.eval_id = eval_id
+        self.job_id = job_id
+        self.priority = priority
+        self.submit_t = submit_t
+        self.running_t: Optional[float] = None
+        self.done_t: Optional[float] = None
+        self.rejected = 0
+
+
+class LoadHarness:
+    """One scenario run against one in-process server."""
+
+    def __init__(self, scenario: Scenario,
+                 logger: Optional[logging.Logger] = None):
+        self.sc = scenario
+        self.logger = logger or logging.getLogger("nomad_tpu.loadgen")
+        self.server: Optional[Server] = None
+        self._stop = threading.Event()
+        self._l = threading.Lock()
+        self._seq = 0
+        self._start_t = 0.0
+        self._submit_end_t = 0.0
+        self.subs: Dict[str, _Submission] = {}      # eval_id → record
+        # Events that arrived for an eval BEFORE its submitter thread
+        # registered the record (job_register returns the eval id, but
+        # a fast worker can plan-apply and ack it before the submitter
+        # reacquires the lock) — replayed at registration.  Bounded:
+        # untracked ids (internal evals) must not accumulate.
+        self._early: "OrderedDict[str, list]" = OrderedDict()
+        self.dropped = 0                            # gave up after retries
+        self.reject_events = 0                      # total 429 NACKs seen
+        self.placed_events: List[Tuple[float, int]] = []
+        self._hb_renewals: List[float] = []         # granted TTLs
+        self._filter_subs: list = []
+        self._threads: List[threading.Thread] = []
+
+    # -- setup -------------------------------------------------------------
+
+    def _build_server(self) -> Server:
+        import os
+
+        sc = self.sc
+        cfg = ServerConfig(
+            num_schedulers=sc.num_workers,
+            use_tpu_batch_worker=sc.use_tpu_batch_worker,
+            batch_size=sc.batch_size,
+            min_heartbeat_ttl=sc.min_heartbeat_ttl,
+            broker_max_pending=sc.broker_max_pending,
+            broker_coalesce=sc.broker_coalesce,
+            node_name=f"loadgen-{sc.name}")
+        # Workers read the stale-snapshot knob from the env at
+        # construction; scope the override to the build.
+        prev = os.environ.get("NOMAD_TPU_STALE_SNAPSHOT")
+        os.environ["NOMAD_TPU_STALE_SNAPSHOT"] = \
+            "1" if sc.stale_snapshot else "0"
+        try:
+            srv = Server(cfg, logger=self.logger.getChild("server"))
+            srv.start()
+        finally:
+            if prev is None:
+                os.environ.pop("NOMAD_TPU_STALE_SNAPSHOT", None)
+            else:
+                os.environ["NOMAD_TPU_STALE_SNAPSHOT"] = prev
+        deadline = time.monotonic() + 10.0
+        while not srv.is_leader() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        if not srv.is_leader():
+            raise RuntimeError("loadgen server failed to take leadership")
+        return srv
+
+    def _register_nodes(self) -> List[str]:
+        sc = self.sc
+        ids = []
+        for i in range(sc.num_nodes):
+            node = s.Node(
+                id=f"lg-node-{i:05d}",
+                datacenter="dc1", name=f"lg-node-{i:05d}",
+                attributes={"kernel.name": "linux", "driver.exec": "1"},
+                resources=s.Resources(cpu=sc.node_cpu,
+                                      memory_mb=sc.node_memory_mb,
+                                      disk_mb=100 * 1024, iops=1000),
+                reserved=s.Resources(),
+                node_class="loadgen",
+                status=s.NODE_STATUS_READY)
+            self.server.node_register(node)
+            ids.append(node.id)
+        return ids
+
+    def _job_for(self, seq: int) -> s.Job:
+        """Deterministic job n of the arrival stream: the mix draw keys
+        on (scenario seed, n), not on thread interleaving, so two runs
+        offer byte-identical load."""
+        sc = self.sc
+        rng = random.Random((sc.seed << 20) ^ seq)
+        total = sum(m.weight for m in sc.job_mix)
+        pick = rng.random() * total
+        shape: JobShape = sc.job_mix[-1]
+        for m in sc.job_mix:
+            pick -= m.weight
+            if pick <= 0:
+                shape = m
+                break
+        job_id = f"lg-{sc.name}-{seq:06d}"
+        if sc.update_fraction and seq >= 20 \
+                and rng.random() < sc.update_fraction:
+            # A job UPDATE: re-register a recent job under a new eval —
+            # the duplicate-eval stream per-job coalescing exists for.
+            target = rng.randrange(max(0, seq - 500), seq)
+            job_id = f"lg-{sc.name}-{target:06d}"
+        return s.Job(
+            region="global", id=job_id, name=job_id,
+            type=s.JOB_TYPE_SERVICE, priority=shape.priority,
+            datacenters=["dc1"],
+            task_groups=[s.TaskGroup(
+                name="tg", count=shape.count,
+                ephemeral_disk=s.EphemeralDisk(size_mb=10),
+                tasks=[s.Task(
+                    name="t", driver="exec",
+                    config={"command": "/bin/date"},
+                    resources=s.Resources(cpu=shape.cpu,
+                                          memory_mb=shape.memory_mb),
+                    log_config=s.LogConfig())])])
+
+    # -- client behaviors --------------------------------------------------
+
+    def _submitter(self, client_idx: int) -> None:
+        sc = self.sc
+        rng = random.Random((sc.seed << 8) ^ client_idx)
+        while not self._stop.is_set():
+            with self._l:
+                seq = self._seq
+                if sc.max_submissions and seq >= sc.max_submissions:
+                    return
+                target_t = self._start_t + seq / sc.arrival_rate
+                if target_t >= self._submit_end_t:
+                    return
+                self._seq = seq + 1
+            delay = target_t - time.monotonic()
+            if delay > 0:
+                if self._stop.wait(delay):
+                    return
+            job = self._job_for(seq)
+            submit_t = time.monotonic()
+            rejected = 0
+            for attempt in range(sc.submit_retries + 1):
+                try:
+                    _, eval_id = self.server.job_register(job)
+                    rec = _Submission(seq, eval_id, job.id, job.priority,
+                                      submit_t)
+                    rec.rejected = rejected
+                    with self._l:
+                        self.subs[eval_id] = rec
+                        for kind, t in self._early.pop(eval_id, ()):
+                            self._apply_event_locked(rec, kind, t)
+                    break
+                except BrokerLimitError as e:
+                    rejected += 1
+                    with self._l:
+                        self.reject_events += 1
+                    if attempt >= sc.submit_retries:
+                        with self._l:
+                            self.dropped += 1
+                        break
+                    # The server's hint plus client-side full jitter —
+                    # the same discipline utils/backoff applies.
+                    if self._stop.wait(e.retry_after * (0.5 + rng.random())):
+                        return
+
+    def _heartbeater(self, node_ids: List[str]) -> None:
+        """Renew each owned node at ~70% of its granted TTL, like the
+        client agent does; granted TTLs are recorded so the report can
+        show the jitter dispersal."""
+        next_due: Dict[str, float] = {n: 0.0 for n in node_ids}
+        while not self._stop.is_set():
+            now = time.monotonic()
+            soonest = now + 0.5
+            for node_id, due in next_due.items():
+                if due <= now:
+                    try:
+                        _, ttl = self.server.node_update_status(
+                            node_id, s.NODE_STATUS_READY)
+                    except Exception:
+                        continue
+                    with self._l:
+                        self._hb_renewals.append(ttl)
+                    next_due[node_id] = now + max(0.2, ttl * 0.7)
+                soonest = min(soonest, next_due[node_id])
+            if self._stop.wait(max(0.02, soonest - time.monotonic())):
+                return
+
+    def _attach_subscribers(self) -> None:
+        """K event-stream subscriptions with per-job topic filters (each
+        follower watches its own job key, the realistic alloc-watch
+        shape): the cost under test is the publish-side filter walk,
+        which every state write now pays."""
+        for i in range(self.sc.subscribers):
+            sub = self.server.event_stream_subscribe(
+                topics={"Job": {f"lg-{self.sc.name}-{i:06d}"},
+                        "Alloc": {f"lg-{self.sc.name}-{i:06d}"}})
+            self._filter_subs.append(sub)
+
+    def _sub_drainer(self) -> None:
+        """Keeps the filtered subscriptions from shedding: round-robin
+        drain, cheap because most filters match nothing."""
+        while not self._stop.is_set():
+            for sub in self._filter_subs:
+                while sub.next(timeout=0) is not None:
+                    pass
+            if self._stop.wait(0.25):
+                return
+
+    @staticmethod
+    def _apply_event_locked(rec: _Submission, kind: str, t: float) -> None:
+        if kind == "running":
+            if rec.running_t is None:
+                rec.running_t = t
+        elif rec.done_t is None:
+            rec.done_t = t
+
+    def _note_event_locked(self, eval_id: str, kind: str,
+                           t: float) -> None:
+        """Apply to the tracked record, or buffer for a submission whose
+        registering thread hasn't run yet (caller holds self._l)."""
+        rec = self.subs.get(eval_id)
+        if rec is not None:
+            self._apply_event_locked(rec, kind, t)
+            return
+        self._early.setdefault(eval_id, []).append((kind, t))
+        self._early.move_to_end(eval_id)
+        while len(self._early) > 2048:
+            self._early.popitem(last=False)
+
+    def _tracker(self) -> None:
+        """Follows the real event stream (the SDK-visible signal):
+        PlanApplied marks submit→running, EvalAcked marks completion."""
+        sub = self.server.event_stream_subscribe(
+            topics={s.TOPIC_PLAN: set(), "Eval": set()})
+        try:
+            while True:
+                ev = sub.next(timeout=0.2)
+                if ev is None:
+                    if self._stop.is_set() and self._drained_locked():
+                        return
+                    continue
+                now = time.monotonic()
+                if ev.topic == s.TOPIC_PLAN and ev.type == "PlanApplied":
+                    placed = int((ev.payload or {}).get("Placed", 0))
+                    with self._l:
+                        self.placed_events.append((now, placed))
+                        if placed > 0:
+                            self._note_event_locked(ev.key, "running", now)
+                elif ev.topic == "Eval" and ev.type == "EvalAcked":
+                    with self._l:
+                        self._note_event_locked(ev.key, "done", now)
+                elif ev.topic == "Eval" and ev.type == "EvalUpdated":
+                    # Terminal status writes also close a submission:
+                    # a COALESCED eval is cancelled by the shed reaper
+                    # and never acked (its trigger was absorbed by the
+                    # kept eval), and failed evals end here too.
+                    status = (ev.payload or {}).get("Status", "")
+                    if status in (s.EVAL_STATUS_CANCELLED,
+                                  s.EVAL_STATUS_FAILED):
+                        with self._l:
+                            self._note_event_locked(ev.key, "done", now)
+        finally:
+            sub.close()
+
+    def _drained_locked(self) -> bool:
+        with self._l:
+            return all(rec.done_t is not None for rec in self.subs.values())
+
+    # -- fan-out probe -----------------------------------------------------
+
+    def _measure_fanout(self, events: int = 200) -> Dict:
+        """Publish-side cost per event with the scenario's subscriber
+        population attached: the walk over K filters is the fan-out
+        bill every state write pays."""
+        eb = self.server.event_broker
+        t0 = time.perf_counter()
+        for i in range(events):
+            eb.publish_external("Loadgen", "FanoutProbe", f"probe-{i}")
+        elapsed = time.perf_counter() - t0
+        return {"subscribers": len(self._filter_subs) + 1,
+                "events": events,
+                "us_per_event": round(elapsed / events * 1e6, 2)}
+
+    # -- run ---------------------------------------------------------------
+
+    def run(self) -> Dict:
+        sc = self.sc
+        self.server = self._build_server()
+        try:
+            return self._run_inner()
+        finally:
+            self._stop.set()
+            for t in self._threads:
+                t.join(timeout=5.0)
+            self.server.shutdown()
+
+    def _run_inner(self) -> Dict:
+        sc = self.sc
+        node_ids = self._register_nodes()
+        self._attach_subscribers()
+
+        def spawn(fn, *args, name=""):
+            t = threading.Thread(target=fn, args=args, daemon=True,
+                                 name=name)
+            t.start()
+            self._threads.append(t)
+            return t
+
+        tracker = spawn(self._tracker, name="lg-tracker")
+        if self._filter_subs:
+            spawn(self._sub_drainer, name="lg-sub-drain")
+        if sc.heartbeat:
+            per = max(1, len(node_ids) // max(1, sc.num_clients))
+            for c in range(sc.num_clients):
+                chunk = node_ids[c * per:(c + 1) * per]
+                if chunk:
+                    spawn(self._heartbeater, chunk, name=f"lg-hb-{c}")
+
+        self._start_t = time.monotonic() + 0.05
+        measure_start = self._start_t + sc.warmup_s
+        measure_end = measure_start + sc.measure_s
+        self._submit_end_t = measure_end
+        submitters = [spawn(self._submitter, c, name=f"lg-client-{c}")
+                      for c in range(sc.num_clients)]
+
+        for t in submitters:
+            t.join(timeout=sc.warmup_s + sc.measure_s + 30.0)
+        submit_done_t = time.monotonic()
+
+        # Drain: bounded wait for the backlog to clear.
+        drain_deadline = submit_done_t + sc.drain_s
+        while time.monotonic() < drain_deadline:
+            if self._drained_locked():
+                break
+            time.sleep(0.05)
+        drained_t = time.monotonic()
+
+        fanout = self._measure_fanout() if self._filter_subs else {}
+        report = self._assemble(measure_start, measure_end, drained_t,
+                                fanout)
+        self._stop.set()
+        tracker.join(timeout=5.0)
+        return report
+
+    # -- report ------------------------------------------------------------
+
+    def _assemble(self, m_start: float, m_end: float, drained_t: float,
+                  fanout: Dict) -> Dict:
+        sc = self.sc
+        with self._l:
+            records = list(self.subs.values())
+            hb_ttls = list(self._hb_renewals)
+            placed_events = list(self.placed_events)
+            dropped = self.dropped
+            rejects = self.reject_events
+
+        window = max(1e-9, m_end - m_start)
+        completed_in_window = [r for r in records
+                               if r.done_t is not None
+                               and m_start <= r.done_t <= m_end]
+        placed_in_window = sum(p for t, p in placed_events
+                               if m_start <= t <= m_end)
+        all_done = [r for r in records if r.done_t is not None]
+        submit_to_running = [r.running_t - r.submit_t for r in records
+                             if r.running_t is not None]
+        submit_to_done = [r.done_t - r.submit_t for r in all_done]
+        # Active-period rate: completions over first-submit → last-done.
+        # For work-bounded runs (max_submissions) this is THE sustained
+        # number — the fixed measure window under-reads a burst that
+        # drains before the window closes.
+        if all_done:
+            active = (max(r.done_t for r in all_done)
+                      - min(r.submit_t for r in records))
+            active_rate = len(all_done) / max(1e-9, active)
+            active_placed = sum(p for _, p in placed_events) \
+                / max(1e-9, active)
+        else:
+            active_rate = active_placed = 0.0
+
+        # Server-side histograms/counters (must AGREE with /v1/metrics —
+        # they are read from the same sink the endpoint renders).
+        latest = self.server.metrics.sink.latest() \
+            if hasattr(self.server.metrics.sink, "latest") else {}
+        samples = latest.get("Samples", {})
+        totals = latest.get("CounterTotals", {})
+
+        def sample(key):
+            agg = samples.get(key) or {}
+            return {k: agg.get(k) for k in ("count", "p50", "p95", "p99")
+                    if agg} if agg else {}
+
+        slowest = sorted((r for r in records if r.running_t is not None),
+                         key=lambda r: r.running_t - r.submit_t,
+                         reverse=True)[:5]
+        report = {
+            "scenario": sc.to_dict(),
+            "offered": {
+                "submitted": len(records),
+                "target_rate_per_s": sc.arrival_rate,
+                "dropped_after_retries": dropped,
+                "admission_rejects_seen": rejects,
+            },
+            "sustained": {
+                "window_s": round(window, 3),
+                "evals_per_s": round(active_rate, 2),
+                "placed_per_s": round(active_placed, 2),
+                "evals_per_s_window": round(
+                    len(completed_in_window) / window, 2),
+                "placed_per_s_window": round(placed_in_window / window, 2),
+                "completed_total": len(all_done),
+                "completed_in_window": len(completed_in_window),
+                "stragglers_after_drain": len(records) - len(all_done),
+            },
+            "latency_ms": {
+                "submit_to_running": _percentiles(submit_to_running),
+                "submit_to_complete": _percentiles(submit_to_done),
+                "plan_apply": sample("nomad.plan.apply"),
+                "plan_evaluate": sample("nomad.plan.evaluate"),
+                "plan_staleness_entries": sample("nomad.plan.staleness"),
+            },
+            "control_plane": {
+                "plan_conflicts": totals.get("nomad.plan.conflict", 0),
+                "snapshot_reuse": totals.get("nomad.worker.snapshot_reuse",
+                                             0),
+                "snapshot_fresh": totals.get("nomad.worker.snapshot_fresh",
+                                             0),
+                "broker": self.server.broker_stats(),
+            },
+            "heartbeat": {
+                "renewals": len(hb_ttls),
+                "distinct_ttls": len({round(t, 4) for t in hb_ttls}),
+                "ttl_min": round(min(hb_ttls), 4) if hb_ttls else 0,
+                "ttl_max": round(max(hb_ttls), 4) if hb_ttls else 0,
+            },
+            "event_fanout": fanout,
+        }
+        if tracing.enabled() and slowest:
+            report["slow_tail_traces"] = [
+                {"eval_id": r.eval_id,
+                 "submit_to_running_ms": round(
+                     (r.running_t - r.submit_t) * 1000.0, 2),
+                 "trace": f"/v1/trace/eval/{r.eval_id}"}
+                for r in slowest]
+        return report
+
+
+def run_scenario(scenario: Scenario,
+                 logger: Optional[logging.Logger] = None) -> Dict:
+    return LoadHarness(scenario, logger=logger).run()
+
+
+def compare_workers(scenario: Scenario, worker_counts: List[int],
+                    logger: Optional[logging.Logger] = None,
+                    baseline_serial: bool = True) -> Dict:
+    """Run the same offered load at each worker count and report the
+    sustained evals/s speedup of the last count over the first.
+
+    With ``baseline_serial`` (the acceptance-gate shape) the FIRST count
+    runs with ``stale_snapshot=False`` — the pre-ISSUE-7 serial
+    discipline (fresh O(cluster) snapshot per eval) — and the rest run
+    the stale-snapshot pool, so the ratio is the end-to-end gain of the
+    multi-worker stale-snapshot drain over the serial baseline."""
+    from dataclasses import replace
+
+    runs = {}
+    labels = []
+    for i, m in enumerate(worker_counts):
+        stale = scenario.stale_snapshot and not (baseline_serial and i == 0)
+        label = f"{m}" + ("" if stale else "-serial-baseline")
+        labels.append(label)
+        runs[label] = run_scenario(
+            replace(scenario, num_workers=m, stale_snapshot=stale),
+            logger=logger)
+    first = runs[labels[0]]["sustained"]["evals_per_s"]
+    last = runs[labels[-1]]["sustained"]["evals_per_s"]
+    return {
+        "scenario": scenario.name,
+        "worker_counts": worker_counts,
+        "evals_per_s": {lbl: runs[lbl]["sustained"]["evals_per_s"]
+                        for lbl in labels},
+        "speedup": round(last / first, 3) if first else None,
+        "runs": runs,
+    }

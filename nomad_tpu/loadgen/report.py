@@ -1,0 +1,77 @@
+"""Report rendering + persistence for load-harness runs.
+
+The JSON report is the machine contract (bench.py --check and the tier-1
+smoke test read it); ``render_report`` is the human summary printed to
+stderr, deliberately shaped like the bench's phase detail so the two
+read side by side.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, TextIO
+
+
+def write_report(report: Dict, path: str) -> None:
+    with open(path, "w") as fh:
+        json.dump(report, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def render_report(report: Dict, out: TextIO) -> None:
+    if "worker_counts" in report:  # compare_workers shape
+        out.write(f"== loadgen compare: {report['scenario']} "
+                  f"workers={report['worker_counts']} ==\n")
+        for m, rate in report["evals_per_s"].items():
+            out.write(f"  M={m}: sustained {rate} evals/s\n")
+        out.write(f"  speedup: {report['speedup']}x\n")
+        for m, run in report["runs"].items():
+            out.write(f"-- M={m} --\n")
+            _render_single(run, out, indent="  ")
+        return
+    _render_single(report, out)
+
+
+def _render_single(r: Dict, out: TextIO, indent: str = "") -> None:
+    sc = r["scenario"]
+    off = r["offered"]
+    sus = r["sustained"]
+    lat = r["latency_ms"]
+    cp = r["control_plane"]
+
+    def w(line: str) -> None:
+        out.write(indent + line + "\n")
+
+    w(f"scenario {sc['name']}: {sc['num_nodes']} nodes, "
+      f"{sc['num_clients']} clients @ {sc['arrival_rate']}/s, "
+      f"M={sc['num_workers']} workers"
+      + (" (batch)" if sc["use_tpu_batch_worker"] else ""))
+    w(f"offered: {off['submitted']} submitted, "
+      f"{off['dropped_after_retries']} dropped, "
+      f"{off['admission_rejects_seen']} 429s")
+    w(f"sustained: {sus['evals_per_s']} evals/s, "
+      f"{sus['placed_per_s']} placed/s over {sus['window_s']}s "
+      f"({sus['stragglers_after_drain']} stragglers)")
+    s2r = lat["submit_to_running"]
+    w(f"submit→running ms: p50={s2r['p50']} p95={s2r['p95']} "
+      f"p99={s2r['p99']} (n={s2r['count']})")
+    pa = lat.get("plan_apply") or {}
+    if pa:
+        w(f"plan.apply ms: p50={pa.get('p50')} p99={pa.get('p99')}")
+    w(f"plan conflicts: {cp['plan_conflicts']}, snapshot reuse/fresh: "
+      f"{cp['snapshot_reuse']}/{cp['snapshot_fresh']}")
+    broker = cp["broker"]
+    w(f"broker: pending={broker['Pending']} "
+      f"coalesced={broker['CoalescedTotal']} shed={broker['ShedTotal']} "
+      f"rejects={broker['AdmissionRejects']} "
+      f"plan_queue={broker['PlanQueueDepth']}")
+    hb = r.get("heartbeat") or {}
+    if hb.get("renewals"):
+        w(f"heartbeats: {hb['renewals']} renewals, "
+          f"{hb['distinct_ttls']} distinct TTLs in "
+          f"[{hb['ttl_min']}, {hb['ttl_max']}]")
+    fo = r.get("event_fanout") or {}
+    if fo:
+        w(f"event fan-out: {fo['us_per_event']}us/event @ "
+          f"{fo['subscribers']} filtered subscribers")
+    for tr in r.get("slow_tail_traces", []):
+        w(f"slow tail: {tr['submit_to_running_ms']}ms {tr['trace']}")

@@ -1,0 +1,175 @@
+"""Scenario specs for the control-plane load harness.
+
+A scenario is a small, serializable description of offered load: how many
+simulated nodes and clients, the open-loop job arrival rate, the job mix
+(sizes × priorities, weighted), the warmup/measure/drain phase durations,
+and the server shape under test (worker count, batch worker, admission
+knobs).  Builtins cover the regression tiers; ``Scenario.from_dict`` /
+``load_scenario`` accept the same shape as JSON for custom runs::
+
+    {
+      "name": "my-load",
+      "num_nodes": 200, "num_clients": 8, "arrival_rate": 120,
+      "warmup_s": 2, "measure_s": 10, "drain_s": 20,
+      "job_mix": [
+        {"weight": 8, "count": 1, "cpu": 100, "memory_mb": 128,
+         "priority": 50},
+        {"weight": 1, "count": 4, "cpu": 500, "memory_mb": 512,
+         "priority": 80}
+      ],
+      "num_workers": 4, "subscribers": 64, "broker_max_pending": 0,
+      "seed": 42
+    }
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class JobShape:
+    """One entry of the weighted job mix."""
+
+    weight: float = 1.0
+    count: int = 1          # task-group count (allocs per job)
+    cpu: int = 100
+    memory_mb: int = 128
+    priority: int = 50
+
+
+@dataclass
+class Scenario:
+    name: str = "custom"
+    # Cluster shape.
+    num_nodes: int = 100
+    node_cpu: int = 4000
+    node_memory_mb: int = 8192
+    # Offered load.
+    num_clients: int = 4          # concurrent submitter threads
+    arrival_rate: float = 50.0    # open-loop submissions/s (aggregate)
+    max_submissions: int = 0      # 0 = bounded by time only
+    job_mix: List[JobShape] = field(default_factory=lambda: [JobShape()])
+    # Phase protocol.
+    warmup_s: float = 1.0
+    measure_s: float = 5.0
+    drain_s: float = 15.0
+    # Fraction of submissions that RE-register a recent job (a job
+    # update) instead of a new one — duplicate-eval pressure, the
+    # traffic the broker's per-job coalescing exists for.
+    update_fraction: float = 0.0
+    # Simulated client behaviors.
+    heartbeat: bool = True
+    min_heartbeat_ttl: float = 2.0
+    subscribers: int = 16         # event-stream followers w/ topic filters
+    submit_retries: int = 4       # retries after a 429 admission NACK
+    # Server under test.
+    num_workers: int = 1
+    use_tpu_batch_worker: bool = False
+    batch_size: int = 16
+    broker_max_pending: int = 0
+    broker_coalesce: bool = True
+    # Stale-snapshot worker pool (worker.py): off = the pre-ISSUE-7
+    # serial discipline of one fresh O(cluster) snapshot per eval — the
+    # regression baseline the speedup gate compares against.
+    stale_snapshot: bool = True
+    # Determinism.
+    seed: int = 42
+
+    def to_dict(self) -> Dict:
+        return asdict(self)
+
+    @staticmethod
+    def from_dict(data: Dict) -> "Scenario":
+        data = dict(data)
+        mix = [JobShape(**m) if isinstance(m, dict) else m
+               for m in data.pop("job_mix", [])] or [JobShape()]
+        known = {f for f in Scenario.__dataclass_fields__}  # noqa: C416
+        unknown = set(data) - known
+        if unknown:
+            raise ValueError(
+                f"unknown scenario fields: {', '.join(sorted(unknown))}")
+        return Scenario(job_mix=mix, **data)
+
+
+def load_scenario(path: str) -> Scenario:
+    with open(path) as fh:
+        return Scenario.from_dict(json.load(fh))
+
+
+# -- builtins ---------------------------------------------------------------
+
+#: Fast, deterministic tier-1 gate: a fixed submission count at a rate the
+#: single serial worker sustains, so the run is bounded by work, not time
+#: (seconds on a cold CPU machine, including the first-eval warmups).
+SMOKE = Scenario(
+    name="smoke",
+    num_nodes=20, num_clients=2, arrival_rate=200.0, max_submissions=30,
+    job_mix=[JobShape(weight=3, count=1, cpu=50, memory_mb=64, priority=50),
+             JobShape(weight=1, count=2, cpu=100, memory_mb=128,
+                      priority=70)],
+    warmup_s=0.0, measure_s=8.0, drain_s=20.0,
+    subscribers=8, min_heartbeat_ttl=1.0, num_workers=1, seed=7)
+
+#: The sustained-throughput scenario the bench guard and the scaling
+#: gate run: a bounded burst (work-bounded, so runs terminate even when
+#: a config is slow) offered faster than any single serial worker
+#: drains it, on a cluster with ample capacity (saturation must come
+#: from the CONTROL PLANE, not from placement failures — blocked evals
+#: never complete and would poison the completion-rate metric).
+#: Heartbeat TTLs in the throughput scenarios are LONG (30s): renewals
+#: still flow (TTL-jitter dispersal shows in the report) but a GIL-
+#: starved renewal thread can never slip past ttl+grace — a missed
+#: heartbeat marks the node down and fans out one eval per job with
+#: allocs on it, an eval storm that turns a throughput run into a
+#: different experiment.  Short-TTL pressure is the smoke/fanout
+#: scenarios' job, where scheduling load is light.
+BASELINE = Scenario(
+    name="baseline",
+    num_nodes=5000, node_cpu=64_000, node_memory_mb=262_144,
+    num_clients=8, arrival_rate=1500.0, max_submissions=2000,
+    job_mix=[JobShape(weight=8, count=1, cpu=100, memory_mb=128,
+                      priority=50),
+             JobShape(weight=2, count=2, cpu=200, memory_mb=256,
+                      priority=60),
+             JobShape(weight=1, count=4, cpu=400, memory_mb=512,
+                      priority=80)],
+    warmup_s=0.0, measure_s=30.0, drain_s=60.0,
+    subscribers=64, min_heartbeat_ttl=30.0, num_workers=1, seed=42)
+
+#: 10× overload against a bounded broker: proves admission control keeps
+#: memory bounded (shed/coalesce/reject counters move, pending stays at
+#: the cap) instead of OOM-shaped queue growth.
+OVERLOAD_10X = Scenario(
+    name="overload_10x",
+    num_nodes=100, node_cpu=64_000, node_memory_mb=262_144,
+    num_clients=16, arrival_rate=2000.0, max_submissions=6000,
+    job_mix=[JobShape(weight=1, count=1, cpu=50, memory_mb=64,
+                      priority=50)],
+    update_fraction=0.5,
+    warmup_s=0.0, measure_s=30.0, drain_s=45.0,
+    subscribers=32, min_heartbeat_ttl=30.0, num_workers=2,
+    broker_max_pending=256, submit_retries=1, seed=99)
+
+#: Event fan-out stress: ~10k filtered subscribers on a modest event
+#: stream — the publish-side cost (filter walk per event) is the number
+#: under test.
+FANOUT_10K = Scenario(
+    name="fanout_10k",
+    num_nodes=50, num_clients=4, arrival_rate=100.0,
+    max_submissions=200,
+    warmup_s=0.0, measure_s=20.0, drain_s=30.0,
+    subscribers=10_000, min_heartbeat_ttl=5.0, num_workers=2, seed=11)
+
+BUILTIN_SCENARIOS: Dict[str, Scenario] = {
+    sc.name: sc for sc in (SMOKE, BASELINE, OVERLOAD_10X, FANOUT_10K)}
+
+
+def get_scenario(name: str) -> Scenario:
+    try:
+        return BUILTIN_SCENARIOS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown scenario {name!r}; builtins: "
+            f"{', '.join(sorted(BUILTIN_SCENARIOS))}") from None

@@ -13,6 +13,11 @@ from ..structs.funcs import remove_allocs
 from ..structs.node_class import escaped_constraints
 from ..utils import version as goversion
 
+# Shared seed source for per-eval PRNGs (EvalContext.rng): seeded once
+# from the OS, then each eval draws 64 bits instead of paying its own
+# urandom read.
+_SEED_SOURCE = random.Random()
+
 
 class EvalCache:
     """Regex + version-constraint caches, matching the per-eval caches in
@@ -48,7 +53,12 @@ class EvalContext:
     @property
     def rng(self) -> random.Random:
         if self._rng is None:
-            self._rng = random.Random()
+            # Seed from the module PRNG, not the OS: an unseeded
+            # Random() reads urandom (~50µs), once per eval on the
+            # oracle hot path.  getrandbits on the shared source is one
+            # C call (GIL-atomic), and determinism is unchanged — the
+            # unseeded path was never reproducible.
+            self._rng = random.Random(_SEED_SOURCE.getrandbits(64))
         return self._rng
 
     @rng.setter

@@ -18,13 +18,31 @@ from .context import ComputedClassFeasibility, EvalContext
 
 class StaticIterator:
     """Yields nodes in fixed order; base of the iterator chain
-    (feasible.go:34-78)."""
+    (feasible.go:34-78).
 
-    def __init__(self, ctx: EvalContext, nodes: Optional[List[s.Node]]):
+    With ``lazy_shuffle`` armed it yields an incremental Fisher-Yates
+    order instead: position i is finalized (one rng draw + swap) only
+    when first consumed.  The LimitIterator at the top of the stack
+    consumes O(log N) candidates of an N-node shuffle, and the eager
+    O(N) shuffle was the single largest scheduler cost in the
+    control-plane load-harness profile.  The finalized prefix is stable
+    across reset()/wrap-around, so within one arming the order is
+    exactly one fixed shuffle, same as the eager version."""
+
+    def __init__(self, ctx: EvalContext, nodes: Optional[List[s.Node]],
+                 rng=None):
         self.ctx = ctx
         self.nodes: List[s.Node] = nodes or []
         self.offset = 0
         self.seen = 0
+        self.rng = rng
+        self._finalized = 0
+
+    def lazy_shuffle(self, rng) -> None:
+        """Arm (or re-arm) incremental shuffling of the current node
+        list; already-finalized positions are forgotten."""
+        self.rng = rng
+        self._finalized = 0
 
     def next_option(self) -> Optional[s.Node]:
         n = len(self.nodes)
@@ -33,6 +51,11 @@ class StaticIterator:
                 self.offset = 0
             else:
                 return None
+        if self.rng is not None and self.offset >= self._finalized:
+            j = self.offset + self.rng.randrange(n - self.offset)
+            nodes = self.nodes
+            nodes[self.offset], nodes[j] = nodes[j], nodes[self.offset]
+            self._finalized = self.offset + 1
         option = self.nodes[self.offset]
         self.offset += 1
         self.seen += 1
@@ -46,13 +69,12 @@ class StaticIterator:
         self.nodes = nodes
         self.offset = 0
         self.seen = 0
+        self._finalized = 0
 
 
 def new_random_iterator(ctx: EvalContext, nodes: Optional[List[s.Node]]) -> StaticIterator:
-    """Fisher-Yates shuffle then static order (feasible.go:82)."""
-    nodes = nodes or []
-    shuffle_nodes(nodes, ctx.rng)
-    return StaticIterator(ctx, nodes)
+    """Fisher-Yates order, finalized lazily as consumed (feasible.go:82)."""
+    return StaticIterator(ctx, nodes or [], rng=ctx.rng)
 
 
 def shuffle_nodes(nodes: List[s.Node], rng) -> None:

@@ -25,7 +25,6 @@ from .feasible import (
     DriverChecker,
     FeasibilityWrapper,
     StaticIterator,
-    shuffle_nodes,
 )
 from .rank import BinPackIterator, FeasibleRankIterator, JobAntiAffinityIterator, RankedNode
 from .select import LimitIterator, MaxScoreIterator
@@ -68,11 +67,13 @@ class GenericStack:
         self.max_score = MaxScoreIterator(ctx, self.limit)
 
     def set_nodes(self, base_nodes: List[s.Node]) -> None:
-        """Shuffle, then bound candidate scans: 2 for batch
+        """Random order (finalized lazily as consumed — the limit below
+        bounds the scan, so an eager O(N) shuffle pays for positions no
+        iterator ever reads), then bound candidate scans: 2 for batch
         (power-of-two-choices), max(2, ⌈log₂ N⌉) for service
         (stack.go:118-137)."""
-        shuffle_nodes(base_nodes, self.ctx.rng)
         self.source.set_nodes(base_nodes)
+        self.source.lazy_shuffle(self.ctx.rng)
 
         limit = 2
         n = len(base_nodes)

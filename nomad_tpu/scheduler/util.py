@@ -140,7 +140,19 @@ def diff_system_allocs(
 
 def ready_nodes_in_dcs(state, dcs: List[str]) -> Tuple[List[s.Node], Dict[str, int]]:
     """Ready, undrained nodes in the job's datacenters + per-DC counts
-    (util.go:224)."""
+    (util.go:224).
+
+    Memoized per store/snapshot (invalidated by node writes via
+    StateStore._bump): the stale-snapshot worker pool schedules many
+    evals off one snapshot, and this walk was the second-largest
+    per-eval cost in the load-harness profile.  Callers receive a fresh
+    list (stacks shuffle it in place)."""
+    cache = getattr(state, "_ready_nodes_cache", None)
+    key = tuple(dcs)
+    if cache is not None:
+        hit = cache.get(key)
+        if hit is not None:
+            return list(hit[0]), dict(hit[1])
     dc_map = {dc: 0 for dc in dcs}
     out: List[s.Node] = []
     for node in state.nodes(None):
@@ -150,7 +162,13 @@ def ready_nodes_in_dcs(state, dcs: List[str]) -> Tuple[List[s.Node], Dict[str, i
             continue
         out.append(node)
         dc_map[node.datacenter] += 1
-    return out, dc_map
+    try:
+        if cache is None:
+            cache = state._ready_nodes_cache = {}
+        cache[key] = (out, dc_map)
+    except AttributeError:
+        return out, dc_map  # slot-restricted store: serve uncached
+    return list(out), dict(dc_map)
 
 
 class SetStatusError(Exception):

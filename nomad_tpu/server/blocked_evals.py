@@ -143,11 +143,23 @@ class BlockedEvals:
             if not self._enabled:
                 return
             unblocked: List[Tuple[s.Evaluation, str]] = []
+
+            def admit(wrapped: _Wrapped) -> None:
+                # Carry the unblock index on a copy: the stale-snapshot
+                # worker pool (worker.py _required_index) must schedule
+                # this eval from a snapshot that CONTAINS the capacity
+                # change that woke it — a cached view from before the
+                # unblock would re-fail the placement and re-block the
+                # eval in a wake/re-block spin until the cache rolls.
+                ev = wrapped.eval.copy()
+                ev.snapshot_index = max(ev.snapshot_index, index)
+                unblocked.append((ev, wrapped.token))
+
             # Escaped evals always unblock — any node could be feasible.
             for eid in list(self.escaped):
                 wrapped = self.escaped.pop(eid)
                 self.jobs.pop(wrapped.eval.job_id, None)
-                unblocked.append((wrapped.eval, wrapped.token))
+                admit(wrapped)
             # Captured evals unblock unless explicitly ineligible for this
             # class (unknown classes unblock for correctness).
             for eid in list(self.captured):
@@ -157,7 +169,7 @@ class BlockedEvals:
                     continue
                 del self.captured[eid]
                 self.jobs.pop(wrapped.eval.job_id, None)
-                unblocked.append((wrapped.eval, wrapped.token))
+                admit(wrapped)
             if unblocked:
                 self.eval_broker.enqueue_all(unblocked)
 

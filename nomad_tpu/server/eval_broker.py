@@ -9,6 +9,22 @@ delay, requeue-on-ack for reblocked evals.
 For the TPU build this is also where batching happens: dequeue_batch()
 drains up to B ready evals of one scheduler type in one call — preserving
 the per-job invariant because ready never holds two evals of one job.
+
+Admission control (control-plane saturation, ROADMAP item 2): the broker
+is the choke point between an unbounded client arrival stream and a
+bounded scheduling pipeline, so it also owns
+
+- **per-job coalescing** — a job with a queued eval AND a deferred
+  duplicate sheds further duplicates (every eval is a full-job
+  reconcile, so the kept one covers the shed one's trigger; the shed
+  eval is cancelled through the log by the server's shed reaper);
+- **a bounded pending queue** — ``max_pending`` caps tracked evals;
+  ``check_admission`` raises :class:`BrokerLimitError` (the 429-style
+  NACK, carrying ``retry_after``) at the RPC front door BEFORE the eval
+  is persisted, so overload backpressures to clients riding the
+  utils/backoff jittered-retry plumbing instead of growing the heap;
+  priorities at or above ``bypass_priority`` (core GC, node repair)
+  are always admitted.
 """
 from __future__ import annotations
 
@@ -16,6 +32,7 @@ import heapq
 import itertools
 import random
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -28,6 +45,33 @@ FAILED_QUEUE = "_failed"
 
 class EvalBrokerError(Exception):
     pass
+
+
+class BrokerLimitError(EvalBrokerError):
+    """Admission NACK: the pending-eval queue is at capacity.  Carries
+    ``retry_after`` (seconds) so clients back off instead of hammering;
+    the HTTP layer maps this to 429 + Retry-After, the RPC layer
+    re-types it from the wire error string."""
+
+    def __init__(self, retry_after: float, pending: int, limit: int):
+        self.retry_after = retry_after
+        self.pending = pending
+        self.limit = limit
+        super().__init__(
+            f"eval broker at capacity ({pending}/{limit} pending); "
+            f"retry_after={retry_after:.2f}")
+
+    @staticmethod
+    def from_message(msg: str) -> "BrokerLimitError":
+        """Rebuild from the wire error string (rpc.py encodes errors as
+        '<TypeName>: <message>')."""
+        import re
+
+        m = re.search(r"retry_after=([0-9.]+)", msg)
+        retry = float(m.group(1)) if m else 1.0
+        m = re.search(r"\((\d+)/(\d+) pending\)", msg)
+        pending, limit = (int(m.group(1)), int(m.group(2))) if m else (0, 0)
+        return BrokerLimitError(retry, pending, limit)
 
 
 ERR_NOT_OUTSTANDING = "evaluation is not outstanding"
@@ -43,12 +87,19 @@ class _HeapEntry:
 
 
 class _Unack:
-    __slots__ = ("eval", "token", "timer", "fired", "paused")
+    """One outstanding delivery.  ``deadline`` (monotonic) replaces the
+    reference's per-eval time.AfterFunc: a Python threading.Timer is a
+    whole OS thread per dequeue, which the load harness measured as a
+    material per-eval cost at saturation — one sweeper thread walks the
+    deadlines instead."""
 
-    def __init__(self, ev: s.Evaluation, token: str, timer: Optional[threading.Timer]):
+    __slots__ = ("eval", "token", "deadline", "fired", "paused")
+
+    def __init__(self, ev: s.Evaluation, token: str,
+                 deadline: Optional[float]):
         self.eval = ev
         self.token = token
-        self.timer = timer
+        self.deadline = deadline
         self.fired = False
         self.paused = False
 
@@ -69,6 +120,9 @@ class EvalBroker:
         subsequent_nack_delay: float = 20.0,
         delivery_limit: int = 3,
         metrics=None,
+        max_pending: int = 0,
+        coalesce: bool = True,
+        bypass_priority: int = s.JOB_MAX_PRIORITY,
     ):
         self.metrics = metrics if metrics is not None else NULL_TELEMETRY
         if nack_timeout < 0:
@@ -77,6 +131,10 @@ class EvalBroker:
         self.initial_nack_delay = initial_nack_delay
         self.subsequent_nack_delay = subsequent_nack_delay
         self.delivery_limit = delivery_limit
+        # Admission control: 0 = unbounded (the historical behavior).
+        self.max_pending = max_pending
+        self.coalesce = coalesce
+        self.bypass_priority = bypass_priority
 
         self._l = threading.RLock()
         self._cond = threading.Condition(self._l)
@@ -91,6 +149,17 @@ class EvalBroker:
         self.requeue: Dict[str, s.Evaluation] = {}  # token → eval
         self.time_wait: Dict[str, threading.Timer] = {}
 
+        # Saturation counters + the shed hand-off (evals coalesced away;
+        # the server's shed reaper cancels them through the log — the
+        # broker cannot raft.apply itself without inverting the
+        # raft-lock → broker-lock order the FSM enqueue hook takes).
+        self.shed_total = 0
+        self.coalesced_total = 0
+        self.admission_rejects = 0
+        self._shed: List[s.Evaluation] = []
+        self._shed_cond = threading.Condition(self._l)
+        self._sweeper: Optional[threading.Thread] = None
+
     # -- lifecycle ---------------------------------------------------------
 
     def enabled(self) -> bool:
@@ -98,10 +167,49 @@ class EvalBroker:
             return self._enabled
 
     def set_enabled(self, enabled: bool) -> None:
+        sweeper = None
         with self._l:
             self._enabled = enabled
+            if enabled and self.nack_timeout > 0:
+                # ALWAYS spawn on enable: an is_alive() check races a
+                # disable→enable flap (the old sweeper observed the
+                # disable and is mid-exit but still alive, so no new one
+                # would start and nack redelivery would go dead).  The
+                # sweeper exits when superseded, so a flap costs at most
+                # one short-lived extra thread.
+                sweeper = self._sweeper = threading.Thread(
+                    target=self._sweep_nack_timeouts, daemon=True,
+                    name="broker-nack-sweeper")
+        if sweeper is not None:
+            sweeper.start()
         if not enabled:
             self.flush()
+
+    def _sweep_nack_timeouts(self) -> None:
+        """The single owner of every unack deadline: scan, mark fired,
+        nack outside the lock.  Granularity scales with the timeout so
+        test-sized timeouts still fire promptly while the production
+        60s default costs four wakeups a second at most."""
+        interval = max(0.005, min(0.25, self.nack_timeout / 5.0))
+        me = threading.current_thread()
+        while True:
+            with self._l:
+                if not self._enabled or self._sweeper is not me:
+                    return
+                now = time.monotonic()
+                due = []
+                for eid, unack in self.unack.items():
+                    if (not unack.paused and not unack.fired
+                            and unack.deadline is not None
+                            and unack.deadline <= now):
+                        unack.fired = True
+                        due.append((eid, unack.token))
+            for eid, token in due:
+                try:
+                    self.nack(eid, token)
+                except EvalBrokerError:
+                    pass
+            time.sleep(interval)
 
     # -- enqueue -----------------------------------------------------------
 
@@ -165,12 +273,97 @@ class EvalBroker:
         if not pending_eval:
             self.job_evals[ev.job_id] = ev.id
         elif pending_eval != ev.id:
+            if self.coalesce and self._coalesce_deferred(ev):
+                return
             heapq.heappush(self.blocked.setdefault(ev.job_id, []),
                            self._entry(ev))
             return
 
         heapq.heappush(self.ready.setdefault(queue, []), self._entry(ev))
         self._cond.notify_all()
+
+    def _coalesce_deferred(self, ev: s.Evaluation) -> bool:
+        """Per-job dedup of DEFERRED duplicates (the job already has a
+        queued eval; ``ev`` would be the second-or-later in line).  Every
+        eval is a full-job reconcile, so one deferred eval whose
+        TRIGGER index (Evaluation.trigger_index — what the stale-snapshot
+        worker fence schedules against) covers both subsumes the other —
+        keep the higher-priority one, shed the loser for the reaper to
+        cancel.  Coalescing is skipped when the would-be keeper's
+        trigger index is LOWER than the loser's: the worker may schedule
+        the keeper from a snapshot that predates the shed trigger (a
+        node death, an unblock index) and the trigger would be lost.
+        Returns True when ``ev`` was absorbed (caller must not enqueue
+        it)."""
+        deferred = self.blocked.get(ev.job_id)
+        if not deferred:
+            return False
+        if len(deferred) > 1:  # legacy pile-up (coalesce toggled on late)
+            return False
+        other = deferred[0].eval
+        keeper, loser = ((other, ev)
+                         if (other.priority, other.trigger_index())
+                         >= (ev.priority, ev.trigger_index())
+                         else (ev, other))
+        if keeper.trigger_index() < loser.trigger_index():
+            return False
+        if keeper is ev:
+            deferred[0] = self._entry(ev)
+        self._shed_locked(loser)
+        self.coalesced_total += 1
+        self.metrics.incr_counter("broker.coalesce")
+        tr = tracing.TRACER
+        if tr is not None:
+            tr.event("broker.coalesce", eval_id=loser.id,
+                     job_id=loser.job_id, kept_eval=keeper.id)
+        return True  # ev was either shed or installed as the deferred slot
+
+    def _shed_locked(self, ev: s.Evaluation) -> None:
+        self.evals.pop(ev.id, None)
+        self.shed_total += 1
+        self.metrics.incr_counter("broker.shed")
+        self._shed.append(ev)
+        self._shed_cond.notify_all()
+
+    def get_shed(self, timeout: Optional[float]) -> List[s.Evaluation]:
+        """Blocking drain of coalesced-away evals (the server's shed
+        reaper cancels them through the log, mirroring
+        BlockedEvals.get_duplicates)."""
+        with self._l:
+            if not self._shed:
+                self._shed_cond.wait(timeout)
+            out, self._shed = self._shed, []
+            return out
+
+    # -- admission ---------------------------------------------------------
+
+    def pending_count(self) -> int:
+        with self._l:
+            return len(self.evals)
+
+    def check_admission(self, priority: int = 0) -> None:
+        """Front-door admission check, called by the RPC surface BEFORE
+        the eval-creating raft apply.  Raises BrokerLimitError when the
+        broker tracks ``max_pending`` or more evals, unless ``priority``
+        is at or above ``bypass_priority`` (repair/GC traffic must not
+        starve behind user submissions).  Estimated retry_after grows
+        with the overload ratio; callers add jitter via utils/backoff."""
+        if self.max_pending <= 0:
+            return
+        with self._l:
+            if not self._enabled:
+                return
+            pending = len(self.evals)
+            if pending < self.max_pending or priority >= self.bypass_priority:
+                return
+            self.admission_rejects += 1
+        self.metrics.incr_counter("broker.admission_reject")
+        tr = tracing.TRACER
+        if tr is not None:
+            tr.event("broker.admission_reject", pending=pending,
+                     limit=self.max_pending)
+        retry_after = min(5.0, 0.2 + 0.3 * (pending / self.max_pending))
+        raise BrokerLimitError(retry_after, pending, self.max_pending)
 
     def _entry(self, ev: s.Evaluation) -> _HeapEntry:
         return _HeapEntry((-ev.priority, ev.create_index, next(self._seq)), ev)
@@ -240,15 +433,9 @@ class EvalBroker:
         ev = heapq.heappop(heap).eval
         token = s.generate_uuid()
 
-        timer: Optional[threading.Timer] = None
-        if self.nack_timeout > 0:
-            timer = threading.Timer(self.nack_timeout, self._nack_timeout_fire,
-                                    args=(ev.id, token))
-            timer.daemon = True
-        unack = _Unack(ev, token, timer)
-        self.unack[ev.id] = unack
-        if timer is not None:
-            timer.start()
+        deadline = (time.monotonic() + self.nack_timeout
+                    if self.nack_timeout > 0 else None)
+        self.unack[ev.id] = _Unack(ev, token, deadline)
         self.evals[ev.id] = self.evals.get(ev.id, 0) + 1
         tr = tracing.TRACER
         if tr is not None:
@@ -256,17 +443,6 @@ class EvalBroker:
                      eval_type=ev.type, attempt=self.evals[ev.id])
         self.metrics.incr_counter("broker.dequeue")
         return ev, token
-
-    def _nack_timeout_fire(self, eval_id: str, token: str) -> None:
-        with self._l:
-            unack = self.unack.get(eval_id)
-            if unack is None or unack.token != token:
-                return
-            unack.fired = True
-        try:
-            self.nack(eval_id, token)
-        except EvalBrokerError:
-            pass
 
     # -- outstanding / ack / nack -----------------------------------------
 
@@ -288,13 +464,8 @@ class EvalBroker:
             unack = self._get_unack(eval_id, token)
             if unack.fired:
                 raise EvalBrokerError(ERR_NACK_TIMEOUT_REACHED)
-            if unack.timer is not None:
-                unack.timer.cancel()
-                unack.timer = threading.Timer(
-                    self.nack_timeout, self._nack_timeout_fire,
-                    args=(eval_id, token))
-                unack.timer.daemon = True
-                unack.timer.start()
+            if unack.deadline is not None:
+                unack.deadline = time.monotonic() + self.nack_timeout
 
     def _get_unack(self, eval_id: str, token: str) -> _Unack:
         unack = self.unack.get(eval_id)
@@ -312,13 +483,17 @@ class EvalBroker:
                 unack = self._get_unack(eval_id, token)
                 if unack.fired:
                     raise EvalBrokerError("Evaluation ID Ack'd after Nack timer expiration")
-                if unack.timer is not None:
-                    unack.timer.cancel()
                 job_id = unack.eval.job_id
                 tr = tracing.TRACER
                 if tr is not None:
                     tr.event("broker.ack", eval_id=eval_id, job_id=job_id,
                              attempts=self.evals.get(eval_id, 0))
+                    # Close the submit→scheduled umbrella (eval.e2e):
+                    # the ack is the moment the eval's plan has applied
+                    # and the client-visible work is done.
+                    tr.close_mark(eval_id, job_id=job_id,
+                                  outcome="acked",
+                                  attempts=self.evals.get(eval_id, 0))
                 self.metrics.incr_counter("broker.ack")
                 eb = self.event_broker
                 if eb is not None:
@@ -351,8 +526,6 @@ class EvalBroker:
         with self._l:
             self.requeue.pop(token, None)
             unack = self._get_unack(eval_id, token)
-            if unack.timer is not None:
-                unack.timer.cancel()
             del self.unack[eval_id]
 
             dequeues = self.evals.get(eval_id, 0)
@@ -372,6 +545,11 @@ class EvalBroker:
                 tr.event("broker.nack", eval_id=eval_id,
                          job_id=unack.eval.job_id, attempts=dequeues,
                          outcome=outcome, wait=wait)
+                if outcome == "failed":
+                    # Terminal nack: the umbrella closes with the burn
+                    # recorded — a redelivery would reopen nothing.
+                    tr.close_mark(eval_id, job_id=unack.eval.job_id,
+                                  outcome="failed", attempts=dequeues)
             self.metrics.incr_counter("broker.nack")
             eb = self.event_broker
             if eb is not None:
@@ -392,26 +570,21 @@ class EvalBroker:
             unack = self._get_unack(eval_id, token)
             if unack.fired:
                 raise EvalBrokerError(ERR_NACK_TIMEOUT_REACHED)
-            if unack.timer is not None:
-                unack.timer.cancel()
             unack.paused = True
 
     def resume_nack_timeout(self, eval_id: str, token: str) -> None:
         with self._l:
             unack = self._get_unack(eval_id, token)
             unack.paused = False
-            unack.timer = threading.Timer(
-                self.nack_timeout, self._nack_timeout_fire, args=(eval_id, token))
-            unack.timer.daemon = True
-            unack.timer.start()
+            if self.nack_timeout > 0:
+                unack.deadline = time.monotonic() + self.nack_timeout
 
     # -- maintenance -------------------------------------------------------
 
     def flush(self) -> None:
         with self._l:
-            for unack in self.unack.values():
-                if unack.timer is not None:
-                    unack.timer.cancel()
+            # Unack deadlines die with the map (the sweeper re-reads it
+            # under the lock); only the wait timers are real threads.
             for timer in self.time_wait.values():
                 timer.cancel()
             self.evals = {}
@@ -421,6 +594,9 @@ class EvalBroker:
             self.unack = {}
             self.requeue = {}
             self.time_wait = {}
+            # Shed evals not yet reaped die with the leadership that shed
+            # them — the next leader's restore pass re-evaluates.
+            self._shed = []
             self._cond.notify_all()
 
     def stats(self) -> Dict[str, int]:
@@ -431,4 +607,45 @@ class EvalBroker:
                 "total_blocked": sum(len(h) for h in self.blocked.values()),
                 "total_waiting": len(self.time_wait),
                 "by_scheduler": {k: len(h) for k, h in self.ready.items()},
+            }
+
+    def extended_stats(self) -> Dict:
+        """The /v1/broker/stats saturation surface: pending by state and
+        priority, the delivery-attempts histogram, and the admission /
+        coalesce / shed counters — what the load harness reads and what
+        an operator needs to tell "busy" from "melting"."""
+        with self._l:
+            failed = len(self.ready.get(FAILED_QUEUE, ()))
+            by_state = {
+                "ready": sum(len(h) for k, h in self.ready.items()
+                             if k != FAILED_QUEUE),
+                "unacked": len(self.unack),
+                "deferred": sum(len(h) for h in self.blocked.values()),
+                "waiting": len(self.time_wait),
+                "failed": failed,
+            }
+            by_priority: Dict[int, int] = {}
+            for heaps in (self.ready.values(), self.blocked.values()):
+                for heap in heaps:
+                    for entry in heap:
+                        prio = entry.eval.priority
+                        by_priority[prio] = by_priority.get(prio, 0) + 1
+            attempts_hist: Dict[int, int] = {}
+            for attempts in self.evals.values():
+                attempts_hist[attempts] = attempts_hist.get(attempts, 0) + 1
+            return {
+                "Enabled": self._enabled,
+                "Pending": len(self.evals),
+                "MaxPending": self.max_pending,
+                "Coalesce": self.coalesce,
+                "BypassPriority": self.bypass_priority,
+                "ByState": by_state,
+                "ByPriority": {str(k): v
+                               for k, v in sorted(by_priority.items())},
+                "DeliveryAttempts": {str(k): v for k, v
+                                     in sorted(attempts_hist.items())},
+                "ShedTotal": self.shed_total,
+                "CoalescedTotal": self.coalesced_total,
+                "AdmissionRejects": self.admission_rejects,
+                "ShedUnreaped": len(self._shed),
             }

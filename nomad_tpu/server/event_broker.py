@@ -157,11 +157,72 @@ class EventBroker:
         self._l = threading.Lock()
         self._ring: deque = deque()
         self._subs: List[Subscription] = []
+        # Subscriber index (the 10k-filtered-subscriber fan-out fix):
+        # publish used to walk EVERY subscription's filter per event
+        # under the ring lock — O(K) per write with K alloc-watchers
+        # attached.  Bucketing by topic and (topic, key) makes delivery
+        # O(matching): an event touches the follow-all list, its topic's
+        # every-key list, and its exact (topic, key) list.  Exotic
+        # filters ("*" with key sets) fall back to a per-event match in
+        # _subs_unindexed.
+        self._subs_all: List[Subscription] = []
+        self._subs_unindexed: List[Subscription] = []
+        self._subs_topic_all: Dict[str, List[Subscription]] = {}
+        self._subs_topic_key: Dict[Tuple[str, str],
+                                   List[Subscription]] = {}
         # Highest index ever evicted from the ring: a resume at or below
         # it has a gap and must error instead of silently skipping.
         self._evicted_through = 0
         self.published = 0
         self.evicted = 0
+
+    def _index_sub(self, sub: Subscription) -> None:
+        if sub.topics is None:
+            self._subs_all.append(sub)
+        elif "*" in sub.topics:
+            self._subs_unindexed.append(sub)
+        else:
+            for topic, keys in sub.topics.items():
+                if not keys:
+                    self._subs_topic_all.setdefault(topic, []).append(sub)
+                else:
+                    for key in keys:
+                        self._subs_topic_key.setdefault(
+                            (topic, key), []).append(sub)
+
+    def _deindex_sub(self, sub: Subscription) -> None:
+        """Mirror of _index_sub.  Emptied buckets are POPPED — churning
+        per-alloc watchers mint unique (topic, key) entries, and leaving
+        empty lists behind would grow the index without bound."""
+
+        def drop(table, key):
+            bucket = table.get(key)
+            if bucket is None:
+                return
+            try:
+                bucket.remove(sub)
+            except ValueError:
+                pass
+            if not bucket:
+                del table[key]
+
+        if sub.topics is None:
+            try:
+                self._subs_all.remove(sub)
+            except ValueError:
+                pass
+        elif "*" in sub.topics:
+            try:
+                self._subs_unindexed.remove(sub)
+            except ValueError:
+                pass
+        else:
+            for topic, keys in sub.topics.items():
+                if not keys:
+                    drop(self._subs_topic_all, topic)
+                else:
+                    for key in keys:
+                        drop(self._subs_topic_key, (topic, key))
 
     # -- publish -----------------------------------------------------------
 
@@ -209,9 +270,17 @@ class EventBroker:
             # events to a live subscriber inverted, breaking the
             # monotonic-order contract resume dedupe relies on.  offer()
             # is a deque append under the sub's own lock (broker → sub,
-            # the documented order).
-            for sub in self._subs:
-                for ev in events:
+            # the documented order).  Delivery walks the subscriber
+            # INDEX, not every subscription — O(matching) per event.
+            for ev in events:
+                for sub in self._subs_all:
+                    sub.offer(ev)
+                for sub in self._subs_topic_all.get(ev.topic, ()):
+                    sub.offer(ev)
+                for sub in self._subs_topic_key.get((ev.topic, ev.key),
+                                                    ()):
+                    sub.offer(ev)
+                for sub in self._subs_unindexed:
                     if sub.matches(ev):
                         sub.offer(ev)
         _note_recent(events)
@@ -261,6 +330,7 @@ class EventBroker:
                     if ev.index >= from_index and sub.matches(ev):
                         sub.offer(ev, replay=True)
             self._subs.append(sub)
+            self._index_sub(sub)
         return sub
 
     def mark_armed(self, applied_index: int) -> None:
@@ -279,7 +349,8 @@ class EventBroker:
             try:
                 self._subs.remove(sub)
             except ValueError:
-                pass
+                return  # already removed; index buckets were cleaned then
+            self._deindex_sub(sub)
 
     # -- introspection -----------------------------------------------------
 
@@ -309,6 +380,10 @@ class EventBroker:
         with self._l:
             subs = list(self._subs)
             self._subs = []
+            self._subs_all = []
+            self._subs_unindexed = []
+            self._subs_topic_all = {}
+            self._subs_topic_key = {}
         for sub in subs:
             with sub._cond:
                 sub.closed = True

@@ -130,7 +130,19 @@ class FSM:
         self.state.upsert_evals(index, evals)
         if self.on_eval_update is not None:
             for ev in evals:
-                self.on_eval_update(ev)
+                # Hand the hook the STORED copy: the store stamps
+                # create/modify_index on its own copy, and the broker
+                # must enqueue an eval whose modify_index reflects the
+                # write — the stale-snapshot fence (worker.py
+                # _required_index) keys on it, and an unstamped 0 would
+                # let a cached snapshot that predates this eval's job
+                # serve its scheduling.
+                # A COPY, not the row: the broker mutates its evals
+                # (nack re-enqueue delay on ev.wait), and store rows are
+                # shared with snapshots.
+                stored = self.state.eval_by_id(None, ev.id)
+                self.on_eval_update(stored.copy() if stored is not None
+                                    else ev)
 
     def _apply_eval_delete(self, index: int, req: dict):
         self.state.delete_eval(index, req.get("evals", []), req.get("allocs", []))

@@ -80,7 +80,19 @@ class PlanApplier:
                 self.logger.warning("plan for eval %s was cancelled before "
                                     "apply; dropping", plan.eval_id)
                 continue
-            snap = self.raft.fsm.state.snapshot()
+            # The fit re-check reads the LIVE store, not a snapshot: a
+            # full snapshot per plan is an O(cluster) copy (the single
+            # largest applier cost in the load-harness profile), and the
+            # applier is the ONLY writer of placements — every alloc an
+            # earlier plan added is already applied when the next plan's
+            # reads run, which is the one consistency property the
+            # optimistic re-check needs (the reference gets it by
+            # optimistically applying results to a reused snapshot,
+            # plan_apply.go:55-120).  Concurrent non-plan writes (client
+            # status, node transitions) make individual reads at-least-
+            # as-fresh as any snapshot taken at dequeue time.  Revisit
+            # if apply ever becomes async (multi-voter replication).
+            snap = self.raft.fsm.state
 
             # Branch before building span attrs (the disarmed per-plan
             # path pays one load + comparison only).
@@ -94,6 +106,22 @@ class PlanApplier:
                 self.logger.exception("plan evaluation failed")
                 future.respond(None, exc)
                 continue
+
+            # Staleness + conflict telemetry for the stale-snapshot
+            # worker pool: how far behind the log this plan's snapshot
+            # was, and whether the optimistic-concurrency re-check had
+            # to reject part of it (the submitter replans the rejected
+            # remainder off refreshed state — the requeue path).
+            if plan.snapshot_index:
+                self.metrics.add_sample(
+                    "plan.staleness",
+                    max(0, self.raft.applied_index() - plan.snapshot_index))
+            if result.refresh_index:
+                self.metrics.incr_counter("plan.conflict")
+                if tr is not None:
+                    tr.event("plan.conflict", eval_id=plan.eval_id,
+                             snapshot_index=plan.snapshot_index,
+                             refresh_index=result.refresh_index)
 
             if result.node_update or result.node_allocation or result.alloc_slabs:
                 try:
@@ -358,6 +386,11 @@ class PlanApplier:
                 job_lookup=lambda jid: snap.job_by_id(None, jid))
             payload["preemption_evals"] = preemption_evals
         _, index = self.raft.apply(MessageType.APPLY_PLAN_RESULTS, payload)
+        # Stale-snapshot fence bookkeeping: workers may not reuse a
+        # cached snapshot for this job below this index (worker.py
+        # _snapshot_covering).
+        self.plan_queue.note_applied(
+            plan.job.id if plan.job is not None else "", index)
         # Residency index plumbing (ops/resident.py): record the newest
         # plan-apply index so NodeStateDelta events can line residency
         # churn up against plan traffic.  sys.modules lookup keeps the

@@ -5,10 +5,16 @@ from __future__ import annotations
 import heapq
 import itertools
 import threading
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
 from ..structs import structs as s
+
+# Bound on the per-job last-apply fence table: evictions fold into the
+# global floor, so the map cannot grow with job cardinality (dispatch
+# workloads mint a unique child job id per dispatch).
+JOB_APPLY_CAP = 16384
 
 
 class PlanFuture:
@@ -73,6 +79,36 @@ class PlanQueue:
         self._enabled = False
         self._heap: List[_PendingPlan] = []
         self._seq = itertools.count()
+        # Per-job last plan-apply index (stale-snapshot fence): a worker
+        # may reuse a cached snapshot for job J only if it covers J's
+        # newest committed plan — the broker serializes evals per job,
+        # but an eval CREATED before J's previous plan applied can be
+        # DEQUEUED after it, and scheduling J from a snapshot that
+        # misses J's own placements would double-place them (capacity
+        # re-checks can't catch same-job duplication).  Plans with no
+        # attributable job bump the global floor instead; so do LRU
+        # evictions past JOB_APPLY_CAP (conservative: unknown jobs then
+        # require a snapshot past the evicted apply, never an older
+        # one).
+        self._job_apply: "OrderedDict[str, int]" = OrderedDict()
+        self._apply_floor = 0
+
+    def note_applied(self, job_id: str, index: int) -> None:
+        with self._l:
+            if job_id:
+                if index > self._job_apply.get(job_id, 0):
+                    self._job_apply[job_id] = index
+                self._job_apply.move_to_end(job_id)
+                while len(self._job_apply) > JOB_APPLY_CAP:
+                    _, evicted = self._job_apply.popitem(last=False)
+                    if evicted > self._apply_floor:
+                        self._apply_floor = evicted
+            elif index > self._apply_floor:
+                self._apply_floor = index
+
+    def applied_index_for(self, job_id: str) -> int:
+        with self._l:
+            return max(self._job_apply.get(job_id, 0), self._apply_floor)
 
     def enabled(self) -> bool:
         with self._l:

@@ -114,6 +114,19 @@ class RaftLog:
         with self._l:
             return self._last_index
 
+    def applied_index_relaxed(self) -> int:
+        """Lock-free lower bound on :meth:`applied_index`.  ``_applied``
+        is stamped AFTER each FSM apply (GIL-ordered), so this never
+        reports an entry whose state is not yet visible — it may lag an
+        in-flight apply by one entry.  For hot read paths (heartbeat
+        grants, wait-for-index polling, external event stamping) where
+        queueing on the raft lock behind the apply stream is the
+        dominant cost; anything that needs the serializes-with-applies
+        guarantee (event-broker arming horizon) stays on the locked
+        read."""
+        applied = getattr(self, "_applied", None)
+        return applied if applied is not None else self.applied_index()
+
     def apply(self, msg_type: MessageType, payload: dict):
         """Append + commit + apply one entry; returns (result, index)
         (the raftApply path, nomad/rpc.go raftApply → fsm.Apply).
@@ -134,6 +147,7 @@ class RaftLog:
             index = self._last_index
             self._persist(index, msg_type, payload)
             result = self.fsm.apply(index, msg_type, payload)
+            self._applied = index  # after the apply: relaxed-read fence
         self.metrics.measure_since("raft.apply", t0)
         # Branch before building attrs: the disarmed commit path pays
         # one load + comparison, no getattr/dict/timestamp.
@@ -219,6 +233,7 @@ class FileLog(RaftLog):
             with open(path, "rb") as fh:
                 self.fsm.restore(fh.read())
             self._last_index = snap_idx
+            self._applied = snap_idx
 
         # Gather entries from BOTH logs and apply in index order: a node
         # toggled between native and fallback modes may have newer entries
@@ -654,6 +669,7 @@ class MultiRaft(RaftLog):
         # beyond it may be uncommitted and are re-committed by the leader.
         self.commit_index = self.base_index
         self._last_index = self.base_index  # last *applied*
+        self._applied = self.base_index
 
         self.leader_addr: Optional[str] = None
         self.state = "follower"
@@ -1105,6 +1121,7 @@ class MultiRaft(RaftLog):
                 except Exception:
                     self.logger.exception("raft: fsm apply failed at %d", idx)
             self._last_index = idx
+            self._applied = idx
             fut = self._futures.pop(idx, None)
             if fut is not None:
                 fut.resolve(result)
@@ -1188,6 +1205,7 @@ class MultiRaft(RaftLog):
             self.store.rewrite([])
             self.commit_index = self.base_index
             self._last_index = self.base_index
+            self._applied = self.base_index
             return {"term": self.term, "success": True}
 
     # -- compaction --------------------------------------------------------

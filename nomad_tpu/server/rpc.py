@@ -311,6 +311,13 @@ class _Conn:
         if err:
             if isinstance(err, str) and err.startswith("__no_leader__:"):
                 raise NoLeaderError(err.split(":", 1)[1])
+            if isinstance(err, str) and err.startswith("BrokerLimitError"):
+                # Re-type the admission NACK so wire callers get the
+                # retry_after hint instead of a generic RPCError (the
+                # client's jittered-backoff retry plumbing keys on it).
+                from .eval_broker import BrokerLimitError
+
+                raise BrokerLimitError.from_message(err)
             raise RPCError(err)
         return reply
 

@@ -62,6 +62,20 @@ class ServerConfig:
     device_mesh: object = None
     eval_nack_timeout: float = 60.0
     eval_delivery_limit: int = 3
+    # Eval-broker admission control (ISSUE 7): bounded pending queue +
+    # per-job coalescing.  0 = unbounded (historical behavior); the env
+    # knobs let operators bound a running deployment without code.
+    broker_max_pending: int = field(default_factory=lambda: int(
+        os.environ.get("NOMAD_TPU_BROKER_MAX_PENDING", "") or 0))
+    broker_coalesce: bool = field(default_factory=lambda: (
+        os.environ.get("NOMAD_TPU_BROKER_COALESCE", "").strip().lower()
+        not in ("0", "false", "no", "off")))
+    broker_bypass_priority: int = field(default_factory=lambda: int(
+        os.environ.get("NOMAD_TPU_BROKER_BYPASS_PRIO", "")
+        or s.JOB_MAX_PRIORITY))
+    # Heartbeat TTL jitter fraction (thundering-herd dispersal).
+    heartbeat_ttl_jitter: float = field(default_factory=lambda: float(
+        os.environ.get("NOMAD_TPU_HEARTBEAT_JITTER", "") or 0.1))
     # Retry cadence for queued (failed) Vault revocations
     # (vault.go:1104 revokeDaemon — 5 minutes there; shorter default so
     # a failed revoke clears quickly and tests can observe it).
@@ -108,7 +122,10 @@ class Server:
         self.eval_broker = EvalBroker(
             nack_timeout=self.config.eval_nack_timeout,
             delivery_limit=self.config.eval_delivery_limit,
-            metrics=self.metrics)
+            metrics=self.metrics,
+            max_pending=self.config.broker_max_pending,
+            coalesce=self.config.broker_coalesce,
+            bypass_priority=self.config.broker_bypass_priority)
         self.blocked_evals = BlockedEvals(self.eval_broker)
         self.plan_queue = PlanQueue()
         self.time_table = TimeTable()
@@ -185,8 +202,12 @@ class Server:
         # armed (attached to the state store + global registry) only via
         # NOMAD_TPU_EVENTS=1 or the first /v1/event/stream subscriber —
         # disarmed, every state write pays one attribute load + branch.
+        # Relaxed index source: external events are clamped monotonic by
+        # the broker anyway, and heartbeat-expiry publishes must not
+        # queue on the raft lock behind the apply stream.
         self.event_broker = EventBroker(
-            metrics=self.metrics, index_source=self.raft.applied_index)
+            metrics=self.metrics,
+            index_source=self.raft.applied_index_relaxed)
         self._events_enabled = False
         self._events_lock = threading.Lock()
         if os.environ.get("NOMAD_TPU_EVENTS", "").strip().lower() in (
@@ -201,7 +222,8 @@ class Server:
             min_ttl=self.config.min_heartbeat_ttl,
             max_per_second=self.config.max_heartbeats_per_second,
             logger=self.logger,
-            metrics=self.metrics)
+            metrics=self.metrics,
+            ttl_jitter=self.config.heartbeat_ttl_jitter)
         if self._events_enabled:
             self.heartbeat.event_broker = self.event_broker
         self.periodic = PeriodicDispatch(self._periodic_dispatch, self.logger)
@@ -614,6 +636,28 @@ class Server:
                     cancelled.append(ev)
                 self.raft.apply(MessageType.EVAL_UPDATE, {"evals": cancelled})
 
+        def shed_reaper():
+            # Broker-coalesced duplicates: the broker absorbed their
+            # trigger into the kept eval; cancel them through the log so
+            # eval-status tells the story (and they never look pending).
+            while self._leader and not self._shutdown.is_set():
+                shed = self.eval_broker.get_shed(timeout=0.5)
+                if not shed:
+                    continue
+                cancelled = []
+                for dup in shed:
+                    ev = dup.copy()
+                    ev.status = s.EVAL_STATUS_CANCELLED
+                    ev.status_description = (
+                        f"coalesced with a pending evaluation for job "
+                        f"{ev.job_id!r} (broker admission control)")
+                    cancelled.append(ev)
+                try:
+                    self.raft.apply(MessageType.EVAL_UPDATE,
+                                    {"evals": cancelled})
+                except NotLeaderError:
+                    return
+
         def failed_unblocker():
             while self._leader and not self._shutdown.is_set():
                 self._shutdown.wait(self.config.failed_eval_unblock_interval)
@@ -645,8 +689,8 @@ class Server:
                 if done:
                     self._deregister_accessor_rows(done)
 
-        for target in (dup_reaper, failed_unblocker, gc_scheduler,
-                       vault_revoke_daemon):
+        for target in (dup_reaper, shed_reaper, failed_unblocker,
+                       gc_scheduler, vault_revoke_daemon):
             t = threading.Thread(target=target, daemon=True)
             t.start()
             self._reaper_threads.append(t)
@@ -664,6 +708,8 @@ class Server:
                                        b.get("total_unacked", 0))
                 self.metrics.set_gauge("broker.total_waiting",
                                        b.get("total_waiting", 0))
+                self.metrics.set_gauge("broker.pending",
+                                       self.eval_broker.pending_count())
                 bl = self.blocked_evals.stats()
                 self.metrics.set_gauge("blocked_evals.total_blocked",
                                        bl.get("total_blocked", 0))
@@ -895,6 +941,15 @@ class Server:
         if problems:
             raise ValueError("job validation failed: " + "; ".join(problems))
 
+        # Admission control at the front door (429-style NACK): reject
+        # BEFORE the raft write while the broker is saturated — once the
+        # job + eval are persisted there is nothing left to shed.  Only
+        # evals-to-be are gated (periodic/parameterized registrations
+        # enqueue nothing).
+        if self._leader and not job.is_periodic() \
+                and not job.is_parameterized():
+            self.eval_broker.check_admission(job.priority)
+
         try:
             _, index = self.raft.apply(MessageType.JOB_REGISTER, {"job": job})
         except NotLeaderError:
@@ -913,6 +968,12 @@ class Server:
                 job_modify_index=index,
                 status=s.EVAL_STATUS_PENDING,
             )
+            # Open the eval.e2e umbrella (submit → broker ack) before
+            # the eval write so the span covers enqueue + queue wait.
+            tr = tracing.TRACER
+            if tr is not None:
+                tr.mark(ev.id, job_id=job.id, submit="job_register",
+                        priority=job.priority)
             _, eval_index = self.raft.apply(MessageType.EVAL_UPDATE, {"evals": [ev]})
             eval_id = ev.id
         return index, eval_id
@@ -1066,10 +1127,16 @@ class Server:
             raise ValueError("can't evaluate periodic job")
         if job.is_parameterized():
             raise ValueError("can't evaluate parameterized job")
+        if self._leader:
+            self.eval_broker.check_admission(job.priority)
         ev = s.Evaluation(
             id=s.generate_uuid(), priority=job.priority, type=job.type,
             triggered_by=s.EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
             job_modify_index=job.modify_index, status=s.EVAL_STATUS_PENDING)
+        tr = tracing.TRACER
+        if tr is not None:
+            tr.mark(ev.id, job_id=job.id, submit="job_evaluate",
+                    priority=job.priority)
         try:
             _, index = self.raft.apply(MessageType.EVAL_UPDATE, {"evals": [ev]})
         except NotLeaderError:
@@ -1117,6 +1184,8 @@ class Server:
         child.meta = dict(parent.meta)
         child.meta.update(meta)
         child.status = s.JOB_STATUS_PENDING
+        if self._leader:
+            self.eval_broker.check_admission(child.priority)
         try:
             _, index = self.raft.apply(MessageType.JOB_REGISTER, {"job": child})
         except NotLeaderError:
@@ -1264,7 +1333,10 @@ class Server:
             reply = self._forward("Node.UpdateStatus",
                                   {"NodeID": node_id, "Status": status})
             return reply["Index"], reply["HeartbeatTTL"]
-        index = self.raft.applied_index()
+        # Relaxed: the common no-transition heartbeat must not queue on
+        # the raft lock behind the apply stream (at harness scale that
+        # convoy starved renewals into expiry).
+        index = self.raft.applied_index_relaxed()
         if node.status != status:
             _, index = self.raft.apply(
                 MessageType.NODE_UPDATE_STATUS,
@@ -1499,6 +1571,15 @@ class Server:
             self.raft.apply(MessageType.RECONCILE_JOB_SUMMARIES, {})
         except NotLeaderError:
             self._forward("System.ReconcileJobSummaries", {})
+
+    def broker_stats(self) -> Dict:
+        """The /v1/broker/stats saturation surface: broker admission /
+        coalesce state plus the plan-queue depth (the two stages whose
+        backlogs say whether the control plane is keeping up)."""
+        out = self.eval_broker.extended_stats()
+        out["PlanQueueDepth"] = self.plan_queue.depth()
+        out["BlockedEvals"] = self.blocked_evals.stats()
+        return out
 
     def stats(self) -> Dict:
         out = {

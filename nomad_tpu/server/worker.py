@@ -33,6 +33,29 @@ DEQUEUE_TIMEOUT = 0.5
 RAFT_SYNC_LIMIT = 5.0
 
 
+def stale_snapshot_enabled() -> bool:
+    """Stale-snapshot scheduling (the reference's optimistic-concurrency
+    design, PAPER.md L3): workers REUSE a recent state snapshot instead
+    of copying the whole store per eval, as long as it covers the eval's
+    trigger indexes — any staleness it carries is caught by the plan
+    applier's per-node re-check, which partially commits and refreshes
+    the scheduler.  Default on; NOMAD_TPU_STALE_SNAPSHOT=0 restores the
+    snapshot-per-eval path."""
+    return os.environ.get("NOMAD_TPU_STALE_SNAPSHOT", "").strip().lower() \
+        not in ("0", "false", "no", "off")
+
+
+def _stale_snapshot_max_lag() -> int:
+    """How many raft entries a reused snapshot may lag the applied index
+    before the worker refreshes anyway — bounds the conflict rate under
+    churn without giving up cross-eval reuse."""
+    try:
+        return int(os.environ.get("NOMAD_TPU_STALE_SNAPSHOT_LAG", "")
+                   or 512)
+    except ValueError:
+        return 512
+
+
 class WorkerPlanner:
     """The scheduler.Planner implementation workers hand to schedulers
     (worker.go:300-499)."""
@@ -55,6 +78,8 @@ class WorkerPlanner:
         unbounded plan queue, attach the eval token for fencing."""
         w = self.worker
         plan.eval_token = self.token
+        if self.snapshot_index is not None:
+            plan.snapshot_index = self.snapshot_index
         try:
             w.broker.pause_nack_timeout(self.eval.id, self.token)
         except EvalBrokerError:
@@ -75,9 +100,15 @@ class WorkerPlanner:
         state = None
         if result is not None and result.refresh_index:
             # Wait for our state to catch up, then hand a refreshed
-            # snapshot to the scheduler (worker.go:335-350).
+            # snapshot to the scheduler (worker.go:335-350).  The
+            # refresh also replaces the worker's stale-snapshot cache —
+            # a conflict means the cached view lost its bet.
             w.wait_for_index(result.refresh_index, RAFT_SYNC_LIMIT)
+            idx = w.raft.applied_index()
             state = w.raft.fsm.state.snapshot()
+            if w._stale_ok:
+                w._snap_cache = (idx, state)
+            self.snapshot_index = idx
         return result, state
 
     def update_eval(self, ev: s.Evaluation) -> None:
@@ -133,6 +164,14 @@ class Worker:
         # a fixed 50ms nap synchronized every worker's retry into one
         # thundering dequeue per tick.
         self._idle_backoff = Backoff(base=0.02, max_delay=0.5)
+        # Stale-snapshot cache: (applied index at snapshot time, the
+        # snapshot).  Reused across evals while it covers the eval's
+        # trigger indexes and isn't too far behind the log — the paper's
+        # schedule-anywhere-off-a-snapshot discipline; plan-apply's
+        # re-check owns correctness.  Per-worker (no lock needed).
+        self._stale_ok = stale_snapshot_enabled()
+        self._snap_cache: Optional[Tuple[int, object]] = None
+        self._snap_max_lag = _stale_snapshot_max_lag()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -160,25 +199,61 @@ class Worker:
 
     # -- loop --------------------------------------------------------------
 
+    # How many ready evals one dequeue drains.  Each eval is still
+    # scheduled/acked individually (latency and nack semantics are
+    # per-eval, unlike BatchWorker's one-kernel-per-batch), but the
+    # FIRST eval's fresh snapshot covers its batch-mates' trigger
+    # indexes — they were all written before the dequeue — so under
+    # backlog the stale-snapshot cache turns one O(cluster) store copy
+    # into GREEDY_BATCH evals' worth of scheduling.  Idle brokers
+    # return a single eval (or none); the latency-optimal light-load
+    # path is unchanged.
+    GREEDY_BATCH = 8
+
     def run(self) -> None:
         while not self._stop.is_set():
             self._check_paused()
-            item = self._dequeue()
-            if item is None:
-                continue
-            ev, token = item
-            self.process_eval(ev, token)
+            for ev, token in self._dequeue_batch():
+                if self._stop.is_set():
+                    # Shutting down mid-batch: hand undone evals back
+                    # for redelivery instead of scheduling into a
+                    # stopping server.
+                    try:
+                        self.broker.nack(ev.id, token)
+                    except EvalBrokerError:
+                        pass
+                    continue
+                # The nack deadline guards PROCESSING, not in-worker
+                # queue wait (paused at dequeue below): resume as this
+                # eval's turn starts.  A resume failure means the
+                # delivery already burned (broker flushed on leadership
+                # loss) — skip rather than double-schedule.
+                try:
+                    self.broker.resume_nack_timeout(ev.id, token)
+                except EvalBrokerError:
+                    continue
+                self.process_eval(ev, token)
 
-    def _dequeue(self) -> Optional[Tuple[s.Evaluation, str]]:
+    def _dequeue_batch(self) -> List[Tuple[s.Evaluation, str]]:
         try:
-            ev, token = self.broker.dequeue(self.schedulers, DEQUEUE_TIMEOUT)
+            batch = self.broker.dequeue_batch(
+                self.schedulers, self.GREEDY_BATCH, DEQUEUE_TIMEOUT)
         except EvalBrokerError:
             time.sleep(self._idle_backoff.next_delay())
-            return None
+            return []
         self._idle_backoff.reset()
-        if ev is None:
-            return None
-        return ev, token
+        # Pause every batch-mate's nack deadline: the clock must cover
+        # one eval's processing (the single-dequeue contract), not its
+        # wait behind up to GREEDY_BATCH-1 predecessors — a mid-batch
+        # expiry would redeliver an eval this worker is still going to
+        # schedule, and same-job double placement is exactly what the
+        # capacity re-check cannot catch.
+        for ev, token in batch:
+            try:
+                self.broker.pause_nack_timeout(ev.id, token)
+            except EvalBrokerError:
+                pass
+        return batch
 
     # The unit of the UNSUFFIXED worker.invoke_scheduler histogram is one
     # scheduler invocation.  For this worker that's one eval; BatchWorker
@@ -250,22 +325,72 @@ class Worker:
         """Wait for log catch-up (worker.go:229).  Backed-off polling:
         sub-millisecond first checks for the common just-behind case,
         ramping to a coarse interval so a genuinely stalled log doesn't
-        pin a core."""
-        return wait_until(lambda: self.raft.applied_index() >= index,
-                          timeout, initial=0.0005, max_interval=0.005)
+        pin a core.  The relaxed read keeps M polling workers off the
+        raft lock (it under-reports by at most an in-flight entry,
+        which the next poll observes)."""
+        return wait_until(
+            lambda: self.raft.applied_index_relaxed() >= index,
+            timeout, initial=0.0005, max_interval=0.005)
 
     def sched_name(self, ev: s.Evaluation) -> str:
         """Scheduler-registry name for an eval (overridable: the batch
         worker swaps in vectorized implementations)."""
         return ev.type
 
-    def invoke_scheduler(self, ev: s.Evaluation, token: str) -> None:
-        """(worker.go:262): snapshot state, instantiate by eval type."""
-        # Index first, snapshot second: the snapshot then holds AT LEAST
-        # everything up to the recorded index, so a blocked eval's
-        # snapshot_index never overstates what the scheduler saw.
+    def _required_index(self, ev: s.Evaluation) -> int:
+        """The lowest applied index a snapshot must cover to schedule
+        ``ev`` safely — the eval's TRIGGER indexes, not its own write
+        index (requiring ev.modify_index would force a fresh snapshot
+        for every eval created after the cache, defeating reuse under
+        exactly the backlog conditions reuse exists for):
+
+        - ``job_modify_index``  — the job write the eval reconciles
+          (job register/update/deregister paths stamp it);
+        - ``node_modify_index`` — the node transition (node evals);
+        - ``snapshot_index``    — what the last scheduling attempt saw,
+          raised to the UNBLOCK index by BlockedEvals on re-admission
+          (preemption follow-ups and requeues ride this too);
+        - the job's newest committed plan (plan_queue.applied_index_for)
+          — broker per-job serialization orders eval N+1's DEQUEUE
+          after eval N's plan apply, but not its CREATION, and a
+          snapshot missing the job's own placements would double-place
+          them (capacity re-checks cannot catch same-job duplication).
+        """
+        if ev.type == s.JOB_TYPE_CORE:
+            # GC sweeps must see current state: a pinned stale cache
+            # would hide newly-terminal rows from the core scheduler
+            # indefinitely (GC is rare; a fresh snapshot is cheap).
+            return self.raft.applied_index()
+        return max(ev.trigger_index(),
+                   self.plan_queue.applied_index_for(ev.job_id))
+
+    def _snapshot_covering(self, required: int) -> Tuple[int, object]:
+        """(index, snapshot) with index >= required.  With stale-snapshot
+        scheduling enabled the cached snapshot is reused while it covers
+        ``required`` and lags the log by at most the configured bound —
+        dropping the O(cluster) store copy from the per-eval path; any
+        capacity staleness is the plan applier's re-check problem
+        (optimistic concurrency).  Index is read BEFORE the snapshot is
+        taken so a blocked eval's snapshot_index never overstates what
+        the scheduler saw."""
+        if self._stale_ok:
+            cached = self._snap_cache
+            if cached is not None and cached[0] >= required \
+                    and self.raft.applied_index_relaxed() - cached[0] \
+                    <= self._snap_max_lag:
+                self.metrics.incr_counter("worker.snapshot_reuse")
+                return cached
         snapshot_index = self.raft.applied_index()
         snap = self.raft.fsm.state.snapshot()
+        if self._stale_ok:
+            self._snap_cache = (snapshot_index, snap)
+            self.metrics.incr_counter("worker.snapshot_fresh")
+        return snapshot_index, snap
+
+    def invoke_scheduler(self, ev: s.Evaluation, token: str) -> None:
+        """(worker.go:262): snapshot state, instantiate by eval type."""
+        snapshot_index, snap = self._snapshot_covering(
+            self._required_index(ev))
         planner = WorkerPlanner(self, ev, token,
                                 snapshot_index=snapshot_index)
         sched_name = self.sched_name(ev)
@@ -421,6 +546,12 @@ class BatchWorker(Worker):
         max_index = max(ev.modify_index for ev, _ in batch)
         with tracing.span("worker.wait_for_index"):
             self.wait_for_index(max_index, RAFT_SYNC_LIMIT)
+        # Always a fresh snapshot on the batch path: the device-resident
+        # usage mirror advances by inter-snapshot deltas, and a reused
+        # pre-apply snapshot would hide the previous batch's own
+        # placements from the next batch's usage encode (conflict churn
+        # the per-eval stale-snapshot pool tolerates, the batched kernel
+        # path should not).
         snapshot_index = self.raft.applied_index()
         snap = self.raft.fsm.state.snapshot()
 

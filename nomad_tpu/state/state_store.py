@@ -315,6 +315,13 @@ class StateStore:
 
     def _bump(self, table: str, index: int) -> None:
         self._indexes[table] = index
+        if table == "nodes":
+            # Drop the memoized ready-node list (scheduler/util.py
+            # ready_nodes_in_dcs): node writes are the only thing that
+            # changes it, and the stale-snapshot worker pool reuses one
+            # snapshot across many evals — the memo is what makes that
+            # reuse O(1) instead of an O(cluster) walk per eval.
+            self.__dict__.pop("_ready_nodes_cache", None)
 
     # -- lazy slab resolution ---------------------------------------------
     #

@@ -40,7 +40,7 @@ from typing import Any, Dict, List, Optional
 __all__ = [
     "Span", "Tracer", "TRACER", "NOOP",
     "enable", "disable", "enabled", "span", "event", "record",
-    "trace_for_eval", "recent", "note_fault",
+    "trace_for_eval", "recent", "note_fault", "mark", "close_mark",
 ]
 
 # Bounded-store defaults: the recency ring holds ~4k completed spans;
@@ -56,6 +56,10 @@ MAX_SPANS_PER_EVAL = 256
 # the span keeps the first N ids (indexed + serialized) plus an
 # `eval_ids_elided` count.
 MAX_EVAL_IDS_PER_SPAN = 128
+# Cross-thread umbrella marks (eval.e2e: RPC submit → broker ack): an
+# eval whose ack never comes (leadership churn) must not pin its mark
+# forever, so the mark table is a bounded LRU.
+MAX_MARKS = 4096
 
 
 class Span:
@@ -160,6 +164,9 @@ class Tracer:
         self._by_eval: "OrderedDict[str, _EvalBucket]" = OrderedDict()
         self.max_evals = max(1, max_evals)
         self._local = threading.local()
+        # eval_id → (monotonic submit time, attrs): the open end of a
+        # cross-thread umbrella span (mark/close_mark).
+        self._marks: "OrderedDict[str, tuple]" = OrderedDict()
 
     # -- thread-local span stack ------------------------------------------
 
@@ -230,6 +237,34 @@ class Tracer:
         sp.end = end
         self._record(sp)
         return sp
+
+    # -- cross-thread umbrella marks ---------------------------------------
+
+    def mark(self, eval_id: str, **attrs: Any) -> None:
+        """Open an umbrella: remember WHEN (monotonic) this eval was
+        submitted, so whichever thread later closes it can record one
+        span covering the whole client-visible lifecycle."""
+        with self._l:
+            self._marks[eval_id] = (time.monotonic(), attrs)
+            self._marks.move_to_end(eval_id)
+            while len(self._marks) > MAX_MARKS:
+                self._marks.popitem(last=False)
+
+    def close_mark(self, eval_id: str, name: str = "eval.e2e",
+                   **attrs: Any) -> None:
+        """Close the umbrella opened by :meth:`mark` — records one
+        retroactive ``eval.e2e`` span (submit → now) stitching client
+        RPC → broker → worker → plan-apply across threads.  No-op when
+        no mark exists (evals born inside the scheduler)."""
+        with self._l:
+            entry = self._marks.pop(eval_id, None)
+        if entry is None:
+            return
+        start, mark_attrs = entry
+        merged = dict(mark_attrs)
+        merged.update(attrs)
+        merged["eval_id"] = eval_id
+        self.record(name, start, time.monotonic(), **merged)
 
     # -- storage / query ---------------------------------------------------
 
@@ -347,6 +382,18 @@ def trace_for_eval(eval_id: str) -> List[Dict[str, Any]]:
 def recent(n: int = 100) -> List[Dict[str, Any]]:
     tr = TRACER
     return tr.recent(n) if tr is not None else []
+
+
+def mark(eval_id: str, **attrs: Any) -> None:
+    tr = TRACER
+    if tr is not None:
+        tr.mark(eval_id, **attrs)
+
+
+def close_mark(eval_id: str, name: str = "eval.e2e", **attrs: Any) -> None:
+    tr = TRACER
+    if tr is not None:
+        tr.close_mark(eval_id, name, **attrs)
 
 
 def note_fault(point: str, rule_index: int, action: str) -> None:

@@ -495,3 +495,37 @@ class TestAgentMonitor:
         while time.time() < deadline and "after-monitor" not in text():
             time.sleep(0.05)
         assert "after-monitor marker" in text(), "live line not streamed"
+
+
+class TestBrokerStatsEndpoint:
+    def test_broker_stats_shape(self, api):
+        """/v1/broker/stats (ISSUE 7 satellite): the saturation surface
+        the load harness polls, served over HTTP + SDK."""
+        stats = api.system.broker_stats()
+        for key in ("Enabled", "Pending", "MaxPending", "ByState",
+                    "ByPriority", "DeliveryAttempts", "ShedTotal",
+                    "CoalescedTotal", "AdmissionRejects",
+                    "PlanQueueDepth", "BlockedEvals"):
+            assert key in stats, key
+        assert set(stats["ByState"]) == {"ready", "unacked", "deferred",
+                                         "waiting", "failed"}
+
+    def test_admission_nack_maps_to_429_with_retry_after(self, agent, api):
+        """A saturated broker answers job submissions with 429 +
+        Retry-After; the SDK surfaces both."""
+        broker = agent.server.eval_broker
+        prev = broker.max_pending
+        broker.max_pending = 1
+        # Deterministic saturation: plant one tracked pending eval (a
+        # live worker would drain a real one before the assert).
+        with broker._l:
+            broker.evals["fake-saturation"] = 0
+        try:
+            with pytest.raises(APIError) as exc:
+                api.jobs.register(exec_job())
+            assert exc.value.code == 429
+            assert exc.value.retry_after > 0
+        finally:
+            broker.max_pending = prev
+            with broker._l:
+                broker.evals.pop("fake-saturation", None)

@@ -31,7 +31,8 @@ def _always_disarm():
 class TestTTLScaling:
     def test_small_fleet_gets_min_ttl(self):
         h = HeartbeatTimers(on_expire=lambda nid: None, min_ttl=10.0,
-                            max_per_second=50.0, grace=10.0)
+                            max_per_second=50.0, grace=10.0,
+                            ttl_jitter=0.0)
         h.set_enabled(True)
         try:
             assert h.reset_heartbeat_timer("n1") == 10.0
@@ -44,7 +45,8 @@ class TestTTLScaling:
         (config.go:185-197): a 500-node fleet at 50 hb/s spreads
         heartbeats over ≥10s each."""
         h = HeartbeatTimers(on_expire=lambda nid: None, min_ttl=1.0,
-                            max_per_second=10.0, grace=60.0)
+                            max_per_second=10.0, grace=60.0,
+                            ttl_jitter=0.0)
         h.set_enabled(True)
         try:
             for i in range(100):
@@ -58,6 +60,31 @@ class TestTTLScaling:
             assert h.reset_heartbeat_timer("node-next") == pytest.approx(1.0)
         finally:
             h.set_enabled(False)
+
+    def test_initial_ttl_jitter_disperses_renewals(self):
+        """Thundering-herd regression (ISSUE 7 satellite): a fleet
+        registered in one burst must NOT be granted identical TTLs —
+        identical grants phase-lock every client's renewal onto the same
+        beat forever.  With the default jitter the granted TTLs (and so
+        the renewal arrival times) spread over a band ≥ half the
+        configured jitter width, and every grant stays within
+        [ttl, ttl·(1+jitter)] so expiry timing guarantees hold."""
+        import random
+
+        h = HeartbeatTimers(on_expire=lambda nid: None, min_ttl=10.0,
+                            max_per_second=50.0, grace=10.0,
+                            ttl_jitter=0.1, rng=random.Random(42))
+        h.set_enabled(True)
+        try:
+            ttls = [h.reset_heartbeat_timer(f"burst-{i}")
+                    for i in range(200)]
+        finally:
+            h.set_enabled(False)
+        assert all(10.0 <= t <= 10.0 * 1.1 + 1e-9 for t in ttls)
+        # Dispersed, not clustered: the spread covers most of the jitter
+        # band and no single value dominates.
+        assert max(ttls) - min(ttls) >= 10.0 * 0.1 * 0.5
+        assert len({round(t, 3) for t in ttls}) > 150
 
     def test_disabled_grants_min_ttl_without_tracking(self):
         h = HeartbeatTimers(on_expire=lambda nid: None, min_ttl=3.0)

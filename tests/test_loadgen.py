@@ -1,0 +1,264 @@
+"""Load-harness + eval-broker admission-control tests (ISSUE 7).
+
+The smoke scenario is the tier-1 gate for the whole control-plane
+saturation plane: it drives the REAL server stack (workers, broker,
+plan pipeline, heartbeats, event stream) with a fixed, seeded burst and
+must complete in seconds, deterministically.
+"""
+import time
+
+import pytest
+
+from nomad_tpu.server import Server, ServerConfig
+from nomad_tpu.server.eval_broker import BrokerLimitError, EvalBroker
+from nomad_tpu.structs import structs as s
+
+
+def wait_until(predicate, timeout=30.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+# ---------------------------------------------------------------------------
+# harness smoke (the tier-1 loadgen gate)
+# ---------------------------------------------------------------------------
+
+
+class TestLoadgenSmoke:
+    def test_smoke_scenario_end_to_end(self):
+        from nomad_tpu.loadgen import LoadHarness
+        from nomad_tpu.loadgen.scenario import get_scenario
+
+        report = LoadHarness(get_scenario("smoke")).run()
+        off, sus = report["offered"], report["sustained"]
+        # Deterministic offered load: the seeded burst submits exactly
+        # max_submissions jobs and every one completes.
+        assert off["submitted"] == 30
+        assert off["dropped_after_retries"] == 0
+        assert sus["completed_total"] == 30
+        assert sus["stragglers_after_drain"] == 0
+        assert sus["evals_per_s"] > 0
+        # The report's latency sections are populated and the harness
+        # agrees with the server's own telemetry plane.
+        s2r = report["latency_ms"]["submit_to_running"]
+        assert s2r["count"] > 0 and s2r["p99"] >= s2r["p50"] > 0
+        assert report["latency_ms"]["plan_apply"].get("count", 0) > 0
+        broker = report["control_plane"]["broker"]
+        assert broker["Pending"] == 0
+        # Simulated clients really heartbeat, with jitter-dispersed TTLs.
+        hb = report["heartbeat"]
+        assert hb["renewals"] >= 20
+        assert hb["distinct_ttls"] > 1
+        # Event fan-out probe ran against the filtered subscribers.
+        assert report["event_fanout"]["subscribers"] >= 8
+        assert report["event_fanout"]["us_per_event"] > 0
+
+    def test_overload_sheds_and_stays_bounded(self):
+        """Scaled-down 10× overload against a bounded broker: admission
+        rejects fire, the pending queue never outgrows the cap, and the
+        run still terminates with no stragglers (accepted work drains,
+        rejected work is dropped by the client after its retries)."""
+        from dataclasses import replace
+
+        from nomad_tpu.loadgen import LoadHarness
+        from nomad_tpu.loadgen.scenario import get_scenario
+
+        sc = replace(get_scenario("overload_10x"),
+                     num_nodes=20, num_clients=8, arrival_rate=1500.0,
+                     max_submissions=400, subscribers=8,
+                     broker_max_pending=32, drain_s=30.0)
+        report = LoadHarness(sc).run()
+        off = report["offered"]
+        broker = report["control_plane"]["broker"]
+        assert off["admission_rejects_seen"] > 0
+        assert broker["AdmissionRejects"] > 0
+        assert broker["MaxPending"] == 32
+        assert broker["Pending"] <= 32
+        assert report["sustained"]["stragglers_after_drain"] == 0
+        # Accounting closes: accepted = submitted tracked, and accepted
+        # + dropped = attempts that got an answer.
+        assert off["submitted"] + off["dropped_after_retries"] <= 400
+        assert report["sustained"]["completed_total"] == off["submitted"]
+
+
+# ---------------------------------------------------------------------------
+# broker admission control units
+# ---------------------------------------------------------------------------
+
+
+def make_eval(job_id, eval_id=None, priority=50, trigger_index=0):
+    return s.Evaluation(id=eval_id or s.generate_uuid(), job_id=job_id,
+                        type=s.JOB_TYPE_SERVICE, priority=priority,
+                        status=s.EVAL_STATUS_PENDING,
+                        job_modify_index=trigger_index)
+
+
+class TestBrokerAdmission:
+    def test_coalesce_keeps_newest_sheds_older(self):
+        b = EvalBroker(coalesce=True)
+        b.set_enabled(True)
+        try:
+            b.enqueue(make_eval("j1", "e0", trigger_index=1))
+            b.enqueue(make_eval("j1", "e1", trigger_index=2))  # deferred
+            b.enqueue(make_eval("j1", "e2", trigger_index=3))  # coalesces
+            st = b.extended_stats()
+            assert st["CoalescedTotal"] == 1
+            assert st["ShedTotal"] == 1
+            assert st["ByState"]["deferred"] == 1
+            shed = b.get_shed(timeout=0.1)
+            assert [ev.id for ev in shed] == ["e1"]
+            # Queued eval unaffected; the kept deferred one is e2.
+            ev, token = b.dequeue([s.JOB_TYPE_SERVICE], 0.1)
+            assert ev.id == "e0"
+            b.ack("e0", token)
+            ev, token = b.dequeue([s.JOB_TYPE_SERVICE], 0.5)
+            assert ev.id == "e2"
+        finally:
+            b.set_enabled(False)
+
+    def test_coalesce_refuses_when_keeper_would_miss_trigger(self):
+        """A higher-priority deferred eval with an OLDER trigger index
+        must not absorb a newer trigger (a node death, an unblock) —
+        both stay queued."""
+        b = EvalBroker(coalesce=True)
+        b.set_enabled(True)
+        try:
+            b.enqueue(make_eval("j1", "e0", trigger_index=1))
+            b.enqueue(make_eval("j1", "e1", priority=90, trigger_index=2))
+            b.enqueue(make_eval("j1", "e2", priority=50, trigger_index=9))
+            st = b.extended_stats()
+            assert st["CoalescedTotal"] == 0
+            assert st["ByState"]["deferred"] == 2
+        finally:
+            b.set_enabled(False)
+
+    def test_admission_rejects_past_cap_with_retry_after(self):
+        b = EvalBroker(max_pending=2, bypass_priority=90)
+        b.set_enabled(True)
+        try:
+            b.enqueue(make_eval("j1"))
+            b.enqueue(make_eval("j2"))
+            with pytest.raises(BrokerLimitError) as exc:
+                b.check_admission(50)
+            assert exc.value.retry_after > 0
+            assert exc.value.pending == 2
+            # Priority at/above the bypass floor is always admitted.
+            b.check_admission(90)
+            # And below the cap admission is open again.
+            ev, token = b.dequeue([s.JOB_TYPE_SERVICE], 0.1)
+            b.ack(ev.id, token)
+            b.check_admission(50)
+            assert b.extended_stats()["AdmissionRejects"] == 1
+        finally:
+            b.set_enabled(False)
+
+    def test_limit_error_wire_roundtrip(self):
+        err = BrokerLimitError(1.25, 300, 256)
+        rebuilt = BrokerLimitError.from_message(
+            f"BrokerLimitError: {err}".split(": ", 1)[1])
+        assert rebuilt.retry_after == pytest.approx(1.25)
+        assert (rebuilt.pending, rebuilt.limit) == (300, 256)
+
+    def test_delivery_attempts_histogram_in_stats(self):
+        b = EvalBroker(nack_timeout=60.0)
+        b.set_enabled(True)
+        try:
+            b.enqueue(make_eval("j1", "e0"))
+            ev, token = b.dequeue([s.JOB_TYPE_SERVICE], 0.1)
+            b.nack(ev.id, token)
+            ev, token = b.dequeue([s.JOB_TYPE_SERVICE], 2.0)
+            st = b.extended_stats()
+            assert st["DeliveryAttempts"] == {"2": 1}
+            assert st["ByState"]["unacked"] == 1
+        finally:
+            b.set_enabled(False)
+
+    def test_server_job_register_429s_when_saturated(self):
+        srv = Server(ServerConfig(num_schedulers=1, broker_max_pending=1,
+                                  min_heartbeat_ttl=60))
+        srv.start()
+        try:
+            assert wait_until(srv.is_leader, timeout=10.0)
+            for w in srv.workers:
+                w.set_pause(True)
+
+            def job(n):
+                jid = f"adm-{n}"
+                return s.Job(
+                    region="global", id=jid, name=jid,
+                    type=s.JOB_TYPE_SERVICE, priority=50,
+                    datacenters=["dc1"],
+                    task_groups=[s.TaskGroup(
+                        name="tg", count=1,
+                        ephemeral_disk=s.EphemeralDisk(size_mb=10),
+                        tasks=[s.Task(
+                            name="t", driver="exec",
+                            config={"command": "/bin/date"},
+                            resources=s.Resources(cpu=10, memory_mb=10),
+                            log_config=s.LogConfig())])])
+
+            srv.job_register(job(0))
+            with pytest.raises(BrokerLimitError):
+                srv.job_register(job(1))
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# eval.e2e umbrella span (tracing satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestEvalE2ESpan:
+    def test_submit_to_ack_umbrella_span_recorded(self):
+        from nomad_tpu.utils import tracing
+
+        tracing.enable()
+        srv = Server(ServerConfig(num_schedulers=1, min_heartbeat_ttl=60))
+        srv.start()
+        try:
+            assert wait_until(srv.is_leader, timeout=10.0)
+            srv.node_register(s.Node(
+                id="e2e-node", datacenter="dc1", name="e2e-node",
+                attributes={"kernel.name": "linux", "driver.exec": "1"},
+                resources=s.Resources(cpu=4000, memory_mb=8192,
+                                      disk_mb=100 * 1024, iops=100),
+                reserved=s.Resources(), status=s.NODE_STATUS_READY))
+            jid = "e2e-job"
+            job = s.Job(
+                region="global", id=jid, name=jid,
+                type=s.JOB_TYPE_SERVICE, priority=50,
+                datacenters=["dc1"],
+                task_groups=[s.TaskGroup(
+                    name="tg", count=1,
+                    ephemeral_disk=s.EphemeralDisk(size_mb=10),
+                    tasks=[s.Task(name="t", driver="exec",
+                                  config={"command": "/bin/date"},
+                                  resources=s.Resources(cpu=10,
+                                                        memory_mb=10),
+                                  log_config=s.LogConfig())])])
+            _, eval_id = srv.job_register(job)
+            assert wait_until(
+                lambda: (ev := srv.state.eval_by_id(None, eval_id))
+                is not None and ev.terminal_status())
+            assert wait_until(lambda: any(
+                sp["Name"] == "eval.e2e"
+                for sp in tracing.trace_for_eval(eval_id)), timeout=10.0)
+            e2e = [sp for sp in tracing.trace_for_eval(eval_id)
+                   if sp["Name"] == "eval.e2e"]
+            assert len(e2e) == 1
+            assert e2e[0]["Attrs"]["outcome"] == "acked"
+            assert e2e[0]["Attrs"]["submit"] == "job_register"
+            # The umbrella COVERS the whole lifecycle: its window spans
+            # the broker enqueue and the worker's scheduling.
+            spans = tracing.trace_for_eval(eval_id)
+            enq = [sp for sp in spans if sp["Name"] == "broker.enqueue"]
+            assert enq and e2e[0]["Start"] <= enq[0]["Start"] \
+                and e2e[0]["End"] >= enq[0]["End"]
+        finally:
+            srv.shutdown()
+            tracing.disable()
