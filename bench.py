@@ -74,6 +74,17 @@ E_N_NODES = 50_000             # config (e) scale
 E_N_JOBS = 1_000               # 1M task-groups total
 NS_N_JOBS = 1_000              # north star: 1M tgs on the 10k cluster
 
+# config_mesh (ISSUE 8): the ROADMAP's declared scale axis — 1M NODES —
+# through the production fused node-sharded path, forced 8-way
+# host-device sharding on CPU, 10M task-groups, score delta vs the
+# single-chip program at the same pinned seed must be exactly 0.0%.
+MESH_N_NODES = 1_000_000
+MESH_N_JOBS = 100
+MESH_COUNT_PER_JOB = 100_000   # 10M task-groups total
+MESH_DEVICES = 8
+MESH_CHILD_ENV = "NOMAD_TPU_BENCH_MESH_CHILD"
+MESH_SEED = 20260804           # pinned: both engines must tie-break alike
+
 
 def log(*args):
     print(*args, file=sys.stderr, flush=True)
@@ -1055,6 +1066,186 @@ def bench_config_a():
             "tpu": tpu_detail}
 
 
+# -- config_mesh (ISSUE 8): 1M nodes x 10M tgs over the node mesh -----------
+
+class RecordingPlanner(NullPlanner):
+    """NullPlanner that records the placements each plan proposes
+    ((job, tg) → node ids from slabs + explicit allocs) without touching
+    state — both engines then schedule the identical pristine snapshot
+    and their outputs compare bit-for-bit."""
+
+    def __init__(self):
+        self.placements = {}
+
+    def submit_plan(self, plan):
+        for slab in plan.alloc_slabs:
+            key = (slab.proto.job_id, slab.proto.task_group)
+            self.placements.setdefault(key, []).extend(slab.node_ids)
+        for nid, allocs in plan.node_allocation.items():
+            for a in allocs:
+                self.placements.setdefault(
+                    (a.job_id, a.task_group), []).append(nid)
+        return super().submit_plan(plan)
+
+
+def _mesh_scorefit(h, placements, ask_by_key):
+    """Aggregate final-state ScoreFit derived from recorded placements
+    (binpack_scores' formula without materialized allocs)."""
+    used = {}
+    for key, nids in placements.items():
+        cpu, mem = ask_by_key[key]
+        for nid in nids:
+            c, m = used.get(nid, (0, 0))
+            used[nid] = (c + cpu, m + mem)
+    total = 0.0
+    for nid, (cpu, mem) in used.items():
+        node = h.state.node_by_id(None, nid)
+        res, reserved = node.resources, node.reserved
+        cap_cpu = res.cpu - (reserved.cpu if reserved else 0)
+        cap_mem = res.memory_mb - (reserved.memory_mb if reserved else 0)
+        free_cpu = 1.0 - (cpu / cap_cpu if cap_cpu else 1.0)
+        free_mem = 1.0 - (mem / cap_mem if cap_mem else 1.0)
+        total += min(18.0, max(0.0, 20.0 - (10.0 ** free_cpu
+                                            + 10.0 ** free_mem)))
+    return total
+
+
+def _mesh_child_main() -> int:
+    """Subprocess body for config_mesh: forced 8-device virtual CPU
+    mesh (the parent set XLA_FLAGS before this interpreter started), 1M
+    nodes x 10M task-groups through the production fused sharded path,
+    then the SAME problem through the single-chip program at the same
+    pinned seed — placements must be a bit-identical multiset, score
+    delta exactly 0.0%.  Prints ONE JSON line."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    os.environ["NOMAD_TPU_RNG_SEED"] = str(MESH_SEED)
+    n_nodes = int(os.environ.get("NOMAD_TPU_BENCH_MESH_NODES",
+                                 MESH_N_NODES))
+    n_jobs = int(os.environ.get("NOMAD_TPU_BENCH_MESH_JOBS", MESH_N_JOBS))
+    count = int(os.environ.get("NOMAD_TPU_BENCH_MESH_COUNT",
+                               MESH_COUNT_PER_JOB))
+
+    from nomad_tpu.ops.batch_sched import TPUBatchScheduler
+    from nomad_tpu.parallel import make_node_mesh
+    from nomad_tpu.scheduler import Harness
+
+    devs = jax.devices()
+    assert len(devs) >= MESH_DEVICES, f"need {MESH_DEVICES} devices"
+    mesh = make_node_mesh(devs[:MESH_DEVICES])
+
+    t0 = time.monotonic()
+    h = Harness()
+    build_cluster(h, n_nodes)
+    jobs = [make_job(count) for _ in range(n_jobs)]
+    for j in jobs:
+        h.state.upsert_job(h.next_index(), j)
+    snap = h.snapshot()
+    build_s = time.monotonic() - t0
+    log(f"config-mesh: built {n_nodes} nodes x {n_jobs * count} tgs in "
+        f"{build_s:.1f}s")
+    ask_by_key = {}
+    for j in jobs:
+        for tg in j.task_groups:
+            cpu = sum(t.resources.cpu for t in tg.tasks)
+            mem = sum(t.resources.memory_mb for t in tg.tasks)
+            ask_by_key[(j.id, tg.name)] = (cpu, mem)
+
+    def run(use_mesh):
+        rec = RecordingPlanner()
+        sched = TPUBatchScheduler(h.logger, snap, rec,
+                                  mesh=mesh if use_mesh else None)
+        t = time.monotonic()
+        stats = sched.schedule_batch([reg_eval(j) for j in jobs])
+        return time.monotonic() - t, stats, rec.placements
+
+    # Warm mesh pass (XLA compile for the sharded program), then timed.
+    warm_s, warm_stats, _ = run(True)
+    assert warm_stats.mesh_shards == MESH_DEVICES, \
+        f"mesh pass did not shard ({warm_stats!r})"
+    log(f"config-mesh: mesh warm-up (incl. XLA compile) {warm_s:.1f}s")
+    mesh_s, mesh_stats, mesh_pl = run(True)
+    placed = sum(len(v) for v in mesh_pl.values())
+    log(f"config-mesh: mesh {placed} placed in {mesh_s:.1f}s → "
+        f"{placed / mesh_s:.0f} placed-tg/s ({mesh_stats!r})")
+
+    # Single-chip reference at the same seed: one timed pass (compile
+    # included — its rate is context, its PLACEMENTS are the check).
+    single_s, single_stats, single_pl = run(False)
+    log(f"config-mesh: single-chip reference in {single_s:.1f}s "
+        f"(incl. compile; {single_stats!r})")
+
+    bit_identical = ({k: sorted(v) for k, v in mesh_pl.items()}
+                     == {k: sorted(v) for k, v in single_pl.items()})
+    score_mesh = _mesh_scorefit(h, mesh_pl, ask_by_key)
+    score_single = _mesh_scorefit(h, single_pl, ask_by_key)
+    delta_pct = (100.0 * (score_single - score_mesh) / score_single
+                 if score_single else 0.0)
+    out = {
+        "nodes": n_nodes, "taskgroups": n_jobs * count,
+        "mesh_devices": MESH_DEVICES, "seed": MESH_SEED,
+        "placed": placed,
+        "elapsed_s": round(mesh_s, 3),
+        "sustained_placed_per_s": round(placed / mesh_s, 1),
+        "compile_warmup_s": round(warm_s, 1),
+        "cluster_build_s": round(build_s, 1),
+        "commit_s": round(mesh_stats.commit_seconds, 3),
+        "fetch_s": round(mesh_stats.fetch_seconds, 3),
+        "fetch_bytes": mesh_stats.fetch_bytes,
+        "quantized": mesh_stats.quantized,
+        "resident_hits": mesh_stats.resident_hits,
+        "single_chip": {
+            "elapsed_s": round(single_s, 3),
+            "placed": sum(len(v) for v in single_pl.values()),
+            "note": "one pass incl. compile (reference for the delta, "
+                    "not a tuned rate)",
+        },
+        "bit_identical_placements": bit_identical,
+        "score_delta_pct": round(delta_pct, 4),
+        "platform": str(jax.devices()[0].platform),
+        "note": ("8-way VIRTUAL mesh on one CPU host: shards execute "
+                 "serially and collectives are memcpys, so wall time "
+                 "measures correctness-at-scale + per-device memory "
+                 "(each shard holds 1/8 of the node tensors), not ICI "
+                 "speedup; at this shape count≈shard so the candidate "
+                 "all-gather is ~the full node axis"),
+    }
+    print(json.dumps(out), flush=True)
+    return 0 if bit_identical else 1
+
+
+def bench_mesh(deadline_s: int = 900, scale=None) -> dict:
+    """config_mesh driver: spawn the forced-8-device subprocess (the
+    device count must be pinned in XLA_FLAGS before jax initializes, so
+    the current process cannot run this phase itself) and parse its one
+    JSON line.  ``scale`` optionally overrides (nodes, jobs, count) for
+    tests."""
+    import subprocess
+
+    from nomad_tpu.utils.platform import virtual_mesh_env
+
+    env = virtual_mesh_env(MESH_DEVICES)
+    env[MESH_CHILD_ENV] = "1"
+    env.pop(CHILD_ENV, None)
+    if scale is not None:
+        env["NOMAD_TPU_BENCH_MESH_NODES"] = str(scale[0])
+        env["NOMAD_TPU_BENCH_MESH_JOBS"] = str(scale[1])
+        env["NOMAD_TPU_BENCH_MESH_COUNT"] = str(scale[2])
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)], env=env,
+        timeout=deadline_s, capture_output=True, text=True)
+    for line in (proc.stderr or "").splitlines():
+        log(f"  {line}")
+    lines = [ln for ln in (proc.stdout or "").splitlines() if ln.strip()]
+    if not lines:
+        raise RuntimeError(
+            f"config_mesh child produced no output (rc={proc.returncode})")
+    out = json.loads(lines[-1])
+    out["child_rc"] = proc.returncode
+    return out
+
+
 # -- orchestration ----------------------------------------------------------
 
 class PhaseTimeout(Exception):
@@ -1312,6 +1503,22 @@ def _child_main():
     if sdy is not None:
         detail["config_steady"] = sdy
 
+    # The ROADMAP scale axis (ISSUE 8): 1M nodes x 10M tgs through the
+    # fused node-sharded path in its own forced-8-device subprocess.
+    # Runs LAST on whatever budget remains — the subprocess is outside
+    # this child's SIGALRM reach, so the deadline rides the subprocess
+    # timeout; a squeeze skips it (the --check guard measures it fresh
+    # either way).
+    rem_mesh = budget.remaining()
+    if rem_mesh > 120:
+        cm = phase("config_mesh", int(rem_mesh - 15), bench_mesh,
+                   deadline_s=int(rem_mesh - 20))
+        if cm is not None:
+            detail["config_mesh"] = cm
+    else:
+        detail["config_mesh"] = {
+            "skipped": f"global budget exhausted ({rem_mesh:.0f}s left)"}
+
     flush()
     # The parent assembles and prints the ONE JSON line (it may merge a
     # TPU re-run on top of these CPU numbers first).
@@ -1387,7 +1594,7 @@ def _extract_baseline_numbers(doc: dict):
     tail string."""
     import re
 
-    ns = p95 = ce = steady = cf = ctl = ctl_p99 = None
+    ns = p95 = ce = steady = cf = ctl = ctl_p99 = mesh_rate = None
     parsed = doc.get("parsed")
     if isinstance(parsed, dict):
         det = parsed.get("detail") or parsed
@@ -1402,6 +1609,8 @@ def _extract_baseline_numbers(doc: dict):
         ctl = (det.get("config_control") or {}).get("m4_evals_per_s")
         ctl_p99 = (det.get("config_control")
                    or {}).get("submit_to_running_p99_ms")
+        mesh_rate = (det.get("config_mesh")
+                     or {}).get("sustained_placed_per_s")
     tail = doc.get("tail") or ""
     if ns is None:
         m = re.search(r'"config_northstar_10k_x_1m":\s*\{[^{}]*?'
@@ -1434,14 +1643,18 @@ def _extract_baseline_numbers(doc: dict):
         m = re.search(r'"config_control":\s*\{[^{}]*?'
                       r'"submit_to_running_p99_ms":\s*([0-9.]+)', tail)
         ctl_p99 = float(m.group(1)) if m else None
-    return ns, p95, ce, steady, cf, ctl, ctl_p99
+    if mesh_rate is None:
+        m = re.search(r'"config_mesh":\s*\{[^{}]*?'
+                      r'"sustained_placed_per_s":\s*([0-9.]+)', tail)
+        mesh_rate = float(m.group(1)) if m else None
+    return ns, p95, ce, steady, cf, ctl, ctl_p99, mesh_rate
 
 
 def _latest_bench_baseline():
     """Newest BENCH_r*.json with parseable numbers →
     (name, ns_s, p95_ms, config_e_s, steady_placed_per_s,
     northstar_commit_fetch_s, control_evals_per_s,
-    control_s2r_p99_ms)."""
+    control_s2r_p99_ms, mesh_placed_per_s)."""
     import glob
 
     here = os.path.dirname(os.path.abspath(__file__))
@@ -1455,7 +1668,7 @@ def _latest_bench_baseline():
         nums = _extract_baseline_numbers(doc)
         if any(v is not None for v in nums):
             return (os.path.basename(path),) + nums
-    return (None,) * 8
+    return (None,) * 9
 
 
 CHECK_THRESHOLD_DEFAULT = 1.5
@@ -1484,7 +1697,7 @@ def _check_main(argv) -> int:
             "NOMAD_TPU_BENCH_CHECK_THRESHOLD", 0) or CHECK_THRESHOLD_DEFAULT)
 
     (baseline_file, base_ns, base_p95, base_ce, base_steady, base_cf,
-     base_ctl, base_ctl_p99) = _latest_bench_baseline()
+     base_ctl, base_ctl_p99, base_mesh) = _latest_bench_baseline()
     out = {"check": "bench-regression", "baseline": baseline_file,
            "threshold": threshold}
     if baseline_file is None:
@@ -1638,6 +1851,35 @@ def _check_main(argv) -> int:
         out["control_plane_evals_per_s"] = {"error": repr(exc)}
         failures.append(f"control-plane phase failed: {exc!r}")
 
+    # Node-mesh scale axis (ISSUE 8): 1M nodes x 10M tgs through the
+    # fused sharded path in its own forced-8-device subprocess.  The
+    # score delta vs the single-chip program at the same pinned seed
+    # must be EXACTLY 0.0% (bit-identical placements — needs no
+    # baseline); sustained placed/s additionally guards against the
+    # latest BENCH_r*.json once one carries a config_mesh number.
+    try:
+        cm = bench_mesh(deadline_s=1500)
+        cur_rate = float(cm["sustained_placed_per_s"])
+        out["config_mesh_placed_per_s"] = {
+            "baseline": base_mesh, "current": cur_rate,
+            "ratio": (round(cur_rate / base_mesh, 3)
+                      if base_mesh else None)}
+        out["config_mesh_score_delta_pct"] = {
+            "current": cm["score_delta_pct"], "budget_pct": 0.0,
+            "bit_identical": cm["bit_identical_placements"]}
+        if not cm["bit_identical_placements"]:
+            failures.append(
+                f"config_mesh placements diverged from the single-chip "
+                f"path (score delta {cm['score_delta_pct']}%) — the "
+                "mesh path must be exact")
+        if base_mesh is not None and cur_rate < base_mesh / threshold:
+            failures.append(
+                f"config_mesh sustained {cur_rate:.0f} placed/s is "
+                f"below baseline {base_mesh:.0f}/{threshold}")
+    except Exception as exc:
+        out["config_mesh_placed_per_s"] = {"error": repr(exc)}
+        failures.append(f"config_mesh phase failed: {exc!r}")
+
     out["failures"] = failures
     out["result"] = "fail" if failures else "ok"
     print(json.dumps(out), flush=True)
@@ -1645,6 +1887,8 @@ def _check_main(argv) -> int:
 
 
 def main():
+    if os.environ.get(MESH_CHILD_ENV) == "1":
+        sys.exit(_mesh_child_main())
     if "--check" in sys.argv[1:]:
         sys.exit(_check_main(sys.argv[1:]))
     if os.environ.get(CHILD_ENV) == "1":

@@ -15,7 +15,11 @@ Covers:
   differential verify against a fresh full encode (the guard, armed at
   every hit) → staleness-fence fallback for an old snapshot → breaker
   trip on injected resident corruption (fault point
-  ``ops.resident_state``).
+  ``ops.resident_state``);
+- the node-mesh production path (ISSUE 8): sharded cold encode →
+  sharded delta apply with the per-shard guard → corruption on one
+  shard attributed + breaker trip → oracle carries — run on a virtual
+  8-device CPU mesh in a subprocess.
 """
 from __future__ import annotations
 
@@ -516,14 +520,169 @@ def fused_drill(seed: int = 0, log=print) -> bool:
     return True
 
 
+def mesh_drill_child(seed: int = 0, log=print, n_devices: int = 8) -> bool:
+    """Node-mesh drill body (requires ``n_devices`` jax devices — the
+    parent ``mesh_drill`` provisions a virtual CPU mesh): sharded cold
+    encode installs the mirror, a second batch applies usage deltas on
+    the owning shards with the differential guard armed at every hit, a
+    corrupted mirror row is attributed to its shard and trips the
+    breaker, and the open breaker routes the next batch through the CPU
+    oracle which still places everything."""
+    import os
+
+    import jax
+
+    from .. import fault, mock
+    from ..parallel import make_node_mesh
+    from ..scheduler import Harness
+    from ..structs import structs as s
+    from . import resident
+    from .batch_sched import TPUBatchScheduler
+    from .breaker import KernelCircuitBreaker
+
+    def check(cond, msg):
+        if not cond:
+            log(f"mesh drill: FAIL — {msg}")
+        return cond
+
+    devs = jax.devices()
+    if not check(len(devs) >= n_devices,
+                 f"need {n_devices} devices, have {len(devs)}"):
+        return False
+    mesh = make_node_mesh(devs[:n_devices])
+    saved = {k: os.environ.get(k) for k in
+             ("NOMAD_TPU_RESIDENT", "NOMAD_TPU_RESIDENT_GUARD_EVERY")}
+    os.environ["NOMAD_TPU_RESIDENT"] = "1"
+    os.environ["NOMAD_TPU_RESIDENT_GUARD_EVERY"] = "1"
+    resident.reset_counters()
+    brk = KernelCircuitBreaker(threshold=0.9, window=8, min_checks=1,
+                               cooldown=3600.0)
+    try:
+        h = Harness()
+        for _ in range(16):
+            node = mock.node()
+            node.resources.networks = []
+            node.reserved.networks = []
+            node.compute_class()
+            h.state.upsert_node(h.next_index(), node)
+
+        def run_batch():
+            job = mock.job()
+            for tg in job.task_groups:
+                for t in tg.tasks:
+                    t.resources.networks = []
+            job.task_groups[0].count = 2
+            h.state.upsert_job(h.next_index(), job)
+            ev = s.Evaluation(
+                id=s.generate_uuid(), priority=job.priority, type=job.type,
+                triggered_by=s.EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
+                status=s.EVAL_STATUS_PENDING)
+            sched = TPUBatchScheduler(h.logger, h.snapshot(), h,
+                                      mesh=mesh, breaker=brk)
+            stats = sched.schedule_batch([ev])
+            placed = len([a for a in
+                          h.state.allocs_by_job(None, job.id, True)
+                          if not a.terminal_status()]) == 2
+            return stats, placed
+
+        s1, p1 = run_batch()
+        if not (check(s1.mesh_shards == n_devices and s1.fused == 1,
+                      f"cold batch did not run the fused mesh pass "
+                      f"({s1!r})")
+                and check(s1.full_reencodes == 1,
+                          f"cold batch should full-encode ({s1!r})")
+                and check(p1, "cold mesh batch did not place")):
+            return False
+        s2, p2 = run_batch()
+        if not (check(s2.resident_hits == 1,
+                      f"second batch should take the sharded delta path "
+                      f"({s2!r})")
+                and check(p2, "delta batch did not place")
+                and check(resident.GUARD_RUNS >= 1
+                          and resident.GUARD_MISMATCHES == 0,
+                          "per-shard guard did not verify the delta "
+                          "apply")):
+            return False
+        with fault.scenario({"seed": seed, "faults": [
+                {"point": "ops.resident_state", "action": "corrupt",
+                 "times": 1}]}):
+            s3, p3 = run_batch()
+        if not (check(resident.GUARD_MISMATCHES == 1,
+                      "guard missed the injected shard corruption")
+                and check(brk.state == "open",
+                          f"breaker {brk.state!r}, expected open")
+                and check(p3, "corrupted-shard batch did not place")):
+            return False
+        s4, p4 = run_batch()
+        if not (check(s4.oracle_routed > 0,
+                      "open breaker did not route the mesh batch "
+                      "through the oracle")
+                and check(p4, "oracle-carried batch did not place")):
+            return False
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        resident.reset_counters()
+    log(f"mesh drill: OK — {n_devices}-shard fused cold encode placed, "
+        "delta apply landed on the owning shards (guard verified "
+        "bit-identical), injected corruption was attributed to its "
+        "shard and tripped the breaker, and the oracle carried the "
+        "next batch")
+    return True
+
+
+def mesh_drill(seed: int = 0, log=print, n_devices: int = 8,
+               deadline_s: int = 420) -> bool:
+    """Parent half of the mesh drill: provision an ``n_devices`` virtual
+    CPU mesh in a throwaway subprocess (the same
+    xla_force_host_platform_device_count recipe tests/conftest.py and
+    the driver dryrun use — the current process may already have a
+    single-device backend initialized) and run ``mesh_drill_child``
+    there."""
+    import subprocess
+
+    from ..utils.platform import virtual_mesh_env
+
+    env = virtual_mesh_env(n_devices)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "nomad_tpu.ops", "--mesh-drill-child",
+             "--seed", str(seed)],
+            env=env, timeout=deadline_s, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        log(f"mesh drill: FAIL — child exceeded {deadline_s}s deadline")
+        return False
+    for line in (proc.stdout or "").splitlines():
+        log(line)
+    if proc.returncode != 0:
+        tail = (proc.stderr or "").strip().splitlines()[-5:]
+        for line in tail:
+            log(f"mesh drill child stderr: {line}")
+        log(f"mesh drill: FAIL — child rc={proc.returncode}")
+        return False
+    return True
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="python -m nomad_tpu.ops")
     parser.add_argument("--selfcheck", action="store_true",
                         help="run the oracle-vs-kernel agreement checks")
+    parser.add_argument("--mesh-drill-child", action="store_true",
+                        help=argparse.SUPPRESS)  # subprocess entry
     parser.add_argument("--nodes", type=int, default=64)
     parser.add_argument("--specs", type=int, default=64)
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args(argv)
+    if args.mesh_drill_child:
+        import jax
+
+        # The environment may pre-import jax pinning the platform; the
+        # env var alone is ignored after that (see __graft_entry__).
+        jax.config.update("jax_platforms", "cpu")
+        return 0 if mesh_drill_child(seed=args.seed) else 1
     if not args.selfcheck:
         parser.print_help()
         return 2
@@ -532,6 +691,7 @@ def main(argv=None) -> int:
     ok = tracing_drill(seed=args.seed) and ok
     ok = residency_drill(seed=args.seed) and ok
     ok = fused_drill(seed=args.seed) and ok
+    ok = mesh_drill(seed=args.seed) and ok
     return 0 if ok else 1
 
 

@@ -47,14 +47,20 @@ from .kernels import device_pass, summary_layout
 logger = logging.getLogger("nomad_tpu.ops.batch_sched")
 
 # Count of placement passes that ran node-sharded over a Mesh (test /
-# telemetry introspection for the multi-slice path).
+# telemetry introspection for the multi-slice path).  Since ISSUE 8 the
+# mesh path is the fused single-dispatch/single-fetch program
+# (parallel/sharded.sharded_fused_pass) — slot-mode AllocMetric scores
+# ride the same packed buffer as on the single-chip path, so the old
+# mesh_score_gap_passes gauge (ADVICE r5) is gone: no mesh pass can
+# drop scores anymore.
 MESH_PASSES = 0
 
-# Mesh passes that silently dropped per-node AllocMetric scores at a
-# scale where the single-chip path would have carried them; logged once,
-# exported as the batch.mesh_score_gap_passes gauge (ADVICE r5).
-MESH_SCORE_GAP_PASSES = 0
-_mesh_score_gap_logged = False
+# Budget for the commit-ordered slot record on the mesh path ([U, M]
+# int32 + optional f32/i32 score rows, replicated per device).  A batch
+# whose record would exceed this falls back to the single-chip program
+# (which has its own matrix-mode fallback) with a warning — pathological
+# shapes degrade, they never mis-place or drop scores vs single-chip.
+MESH_SLOT_BUDGET_BYTES = 512 << 20
 
 # Static cluster-tensor cache: (nodes index, attr targets, literals,
 # with_networks) → finalized ClusterTensors (see _place_on_device).
@@ -395,9 +401,9 @@ class TPUBatchScheduler:
         if resident.GUARD_MISMATCHES:
             m.set_gauge("batch.resident_guard_mismatches",
                         resident.GUARD_MISMATCHES)
-        if MESH_SCORE_GAP_PASSES:
-            m.set_gauge("batch.mesh_score_gap_passes",
-                        MESH_SCORE_GAP_PASSES)
+        if stats.mesh_shards:
+            m.incr_counter("batch.mesh_passes", 1)
+            m.set_gauge("batch.mesh_shards", stats.mesh_shards)
         m.set_gauge("breaker.trips", self.breaker.trips)
         # Live breaker, not stats.breaker_state: batches that never reach
         # the breaker gate (empty spec_list) leave stats at the "closed"
@@ -694,6 +700,7 @@ class TPUBatchScheduler:
             stats.fetch_bytes = kstats.get("fetch_bytes", 0)
             stats.fused = kstats.get("fused", 0)
             stats.quantized = kstats.get("quantized", 0)
+            stats.mesh_shards = kstats.get("mesh_shards", 0)
             stats.preempt_placed = kstats.get("preempt_placed", 0)
             stats.preempt_evicted = kstats.get("preempt_evicted", 0)
             stats.preempt_checked = kstats.get("preempt_checked", 0)
@@ -823,10 +830,16 @@ class TPUBatchScheduler:
 
         attr_targets, literals = encode.collect_attr_targets(spec_list)
         with_networks = any(sp.net_active for sp in spec_list)
+        # Node-axis pad multiple: the TPU lane width (128), raised to a
+        # common multiple of the mesh size when this scheduler schedules
+        # over a Mesh — MISSING-filled pad shards are infeasible by
+        # construction (ineligible rows), so the mesh path never falls
+        # back to single-chip over divisibility (ISSUE 8 satellite).
+        pad_m = self._node_pad_multiple()
         # Static cluster tensors are cached across batches keyed by the
-        # nodes-table raft index (+ the constraint vocabulary): a stable
-        # fleet re-encodes nothing; only alloc usage is layered on per
-        # batch (SURVEY §2.2 incremental device mirror).
+        # nodes-table raft index (+ the constraint vocabulary + the pad
+        # geometry): a stable fleet re-encodes nothing; only alloc usage
+        # is layered on per batch (SURVEY §2.2 incremental device mirror).
         base = None
         cache_key = None
         table_index = getattr(self.state, "table_index", None)
@@ -837,13 +850,15 @@ class TPUBatchScheduler:
             # Slot layout (store_uid, nodes_index, ...) is relied on by
             # ops/resident.py's old-nodes-index staleness fence.
             cache_key = (store_uid, table_index("nodes"),
-                         tuple(attr_targets), lit_key, with_networks)
+                         tuple(attr_targets), lit_key, with_networks,
+                         pad_m)
             base = _CLUSTER_CACHE.pop(cache_key, None)
             if base is not None:
                 _CLUSTER_CACHE[cache_key] = base  # LRU touch-on-hit
         if base is None:
             base = encode.encode_cluster_static(
-                all_nodes, attr_targets, with_networks=with_networks)
+                all_nodes, attr_targets, with_networks=with_networks,
+                node_pad_multiple=pad_m)
             encode.finalize_codebooks(base, literals)
             if cache_key is not None:
                 _CLUSTER_CACHE[cache_key] = base
@@ -862,10 +877,14 @@ class TPUBatchScheduler:
         if use_resident:
             # The usage mirror depends only on the node set, not the
             # batch's constraint vocabulary — key it by (store lineage,
-            # nodes index) so residency survives vocabulary changes.
+            # nodes index, pad geometry) so residency survives
+            # vocabulary changes; ``shards`` lets the differential
+            # guard attribute a mismatch to the owning mesh shard.
             used, touched, resident_info = resident.acquire(
-                self.state, cache_key[:2], base, self._live_allocs_by_node,
-                breaker=self.breaker)
+                self.state, cache_key[:2] + (base.n_pad,), base,
+                self._live_allocs_by_node, breaker=self.breaker,
+                shards=(self.mesh.devices.size
+                        if self.mesh is not None else 0))
             ct = encode.with_usage(base, used)
             # The preemption pass only needs WHICH nodes may carry live
             # allocs (it re-materializes candidate rows from state);
@@ -899,18 +918,6 @@ class TPUBatchScheduler:
                 idx = node_index.get(node_id)
                 if idx is not None:
                     jc_entries[(j, idx)] = jc_entries.get((j, idx), 0) + 1
-        if self.mesh is not None:
-            if ct.n_pad % self.mesh.devices.size == 0:
-                # The sharded kernel blocks internally (gathered results);
-                # wrap the finished tuple so _fetch_device is a no-op.
-                done = self._place_on_mesh(
-                    spec_list, all_nodes, ct, st, jc_entries,
-                    with_networks, t0)
-                return {"done": done, "resident": resident_info}
-            self.logger.warning(
-                "mesh size %d does not divide node pad %d; using the "
-                "single-chip path", self.mesh.devices.size, ct.n_pad)
-
         k_jc = encode.pow2_bucket(max(1, len(jc_entries)), minimum=8)
         jc_rows = np.full(k_jc, -1, dtype=np.int32)
         jc_cols = np.zeros(k_jc, dtype=np.int32)
@@ -944,13 +951,8 @@ class TPUBatchScheduler:
             if quant is False:
                 quant = encode.quantize_resource_rows(ct.capacity,
                                                       base.used)
-                if quant is not None and not (
-                        resident.check_quant_roundtrip(
-                            ct.capacity, quant.cap_q, quant.scale,
-                            breaker=self.breaker, what="capacity")
-                        and resident.check_quant_roundtrip(
-                            base.used, quant.used_q, quant.scale,
-                            breaker=self.breaker, what="used baseline")):
+                if quant is not None and not self._quant_roundtrip_ok(
+                        ct, base, quant):
                     quant = None
                 base._quant_rows = quant  # type: ignore[attr-defined]
         if quant is not None:
@@ -1013,6 +1015,17 @@ class TPUBatchScheduler:
         if with_dp:
             dyn.update(dp_col=st.dp_col, dp_active=st.dp_active,
                        dp_used=st.dp_used)
+
+        if self.mesh is not None:
+            handle = self._dispatch_mesh(
+                spec_list, all_nodes, ct, st, static, dyn,
+                with_networks=with_networks, with_dp=with_dp,
+                quantized=0 if quant is None else 1, t0=t0,
+                resident_info=resident_info)
+            if handle is not None:
+                return handle
+            # Slot-record budget exceeded (pathological count skew):
+            # degrade to the single-chip program below.
 
         sbuf, meta_s = xfer.pack_host(static)
         dbuf, meta_d = xfer.pack_host(dyn)
@@ -1114,14 +1127,36 @@ class TPUBatchScheduler:
             "resident": resident_info,
         }
 
+    def _quant_roundtrip_ok(self, ct, base, quant) -> bool:
+        """Quantized-rows round-trip bound, run once per static encode.
+        On a mesh the check runs PER SHARD SLICE — exactly the rows each
+        device will dequantize — so a corrupt codebook is attributed to
+        its owning shard before anything ships."""
+        if self.mesh is None:
+            return (resident.check_quant_roundtrip(
+                        ct.capacity, quant.cap_q, quant.scale,
+                        breaker=self.breaker, what="capacity")
+                    and resident.check_quant_roundtrip(
+                        base.used, quant.used_q, quant.scale,
+                        breaker=self.breaker, what="used baseline"))
+        d = self.mesh.devices.size
+        n_l = ct.n_pad // d
+        for s_i in range(d):
+            sl = slice(s_i * n_l, (s_i + 1) * n_l)
+            if not (resident.check_quant_roundtrip(
+                        ct.capacity[sl], quant.cap_q[sl], quant.scale,
+                        breaker=self.breaker,
+                        what=f"capacity shard {s_i}")
+                    and resident.check_quant_roundtrip(
+                        base.used[sl], quant.used_q[sl], quant.scale,
+                        breaker=self.breaker,
+                        what=f"used baseline shard {s_i}")):
+                return False
+        return True
+
     def _fetch_device(self, handle):
         """Blocking fetch + decode + shared post-processing of an
-        in-flight _dispatch_device handle."""
-        done = handle.get("done")
-        if done is not None:
-            expanded, unplaced, metrics, kstats = done
-            kstats.setdefault("resident", handle.get("resident") or {})
-            return expanded, unplaced, metrics, kstats
+        in-flight _dispatch_device / _dispatch_mesh handle."""
         spec_list = handle["spec_list"]
         all_nodes = handle["all_nodes"]
         ct, st = handle["ct"], handle["st"]
@@ -1260,99 +1295,110 @@ class TPUBatchScheduler:
         kstats["fetch_bytes"] = fetch_bytes + kstats.get("fetch_bytes", 0)
         kstats["fused"] = 1 if handle.get("fused_buf") is not None else 0
         kstats["quantized"] = handle.get("quantized", 0)
+        kstats["mesh_shards"] = handle.get("mesh_shards", 0)
         kstats["resident"] = handle.get("resident") or {}
         return expanded, unplaced, metrics, kstats
 
-    def _place_on_mesh(self, spec_list, all_nodes, ct, st, jc_entries,
-                       with_networks, t0):
-        """Node-sharded placement over this scheduler's own Mesh
-        (parallel/sharded.py sharded_placement_rounds): feasibility is
-        computed once, the multi-round capacity loop runs with the node
-        axis split across the mesh's devices (local top-k + ICI
-        all-gather per commit), and the shared post-processing consumes
-        the gathered placements.  Bit-identical semantics to the
-        single-chip kernel (pinned by tests/test_parallel.py); the
-        packed-buffer link optimizations of the single-chip path don't
-        apply — each shard holds only its node slice."""
+    def _node_pad_multiple(self) -> int:
+        """Node-axis pad multiple: 128 (TPU lane width), raised to the
+        least common multiple with the mesh size so a mesh scheduler's
+        shards always divide evenly (satellite: no silent single-chip
+        fallback on divisibility — pad rows are ineligible, hence
+        infeasible by construction)."""
+        import math
+
+        if self.mesh is None:
+            return 128
+        d = self.mesh.devices.size
+        return 128 * d // math.gcd(128, d)
+
+    def _dispatch_mesh(self, spec_list, all_nodes, ct, st, static, dyn,
+                       *, with_networks, with_dp, quantized, t0,
+                       resident_info):
+        """Node-sharded twin of the fused dispatch: the SAME static/dyn
+        tensor dicts, but the static pack is split into per-shard
+        buffers placed on their owning device (NamedSharding over the
+        node axis — a 1M-node cluster never materializes unsharded on
+        any device), the usage-delta scatter-adds land on the owning
+        shard inside the kernel, and the whole batch result — summary,
+        COO placements, slot-mode AllocMetric scores — comes back as the
+        same single packed buffer `_fetch_device` already decodes.  One
+        dispatch, one fetch, per batch; bit-identical placements and
+        scores to the single-chip program (k_cand ≥ max count ⇒ the
+        per-round global top-k lies inside the gathered local top-k
+        candidates — see parallel/sharded.py).
+
+        Returns None when the slot record would blow its budget
+        (pathological count skew): the caller degrades to the
+        single-chip program."""
         global MESH_PASSES
-        from ..parallel.sharded import (
-            DPTensors as SDPTensors,
-            NetTensors as SNetTensors,
-            sharded_placement_rounds,
-        )
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel import sharded as shmod
 
-        u_pad, n_pad = st.u_pad, ct.n_pad
-        jc = np.zeros((u_pad, n_pad), dtype=np.int32)
-        for (j, nidx), v in jc_entries.items():
-            jc[j, nidx] = v
-        with_dp = any(sp.dp_target is not None for sp in spec_list)
-        # The sharded kernel returns placements without per-commit score
-        # side-outputs, so AllocMetric.scores stay empty on this path even
-        # at scales where the single-chip path would populate them
-        # (u_pad*n_pad <= 16M).  Make the gap observable: a one-time log
-        # plus a pass counter the telemetry bridge exports.
-        global MESH_SCORE_GAP_PASSES, _mesh_score_gap_logged
-        if u_pad * n_pad <= 16_000_000:
-            MESH_SCORE_GAP_PASSES += 1
-            if not _mesh_score_gap_logged:
-                _mesh_score_gap_logged = True
-                self.logger.warning(
-                    "mesh scheduling drops per-node AllocMetric scores "
-                    "(%d x %d would carry them on the single-chip path); "
-                    "counts stay exact, score forensics are unavailable "
-                    "while a device_mesh is configured", u_pad, n_pad)
+        mesh = self.mesh
+        d = mesh.devices.size
+        n_l = ct.n_pad // d
+        max_count = max((sp.count for sp in spec_list), default=1)
+        total_asks = int(sum(sp.count for sp in spec_list))
+        # Slot-mode scores whenever the single-chip path would carry
+        # them (the score-gap gauge this path used to export is gone:
+        # no mesh pass drops scores anymore).  The threshold is taken
+        # at the SINGLE-CHIP pad (128), not the mesh's lcm(128, D)
+        # pad-up — otherwise a non-power-of-two mesh could cross the
+        # 16M boundary and drop scores exactly where the reference
+        # path still carries them.
+        n_pad_ref = max(128, encode.round_up(ct.n_real, 128))
+        with_scores = st.u_pad * n_pad_ref <= 16_000_000
+        slot_m = encode.pow2_bucket(max(8, max_count), minimum=8)
+        slot_bytes = 4 + (8 if with_scores else 0)
+        if st.u_pad * slot_m * slot_bytes > MESH_SLOT_BUDGET_BYTES:
+            self.logger.warning(
+                "mesh slot record %d x %d exceeds budget; batch takes "
+                "the single-chip path", st.u_pad, slot_m)
+            return None
+        max_nnz = encode.pow2_bucket(max(8, total_asks), minimum=8)
+        k_cand = min(n_l, encode.pow2_bucket(max(64, max_count)))
 
+        # Per-shard static packs: node-axis arrays sliced to the owning
+        # shard, the [4] scale codebook replicated into each (every
+        # shard dequantizes its own rows — the quant round-trip guard in
+        # _dispatch_device already verified each shard's slice).
+        sbuf, meta_s = xfer.pack_host_sharded(
+            static, d, replicate=("res_scale",))         # [D, B]
+        dbuf, meta_d = xfer.pack_host(dyn)
         encode_seconds = time.monotonic() - t0
         t1 = time.monotonic()
-        feas = kernels.feasibility_matrix(
-            jnp.asarray(ct.attr_values), jnp.asarray(ct.eligible),
-            jnp.asarray(ct.dc_code), jnp.asarray(st.constraint_attr),
-            jnp.asarray(st.constraint_op), jnp.asarray(st.constraint_rhs),
-            jnp.asarray(st.dc_mask), jnp.asarray(st.precomp))
-        net = None
-        if with_networks:
-            net = SNetTensors(
-                active=jnp.asarray(st.net_active),
-                mbits=jnp.asarray(st.net_mbits),
-                dyn_need=jnp.asarray(st.dyn_need),
-                resv_words=jnp.asarray(st.resv_words),
-                bw_cap=jnp.asarray(ct.bw_cap),
-                bw_used=jnp.asarray(ct.bw_used),
-                dyn_free=jnp.asarray(ct.dyn_free),
-                port_words=jnp.asarray(ct.port_words))
-        dp = None
-        if with_dp:
-            dp = SDPTensors(
-                col=jnp.asarray(st.dp_col),
-                active=jnp.asarray(st.dp_active),
-                used0=jnp.asarray(st.dp_used),
-                attr_values=jnp.asarray(ct.attr_values))
-        seed = (int.from_bytes(s.generate_uuid()[:8].encode(), "big")
-                & 0x7FFFFFFF)
-        result = sharded_placement_rounds(
-            self.mesh, feas,
-            jnp.asarray(ct.used.astype(np.int32)),
-            jnp.asarray(ct.capacity.astype(np.int32)),
-            jnp.asarray(ct.score_denom),
-            jnp.asarray(st.ask.astype(np.int32)),
-            jnp.asarray(st.count), jnp.asarray(st.penalty),
-            jnp.asarray(st.distinct_hosts), jnp.asarray(st.job_index),
-            jnp.asarray(jc), jax.random.PRNGKey(seed),
-            net=net, dp=dp)
-        placements = np.asarray(result.placements)
-        unplaced_arr = np.asarray(result.unplaced)
-        rounds = int(result.rounds)
-        feas_count = np.asarray(jnp.sum(feas, axis=1))
-        coo_rows, coo_cols = np.nonzero(placements)
-        coo_counts = placements[coo_rows, coo_cols]
-        coo_scores = np.zeros(len(coo_rows), dtype=np.float32)
-        coo_coll = np.zeros(len(coo_rows), dtype=np.int32)
+
+        import hashlib
+        digest = (hashlib.blake2b(sbuf.tobytes(),
+                                  digest_size=16).hexdigest(),
+                  meta_s, shmod._mesh_cache_key(mesh))
+        static_dev = _DEVICE_STATIC_CACHE.pop(digest, None)
+        if static_dev is None:
+            static_dev = jax.device_put(
+                sbuf, NamedSharding(mesh, P(shmod.NODE_AXIS)))
+        _DEVICE_STATIC_CACHE[digest] = static_dev  # LRU touch-on-hit
+        while len(_DEVICE_STATIC_CACHE) > 4:
+            _DEVICE_STATIC_CACHE.pop(next(iter(_DEVICE_STATIC_CACHE)))
+        dyn_dev = jax.device_put(dbuf, NamedSharding(mesh, P()))
+
+        fused_buf, aux, feas, fused_meta = shmod.sharded_fused_pass(
+            mesh, static_dev, dyn_dev, meta_s=meta_s, meta_d=meta_d,
+            u_pad=st.u_pad, n_pad=ct.n_pad, with_networks=with_networks,
+            with_dp=with_dp, with_scores=with_scores, max_nnz=max_nnz,
+            slot_m=slot_m, k_cand=k_cand)
         MESH_PASSES += 1
-        return self._finalize_device_outputs(
-            spec_list, all_nodes, ct, st, feas, unplaced_arr, feas_count,
-            coo_rows, coo_cols, coo_counts, coo_scores, coo_coll,
-            rounds, with_scores=False, encode_seconds=encode_seconds,
-            t1=t1)
+        return {
+            "spec_list": spec_list, "all_nodes": all_nodes, "ct": ct,
+            "st": st, "feas": feas, "summary_buf": None, "coo_mat": None,
+            "slot_m": slot_m, "fused_buf": fused_buf,
+            "fused_meta": fused_meta,
+            "fused_overflow": ("slots", aux),
+            "quantized": quantized, "mesh_shards": d,
+            "with_scores": with_scores, "max_nnz": max_nnz,
+            "encode_seconds": encode_seconds, "t1": t1,
+            "resident": resident_info,
+        }
 
     def _finalize_device_outputs(self, spec_list, all_nodes, ct, st, feas,
                                  unplaced_arr, feas_count, coo_rows,
@@ -2127,6 +2173,10 @@ class BatchStats:
         # codebook, exact by construction).
         self.fused = 0
         self.quantized = 0
+        # Mesh size when this batch ran the node-sharded fused program
+        # (parallel/sharded.sharded_fused_pass); 0 on the single-chip
+        # path.
+        self.mesh_shards = 0
         self.commit_seconds = 0.0
         # Host-side async-dispatch gap between the post-encode dispatch
         # point and the start of the blocking fetch (device compute
@@ -2180,6 +2230,8 @@ class BatchStats:
                 extra += f" fences={self.staleness_fences}"
         if self.pipeline_overlap_s:
             extra += f" overlap={self.pipeline_overlap_s:.3f}s"
+        if self.mesh_shards:
+            extra += f" mesh_shards={self.mesh_shards}"
         if self.device_ran:
             extra += (f" fused={self.fused} quantized={self.quantized} "
                       f"commit={self.commit_seconds:.3f}s "

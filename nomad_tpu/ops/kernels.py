@@ -734,6 +734,13 @@ def _slots_coo_gather(slots: jnp.ndarray, slot_scores: jnp.ndarray,
     north-star shape; a scatter formulation of the same thing measured
     0.26s (XLA CPU scatters are serial and bounds-checked).
 
+    Shared contract with the node-mesh program: the sharded fused pass
+    (parallel/sharded.sharded_fused_pass) builds the SAME commit-ordered
+    slot record (per-shard partials at globally disjoint positions,
+    merged by one psum) and runs this very expression on it, so the two
+    paths' COO payloads — and therefore placements and AllocMetric
+    scores — are byte-identical by construction.
+
     Entries are per-ALLOC (counts ≡ 1, so a node committed in two
     rounds appears twice), rows ascending by construction (per-spec
     contiguous slot prefixes in spec order), scores aligned with their

@@ -206,21 +206,30 @@ def _full_usage(base, rows_fn) -> Tuple[np.ndarray, set]:
 
 
 def acquire(state, cache_key: Tuple, base, rows_fn,
-            breaker=None) -> Tuple[np.ndarray, List[int], Dict]:
+            breaker=None, shards: int = 0
+            ) -> Tuple[np.ndarray, List[int], Dict]:
     """Produce the live usage matrix for this batch.
 
     ``state`` is the scheduler's snapshot, ``cache_key`` the residency
-    key ``(store_uid, nodes_table_index)`` — the usage matrix depends
-    only on the node set, NOT the batch's constraint vocabulary, so the
-    mirror survives vocabulary changes that re-key the static tensor
-    cache — ``base`` the finalized static ClusterTensors, ``rows_fn`` a
-    callable returning {node_id: [live alloc rows]} for the full-walk
-    fallback.
+    key ``(store_uid, nodes_table_index, n_pad)`` — the usage matrix
+    depends only on the node set (and pad geometry), NOT the batch's
+    constraint vocabulary, so the mirror survives vocabulary changes
+    that re-key the static tensor cache — ``base`` the finalized static
+    ClusterTensors, ``rows_fn`` a callable returning {node_id: [live
+    alloc rows]} for the full-walk fallback.
+
+    ``shards``: node-mesh size when the scheduler runs the sharded
+    path; the differential guard then bit-compares PER SHARD SLICE and
+    reports the offending shard ids alongside the breaker feed (the
+    mirror itself stays one host matrix — on device each shard holds
+    only its slice, so attribution is what operators need to map a
+    mismatch to hardware).
 
     Returns ``(used int64 [n_pad, 4], touched_rows sorted list, info)``
     where info carries the BatchStats counters:
     ``resident_hit``/``delta_rows``/``full_reencode``/``fence``/
-    ``guard_ran``/``guard_mismatch``.
+    ``guard_ran``/``guard_mismatch`` (+ ``guard_bad_shards`` on a
+    sharded mismatch).
     """
     global _STATE, HITS, FULL_REENCODES, STALENESS_FALLBACKS
     global GUARD_RUNS, GUARD_MISMATCHES
@@ -302,14 +311,26 @@ def acquire(state, cache_key: Tuple, base, rows_fn,
                     if not np.array_equal(used, ref_used):
                         GUARD_MISMATCHES += 1
                         info["guard_mismatch"] = True
-                        bad = int((used != ref_used).any(axis=1).sum())
+                        bad_rows = np.nonzero(
+                            (used != ref_used).any(axis=1))[0]
+                        bad = int(len(bad_rows))
+                        bad_shards: List[int] = []
+                        if shards > 0:
+                            n_l = max(1, used.shape[0] // shards)
+                            bad_shards = sorted(
+                                {int(r) // n_l for r in bad_rows})
+                            info["guard_bad_shards"] = bad_shards
                         logger.error(
                             "resident usage mirror diverged from full "
-                            "re-encode on %d node rows; invalidating and "
-                            "feeding the breaker", bad)
-                        tracing.event("resident.guard_mismatch", rows=bad)
+                            "re-encode on %d node rows%s; invalidating "
+                            "and feeding the breaker", bad,
+                            (f" (mesh shards {bad_shards})"
+                             if bad_shards else ""))
+                        tracing.event("resident.guard_mismatch", rows=bad,
+                                      shards=bad_shards)
                         _publish("guard_mismatch", Rows=bad,
-                                 AllocIndex=snap_index)
+                                 AllocIndex=snap_index,
+                                 Shards=bad_shards)
                         if breaker is not None:
                             breaker.record(False)
                         _STATE = None

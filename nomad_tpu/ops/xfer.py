@@ -91,6 +91,44 @@ def pack_host(arrays: Dict[str, np.ndarray]) -> Tuple[np.ndarray, Meta]:
     return buf, meta
 
 
+def pack_host_sharded(arrays: Dict[str, np.ndarray], shards: int,
+                      replicate: Tuple[str, ...] = ()
+                      ) -> Tuple[np.ndarray, Meta]:
+    """Per-shard packing for node-mesh uploads: every array is split
+    into ``shards`` equal slices along its leading axis — except the
+    ``replicate`` names, which are copied whole into every shard (e.g.
+    the [4] quantization scale codebook) — and each slice set packs
+    into one uint8 row of the returned [shards, B] buffer.  All rows
+    share the same layout by construction, so the single returned meta
+    describes every shard; placed with ``NamedSharding(mesh,
+    P(node_axis))`` each device receives exactly its slice and unpacks
+    it with the shared ``unpack_device``.
+    """
+    for name, arr in arrays.items():
+        if name not in replicate and arr.shape[0] % shards:
+            # A non-replicated array whose leading axis doesn't divide
+            # the mesh would be silently truncated into wrong slices —
+            # fail loudly instead (either pad the axis or list the
+            # array in ``replicate``).
+            raise ValueError(
+                f"pack_host_sharded: array {name!r} leading axis "
+                f"{arr.shape[0]} not divisible by {shards} shards")
+    rows: List[np.ndarray] = []
+    meta: Meta = ()
+    for s_i in range(shards):
+        sl: Dict[str, np.ndarray] = {}
+        for name, arr in arrays.items():
+            if name in replicate:
+                sl[name] = arr
+            else:
+                n_l = arr.shape[0] // shards
+                sl[name] = np.ascontiguousarray(
+                    arr[s_i * n_l:(s_i + 1) * n_l])
+        buf, meta = pack_host(sl)
+        rows.append(buf)
+    return np.stack(rows), meta
+
+
 def unpack_device(buf: jnp.ndarray, meta: Meta) -> Dict[str, jnp.ndarray]:
     """Slice + bitcast each array out of the packed device buffer.
 

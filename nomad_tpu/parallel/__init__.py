@@ -5,6 +5,7 @@ from .sharded import (
     NODE_AXIS,
     make_node_mesh,
     sharded_candidate_scores,
+    sharded_fused_pass,
     sharded_placement_rounds,
     sharded_schedule_step,
 )

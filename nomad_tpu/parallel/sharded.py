@@ -399,6 +399,332 @@ def sharded_placement_rounds(
         used_after=used_after, rounds=rounds)
 
 
+# -- fused single-dispatch mesh pass (ISSUE 8 tentpole) ---------------------
+#
+# The multi-device twin of ops/kernels.fused_pass: ONE device dispatch
+# over node-sharded packed static buffers + a replicated dynamic buffer
+# runs unpack (+ dequantize) → per-shard usage-delta scatter-adds →
+# per-shard feasibility → the local-top-k + ICI-all-gather capacity-
+# feedback commit loop → a commit-ordered slot record → slot→COO gather
+# → ONE packed result buffer (replicated, fetched from one device).
+#
+# Exactness: per round a spec commits at most ``remaining ≤ count``
+# allocs, so with ``k_cand ≥ max(count)`` (or k_cand == the whole shard)
+# the global top-``remaining`` of any round lies inside the gathered
+# local top-k_cand candidates — the selection, tie-jitter (keyed on
+# GLOBAL node index) and commit order are bit-identical to the
+# single-chip kernel.  batch_sched sizes k_cand that way, so the mesh
+# path is exact by construction, not within a budget.
+#
+# Slot-record merge: each shard records ITS OWN committed nodes at their
+# global commit positions (per-commit position = allocs placed so far +
+# lower-shard count prefix + within-shard ascending-node rank — the
+# single-chip kernel's ascending-node commit order), encoded as
+# ``global_index + 1`` with 0 for empty, so positions are disjoint
+# across shards and ONE end-of-loop psum produces the replicated
+# [U, M] record the COO gather (ops/kernels._slots_coo_gather, the very
+# same expression the single-chip fused program uses) consumes.
+
+# Compiled sharded-fused programs keyed by (mesh devices, metas, static
+# shape/flags): the production hot loop must not re-trace per batch the
+# way the legacy eager shard_map side path did.
+_FUSED_MESH_CACHE = {}
+
+
+def _mesh_cache_key(mesh) -> Tuple:
+    return tuple(d.id for d in mesh.devices.flat)
+
+
+def sharded_fused_pass(
+    mesh: Mesh,
+    static_shards,          # [D, B] uint8 — NamedSharding P(NODE_AXIS)
+    dyn_buf,                # [Bd] uint8 — replicated
+    *,
+    meta_s,                 # PER-SHARD static layout (n_l-row shapes)
+    meta_d,
+    u_pad: int,
+    n_pad: int,
+    with_networks: bool,
+    with_dp: bool,
+    with_scores: bool,
+    max_nnz: int,
+    slot_m: int,
+    k_cand: int,
+    max_rounds: int = 256,
+):
+    """Fused node-sharded score-and-commit: returns
+    ``(packed result buffer, (slots, slot_scores, slot_coll), feas,
+    result layout meta)`` exactly like ops/kernels.fused_pass — the
+    caller's fetch/decode/forensics paths are shared with the
+    single-chip program.  ``slots``/scores are replicated [U, M]
+    (overflow source); ``feas`` stays node-sharded [U, n_pad]."""
+    from ..ops.kernels import fused_layout, fused_window
+
+    d = mesh.devices.size
+    assert n_pad % d == 0, f"mesh size {d} must divide node pad {n_pad}"
+    assert slot_m > 0, "the fused mesh pass requires a slot record"
+    k_cand = min(k_cand, n_pad // d)
+    compact_u16 = (not with_scores and u_pad <= 65536
+                   and n_pad <= 65536 and max_rounds < 65536)
+    window_nnz = fused_window(max_nnz, with_scores=with_scores,
+                              compact_u16=compact_u16)
+    meta = fused_layout(u_pad, window_nnz=window_nnz,
+                        with_scores=with_scores, compact_u16=compact_u16)
+    key = (_mesh_cache_key(mesh), meta_s, meta_d, u_pad, n_pad,
+           with_networks, with_dp, with_scores, slot_m, k_cand,
+           max_rounds, window_nnz, compact_u16)
+    fn = _FUSED_MESH_CACHE.get(key)
+    if fn is None:
+        fn = _build_fused_mesh_fn(
+            mesh, meta_s=meta_s, meta_d=meta_d, u_pad=u_pad, n_pad=n_pad,
+            with_networks=with_networks, with_dp=with_dp,
+            with_scores=with_scores, slot_m=slot_m, k_cand=k_cand,
+            max_rounds=max_rounds, window_nnz=window_nnz,
+            compact_u16=compact_u16)
+        _FUSED_MESH_CACHE[key] = fn
+        while len(_FUSED_MESH_CACHE) > 16:
+            _FUSED_MESH_CACHE.pop(next(iter(_FUSED_MESH_CACHE)))
+    buf, slots, sscores, scoll, feas = fn(static_shards, dyn_buf)
+    return buf, (slots, sscores, scoll), feas, meta
+
+
+def _build_fused_mesh_fn(mesh, *, meta_s, meta_d, u_pad, n_pad,
+                         with_networks, with_dp, with_scores, slot_m,
+                         k_cand, max_rounds, window_nnz, compact_u16):
+    from ..ops import xfer
+    from ..ops.kernels import (
+        _score_fit as score_fit,
+        _slots_coo_gather,
+        feasibility_matrix,
+    )
+
+    d = mesh.devices.size
+    n_l = n_pad // d
+    c_total = k_cand * d
+    big_idx = jnp.int32(n_pad + 1)
+
+    @functools.partial(
+        _shard_map,
+        mesh=mesh,
+        in_specs=(P(NODE_AXIS), P()),
+        out_specs=(P(), P(), P(), P(), P(None, NODE_AXIS)),
+        **(_SMAP_CHECK_OFF if _SMAP_LEGACY else {}),
+    )
+    def _run(sbuf_l, dyn):
+        ds = xfer.unpack_device(sbuf_l.reshape(-1), meta_s)
+        dd = xfer.unpack_device(dyn, meta_d)
+        # Quantized resource rows: one exact integer multiply per shard
+        # (the device twin of encode.dequantize_rows).
+        if "res_scale" in ds:
+            scale = ds.pop("res_scale")[None, :]
+            ds["cap"] = ds.pop("cap_q").astype(jnp.int32) * scale
+            ds["used_base"] = ds.pop("used_base_q").astype(jnp.int32) * scale
+        # Same materialization barrier as the single-chip program: keep
+        # the packed-buffer decode out of the while/scan body.
+        ds = dict(zip(ds.keys(),
+                      lax.optimization_barrier(tuple(ds.values()))))
+        dd = dict(zip(dd.keys(),
+                      lax.optimization_barrier(tuple(dd.values()))))
+        shard = lax.axis_index(NODE_AXIS)
+        gidx = shard * n_l + jnp.arange(n_l, dtype=jnp.int32)
+
+        # Usage deltas carry GLOBAL node rows; each shard applies only
+        # the rows it owns (the owning-shard scatter-add).
+        lrow = dd["u_rows"] - shard * n_l
+        uvalid = (dd["u_rows"] >= 0) & (lrow >= 0) & (lrow < n_l)
+        uidx = jnp.where(uvalid, lrow, jnp.int32(n_l))
+        used0 = ds["used_base"].at[uidx].add(dd["u_vals"], mode="drop")
+
+        # Per-(job, node) counts, local scatter of the global sparse set.
+        jrow = jnp.clip(dd["jc_rows"], 0, u_pad - 1)
+        jcol = dd["jc_cols"] - shard * n_l
+        jvalid = (dd["jc_rows"] >= 0) & (jcol >= 0) & (jcol < n_l)
+        jcol = jnp.where(jvalid, jcol, jnp.int32(n_l))
+        jc0 = jnp.zeros((u_pad, n_l), dtype=jnp.int32).at[jrow, jcol].add(
+            jnp.where(jvalid, dd["jc_vals"], 0), mode="drop")
+
+        precomp = dd["precomp"]
+        if precomp.shape != (1, 1):
+            precomp = lax.dynamic_slice(
+                precomp, (jnp.int32(0), shard * n_l), (u_pad, n_l))
+        feas_l = feasibility_matrix(
+            ds["attr"], ds["elig"], ds["dc"], dd["c_attr"], dd["c_op"],
+            dd["c_rhs"], dd["dc_mask"], precomp)
+
+        if with_networks:
+            bw_used0 = ds["bw_used_base"].at[uidx].add(
+                dd["u_bw"], mode="drop")
+            dyn_free0 = ds["dyn_free_base"].at[uidx].add(
+                dd["u_dyn"], mode="drop")
+            port_words0 = ds["port_words_base"].at[uidx].set(
+                dd["u_ports"], mode="drop")
+        else:
+            bw_used0 = jnp.zeros(n_l, dtype=jnp.int32)
+            dyn_free0 = jnp.zeros(n_l, dtype=jnp.int32)
+            port_words0 = jnp.zeros((n_l, 1), dtype=jnp.uint32)
+        if with_dp:
+            dp_used_init = dd["dp_used"]
+            v_pad = dp_used_init.shape[1]
+        else:
+            dp_used_init = jnp.zeros((1, 1), dtype=bool)
+            v_pad = 1
+
+        cap_l = ds["cap"]
+        denom_l = ds["denom"]
+        ask_r = dd["ask"]
+        count_r = dd["count"]
+        key = jax.random.PRNGKey(dd["rng_seed"][0])
+        jit_seed_r = jitter_seed(key)
+        d_arange = jnp.arange(d, dtype=jnp.int32)
+
+        def place_one_spec(carry, u):
+            (used, jc, remaining, bw_used, port_words, dyn_free, dp_used,
+             slots, sscores, scoll) = carry
+            cap_left = cap_l - used
+            fits = jnp.all(ask_r[u][None, :] <= cap_left, axis=1)
+            collisions = jc[dd["ji"][u]]
+            ok = feas_l[u] & fits
+            ok = ok & jnp.where(dd["dh"][u], collisions == 0, True)
+
+            if with_networks:
+                bw_ok = bw_used + dd["net_mbits"][u] <= ds["bw_cap"]
+                resv_hit = jnp.any(
+                    (port_words & dd["resv_words"][u][None, :]) != 0,
+                    axis=1)
+                dyn_ok = dyn_free >= dd["dyn_need"][u]
+                ok = ok & jnp.where(dd["net_active"][u],
+                                    bw_ok & ~resv_hit & dyn_ok, True)
+            if with_dp:
+                col = jnp.clip(dd["dp_col"][u], 0, ds["attr"].shape[1] - 1)
+                codes = ds["attr"][:, col]
+                code_c = jnp.clip(codes, 0, v_pad - 1)
+                dp_ok = (codes != MISSING) & ~dp_used[u, code_c]
+                ok = ok & jnp.where(dd["dp_active"][u], dp_ok, True)
+
+            base_score = score_fit(used, ask_r[u], denom_l)
+            score = (base_score
+                     - dd["penalty"][u] * collisions.astype(jnp.float32))
+            score = score + tie_jitter(jit_seed_r, u, gidx)
+            scored = jnp.where(ok, score, NEG_INF)
+
+            # Local top-k_cand → ICI all-gather → global top-k select
+            # (identical to sharded_placement_rounds; exact because
+            # k ≤ remaining ≤ count ≤ k_cand).
+            loc_scores, loc_idx = lax.top_k(scored, k_cand)
+            all_scores = lax.all_gather(loc_scores, NODE_AXIS, tiled=True)
+            n_ok = lax.psum(jnp.sum(ok.astype(jnp.int32)), NODE_AXIS)
+            k = jnp.minimum(remaining[u], n_ok)
+            order = jnp.argsort(-all_scores)
+            ranks = jnp.zeros(c_total, dtype=jnp.int32).at[order].set(
+                jnp.arange(c_total, dtype=jnp.int32))
+            sel_cand = (all_scores > NEG_INF / 2) & (ranks < k)
+            my_sel = lax.dynamic_slice(
+                sel_cand, (shard * k_cand,), (k_cand,))
+            sel = jnp.zeros(n_l, dtype=bool).at[loc_idx].set(my_sel) & ok
+
+            if with_dp:
+                sel_score = jnp.where(sel, scored, jnp.float32(NEG_INF))
+                best_l = jnp.full(v_pad, NEG_INF, dtype=jnp.float32
+                                  ).at[code_c].max(sel_score)
+                best_g = lax.pmax(best_l, NODE_AXIS)
+                cand_dp = sel & (sel_score >= best_g[code_c])
+                idx_l = jnp.full(v_pad, big_idx, dtype=jnp.int32
+                                 ).at[code_c].min(
+                    jnp.where(cand_dp, gidx, big_idx))
+                idx_g = lax.pmin(idx_l, NODE_AXIS)
+                keep = cand_dp & (gidx == idx_g[code_c])
+                sel = jnp.where(dd["dp_active"][u], keep, sel)
+
+            sel_i = sel.astype(jnp.int32)
+            placed_l = jnp.sum(sel_i)
+            counts_g = lax.all_gather(placed_l, NODE_AXIS)      # [D]
+            placed = jnp.sum(counts_g)
+            # Global commit positions in the single-chip kernel's
+            # ascending-node order: allocs placed so far + lower-shard
+            # prefix + within-shard ascending-node rank.
+            prefix = jnp.sum(jnp.where(d_arange < shard, counts_g, 0))
+            offset = count_r[u] - remaining[u]
+            pos_l = jnp.cumsum(sel_i)
+            dest = jnp.where(sel, offset + prefix + pos_l - 1,
+                             jnp.int32(slot_m))
+            slots = slots.at[u, dest].set(gidx + 1, mode="drop")
+            if with_scores:
+                sscores = sscores.at[u, dest].set(base_score, mode="drop")
+                scoll = scoll.at[u, dest].set(collisions, mode="drop")
+
+            used = used + sel_i[:, None] * ask_r[u][None, :]
+            jc = jc.at[dd["ji"][u]].add(sel_i)
+            remaining = remaining.at[u].add(-placed)
+            if with_networks:
+                commit_net = dd["net_active"][u]
+                bw_used = bw_used + jnp.where(
+                    commit_net, sel_i * dd["net_mbits"][u], 0)
+                port_words = jnp.where(
+                    (commit_net & sel)[:, None],
+                    port_words | dd["resv_words"][u][None, :], port_words)
+                dyn_free = dyn_free - jnp.where(
+                    commit_net, sel_i * dd["dyn_need"][u], 0)
+            if with_dp:
+                dp_upd_l = jnp.zeros(v_pad, dtype=bool).at[code_c].max(
+                    sel & dd["dp_active"][u])
+                dp_upd = lax.psum(
+                    dp_upd_l.astype(jnp.int32), NODE_AXIS) > 0
+                dp_used = dp_used.at[u].set(dp_used[u] | dp_upd)
+            return (used, jc, remaining, bw_used, port_words, dyn_free,
+                    dp_used, slots, sscores, scoll), placed
+
+        def round_body(state):
+            (used, jc, remaining, bw_used, port_words, dyn_free, dp_used,
+             slots, sscores, scoll, _, rounds) = state
+            carry, placed = lax.scan(
+                place_one_spec,
+                (used, jc, remaining, bw_used, port_words, dyn_free,
+                 dp_used, slots, sscores, scoll),
+                jnp.arange(u_pad))
+            (used, jc, remaining, bw_used, port_words, dyn_free, dp_used,
+             slots, sscores, scoll) = carry
+            return (used, jc, remaining, bw_used, port_words, dyn_free,
+                    dp_used, slots, sscores, scoll, jnp.sum(placed),
+                    rounds + 1)
+
+        def round_cond(state):
+            remaining = state[2]
+            progress = state[10]
+            rounds = state[11]
+            return ((progress > 0) & (jnp.sum(remaining) > 0)
+                    & (rounds < max_rounds))
+
+        sscore_shape = (u_pad, slot_m) if with_scores else (1, 1)
+        state = (used0, jc0, count_r,
+                 bw_used0, port_words0, dyn_free0, dp_used_init,
+                 _mark_varying(jnp.zeros((u_pad, slot_m), dtype=jnp.int32)),
+                 _mark_varying(jnp.zeros(sscore_shape, dtype=jnp.float32)),
+                 _mark_varying(jnp.zeros(sscore_shape, dtype=jnp.int32)),
+                 jnp.array(1, dtype=jnp.int32), jnp.array(0, dtype=jnp.int32))
+        (used, jc, remaining, _bw, _pw, _df, _dpu, slots_p, sscores_p,
+         scoll_p, _, rounds) = lax.while_loop(round_cond, round_body, state)
+
+        # Disjoint per-shard partials → ONE psum yields the replicated
+        # commit-ordered record; +1/-1 encoding keeps empty slots at -1.
+        slots_full = lax.psum(slots_p, NODE_AXIS) - 1
+        sscores_full = lax.psum(sscores_p, NODE_AXIS)
+        scoll_full = lax.psum(scoll_p, NODE_AXIS)
+        coo_win, nnz = _slots_coo_gather(
+            slots_full, sscores_full, scoll_full, out_rows=window_nnz,
+            with_scores=with_scores, compact_u16=compact_u16)
+        feas_count = lax.psum(
+            jnp.sum(feas_l.astype(jnp.int32), axis=1), NODE_AXIS)
+        buf, _ = xfer.pack_device({
+            "unplaced": remaining,
+            "feas_count": feas_count,
+            "scalars": jnp.stack([nnz, rounds]).astype(jnp.int32),
+            "coo": coo_win,
+        })
+        return buf, slots_full, sscores_full, scoll_full, feas_l
+
+    return jax.jit(_run)
+
+
 def sharded_schedule_step(
     mesh: Mesh,
     feas: jax.Array,

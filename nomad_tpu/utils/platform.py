@@ -6,3 +6,31 @@ def is_tpu_platform(platform: str) -> bool:
     this environment registers through the experimental 'axon' PJRT
     plugin rather than as 'tpu'; both compile through Mosaic."""
     return platform in ("tpu", "axon")
+
+
+def virtual_mesh_env(n_devices: int, base_env=None) -> dict:
+    """Subprocess environment that provisions an ``n_devices`` virtual
+    CPU mesh: any pre-existing forced-device-count flag is stripped
+    from XLA_FLAGS (it may be lower than needed), exactly ``n_devices``
+    is pinned, and the platform is forced to CPU.  XLA reads the flag
+    at backend init, so this only works for a FRESH interpreter — the
+    one shared recipe behind the selfcheck mesh drill, bench
+    config_mesh, and the driver dryrun (tests/conftest.py inlines a
+    variant because it must run before any import).
+
+    Note: environments that pre-import jax pin the platform at
+    interpreter startup; the child must still call
+    ``jax.config.update('jax_platforms', 'cpu')`` (see
+    __graft_entry__).
+    """
+    import os
+    import re
+
+    env = dict(base_env if base_env is not None else os.environ)
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (flags.strip() +
+                        f" --xla_force_host_platform_device_count="
+                        f"{n_devices}").strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
