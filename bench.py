@@ -1152,6 +1152,34 @@ def _mesh_child_main() -> int:
             mem = sum(t.resources.memory_mb for t in tg.tasks)
             ask_by_key[(j.id, tg.name)] = (cpu, mem)
 
+    # Static-encode A/B at the full node count (ISSUE 9): the columnar
+    # slice vs the object walk, guard suppressed so each side is timed
+    # pure.  This is the host cost the columnar state store removes
+    # from every cold encode at this scale.
+    from nomad_tpu.ops import encode as _enc
+    guard_prev = os.environ.get("NOMAD_TPU_COLUMNAR_GUARD_EVERY")
+    os.environ["NOMAD_TPU_COLUMNAR_GUARD_EVERY"] = "0"
+    try:
+        enc_nodes = snap.nodes(None)
+        t = time.monotonic()
+        ct_col = _enc.build_cluster_static(snap, enc_nodes, [], {})
+        encode_columnar_s = time.monotonic() - t
+        t = time.monotonic()
+        ct_walk = _enc.encode_cluster_static(enc_nodes, [])
+        _enc.finalize_codebooks(ct_walk, {})
+        encode_walk_s = time.monotonic() - t
+        encode_exact = not _enc._static_mismatch(ct_col, ct_walk)
+        del ct_col, ct_walk
+    finally:
+        if guard_prev is None:
+            os.environ.pop("NOMAD_TPU_COLUMNAR_GUARD_EVERY", None)
+        else:
+            os.environ["NOMAD_TPU_COLUMNAR_GUARD_EVERY"] = guard_prev
+    log(f"config-mesh: static encode {n_nodes} nodes — columnar "
+        f"{encode_columnar_s:.2f}s vs object walk {encode_walk_s:.2f}s "
+        f"({encode_walk_s / max(encode_columnar_s, 1e-9):.1f}x, "
+        f"bit_identical={encode_exact})")
+
     def run(use_mesh):
         rec = RecordingPlanner()
         sched = TPUBatchScheduler(h.logger, snap, rec,
@@ -1195,6 +1223,21 @@ def _mesh_child_main() -> int:
         "fetch_bytes": mesh_stats.fetch_bytes,
         "quantized": mesh_stats.quantized,
         "resident_hits": mesh_stats.resident_hits,
+        "encode_s": round(mesh_stats.encode_seconds, 3),
+        # Host-vs-device split (ISSUE 9): at 1M nodes the residual cost
+        # is the HOST — encode (columnar slice vs object walk) and
+        # finalize (plan materialization) — so the split is what the
+        # --check encode guard reads.
+        "time_split": {
+            "phase1_s": round(mesh_stats.phase1_seconds, 3),
+            "phase2_s": round(mesh_stats.phase2_seconds, 3),
+            "encode_s": round(mesh_stats.encode_seconds, 3),
+            "dispatch_s": round(mesh_stats.dispatch_seconds, 3),
+            "commit_s": round(mesh_stats.commit_seconds, 3),
+            "fetch_s": round(mesh_stats.fetch_seconds, 3),
+            "metrics_s": round(mesh_stats.metrics_seconds, 3),
+            "finalize_s": round(mesh_stats.finalize_seconds, 3),
+        },
         "single_chip": {
             "elapsed_s": round(single_s, 3),
             "placed": sum(len(v) for v in single_pl.values()),
@@ -1203,6 +1246,11 @@ def _mesh_child_main() -> int:
         },
         "bit_identical_placements": bit_identical,
         "score_delta_pct": round(delta_pct, 4),
+        "static_encode_columnar_s": round(encode_columnar_s, 3),
+        "static_encode_walk_s": round(encode_walk_s, 3),
+        "static_encode_speedup": round(
+            encode_walk_s / max(encode_columnar_s, 1e-9), 1),
+        "static_encode_bit_identical": encode_exact,
         "platform": str(jax.devices()[0].platform),
         "note": ("8-way VIRTUAL mesh on one CPU host: shards execute "
                  "serially and collectives are memcpys, so wall time "
@@ -1213,6 +1261,80 @@ def _mesh_child_main() -> int:
     }
     print(json.dumps(out), flush=True)
     return 0 if bit_identical else 1
+
+
+def bench_snapshot(legacy: bool = True) -> dict:
+    """config_snapshot (ISSUE 9): FSM snapshot+restore wall time through
+    the v2 columnar binary format, vs the legacy per-object msgpack path
+    on the SAME store.  The compare shape is sized so the legacy side
+    stays affordable (it was measured at ~75s/side on 100k nodes); the
+    columnar side additionally runs at a larger shape for the absolute
+    restore-time record.  ``--check`` re-measures the columnar side only
+    and guards it against the latest BENCH_r*.json."""
+    from nomad_tpu import mock
+    from nomad_tpu.state.state_store import StateStore
+    from nomad_tpu.structs import structs as s
+
+    n_nodes = int(os.environ.get("NOMAD_TPU_BENCH_SNAP_NODES", "50000"))
+    n_allocs = int(os.environ.get("NOMAD_TPU_BENCH_SNAP_ALLOCS", "250000"))
+
+    def build(n, m):
+        st = StateStore()
+        proto_node = mock.node()
+        proto_node.resources.networks = []
+        proto_node.reserved.networks = []
+        proto_node.compute_class()
+        for i in range(n):
+            node = s._fast_copy(proto_node)
+            node.id = f"bench-node-{i:07d}"
+            node.name = f"n{i}"
+            st.upsert_node(i + 1, node)
+        proto = mock.alloc()
+        proto.resources = s.Resources(cpu=100, memory_mb=128, disk_mb=300)
+        st.upsert_slabs(n + 2, [s.AllocSlab(
+            proto=proto, ids=s.LazyUuids(m),
+            names=s.LazyNames(m, "bench.tg"),
+            node_ids=[f"bench-node-{i % n:07d}" for i in range(m)],
+            prev_ids=[])])
+        return st
+
+    def measure(st, flag):
+        prev = os.environ.get("NOMAD_TPU_COLUMNAR")
+        os.environ["NOMAD_TPU_COLUMNAR"] = flag
+        try:
+            t = time.monotonic()
+            blob = st.persist()
+            persist_s = time.monotonic() - t
+            t = time.monotonic()
+            restored = StateStore.restore(blob)
+            restore_s = time.monotonic() - t
+            assert len(restored.nodes_table) == len(st.nodes_table)
+            return {"persist_s": round(persist_s, 2),
+                    "restore_s": round(restore_s, 2),
+                    "total_s": round(persist_s + restore_s, 2),
+                    "bytes": len(blob)}
+        finally:
+            if prev is None:
+                os.environ.pop("NOMAD_TPU_COLUMNAR", None)
+            else:
+                os.environ["NOMAD_TPU_COLUMNAR"] = prev
+
+    st = build(n_nodes, n_allocs)
+    col = measure(st, "1")
+    out = {"nodes": n_nodes, "allocs": n_allocs, "columnar": col,
+           "snapshot_restore_s": col["total_s"]}
+    log(f"config-snapshot: columnar persist {col['persist_s']}s + "
+        f"restore {col['restore_s']}s ({col['bytes'] >> 20}MB) at "
+        f"{n_nodes} nodes x {n_allocs} allocs")
+    if legacy:
+        leg = measure(st, "0")
+        out["legacy_msgpack"] = leg
+        out["speedup_vs_legacy"] = round(
+            leg["total_s"] / max(col["total_s"], 1e-9), 1)
+        log(f"config-snapshot: legacy msgpack {leg['persist_s']}s + "
+            f"{leg['restore_s']}s ({leg['bytes'] >> 20}MB) → columnar "
+            f"{out['speedup_vs_legacy']}x faster")
+    return out
 
 
 def bench_mesh(deadline_s: int = 900, scale=None) -> dict:
@@ -1503,6 +1625,12 @@ def _child_main():
     if sdy is not None:
         detail["config_steady"] = sdy
 
+    # FSM snapshot+restore (ISSUE 9): the v2 columnar binary format vs
+    # the legacy per-object msgpack path on the same store.
+    snap_ph = phase("config_snapshot", 300, bench_snapshot)
+    if snap_ph is not None:
+        detail["config_snapshot"] = snap_ph
+
     # The ROADMAP scale axis (ISSUE 8): 1M nodes x 10M tgs through the
     # fused node-sharded path in its own forced-8-device subprocess.
     # Runs LAST on whatever budget remains — the subprocess is outside
@@ -1595,6 +1723,7 @@ def _extract_baseline_numbers(doc: dict):
     import re
 
     ns = p95 = ce = steady = cf = ctl = ctl_p99 = mesh_rate = None
+    mesh_encode = snap_s = None
     parsed = doc.get("parsed")
     if isinstance(parsed, dict):
         det = parsed.get("detail") or parsed
@@ -1611,6 +1740,10 @@ def _extract_baseline_numbers(doc: dict):
                    or {}).get("submit_to_running_p99_ms")
         mesh_rate = (det.get("config_mesh")
                      or {}).get("sustained_placed_per_s")
+        mesh_encode = (det.get("config_mesh")
+                       or {}).get("static_encode_columnar_s")
+        snap_s = (det.get("config_snapshot") or {}).get(
+            "snapshot_restore_s")
     tail = doc.get("tail") or ""
     if ns is None:
         m = re.search(r'"config_northstar_10k_x_1m":\s*\{[^{}]*?'
@@ -1647,14 +1780,28 @@ def _extract_baseline_numbers(doc: dict):
         m = re.search(r'"config_mesh":\s*\{[^{}]*?'
                       r'"sustained_placed_per_s":\s*([0-9.]+)', tail)
         mesh_rate = float(m.group(1)) if m else None
-    return ns, p95, ce, steady, cf, ctl, ctl_p99, mesh_rate
+    if mesh_encode is None:
+        m = re.search(r'"config_mesh":.*?'
+                      r'"static_encode_columnar_s":\s*([0-9.]+)', tail,
+                      re.DOTALL)
+        mesh_encode = float(m.group(1)) if m else None
+    if snap_s is None:
+        # snapshot_restore_s sits after the nested columnar dict: same
+        # non-greedy cross-brace idiom as commit_fetch_s above.
+        m = re.search(r'"config_snapshot":.*?'
+                      r'"snapshot_restore_s":\s*([0-9.]+)', tail,
+                      re.DOTALL)
+        snap_s = float(m.group(1)) if m else None
+    return (ns, p95, ce, steady, cf, ctl, ctl_p99, mesh_rate,
+            mesh_encode, snap_s)
 
 
 def _latest_bench_baseline():
     """Newest BENCH_r*.json with parseable numbers →
     (name, ns_s, p95_ms, config_e_s, steady_placed_per_s,
     northstar_commit_fetch_s, control_evals_per_s,
-    control_s2r_p99_ms, mesh_placed_per_s)."""
+    control_s2r_p99_ms, mesh_placed_per_s, mesh_encode_s,
+    snapshot_restore_s)."""
     import glob
 
     here = os.path.dirname(os.path.abspath(__file__))
@@ -1668,7 +1815,7 @@ def _latest_bench_baseline():
         nums = _extract_baseline_numbers(doc)
         if any(v is not None for v in nums):
             return (os.path.basename(path),) + nums
-    return (None,) * 9
+    return (None,) * 11
 
 
 CHECK_THRESHOLD_DEFAULT = 1.5
@@ -1697,7 +1844,8 @@ def _check_main(argv) -> int:
             "NOMAD_TPU_BENCH_CHECK_THRESHOLD", 0) or CHECK_THRESHOLD_DEFAULT)
 
     (baseline_file, base_ns, base_p95, base_ce, base_steady, base_cf,
-     base_ctl, base_ctl_p99, base_mesh) = _latest_bench_baseline()
+     base_ctl, base_ctl_p99, base_mesh, base_mesh_enc,
+     base_snap) = _latest_bench_baseline()
     out = {"check": "bench-regression", "baseline": baseline_file,
            "threshold": threshold}
     if baseline_file is None:
@@ -1851,6 +1999,27 @@ def _check_main(argv) -> int:
         out["control_plane_evals_per_s"] = {"error": repr(exc)}
         failures.append(f"control-plane phase failed: {exc!r}")
 
+    # FSM snapshot+restore guard (ISSUE 9): the columnar persist+restore
+    # wall time must not regress past threshold x baseline.  Measured
+    # fresh even when the baseline predates the metric (this run's BENCH
+    # file carries it forward); the legacy-msgpack comparison lives in
+    # the recorded trajectory runs, not here (it is ~25x slower).
+    try:
+        with _deadline(180, "check_config_snapshot"):
+            snp = bench_snapshot(legacy=False)
+        cur_snap = float(snp["snapshot_restore_s"])
+        out["snapshot_restore_s"] = {
+            "baseline": base_snap, "current": cur_snap,
+            "ratio": (round(cur_snap / base_snap, 3)
+                      if base_snap else None)}
+        if base_snap is not None and cur_snap > base_snap * threshold:
+            failures.append(
+                f"FSM snapshot+restore {cur_snap:.2f}s exceeds "
+                f"{threshold}x baseline {base_snap:.2f}s")
+    except Exception as exc:
+        out["snapshot_restore_s"] = {"error": repr(exc)}
+        failures.append(f"config_snapshot phase failed: {exc!r}")
+
     # Node-mesh scale axis (ISSUE 8): 1M nodes x 10M tgs through the
     # fused sharded path in its own forced-8-device subprocess.  The
     # score delta vs the single-chip program at the same pinned seed
@@ -1876,6 +2045,32 @@ def _check_main(argv) -> int:
             failures.append(
                 f"config_mesh sustained {cur_rate:.0f} placed/s is "
                 f"below baseline {base_mesh:.0f}/{threshold}")
+        # Columnar encode guard (ISSUE 9): the in-child A/B measures
+        # both sides at the full node count, so the >=3x-vs-walk floor
+        # needs no baseline; the absolute columnar seconds additionally
+        # guard against the latest BENCH_r*.json once one carries it.
+        cur_enc = cm.get("static_encode_columnar_s")
+        if cur_enc is not None:
+            out["config_mesh_encode_s"] = {
+                "baseline": base_mesh_enc, "current": cur_enc,
+                "walk_s": cm.get("static_encode_walk_s"),
+                "speedup_vs_walk": cm.get("static_encode_speedup"),
+                "ratio": (round(cur_enc / base_mesh_enc, 3)
+                          if base_mesh_enc else None)}
+            if not cm.get("static_encode_bit_identical", True):
+                failures.append(
+                    "config_mesh columnar static encode diverged from "
+                    "the object walk")
+            if cm.get("static_encode_speedup", 0) < 3.0:
+                failures.append(
+                    f"config_mesh columnar encode "
+                    f"{cur_enc:.2f}s is under 3x faster than the walk "
+                    f"({cm.get('static_encode_walk_s')}s)")
+            if (base_mesh_enc is not None
+                    and cur_enc > base_mesh_enc * threshold):
+                failures.append(
+                    f"config_mesh encode {cur_enc:.2f}s exceeds "
+                    f"{threshold}x baseline {base_mesh_enc:.2f}s")
     except Exception as exc:
         out["config_mesh_placed_per_s"] = {"error": repr(exc)}
         failures.append(f"config_mesh phase failed: {exc!r}")

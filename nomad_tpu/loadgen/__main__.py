@@ -35,6 +35,12 @@ def main(argv=None) -> int:
     p.add_argument("--compare-workers", default="",
                    help="comma list, e.g. 1,4: run per worker count and "
                         "report the speedup")
+    p.add_argument("--wal", action="store_true",
+                   help="durable raft log (FileLog + native group-commit "
+                        "WAL): plan applies pay real fsyncs")
+    p.add_argument("--compare-wal", action="store_true",
+                   help="run WAL-off then WAL-on and report the "
+                        "plan-apply durability cost")
     p.add_argument("--out", default="", help="write the JSON report here")
     p.add_argument("--trace", action="store_true",
                    help="arm the eval-lifecycle tracing plane (slow-tail "
@@ -65,10 +71,16 @@ def main(argv=None) -> int:
         sc = replace(sc, num_workers=args.workers)
     if args.batch_worker:
         sc = replace(sc, use_tpu_batch_worker=True)
+    if args.wal:
+        sc = replace(sc, wal=True)
 
     if args.compare_workers:
         counts = [int(x) for x in args.compare_workers.split(",") if x]
         report = compare_workers(sc, counts)
+    elif args.compare_wal:
+        from .harness import compare_wal
+
+        report = compare_wal(sc)
     else:
         report = run_scenario(sc)
 

@@ -18,6 +18,19 @@ def write_report(report: Dict, path: str) -> None:
 
 
 def render_report(report: Dict, out: TextIO) -> None:
+    if report.get("compare") == "wal":  # compare_wal shape
+        out.write(f"== loadgen WAL compare: {report['scenario']} ==\n")
+        for k in ("wal_off", "wal_on"):
+            out.write(f"  {k}: {report['evals_per_s'][k]} evals/s, "
+                      f"plan.apply p99={report['plan_apply_p99_ms'][k]}ms\n")
+        fs = report.get("plan_apply_fsync") or {}
+        if fs:
+            out.write(f"  plan_apply_fsync ms: p50={fs.get('p50')} "
+                      f"p99={fs.get('p99')} (n={fs.get('count')})\n")
+        for k, run in report["runs"].items():
+            out.write(f"-- {k} --\n")
+            _render_single(run, out, indent="  ")
+        return
     if "worker_counts" in report:  # compare_workers shape
         out.write(f"== loadgen compare: {report['scenario']} "
                   f"workers={report['worker_counts']} ==\n")
@@ -57,6 +70,10 @@ def _render_single(r: Dict, out: TextIO, indent: str = "") -> None:
     pa = lat.get("plan_apply") or {}
     if pa:
         w(f"plan.apply ms: p50={pa.get('p50')} p99={pa.get('p99')}")
+    fs = lat.get("plan_apply_fsync") or {}
+    if fs:
+        w(f"plan.apply fsync ms: p50={fs.get('p50')} p99={fs.get('p99')} "
+          f"(n={fs.get('count')})")
     w(f"plan conflicts: {cp['plan_conflicts']}, snapshot reuse/fresh: "
       f"{cp['snapshot_reuse']}/{cp['snapshot_fresh']}")
     broker = cp["broker"]

@@ -74,6 +74,10 @@ class Scenario:
     # serial discipline of one fresh O(cluster) snapshot per eval — the
     # regression baseline the speedup gate compares against.
     stale_snapshot: bool = True
+    # Durable raft log (FileLog + the native group-commit WAL, ISSUE 9):
+    # every apply pays a real fsync; the report's plan_apply_fsync
+    # percentiles and the --compare-wal gate measure it.
+    wal: bool = False
     # Determinism.
     seed: int = 42
 

@@ -94,6 +94,11 @@ def _wal_lib() -> ctypes.CDLL:
         lib.nwal_append.restype = ctypes.c_int
         lib.nwal_append.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                     ctypes.c_uint32]
+        lib.nwal_write.restype = ctypes.c_uint64
+        lib.nwal_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_uint32]
+        lib.nwal_sync_seq.restype = ctypes.c_int
+        lib.nwal_sync_seq.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.nwal_iter_start.restype = None
         lib.nwal_iter_start.argtypes = [ctypes.c_void_p]
         lib.nwal_iter_next.restype = ctypes.c_int
@@ -135,6 +140,22 @@ class NativeWAL:
         rc = self._lib.nwal_append(self._h, record, len(record))
         if rc != 0:
             raise OSError(f"nwal_append failed on {self.path}")
+
+    def write(self, record: bytes) -> int:
+        """Write one record WITHOUT waiting for durability; returns its
+        seq for :meth:`sync_to`.  The raft log calls this under its
+        apply lock (file order == index order for the durable prefix)
+        and syncs outside it so concurrent appliers share one fsync."""
+        seq = self._lib.nwal_write(self._h, record, len(record))
+        if seq == 0:
+            raise OSError(f"nwal_write failed on {self.path}")
+        return seq
+
+    def sync_to(self, seq: int) -> None:
+        """Block until records through ``seq`` are durable (group
+        commit across concurrent callers)."""
+        if self._lib.nwal_sync_seq(self._h, seq) != 0:
+            raise OSError(f"nwal_sync_seq failed on {self.path}")
 
     def records(self) -> Iterator[bytes]:
         """Iterate all records from the start.  Not safe to interleave

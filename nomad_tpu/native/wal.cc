@@ -61,6 +61,11 @@ struct Wal {
   uint64_t written_seq = 0;   // records written to the fd
   uint64_t synced_seq = 0;    // records known durable
   bool sync_in_flight = false;
+  // Sticky: one failed fsync poisons the log.  The kernel may CLEAR
+  // the error state after reporting it once (fsyncgate), so a sibling
+  // waiter retrying the fsync would get rc==0 and falsely ack entries
+  // whose dirty pages were dropped.
+  bool failed = false;
 
   // iteration state (single iterator at a time; guarded by mu)
   std::vector<uint8_t> iter_buf;
@@ -138,50 +143,74 @@ Wal* nwal_open(const char* path, int sync_mode, char* errbuf, int errcap) {
 
 long nwal_entry_count(Wal* w) { return w->entry_count; }
 
-// Append one record; returns 0 when the record is DURABLE (group-commit
-// fsync has covered it), -1 on error.
-int nwal_append(Wal* w, const void* data, uint32_t len) {
+// Write one framed record WITHOUT waiting for durability; returns the
+// record's seq (>0), 0 on error.  Callers that need an ordering
+// guarantee (the raft log: record index order == file order for the
+// durable prefix) serialize their write() calls externally and only
+// overlap the sync_seq() waits — that separation is what lets
+// concurrent raft appliers share one fsync instead of paying one each
+// under the apply lock.
+uint64_t nwal_write(Wal* w, const void* data, uint32_t len) {
   uint8_t hdr[8];
   uint32_t crc = crc32((const uint8_t*)data, len);
   std::memcpy(hdr, &len, 4);
   std::memcpy(hdr + 4, &crc, 4);
+  std::lock_guard<std::mutex> lk(w->mu);
+  off_t start = ::lseek(w->fd, 0, SEEK_CUR);
+  if (start < 0) return 0;
+  if (::write(w->fd, hdr, 8) != 8 ||
+      (len && ::write(w->fd, data, len) != (ssize_t)len)) {
+    // Roll the torn frame back (ENOSPC / short write): leaving it
+    // mid-log would strand every LATER successful append behind it —
+    // recovery truncates at the first bad frame, silently discarding
+    // acked-durable entries.
+    ::ftruncate(w->fd, start);
+    ::lseek(w->fd, start, SEEK_SET);
+    return 0;
+  }
+  w->entry_count++;
+  return ++w->written_seq;
+}
 
-  uint64_t my_seq;
-  {
-    std::unique_lock<std::mutex> lk(w->mu);
-    // Write under the lock: record order == seq order.
-    if (::write(w->fd, hdr, 8) != 8) return -1;
-    if (len && ::write(w->fd, data, len) != (ssize_t)len) return -1;
-    my_seq = ++w->written_seq;
-    w->entry_count++;
-    if (w->sync_mode == 0) {
-      w->synced_seq = my_seq;
-      return 0;
-    }
-    // Group commit: wait while another thread's fsync is in flight —
-    // when it finishes it covers every record written before it started
-    // its fsync; if ours isn't covered, we become the next syncer.
-    while (true) {
-      if (w->synced_seq >= my_seq) return 0;
-      if (!w->sync_in_flight) break;
-      w->cv.wait(lk);
-    }
-    w->sync_in_flight = true;
+// Block until records through ``seq`` are durable (group commit): if a
+// sibling's fsync is in flight, wait — when it finishes it covers every
+// record written before it started; otherwise become the syncer for
+// everything written so far.  Returns 0 durable, -1 on fsync error.
+int nwal_sync_seq(Wal* w, uint64_t seq) {
+  std::unique_lock<std::mutex> lk(w->mu);
+  if (w->sync_mode == 0) {
+    if (w->synced_seq < w->written_seq) w->synced_seq = w->written_seq;
+    return 0;
   }
-  // fsync outside the lock: appenders keep writing into the next batch.
-  uint64_t cover;
-  {
-    std::lock_guard<std::mutex> lk(w->mu);
-    cover = w->written_seq;
+  while (true) {
+    if (w->failed) return -1;
+    if (w->synced_seq >= seq) return 0;
+    if (!w->sync_in_flight) break;
+    w->cv.wait(lk);
   }
+  w->sync_in_flight = true;
+  uint64_t cover = w->written_seq;
+  lk.unlock();
+  // fsync outside the lock: writers keep appending the next batch.
   int rc = ::fsync(w->fd);
-  {
-    std::lock_guard<std::mutex> lk(w->mu);
-    w->sync_in_flight = false;
-    if (rc == 0 && cover > w->synced_seq) w->synced_seq = cover;
-    w->cv.notify_all();
+  lk.lock();
+  w->sync_in_flight = false;
+  if (rc == 0) {
+    if (cover > w->synced_seq) w->synced_seq = cover;
+  } else {
+    w->failed = true;  // sticky: no waiter may retry and falsely ack
   }
-  return rc == 0 ? 0 : -1;
+  w->cv.notify_all();
+  if (rc != 0) return -1;
+  return w->synced_seq >= seq ? 0 : -1;
+}
+
+// Append one record; returns 0 when the record is DURABLE (group-commit
+// fsync has covered it), -1 on error.
+int nwal_append(Wal* w, const void* data, uint32_t len) {
+  uint64_t seq = nwal_write(w, data, len);
+  if (seq == 0) return -1;
+  return nwal_sync_seq(w, seq);
 }
 
 // Iterate records from the start.  nwal_iter_next fills *data/*len with

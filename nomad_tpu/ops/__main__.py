@@ -326,6 +326,202 @@ def residency_drill(seed: int = 0, log=print) -> bool:
     return True
 
 
+def columnar_drill(seed: int = 0, log=print) -> bool:
+    """Columnar state-store drill (ISSUE 9): the first snapshot cold-
+    builds the store's numpy mirror and the encode slices it (guard
+    armed at EVERY encode, so the column-built buffers are verified
+    bit-identical against the object walk), incremental node/alloc
+    writes keep parity, an injected column corruption is caught by the
+    guard and trips the breaker, and the oracle carries the next
+    batch."""
+    import os
+
+    from .. import fault, mock
+    from ..scheduler import Harness
+    from ..state import columnar
+    from ..structs import structs as s
+    from .batch_sched import TPUBatchScheduler
+    from .breaker import KernelCircuitBreaker
+
+    def check(cond, msg):
+        if not cond:
+            log(f"columnar drill: FAIL — {msg}")
+        return cond
+
+    saved = {k: os.environ.get(k) for k in
+             ("NOMAD_TPU_COLUMNAR", "NOMAD_TPU_COLUMNAR_GUARD_EVERY")}
+    os.environ["NOMAD_TPU_COLUMNAR"] = "1"
+    os.environ["NOMAD_TPU_COLUMNAR_GUARD_EVERY"] = "1"
+    columnar.reset_counters()
+    brk = KernelCircuitBreaker(threshold=0.9, window=8, min_checks=1,
+                               cooldown=3600.0)
+    try:
+        h = Harness()
+        for _ in range(8):
+            node = mock.node()
+            node.resources.networks = []
+            node.reserved.networks = []
+            node.compute_class()
+            h.state.upsert_node(h.next_index(), node)
+
+        def run_batch():
+            job = mock.job()
+            for tg in job.task_groups:
+                for t in tg.tasks:
+                    t.resources.networks = []
+            job.task_groups[0].count = 2
+            h.state.upsert_job(h.next_index(), job)
+            ev = s.Evaluation(
+                id=s.generate_uuid(), priority=job.priority, type=job.type,
+                triggered_by=s.EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
+                status=s.EVAL_STATUS_PENDING)
+            sched = TPUBatchScheduler(h.logger, h.snapshot(), h,
+                                      breaker=brk)
+            stats = sched.schedule_batch([ev])
+            placed = len([a for a in
+                          h.state.allocs_by_job(None, job.id, True)
+                          if not a.terminal_status()]) == 2
+            return stats, placed
+
+        # 1. Cold build + first columnar encode, guard-verified.
+        _, p1 = run_batch()
+        if not (check(columnar.COLUMNAR_ENCODES >= 1,
+                      "first batch did not take the columnar encode")
+                and check(columnar.GUARD_RUNS >= 1
+                          and columnar.GUARD_MISMATCHES == 0,
+                          "guard did not verify the cold column build")
+                and check(p1, "cold columnar batch did not place")):
+            return False
+
+        # 2. Incremental writes (status flip + a fresh node) re-key the
+        # static cache; the columnar re-encode must still match the
+        # walk bit-for-bit.
+        some_node = h.state.nodes(None)[0]
+        h.state.update_node_drain(h.next_index(), some_node.id, True)
+        h.state.update_node_drain(h.next_index(), some_node.id, False)
+        extra = mock.node()
+        extra.resources.networks = []
+        extra.reserved.networks = []
+        extra.compute_class()
+        h.state.upsert_node(h.next_index(), extra)
+        guard_before = columnar.GUARD_RUNS
+        _, p2 = run_batch()
+        if not (check(columnar.GUARD_RUNS > guard_before
+                      and columnar.GUARD_MISMATCHES == 0,
+                      "guard did not verify the incremental re-encode")
+                and check(p2, "incremental batch did not place")):
+            return False
+
+        # 3. Injected column corruption: the guard catches it, feeds
+        # the breaker, and the batch proceeds on the walk's buffers.
+        extra2 = mock.node()
+        extra2.resources.networks = []
+        extra2.reserved.networks = []
+        extra2.compute_class()
+        h.state.upsert_node(h.next_index(), extra2)  # force re-encode
+        with fault.scenario({"seed": seed, "faults": [
+                {"point": "state.columns", "action": "corrupt",
+                 "times": 1}]}):
+            _, p3 = run_batch()
+        if not (check(columnar.GUARD_MISMATCHES == 1,
+                      "guard missed the injected column corruption")
+                and check(brk.state == "open",
+                          f"breaker {brk.state!r}, expected open")
+                and check(p3, "corrupted-column batch did not place")):
+            return False
+
+        # 4. Open breaker: the oracle carries the next batch.
+        s4, p4 = run_batch()
+        if not (check(s4.oracle_routed > 0,
+                      "open breaker did not route through the oracle")
+                and check(p4, "oracle-carried batch did not place")):
+            return False
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        columnar.reset_counters()
+    log("columnar drill: OK — cold column build verified bit-identical "
+        "to the object walk, incremental writes kept parity, injected "
+        "corruption tripped the breaker, oracle carried the next batch")
+    return True
+
+
+def wal_drill(seed: int = 0, log=print) -> bool:
+    """Native group-commit WAL drill (ISSUE 9): append through the
+    FileLog, crash mid-frame via the ``wal.fsync`` fault point (a torn
+    partial record is left on disk), and recover — the torn tail is
+    truncated, committed entries survive, the crashed entry never
+    applied, and post-recovery appends land cleanly."""
+    import os
+    import shutil
+    import tempfile
+
+    from .. import fault, mock
+    from ..server.fsm import FSM, MessageType
+    from ..server.raft import FileLog
+
+    def check(cond, msg):
+        if not cond:
+            log(f"wal drill: FAIL — {msg}")
+        return cond
+
+    d = tempfile.mkdtemp(prefix="nomad-tpu-waldrill-")
+    try:
+        flog = FileLog(FSM(), d)
+        native = flog._nwal is not None
+        node = mock.node()
+        node.compute_class()
+        flog.apply(MessageType.NODE_REGISTER, {"node": node})
+        applied = flog.applied_index()
+
+        job = mock.job()
+        crashed = False
+        with fault.scenario({"seed": seed, "faults": [
+                {"point": "wal.fsync", "action": "crash", "times": 1}]}):
+            try:
+                flog.apply(MessageType.JOB_REGISTER, {"job": job})
+            except Exception:
+                crashed = True
+        flog.close()
+        if not check(crashed, "injected mid-frame crash did not fire"):
+            return False
+        wal_file = os.path.join(d, "wal.crc" if native else "wal.log")
+        torn_size = os.path.getsize(wal_file)
+
+        flog2 = FileLog(FSM(), d)
+        if not (check(flog2.applied_index() == applied,
+                      "recovery lost or invented entries")
+                and check(flog2.fsm.state.node_by_id(None, node.id)
+                          is not None, "committed entry lost")
+                and check(flog2.fsm.state.job_by_id(None, job.id) is None,
+                          "torn entry applied")
+                and check(os.path.getsize(wal_file) < torn_size,
+                          "torn tail was not truncated")):
+            flog2.close()
+            return False
+        flog2.apply(MessageType.JOB_REGISTER, {"job": job})
+        applied2 = flog2.applied_index()
+        flog2.close()
+
+        flog3 = FileLog(FSM(), d)
+        ok = (check(flog3.applied_index() == applied2,
+                    "post-recovery append did not survive")
+              and check(flog3.fsm.state.job_by_id(None, job.id)
+                        is not None, "post-recovery entry lost"))
+        flog3.close()
+        if not ok:
+            return False
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    log(f"wal drill: OK — {'native' if native else 'fallback'} WAL "
+        "crashed mid-frame, recovery truncated the torn tail, committed "
+        "entries survived, post-recovery appends land cleanly")
+    return True
+
+
 def fused_drill(seed: int = 0, log=print) -> bool:
     """Fused score-and-commit drill (PR 6): a cold batch through the
     fused single-dispatch path must place with exactly ONE ``batch.fetch``
@@ -690,6 +886,8 @@ def main(argv=None) -> int:
     ok = breaker_drill(seed=args.seed) and ok
     ok = tracing_drill(seed=args.seed) and ok
     ok = residency_drill(seed=args.seed) and ok
+    ok = columnar_drill(seed=args.seed) and ok
+    ok = wal_drill(seed=args.seed) and ok
     ok = fused_drill(seed=args.seed) and ok
     ok = mesh_drill(seed=args.seed) and ok
     return 0 if ok else 1

@@ -819,6 +819,49 @@ class TPUBatchScheduler:
                     allocs_by_node[alloc.node_id].append(alloc)
         return allocs_by_node
 
+    def _columnar_usage(self, base):
+        """Live usage rows sliced from the store's columnar mirror
+        (state/columnar.py): base reserved-only usage + the
+        fold-on-read usage matrix — O(changed allocs) instead of the
+        full alloc-row walk.  Returns ``(used int64 [n_pad, 4],
+        touched_rows set)`` or None when the mirror is unavailable
+        (disabled, invalidated, network batch, or a non-StateStore
+        double).  Every ``NOMAD_TPU_COLUMNAR_GUARD_EVERY`` reads the
+        object walk runs anyway and must match bit-for-bit — a mismatch
+        feeds the breaker, bumps the columnar epoch, and this batch
+        proceeds on the walk's rows."""
+        from ..state import columnar as colmod
+
+        if getattr(base, "_with_networks", False):
+            return None
+        columns_fn = getattr(self.state, "columns", None)
+        if columns_fn is None:
+            return None
+        cols = columns_fn()
+        if cols is None or cols.n != base.n_real:
+            return None
+        usage = self.state.column_usage(cols)[:cols.n]
+        used = np.asarray(base.used, dtype=np.int64).copy()
+        used[:cols.n] += usage
+        touched = set(np.nonzero(usage.any(axis=1))[0].tolist())
+        colmod.USAGE_READS += 1
+        every = colmod.guard_every()
+        if every > 0 and colmod.USAGE_READS % every == 0:
+            colmod.USAGE_GUARD_RUNS += 1
+            ref_used, ref_touched = resident._full_usage(
+                base, self._live_allocs_by_node)
+            if not np.array_equal(used, ref_used):
+                bad = int((used != ref_used).any(axis=1).sum())
+                colmod.note_guard_mismatch("usage", "usage",
+                                           breaker=self.breaker, Rows=bad)
+                return ref_used, set(ref_touched)
+            if self.breaker is not None:
+                self.breaker.record(True)
+            # The walk's touched set is authoritative: it also covers
+            # nodes whose live allocs net to zero usage.
+            return used, set(ref_touched)
+        return used, touched
+
     def _dispatch_device(self, spec_list: List[encode.PlacementSpec]):
         """Host encode + async device dispatch: everything up to (but
         not including) the blocking fetch.  Returns the in-flight handle
@@ -856,10 +899,13 @@ class TPUBatchScheduler:
             if base is not None:
                 _CLUSTER_CACHE[cache_key] = base  # LRU touch-on-hit
         if base is None:
-            base = encode.encode_cluster_static(
-                all_nodes, attr_targets, with_networks=with_networks,
-                node_pad_multiple=pad_m)
-            encode.finalize_codebooks(base, literals)
+            # Columnar path (ISSUE 9): slice the store's numpy mirrors
+            # instead of walking a node object per row; differential
+            # guard + object-walk fallback live inside.
+            base = encode.build_cluster_static(
+                self.state, all_nodes, attr_targets, literals,
+                with_networks=with_networks, node_pad_multiple=pad_m,
+                breaker=self.breaker)
             if cache_key is not None:
                 _CLUSTER_CACHE[cache_key] = base
                 while len(_CLUSTER_CACHE) > 4:
@@ -884,20 +930,30 @@ class TPUBatchScheduler:
                 self.state, cache_key[:2] + (base.n_pad,), base,
                 self._live_allocs_by_node, breaker=self.breaker,
                 shards=(self.mesh.devices.size
-                        if self.mesh is not None else 0))
+                        if self.mesh is not None else 0),
+                usage_fn=lambda: self._columnar_usage(base))
             ct = encode.with_usage(base, used)
             # The preemption pass only needs WHICH nodes may carry live
             # allocs (it re-materializes candidate rows from state);
             # avoid the full row walk the resident path just saved.
             self._allocs_by_node = {base.node_ids[i]: True for i in touched}
         else:
-            allocs_by_node = self._live_allocs_by_node()
-            self._allocs_by_node = allocs_by_node
-            ct = (encode.apply_alloc_usage(base, allocs_by_node)
-                  if allocs_by_node else base)
-            touched = sorted(i for i in (node_index.get(nid)
-                                         for nid in allocs_by_node)
-                             if i is not None)
+            cu = (self._columnar_usage(base)
+                  if not with_networks else None)
+            if cu is not None:
+                used, touched_set = cu
+                ct = encode.with_usage(base, used)
+                self._allocs_by_node = {base.node_ids[i]: True
+                                        for i in touched_set}
+                touched = sorted(touched_set)
+            else:
+                allocs_by_node = self._live_allocs_by_node()
+                self._allocs_by_node = allocs_by_node
+                ct = (encode.apply_alloc_usage(base, allocs_by_node)
+                      if allocs_by_node else base)
+                touched = sorted(i for i in (node_index.get(nid)
+                                             for nid in allocs_by_node)
+                                 if i is not None)
         st = encode.encode_specs(spec_list, ct, all_nodes)
 
         # Existing per-(job, node) alloc counts for anti-affinity/distinct,

@@ -208,6 +208,29 @@ def encode_cluster(
     return ct
 
 
+def _resolve_attr_rows(nodes: Sequence[s.Node],
+                       attr_targets: Sequence[str]):
+    """Per-node resolution of the batch's attribute targets (the second
+    loop of the object walk, shared with the columnar path — string
+    attr resolution has no columnar form)."""
+    value_sets: Dict[str, Set[str]] = {t: set() for t in attr_targets}
+    if not attr_targets:
+        # One shared empty row: finalize_codebooks only reads these.
+        return [{}] * len(nodes), value_sets
+    resolved: List[Dict[str, Optional[str]]] = []
+    for node in nodes:
+        row: Dict[str, Optional[str]] = {}
+        for t in attr_targets:
+            val, ok = resolve_constraint_target(t, node)
+            if ok and isinstance(val, str):
+                row[t] = val
+                value_sets[t].add(val)
+            else:
+                row[t] = None
+        resolved.append(row)
+    return resolved, value_sets
+
+
 def encode_cluster_static(
     nodes: Sequence[s.Node],
     attr_targets: Sequence[str],
@@ -279,18 +302,7 @@ def encode_cluster_static(
     # Ordered value codebooks per attribute target: collect node values, sort,
     # assign ranks — integer compare ≡ lexical compare.
     attr_index = {t: j for j, t in enumerate(attr_targets)}
-    value_sets: Dict[str, Set[str]] = {t: set() for t in attr_targets}
-    resolved: List[Dict[str, Optional[str]]] = []
-    for node in nodes:
-        row: Dict[str, Optional[str]] = {}
-        for t in attr_targets:
-            val, ok = resolve_constraint_target(t, node)
-            if ok and isinstance(val, str):
-                row[t] = val
-                value_sets[t].add(val)
-            else:
-                row[t] = None
-        resolved.append(row)
+    resolved, value_sets = _resolve_attr_rows(nodes, attr_targets)
 
     value_codebooks: Dict[str, Dict[str, int]] = {
         t: {} for t in attr_targets
@@ -325,6 +337,154 @@ def encode_cluster_static(
     ct._nodes = list(nodes)            # type: ignore[attr-defined]
     ct._with_networks = with_networks  # type: ignore[attr-defined]
     ct._node_index = {nid: i for i, nid in enumerate(node_ids)}  # type: ignore[attr-defined]
+    return ct
+
+
+def encode_cluster_static_columnar(
+    cols,
+    nodes: Sequence[s.Node],
+    attr_targets: Sequence[str],
+    node_pad_multiple: int = 128,
+) -> ClusterTensors:
+    """``encode_cluster_static`` built by SLICING the state store's
+    columnar mirror (state/columnar.ClusterColumns) instead of walking a
+    node object per row — bit-identical output by construction (codes
+    are assigned in the same first-seen order the walk's ``setdefault``
+    produces; the columnar guard in :func:`build_cluster_static` pins
+    it).  Network batches keep the object walk (port bitmaps have no
+    columnar form), as does any store without a warm mirror."""
+    n_real = cols.n
+    n_pad = max(node_pad_multiple, round_up(n_real, node_pad_multiple))
+
+    capacity = np.zeros((n_pad, RES_DIMS), dtype=np.int64)
+    capacity[:n_real] = cols.cap[:n_real]
+    used = np.zeros((n_pad, RES_DIMS), dtype=np.int64)
+    used[:n_real] = cols.res[:n_real]
+    score_denom = np.ones((n_pad, 2), dtype=np.float32)
+    score_denom[:n_real, 0] = cols.cap[:n_real, 0] - cols.res[:n_real, 0]
+    score_denom[:n_real, 1] = cols.cap[:n_real, 1] - cols.res[:n_real, 1]
+    eligible = np.zeros(n_pad, dtype=bool)
+    eligible[:n_real] = cols.eligible[:n_real]
+    dc_code = np.full(n_pad, MISSING, dtype=np.int32)
+    dc_code[:n_real] = cols.dc_code[:n_real]
+    class_code = np.full(n_pad, MISSING, dtype=np.int32)
+    class_code[:n_real] = cols.class_code[:n_real]
+
+    node_ids = list(cols.node_ids[:n_real])
+    attr_index = {t: j for j, t in enumerate(attr_targets)}
+    resolved, value_sets = _resolve_attr_rows(nodes, attr_targets)
+    attr_values = np.full((n_pad, max(1, len(attr_targets))), MISSING,
+                          dtype=np.int32)
+
+    ct = ClusterTensors(
+        node_ids=node_ids,
+        n_real=n_real,
+        n_pad=n_pad,
+        capacity=capacity,
+        used=used,
+        score_denom=score_denom,
+        eligible=eligible,
+        dc_code=dc_code,
+        class_code=class_code,
+        attr_values=attr_values,
+        attr_index=attr_index,
+        dc_codebook=cols.dc_codebook(),
+        value_codebooks={t: {} for t in attr_targets},
+        bw_cap=np.zeros(n_pad, dtype=np.int32),
+        bw_used=np.zeros(n_pad, dtype=np.int32),
+        dyn_free=np.zeros(n_pad, dtype=np.int32),
+        port_words=np.zeros((n_pad, 1), dtype=np.uint32),
+    )
+    ct._raw_rows = resolved            # type: ignore[attr-defined]
+    ct._value_sets = value_sets        # type: ignore[attr-defined]
+    ct._class_codebook = cols.class_codebook()  # type: ignore[attr-defined]
+    ct._nodes = nodes if type(nodes) is list else list(nodes)  # type: ignore[attr-defined]
+    ct._with_networks = False          # type: ignore[attr-defined]
+    ct._node_index = {nid: i for i, nid in enumerate(node_ids)}  # type: ignore[attr-defined]
+    ct._columnar = True                # type: ignore[attr-defined]
+    return ct
+
+
+def _static_mismatch(ct: ClusterTensors, ref: ClusterTensors) -> str:
+    """First difference between a column-built and a walk-built static
+    encode, or '' when bit-identical.  Everything the device pass (and
+    the codebook-dependent spec lowering) consumes is compared."""
+    if ct.node_ids != ref.node_ids:
+        return "node_ids order"
+    for name in ("capacity", "used", "score_denom", "eligible",
+                 "dc_code", "class_code", "attr_values"):
+        if not np.array_equal(getattr(ct, name), getattr(ref, name)):
+            return name
+    if ct.dc_codebook != ref.dc_codebook:
+        return "dc_codebook"
+    if ct.value_codebooks != ref.value_codebooks:
+        return "value_codebooks"
+    if getattr(ct, "_class_codebook", None) != getattr(
+            ref, "_class_codebook", None):
+        return "class_codebook"
+    return ""
+
+
+def build_cluster_static(
+    state,
+    nodes: Sequence[s.Node],
+    attr_targets: Sequence[str],
+    literals: Dict[str, Set[str]],
+    node_pad_multiple: int = 128,
+    with_networks: bool = False,
+    breaker=None,
+) -> ClusterTensors:
+    """Static cluster tensors + finalized codebooks, via the store's
+    columnar mirror when available (``NOMAD_TPU_COLUMNAR``), the object
+    walk otherwise.  Every ``NOMAD_TPU_COLUMNAR_GUARD_EVERY`` columnar
+    encodes the walk runs anyway and the outputs are bit-compared: a
+    mismatch feeds the breaker, bumps the columnar epoch (every mirror
+    in the process rebuilds before being trusted again), and the batch
+    proceeds on the walk's buffers — corruption degrades, never
+    mis-places.  Fault point ``state.columns`` (action ``corrupt``)
+    perturbs one column-built row, the chaos twin of mirror drift."""
+    from .. import fault
+    from ..state import columnar as colmod
+
+    cols = None
+    if not with_networks:
+        columns_fn = getattr(state, "columns", None)
+        if columns_fn is not None:
+            cols = columns_fn()
+        if cols is not None and cols.n != len(nodes):
+            cols = None  # mirror out of step with the caller's node list
+    if cols is None:
+        colmod.WALK_ENCODES += 1
+        ct = encode_cluster_static(nodes, attr_targets,
+                                   node_pad_multiple=node_pad_multiple,
+                                   with_networks=with_networks)
+        finalize_codebooks(ct, literals)
+        return ct
+
+    colmod.COLUMNAR_ENCODES += 1
+    ct = encode_cluster_static_columnar(
+        cols, nodes, attr_targets, node_pad_multiple=node_pad_multiple)
+    finalize_codebooks(ct, literals)
+
+    act = fault.faultpoint("state.columns")
+    if act is not None and act.kind == "corrupt":
+        row = act.rng.randrange(max(1, ct.n_real))
+        ct.capacity[row, act.rng.randrange(RES_DIMS)] += \
+            1 + act.rng.randrange(1000)
+
+    every = colmod.guard_every()
+    if every > 0 and colmod.COLUMNAR_ENCODES % every == 0:
+        colmod.GUARD_RUNS += 1
+        ref = encode_cluster_static(nodes, attr_targets,
+                                    node_pad_multiple=node_pad_multiple)
+        finalize_codebooks(ref, literals)
+        bad = _static_mismatch(ct, ref)
+        if bad:
+            colmod.note_guard_mismatch("static", bad, breaker=breaker,
+                                       Nodes=int(ref.n_real))
+            return ref
+        if breaker is not None:
+            breaker.record(True)
     return ct
 
 

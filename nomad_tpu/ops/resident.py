@@ -205,8 +205,24 @@ def _full_usage(base, rows_fn) -> Tuple[np.ndarray, set]:
     return used, touched
 
 
+def _usage_source(base, rows_fn, usage_fn) -> Tuple[np.ndarray, set]:
+    """Full live-usage rows for a cold build / fence / feed-gap rebuild:
+    the columnar mirror slice when the caller supplied one (O(changed)
+    via the store's delta feed, ISSUE 9), the object walk otherwise.
+    The DIFFERENTIAL GUARD below never uses ``usage_fn`` — it must stay
+    an independent accumulation path (the mirror and this cache both
+    ride the same delta log; the guard's job is to catch that log
+    lying, so it re-derives from the alloc rows themselves)."""
+    if usage_fn is not None:
+        out = usage_fn()
+        if out is not None:
+            used, touched = out
+            return used, set(touched)
+    return _full_usage(base, rows_fn)
+
+
 def acquire(state, cache_key: Tuple, base, rows_fn,
-            breaker=None, shards: int = 0
+            breaker=None, shards: int = 0, usage_fn=None
             ) -> Tuple[np.ndarray, List[int], Dict]:
     """Produce the live usage matrix for this batch.
 
@@ -250,7 +266,7 @@ def acquire(state, cache_key: Tuple, base, rows_fn,
             STALENESS_FALLBACKS += 1
             info["fence"] = True
             info["full_reencode"] = True
-            used, touched = _full_usage(base, rows_fn)
+            used, touched = _usage_source(base, rows_fn, usage_fn)
             tracing.event("resident.fence", snap_nodes_index=cache_key[1],
                           cached_nodes_index=st.key[1])
             _publish("staleness_fence", SnapshotNodesIndex=cache_key[1],
@@ -264,7 +280,7 @@ def acquire(state, cache_key: Tuple, base, rows_fn,
                 STALENESS_FALLBACKS += 1
                 info["fence"] = True
                 info["full_reencode"] = True
-                used, touched = _full_usage(base, rows_fn)
+                used, touched = _usage_source(base, rows_fn, usage_fn)
                 tracing.event("resident.fence", snap_index=snap_index,
                               cached_index=st.alloc_index)
                 _publish("staleness_fence", SnapshotIndex=snap_index,
@@ -353,7 +369,7 @@ def acquire(state, cache_key: Tuple, base, rows_fn,
                   else ("key_change" if st is not None else "cold"))
         FULL_REENCODES += 1
         info["full_reencode"] = True
-        used, touched = _full_usage(base, rows_fn)
+        used, touched = _usage_source(base, rows_fn, usage_fn)
         _STATE = ResidentState(cache_key, used, snap_index, set(touched))
         tracing.event("resident.full_reencode", reason=reason,
                       alloc_index=snap_index)

@@ -216,11 +216,17 @@ class FSM:
     # -- snapshot / restore ------------------------------------------------
 
     def snapshot(self) -> bytes:
-        """(fsm.go:568)."""
+        """(fsm.go:568).  Columnar-enabled stores emit the v2 binary
+        format (struct-of-arrays nodes, columnar slabs, numpy columns —
+        state/columnar.py); ``NOMAD_TPU_COLUMNAR=0`` emits the legacy
+        per-object msgpack blob."""
         return self.state.persist()
 
     def restore(self, blob: bytes) -> None:
-        """(fsm.go:582) — replaces the state store wholesale."""
+        """(fsm.go:582) — replaces the state store wholesale.  Both
+        snapshot formats restore (the v2 magic is sniffed); v2 slabs
+        rehydrate lazily and the restored store encodes through its
+        warm numpy columns immediately."""
         self.state = StateStore.restore(blob)
         if self.event_broker is not None:
             self.state.event_broker = self.event_broker

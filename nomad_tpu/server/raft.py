@@ -85,12 +85,21 @@ class RaftLog:
 
     def __init__(self, fsm: FSM):
         self.fsm = fsm
-        # RLock: fsm.apply runs under this lock and its hooks may consult
-        # applied_index() on the same thread.
+        # RLock: index assignment/persist run under this lock; FSM-apply
+        # hooks may consult applied_index() on the same thread.
         self._l = threading.RLock()
         self._last_index = 0
+        self._applied = 0
         self._leader = True  # single-voter: always leader
         self._leader_listeners: List[Callable[[bool], None]] = []
+        # Apply sequencer: entries apply to the FSM in strict index
+        # order AFTER their durability wait (see apply()).  A sync
+        # covers the whole written prefix, so durability of entry N
+        # implies durability of everything below it — the wait here is
+        # only for apply ORDERING, never for a lower entry's fsync.
+        self._apply_cv = threading.Condition()
+        self._apply_next = 1
+        self._apply_failed = False
 
     # -- leadership --------------------------------------------------------
 
@@ -112,7 +121,7 @@ class RaftLog:
 
     def applied_index(self) -> int:
         with self._l:
-            return self._last_index
+            return self._applied
 
     def applied_index_relaxed(self) -> int:
         """Lock-free lower bound on :meth:`applied_index`.  ``_applied``
@@ -131,13 +140,35 @@ class RaftLog:
         """Append + commit + apply one entry; returns (result, index)
         (the raftApply path, nomad/rpc.go raftApply → fsm.Apply).
 
-        The FSM apply runs under the log lock so entries reach the state
-        store in strict index order and applied_index() never reports an
-        entry whose state is not yet visible."""
+        Three phases, preserving durability-before-visibility while
+        letting concurrent appliers share one fsync:
+
+        1. Under the log lock: assign the index and WRITE the entry
+           (file order == index order, so the durable prefix is always
+           gap-free).  No fsync here — holding the lock across the
+           fsync made group commit structurally impossible (appends
+           were never concurrent) and serialized one fsync per apply.
+        2. Outside the lock: wait for durability (_sync_persist);
+           concurrent waiters coalesce into one group-commit fsync.
+        3. Apply sequencer: FSM applies run in strict index order,
+           AFTER durability — nothing external (event stream, blocking
+           queries, applied_index readers) can observe state a crash
+           would erase.  A sync covers the whole written prefix, so
+           waiting for entry N-1's APPLY never waits on another fsync.
+
+        A durability failure poisons the log (fsync failure is fatal —
+        the reference panics): the entry was never applied, no retry
+        can double-apply, and every queued/later apply fails too."""
         t0 = time.monotonic()
         with self._l:
             if not self._leader:
                 raise NotLeaderError("not the leader")
+            if getattr(self, "_wal_failed", False):
+                # A durability failure already poisoned this log: the
+                # durable prefix is unknown, so NO further applies are
+                # accepted — restart to recover from it.
+                raise NotLeaderError("write-ahead log failed; restart "
+                                     "to recover from the durable prefix")
             # Fault point BEFORE append: an injected crash here models the
             # leader dying before the entry commits — nothing persists,
             # nothing applies, and the caller's retry path must cope.
@@ -145,9 +176,44 @@ class RaftLog:
                 raise NotLeaderError("injected step-down")
             self._last_index += 1
             index = self._last_index
-            self._persist(index, msg_type, payload)
-            result = self.fsm.apply(index, msg_type, payload)
-            self._applied = index  # after the apply: relaxed-read fence
+            try:
+                token = self._persist(index, msg_type, payload)
+            except Exception:
+                # Nothing reached the log (writes roll back torn
+                # frames): release the index so the apply sequencer
+                # never waits on a permanently-missing entry.
+                self._last_index -= 1
+                raise
+        if token is not None:
+            try:
+                self._sync_persist(token, msg_type)
+            except Exception:
+                with self._l:
+                    self._wal_failed = True
+                with self._apply_cv:
+                    # The written entry will never apply: every later
+                    # (higher-index) applier queued behind it must fail
+                    # rather than wait forever.
+                    self._apply_failed = True
+                    self._apply_cv.notify_all()
+                raise
+        with self._apply_cv:
+            while self._apply_next != index:
+                if self._apply_failed:
+                    raise NotLeaderError(
+                        "write-ahead log failed; restart to recover "
+                        "from the durable prefix")
+                self._apply_cv.wait()
+            try:
+                result = self.fsm.apply(index, msg_type, payload)
+            finally:
+                # ALWAYS advance: an FSM apply that raises (e.g. a
+                # deregister of an unknown node) propagates to its one
+                # caller exactly as before, but the sequencer must not
+                # wedge every later apply behind the dead index.
+                self._applied = index  # visible only now: post-durability
+                self._apply_next = index + 1
+                self._apply_cv.notify_all()
         self.metrics.measure_since("raft.apply", t0)
         # Branch before building attrs: the disarmed commit path pays
         # one load + comparison, no getattr/dict/timestamp.
@@ -157,8 +223,11 @@ class RaftLog:
                       msg_type=getattr(msg_type, "name", str(msg_type)))
         return result, index
 
-    def _persist(self, index: int, msg_type: MessageType, payload: dict) -> None:
-        pass  # in-memory: nothing to do
+    def _persist(self, index: int, msg_type: MessageType, payload: dict):
+        return None  # in-memory: nothing to do
+
+    def _sync_persist(self, token, msg_type) -> None:
+        pass  # in-memory: nothing to wait for
 
     def snapshot(self) -> None:
         pass
@@ -211,6 +280,14 @@ class FileLog(RaftLog):
         self._recover()
         self._fh = (open(self.wal_path, "ab") if self._nwal is None
                     else None)
+        # Pure-Python group-commit state (the fallback twin of
+        # native/wal.cc's written/synced seq + single-syncer dance):
+        # writes happen in index order under the raft lock; the fsync
+        # wait runs outside it so concurrent appliers share one fsync.
+        self._py_cv = threading.Condition()
+        self._py_written = 0
+        self._py_synced = 0
+        self._py_sync_in_flight = False
 
     # -- recovery ----------------------------------------------------------
 
@@ -278,6 +355,8 @@ class FileLog(RaftLog):
             prev_index = index
             self.fsm.apply(index, MessageType(msg_type), payload)
             self._last_index = index
+        self._applied = self._last_index
+        self._apply_next = self._last_index + 1
 
     def _read_crc_entries(self, snap_idx: int):
         """Pure-Python reader for the native wal.crc format
@@ -360,22 +439,124 @@ class FileLog(RaftLog):
 
     # -- persistence -------------------------------------------------------
 
-    def _persist(self, index: int, msg_type: MessageType, payload: dict) -> None:
+    def _persist(self, index: int, msg_type: MessageType, payload: dict):
+        """WRITE one entry (buffered, index order — caller holds the
+        raft lock) and return the durability token _sync_persist waits
+        on outside the lock."""
         blob = _encode_entry(index, msg_type, payload)
+        # Fault point ``wal.fsync``: a crash here models the process
+        # dying mid-frame — a torn partial record is left on disk (the
+        # recovery path must truncate it) and the entry never applies.
+        act = fault.faultpoint("wal.fsync", index=index,
+                              msg_type=getattr(msg_type, "name",
+                                               str(msg_type)))
+        if act is not None:
+            if act.kind == "delay":
+                time.sleep(act.delay)
+            else:
+                self._write_torn_frame(blob)
+                # Crash semantics: this process's log is DEAD.  Without
+                # the poison, a caller catching the injected error could
+                # keep appending — in the O_APPEND fallback those frames
+                # land AFTER the torn one, get acked durable, and are
+                # then silently truncated away with the bad tail at the
+                # next recovery.
+                self._wal_failed = True
+                act.raise_injected()
         if self._nwal is not None:
-            # Durable on return; concurrent appends share one fsync.
-            self._nwal.append(blob)
-            return
-        self._fh.write(_LEN.pack(len(blob)))
-        self._fh.write(blob)
-        self._fh.flush()
-        if self.fsync:
-            os.fsync(self._fh.fileno())
+            return self._nwal.write(blob)
+        pos = self._fh.tell()
+        try:
+            self._fh.write(_LEN.pack(len(blob)))
+            self._fh.write(blob)
+            self._fh.flush()
+        except OSError:
+            # Roll the torn frame back (ENOSPC): left mid-log it would
+            # strand later appends behind it — recovery truncates at
+            # the first bad frame.
+            try:
+                self._fh.seek(pos)
+                self._fh.truncate(pos)
+            except OSError:  # pragma: no cover — disk truly gone
+                pass
+            raise
+        with self._py_cv:
+            self._py_written += 1
+            return self._py_written
+
+    def _sync_persist(self, seq: int, msg_type) -> None:
+        """Wait (outside the raft lock) until the entry written as
+        ``seq`` is durable.  Concurrent callers coalesce into one fsync
+        — natively via wal.cc's group commit, in the fallback via the
+        same written/synced-seq single-syncer dance in Python."""
+        t0 = time.monotonic()
+        if self._nwal is not None:
+            self._nwal.sync_to(seq)
+        elif self.fsync:
+            with self._py_cv:
+                while True:
+                    if getattr(self, "_py_failed", False):
+                        # Sticky: a failed fsync may have dropped dirty
+                        # pages AND cleared the kernel error state
+                        # (fsyncgate) — a retry would return success
+                        # and falsely ack never-written entries.
+                        raise OSError("wal fsync previously failed")
+                    if self._py_synced >= seq:
+                        break
+                    if not self._py_sync_in_flight:
+                        self._py_sync_in_flight = True
+                        cover = self._py_written
+                        self._py_cv.release()
+                        try:
+                            os.fsync(self._fh.fileno())
+                        except OSError:
+                            self._py_cv.acquire()
+                            self._py_sync_in_flight = False
+                            self._py_failed = True
+                            self._py_cv.notify_all()
+                            raise
+                        self._py_cv.acquire()
+                        self._py_sync_in_flight = False
+                        self._py_cv.notify_all()
+                        if cover > self._py_synced:
+                            self._py_synced = cover
+                        break
+                    self._py_cv.wait()
+        self.metrics.measure_since("raft.fsync", t0)
+        if msg_type == MessageType.APPLY_PLAN_RESULTS:
+            # The loadgen report's plan_apply_fsync percentiles: the
+            # durability wait specifically on the plan-apply path.
+            self.metrics.measure_since("raft.fsync.plan", t0)
+
+    def _write_torn_frame(self, blob: bytes) -> None:
+        """Simulate a crash mid-append: leave a partial frame (header +
+        truncated payload) at the tail of whichever log is active."""
+        frame = _LEN.pack(len(blob)) + blob if self._nwal is None else (
+            struct.pack("<II", len(blob), 0xDEADBEEF) + blob)
+        torn = frame[:max(4, len(frame) // 2)]
+        path = (os.path.join(self.data_dir, "wal.crc")
+                if self._nwal is not None else self.wal_path)
+        try:
+            with open(path, "ab") as fh:
+                fh.write(torn)
+                fh.flush()
+        except OSError:  # pragma: no cover — fault plumbing best-effort
+            pass
 
     def snapshot(self) -> None:
         """Write an FSM snapshot and truncate the WAL (fsm.go:568 +
         snapshotsRetained=2)."""
         with self._l:
+            # Drain the apply sequencer first: entries assigned but not
+            # yet applied are neither in the FSM snapshot nor allowed to
+            # survive the WAL truncation below (they would be lost on
+            # restart after their appliers ack).  Holding the log lock
+            # blocks new appends; in-flight syncers/appliers need only
+            # the sequencer, so this cannot deadlock.
+            with self._apply_cv:
+                while (self._apply_next <= self._last_index
+                       and not self._apply_failed):
+                    self._apply_cv.wait(timeout=1.0)
             index = self._last_index
             blob = self.fsm.snapshot()
             path = os.path.join(self.data_dir, f"snapshot-{index}")
@@ -392,8 +573,17 @@ class FileLog(RaftLog):
                     # Legacy records are covered by the snapshot too.
                     open(self.wal_path, "wb").close()
             else:
-                self._fh.close()
-                self._fh = open(self.wal_path, "wb")
+                # Everything written so far is covered by the fsynced
+                # snapshot file: mark it synced so in-flight
+                # _sync_persist waiters resolve, and PARK the old
+                # handle instead of closing it — a racing fsync on the
+                # old fd stays harmless (the fd remains valid; the
+                # truncating reopen targets the path, not the fd).
+                with self._py_cv:
+                    self._py_synced = self._py_written
+                    self._parked_fh = self._fh
+                    self._fh = open(self.wal_path, "wb")
+                    self._py_cv.notify_all()
             # Retain only the most recent snapshots.
             snaps = self._snapshot_files()
             for old_idx, old_path in snaps[:-SNAPSHOTS_RETAINED]:
@@ -404,6 +594,9 @@ class FileLog(RaftLog):
             self._nwal.close()
         if self._fh is not None:
             self._fh.close()
+        parked = getattr(self, "_parked_fh", None)
+        if parked is not None:
+            parked.close()
 
 
 # ---------------------------------------------------------------------------

@@ -25,7 +25,10 @@ from collections import defaultdict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
+import numpy as np
+
 from ..structs import structs as s
+from . import columnar
 
 # Shared immutable empty result for index misses (never mutated).
 _EMPTY_SET: Set[str] = set()
@@ -190,6 +193,14 @@ class StateStore:
         self._alloc_log_owned: bool = True
         self._alloc_log_floor: int = 0
         self._alloc_log_weight: int = 0
+        # Columnar mirror of the node table + live-usage matrix
+        # (state/columnar.py): node writes maintain it incrementally,
+        # usage derives lazily from the delta log above, snapshots share
+        # it copy-on-write, and ops/encode slices it instead of walking
+        # node objects.  None = not built yet / invalidated by a
+        # structural change (rebuilt by the owner at the next
+        # snapshot()/columns() call).
+        self._columns: Optional[columnar.ClusterColumns] = None
 
     # -- snapshot ----------------------------------------------------------
 
@@ -238,10 +249,145 @@ class StateStore:
             snap._alloc_log_owned = False
             snap._alloc_log_floor = self._alloc_log_floor
             snap._alloc_log_weight = self._alloc_log_weight
+            # Columnar mirror: O(1) share behind copy-on-write (array
+            # refs + a private row cursor; see columnar.ClusterColumns.
+            # share).  Built here on first use so the mirror warms on
+            # the OWNING store and survives the snapshot.
+            snap._columns = None
+            if columnar.enabled():
+                cols = self._ensure_columns_locked()
+                if cols is not None:
+                    self._col_fold_if_stale(cols)
+                    snap._columns = cols.share()
             # Writes to a snapshot (job_plan dry runs, scheduler harness
             # worlds) are hypothetical: they must never publish events.
             snap.event_broker = None
             return snap
+
+    # -- columnar mirror ---------------------------------------------------
+
+    def _ensure_columns_locked(self) -> Optional[columnar.ClusterColumns]:
+        """Return the columnar mirror, cold-building it when absent or
+        epoch-stale.  Snapshots never build (the mirror must warm on the
+        owning store, not die with a per-batch view).  Caller holds the
+        lock."""
+        cols = self._columns
+        if cols is not None and cols.epoch == columnar.EPOCH:
+            return cols
+        if isinstance(self, StateSnapshot):
+            return None
+        self._columns = columnar.ClusterColumns.build(self)
+        return self._columns
+
+    def columns(self) -> Optional[columnar.ClusterColumns]:
+        """The columnar node/usage mirror for the encode path, or None
+        when disabled/unavailable (callers fall back to the object
+        walk)."""
+        if not columnar.enabled():
+            return None
+        with self._lock:
+            return self._ensure_columns_locked()
+
+    def column_usage(self, cols: columnar.ClusterColumns):
+        """Catch ``cols``' usage matrix up with this store's alloc
+        writes (O(changed) via the delta feed; full row-walk rebuild on
+        a feed gap) and return it.  Rows beyond ``cols.n`` are
+        padding."""
+        with self._lock:
+            if not cols.fold_usage(self):
+                cols.rebuild_usage(self)
+            return cols.usage
+
+    #: Un-folded delta-suffix length (log entries) past which snapshot()
+    #: folds the OWNER's usage cursor forward before sharing.  Folding
+    #: on every snapshot would pay a [n, 4] COW copy even for batches
+    #: that never read usage (the resident delta path); never folding
+    #: lets the cursor fall off the bounded log's trim floor, silently
+    #: degrading every usage read to a full O(all allocs) row-walk
+    #: rebuild — the exact cost the mirror removes.
+    COL_FOLD_BACKLOG = 4096
+
+    def _col_fold_if_stale(self, cols: columnar.ClusterColumns) -> None:
+        """Owner-side usage-cursor maintenance at snapshot time (caller
+        holds the lock): one amortized fold/rebuild here keeps every
+        per-batch snapshot view's fold O(recent) instead of each view
+        independently re-scanning the whole suffix."""
+        import bisect
+
+        if cols.usage_index < self._alloc_log_floor:
+            cols.rebuild_usage(self)
+            return
+        start = bisect.bisect_right(self._alloc_log, cols.usage_index,
+                                    0, self._alloc_log_len,
+                                    key=lambda e: e[0])
+        if self._alloc_log_len - start > self.COL_FOLD_BACKLOG:
+            if not cols.fold_usage(self):
+                cols.rebuild_usage(self)
+
+    def _col_node_upserted(self, node: s.Node, existing: Optional[s.Node]
+                           ) -> None:
+        """upsert_node hook (caller holds the lock): append or update the
+        mirror row.  A datacenter/computed-class change on an existing
+        node could reorder the first-seen codebooks, so it drops the
+        mirror for rebuild instead."""
+        cols = self._columns
+        if cols is None:
+            return
+        if existing is None:
+            # Fold BEFORE appending: the backfill below reads the
+            # tables' current truth for this node, so any still-pending
+            # log entries for it must land first or they'd double-count.
+            if not cols.fold_usage(self):
+                cols.rebuild_usage(self)
+            row = cols.append_node(node)
+            self._col_backfill_usage(cols, node.id, row)
+        elif not cols.update_node(node):
+            self._columns = None
+
+    @staticmethod
+    def _slab_node_set(slab: s.AllocSlab) -> frozenset:
+        """Cached node-id membership set for one slab (built once;
+        slab node_ids are immutable post-insert)."""
+        ns = getattr(slab, "_node_set", None)
+        if ns is None:
+            ns = frozenset(slab.node_ids)
+            slab._node_set = ns
+        return ns
+
+    def _col_backfill_usage(self, cols: columnar.ClusterColumns,
+                            node_id: str, row: int) -> None:
+        """A node registered AFTER allocs referencing it: seed its fresh
+        usage row from the live rows already in the tables (the object
+        walk counts them, so the mirror must too)."""
+        # Materialize pending slabs ONLY when one actually references
+        # this node: unconditionally draining a million-row pending slab
+        # to backfill a node whose allocs are all standalone rows would
+        # defeat the lazy-slab discipline.  Membership goes through a
+        # per-slab frozenset cached on the slab (an undeclared attr,
+        # like _id_idx, so it stays off the wire codec) — a linear scan
+        # of a 10M-entry node_ids list per node registration would stall
+        # the store lock for hundreds of ms.
+        if self._pending_slabs and any(
+                node_id in self._slab_node_set(slab)
+                for slab in self._pending_slabs):
+            self._materialize_pending()
+        ids = self._idx_get(self._allocs_by_node, node_id)
+        if not ids:
+            return
+        c = m = d = io = 0
+        for aid in ids:
+            v = self.allocs_table.get(aid)
+            if v is None:
+                continue
+            r = v.proto if type(v) is s.AllocSlab else v
+            if r.terminal_status():
+                continue
+            vec = self._usage_vec(r)
+            c += vec[0]
+            m += vec[1]
+            d += vec[2]
+            io += vec[3]
+        cols.usage[row] = (c, m, d, io)
 
     # -- immutable index-set updates ---------------------------------------
     #
@@ -403,6 +549,7 @@ class StateStore:
                 node.create_index = index
             node.modify_index = index
             self.nodes_table[node.id] = node
+            self._col_node_upserted(node, existing)
             self._bump("nodes", index)
         eb = self.event_broker
         if eb is not None:
@@ -418,6 +565,9 @@ class StateStore:
             if node_id not in self.nodes_table:
                 raise KeyError(f"node not found: {node_id}")
             del self.nodes_table[node_id]
+            # Deletion shifts every later row: drop the mirror (the
+            # owner rebuilds at the next snapshot()/columns() call).
+            self._columns = None
             self._bump("nodes", index)
         eb = self.event_broker
         if eb is not None:
@@ -434,6 +584,8 @@ class StateStore:
             node.status = status
             node.modify_index = index
             self.nodes_table[node_id] = node
+            if self._columns is not None:
+                self._columns.set_eligible(node_id, node.ready())
             self._bump("nodes", index)
         eb = self.event_broker
         if eb is not None:
@@ -451,6 +603,8 @@ class StateStore:
             node.drain = drain
             node.modify_index = index
             self.nodes_table[node_id] = node
+            if self._columns is not None:
+                self._columns.set_eligible(node_id, node.ready())
             self._bump("nodes", index)
         eb = self.event_broker
         if eb is not None:
@@ -1601,8 +1755,196 @@ class StateStore:
 
     # -- persistence (FSM snapshot support) --------------------------------
 
+    #: v2 binary snapshot magic (state/columnar.py container format).
+    #: Legacy blobs are bare msgpack maps whose first byte can never be
+    #: ASCII "N", so an 8-byte prefix sniff is unambiguous.
+    SNAP2_MAGIC = b"NTPUSNP2"
+
     def persist(self) -> bytes:
-        """Serialize all tables for an FSM snapshot (fsm.go:568 Snapshot)."""
+        """Serialize all tables for an FSM snapshot (fsm.go:568
+        Snapshot).  Columnar-enabled stores write the v2 binary format
+        (struct-of-arrays node section, slabs kept columnar,
+        length-prefixed dtype+shape+bytes numpy columns — a 1M-node
+        cluster persists in seconds); ``NOMAD_TPU_COLUMNAR=0`` restores
+        the legacy per-object msgpack blob."""
+        if columnar.enabled():
+            return self._persist_columnar()
+        return self._persist_legacy()
+
+    @staticmethod
+    def _slab_col_spec(col):
+        """Wire form of one slab string column: lazy formulaic columns
+        ship as their 3-field generator spec (1M ids -> ~40 bytes)."""
+        if isinstance(col, s.LazyUuids):
+            return {"lz": "u", "p": col.prefix, "n": col.n}
+        if isinstance(col, s.LazyNames):
+            return {"lz": "n", "p": col.prefix, "n": col.n}
+        return list(col)
+
+    @staticmethod
+    def _slab_col_load(v):
+        if isinstance(v, dict):
+            if v["lz"] == "u":
+                return s.LazyUuids(v["n"], v["p"])
+            return s.LazyNames(v["n"], v["p"])
+        return v
+
+    def _persist_columnar(self) -> bytes:
+        """v2: msgpack envelope of {tables, nodes SoA, standalone
+        allocs, columnar slabs, numpy columns}.  Slabs are NOT
+        materialized — their protos ship once and the string columns
+        ship as columns (lazy ones as generator specs), which is where
+        the 1M-alloc win lives; restore re-installs them as pending
+        slabs (the lazy-rehydration path readers already drain)."""
+        import msgpack
+
+        from ..api.codec import to_wire
+        from ..server.log_codec import encode_payload
+
+        with self._lock:
+            # Shared job trees referenced from alloc rows/protos are
+            # deduplicated by identity into one list (the legacy
+            # alloc_jobs discipline).
+            alloc_jobs: List[s.Job] = []
+            job_ref_by_identity: Dict[int, int] = {}
+
+            def ref_job(j: s.Job) -> int:
+                r = job_ref_by_identity.get(id(j))
+                if r is None:
+                    r = job_ref_by_identity[id(j)] = len(alloc_jobs)
+                    alloc_jobs.append(j)
+                return r
+
+            table = self.allocs_table
+            allocs_out: Dict[str, s.Allocation] = {}
+            alloc_job_refs: Dict[str, int] = {}
+            slab_docs: List[dict] = []
+            seen_slabs: Set[int] = set()
+
+            def slab_doc(slab: s.AllocSlab, dead: List[int]) -> dict:
+                proto = slab.proto
+                jr = None
+                if proto.job is not None:
+                    jr = ref_job(proto.job)
+                    proto = s._fast_copy(proto)
+                    proto.job = None
+                return {"proto": to_wire(proto), "job_ref": jr,
+                        "ids": self._slab_col_spec(slab.ids),
+                        "names": self._slab_col_spec(slab.names),
+                        "node_ids": list(slab.node_ids),
+                        "prev_ids": self._slab_col_spec(slab.prev_ids),
+                        "ci": slab.create_index, "mi": slab.modify_index,
+                        "dead": dead}
+
+            for aid, v in table.items():
+                if type(v) is s.AllocSlab:
+                    if id(v) in seen_slabs:
+                        continue
+                    seen_slabs.add(id(v))
+                    # Slots whose table entry was replaced (client
+                    # update cache-back) or removed persist through
+                    # their own row / not at all.
+                    dead = [i for i, aid2 in enumerate(v.ids)
+                            if table.get(aid2) is not v]
+                    slab_docs.append(slab_doc(v, dead))
+                else:
+                    a = v
+                    if a.job is not None:
+                        alloc_job_refs[aid] = ref_job(a.job)
+                        a = s._fast_copy(a)
+                        a.job = None
+                    allocs_out[aid] = a
+            # Pending slabs (deferred indexing) are disjoint from table
+            # values and have no replaced slots by construction.
+            for slab in self._pending_slabs:
+                slab_docs.append(slab_doc(slab, []))
+
+            # Node table as struct-of-arrays: scalar fields as parallel
+            # lists (one C-speed msgpack pack), resource 4-vectors as
+            # binary arrays, networks sparse (absent on fleet nodes).
+            nodes = list(self.nodes_table.values())
+            n = len(nodes)
+            cap = np.zeros((n, columnar.RES_DIMS), dtype=np.int64)
+            resv = np.zeros((n, columnar.RES_DIMS), dtype=np.int64)
+            res_present: List[bool] = []
+            nets: Dict[str, list] = {}
+            rnets: Dict[str, list] = {}
+            for i, nd in enumerate(nodes):
+                r = nd.resources
+                if r is not None:
+                    cap[i] = (r.cpu, r.memory_mb, r.disk_mb, r.iops)
+                    if r.networks:
+                        nets[str(i)] = [to_wire(x) for x in r.networks]
+                rv = nd.reserved
+                if rv is None:
+                    res_present.append(False)
+                else:
+                    res_present.append(True)
+                    resv[i] = (rv.cpu, rv.memory_mb, rv.disk_mb, rv.iops)
+                    if rv.networks:
+                        rnets[str(i)] = [to_wire(x) for x in rv.networks]
+            node_soa = {
+                "id": [nd.id for nd in nodes],
+                "name": [nd.name for nd in nodes],
+                "datacenter": [nd.datacenter for nd in nodes],
+                "http_addr": [nd.http_addr for nd in nodes],
+                "node_class": [nd.node_class for nd in nodes],
+                "computed_class": [nd.computed_class for nd in nodes],
+                "status": [nd.status for nd in nodes],
+                "status_description": [nd.status_description
+                                       for nd in nodes],
+                "drain": [nd.drain for nd in nodes],
+                "status_updated_at": [nd.status_updated_at for nd in nodes],
+                "create_index": [nd.create_index for nd in nodes],
+                "modify_index": [nd.modify_index for nd in nodes],
+                "attributes": [nd.attributes for nd in nodes],
+                "meta": [nd.meta for nd in nodes],
+                "links": [nd.links for nd in nodes],
+                "cap": columnar.pack_array(cap),
+                "res": columnar.pack_array(resv),
+                "res_present": res_present,
+                "networks": nets,
+                "res_networks": rnets,
+            }
+
+            tables_blob = encode_payload({
+                "jobs": self.jobs_table,
+                "job_versions": self.job_versions,
+                "job_summary": self.job_summary_table,
+                "evals": self.evals_table,
+                "periodic_launch": self.periodic_launch_table,
+                "vault_accessors": self.vault_accessors_table,
+                "deployments": self.deployments_table,
+                "indexes": self._indexes,
+            })
+            allocs_blob = encode_payload({
+                "rows": allocs_out,
+                "jobs": alloc_jobs,
+                "refs": alloc_job_refs,
+            })
+
+            # Numeric columns ride along when the mirror is warm so the
+            # restored store encodes without a cold column build.
+            col_blob = col_meta = None
+            cols = (self._ensure_columns_locked()
+                    if columnar.enabled() else None)
+            if cols is not None and cols.epoch == columnar.EPOCH:
+                if not cols.fold_usage(self):
+                    cols.rebuild_usage(self)
+                col_blob = columnar.pack_columns(cols)
+                col_meta = {"dc": list(cols.dc_book)[:cols.dc_len],
+                            "class": list(cols.class_book)[:cols.class_len],
+                            "usage_index": cols.usage_index}
+
+            doc = {"tables": tables_blob, "nodes": node_soa,
+                   "allocs": allocs_blob, "slabs": slab_docs,
+                   "columns": col_blob, "colmeta": col_meta}
+            return self.SNAP2_MAGIC + msgpack.packb(doc, use_bin_type=True)
+
+    def _persist_legacy(self) -> bytes:
+        """Legacy per-object msgpack snapshot (the pre-columnar format;
+        still written under ``NOMAD_TPU_COLUMNAR=0`` and always
+        readable)."""
         with self._lock:
             if self._pending_slabs:
                 self._materialize_pending()
@@ -1654,7 +1996,11 @@ class StateStore:
     @classmethod
     def restore(cls, blob: bytes) -> "StateStore":
         """Rebuild a store (and its secondary indexes) from a snapshot
-        (fsm.go:582 Restore)."""
+        (fsm.go:582 Restore).  Sniffs the v2 magic; legacy msgpack blobs
+        keep restoring through the old path (upgrade compatibility in
+        both directions)."""
+        if blob[:len(cls.SNAP2_MAGIC)] == cls.SNAP2_MAGIC:
+            return cls._restore_columnar(blob)
         from ..server.log_codec import decode_payload
 
         payload = decode_payload(blob)
@@ -1688,6 +2034,143 @@ class StateStore:
         # The usage-delta log is not persisted: the restored store starts
         # an empty log with the floor at the restored allocs index, so
         # any resident consumer from before the restore full re-encodes.
+        store._alloc_log_floor = store._indexes.get("allocs", 0)
+        return store
+
+    @classmethod
+    def _restore_columnar(cls, blob: bytes) -> "StateStore":
+        """v2 restore: node objects rebuilt struct-of-arrays-fast
+        (``__new__`` + direct ``__dict__``), slabs re-installed as
+        PENDING (per-alloc table rows and node-index cells rehydrate
+        lazily on first read, exactly like a live bulk commit), numpy
+        columns installed from their binary section."""
+        import msgpack
+
+        from ..api.codec import from_wire
+        from ..server.log_codec import decode_payload
+
+        doc = msgpack.unpackb(blob[len(cls.SNAP2_MAGIC):], raw=False)
+        store = cls()
+        t = decode_payload(doc["tables"])
+        store.jobs_table = t["jobs"]
+        store.job_versions = t["job_versions"]
+        store.job_summary_table = t["job_summary"]
+        store.evals_table = t["evals"]
+        store.periodic_launch_table = t["periodic_launch"]
+        store.vault_accessors_table = t["vault_accessors"]
+        store.deployments_table = t["deployments"]
+        store._indexes = t["indexes"]
+
+        # -- nodes: SoA -> objects without dataclass __init__ ----------
+        nd = doc["nodes"]
+        ids = nd["id"]
+        n = len(ids)
+        cap = columnar.unpack_array(memoryview(nd["cap"]), 0)[0].tolist()
+        resv = columnar.unpack_array(memoryview(nd["res"]), 0)[0].tolist()
+        res_present = nd["res_present"]
+        nets = nd["networks"] or {}
+        rnets = nd["res_networks"] or {}
+
+        def mk_nets(lst):
+            return [from_wire(s.NetworkResource, x) for x in lst]
+
+        new = object.__new__
+        R, ND = s.Resources, s.Node
+        names, dcs = nd["name"], nd["datacenter"]
+        https, ncls, ccls = nd["http_addr"], nd["node_class"], \
+            nd["computed_class"]
+        sts, stsd, drains = nd["status"], nd["status_description"], \
+            nd["drain"]
+        supd, cidx, midx = nd["status_updated_at"], nd["create_index"], \
+            nd["modify_index"]
+        attrs, metas, links = nd["attributes"], nd["meta"], nd["links"]
+        nodes_table = store.nodes_table
+        for i in range(n):
+            c = cap[i]
+            r = new(R)
+            r.__dict__ = {"cpu": c[0], "memory_mb": c[1], "disk_mb": c[2],
+                          "iops": c[3],
+                          "networks": (mk_nets(nets[str(i)])
+                                       if str(i) in nets else [])}
+            if res_present[i]:
+                v = resv[i]
+                rv = new(R)
+                rv.__dict__ = {"cpu": v[0], "memory_mb": v[1],
+                               "disk_mb": v[2], "iops": v[3],
+                               "networks": (mk_nets(rnets[str(i)])
+                                            if str(i) in rnets else [])}
+            else:
+                rv = None
+            node = new(ND)
+            node.__dict__ = {
+                "id": ids[i], "datacenter": dcs[i], "name": names[i],
+                "http_addr": https[i], "attributes": attrs[i],
+                "resources": r, "reserved": rv, "links": links[i],
+                "meta": metas[i], "node_class": ncls[i],
+                "computed_class": ccls[i], "drain": drains[i],
+                "status": sts[i], "status_description": stsd[i],
+                "status_updated_at": supd[i], "create_index": cidx[i],
+                "modify_index": midx[i],
+            }
+            nodes_table[ids[i]] = node
+
+        # -- standalone alloc rows (eager: the small set) ---------------
+        a = decode_payload(doc["allocs"])
+        alloc_jobs = a["jobs"]
+        store.allocs_table = a["rows"]
+        for aid, ref in a["refs"].items():
+            row = store.allocs_table.get(aid)
+            if row is not None and 0 <= ref < len(alloc_jobs):
+                row.job = alloc_jobs[ref]
+        for alloc in store.allocs_table.values():
+            store._allocs_by_node[alloc.node_id].add(alloc.id)
+            store._allocs_by_job[alloc.job_id].add(alloc.id)
+            store._allocs_by_eval[alloc.eval_id].add(alloc.id)
+
+        # -- slabs: re-install as pending (lazy rehydration) ------------
+        for sd in doc["slabs"]:
+            proto = from_wire(s.Allocation, sd["proto"])
+            jr = sd.get("job_ref")
+            if jr is not None and 0 <= jr < len(alloc_jobs):
+                proto.job = alloc_jobs[jr]
+            slab = s.AllocSlab(
+                proto=proto,
+                ids=cls._slab_col_load(sd["ids"]),
+                names=cls._slab_col_load(sd["names"]),
+                node_ids=sd["node_ids"],
+                prev_ids=cls._slab_col_load(sd["prev_ids"]),
+                create_index=sd["ci"], modify_index=sd["mi"])
+            dead = sd.get("dead")
+            if dead:
+                deadset = set(dead)
+                keep = [i for i in range(len(slab.ids))
+                        if i not in deadset]
+                slab = s.AllocSlab(
+                    proto=proto,
+                    ids=[slab.ids[i] for i in keep],
+                    names=[slab.names[i] for i in keep],
+                    node_ids=[slab.node_ids[i] for i in keep],
+                    prev_ids=([slab.prev_ids[i] for i in keep]
+                              if slab.prev_ids else []),
+                    create_index=sd["ci"], modify_index=sd["mi"])
+            store._pending_slabs.append(slab)
+            store._pending_by_job.setdefault(proto.job_id, []).append(slab)
+            store._idx_append(store._allocs_by_job, proto.job_id, slab.ids)
+            store._idx_append(store._allocs_by_eval, proto.eval_id,
+                              slab.ids)
+
+        for ev in store.evals_table.values():
+            store._evals_by_job[ev.job_id].add(ev.id)
+        for acc in store.vault_accessors_table.values():
+            store._vault_by_alloc[acc.alloc_id].add(acc.accessor)
+            store._vault_by_node[acc.node_id].add(acc.accessor)
+
+        # -- numpy columns (warm encode start) --------------------------
+        if doc.get("columns") is not None:
+            cm = doc["colmeta"]
+            store._columns = columnar.unpack_columns(
+                doc["columns"], ids, cm["dc"], cm["class"],
+                cm["usage_index"])
         store._alloc_log_floor = store._indexes.get("allocs", 0)
         return store
 

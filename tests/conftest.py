@@ -17,6 +17,11 @@ if "xla_force_host_platform_device_count" not in flags:
 # plugin wins over the env var, so override through the config API (must
 # happen before any backend is initialized).
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Columnar differential guard at EVERY encode (ISSUE 9 acceptance: the
+# whole suite verifies the column-built buffers bit-identical to the
+# object walk; a single mismatch trips the breaker and fails the
+# asserting tests).  Respect an explicit override from the environment.
+os.environ.setdefault("NOMAD_TPU_COLUMNAR_GUARD_EVERY", "1")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

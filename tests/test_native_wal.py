@@ -89,6 +89,19 @@ class TestNativeWAL:
             assert mine == [f"t{k}-{i}".encode() for i in range(per)]
         w2.close()
 
+    def test_write_then_sync_split_api(self, tmp_path):
+        """The raft log's two-phase path: write() buffers in order,
+        sync_to() group-commits; records are durable and replayable."""
+        p = str(tmp_path / "wal.crc")
+        w = NativeWAL(p)
+        seqs = [w.write(f"s{i}".encode()) for i in range(20)]
+        assert seqs == list(range(1, 21))
+        w.sync_to(seqs[-1])  # one fsync covers the whole batch
+        w.close()
+        w2 = NativeWAL(p)
+        assert list(w2.records()) == [f"s{i}".encode() for i in range(20)]
+        w2.close()
+
     def test_reset(self, tmp_path):
         p = str(tmp_path / "wal.crc")
         w = NativeWAL(p)
@@ -213,6 +226,81 @@ class TestFileLogNative:
         assert log3.fsm.state.node_by_id(None, node.id) is not None
         assert log3.fsm.state.job_by_id(None, job.id) is not None
         log3.close()
+
+    def test_failed_fsm_apply_does_not_wedge_the_sequencer(self, tmp_path):
+        """An FSM apply that raises (deregister of an unknown node)
+        propagates to its caller but must not wedge the apply sequencer
+        for every later entry."""
+        from nomad_tpu import mock
+
+        log, MT = self._mk(str(tmp_path / "raft"))
+        with pytest.raises(KeyError):
+            log.apply(MT.NODE_DEREGISTER, {"node_id": "no-such-node"})
+        node = mock.node()
+        log.apply(MT.NODE_REGISTER, {"node": node})  # must not block
+        assert log.fsm.state.node_by_id(None, node.id) is not None
+        log.snapshot()  # drain loop must not spin either
+        log.close()
+
+    def test_concurrent_applies_group_commit_durable(self, tmp_path):
+        """Concurrent raft appliers overlap their durability waits (the
+        fsync happens OUTSIDE the apply lock); every acked entry must
+        survive a reopen, in index order with no gaps."""
+        import threading
+
+        from nomad_tpu import mock
+
+        data_dir = str(tmp_path / "raft")
+        log, MT = self._mk(data_dir)
+        n_threads, per = 6, 20
+
+        def worker(k):
+            for _ in range(per):
+                log.apply(MT.NODE_REGISTER, {"node": mock.node()})
+
+        ts = [threading.Thread(target=worker, args=(k,))
+              for k in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        applied = log.applied_index()
+        assert applied == n_threads * per
+        log.close()
+
+        log2, _ = self._mk(data_dir)
+        assert log2.applied_index() == applied
+        assert len(log2.fsm.state.nodes(None)) == n_threads * per
+        log2.close()
+
+    def test_concurrent_applies_durable_python_fallback(self, tmp_path,
+                                                        monkeypatch):
+        """Same guarantee through the pure-Python group-commit twin."""
+        import threading
+
+        from nomad_tpu import mock
+
+        monkeypatch.setenv("NOMAD_TPU_NO_NATIVE", "1")
+        data_dir = str(tmp_path / "raft")
+        log, MT = self._mk(data_dir)
+        assert log._nwal is None
+
+        def worker(k):
+            for _ in range(15):
+                log.apply(MT.NODE_REGISTER, {"node": mock.node()})
+
+        ts = [threading.Thread(target=worker, args=(k,)) for k in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        applied = log.applied_index()
+        assert applied == 60
+        log.close()
+
+        log2, _ = self._mk(data_dir)
+        assert log2.applied_index() == applied
+        log2.close()
 
     def test_snapshot_truncates_both_logs(self, tmp_path):
         from nomad_tpu import mock
