@@ -750,10 +750,14 @@ def bench_steady(n_nodes: int = E_N_NODES, n_batches: int = 200,
     of ``n_batches`` small eval batches through the device-resident
     delta path + double-buffered pipeline (ops/resident.py +
     schedule_stream), then the SAME workload shape with residency off
-    (full O(cluster) usage re-encode per batch) as the in-run baseline.
-    Reports sustained placed/s and per-batch p50/p95 for both, the
-    on/off speedup (acceptance bar: >= 2x), and the differential-guard
-    mismatch count (must be 0)."""
+    (full O(cluster) usage re-encode per batch) as an in-run reference.
+    The acceptance metric is the ABSOLUTE residency-on sustained
+    placed/s (guarded vs the latest baseline in ``--check``) and the
+    differential-guard mismatch count (must be 0); the on/off ratio is
+    reported for context only — PR 9's columnar fold sped the OFF leg
+    up too, so the ratio shrinks whenever an unrelated win lands and
+    cannot be a regression gate.  ``off_batches=0`` skips the OFF leg
+    entirely (the --check shape)."""
     import os
 
     from nomad_tpu.ops import resident
@@ -810,18 +814,23 @@ def bench_steady(n_nodes: int = E_N_NODES, n_batches: int = 200,
                 out_batches.append(evals)
             return out_jobs, out_batches
 
-        os.environ["NOMAD_TPU_RESIDENT"] = "0"
-        off_jobs, off_evbatches = build_batches(off_batches)
-        sink_off = InmemSink(interval=3600.0)
-        sched = TPUBatchScheduler(h.logger, h.snapshot(), h)
-        t0 = time.monotonic()
-        for evals in off_evbatches:
-            sched.state = h.snapshot()
-            stt = sched.schedule_batch(evals)
-            sink_off.add_sample("steady.batch", stt.total_seconds * 1000.0)
-        off_elapsed = time.monotonic() - t0
-        placed_off = total_placed(h, off_jobs)
-        samp_off = sink_off.latest()["Samples"]["steady.batch"]
+        samp_off = None
+        placed_off = 0
+        off_elapsed = 0.0
+        if off_batches:
+            os.environ["NOMAD_TPU_RESIDENT"] = "0"
+            off_jobs, off_evbatches = build_batches(off_batches)
+            sink_off = InmemSink(interval=3600.0)
+            sched = TPUBatchScheduler(h.logger, h.snapshot(), h)
+            t0 = time.monotonic()
+            for evals in off_evbatches:
+                sched.state = h.snapshot()
+                stt = sched.schedule_batch(evals)
+                sink_off.add_sample("steady.batch",
+                                    stt.total_seconds * 1000.0)
+            off_elapsed = time.monotonic() - t0
+            placed_off = total_placed(h, off_jobs)
+            samp_off = sink_off.latest()["Samples"]["steady.batch"]
 
         os.environ["NOMAD_TPU_RESIDENT"] = "1"
         on_jobs, batches = build_batches(n_batches)
@@ -851,16 +860,19 @@ def bench_steady(n_nodes: int = E_N_NODES, n_batches: int = 200,
     rate_on = placed_on / on_elapsed if on_elapsed else 0.0
     rate_off = placed_off / off_elapsed if off_elapsed else 0.0
     speedup = rate_on / rate_off if rate_off else 0.0
+    off_note = (f"; OFF {placed_off} placed in {off_elapsed:.2f}s → "
+                f"{rate_off:.0f}/s (p50 {samp_off['p50']:.1f}ms p95 "
+                f"{samp_off['p95']:.1f}ms) → ratio {speedup:.2f}x "
+                "(context only; the guard is the absolute ON rate)"
+                if samp_off is not None else "")
     log(f"config-steady: warm {n_nodes} nodes, {n_batches} batches x "
         f"{evals_per_batch} evals x {count_per_eval} tgs: residency ON "
         f"{placed_on} placed in {on_elapsed:.2f}s → {rate_on:.0f}/s "
         f"(p50 {samp_on['p50']:.1f}ms p95 {samp_on['p95']:.1f}ms, "
         f"{hits}/{n_batches} delta hits, {delta_rows} delta rows, "
-        f"guard {guard_runs} runs / {mismatches} mismatches); OFF "
-        f"{placed_off} placed in {off_elapsed:.2f}s → {rate_off:.0f}/s "
-        f"(p50 {samp_off['p50']:.1f}ms p95 {samp_off['p95']:.1f}ms) → "
-        f"speedup {speedup:.2f}x")
-    return {
+        f"guard {guard_runs} runs / {mismatches} mismatches)"
+        + off_note)
+    out = {
         "nodes": n_nodes, "warm_allocs": n_nodes,
         "batches": n_batches, "evals_per_batch": evals_per_batch,
         "taskgroups_per_eval": count_per_eval,
@@ -871,20 +883,24 @@ def bench_steady(n_nodes: int = E_N_NODES, n_batches: int = 200,
         "pipeline_overlap_s": round(overlap_s, 3),
         "batch_latency_note": (
             "ON p50/p95 are per-batch wall latencies inside the pipeline "
-            "(they include interleaved neighbor host phases); the "
-            "speedup compares sustained placed/s, not latencies"),
+            "(they include interleaved neighbor host phases)"),
         "guard_runs": guard_runs, "guard_mismatches": mismatches,
-        "residency_off": {
-            "batches": off_batches,
-            "sustained_placed_per_s": round(rate_off, 1),
-            "batch_p50_ms": round(samp_off["p50"], 2),
-            "batch_p95_ms": round(samp_off["p95"], 2)},
-        "speedup_vs_residency_off": round(speedup, 2),
-        "speedup_target": 2.0,
-        "speedup_target_met": speedup >= 2.0,
+        "acceptance_note": (
+            "guarded on ABSOLUTE residency-on sustained placed/s (and "
+            "guard mismatches == 0); the on/off ratio is context only — "
+            "PR 9's columnar fold sped the OFF leg too, so the ratio "
+            "shrinks on unrelated wins"),
         "compile_warmup_s": round(compile_s, 3),
         "elapsed_s": round(on_elapsed, 3),
     }
+    if samp_off is not None:
+        out["residency_off"] = {
+            "batches": off_batches,
+            "sustained_placed_per_s": round(rate_off, 1),
+            "batch_p50_ms": round(samp_off["p50"], 2),
+            "batch_p95_ms": round(samp_off["p95"], 2)}
+        out["speedup_vs_residency_off"] = round(speedup, 2)
+    return out
 
 
 def bench_control_plane(nodes: int = 800, submissions: int = 800):
@@ -925,6 +941,50 @@ def bench_control_plane(nodes: int = 800, submissions: int = 800):
         f"M=4 stale {out['m4_evals_per_s']} evals/s "
         f"({out['speedup']}x), submit→running p99 "
         f"{out['submit_to_running_p99_ms']}ms")
+    return out
+
+
+def bench_follower_scale(nodes: int = 2000, submissions: int = 160):
+    """config_follower: horizontal control-plane scale-out (ISSUE 10) —
+    the loadgen harness offers the same seeded gang-scale burst to (a)
+    ONE server with M workers and (b) 1 leader + follower-scheduler
+    SUBPROCESSES (each scheduling off its own replicated FSM on its own
+    interpreter, forwarding plans to the leader's serialized
+    plan-apply).  Scaled down from the full `multi_server` scenario to
+    fit the bench budget; the full-scale evidence (including the
+    cluster_leader_sched comparison leg) lives in LOADGEN_r03.json."""
+    from dataclasses import replace
+
+    from nomad_tpu.loadgen.harness import compare_servers
+    from nomad_tpu.loadgen.scenario import get_scenario
+
+    sc = replace(get_scenario("multi_server"), num_nodes=nodes,
+                 max_submissions=submissions, subscribers=16,
+                 drain_s=90.0)
+    cmp = compare_servers(sc, cluster_leg=False)
+    pf = cmp.get("plan_forward") or {}
+    out = {
+        "nodes": nodes, "submissions": submissions,
+        "servers": sc.num_servers,
+        "leader_workers": sc.leader_workers,
+        "follower_workers": sc.follower_workers or sc.num_workers,
+        "single_evals_per_s":
+            cmp["evals_per_s"][f"single_m{sc.num_workers}"],
+        "multi_evals_per_s":
+            cmp["evals_per_s"]["cluster_follower_sched"],
+        "speedup": cmp["speedup"],
+        "double_placements": cmp["double_placements"]["multi"],
+        "plan_conflicts": cmp["plan_conflicts"]["multi"],
+        "forwarded_plans": pf.get("forwarded_total"),
+        "plan_forward_rtt_p99_ms": pf.get("rtt_p99_ms_max"),
+        "lag_handbacks": pf.get("lag_handbacks_total"),
+        "stragglers": cmp["stragglers"]["multi"],
+    }
+    log(f"  follower-scale: single {out['single_evals_per_s']} evals/s, "
+        f"{sc.num_servers} servers {out['multi_evals_per_s']} evals/s "
+        f"({out['speedup']}x), {out['forwarded_plans']} plans forwarded "
+        f"(rtt p99 {out['plan_forward_rtt_p99_ms']}ms), "
+        f"{out['double_placements']} double placements")
     return out
 
 
@@ -1544,6 +1604,12 @@ def _child_main():
     if cp is not None:
         detail["config_control"] = cp
 
+    # Follower-read scale-out (ISSUE 10): host-only, subprocess
+    # followers put the scheduling CPU on their own interpreters.
+    fs = phase("config_follower", 300, bench_follower_scale)
+    if fs is not None:
+        detail["config_follower"] = fs
+
     # Fused vs two-phase differential (PR 6): same problem through both
     # device programs; the delta must be exactly 0.0%.
     fd = phase("fused_vs_two_phase", 90, bench_fused_delta)
@@ -1818,6 +1884,22 @@ def _latest_bench_baseline():
     return (None,) * 11
 
 
+def _loadgen_follower_baseline():
+    """Check-scale numbers recorded in LOADGEN_r03.json →
+    (multi_evals_per_s, speedup) or (None, None).  The r03 file records
+    the full `multi_server` scenario AND a `check_scale` run at the
+    bench_follower_scale shape, so the --check guard compares
+    like-for-like."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        with open(os.path.join(here, "LOADGEN_r03.json")) as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return None, None
+    cs = doc.get("check_scale") or {}
+    return cs.get("multi_evals_per_s"), cs.get("speedup")
+
+
 CHECK_THRESHOLD_DEFAULT = 1.5
 
 
@@ -1938,13 +2020,17 @@ def _check_main(argv) -> int:
             out["config_e_elapsed_s"] = {"error": repr(exc)}
             failures.append(f"config_e phase failed: {exc!r}")
     if base_steady is not None:
-        # Throughput guard: regression = falling BELOW baseline/threshold
-        # (the inverse of the elapsed-time guards).  Reduced batch counts
-        # keep the check fast; sustained rate is warm-state, so it
-        # compares like-for-like with the full run.
+        # Throughput guard on the ABSOLUTE residency-on rate: regression
+        # = falling BELOW baseline/threshold (the inverse of the
+        # elapsed-time guards).  The residency-off leg is skipped here
+        # (off_batches=0): it existed only for the on/off ratio, which
+        # is no longer a gate — PR 9's columnar fold sped the OFF leg
+        # up too, so the ratio punished unrelated wins.  Reduced batch
+        # count keeps the check fast; sustained rate is warm-state, so
+        # it compares like-for-like with the full run.
         try:
             with _deadline(240, "check_config_steady"):
-                sdy = bench_steady(n_batches=60, off_batches=8)
+                sdy = bench_steady(n_batches=60, off_batches=0)
             cur = float(sdy["sustained_placed_per_s"])
             out["config_steady_placed_per_s"] = {
                 "baseline": base_steady, "current": cur,
@@ -1998,6 +2084,46 @@ def _check_main(argv) -> int:
     except Exception as exc:
         out["control_plane_evals_per_s"] = {"error": repr(exc)}
         failures.append(f"control-plane phase failed: {exc!r}")
+
+    # Follower-read scale-out guard (ISSUE 10): 1 leader + 2 follower-
+    # scheduler subprocesses vs one server at the same offered load.
+    # Hard gates: ZERO double placements and no stragglers (the
+    # correctness bar); sustained multi-server evals/s additionally
+    # guards against the check-scale run recorded in LOADGEN_r03.json
+    # (the full-scale ≥1.5x evidence lives in that file's main run).
+    base_follower, base_follower_speedup = _loadgen_follower_baseline()
+    try:
+        with _deadline(480, "check_follower_scale"):
+            fsc = bench_follower_scale()
+        out["follower_scale_evals_per_s"] = {
+            "baseline": base_follower,
+            "current": fsc["multi_evals_per_s"],
+            "speedup_vs_single": fsc["speedup"],
+            "baseline_speedup": base_follower_speedup,
+            "ratio": (round(fsc["multi_evals_per_s"] / base_follower, 3)
+                      if base_follower else None)}
+        out["follower_scale_integrity"] = {
+            "double_placements": fsc["double_placements"],
+            "plan_conflicts": fsc["plan_conflicts"],
+            "lag_handbacks": fsc["lag_handbacks"]}
+        if fsc["double_placements"]:
+            failures.append(
+                f"follower-scale run produced "
+                f"{fsc['double_placements']} double placements — the "
+                "follower-read fence must make these impossible")
+        if fsc["stragglers"]:
+            failures.append(
+                f"follower-scale run left {fsc['stragglers']} "
+                "stragglers after drain")
+        if base_follower is not None \
+                and fsc["multi_evals_per_s"] < base_follower / threshold:
+            failures.append(
+                f"follower-scale sustained {fsc['multi_evals_per_s']:.0f} "
+                f"evals/s is below baseline "
+                f"{base_follower:.0f}/{threshold}")
+    except Exception as exc:
+        out["follower_scale_evals_per_s"] = {"error": repr(exc)}
+        failures.append(f"follower-scale phase failed: {exc!r}")
 
     # FSM snapshot+restore guard (ISSUE 9): the columnar persist+restore
     # wall time must not regress past threshold x baseline.  Measured

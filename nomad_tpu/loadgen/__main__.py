@@ -17,6 +17,77 @@ from .report import render_report, write_report
 from .scenario import BUILTIN_SCENARIOS, get_scenario, load_scenario
 
 
+def _start_child_sampler() -> None:
+    """NOMAD_TPU_LG_PROFILE=1: sample every thread's top frames and dump
+    the histogram to stderr at exit — the poor man's py-spy for tuning
+    follower-scheduler subprocesses."""
+    import atexit
+    import collections
+    import threading
+    import time
+
+    samples: collections.Counter = collections.Counter()
+
+    def sampler():
+        me = threading.get_ident()
+        while True:
+            for tid, frame in sys._current_frames().items():
+                if tid == me:
+                    continue
+                f, stack = frame, []
+                for _ in range(3):
+                    if f is None:
+                        break
+                    stack.append(f"{f.f_code.co_filename.rsplit('/', 1)[-1]}"
+                                 f":{f.f_code.co_name}")
+                    f = f.f_back
+                samples["|".join(stack)] += 1
+            time.sleep(0.005)
+
+    threading.Thread(target=sampler, daemon=True).start()
+    atexit.register(lambda: print(
+        "\n".join(f"{n:6d}  {s}" for s, n in samples.most_common(25)),
+        file=sys.stderr, flush=True))
+
+
+def _follower_child_main(args) -> int:
+    """Follower-scheduler server subprocess (spawned by the harness for
+    multi-server scenarios): joins the leader, runs FollowerWorkers off
+    its replicated FSM, prints ``READY <addr>`` once serving, and parks
+    until the parent closes stdin."""
+    import os
+
+    os.environ.setdefault("NOMAD_TPU_FOLLOWER_SCHED", "1")
+    from ..server import Server, ServerConfig
+    from .harness import _apply_switch_interval
+
+    _apply_switch_interval()
+
+    if not args.join:
+        print("ERROR --follower-child requires --join", flush=True)
+        return 2
+    srv = Server(ServerConfig(
+        node_name=args.name or "lg-follower",
+        enable_rpc=True, start_join=[args.join], bootstrap_expect=1,
+        num_schedulers=max(0, args.workers), min_heartbeat_ttl=60.0,
+        non_voting=getattr(args, "non_voting", False)),
+        logger=logging.getLogger("nomad_tpu.loadgen.follower"))
+    if hasattr(srv.metrics.sink, "interval"):
+        # One aggregation window for the whole run, like the harness
+        # leader: the parent collects RTT/lag histograms at teardown.
+        srv.metrics.sink.interval = 3600.0
+    if os.environ.get("NOMAD_TPU_LG_PROFILE", "").strip() == "1":
+        _start_child_sampler()
+    srv.start()
+    print(f"READY {srv.config.rpc_advertise}", flush=True)
+    try:
+        sys.stdin.read()  # EOF = parent teardown
+    except (OSError, KeyboardInterrupt):
+        pass
+    srv.shutdown()
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m nomad_tpu.loadgen",
@@ -41,6 +112,20 @@ def main(argv=None) -> int:
     p.add_argument("--compare-wal", action="store_true",
                    help="run WAL-off then WAL-on and report the "
                         "plan-apply durability cost")
+    p.add_argument("--servers", type=int, default=0,
+                   help="override scenario num_servers (1 leader + N-1 "
+                        "follower-scheduler subprocesses)")
+    p.add_argument("--compare-servers", action="store_true",
+                   help="run single-server then multi-server on the same "
+                        "offered load and report the scale-out speedup")
+    # Internal: the follower-scheduler subprocess entry (spawned by the
+    # harness; parks on stdin EOF).
+    p.add_argument("--follower-child", action="store_true",
+                   help=argparse.SUPPRESS)
+    p.add_argument("--join", default="", help=argparse.SUPPRESS)
+    p.add_argument("--name", default="", help=argparse.SUPPRESS)
+    p.add_argument("--non-voting", action="store_true",
+                   help=argparse.SUPPRESS)
     p.add_argument("--out", default="", help="write the JSON report here")
     p.add_argument("--trace", action="store_true",
                    help="arm the eval-lifecycle tracing plane (slow-tail "
@@ -51,6 +136,8 @@ def main(argv=None) -> int:
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.WARNING,
         stream=sys.stderr)
+    if args.follower_child:
+        return _follower_child_main(args)
     if args.trace:
         from ..utils import tracing
 
@@ -73,6 +160,8 @@ def main(argv=None) -> int:
         sc = replace(sc, use_tpu_batch_worker=True)
     if args.wal:
         sc = replace(sc, wal=True)
+    if args.servers:
+        sc = replace(sc, num_servers=args.servers)
 
     if args.compare_workers:
         counts = [int(x) for x in args.compare_workers.split(",") if x]
@@ -81,6 +170,10 @@ def main(argv=None) -> int:
         from .harness import compare_wal
 
         report = compare_wal(sc)
+    elif args.compare_servers:
+        from .harness import compare_servers
+
+        report = compare_servers(sc)
     else:
         report = run_scenario(sc)
 

@@ -37,6 +37,40 @@ from ..structs import structs as s
 from ..utils import tracing
 from .scenario import JobShape, Scenario
 
+# Raft timing for multi-server measurement clusters: elections slowed to
+# seconds (leader + follower alike) so GIL stalls under offered load
+# cannot depose the leader mid-run; heartbeats stay sub-second so a real
+# leader death still fails over inside the drain budget.
+RAFT_TUNING = {
+    "NOMAD_TPU_RAFT_HEARTBEAT_S": "0.2",
+    "NOMAD_TPU_RAFT_ELECTION_MIN_S": "5.0",
+    "NOMAD_TPU_RAFT_ELECTION_MAX_S": "8.0",
+    # GIL switch interval for every server process in the cluster: a
+    # follower's AppendEntries handler sits INSIDE the leader's quorum
+    # wait, and at CPython's default 5ms interval a busy follower's
+    # pure-Python scheduling loops add ~25ms to every cluster commit.
+    "NOMAD_TPU_SWITCH_INTERVAL": "0.001",
+}
+
+
+def _apply_switch_interval():
+    """Set the GIL switch interval from the env; returns the PRIOR
+    value so in-process callers (the harness leader — unlike follower
+    subprocesses, it shares the interpreter with whatever ran the
+    scenario, e.g. bench --check phases) can restore it."""
+    import os
+    import sys
+
+    val = os.environ.get("NOMAD_TPU_SWITCH_INTERVAL", "").strip()
+    if not val:
+        return None
+    prior = sys.getswitchinterval()
+    try:
+        sys.setswitchinterval(float(val))
+    except (ValueError, OSError):  # pragma: no cover
+        return None
+    return prior
+
 
 def _percentiles(values: List[float]) -> Dict[str, float]:
     if not values:
@@ -97,6 +131,9 @@ class LoadHarness:
         self._hb_renewals: List[float] = []         # granted TTLs
         self._filter_subs: list = []
         self._threads: List[threading.Thread] = []
+        # Multi-server mode (ISSUE 10): follower-scheduler subprocesses.
+        self._follower_procs: list = []
+        self.follower_addrs: List[str] = []
 
     # -- setup -------------------------------------------------------------
 
@@ -120,25 +157,222 @@ class LoadHarness:
             broker_max_pending=sc.broker_max_pending,
             broker_coalesce=sc.broker_coalesce,
             node_name=f"loadgen-{sc.name}")
+        if sc.num_servers > 1:
+            # Multi-server cluster: the in-process server is the
+            # deterministic leader (MultiRaft, single-voter bootstrap);
+            # follower-scheduler subprocesses join it over real TCP and
+            # are promoted to voters through replicated CONFIG entries.
+            cfg.enable_rpc = True
+            cfg.force_multi_raft = True
+            cfg.bootstrap_expect = 1
+            if sc.leader_workers >= 0:
+                cfg.num_schedulers = sc.leader_workers
+                # The leader's own follower pool parks while it leads,
+                # but keeps the shape symmetric for failover.
+                cfg.follower_schedulers = max(
+                    0, (0 if sc.follower_workers < 0
+                        else sc.follower_workers or sc.num_workers))
         # Workers read the stale-snapshot knob from the env at
-        # construction; scope the override to the build.
-        prev = os.environ.get("NOMAD_TPU_STALE_SNAPSHOT")
-        os.environ["NOMAD_TPU_STALE_SNAPSHOT"] = \
-            "1" if sc.stale_snapshot else "0"
+        # construction; scope the overrides to the build.  Multi-server
+        # runs also slow raft elections WAY down (the measurement load
+        # can starve the in-process leader's heartbeat threads past the
+        # stock 0.3-0.6s window, and a mid-run deposition would measure
+        # election churn, not scheduling — the raft_multiplier
+        # discipline for loaded hosts).
+        overrides = {"NOMAD_TPU_STALE_SNAPSHOT":
+                     "1" if sc.stale_snapshot else "0"}
+        if sc.num_servers > 1:
+            overrides.update(RAFT_TUNING)
+        prev = {k: os.environ.get(k) for k in overrides}
+        os.environ.update(overrides)
+        if sc.num_servers > 1:
+            self._prior_switch_interval = _apply_switch_interval()
         try:
             srv = Server(cfg, logger=self.logger.getChild("server"))
             srv.start()
         finally:
-            if prev is None:
-                os.environ.pop("NOMAD_TPU_STALE_SNAPSHOT", None)
-            else:
-                os.environ["NOMAD_TPU_STALE_SNAPSHOT"] = prev
+            for k, v in prev.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        if hasattr(srv.metrics.sink, "interval"):
+            # One aggregation window for the whole run: a long straggler
+            # drain must not rotate the histograms out before _assemble
+            # / the follower-stats collection read them.
+            srv.metrics.sink.interval = 3600.0
         deadline = time.monotonic() + 10.0
         while not srv.is_leader() and time.monotonic() < deadline:
             time.sleep(0.005)
         if not srv.is_leader():
             raise RuntimeError("loadgen server failed to take leadership")
+        if sc.num_servers > 1:
+            self.server = srv
+            try:
+                self._spawn_followers()
+            except Exception:
+                self._stop_followers()
+                srv.shutdown()
+                raise
         return srv
+
+    # -- follower-scheduler subprocesses (ISSUE 10) ------------------------
+
+    def _spawn_followers(self) -> None:
+        """1 leader + K follower-scheduler servers: each follower is a
+        real subprocess (its scheduling CPU runs on its own
+        interpreter) that joins the leader over TCP, replicates the
+        FSM, and pulls evals via the follower-read path
+        (server/follower_sched.py)."""
+        import os
+        import select
+        import subprocess
+        import sys
+
+        sc = self.sc
+        addr = self.server.config.rpc_advertise
+        # follower_workers: -1 = pure voters (no follower scheduling —
+        # the cluster_leader_sched comparison leg), 0 = num_workers.
+        workers = (0 if sc.follower_workers < 0
+                   else sc.follower_workers or sc.num_workers)
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   NOMAD_TPU_FOLLOWER_SCHED="1", **RAFT_TUNING)
+        for i in range(sc.num_servers - 1):
+            cmd = [sys.executable, "-m", "nomad_tpu.loadgen",
+                   "--follower-child", "--join", addr,
+                   "--workers", str(workers),
+                   "--name", f"lg-follower-{i + 1}"]
+            if not sc.follower_voting:
+                cmd.append("--non-voting")
+            self._follower_procs.append(subprocess.Popen(
+                cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                text=True, env=env))
+        deadline = time.monotonic() + 60.0
+        for proc in self._follower_procs:
+            line = ""
+            while time.monotonic() < deadline:
+                ready, _, _ = select.select([proc.stdout], [], [], 0.5)
+                if ready:
+                    line = proc.stdout.readline()
+                    break
+                if proc.poll() is not None:
+                    break
+            if not line.startswith("READY "):
+                raise RuntimeError(
+                    f"follower server failed to start (got {line!r})")
+            self.follower_addrs.append(line.split()[1])
+        # Membership: voters are promoted through replicated CONFIG
+        # entries; non-voting followers attach to the replication
+        # fan-out as learners.
+        def formed():
+            raft = self.server.raft
+            return len(set(raft.peers) | set(raft.learners))
+        while time.monotonic() < deadline:
+            if formed() == sc.num_servers:
+                break
+            time.sleep(0.05)
+        if formed() != sc.num_servers:
+            raise RuntimeError(
+                f"cluster formed {formed()} members, "
+                f"wanted {sc.num_servers}")
+        self.logger.info("loadgen: cluster up — leader %s + followers %s",
+                         addr, self.follower_addrs)
+
+    def _follower_stats(self) -> List[Dict]:
+        """Per-follower telemetry over the wire (Status.Metrics /
+        Status.BrokerStats): forwarded plans, plan-forward RTT
+        percentiles, follower snapshot lag, lag handbacks."""
+        out = []
+        for addr in self.follower_addrs:
+            try:
+                m = self.server.pool.call(addr, "Status.Metrics", {},
+                                          timeout=5.0)
+                b = self.server.pool.call(addr, "Status.BrokerStats", {},
+                                          timeout=5.0)
+            except Exception as e:
+                out.append({"addr": addr, "error": str(e)})
+                continue
+            samples = m.get("Samples") or {}
+            totals = m.get("CounterTotals") or {}
+
+            def pct(key):
+                agg = samples.get(key) or {}
+                return {k: agg.get(k)
+                        for k in ("count", "p50", "p95", "p99") if agg}
+
+            fs = (b.get("FollowerSched") or {})
+            out.append({
+                "addr": addr,
+                "forwarded_plans": fs.get("ForwardedPlans", 0),
+                "forward_errors": fs.get("ForwardErrors", 0),
+                "forwarded_inflight": fs.get("ForwardedPlansInFlight", 0),
+                "plan_forward_rtt_ms": pct("nomad.plan.forward"),
+                "snapshot_lag_entries": pct("nomad.follower.snapshot_lag"),
+                "evals_scheduled": totals.get(
+                    "nomad.follower.evals_scheduled", 0),
+                "lag_handbacks": totals.get(
+                    "nomad.follower.lag_handback", 0),
+            })
+        return out
+
+    def _stop_followers(self) -> None:
+        for proc in self._follower_procs:
+            try:
+                if proc.stdin is not None:
+                    proc.stdin.close()  # child parks on stdin EOF
+            except OSError:
+                pass
+        for proc in self._follower_procs:
+            try:
+                proc.wait(timeout=10.0)
+            except Exception:
+                proc.kill()
+                proc.wait(timeout=5.0)
+        self._follower_procs = []
+
+    def _collect_integrity(self) -> Dict:
+        """Placement-integrity sweep over the leader's final state: the
+        follower-read acceptance bar is ZERO double placements — no job
+        with more live allocs than its (latest registered) total count,
+        no duplicate alloc names within a job, no overcommitted node."""
+        st = self.server.state
+        with self._l:
+            job_ids = {rec.job_id for rec in self.subs.values()}
+        live_by_job: Dict[str, list] = {}
+        usage: Dict[str, Tuple[int, int]] = {}
+        for a in st.allocs(None):
+            if a.terminal_status():
+                continue
+            live_by_job.setdefault(a.job_id, []).append(a)
+            res = a.resources
+            if res is not None:
+                cpu, mem = usage.get(a.node_id, (0, 0))
+                usage[a.node_id] = (cpu + res.cpu, mem + res.memory_mb)
+        checked = overplaced = dup_names = 0
+        for jid in job_ids:
+            job = st.job_by_id(None, jid)
+            if job is None:
+                continue
+            checked += 1
+            allocs = live_by_job.get(jid, [])
+            want = sum(tg.count for tg in job.task_groups)
+            if len(allocs) > want:
+                overplaced += 1
+            if len({a.name for a in allocs}) != len(allocs):
+                dup_names += 1
+        overcommitted = 0
+        for node in st.nodes(None):
+            cpu, mem = usage.get(node.id, (0, 0))
+            res_cpu = node.resources.cpu - (node.reserved.cpu
+                                            if node.reserved else 0)
+            res_mem = node.resources.memory_mb - (
+                node.reserved.memory_mb if node.reserved else 0)
+            if cpu > res_cpu or mem > res_mem:
+                overcommitted += 1
+        return {"jobs_checked": checked,
+                "overplaced_jobs": overplaced,
+                "duplicate_alloc_names": dup_names,
+                "overcommitted_nodes": overcommitted}
 
     def _register_nodes(self) -> List[str]:
         sc = self.sc
@@ -236,6 +470,21 @@ class LoadHarness:
                     # The server's hint plus client-side full jitter —
                     # the same discipline utils/backoff applies.
                     if self._stop.wait(e.retry_after * (0.5 + rng.random())):
+                        return
+                except Exception:
+                    # Transient control-plane churn (leadership moving
+                    # in a multi-server cluster, a mid-election window):
+                    # a real SDK client retries with backoff rather
+                    # than dying — re-registering the same job id is an
+                    # idempotent update, so a half-landed earlier
+                    # attempt cannot double-place.
+                    if attempt >= sc.submit_retries:
+                        with self._l:
+                            self.dropped += 1
+                        self.logger.exception(
+                            "loadgen: submission %d dropped", seq)
+                        break
+                    if self._stop.wait(0.2 * (0.5 + rng.random())):
                         return
 
     def _heartbeater(self, node_ids: List[str]) -> None:
@@ -367,7 +616,13 @@ class LoadHarness:
             self._stop.set()
             for t in self._threads:
                 t.join(timeout=5.0)
+            self._stop_followers()
             self.server.shutdown()
+            prior = getattr(self, "_prior_switch_interval", None)
+            if prior is not None:
+                import sys as _sys
+
+                _sys.setswitchinterval(prior)
             wal_dir = getattr(self, "_wal_dir", "")
             if wal_dir:
                 import shutil
@@ -418,6 +673,26 @@ class LoadHarness:
         fanout = self._measure_fanout() if self._filter_subs else {}
         report = self._assemble(measure_start, measure_end, drained_t,
                                 fanout)
+        report["integrity"] = self._collect_integrity()
+        if self.follower_addrs:
+            # Per-server scale-out telemetry, read over the wire while
+            # the followers are still up.
+            followers = self._follower_stats()
+            report["follower_servers"] = followers
+            rtts = [f.get("plan_forward_rtt_ms") or {} for f in followers]
+            report["plan_forward"] = {
+                "servers": len(followers),
+                "forwarded_total": sum(f.get("forwarded_plans", 0)
+                                       for f in followers),
+                "errors_total": sum(f.get("forward_errors", 0)
+                                    for f in followers),
+                "evals_scheduled_total": sum(f.get("evals_scheduled", 0)
+                                             for f in followers),
+                "lag_handbacks_total": sum(f.get("lag_handbacks", 0)
+                                           for f in followers),
+                "rtt_p99_ms_max": max(
+                    (r.get("p99") or 0.0 for r in rtts), default=0.0),
+            }
         self._stop.set()
         tracker.join(timeout=5.0)
         return report
@@ -561,6 +836,75 @@ def compare_wal(scenario: Scenario,
             "plan_apply_fsync"),
         "runs": runs,
     }
+
+
+def compare_servers(scenario: Scenario,
+                    logger: Optional[logging.Logger] = None,
+                    cluster_leg: bool = True) -> Dict:
+    """Horizontal scale-out gate (ISSUE 10): the same offered load
+    against
+
+    - ``single``                — ONE server with the scenario's M
+      workers (the PR 7 stale-snapshot baseline; in-process, single-
+      voter, no serialization anywhere);
+    - ``cluster_leader_sched``  — the SAME multi-server cluster with
+      replication but all scheduling leader-local (what a replicated
+      deployment pays without follower reads); and
+    - ``cluster_follower_sched`` — follower-read scheduling per the
+      scenario (the tentpole path).
+
+    Reports sustained evals/s for each, both speedups, the plan-forward
+    RTT tail, plan-conflict rate, and the double-placement sweep — zero
+    is the bar."""
+    from dataclasses import replace
+
+    single = run_scenario(replace(scenario, num_servers=1), logger=logger)
+    cluster = None
+    if cluster_leg:
+        cluster = run_scenario(
+            replace(scenario, leader_workers=scenario.num_workers,
+                    follower_workers=-1, follower_voting=True),
+            logger=logger)
+    multi = run_scenario(scenario, logger=logger)
+    single_rate = single["sustained"]["evals_per_s"]
+    multi_rate = multi["sustained"]["evals_per_s"]
+
+    def conflicts(run):
+        return run["control_plane"]["plan_conflicts"]
+
+    def bad(run):
+        integ = run.get("integrity") or {}
+        return (integ.get("overplaced_jobs", 0)
+                + integ.get("duplicate_alloc_names", 0)
+                + integ.get("overcommitted_nodes", 0))
+
+    rates = {f"single_m{scenario.num_workers}": single_rate,
+             "cluster_follower_sched": multi_rate}
+    out = {
+        "scenario": scenario.name,
+        "compare": "servers",
+        "num_servers": scenario.num_servers,
+        "workers_per_server": scenario.num_workers,
+        "evals_per_s": rates,
+        "speedup": (round(multi_rate / single_rate, 3)
+                    if single_rate else None),
+        "plan_conflicts": {"single": conflicts(single),
+                           "multi": conflicts(multi)},
+        "plan_forward": multi.get("plan_forward", {}),
+        "double_placements": {"single": bad(single), "multi": bad(multi)},
+        "stragglers": {
+            "single": single["sustained"]["stragglers_after_drain"],
+            "multi": multi["sustained"]["stragglers_after_drain"]},
+        "runs": {"single": single, "multi": multi},
+    }
+    if cluster is not None:
+        cluster_rate = cluster["sustained"]["evals_per_s"]
+        rates["cluster_leader_sched"] = cluster_rate
+        out["speedup_vs_cluster_leader"] = (
+            round(multi_rate / cluster_rate, 3) if cluster_rate else None)
+        out["double_placements"]["cluster_leader"] = bad(cluster)
+        out["runs"]["cluster_leader"] = cluster
+    return out
 
 
 def compare_workers(scenario: Scenario, worker_counts: List[int],

@@ -31,6 +31,25 @@ def render_report(report: Dict, out: TextIO) -> None:
             out.write(f"-- {k} --\n")
             _render_single(run, out, indent="  ")
         return
+    if report.get("compare") == "servers":  # compare_servers shape
+        out.write(f"== loadgen scale-out compare: {report['scenario']} "
+                  f"({report['num_servers']} servers x "
+                  f"M={report['workers_per_server']}) ==\n")
+        for k, rate in report["evals_per_s"].items():
+            out.write(f"  {k}: sustained {rate} evals/s\n")
+        out.write(f"  speedup: {report['speedup']}x, double placements: "
+                  f"{report['double_placements']}, plan conflicts: "
+                  f"{report['plan_conflicts']}\n")
+        pf = report.get("plan_forward") or {}
+        if pf:
+            out.write(f"  plan-forward: {pf.get('forwarded_total')} plans "
+                      f"across {pf.get('servers')} followers, rtt p99 "
+                      f"{pf.get('rtt_p99_ms_max')}ms, "
+                      f"{pf.get('lag_handbacks_total')} lag handbacks\n")
+        for k, run in report["runs"].items():
+            out.write(f"-- {k} --\n")
+            _render_single(run, out, indent="  ")
+        return
     if "worker_counts" in report:  # compare_workers shape
         out.write(f"== loadgen compare: {report['scenario']} "
                   f"workers={report['worker_counts']} ==\n")
@@ -90,5 +109,22 @@ def _render_single(r: Dict, out: TextIO, indent: str = "") -> None:
     if fo:
         w(f"event fan-out: {fo['us_per_event']}us/event @ "
           f"{fo['subscribers']} filtered subscribers")
+    integ = r.get("integrity") or {}
+    if integ:
+        w(f"integrity: {integ['jobs_checked']} jobs checked, "
+          f"overplaced={integ['overplaced_jobs']} "
+          f"dup_names={integ['duplicate_alloc_names']} "
+          f"overcommitted_nodes={integ['overcommitted_nodes']}")
+    for f in r.get("follower_servers", []):
+        if "error" in f:
+            w(f"follower {f['addr']}: stats unavailable ({f['error']})")
+            continue
+        rtt = f.get("plan_forward_rtt_ms") or {}
+        lag = f.get("snapshot_lag_entries") or {}
+        w(f"follower {f['addr']}: {f['evals_scheduled']} evals scheduled, "
+          f"{f['forwarded_plans']} plans forwarded "
+          f"(rtt p50={rtt.get('p50')} p99={rtt.get('p99')}ms), "
+          f"snapshot lag p95={lag.get('p95')} entries, "
+          f"{f['lag_handbacks']} lag handbacks")
     for tr in r.get("slow_tail_traces", []):
         w(f"slow tail: {tr['submit_to_running_ms']}ms {tr['trace']}")

@@ -78,6 +78,26 @@ class Scenario:
     # every apply pays a real fsync; the report's plan_apply_fsync
     # percentiles and the --compare-wal gate measure it.
     wal: bool = False
+    # Multi-server cluster (ISSUE 10, follower-read scheduling): 1
+    # leader (in-process, MultiRaft) + num_servers-1 follower-scheduler
+    # servers spawned as SUBPROCESSES joined over real TCP RPC — each
+    # follower schedules off its own replicated FSM on its own
+    # interpreter (real parallelism, not GIL-shared threads) and
+    # forwards plans to the leader's serialized plan-apply.
+    num_servers: int = 1
+    # Follower workers per follower server; 0 → num_workers.
+    follower_workers: int = 0
+    # Leader-local workers in the multi-server shape; -1 → num_workers.
+    # The scale-out sweet spot is 0: the leader spends its interpreter
+    # on plan-apply + RPC + replication and the followers own ALL
+    # scheduling CPU (the ISSUE 10 deployment shape).
+    leader_workers: int = -1
+    # Follower-scheduler servers join as VOTERS (True) or as NON-VOTING
+    # members (False, the reference's non_voting_server): non-voting is
+    # the scheduler-scale-out shape — replication reaches them (so
+    # follower reads work) but quorum, and therefore plan-commit
+    # latency, stays pinned to the voter set.
+    follower_voting: bool = False
     # Determinism.
     seed: int = 42
 
@@ -166,8 +186,32 @@ FANOUT_10K = Scenario(
     warmup_s=0.0, measure_s=20.0, drain_s=30.0,
     subscribers=10_000, min_heartbeat_ttl=5.0, num_workers=2, seed=11)
 
+#: Horizontal scale-out (ISSUE 10): a gang-scale ML-fleet job mix
+#: (50-120 allocs per job — the workload class whose SCHEDULING cost
+#: dominates the control plane) offered to 1 leader + 2 NON-VOTING
+#: follower-scheduler servers (the reference's non_voting_server read-
+#: scaling shape).  ``compare_servers`` runs the same offered load
+#: against (a) one server with M workers and (b) the same cluster with
+#: leader-local scheduling, so the report separates the replication tax
+#: from the follower-read win.  Zero double placements is the hard bar.
+MULTI_SERVER = Scenario(
+    name="multi_server",
+    num_nodes=5000, node_cpu=64_000, node_memory_mb=262_144,
+    num_clients=8, arrival_rate=240.0, max_submissions=600,
+    job_mix=[JobShape(weight=5, count=50, cpu=200, memory_mb=256,
+                      priority=50),
+             JobShape(weight=3, count=80, cpu=200, memory_mb=256,
+                      priority=60),
+             JobShape(weight=2, count=120, cpu=400, memory_mb=512,
+                      priority=80)],
+    warmup_s=0.0, measure_s=30.0, drain_s=120.0,
+    subscribers=32, min_heartbeat_ttl=30.0, num_workers=4,
+    num_servers=3, leader_workers=2, follower_workers=8,
+    follower_voting=False, seed=42)
+
 BUILTIN_SCENARIOS: Dict[str, Scenario] = {
-    sc.name: sc for sc in (SMOKE, BASELINE, OVERLOAD_10X, FANOUT_10K)}
+    sc.name: sc for sc in (SMOKE, BASELINE, OVERLOAD_10X, FANOUT_10K,
+                           MULTI_SERVER)}
 
 
 def get_scenario(name: str) -> Scenario:

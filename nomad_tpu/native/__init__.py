@@ -172,6 +172,12 @@ class NativeWAL:
                 raise OSError(f"nwal_iter_next failed on {self.path}")
             yield ctypes.string_at(data, length.value)
 
+    def sync(self) -> None:
+        """fsync everything written so far (segment-seal barrier: the
+        raft log calls this before rolling the WAL at a snapshot)."""
+        if self._lib.nwal_sync(self._h) != 0:
+            raise OSError(f"nwal_sync failed on {self.path}")
+
     def reset(self) -> None:
         """Truncate to empty (post-snapshot)."""
         if self._lib.nwal_reset(self._h) != 0:

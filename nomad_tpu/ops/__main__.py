@@ -862,6 +862,148 @@ def mesh_drill(seed: int = 0, log=print, n_devices: int = 8,
     return True
 
 
+def follower_drill(seed: int = 0, log=print) -> bool:
+    """Follower-read scheduling drill (ISSUE 10): boot a 3-voter
+    in-process cluster, pause the leader's LOCAL workers so only
+    follower workers can schedule, submit a job, and verify the plan
+    was forwarded by a follower, applied by the LEADER's serialized
+    plan-apply, and is visible on all three FSMs.  Then the
+    lagging-follower streaming-install drill: compact the leader past
+    the log horizon with a tiny chunk size and verify a fresh joiner
+    catches up via CHUNKED InstallSnapshot."""
+    import os
+    import time
+
+    from ..server import Server, ServerConfig
+    from ..structs import structs as s
+
+    def check(cond, msg):
+        if not cond:
+            log(f"follower drill: FAIL — {msg}")
+        return cond
+
+    def wait_until(pred, timeout=30.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return True
+            time.sleep(0.02)
+        return pred()
+
+    saved = os.environ.get("NOMAD_TPU_SNAPSHOT_CHUNK")
+    servers = []
+    fresh = None
+    try:
+        first = None
+        for i in range(3):
+            # num_schedulers=0: NO server runs a leader-local worker —
+            # follower_schedulers=1 gives each a follower-read worker,
+            # so the drill's eval can only complete via the follower
+            # path (the leader's own follower worker parks while it
+            # leads).
+            srv = Server(ServerConfig(
+                node_name=f"drill-s{i + 1}", enable_rpc=True,
+                bootstrap_expect=3, start_join=[first] if first else [],
+                num_schedulers=0, follower_schedulers=1,
+                min_heartbeat_ttl=60.0))
+            if first is None:
+                first = srv.config.rpc_advertise
+            servers.append(srv)
+        for srv in servers:
+            srv.start()
+        if not check(wait_until(lambda: any(
+                x.is_leader() and x.raft.is_raft_leader()
+                for x in servers)), "no leader elected"):
+            return False
+        leader = next(x for x in servers if x.is_leader())
+        followers = [x for x in servers if x is not leader]
+        if not check(wait_until(lambda: all(
+                len(x.raft.peers) == 3 for x in servers)),
+                "voter config did not converge"):
+            return False
+
+        node = s.Node(
+            id="drill-node", datacenter="dc1", name="drill-node",
+            attributes={"kernel.name": "linux", "driver.exec": "1"},
+            resources=s.Resources(cpu=4000, memory_mb=8192,
+                                  disk_mb=100 * 1024, iops=1000),
+            reserved=s.Resources(), status=s.NODE_STATUS_READY)
+        leader.node_register(node)
+        jid = "drill-job"
+        job = s.Job(
+            region="global", id=jid, name=jid, type=s.JOB_TYPE_SERVICE,
+            priority=50, datacenters=["dc1"],
+            task_groups=[s.TaskGroup(
+                name="tg", count=2,
+                ephemeral_disk=s.EphemeralDisk(size_mb=10),
+                tasks=[s.Task(name="t", driver="exec",
+                              config={"command": "/bin/date"},
+                              resources=s.Resources(cpu=100,
+                                                    memory_mb=128),
+                              log_config=s.LogConfig())])])
+        _, eval_id = leader.job_register(job)
+        if not check(wait_until(lambda: (
+                (ev := leader.state.eval_by_id(None, eval_id)) is not None
+                and ev.status == s.EVAL_STATUS_COMPLETE)),
+                "eval did not complete via follower scheduling"):
+            return False
+        forwarded = sum(f.leader_channel.stats()["ForwardedPlans"]
+                        for f in followers)
+        if not (check(forwarded >= 1,
+                      "no plan was forwarded by a follower")
+                and check(wait_until(lambda: all(
+                    len(x.state.allocs_by_job(None, jid)) == 2
+                    for x in servers)),
+                    "placements not visible on every FSM")):
+            return False
+
+        # Lagging-follower streaming install: compact the leader past
+        # the horizon, then join a FRESH server — with a 1KB chunk
+        # ceiling the install must arrive in multiple chunks.
+        os.environ["NOMAD_TPU_SNAPSHOT_CHUNK"] = "1024"
+        leader.raft.snapshot()
+        chunks_before = _counter_total(leader,
+                                       "nomad.raft.snapshot.chunks_sent")
+        fresh = Server(ServerConfig(
+            node_name="drill-fresh", enable_rpc=True, bootstrap_expect=3,
+            start_join=[leader.config.rpc_advertise], num_schedulers=0))
+        fresh.start()
+        if not check(wait_until(lambda: fresh.state.job_by_id(
+                None, jid) is not None, timeout=20.0),
+                "fresh joiner did not receive the snapshot"):
+            return False
+        if not check(wait_until(
+                lambda: fresh.raft.base_index >= leader.raft.base_index,
+                timeout=10.0), "joiner's log base did not advance"):
+            return False
+        chunks = _counter_total(leader, "nomad.raft.snapshot.chunks_sent")
+        if not check(chunks - chunks_before >= 2,
+                     f"snapshot was not chunked ({chunks - chunks_before}"
+                     " chunks sent)"):
+            return False
+    finally:
+        if saved is None:
+            os.environ.pop("NOMAD_TPU_SNAPSHOT_CHUNK", None)
+        else:
+            os.environ["NOMAD_TPU_SNAPSHOT_CHUNK"] = saved
+        if fresh is not None:
+            fresh.shutdown()
+        for srv in servers:
+            srv.shutdown()
+    log("follower drill: OK — 3-voter cluster scheduled on a follower "
+        f"({forwarded} plan(s) forwarded to the leader's plan-apply, "
+        "visible on all FSMs), and a lagging joiner caught up via "
+        f"streaming InstallSnapshot ({chunks - chunks_before} chunks)")
+    return True
+
+
+def _counter_total(server, key: str) -> int:
+    sink = server.metrics.sink
+    if not hasattr(sink, "latest"):
+        return 0
+    return int((sink.latest().get("CounterTotals") or {}).get(key, 0))
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="python -m nomad_tpu.ops")
     parser.add_argument("--selfcheck", action="store_true",
@@ -889,6 +1031,7 @@ def main(argv=None) -> int:
     ok = columnar_drill(seed=args.seed) and ok
     ok = wal_drill(seed=args.seed) and ok
     ok = fused_drill(seed=args.seed) and ok
+    ok = follower_drill(seed=args.seed) and ok
     ok = mesh_drill(seed=args.seed) and ok
     return 0 if ok else 1
 

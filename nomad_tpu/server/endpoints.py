@@ -71,9 +71,21 @@ def register_endpoints(server, rpc) -> None:
     def status_peers(body):
         return server.peer_addresses()
 
+    def status_metrics(body):
+        """Telemetry sink dump over the wire (the loadgen harness reads
+        follower-server forward-RTT/snapshot-lag samples through this;
+        same data /v1/metrics renders on the HTTP side)."""
+        sink = server.metrics.sink
+        return sink.latest() if hasattr(sink, "latest") else {}
+
+    def status_broker_stats(body):
+        return server.broker_stats()
+
     rpc.register("Status.Ping", status_ping)
     rpc.register("Status.Leader", status_leader)
     rpc.register("Status.Peers", status_peers)
+    rpc.register("Status.Metrics", status_metrics)
+    rpc.register("Status.BrokerStats", status_broker_stats)
 
     # -- Serf-lite membership ---------------------------------------------
 
@@ -222,9 +234,44 @@ def register_endpoints(server, rpc) -> None:
         return {"Allocs": [to_wire(a) for a in allocs],
                 "Index": server.state.table_index("allocs")}
 
+    def eval_dequeue_batch(body):
+        # Follower-scheduler pull (server/follower_sched.py).  Same
+        # transport-timeout cap as Eval.Dequeue.
+        timeout = min(float(body.get("Timeout", 0.0) or 0.0), 5.0)
+        reply = server.eval_dequeue_batch(
+            body.get("Schedulers") or [], int(body.get("Max", 1) or 1),
+            timeout)
+        return {"Evals": [{"Eval": to_wire(item["eval"]),
+                           "Token": item["token"],
+                           "Attempts": item["attempts"],
+                           "PlanFence": item["fence"]}
+                          for item in reply["items"]],
+                "AppliedIndex": reply["applied_index"]}
+
+    def eval_update(body):
+        evals = [from_wire(s.Evaluation, e) for e in body["Evals"]]
+        return {"Index": server.eval_update(evals)}
+
+    def eval_reblock(body):
+        ev = from_wire(s.Evaluation, body["Eval"])
+        return {"Index": server.eval_reblock(ev, body["Token"])}
+
+    def eval_pause_nack(body):
+        server.eval_pause_nack(body["EvalID"], body["Token"])
+        return {}
+
+    def eval_resume_nack(body):
+        server.eval_resume_nack(body["EvalID"], body["Token"])
+        return {}
+
     register("Eval.Dequeue", eval_dequeue)
+    register("Eval.DequeueBatch", eval_dequeue_batch)
     register("Eval.Ack", eval_ack)
     register("Eval.Nack", eval_nack)
+    register("Eval.Update", eval_update)
+    register("Eval.Reblock", eval_reblock)
+    register("Eval.PauseNack", eval_pause_nack)
+    register("Eval.ResumeNack", eval_resume_nack)
     register("Eval.GetEval", eval_get)
     register("Eval.List", eval_list)
     register("Eval.Allocations", eval_allocations)
@@ -233,6 +280,13 @@ def register_endpoints(server, rpc) -> None:
 
     def plan_submit(body):
         plan = from_wire(s.Plan, body["Plan"])
+        # Re-denormalize wire-stripped placements (follower_sched
+        # _strip_plan_for_wire ships the job once on the plan).
+        if plan.job is not None:
+            for allocs in plan.node_allocation.values():
+                for alloc in allocs:
+                    if alloc.job is None:
+                        alloc.job = plan.job
         future = server.plan_submit(plan)
         # Bounded: a dropped plan (leadership churn) responds with an
         # error; an unresponsive applier must not pin this thread.  On
@@ -257,7 +311,21 @@ def register_endpoints(server, rpc) -> None:
                 raise TimeoutError(
                     "plan outcome unknown: applier claimed the plan but "
                     "did not respond in 600s; do not replan immediately")
-        return {"Result": to_wire(result) if result is not None else None}
+        if result is None:
+            return {"Result": None}
+        # Full commit: the result would only echo the plan's own
+        # allocations back across the wire — reply with a compact
+        # marker and let the submitter rebuild it from its plan copy.
+        if not result.refresh_index and (
+                sum(map(len, result.node_allocation.values()))
+                == sum(map(len, plan.node_allocation.values()))
+                and sum(map(len, result.node_update.values()))
+                == sum(map(len, plan.node_update.values()))
+                and sum(len(sl) for sl in result.alloc_slabs)
+                == sum(len(sl) for sl in plan.alloc_slabs)):
+            return {"Result": {"Full": True,
+                               "AllocIndex": result.alloc_index}}
+        return {"Result": to_wire(result)}
 
     register("Plan.Submit", plan_submit)
 

@@ -11,10 +11,25 @@ NumCPU/2 goroutines (plan_apply.go:49-53); here the per-node AllocsFit
 re-check is one call into the vectorized kernel (ops/kernels.py
 batch_allocs_fit) when the plan touches many nodes, falling back to the
 scalar path for small plans.
+
+Pipelined commit (ISSUE 10): on a multi-voter cluster each raft apply
+waits a replication round trip, and a strictly serial applier caps
+cluster-wide plan throughput at 1/RTT.  The applier therefore overlaps
+the COMMIT of plan N with the EVALUATION of plan N+1 — the reference's
+async-commit overlap (plan_apply.go:55-120), realized here as a bounded
+pool of commit waiters plus an **optimistic in-flight overlay**: the
+placements of not-yet-visible committed plans are added to every fit
+re-check, so a node can never be over-committed by two plans racing
+through the pipeline.  The overlay is conservative (pending REMOVALS are
+ignored), so the re-check can only be stricter than the truth.  Plans
+carrying preemptions keep the strict serial path: their staleness fence
+reads live alloc rows that an in-flight plan could still change.
 """
 from __future__ import annotations
 
 import logging
+import os
+import queue as _queue
 import threading
 from typing import Dict, List, Optional, Tuple
 
@@ -33,6 +48,48 @@ from .raft import RaftLog
 VECTORIZE_THRESHOLD = 64
 
 
+def _pipeline_depth() -> int:
+    """Concurrent in-flight plan commits (1 restores the strictly
+    serial applier)."""
+    try:
+        return max(1, int(os.environ.get("NOMAD_TPU_PLAN_PIPELINE", "")
+                          or 8))
+    except ValueError:
+        return 8
+
+
+class _InflightOverlay:
+    """Placements of plans whose raft commit is still in flight, keyed
+    by plan: the fit re-check adds them to each touched node's proposed
+    set so pipelined plans cannot jointly over-commit a node."""
+
+    def __init__(self):
+        self._l = threading.Lock()
+        self._plans: Dict[int, Dict[str, List[Tuple[s.Allocation, int]]]] = {}
+
+    def add(self, token: int, result: s.PlanResult) -> None:
+        by_node: Dict[str, List[Tuple[s.Allocation, int]]] = {}
+        for node_id, allocs in result.node_allocation.items():
+            for alloc in allocs:
+                by_node.setdefault(node_id, []).append((alloc, 1))
+        for slab in result.alloc_slabs:
+            for node_id, cnt in slab.node_counts().items():
+                by_node.setdefault(node_id, []).append((slab.proto, cnt))
+        with self._l:
+            self._plans[token] = by_node
+
+    def remove(self, token: int) -> None:
+        with self._l:
+            self._plans.pop(token, None)
+
+    def pending_for(self, node_id: str) -> List[Tuple[s.Allocation, int]]:
+        with self._l:
+            out: List[Tuple[s.Allocation, int]] = []
+            for by_node in self._plans.values():
+                out.extend(by_node.get(node_id, ()))
+            return out
+
+
 class PlanApplier:
     def __init__(self, plan_queue: PlanQueue, raft: RaftLog,
                  logger: Optional[logging.Logger] = None,
@@ -46,6 +103,17 @@ class PlanApplier:
         self.logger = logger or logging.getLogger("nomad_tpu.plan_apply")
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # Pipelined-commit state (see module docstring): a bounded pool
+        # of commit waiters + the in-flight placement overlay + a
+        # drain condition the serial (preemption) path waits on.
+        self.pipeline_depth = _pipeline_depth()
+        self._overlay = _InflightOverlay()
+        self._commit_q: "_queue.Queue" = _queue.Queue()
+        self._commit_threads: List[threading.Thread] = []
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
+        self._token_seq = 0
+        self._fit_guard_reads = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -54,21 +122,36 @@ class PlanApplier:
         self._thread = threading.Thread(target=self.run, daemon=True,
                                         name="plan-applier")
         self._thread.start()
+        for i in range(self.pipeline_depth):
+            t = threading.Thread(target=self._commit_loop, daemon=True,
+                                 name=f"plan-commit-{i}")
+            t.start()
+            self._commit_threads.append(t)
 
     def stop(self) -> None:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
+        for _ in self._commit_threads:
+            self._commit_q.put(None)
+        for t in self._commit_threads:
+            t.join(timeout=5.0)
+        self._commit_threads = []
 
     def run(self) -> None:
         """The planApply hot loop (plan_apply.go:42-120).
 
-        The reference reuses a snapshot with optimistic local application
-        so verification of plan N+1 overlaps the *asynchronous* raft commit
-        of plan N.  Our log apply is synchronous (raft.py), so there is no
-        commit window to overlap — a fresh snapshot per plan is equivalent
-        and avoids masking concurrent non-plan writes.  Revisit when
-        multi-voter replication makes commits async."""
+        The fit re-check reads the LIVE store plus the in-flight
+        overlay: every alloc an earlier plan added is either already
+        applied (visible in the store — overlay entries are removed
+        only AFTER their raft apply returns) or still in the overlay,
+        which is the one consistency property the optimistic re-check
+        needs.  Concurrent non-plan writes (client status, node
+        transitions) make individual reads at-least-as-fresh as any
+        snapshot taken at dequeue time.  Commit waits run on the waiter
+        pool so evaluation of plan N+1 overlaps the (multi-voter,
+        round-trip-priced) commit of plan N — the reference's async
+        overlap, plan_apply.go:55-120."""
         while not self._stop.is_set():
             item = self.plan_queue.dequeue(timeout=0.2)
             if item is None:
@@ -80,65 +163,111 @@ class PlanApplier:
                 self.logger.warning("plan for eval %s was cancelled before "
                                     "apply; dropping", plan.eval_id)
                 continue
-            # The fit re-check reads the LIVE store, not a snapshot: a
-            # full snapshot per plan is an O(cluster) copy (the single
-            # largest applier cost in the load-harness profile), and the
-            # applier is the ONLY writer of placements — every alloc an
-            # earlier plan added is already applied when the next plan's
-            # reads run, which is the one consistency property the
-            # optimistic re-check needs (the reference gets it by
-            # optimistically applying results to a reused snapshot,
-            # plan_apply.go:55-120).  Concurrent non-plan writes (client
-            # status, node transitions) make individual reads at-least-
-            # as-fresh as any snapshot taken at dequeue time.  Revisit
-            # if apply ever becomes async (multi-voter replication).
-            snap = self.raft.fsm.state
+            if plan.node_preemptions:
+                # The preemption staleness fence reads live alloc rows
+                # (modify_index equality): an in-flight plan could still
+                # change them, so preemption plans run strictly serial
+                # against a quiesced pipeline.
+                self._drain_inflight()
+                self._process_plan(plan, future, pipelined=False)
+            else:
+                self._process_plan(plan, future, pipelined=True)
 
-            # Branch before building span attrs (the disarmed per-plan
-            # path pays one load + comparison only).
-            tr = tracing.TRACER
-            try:
-                ev_span = tracing.NOOP if tr is None else tr.span(
-                    "plan.evaluate", eval_id=plan.eval_id)
-                with self.metrics.measure("plan.evaluate"), ev_span:
-                    result = self.evaluate_plan(snap, plan)
-            except Exception as exc:  # pragma: no cover — defensive
-                self.logger.exception("plan evaluation failed")
-                future.respond(None, exc)
-                continue
+    def _process_plan(self, plan: s.Plan, future: PlanFuture,
+                      pipelined: bool) -> None:
+        snap = self.raft.fsm.state
+        # Branch before building span attrs (the disarmed per-plan
+        # path pays one load + comparison only).
+        tr = tracing.TRACER
+        try:
+            ev_span = tracing.NOOP if tr is None else tr.span(
+                "plan.evaluate", eval_id=plan.eval_id)
+            with self.metrics.measure("plan.evaluate"), ev_span:
+                result = self.evaluate_plan(snap, plan)
+        except Exception as exc:  # pragma: no cover — defensive
+            self.logger.exception("plan evaluation failed")
+            future.respond(None, exc)
+            return
 
-            # Staleness + conflict telemetry for the stale-snapshot
-            # worker pool: how far behind the log this plan's snapshot
-            # was, and whether the optimistic-concurrency re-check had
-            # to reject part of it (the submitter replans the rejected
-            # remainder off refreshed state — the requeue path).
-            if plan.snapshot_index:
-                self.metrics.add_sample(
-                    "plan.staleness",
-                    max(0, self.raft.applied_index() - plan.snapshot_index))
-            if result.refresh_index:
-                self.metrics.incr_counter("plan.conflict")
-                if tr is not None:
-                    tr.event("plan.conflict", eval_id=plan.eval_id,
-                             snapshot_index=plan.snapshot_index,
-                             refresh_index=result.refresh_index)
+        # Staleness + conflict telemetry for the stale-snapshot
+        # worker pool: how far behind the log this plan's snapshot
+        # was, and whether the optimistic-concurrency re-check had
+        # to reject part of it (the submitter replans the rejected
+        # remainder off refreshed state — the requeue path).
+        if plan.snapshot_index:
+            self.metrics.add_sample(
+                "plan.staleness",
+                max(0, self.raft.applied_index() - plan.snapshot_index))
+        if result.refresh_index:
+            self.metrics.incr_counter("plan.conflict")
+            if tr is not None:
+                tr.event("plan.conflict", eval_id=plan.eval_id,
+                         snapshot_index=plan.snapshot_index,
+                         refresh_index=result.refresh_index)
 
-            if result.node_update or result.node_allocation or result.alloc_slabs:
-                try:
-                    ap_span = tracing.NOOP if tr is None else tr.span(
-                        "plan.apply", eval_id=plan.eval_id)
-                    with self.metrics.measure("plan.apply"), ap_span:
-                        index = self.apply_plan(plan, result, snap)
-                    result.alloc_index = index
-                    if result.refresh_index:
-                        # Partial commit: ensure the scheduler sees at least
-                        # its own placements (plan_apply.go:187-193).
-                        result.refresh_index = max(result.refresh_index, index)
-                except Exception as exc:
-                    self.logger.exception("failed to apply plan")
-                    future.respond(None, exc)
-                    continue
+        if not (result.node_update or result.node_allocation
+                or result.alloc_slabs):
             future.respond(result, None)
+            return
+        if not pipelined or self.pipeline_depth <= 1 \
+                or not self._commit_threads:
+            self._commit(plan, result, future, snap)
+            return
+        # Hand the commit wait to the pool: the overlay entry makes the
+        # not-yet-visible placements count against every later fit
+        # re-check until the raft apply lands.
+        with self._inflight_cv:
+            while self._inflight >= self.pipeline_depth \
+                    and not self._stop.is_set():
+                self._inflight_cv.wait(0.2)
+            self._inflight += 1
+            self._token_seq += 1
+            token = self._token_seq
+        self._overlay.add(token, result)
+        self._commit_q.put((token, plan, result, future, snap))
+
+    def _commit(self, plan, result, future, snap,
+                token: Optional[int] = None) -> None:
+        tr = tracing.TRACER
+        try:
+            ap_span = tracing.NOOP if tr is None else tr.span(
+                "plan.apply", eval_id=plan.eval_id)
+            with self.metrics.measure("plan.apply"), ap_span:
+                index = self.apply_plan(plan, result, snap)
+            result.alloc_index = index
+            if result.refresh_index:
+                # Partial commit: ensure the scheduler sees at least
+                # its own placements (plan_apply.go:187-193).
+                result.refresh_index = max(result.refresh_index, index)
+        except Exception as exc:
+            self.logger.exception("failed to apply plan")
+            future.respond(None, exc)
+            return
+        finally:
+            if token is not None:
+                # Remove only now: the FSM apply is visible in the live
+                # store (or the plan failed and never will be) — there
+                # is no window where a placement is in neither.
+                self._overlay.remove(token)
+        future.respond(result, None)
+
+    def _commit_loop(self) -> None:
+        while True:
+            item = self._commit_q.get()
+            if item is None:
+                return
+            token, plan, result, future, snap = item
+            try:
+                self._commit(plan, result, future, snap, token=token)
+            finally:
+                with self._inflight_cv:
+                    self._inflight -= 1
+                    self._inflight_cv.notify_all()
+
+    def _drain_inflight(self) -> None:
+        with self._inflight_cv:
+            while self._inflight and not self._stop.is_set():
+                self._inflight_cv.wait(0.2)
 
     # -- evaluation --------------------------------------------------------
 
@@ -207,11 +336,146 @@ class PlanApplier:
     def _evaluate_nodes(self, snap, plan: s.Plan, node_ids: List[str],
                         slab_adds: Optional[Dict] = None) -> Dict[str, bool]:
         slab_adds = slab_adds or {}
+        # Overlay FIRST, store second: a pipelined sibling whose commit
+        # lands between the two reads is then counted TWICE (its
+        # placements in the overlay snapshot AND in the store rows) —
+        # conservative — instead of in neither view, which would let
+        # two plans jointly over-commit a node.
+        overlay = {nid: self._overlay.pending_for(nid)
+                   for nid in node_ids}
+        out = self._evaluate_nodes_columnar(snap, plan, node_ids,
+                                            slab_adds, overlay)
+        if out is not None:
+            return out
+        return self._evaluate_nodes_walk(snap, plan, node_ids, slab_adds,
+                                         overlay)
+
+    def _evaluate_nodes_walk(self, snap, plan: s.Plan,
+                             node_ids: List[str], slab_adds: Dict,
+                             overlay: Dict[str, list]) -> Dict[str, bool]:
         if len(node_ids) >= VECTORIZE_THRESHOLD:
             return self._evaluate_nodes_vectorized(snap, plan, node_ids,
-                                                   slab_adds)
-        return {nid: self._evaluate_node_plan(snap, plan, nid, slab_adds)
+                                                   slab_adds, overlay)
+        return {nid: self._evaluate_node_plan(snap, plan, nid, slab_adds,
+                                              overlay=overlay)
                 for nid in node_ids}
+
+    def _evaluate_nodes_columnar(
+        self, snap, plan: s.Plan, node_ids: List[str], slab_adds: Dict,
+        overlay_map: Dict[str, list], guard: bool = True,
+    ) -> Optional[Dict[str, bool]]:
+        """Fit re-check off the PR 9 columnar mirror: capacity, reserved,
+        eligibility, and LIVE USAGE come straight from the store's numpy
+        columns (O(changed) fold) instead of walking every touched
+        node's alloc objects — under gang-scale plans the walk was the
+        applier's dominant serial cost.  Per-node plan adds/removals and
+        the in-flight overlay stay host-side Python (small).  Falls back
+        per node for port-reserving allocs (allocs_fit owns port math)
+        and rows the mirror dropped; returns None when the mirror is
+        unavailable so callers run the walk.  Differential guard: every
+        NOMAD_TPU_COLUMNAR_GUARD_EVERY evaluations the walk runs anyway
+        and must agree — a mismatch is logged, counted, and the walk's
+        verdicts win (tests pin the cadence to 1: every tier-1 plan is
+        double-checked)."""
+        from ..state import columnar as colmod
+
+        columns_fn = getattr(snap, "columns", None)
+        if columns_fn is None or not colmod.enabled():
+            return None
+        cols = columns_fn()
+        if cols is None:
+            return None
+        usage = snap.column_usage(cols)
+
+        def res_vec(r: Optional[s.Resources]) -> np.ndarray:
+            if r is None:
+                return np.zeros(4, dtype=np.int64)
+            return np.array([r.cpu, r.memory_mb, r.disk_mb, r.iops],
+                            dtype=np.int64)
+
+        def combined(alloc: s.Allocation) -> np.ndarray:
+            if alloc.resources is not None:
+                return res_vec(alloc.resources)
+            total = res_vec(alloc.shared_resources)
+            for task_res in alloc.task_resources.values():
+                total += res_vec(task_res)
+            return total
+
+        def has_ports(alloc: s.Allocation) -> bool:
+            if alloc.resources is not None and alloc.resources.networks:
+                return True
+            return any(tr.networks
+                       for tr in alloc.task_resources.values())
+
+        out: Dict[str, bool] = {}
+        for node_id in node_ids:
+            if not self._preemptions_fresh(snap, plan, node_id):
+                out[node_id] = False
+                continue
+            adds = plan.node_allocation.get(node_id, [])
+            slab_here = slab_adds.get(node_id, [])
+            overlay = overlay_map.get(node_id, ())
+            if not adds and not slab_here:
+                out[node_id] = True  # evict-only always fits
+                continue
+            row = cols.row_of.get(node_id)
+            if (row is None or row >= cols.n
+                    or any(has_ports(a) for a in adds)
+                    or any(p.resources is not None and p.resources.networks
+                           for p, _ in slab_here)
+                    or any(p.resources is not None and p.resources.networks
+                           for p, _ in overlay)):
+                # Port accounting / dropped mirror rows: scalar walk for
+                # this node only.
+                out[node_id] = self._evaluate_node_plan(
+                    snap, plan, node_id, slab_adds, overlay=overlay_map)
+                continue
+            if not cols.eligible[row]:
+                out[node_id] = False
+                continue
+            need = cols.res[row] + usage[row]
+            for removal in list(plan.node_update.get(node_id, ())) + \
+                    list(plan.node_preemptions.get(node_id, ())):
+                live = snap.alloc_by_id(None, removal.id)
+                if (live is not None and not live.terminal_status()
+                        and live.node_id == node_id):
+                    need = need - combined(live)
+            for alloc in adds:
+                need = need + combined(alloc)
+            for proto, cnt in slab_here:
+                need = need + cnt * res_vec(proto.resources)
+            for proto, cnt in overlay:
+                need = need + cnt * res_vec(proto.resources)
+            out[node_id] = bool(np.all(need <= cols.cap[row]))
+
+        every = colmod.guard_every()
+        if guard and every > 0:
+            self._fit_guard_reads += 1
+            if self._fit_guard_reads % every == 0:
+                ref = self._evaluate_nodes_walk(snap, plan, node_ids,
+                                                slab_adds, overlay_map)
+                if ref != out:
+                    # Both passes read LIVE state: a concurrent write
+                    # (pipelined sibling commit, client status) between
+                    # them yields a benign divergence.  Re-run the
+                    # columnar pass — a race will not reproduce against
+                    # the walk's (newer) view; a real mirror bug will.
+                    out2 = self._evaluate_nodes_columnar(
+                        snap, plan, node_ids, slab_adds, overlay_map,
+                        guard=False)
+                    if out2 == ref:
+                        return ref
+                    bad = [nid for nid in node_ids
+                           if ref.get(nid) != out.get(nid)]
+                    colmod.note_guard_mismatch(
+                        "plan_fit", f"{len(bad)} node verdicts",
+                        Nodes=len(bad))
+                    self.logger.error(
+                        "columnar plan-fit guard mismatch on %d nodes "
+                        "(first: %s); using the walk's verdicts",
+                        len(bad), bad[:3])
+                    return ref
+        return out
 
     def _preemptions_fresh(self, snap, plan: s.Plan, node_id: str) -> bool:
         """Optimistic-concurrency fence for preemption: every alloc the
@@ -227,8 +491,12 @@ class PlanApplier:
         return True
 
     def _evaluate_node_plan(self, snap, plan: s.Plan, node_id: str,
-                            slab_adds: Optional[Dict] = None) -> bool:
-        """(plan_apply.go:327 evaluateNodePlan)."""
+                            slab_adds: Optional[Dict] = None,
+                            overlay: Optional[Dict[str, list]] = None,
+                            ) -> bool:
+        """(plan_apply.go:327 evaluateNodePlan).  ``overlay`` is the
+        pre-captured in-flight placement snapshot (see _evaluate_nodes:
+        it must be read BEFORE the store)."""
         if not self._preemptions_fresh(snap, plan, node_id):
             return False
         slab_here = (slab_adds or {}).get(node_id, [])
@@ -245,6 +513,12 @@ class PlanApplier:
         proposed = proposed + list(plan.node_allocation.get(node_id, []))
         for proto, cnt in slab_here:
             proposed.extend([proto] * cnt)
+        # In-flight overlay: placements committed by pipelined siblings
+        # but not yet visible in the store count against this node too.
+        pending = (overlay.get(node_id, ()) if overlay is not None
+                   else self._overlay.pending_for(node_id))
+        for proto, cnt in pending:
+            proposed.extend([proto] * cnt)
         try:
             fit, _, _ = allocs_fit(node, proposed)
         except ValueError:
@@ -254,6 +528,7 @@ class PlanApplier:
     def _evaluate_nodes_vectorized(
         self, snap, plan: s.Plan, node_ids: List[str],
         slab_adds: Optional[Dict] = None,
+        overlay: Optional[Dict[str, list]] = None,
     ) -> Dict[str, bool]:
         """Batched re-check: one kernel call replaces the reference's
         NumCPU/2 verification pool (scalar network checks retained
@@ -313,16 +588,35 @@ class PlanApplier:
                 used[i] += cnt * res_vec(proto.resources)
                 has_networks = has_networks or bool(
                     proto.resources is not None and proto.resources.networks)
+            pending = (overlay.get(node_id, ()) if overlay is not None
+                       else self._overlay.pending_for(node_id))
+            for proto, cnt in pending:
+                used[i] += cnt * res_vec(proto.resources)
+                # Overlay entries with port reservations route the node
+                # to the scalar fallback, where allocs_fit accounts them.
+                has_networks = has_networks or bool(
+                    proto.resources is not None and proto.resources.networks)
             if has_networks:
                 # Port/bandwidth accounting stays host-side: full scalar
                 # re-check for nodes with network reservations.
                 scalar_fallback[node_id] = self._evaluate_node_plan(
-                    snap, plan, node_id, slab_adds)
+                    snap, plan, node_id, slab_adds, overlay=overlay)
 
+        # Pad the node axis to the next power of two: XLA compiles per
+        # shape, and gang-scale plans otherwise mint a fresh compile for
+        # every distinct touched-node count — measured as the dominant
+        # serial applier cost under the multi-server gang workload.
+        # Zero rows trivially fit and are sliced away below.
+        padded = 1 << (n - 1).bit_length()
+        if padded != n:
+            capacity = np.concatenate(
+                [capacity, np.zeros((padded - n, 4), dtype=np.int64)])
+            used = np.concatenate(
+                [used, np.zeros((padded - n, 4), dtype=np.int64)])
         fit, _ = batch_allocs_fit(
             jnp.asarray(capacity, dtype=jnp.int32),
             jnp.asarray(used, dtype=jnp.int32))
-        fit = np.asarray(fit)
+        fit = np.asarray(fit)[:n]
         out: Dict[str, bool] = {}
         for i, node_id in enumerate(node_ids):
             if alloc_only[i]:
@@ -356,7 +650,19 @@ class PlanApplier:
         for update_list in result.node_update.values():
             allocs.extend(update_list)
         for alloc_list in result.node_allocation.values():
-            allocs.extend(alloc_list)
+            for alloc in alloc_list:
+                # Log-entry slimming: every placement embeds the full
+                # Job tree the payload already carries once — strip it
+                # on a COPY (the scheduler still holds the originals)
+                # and let upsert_plan_results re-denormalize.  Only
+                # same-job, non-terminal placements qualify (that is
+                # the exact condition the reattach checks).
+                if (alloc.job is not None and plan.job is not None
+                        and alloc.job_id == plan.job.id
+                        and not alloc.terminal_status()):
+                    alloc = alloc.copy()
+                    alloc.job = None
+                allocs.append(alloc)
         preempted: List[s.Allocation] = []
         for evicted_list in result.node_preemptions.values():
             allocs.extend(evicted_list)

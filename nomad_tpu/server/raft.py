@@ -76,6 +76,20 @@ _LEN = struct.Struct("<Q")
 SNAPSHOTS_RETAINED = 2
 
 
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
 class RaftLog:
     """Single-voter commit path: append → fsync (durable impls) → apply."""
 
@@ -122,6 +136,15 @@ class RaftLog:
     def applied_index(self) -> int:
         with self._l:
             return self._applied
+
+    def fence_index(self) -> int:
+        """Upper bound on every COMMITTED entry's index, safe for the
+        follower-read fence floor at leadership establishment.  For the
+        single-voter log applied == last; MultiRaft overrides with the
+        last LOG index — with async FSM apply the applied index can lag
+        committed entries still draining, and a floor below a committed
+        plan would let a lagging follower stale double-place."""
+        return self.applied_index()
 
     def applied_index_relaxed(self) -> int:
         """Lock-free lower bound on :meth:`applied_index`.  ``_applied``
@@ -256,11 +279,31 @@ class FileLog(RaftLog):
       wal.log         — legacy length-prefixed fallback (pure Python),
                         used when native is unavailable; replayed before
                         wal.crc on recovery so upgrades are seamless
+      walseg-<idx>.*  — sealed WAL segments rolled at a snapshot: fully
+                        fsynced, immutable, deleted once the snapshot
+                        blob that covers them is durable (a crash
+                        mid-snapshot leaves them for replay — nothing
+                        is ever lost to an unfinished snapshot)
       snapshot-<idx>  — FSM snapshot taken at <idx>
-    Recovery: newest snapshot restore, then WAL replay of entries > idx.
+    Recovery: newest snapshot restore, then sealed-segment + WAL replay
+    of entries > idx.
+
+    Automatic snapshotting (ISSUE 10 / ROADMAP item 2): a live server
+    compacts itself — a background thread watches entry/byte thresholds
+    and snapshots OFF the apply path (the expensive FSM serialization
+    runs on a copy-on-write state snapshot outside the log lock, while
+    appends keep flowing into a freshly rolled segment).  Thresholds:
+    ``NOMAD_TPU_FILELOG_SNAPSHOT_ENTRIES`` (default 8192, the
+    hashicorp/raft SnapshotThreshold), ``_BYTES`` (default 64MB of WAL),
+    ``_INTERVAL`` (check cadence, default 1s); 0 entries AND 0 bytes
+    disables.  Operator/test-invoked :meth:`snapshot` runs the same
+    implementation synchronously.
     """
 
-    def __init__(self, fsm: FSM, data_dir: str, fsync: bool = True):
+    def __init__(self, fsm: FSM, data_dir: str, fsync: bool = True,
+                 snapshot_entries: Optional[int] = None,
+                 snapshot_bytes: Optional[int] = None,
+                 snapshot_interval: Optional[float] = None):
         super().__init__(fsm)
         self.data_dir = data_dir
         self.fsync = fsync
@@ -288,6 +331,38 @@ class FileLog(RaftLog):
         self._py_written = 0
         self._py_synced = 0
         self._py_sync_in_flight = False
+        # Automatic snapshotting state.  _sync_inflight counts appliers
+        # holding a durability token (between _persist and the end of
+        # _sync_persist): the WAL roll at a snapshot waits it to zero —
+        # with the log lock held no new tokens mint, so the old
+        # handles/files are quiescent when swapped.
+        self._sync_inflight = 0
+        self._entries_since_snap = 0
+        self._bytes_since_snap = 0
+        self._snap_serial = threading.Lock()
+        self._snap_stop = threading.Event()
+        self._snap_thread: Optional[threading.Thread] = None
+        self.snapshot_entries = (snapshot_entries
+                                 if snapshot_entries is not None
+                                 else _env_int(
+                                     "NOMAD_TPU_FILELOG_SNAPSHOT_ENTRIES",
+                                     8192))
+        self.snapshot_bytes = (snapshot_bytes
+                               if snapshot_bytes is not None
+                               else _env_int(
+                                   "NOMAD_TPU_FILELOG_SNAPSHOT_BYTES",
+                                   64 << 20))
+        self.snapshot_interval = (snapshot_interval
+                                  if snapshot_interval is not None
+                                  else _env_float(
+                                      "NOMAD_TPU_FILELOG_SNAPSHOT_INTERVAL",
+                                      1.0))
+        if (self.snapshot_entries > 0 or self.snapshot_bytes > 0) \
+                and self.snapshot_interval > 0:
+            self._snap_thread = threading.Thread(
+                target=self._auto_snapshot_loop, daemon=True,
+                name="filelog-snapshot")
+            self._snap_thread.start()
 
     # -- recovery ----------------------------------------------------------
 
@@ -302,6 +377,13 @@ class FileLog(RaftLog):
                 out.append((idx, os.path.join(self.data_dir, name)))
         return sorted(out)
 
+    def _segment_files(self) -> List[str]:
+        out = []
+        for name in os.listdir(self.data_dir):
+            if name.startswith("walseg-"):
+                out.append(os.path.join(self.data_dir, name))
+        return sorted(out)
+
     def _recover(self) -> None:
         snap_idx = 0
         snaps = self._snapshot_files()
@@ -312,10 +394,28 @@ class FileLog(RaftLog):
             self._last_index = snap_idx
             self._applied = snap_idx
 
-        # Gather entries from BOTH logs and apply in index order: a node
-        # toggled between native and fallback modes may have newer entries
-        # in either file.
-        entries = self._read_legacy_entries(snap_idx)
+        # Sealed segments first (rolled at snapshots; a crash between the
+        # roll and the snapshot blob's fsync leaves their entries ONLY
+        # here), then the active logs.  Segments fully covered by the
+        # snapshot are deleted — replaying them again would only re-filter.
+        entries: List[Tuple[int, int, dict]] = []
+        for seg in self._segment_files():
+            if seg.endswith(".crc"):
+                got = self._read_crc_entries(snap_idx, path=seg)
+            else:
+                got = self._read_legacy_entries(snap_idx, path=seg)
+            if got:
+                entries.extend(got)
+            else:
+                try:
+                    os.unlink(seg)
+                except OSError:  # pragma: no cover — cleanup best-effort
+                    pass
+
+        # Gather entries from BOTH active logs and apply in index order: a
+        # node toggled between native and fallback modes may have newer
+        # entries in either file.
+        entries.extend(self._read_legacy_entries(snap_idx))
         if self._nwal is not None:
             # Native log replay (CRC + torn-tail handling done at open).
             # A CRC-valid record that still fails to decode (garbage or a
@@ -358,15 +458,16 @@ class FileLog(RaftLog):
         self._applied = self._last_index
         self._apply_next = self._last_index + 1
 
-    def _read_crc_entries(self, snap_idx: int):
+    def _read_crc_entries(self, snap_idx: int, path: Optional[str] = None):
         """Pure-Python reader for the native wal.crc format
         ([u32 len][u32 crc32(payload)][payload]); validates CRCs and
-        truncates a torn/corrupt tail exactly like wal.cc recover()."""
+        truncates a torn/corrupt tail exactly like wal.cc recover().
+        ``path`` reads a sealed segment instead of the active log."""
         import struct as _struct
         import zlib
 
         out = []
-        path = os.path.join(self.data_dir, "wal.crc")
+        path = path or os.path.join(self.data_dir, "wal.crc")
         if not os.path.exists(path):
             return out
         size = os.path.getsize(path)
@@ -394,14 +495,16 @@ class FileLog(RaftLog):
                 fh.truncate(good)
         return out
 
-    def _read_legacy_entries(self, snap_idx: int):
+    def _read_legacy_entries(self, snap_idx: int,
+                             path: Optional[str] = None):
+        wal_path = path or self.wal_path
         out = []
-        if not os.path.exists(self.wal_path):
+        if not os.path.exists(wal_path):
             return out
         good_offset = 0
         torn = False
-        wal_size = os.path.getsize(self.wal_path)
-        with open(self.wal_path, "rb") as fh:
+        wal_size = os.path.getsize(wal_path)
+        with open(wal_path, "rb") as fh:
             while True:
                 header = fh.read(_LEN.size)
                 if len(header) < _LEN.size:
@@ -433,7 +536,7 @@ class FileLog(RaftLog):
         # record — otherwise new fsynced entries land after garbage and are
         # unreachable on the next replay (silent loss).
         if torn:
-            with open(self.wal_path, "r+b") as fh:
+            with open(wal_path, "r+b") as fh:
                 fh.truncate(good_offset)
         return out
 
@@ -464,25 +567,35 @@ class FileLog(RaftLog):
                 self._wal_failed = True
                 act.raise_injected()
         if self._nwal is not None:
-            return self._nwal.write(blob)
-        pos = self._fh.tell()
-        try:
-            self._fh.write(_LEN.pack(len(blob)))
-            self._fh.write(blob)
-            self._fh.flush()
-        except OSError:
-            # Roll the torn frame back (ENOSPC): left mid-log it would
-            # strand later appends behind it — recovery truncates at
-            # the first bad frame.
+            token = self._nwal.write(blob)
+        else:
+            pos = self._fh.tell()
             try:
-                self._fh.seek(pos)
-                self._fh.truncate(pos)
-            except OSError:  # pragma: no cover — disk truly gone
-                pass
-            raise
+                self._fh.write(_LEN.pack(len(blob)))
+                self._fh.write(blob)
+                self._fh.flush()
+            except OSError:
+                # Roll the torn frame back (ENOSPC): left mid-log it would
+                # strand later appends behind it — recovery truncates at
+                # the first bad frame.
+                try:
+                    self._fh.seek(pos)
+                    self._fh.truncate(pos)
+                except OSError:  # pragma: no cover — disk truly gone
+                    pass
+                raise
+            with self._py_cv:
+                self._py_written += 1
+                token = self._py_written
+        # Auto-snapshot accounting (caller holds the raft lock) + the
+        # durability-token guard: inflight is raised ONLY once the write
+        # succeeded, and _sync_persist's finally lowers it — the WAL
+        # roll waits it to zero before swapping handles.
+        self._entries_since_snap += 1
+        self._bytes_since_snap += len(blob) + _LEN.size
         with self._py_cv:
-            self._py_written += 1
-            return self._py_written
+            self._sync_inflight += 1
+        return token
 
     def _sync_persist(self, seq: int, msg_type) -> None:
         """Wait (outside the raft lock) until the entry written as
@@ -490,6 +603,19 @@ class FileLog(RaftLog):
         — natively via wal.cc's group commit, in the fallback via the
         same written/synced-seq single-syncer dance in Python."""
         t0 = time.monotonic()
+        try:
+            self._do_sync_persist(seq)
+        finally:
+            with self._py_cv:
+                self._sync_inflight -= 1
+                self._py_cv.notify_all()
+        self.metrics.measure_since("raft.fsync", t0)
+        if msg_type == MessageType.APPLY_PLAN_RESULTS:
+            # The loadgen report's plan_apply_fsync percentiles: the
+            # durability wait specifically on the plan-apply path.
+            self.metrics.measure_since("raft.fsync.plan", t0)
+
+    def _do_sync_persist(self, seq: int) -> None:
         if self._nwal is not None:
             self._nwal.sync_to(seq)
         elif self.fsync:
@@ -522,11 +648,6 @@ class FileLog(RaftLog):
                             self._py_synced = cover
                         break
                     self._py_cv.wait()
-        self.metrics.measure_since("raft.fsync", t0)
-        if msg_type == MessageType.APPLY_PLAN_RESULTS:
-            # The loadgen report's plan_apply_fsync percentiles: the
-            # durability wait specifically on the plan-apply path.
-            self.metrics.measure_since("raft.fsync.plan", t0)
 
     def _write_torn_frame(self, blob: bytes) -> None:
         """Simulate a crash mid-append: leave a partial frame (header +
@@ -543,60 +664,173 @@ class FileLog(RaftLog):
         except OSError:  # pragma: no cover — fault plumbing best-effort
             pass
 
-    def snapshot(self) -> None:
-        """Write an FSM snapshot and truncate the WAL (fsm.go:568 +
-        snapshotsRetained=2)."""
-        with self._l:
-            # Drain the apply sequencer first: entries assigned but not
-            # yet applied are neither in the FSM snapshot nor allowed to
-            # survive the WAL truncation below (they would be lost on
-            # restart after their appliers ack).  Holding the log lock
-            # blocks new appends; in-flight syncers/appliers need only
-            # the sequencer, so this cannot deadlock.
-            with self._apply_cv:
-                while (self._apply_next <= self._last_index
-                       and not self._apply_failed):
-                    self._apply_cv.wait(timeout=1.0)
-            index = self._last_index
-            blob = self.fsm.snapshot()
-            path = os.path.join(self.data_dir, f"snapshot-{index}")
-            tmp = path + ".tmp"
-            with open(tmp, "wb") as fh:
-                fh.write(blob)
-                fh.flush()
-                os.fsync(fh.fileno())
-            os.replace(tmp, path)
-            # Truncate the WAL: all entries ≤ index are in the snapshot.
-            if self._nwal is not None:
-                self._nwal.reset()
-                if os.path.exists(self.wal_path):
-                    # Legacy records are covered by the snapshot too.
-                    open(self.wal_path, "wb").close()
+    def _roll_wal(self, index: int) -> List[str]:
+        """Seal the active WAL into immutable ``walseg-<index>`` files
+        and open fresh logs (caller holds the raft lock).  Everything
+        sealed is made durable FIRST — a durability token issued before
+        the roll resolves against an already-fsynced prefix, never
+        against the fresh (empty) log.  Returns the sealed paths for
+        deletion once the snapshot blob that covers them is durable."""
+        # Quiesce durability waiters: appends are blocked by the raft
+        # lock, so the token set only drains; waiters never need the
+        # raft lock, so this cannot deadlock.
+        with self._py_cv:
+            while self._sync_inflight:
+                self._py_cv.wait(0.05)
+        segs: List[str] = []
+        if self._nwal is not None:
+            try:
+                self._nwal.sync()
+            except OSError:
+                self._wal_failed = True
+                raise
+            self._nwal.close()
+            crc_path = os.path.join(self.data_dir, "wal.crc")
+            if os.path.exists(crc_path) and os.path.getsize(crc_path):
+                seg = os.path.join(self.data_dir,
+                                   f"walseg-{index:012d}.crc")
+                os.replace(crc_path, seg)
+                segs.append(seg)
+            from ..native import NativeWAL
+
+            self._nwal = NativeWAL(crc_path, fsync=self.fsync)
+            # Legacy records from a pre-native boot are covered too.
+            if os.path.exists(self.wal_path) \
+                    and os.path.getsize(self.wal_path):
+                seg = os.path.join(self.data_dir,
+                                   f"walseg-{index:012d}.log")
+                os.replace(self.wal_path, seg)
+                segs.append(seg)
+        else:
+            if self.fsync:
+                try:
+                    os.fsync(self._fh.fileno())
+                except OSError:
+                    with self._py_cv:
+                        self._py_failed = True
+                        self._py_cv.notify_all()
+                    self._wal_failed = True
+                    raise
+            self._fh.close()
+            if os.path.exists(self.wal_path) \
+                    and os.path.getsize(self.wal_path):
+                seg = os.path.join(self.data_dir,
+                                   f"walseg-{index:012d}.log")
+                os.replace(self.wal_path, seg)
+                segs.append(seg)
+            self._fh = open(self.wal_path, "ab")
+            with self._py_cv:
+                self._py_synced = self._py_written
+                self._py_cv.notify_all()
+        self._entries_since_snap = 0
+        self._bytes_since_snap = 0
+        return segs
+
+    def _persist_snapshot_blob(self, snap_store, index: int) -> None:
+        """Serialize + persist the FSM snapshot — the expensive step,
+        run OUTSIDE the log lock so appends keep flowing into the fresh
+        segment (and the seam the off-apply-path tests hook to prove
+        it)."""
+        blob = snap_store.persist()
+        path = os.path.join(self.data_dir, f"snapshot-{index}")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(blob)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+
+    def _snapshot_impl(self) -> bool:
+        """One FSM snapshot + WAL compaction (fsm.go:568 +
+        snapshotsRetained=2), apply-path-friendly: the log lock is held
+        only for the sequencer drain, an O(1) copy-on-write state
+        snapshot, and the segment roll; the serialization and the
+        fsyncs run outside it."""
+        t0 = time.monotonic()
+        with self._snap_serial:
+            # Quiesce-at-index loop: the sequencer drain must run
+            # WITHOUT the log lock — a live server's FSM-apply hooks
+            # read applied_index() (which takes it), so holding it
+            # across the drain deadlocks against the very entries being
+            # drained.  Instead: read the target index, wait for the
+            # sequencer to pass it lock-free, then re-acquire and
+            # verify nothing new was assigned; retry on a moving
+            # target (a saturated log just postpones compaction to the
+            # watcher's next tick).
+            for _attempt in range(50):
+                with self._l:
+                    if getattr(self, "_wal_failed", False):
+                        return False
+                    index = self._last_index
+                with self._apply_cv:
+                    while (self._apply_next <= index
+                           and not self._apply_failed):
+                        self._apply_cv.wait(timeout=1.0)
+                with self._l:
+                    if getattr(self, "_wal_failed", False):
+                        return False
+                    if self._last_index != index:
+                        continue  # new appends landed; chase the target
+                    snap_store = self.fsm.state.snapshot()
+                    segs = self._roll_wal(index)
+                    break
             else:
-                # Everything written so far is covered by the fsynced
-                # snapshot file: mark it synced so in-flight
-                # _sync_persist waiters resolve, and PARK the old
-                # handle instead of closing it — a racing fsync on the
-                # old fd stays harmless (the fd remains valid; the
-                # truncating reopen targets the path, not the fd).
-                with self._py_cv:
-                    self._py_synced = self._py_written
-                    self._parked_fh = self._fh
-                    self._fh = open(self.wal_path, "wb")
-                    self._py_cv.notify_all()
+                return False  # never quiesced; retry on the next tick
+            # Everything below runs while appends flow into the fresh
+            # segment.  A crash anywhere here is safe: the sealed
+            # segments still hold every entry the unfinished snapshot
+            # would have covered.
+            self._persist_snapshot_blob(snap_store, index)
+            for seg in segs:
+                try:
+                    os.unlink(seg)
+                except OSError:  # pragma: no cover — cleanup best-effort
+                    pass
             # Retain only the most recent snapshots.
-            snaps = self._snapshot_files()
-            for old_idx, old_path in snaps[:-SNAPSHOTS_RETAINED]:
-                os.unlink(old_path)
+            for _old_idx, old_path in \
+                    self._snapshot_files()[:-SNAPSHOTS_RETAINED]:
+                try:
+                    os.unlink(old_path)
+                except OSError:  # pragma: no cover
+                    pass
+        self.metrics.incr_counter("raft.snapshot")
+        self.metrics.measure_since("raft.snapshot.persist", t0)
+        return True
+
+    def _auto_snapshot_loop(self) -> None:
+        """Threshold watcher (hashicorp/raft runSnapshots): snapshots
+        on the dedicated thread, never on an applier's."""
+        import logging as _logging
+
+        while not self._snap_stop.wait(self.snapshot_interval):
+            with self._l:
+                due = (not getattr(self, "_wal_failed", False) and (
+                    (self.snapshot_entries > 0
+                     and self._entries_since_snap >= self.snapshot_entries)
+                    or (self.snapshot_bytes > 0
+                        and self._bytes_since_snap >= self.snapshot_bytes)))
+            if not due:
+                continue
+            try:
+                if self._snapshot_impl():
+                    self.metrics.incr_counter("raft.snapshot.auto")
+            except Exception:
+                _logging.getLogger("nomad_tpu.raft").exception(
+                    "automatic FSM snapshot failed")
+
+    def snapshot(self) -> None:
+        """Write an FSM snapshot and compact the WAL (operator/test
+        entry point; the automatic path runs the same implementation)."""
+        self._snapshot_impl()
 
     def close(self) -> None:
+        self._snap_stop.set()
+        if self._snap_thread is not None:
+            self._snap_thread.join(timeout=2.0)
         if self._nwal is not None:
             self._nwal.close()
         if self._fh is not None:
             self._fh.close()
-        parked = getattr(self, "_parked_fh", None)
-        if parked is not None:
-            parked.close()
 
 
 # ---------------------------------------------------------------------------
@@ -852,6 +1086,20 @@ class MultiRaft(RaftLog):
         self.pool = pool
         self._rand = random.Random(hash(my_addr) & 0xFFFFFF)
         self._leader = False  # starts as follower, unlike single-voter
+        # Timing knobs (instance-level env overrides of the class
+        # defaults): a GIL-bound in-process cluster under measurement
+        # load can starve the leader's heartbeat threads past the stock
+        # 0.3-0.6s window — depositions mid-benchmark measure election
+        # churn, not scheduling.  The loadgen harness slows elections
+        # down (NOMAD_TPU_RAFT_ELECTION_MIN_S/MAX_S) the way the
+        # reference tunes raft_multiplier on loaded hardware.
+        self.HEARTBEAT_INTERVAL = _env_float(
+            "NOMAD_TPU_RAFT_HEARTBEAT_S", type(self).HEARTBEAT_INTERVAL)
+        self.ELECTION_TIMEOUT = (
+            _env_float("NOMAD_TPU_RAFT_ELECTION_MIN_S",
+                       type(self).ELECTION_TIMEOUT[0]),
+            _env_float("NOMAD_TPU_RAFT_ELECTION_MAX_S",
+                       type(self).ELECTION_TIMEOUT[1]))
 
         self.store = _RaftStore(data_dir)
         (self.term, self.voted_for, saved_peers, self.base_index,
@@ -872,8 +1120,22 @@ class MultiRaft(RaftLog):
         # cluster through a replicated CONFIG entry.
         self.peers: List[str] = saved_peers or [my_addr]
         self._bootstrapped = bool(saved_peers)
+        # Non-voting members (the reference's non_voting_server, ISSUE
+        # 10): replicated like voters — they receive AppendEntries /
+        # InstallSnapshot and apply the FSM, which is what follower-read
+        # scheduling needs — but they are never counted toward quorum
+        # and never campaign.  Scheduling capacity scales with learner
+        # count while commit latency stays pinned to the voter set.
+        self.learners: List[str] = []
 
         self._futures: dict = {}           # index -> _ApplyFuture
+        # Leader-appended entries keep their ORIGINAL payload object so
+        # the local FSM apply skips re-decoding its own blob (the
+        # single-voter path shares objects the same way; followers
+        # decode from the replicated blob as before).  Entries are
+        # dropped at apply and at conflict truncation — a truncated
+        # index may be refilled by a DIFFERENT leader's entry.
+        self._local_payloads: dict = {}    # index -> payload
         self._next: dict = {}              # peer -> next index to send
         self._match: dict = {}             # peer -> highest replicated index
         self._repl_events: dict = {}       # peer -> threading.Event
@@ -882,6 +1144,14 @@ class MultiRaft(RaftLog):
         self._last_contact = 0.0
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        # Follower-side async apply (ISSUE 10): raft only requires a
+        # follower to APPEND before acking — applying committed entries
+        # can happen after the reply.  Doing it inline put every FSM
+        # apply inside the leader's quorum round trip (a loaded
+        # follower's apply time became plan-apply latency cluster-wide);
+        # the applier thread drains commit_index in small chunks so
+        # incoming AppendEntries interleave on the lock.
+        self._apply_kick = threading.Event()
         # Leadership transitions are delivered to callbacks strictly in
         # the order they occurred, by one dispatcher thread.  Spawning a
         # thread per transition could reorder a win-then-step-down into
@@ -923,14 +1193,38 @@ class MultiRaft(RaftLog):
 
     # -- lifecycle ---------------------------------------------------------
 
+    # Entries applied per lock hold by the async applier: small enough
+    # that an incoming AppendEntries (which only needs the lock for the
+    # append) never waits behind a long committed backlog.
+    APPLY_CHUNK = 16
+
     def start(self) -> None:
         import time as _time
         self._last_contact = _time.monotonic()
         for target, name in ((self._ticker, "raft-ticker"),
-                             (self._leader_dispatch_loop, "raft-leadership")):
+                             (self._leader_dispatch_loop, "raft-leadership"),
+                             (self._apply_loop, "raft-applier")):
             t = threading.Thread(target=target, name=name, daemon=True)
             t.start()
             self._threads.append(t)
+
+    def _apply_loop(self) -> None:
+        """Follower-side committed-entry applier: drains ``commit_index``
+        OUTSIDE the AppendEntries reply path.  Chunked lock holds keep
+        appends interleaving; ordering is preserved because _apply_to
+        only ever advances _last_index under the lock (the leader's
+        inline _advance_commit applies through the same guard, so a
+        freshly promoted leader and this thread cannot double-apply)."""
+        while not self._stop.is_set():
+            if not self._apply_kick.wait(0.05):
+                continue
+            self._apply_kick.clear()
+            while True:
+                with self._l:
+                    if self._last_index >= self.commit_index:
+                        break
+                    self._apply_to(min(self.commit_index,
+                                       self._last_index + self.APPLY_CHUNK))
 
     def close(self) -> None:
         self._stop.set()
@@ -996,6 +1290,13 @@ class MultiRaft(RaftLog):
         with self._l:
             return self.state == "leader"
 
+    def fence_index(self) -> int:
+        """Last LOG index: election safety puts every committed entry
+        at or below it, and unlike the applied index it cannot lag the
+        async applier (see RaftLog.fence_index)."""
+        with self._l:
+            return self._last_log_index()
+
     # -- persistence helpers (caller holds self._l) ------------------------
 
     def _persist_meta(self) -> None:
@@ -1022,13 +1323,31 @@ class MultiRaft(RaftLog):
         lo, hi = self.ELECTION_TIMEOUT
         return lo + self._rand.random() * (hi - lo)
 
+    def add_learner(self, addr: str) -> None:
+        """Leader-side: attach a non-voting member to the replication
+        fan-out (no CONFIG entry — learners are not part of the
+        committed quorum configuration)."""
+        with self._l:
+            if (addr == self.my_addr or addr in self.peers
+                    or addr in self.learners):
+                return
+            self.learners.append(addr)
+            if self.state == "leader":
+                self._start_replicator(addr)
+
     def _ticker(self) -> None:
         import time as _time
         timeout = self._election_timeout()
         while not self._stop.is_set():
             _time.sleep(0.015)
             with self._l:
-                campaigning_ok = self._bootstrapped and self.state != "leader"
+                # Non-members never campaign: a learner receives the
+                # committed voter config (it is not in it), and a voter
+                # removed from the config must not start elections its
+                # quorum can't win.
+                campaigning_ok = (self._bootstrapped
+                                  and self.state != "leader"
+                                  and self.my_addr in self.peers)
                 since = _time.monotonic() - self._last_contact
             if campaigning_ok and since >= timeout:
                 self._run_election()
@@ -1114,7 +1433,7 @@ class MultiRaft(RaftLog):
                msgpack.packb(self.peers, use_bin_type=True)]
         self.log.append(cfg)
         self.store.append([cfg])
-        for p in self.peers:
+        for p in self.peers + self.learners:
             if p != self.my_addr:
                 self._start_replicator(p)
         self._advance_commit()
@@ -1240,8 +1559,20 @@ class MultiRaft(RaftLog):
                 kick.clear()
                 kick.wait(self.HEARTBEAT_INTERVAL)
 
+    def _snapshot_chunk_size(self) -> int:
+        """Bytes per InstallSnapshot chunk (streaming install,
+        ISSUE 10): a follower far behind the horizon catches up off the
+        PR 9 binary (NTPUSNP2) blob incrementally instead of one giant
+        frame — each chunk stays well under the RPC frame cap and
+        refreshes the follower's leader-contact clock, so a multi-GB
+        install can no longer starve its election timer or blow the
+        64MB frame limit."""
+        return max(1, _env_int("NOMAD_TPU_SNAPSHOT_CHUNK", 4 << 20))
+
     def _send_snapshot(self, peer: str, term: int) -> None:
-        """InstallSnapshot for a peer behind the log horizon."""
+        """InstallSnapshot for a peer behind the log horizon: one frame
+        for small blobs (wire-compatible with pre-streaming followers),
+        chunked offset/total/done frames past the chunk size."""
         from .rpc import RPC_RAFT
         with self._l:
             if self.state != "leader" or self.term != term:
@@ -1251,21 +1582,43 @@ class MultiRaft(RaftLog):
             last_term = self._term_at(last_index)
             if last_term < 0:
                 last_term = self.base_term
-        try:
-            reply = self.pool.call(peer, "raft", {
-                "kind": "install_snapshot", "term": term,
+            peers = list(self.peers)
+        chunk = self._snapshot_chunk_size()
+        base = {"kind": "install_snapshot", "term": term,
                 "leader": self.my_addr,
                 "last_index": last_index, "last_term": last_term,
-                "peers": self.peers,  # config rides the snapshot
-                "data": blob,
-            }, channel=RPC_RAFT, timeout=10.0)
+                "peers": peers}  # config rides the snapshot
+        try:
+            if len(blob) <= chunk:
+                reply = self.pool.call(
+                    peer, "raft", dict(base, data=blob),
+                    channel=RPC_RAFT, timeout=10.0)
+            else:
+                total = len(blob)
+                reply = None
+                for off in range(0, total, chunk):
+                    with self._l:
+                        if self.state != "leader" or self.term != term:
+                            return
+                    reply = self.pool.call(peer, "raft", dict(
+                        base, data=blob[off:off + chunk], offset=off,
+                        total=total, done=off + chunk >= total,
+                    ), channel=RPC_RAFT, timeout=10.0)
+                    self.metrics.incr_counter("raft.snapshot.chunks_sent")
+                    if reply.get("term", 0) > term \
+                            or not reply.get("success", False):
+                        break  # demoted, or receiver lost the sequence
         except Exception:
             self._repl_events[peer].clear()
             self._repl_events[peer].wait(0.2)
             return
         with self._l:
-            if reply.get("term", 0) > self.term:
+            if reply is not None and reply.get("term", 0) > self.term:
                 self._step_down(reply["term"])
+                return
+            if reply is None or not reply.get("success", True):
+                # Receiver aborted (restart/sequence loss): the
+                # replicator loop retries the install from offset 0.
                 return
             self._match[peer] = max(self._match.get(peer, 0), last_index)
             self._next[peer] = last_index + 1
@@ -1289,7 +1642,15 @@ class MultiRaft(RaftLog):
         n = matches[len(matches) - self._quorum()]
         if n > self.commit_index and self._term_at(n) == self.term:
             self.commit_index = n
-            self._apply_to(self.commit_index)
+            if self._threads:
+                # FSM application (and future resolution) runs on the
+                # dedicated applier thread: replicator reply handling
+                # holding the raft lock through every committed entry's
+                # FSM apply made lock waits — and therefore the NEXT
+                # replication round — scale with apply cost.
+                self._apply_kick.set()
+            else:  # not start()ed (unit-test harness): inline
+                self._apply_to(self.commit_index)
 
     def _apply_to(self, target: int) -> None:
         """Apply committed entries through ``target`` in index order,
@@ -1308,9 +1669,12 @@ class MultiRaft(RaftLog):
                     self._bootstrapped = True
                     self._persist_meta()
             elif mt != NOOP_TYPE:
+                payload = self._local_payloads.pop(idx, None)
                 try:
-                    result = self.fsm.apply(idx, MessageType(mt),
-                                            decode_payload(blob))
+                    result = self.fsm.apply(
+                        idx, MessageType(mt),
+                        payload if payload is not None
+                        else decode_payload(blob))
                 except Exception:
                     self.logger.exception("raft: fsm apply failed at %d", idx)
             self._last_index = idx
@@ -1359,6 +1723,11 @@ class MultiRaft(RaftLog):
                     if self.log[pos][1] != e[1]:
                         del self.log[pos:]
                         self.store.rewrite(self.log)
+                        # A different leader refills these indexes: the
+                        # cached local payloads no longer describe them.
+                        for cached in [i for i in self._local_payloads
+                                       if i >= e[0]]:
+                            del self._local_payloads[cached]
                         append_from = k
                         break
                     # identical entry already present — skip
@@ -1372,7 +1741,14 @@ class MultiRaft(RaftLog):
             new_commit = min(msg["leader_commit"], self._last_log_index())
             if new_commit > self.commit_index:
                 self.commit_index = new_commit
-                self._apply_to(new_commit)
+                if self._threads:
+                    # Ack now, apply async: the applier thread owns the
+                    # FSM catch-up (see _apply_loop) so a busy
+                    # follower's apply time never rides the leader's
+                    # quorum wait.
+                    self._apply_kick.set()
+                else:  # not start()ed (unit-test harness): inline
+                    self._apply_to(new_commit)
             return {"success": True, "term": self.term,
                     "match": self._last_log_index()}
 
@@ -1387,12 +1763,35 @@ class MultiRaft(RaftLog):
                 self._persist_meta()
             self.leader_addr = msg["leader"]
             self._last_contact = _time.monotonic()
+            if "offset" in msg:
+                # Streaming install: buffer chunks until done.  The key
+                # pins one specific snapshot transfer; any sequence
+                # break (leader restart, interleaved transfer) replies
+                # success=False and the leader restarts from offset 0.
+                key = (msg["term"], msg["last_index"], msg["total"])
+                rx = getattr(self, "_snap_rx", None)
+                if msg["offset"] == 0:
+                    rx = self._snap_rx = {"key": key, "chunks": [],
+                                          "received": 0}
+                if (rx is None or rx["key"] != key
+                        or rx["received"] != msg["offset"]):
+                    self._snap_rx = None
+                    return {"term": self.term, "success": False}
+                rx["chunks"].append(msg["data"])
+                rx["received"] += len(msg["data"])
+                if not msg.get("done"):
+                    return {"term": self.term, "success": True}
+                self._snap_rx = None
+                if rx["received"] != msg["total"]:
+                    return {"term": self.term, "success": False}
+                msg = dict(msg, data=b"".join(rx["chunks"]))
             self.fsm.restore(msg["data"])
             if msg.get("peers"):
                 self._adopt_peers(list(msg["peers"]))
             self.base_index = msg["last_index"]
             self.base_term = msg["last_term"]
             self.log = []
+            self._local_payloads.clear()
             self.store.save_snapshot(self.base_index, self.base_term,
                                      msg["data"])
             self.store.rewrite([])
@@ -1426,6 +1825,11 @@ class MultiRaft(RaftLog):
     def apply(self, msg_type: MessageType, payload: dict):
         from .log_codec import encode_payload
         t0 = time.monotonic()
+        # Encode OUTSIDE the raft lock: concurrent appliers pay their
+        # own codec time instead of convoying every append behind it
+        # (an entry is pure data; index assignment below still orders
+        # the log).
+        blob = encode_payload(payload)
         with self._l:
             if self.state != "leader":
                 raise NotLeaderError(self.leader_addr or "")
@@ -1435,13 +1839,13 @@ class MultiRaft(RaftLog):
                 # re-elects (possibly us) via the normal election timer.
                 self._step_down(self.term)
                 raise NotLeaderError(self.leader_addr or "")
-            blob = encode_payload(payload)
             index = self._last_log_index() + 1
             entry = [index, self.term, int(msg_type), blob]
             self.log.append(entry)
             self.store.append([entry])
             fut = _ApplyFuture()
             self._futures[index] = fut
+            self._local_payloads[index] = payload
             self._advance_commit()  # single-voter clusters commit here
         self._kick_replicators()
         result = fut.wait(self.APPLY_TIMEOUT)

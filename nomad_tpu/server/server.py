@@ -73,6 +73,26 @@ class ServerConfig:
     broker_bypass_priority: int = field(default_factory=lambda: int(
         os.environ.get("NOMAD_TPU_BROKER_BYPASS_PRIO", "")
         or s.JOB_MAX_PRIORITY))
+    # Follower-read scheduling (ISSUE 10): on a multi-raft cluster every
+    # server also runs FollowerWorkers that, while the server is a
+    # follower, pull evals from the leader's broker over RPC, schedule
+    # off the locally replicated FSM, and forward plans to the leader's
+    # serialized plan-apply (server/follower_sched.py).  Default on —
+    # they idle on single-voter servers and on the leader.
+    follower_scheduling: bool = field(default_factory=lambda: (
+        os.environ.get("NOMAD_TPU_FOLLOWER_SCHED", "").strip().lower()
+        not in ("0", "false", "no", "off")))
+    # Follower workers per server; 0 → num_schedulers.
+    follower_schedulers: int = 0
+    # Join as a NON-VOTING member (the reference's non_voting_server):
+    # replicated like a voter — so follower-read scheduling works — but
+    # never counted toward quorum and never campaigning.  The shape for
+    # scaling scheduler capacity without scaling commit latency.
+    non_voting: bool = False
+    # Force MultiRaft even for a cluster seed with bootstrap_expect=1 —
+    # the shape a deterministic leader takes when follower-scheduler
+    # servers will join it later (the loadgen multi-server scenario).
+    force_multi_raft: bool = False
     # Heartbeat TTL jitter fraction (thundering-herd dispersal).
     heartbeat_ttl_jitter: float = field(default_factory=lambda: float(
         os.environ.get("NOMAD_TPU_HEARTBEAT_JITTER", "") or 0.1))
@@ -177,7 +197,8 @@ class Server:
         # clustering is configured, else the single-voter WAL / in-memory
         # log (raftInmem dev path).
         multi = self.config.enable_rpc and (
-            self.config.bootstrap_expect > 1 or bool(self.config.start_join))
+            self.config.bootstrap_expect > 1 or bool(self.config.start_join)
+            or self.config.force_multi_raft)
         if multi:
             raft_dir = (os.path.join(self.config.data_dir, "raft")
                         if self.config.data_dir else None)
@@ -229,6 +250,8 @@ class Server:
         self.periodic = PeriodicDispatch(self._periodic_dispatch, self.logger)
 
         self.workers: List[Worker] = []
+        self.follower_workers: List[Worker] = []
+        self.leader_channel = None
         self._reaper_threads: List[threading.Thread] = []
 
     # -- lifecycle ---------------------------------------------------------
@@ -272,8 +295,30 @@ class Server:
                     time_table=self.time_table,
                     metrics=self.metrics)
             self.workers.append(worker)
+        # Follower-read scheduling (ISSUE 10): one FollowerWorker pool
+        # per multi-raft server.  They park while this server leads
+        # (the local pool above owns the broker) and pull from the
+        # leader over RPC otherwise, so no leadership-transition
+        # choreography is needed — both pools exist, exactly one is
+        # active.
+        if (self.config.follower_scheduling and self.pool is not None
+                and isinstance(self.raft, MultiRaft)
+                and (self.config.follower_schedulers
+                     or self.config.num_schedulers) > 0):
+            from .follower_sched import FollowerWorker, LeaderChannel
+
+            self.leader_channel = LeaderChannel(
+                self.pool, self.leader_address,
+                my_addr=self.config.rpc_advertise, metrics=self.metrics)
+            n = self.config.follower_schedulers or self.config.num_schedulers
+            for _ in range(n):
+                self.follower_workers.append(FollowerWorker(
+                    self.raft, self.leader_channel, self.is_leader,
+                    logger=self.logger, metrics=self.metrics))
         self.raft.notify_leadership(self._leadership_changed)
         for worker in self.workers:
+            worker.start()
+        for worker in self.follower_workers:
             worker.start()
 
     # -- cluster event stream ----------------------------------------------
@@ -328,6 +373,8 @@ class Server:
         self.event_broker.close()
         for worker in self.workers:
             worker.stop()
+        for worker in self.follower_workers:
+            worker.stop()
         self.plan_applier.stop()
         self.eval_broker.set_enabled(False)
         self.blocked_evals.set_enabled(False)
@@ -348,7 +395,8 @@ class Server:
                 "Addr": self.config.rpc_advertise,
                 "Region": self.config.region,
                 "Status": "alive",
-                "StatusTime": self._status_time}
+                "StatusTime": self._status_time,
+                "NonVoter": self.config.non_voting}
 
     def members(self) -> List[Dict]:
         """(serf.Members / nomad/serf.go peer table)."""
@@ -487,16 +535,23 @@ class Server:
         with self._members_lock:
             # WAN members of other regions are never raft voters
             # (serf.go: per-region raft, WAN gossip for federation only).
+            # Non-voting members (non_voting_server) replicate but never
+            # join the quorum configuration.
             addrs = [m["Addr"] for m in self._members.values()
                      if m.get("Region", self.config.region)
-                     == self.config.region]
+                     == self.config.region and not m.get("NonVoter")]
+            learner_addrs = [m["Addr"] for m in self._members.values()
+                             if m.get("Region", self.config.region)
+                             == self.config.region and m.get("NonVoter")]
         if not self.raft._bootstrapped:
-            if self.config.start_join:
+            if self.config.start_join or self.config.non_voting:
                 return
             if len(addrs) >= self.config.bootstrap_expect:
                 self.raft.bootstrap(addrs)
             return
         if self.raft.is_raft_leader():
+            for addr in learner_addrs:
+                self.raft.add_learner(addr)
             new = sorted(set(self.raft.peers) | set(addrs))
             if new != sorted(self.raft.peers):
                 def _propose():
@@ -554,6 +609,18 @@ class Server:
         self._leader = True
         self.eval_broker.set_enabled(True)
         self.plan_queue.set_enabled(True)
+        # Follower-read fence floor (ISSUE 10): the previous leader's
+        # per-job plan fences died with its PlanQueue, but election
+        # safety guarantees every COMMITTED plan is ≤ our LOG's last
+        # index right now (fence_index — NOT the applied index, which
+        # the async FSM applier may still be draining toward).  Raising
+        # the global floor makes every remote dequeue carry a fence ≥
+        # this index, so a lagging follower replicates past all
+        # pre-failover plans before scheduling — without it, a follower
+        # could schedule a job off a snapshot missing that job's own
+        # committed placements (the one staleness the applier's
+        # capacity re-check cannot catch).
+        self.plan_queue.note_applied("", self.raft.fence_index())
         self.blocked_evals.set_enabled(True)
         self.periodic.set_enabled(True)
         self.heartbeat.set_enabled(True)
@@ -721,6 +788,20 @@ class Server:
                                        self.heartbeat.active())
                 self.metrics.set_gauge("raft.applied_index",
                                        self.raft.applied_index())
+                if self.leader_channel is not None:
+                    self.metrics.set_gauge(
+                        "plan.forward.inflight",
+                        self.leader_channel.inflight())
+                if isinstance(self.raft, MultiRaft) and not self._leader:
+                    # Replication debt of this follower's FSM vs the
+                    # commit horizon the leader has shown it (a lower
+                    # bound on true leader lag; the per-dequeue
+                    # follower.snapshot_lag samples carry the exact
+                    # leader-applied delta).
+                    self.metrics.set_gauge(
+                        "follower.snapshot_lag",
+                        max(0, self.raft.commit_index
+                            - self.raft.applied_index_relaxed()))
                 if self._events_enabled:
                     es = self.event_broker.stats()
                     self.metrics.set_gauge("events.ring_depth",
@@ -1522,6 +1603,55 @@ class Server:
         self._require_leader()
         return self.eval_broker.dequeue(schedulers, timeout)
 
+    def eval_dequeue_batch(self, schedulers: List[str], max_batch: int,
+                           timeout: float = 0.0) -> Dict:
+        """Remote-worker dequeue (Eval.DequeueBatch): up to ``max_batch``
+        ready evals plus, per eval, the delivery-attempt count and the
+        job's PLAN FENCE — the raft index of its newest committed plan
+        (PlanQueue.applied_index_for).  A follower scheduler must cover
+        ``max(eval.trigger_index(), fence)`` with its local log before
+        scheduling (the follower-read staleness guard,
+        server/follower_sched.py).  ``AppliedIndex`` carries the
+        leader's applied index for the follower snapshot-lag gauge."""
+        self._require_leader()
+        batch = self.eval_broker.dequeue_batch(
+            schedulers, max(1, min(int(max_batch), 32)), timeout)
+        items = []
+        for ev, token in batch:
+            items.append({
+                "eval": ev, "token": token,
+                "attempts": self.eval_broker.delivery_attempts(ev.id),
+                "fence": self.plan_queue.applied_index_for(ev.job_id),
+            })
+        return {"items": items,
+                "applied_index": self.raft.applied_index_relaxed()}
+
+    def eval_update(self, evals: List[s.Evaluation]) -> int:
+        """Apply an EVAL_UPDATE on behalf of a remote worker
+        (Eval.Update — the wire twin of WorkerPlanner.update_eval /
+        create_eval / record_eval_failures)."""
+        _, index = self.raft.apply(MessageType.EVAL_UPDATE,
+                                   {"evals": evals})
+        return index
+
+    def eval_reblock(self, ev: s.Evaluation, token: str) -> int:
+        """Apply + reblock on behalf of a remote worker (Eval.Reblock):
+        the blocked-eval tracker is leader-local state, so the update
+        and the reblock must land on the same server."""
+        self._require_leader()
+        _, index = self.raft.apply(MessageType.EVAL_UPDATE,
+                                   {"evals": [ev]})
+        self.blocked_evals.reblock(ev, token)
+        return index
+
+    def eval_pause_nack(self, eval_id: str, token: str) -> None:
+        self._require_leader()
+        self.eval_broker.pause_nack_timeout(eval_id, token)
+
+    def eval_resume_nack(self, eval_id: str, token: str) -> None:
+        self._require_leader()
+        self.eval_broker.resume_nack_timeout(eval_id, token)
+
     def eval_ack(self, eval_id: str, token: str) -> None:
         if not self._leader:
             self._forward("Eval.Ack", {"EvalID": eval_id, "Token": token})
@@ -1554,8 +1684,23 @@ class Server:
     # -- Plan --------------------------------------------------------------
 
     def plan_submit(self, plan: s.Plan):
-        """(Plan.Submit → PlanQueue, plan_endpoint.go)."""
+        """(Plan.Submit → PlanQueue, plan_endpoint.go).
+
+        Token fence: a plan whose eval token no longer matches the
+        broker's OUTSTANDING delivery is a stale worker's submission —
+        the nack deadline fired and the eval was redelivered (possibly
+        to another server; follower-read deliveries run against the
+        full deadline with no mid-flight pause).  Rejecting it here is
+        what makes redelivery safe: same-job double placement is the
+        one staleness the applier's capacity re-check cannot catch.
+        Plans without a token (tests, direct operators) pass."""
         self._require_leader()
+        if plan.eval_id and plan.eval_token:
+            token, outstanding = self.eval_broker.outstanding(plan.eval_id)
+            if outstanding and token != plan.eval_token:
+                raise RuntimeError(
+                    f"plan token fence: eval {plan.eval_id} was "
+                    "redelivered; stale delivery's plan rejected")
         return self.plan_queue.enqueue(plan)
 
     # -- System ------------------------------------------------------------
@@ -1579,6 +1724,18 @@ class Server:
         out = self.eval_broker.extended_stats()
         out["PlanQueueDepth"] = self.plan_queue.depth()
         out["BlockedEvals"] = self.blocked_evals.stats()
+        # Follower-read scheduling surface (ISSUE 10): what THIS server
+        # is forwarding to the leader, and how far its replicated FSM
+        # lags the commit horizon it knows about.
+        fs: Dict = {"Enabled": bool(self.follower_workers),
+                    "IsLeader": self._leader}
+        if self.leader_channel is not None:
+            fs.update(self.leader_channel.stats())
+        if isinstance(self.raft, MultiRaft):
+            fs["SnapshotLag"] = max(
+                0, self.raft.commit_index
+                - self.raft.applied_index_relaxed())
+        out["FollowerSched"] = fs
         return out
 
     def stats(self) -> Dict:

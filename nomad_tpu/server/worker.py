@@ -112,7 +112,7 @@ class WorkerPlanner:
         return result, state
 
     def update_eval(self, ev: s.Evaluation) -> None:
-        self.worker.raft.apply(MessageType.EVAL_UPDATE, {"evals": [ev]})
+        self.worker.apply_eval_updates([ev])
 
     def _snapshot_index(self) -> int:
         if self.snapshot_index is not None:
@@ -121,16 +121,13 @@ class WorkerPlanner:
 
     def create_eval(self, ev: s.Evaluation) -> None:
         ev.snapshot_index = self._snapshot_index()
-        self.worker.raft.apply(MessageType.EVAL_UPDATE, {"evals": [ev]})
+        self.worker.apply_eval_updates([ev])
 
     def reblock_eval(self, ev: s.Evaluation) -> None:
         """(worker.go:470 ReblockEval) — update snapshot index and hand it
         to the blocked tracker via the broker requeue path."""
-        w = self.worker
         ev.snapshot_index = self._snapshot_index()
-        w.raft.apply(MessageType.EVAL_UPDATE, {"evals": [ev]})
-        if w.blocked_evals is not None:
-            w.blocked_evals.reblock(ev, self.token)
+        self.worker.reblock_eval_update(ev, self.token)
 
 
 class Worker:
@@ -314,12 +311,27 @@ class Worker:
                 f"{type(exc).__name__}: {exc}")
             failed.append(f)
         try:
-            self.raft.apply(MessageType.EVAL_UPDATE, {"evals": failed})
+            self.apply_eval_updates(failed)
         except Exception:
             # Recording forensics must never mask the nack itself (e.g.
             # leadership was lost — the next leader redelivers anyway).
             self.logger.debug("could not record failure reason for %d "
                               "evals", len(failed), exc_info=True)
+
+    # -- leader-write hooks ------------------------------------------------
+    # The two write surfaces workers/planners touch beyond plan
+    # submission.  On a leader-local worker they go straight through the
+    # log; FollowerWorker (server/follower_sched.py) overrides both to
+    # forward over the wire, which is what lets one WorkerPlanner serve
+    # both sides.
+
+    def apply_eval_updates(self, evals: List[s.Evaluation]) -> None:
+        self.raft.apply(MessageType.EVAL_UPDATE, {"evals": evals})
+
+    def reblock_eval_update(self, ev: s.Evaluation, token: str) -> None:
+        self.apply_eval_updates([ev])
+        if self.blocked_evals is not None:
+            self.blocked_evals.reblock(ev, token)
 
     def wait_for_index(self, index: int, timeout: float) -> bool:
         """Wait for log catch-up (worker.go:229).  Backed-off polling:
@@ -389,8 +401,18 @@ class Worker:
 
     def invoke_scheduler(self, ev: s.Evaluation, token: str) -> None:
         """(worker.go:262): snapshot state, instantiate by eval type."""
-        snapshot_index, snap = self._snapshot_covering(
-            self._required_index(ev))
+        required = self._required_index(ev)
+        # The fence is a WAIT, not just a cache-choice input: with
+        # multi-voter raft the FSM applier is asynchronous, so even a
+        # leader-local fresh snapshot can predate a committed plan
+        # still draining (e.g. pre-failover plans under the restored
+        # fence floor).  Covered already ⇒ the first poll returns
+        # immediately; a wedged applier raises and the eval nacks.
+        if not self.wait_for_index(required, RAFT_SYNC_LIMIT):
+            raise RuntimeError(
+                f"state did not reach fence {required} within "
+                f"{RAFT_SYNC_LIMIT}s for eval {ev.id}")
+        snapshot_index, snap = self._snapshot_covering(required)
         planner = WorkerPlanner(self, ev, token,
                                 snapshot_index=snapshot_index)
         sched_name = self.sched_name(ev)

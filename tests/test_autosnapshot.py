@@ -1,0 +1,205 @@
+"""Automatic FSM snapshotting on a live single-voter FileLog server
+(ISSUE 10): entry/byte thresholds trip a background snapshot taken OFF
+the apply path — the expensive serialization runs on a copy-on-write
+state snapshot outside the log lock while appends keep flowing into a
+freshly rolled WAL segment — and restore parity with operator-invoked
+snapshots holds.
+"""
+import os
+import threading
+import time
+
+import pytest
+
+from nomad_tpu import mock
+from nomad_tpu.server.fsm import FSM, MessageType
+from nomad_tpu.server.raft import FileLog
+
+
+def wait_until(predicate, timeout=15.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def snapshots_in(d):
+    return sorted(int(f.split("-", 1)[1]) for f in os.listdir(d)
+                  if f.startswith("snapshot-")
+                  and not f.endswith(".tmp"))
+
+
+def segments_in(d):
+    return [f for f in os.listdir(d) if f.startswith("walseg-")]
+
+
+@pytest.mark.parametrize("native", [True, False])
+class TestAutoSnapshot:
+    def _mk(self, d, monkeypatch, native, **kw):
+        if not native:
+            monkeypatch.setenv("NOMAD_TPU_NO_NATIVE", "1")
+        log = FileLog(FSM(), d, **kw)
+        if not native:
+            assert log._nwal is None
+        return log
+
+    def test_threshold_trips_under_live_writes(self, tmp_path,
+                                               monkeypatch, native):
+        """Concurrent appliers push past the entry threshold; the
+        background thread snapshots (possibly repeatedly), segments are
+        cleaned up, and a restart replays to the identical state."""
+        d = str(tmp_path / "raft")
+        log = self._mk(d, monkeypatch, native, snapshot_entries=40,
+                       snapshot_bytes=0, snapshot_interval=0.05)
+
+        def writer():
+            for _ in range(60):
+                log.apply(MessageType.NODE_REGISTER, {"node": mock.node()})
+
+        threads = [threading.Thread(target=writer) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert log.applied_index() == 180
+        assert wait_until(lambda: bool(snapshots_in(d))), \
+            "no automatic snapshot was taken"
+        # Sealed segments are deleted once the snapshot blob covering
+        # them is durable.
+        assert wait_until(lambda: not segments_in(d))
+        log.close()
+
+        log2 = self._mk(d, monkeypatch, native)
+        assert log2.applied_index() == 180
+        assert len(log2.fsm.state.nodes(None)) == 180
+        log2.close()
+
+    def test_snapshot_runs_off_the_apply_path(self, tmp_path,
+                                              monkeypatch, native):
+        """The serialization/persist step runs on the dedicated
+        snapshot thread — never an applier's — and appends LANDED WHILE
+        IT RAN survive the compaction (they flow into the fresh segment,
+        which is not covered by the snapshot and must not be deleted)."""
+        d = str(tmp_path / "raft")
+        persist_threads = []
+        release = threading.Event()
+        entered = threading.Event()
+
+        class SlowSnapLog(FileLog):
+            def _persist_snapshot_blob(self, snap_store, index):
+                persist_threads.append(threading.current_thread().name)
+                entered.set()
+                # Hold the persist open while the main thread appends:
+                # the log lock is NOT held here, so these applies must
+                # complete (a bounded wait proves it).
+                release.wait(10.0)
+                super()._persist_snapshot_blob(snap_store, index)
+
+        if not native:
+            monkeypatch.setenv("NOMAD_TPU_NO_NATIVE", "1")
+        log = SlowSnapLog(FSM(), d, snapshot_entries=10, snapshot_bytes=0,
+                          snapshot_interval=0.02)
+        if not native:
+            assert log._nwal is None
+        for _ in range(12):
+            log.apply(MessageType.NODE_REGISTER, {"node": mock.node()})
+        assert entered.wait(5.0), "auto snapshot did not start"
+        snap_index = None
+        # Appends DURING the in-flight persist: if the snapshot held the
+        # log lock these would block until release; give them a bounded
+        # window instead.
+        done = threading.Event()
+
+        def late_appends():
+            for _ in range(5):
+                log.apply(MessageType.NODE_REGISTER, {"node": mock.node()})
+            done.set()
+
+        t = threading.Thread(target=late_appends)
+        t.start()
+        assert done.wait(5.0), \
+            "appends blocked behind the snapshot persist"
+        release.set()
+        t.join()
+        assert wait_until(lambda: bool(snapshots_in(d)))
+        snap_index = snapshots_in(d)[-1]
+        assert log.applied_index() == 17
+        assert snap_index <= 12  # the late appends are NOT in the blob
+        log.close()
+
+        # Off-path contract: the persist ran on the snapshot thread.
+        assert persist_threads
+        assert all(name == "filelog-snapshot" for name in persist_threads)
+
+        # The late appends survive the restart: they were in the fresh
+        # segment/active WAL, not in the deleted covered segments.
+        if not native:
+            monkeypatch.setenv("NOMAD_TPU_NO_NATIVE", "1")
+        log2 = FileLog(FSM(), d)
+        assert log2.applied_index() == 17
+        assert len(log2.fsm.state.nodes(None)) == 17
+        log2.close()
+
+    def test_restore_parity_with_operator_snapshot(self, tmp_path,
+                                                   monkeypatch, native):
+        """An automatic snapshot and an operator-invoked snapshot of the
+        same entry stream restore to identical state."""
+        nodes = [mock.node() for _ in range(30)]
+
+        d_auto = str(tmp_path / "auto")
+        log_a = self._mk(d_auto, monkeypatch, native, snapshot_entries=10,
+                         snapshot_bytes=0, snapshot_interval=0.02)
+        d_op = str(tmp_path / "op")
+        log_o = self._mk(d_op, monkeypatch, native, snapshot_entries=0,
+                         snapshot_bytes=0)
+        assert log_o._snap_thread is None  # thresholds 0 ⇒ no watcher
+        for node in nodes:
+            log_a.apply(MessageType.NODE_REGISTER, {"node": node})
+            log_o.apply(MessageType.NODE_REGISTER, {"node": node})
+        assert wait_until(lambda: bool(snapshots_in(d_auto)))
+        log_o.snapshot()  # operator-invoked
+        assert snapshots_in(d_op) == [30]
+        log_a.close()
+        log_o.close()
+
+        ra = self._mk(d_auto, monkeypatch, native)
+        ro = self._mk(d_op, monkeypatch, native)
+        assert ra.applied_index() == ro.applied_index() == 30
+        ids_a = {n.id for n in ra.fsm.state.nodes(None)}
+        ids_o = {n.id for n in ro.fsm.state.nodes(None)}
+        assert ids_a == ids_o == {n.id for n in nodes}
+        ra.close()
+        ro.close()
+
+    def test_crash_between_roll_and_blob_loses_nothing(self, tmp_path,
+                                                       monkeypatch,
+                                                       native):
+        """A crash after the WAL roll but BEFORE the snapshot blob is
+        durable leaves the sealed segments on disk; recovery replays
+        them — an unfinished snapshot can never lose entries."""
+        d = str(tmp_path / "raft")
+
+        class CrashySnapLog(FileLog):
+            def _persist_snapshot_blob(self, snap_store, index):
+                raise RuntimeError("injected crash before blob persist")
+
+        if not native:
+            monkeypatch.setenv("NOMAD_TPU_NO_NATIVE", "1")
+        log = CrashySnapLog(FSM(), d, snapshot_entries=0, snapshot_bytes=0)
+        for _ in range(8):
+            log.apply(MessageType.NODE_REGISTER, {"node": mock.node()})
+        with pytest.raises(RuntimeError):
+            log.snapshot()
+        assert segments_in(d), "roll did not seal a segment"
+        assert not snapshots_in(d)
+        log.close()
+
+        log2 = self._mk(d, monkeypatch, native)
+        assert log2.applied_index() == 8
+        assert len(log2.fsm.state.nodes(None)) == 8
+        # And a later (successful) snapshot cleans the leftovers up.
+        log2.snapshot()
+        assert snapshots_in(d) == [8]
+        log2.close()
