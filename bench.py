@@ -944,6 +944,16 @@ def bench_control_plane(nodes: int = 800, submissions: int = 800):
     return out
 
 
+def _codec_s_per_eval(split: dict, _rate: float, completed: int):
+    """Leader codec seconds (rpc+raft encode+decode) per completed eval
+    — the per-entry serialization tax the struct codec exists to cut."""
+    total = 0.0
+    for sub in ("rpc", "raft"):
+        d = split.get(sub) or {}
+        total += d.get("encode_s", 0.0) + d.get("decode_s", 0.0)
+    return round(total / completed, 6) if completed else None
+
+
 def bench_follower_scale(nodes: int = 2000, submissions: int = 160):
     """config_follower: horizontal control-plane scale-out (ISSUE 10) —
     the loadgen harness offers the same seeded gang-scale burst to (a)
@@ -979,6 +989,14 @@ def bench_follower_scale(nodes: int = 2000, submissions: int = 160):
         "plan_forward_rtt_p99_ms": pf.get("rtt_p99_ms_max"),
         "lag_handbacks": pf.get("lag_handbacks_total"),
         "stragglers": cmp["stragglers"]["multi"],
+        # ISSUE 11: the leader-side serialization time-split of the
+        # multi-server leg (codec encode/decode seconds by subsystem),
+        # guarded by --check against the latest LOADGEN_r*.json.
+        "codec_split": (cmp.get("codec_split") or {}).get("multi", {}),
+        "codec_s_per_eval": _codec_s_per_eval(
+            (cmp.get("codec_split") or {}).get("multi", {}),
+            cmp["evals_per_s"]["cluster_follower_sched"],
+            cmp["runs"]["multi"]["sustained"]["completed_total"]),
     }
     log(f"  follower-scale: single {out['single_evals_per_s']} evals/s, "
         f"{sc.num_servers} servers {out['multi_evals_per_s']} evals/s "
@@ -1885,19 +1903,27 @@ def _latest_bench_baseline():
 
 
 def _loadgen_follower_baseline():
-    """Check-scale numbers recorded in LOADGEN_r03.json →
-    (multi_evals_per_s, speedup) or (None, None).  The r03 file records
-    the full `multi_server` scenario AND a `check_scale` run at the
-    bench_follower_scale shape, so the --check guard compares
-    like-for-like."""
+    """Check-scale numbers from the LATEST LOADGEN_r*.json →
+    (multi_evals_per_s, speedup, codec_s_per_eval) or Nones.  The
+    trajectory files record the full `multi_server` scenario AND a
+    `check_scale` run at the bench_follower_scale shape, so the --check
+    guard compares like-for-like; files that predate a metric simply
+    skip that guard (r04 added codec_s_per_eval — ISSUE 11)."""
+    import glob
+
     here = os.path.dirname(os.path.abspath(__file__))
-    try:
-        with open(os.path.join(here, "LOADGEN_r03.json")) as fh:
-            doc = json.load(fh)
-    except (OSError, ValueError):
-        return None, None
-    cs = doc.get("check_scale") or {}
-    return cs.get("multi_evals_per_s"), cs.get("speedup")
+    for path in sorted(glob.glob(os.path.join(here, "LOADGEN_r*.json")),
+                       reverse=True):
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        cs = doc.get("check_scale") or {}
+        if cs.get("multi_evals_per_s") is not None:
+            return (cs.get("multi_evals_per_s"), cs.get("speedup"),
+                    cs.get("codec_s_per_eval"))
+    return None, None, None
 
 
 CHECK_THRESHOLD_DEFAULT = 1.5
@@ -2091,7 +2117,8 @@ def _check_main(argv) -> int:
     # correctness bar); sustained multi-server evals/s additionally
     # guards against the check-scale run recorded in LOADGEN_r03.json
     # (the full-scale ≥1.5x evidence lives in that file's main run).
-    base_follower, base_follower_speedup = _loadgen_follower_baseline()
+    (base_follower, base_follower_speedup,
+     base_codec_per_eval) = _loadgen_follower_baseline()
     try:
         with _deadline(480, "check_follower_scale"):
             fsc = bench_follower_scale()
@@ -2106,6 +2133,22 @@ def _check_main(argv) -> int:
             "double_placements": fsc["double_placements"],
             "plan_conflicts": fsc["plan_conflicts"],
             "lag_handbacks": fsc["lag_handbacks"]}
+        # Codec time-split guard (ISSUE 11): leader rpc+raft
+        # encode+decode seconds per completed eval on the multi-server
+        # leg must not regress past threshold x the recorded baseline.
+        cur_codec = fsc.get("codec_s_per_eval")
+        out["follower_scale_codec_s_per_eval"] = {
+            "baseline": base_codec_per_eval, "current": cur_codec,
+            "split": fsc.get("codec_split"),
+            "ratio": (round(cur_codec / base_codec_per_eval, 3)
+                      if base_codec_per_eval and cur_codec is not None
+                      else None)}
+        if (base_codec_per_eval and cur_codec is not None
+                and cur_codec > base_codec_per_eval * threshold):
+            failures.append(
+                f"follower-scale codec time-split {cur_codec * 1e3:.2f}"
+                f"ms/eval exceeds {threshold}x baseline "
+                f"{base_codec_per_eval * 1e3:.2f}ms/eval")
         if fsc["double_placements"]:
             failures.append(
                 f"follower-scale run produced "

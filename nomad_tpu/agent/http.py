@@ -715,14 +715,24 @@ class HTTPServer:
         inventory; names per telemetry.html.md).  ``?format=prometheus``
         renders the newest interval as text exposition (gauges, counters,
         and sample summaries with p50/p95/p99 quantiles)."""
+        from .. import codec
+
         if query.get("format") == "prometheus":
             from ..utils.telemetry import render_prometheus
 
             sink = self.server.metrics.sink
             if not hasattr(sink, "latest"):
                 raise CodedError(400, "metrics sink has no interval data")
-            return TextResponse(render_prometheus(sink.latest())), None
-        return self.server.metrics.sink.data(), None
+            # Struct-codec histograms (codec.{rpc,raft,snapshot}.
+            # {encode,decode}_seconds) account process-globally in the
+            # codec package; merge them into this server's rendering
+            # (ISSUE 11 observability contract).
+            return TextResponse(render_prometheus(
+                codec.merge_metrics(sink.latest()))), None
+        data = self.server.metrics.sink.data()
+        if isinstance(data, list) and data:
+            codec.merge_metrics(data[-1])
+        return data, None
 
     def broker_stats_request(self, req, query):
         """Eval-broker saturation surface (/v1/broker/stats): pending by

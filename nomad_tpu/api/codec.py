@@ -104,6 +104,20 @@ def from_wire(typ: Any, data: Any) -> Any:
     return data
 
 
+def ensure(typ: Type, data: Any) -> Any:
+    """RPC bodies arrive as dataclasses on struct-codec connections and
+    as CamelCase wire dicts on msgpack connections (server/rpc.py sniffs
+    per frame).  ``ensure`` is the receiver-side adapter: pass through
+    what is already typed, reflect-decode what is not."""
+    if data is None or isinstance(data, typ):
+        return data
+    return from_wire(typ, data)
+
+
+def ensure_list(typ: Type, seq: Any) -> list:
+    return [ensure(typ, x) for x in (seq or [])]
+
+
 def decode_json(typ: Optional[Type], body: bytes) -> Any:
     import json
 

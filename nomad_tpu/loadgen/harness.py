@@ -301,8 +301,19 @@ class LoadHarness:
                         for k in ("count", "p50", "p95", "p99") if agg}
 
             fs = (b.get("FollowerSched") or {})
+            st = m.get("SampleTotals") or {}
+
+            def tot(key):
+                pair = st.get(key)
+                return round(pair[1], 4) if pair else 0.0
+
+            codec_split = {
+                f"{sub}_{op}_s":
+                    tot(f"nomad.codec.{sub}.{op}_seconds")
+                for sub in ("rpc", "raft") for op in ("encode", "decode")}
             out.append({
                 "addr": addr,
+                "codec": codec_split,
                 "forwarded_plans": fs.get("ForwardedPlans", 0),
                 "forward_errors": fs.get("ForwardErrors", 0),
                 "forwarded_inflight": fs.get("ForwardedPlansInFlight", 0),
@@ -608,7 +619,13 @@ class LoadHarness:
     # -- run ---------------------------------------------------------------
 
     def run(self) -> Dict:
+        from .. import codec
+
         sc = self.sc
+        # Codec accounting is process-global and cumulative; snapshot it
+        # here so the report's time-split covers THIS leg only (the
+        # compare_* drivers run several legs in one process).
+        self._codec_before = codec.stats()
         self.server = self._build_server()
         try:
             return self._run_inner()
@@ -698,6 +715,29 @@ class LoadHarness:
         return report
 
     # -- report ------------------------------------------------------------
+
+    def _codec_split(self) -> Dict:
+        """Leader-side codec time-split for this leg: per-subsystem
+        encode/decode seconds + frame counts, plus the codec-enabled
+        flag so an A/B reader can tell the legs apart."""
+        from .. import codec
+
+        delta = codec.stats_delta(getattr(self, "_codec_before", {}))
+        out: Dict = {"enabled": codec.enabled()}
+        for sub in ("rpc", "raft", "snapshot"):
+            d = delta.get(sub) or {}
+            if not (d.get("encodes") or d.get("decodes")):
+                continue
+            out[sub] = {
+                "encode_s": round(d.get("encode_seconds", 0.0), 4),
+                "decode_s": round(d.get("decode_seconds", 0.0), 4),
+                "encodes": int(d.get("encodes", 0)),
+                "decodes": int(d.get("decodes", 0)),
+                "fallbacks": int(d.get("fallbacks", 0)),
+                "encode_mb": round(d.get("encode_bytes", 0) / 1e6, 3),
+                "decode_mb": round(d.get("decode_bytes", 0) / 1e6, 3),
+            }
+        return out
 
     def _assemble(self, m_start: float, m_end: float, drained_t: float,
                   fanout: Dict) -> Dict:
@@ -790,6 +830,11 @@ class LoadHarness:
                 "ttl_max": round(max(hb_ttls), 4) if hb_ttls else 0,
             },
             "event_fanout": fanout,
+            # ISSUE 11: the leader-side serialization time-split —
+            # encode/decode seconds per subsystem over this leg (codec
+            # frames + msgpack fallbacks both counted).  Followers
+            # report their own split via Status.Metrics.
+            "codec": self._codec_split(),
         }
         if tracing.enabled() and slowest:
             report["slow_tail_traces"] = [
@@ -891,6 +936,18 @@ def compare_servers(scenario: Scenario,
         "plan_conflicts": {"single": conflicts(single),
                            "multi": conflicts(multi)},
         "plan_forward": multi.get("plan_forward", {}),
+        # ISSUE 11: the serialization time-split per leg (leader side;
+        # per-follower splits ride runs.multi.follower_servers[].codec).
+        "codec_split": {
+            "single": single.get("codec", {}),
+            "multi": multi.get("codec", {}),
+            "multi_follower_rpc_encode_s": round(sum(
+                (f.get("codec") or {}).get("rpc_encode_s", 0.0)
+                for f in multi.get("follower_servers", [])), 4),
+            "multi_follower_raft_decode_s": round(sum(
+                (f.get("codec") or {}).get("raft_decode_s", 0.0)
+                for f in multi.get("follower_servers", [])), 4),
+        },
         "double_placements": {"single": bad(single), "multi": bad(multi)},
         "stragglers": {
             "single": single["sustained"]["stragglers_after_drain"],

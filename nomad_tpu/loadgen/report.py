@@ -109,6 +109,14 @@ def _render_single(r: Dict, out: TextIO, indent: str = "") -> None:
     if fo:
         w(f"event fan-out: {fo['us_per_event']}us/event @ "
           f"{fo['subscribers']} filtered subscribers")
+    cd = r.get("codec") or {}
+    for sub in ("rpc", "raft", "snapshot"):
+        d = cd.get(sub)
+        if d:
+            w(f"codec[{sub}]: encode {d['encode_s']}s/"
+              f"{d['encodes']} frames, decode {d['decode_s']}s/"
+              f"{d['decodes']} frames, {d['fallbacks']} fallbacks "
+              f"({'struct-codec' if cd.get('enabled') else 'msgpack'})")
     integ = r.get("integrity") or {}
     if integ:
         w(f"integrity: {integ['jobs_checked']} jobs checked, "

@@ -19,7 +19,10 @@ Covers:
 - the node-mesh production path (ISSUE 8): sharded cold encode →
   sharded delta apply with the per-shard guard → corruption on one
   shard attributed + breaker trip → oracle carries — run on a virtual
-  8-device CPU mesh in a subprocess.
+  8-device CPU mesh in a subprocess;
+- the struct codec (ISSUE 11): seeded-corpus round-trip parity with
+  the reflection-msgpack path, encode→corrupt→decode clean rejection,
+  and native/python string-column twin agreement.
 """
 from __future__ import annotations
 
@@ -862,6 +865,115 @@ def mesh_drill(seed: int = 0, log=print, n_devices: int = 8,
     return True
 
 
+def codec_drill(seed: int = 0, log=print) -> bool:
+    """Struct-codec drill (ISSUE 11): a seeded corpus of hot-type
+    payloads must (1) round-trip bit-equal to the reflection-msgpack
+    path, (2) REJECT cleanly — CodecError, never a silent misread or a
+    crash — under truncation and header/tag corruption, and (3) agree
+    byte-for-byte between the native string-column pack and its
+    pure-Python twin."""
+    import os
+    import random
+
+    from .. import codec, mock
+    from ..api.codec import to_wire
+    from ..codec import CodecError
+    from ..codec import native as cnative
+    from ..structs import structs as s
+
+    def check(cond, msg):
+        if not cond:
+            log(f"codec drill: FAIL — {msg}")
+        return cond
+
+    rng = random.Random(seed)
+
+    def corpus_item(i):
+        job = mock.job()
+        alloc = s.Allocation(
+            id=s.generate_uuid(), job_id=job.id, job=job,
+            name=f"{job.id}.tg[{i}]", node_id=s.generate_uuid(),
+            task_resources={"t": s.Resources(cpu=100, memory_mb=128)})
+        slab = s.AllocSlab(proto=alloc, ids=s.LazyUuids(8),
+                           names=s.LazyNames(8, f"{job.id}.tg"),
+                           node_ids=[s.generate_uuid() for _ in range(8)])
+        ev = s.Evaluation(id=s.generate_uuid(), job_id=job.id,
+                          priority=rng.randrange(1, 100))
+        return {"evals": [ev], "allocs": [alloc], "slabs": [slab],
+                "job": job, "eval_id": ev.id}
+
+    corpus = [corpus_item(i) for i in range(8)]
+
+    # 1. Round-trip parity with the msgpack path on every item.
+    for payload in corpus:
+        got = codec.decode(codec.encode(payload))
+        if not check(to_wire(got["job"]) == to_wire(payload["job"])
+                     and to_wire(got["allocs"]) == to_wire(
+                         payload["allocs"])
+                     and list(got["slabs"][0].ids)
+                     == list(payload["slabs"][0].ids),
+                     "round trip diverged from the source payload"):
+            return False
+
+    # 2. encode -> corrupt -> decode must reject cleanly.
+    rejected = accepted = 0
+    for payload in corpus:
+        blob = codec.encode(payload)
+        cuts = [rng.randrange(1, len(blob)) for _ in range(16)]
+        for k in cuts:
+            try:
+                codec.decode(blob[:k])
+                return check(False, f"truncation at {k} was accepted")
+            except CodecError:
+                rejected += 1
+        # Header/tag corruption: magic, version, and a value tag.
+        for pos in (0, 1, 2):
+            bad = bytearray(blob)
+            bad[pos] ^= 0xFF
+            try:
+                codec.decode(bytes(bad))
+                accepted += 1  # content-byte flips may legally decode
+            except CodecError:
+                rejected += 1
+    if not check(rejected > 0, "no corruption was rejected"):
+        return False
+
+    # 3. Native/python twin agreement on the seeded column corpus.
+    runs_before = cnative.GUARD_RUNS
+    saved = os.environ.get("NOMAD_TPU_CODEC_GUARD_EVERY")
+    os.environ["NOMAD_TPU_CODEC_GUARD_EVERY"] = "1"
+    try:
+        for payload in corpus:
+            cols = [list(payload["slabs"][0].node_ids),
+                    [s.generate_uuid() for _ in range(64)]]
+            for col in cols:
+                encoded = [x.encode() for x in col]
+                py = cnative._py_pack_strs(encoded)
+                if not check(cnative.pack_strs(col) == py,
+                             "native pack diverged from python twin"):
+                    return False
+                got, end = cnative.unpack_strs(py, 0, len(col))
+                if not check(got == col and end == len(py),
+                             "native unpack diverged from python twin"):
+                    return False
+        if not check(cnative.GUARD_MISMATCHES == 0,
+                     "differential guard counted a mismatch"):
+            return False
+    finally:
+        if saved is None:
+            os.environ.pop("NOMAD_TPU_CODEC_GUARD_EVERY", None)
+        else:
+            os.environ["NOMAD_TPU_CODEC_GUARD_EVERY"] = saved
+    native_used = cnative._get_lib() is not None and not \
+        cnative._native_disabled
+    log("codec drill: OK — corpus round-tripped bit-equal, "
+        f"{rejected} corruptions rejected cleanly ({accepted} benign "
+        "content flips decoded), native/python twins agree "
+        f"({'native' if native_used else 'python-twin-only'}, "
+        f"{cnative.GUARD_RUNS - runs_before} guarded calls)")
+    return True
+
+
 def follower_drill(seed: int = 0, log=print) -> bool:
     """Follower-read scheduling drill (ISSUE 10): boot a 3-voter
     in-process cluster, pause the leader's LOCAL workers so only
@@ -1029,6 +1141,7 @@ def main(argv=None) -> int:
     ok = tracing_drill(seed=args.seed) and ok
     ok = residency_drill(seed=args.seed) and ok
     ok = columnar_drill(seed=args.seed) and ok
+    ok = codec_drill(seed=args.seed) and ok
     ok = wal_drill(seed=args.seed) and ok
     ok = fused_drill(seed=args.seed) and ok
     ok = follower_drill(seed=args.seed) and ok

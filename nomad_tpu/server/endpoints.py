@@ -19,10 +19,15 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
-from ..api.codec import from_wire, to_wire
+from ..api.codec import ensure, ensure_list
 from ..structs import structs as s
 from .raft import NotLeaderError
 from .rpc import NoLeaderError
+
+# Handlers hand the frame layer RAW dataclasses and accept either raw
+# dataclasses (struct-codec connections) or CamelCase wire dicts
+# (legacy msgpack connections) via ``ensure`` — server/rpc.py owns the
+# per-connection codec choice and the legacy conversion (ISSUE 11).
 
 
 def register_endpoints(server, rpc) -> None:
@@ -74,9 +79,13 @@ def register_endpoints(server, rpc) -> None:
     def status_metrics(body):
         """Telemetry sink dump over the wire (the loadgen harness reads
         follower-server forward-RTT/snapshot-lag samples through this;
-        same data /v1/metrics renders on the HTTP side)."""
+        same data /v1/metrics renders on the HTTP side).  Codec
+        histograms merge in (process-global, like the HTTP side)."""
+        from .. import codec
+
         sink = server.metrics.sink
-        return sink.latest() if hasattr(sink, "latest") else {}
+        latest = sink.latest() if hasattr(sink, "latest") else {}
+        return codec.merge_metrics(latest)
 
     def status_broker_stats(body):
         return server.broker_stats()
@@ -101,7 +110,7 @@ def register_endpoints(server, rpc) -> None:
     # -- Node (client agent surface) --------------------------------------
 
     def node_register(body):
-        node = from_wire(s.Node, body["Node"])
+        node = ensure(s.Node, body["Node"])
         index, ttl = server.node_register(node)
         return {"Index": index, "HeartbeatTTL": ttl}
 
@@ -113,10 +122,10 @@ def register_endpoints(server, rpc) -> None:
         allocs, index = server.node_get_client_allocs(
             body["NodeID"], body.get("MinQueryIndex", 0),
             body.get("MaxQueryTime", 30.0))
-        return {"Allocs": [to_wire(a) for a in allocs], "Index": index}
+        return {"Allocs": allocs, "Index": index}
 
     def node_update_alloc(body):
-        allocs = [from_wire(s.Allocation, a) for a in body["Allocs"]]
+        allocs = ensure_list(s.Allocation, body["Allocs"])
         index = server.node_update_allocs(allocs)
         return {"Index": index}
 
@@ -137,12 +146,10 @@ def register_endpoints(server, rpc) -> None:
         return {"Tasks": tokens}
 
     def node_get(body):
-        node = server.node_get(body["NodeID"])
-        return {"Node": to_wire(node) if node is not None else None}
+        return {"Node": server.node_get(body["NodeID"])}
 
     def alloc_get(body):
-        alloc = server.alloc_get(body["AllocID"])
-        return {"Alloc": to_wire(alloc) if alloc is not None else None}
+        return {"Alloc": server.alloc_get(body["AllocID"])}
 
     register("Node.Get", node_get)
     register("Alloc.Get", alloc_get)
@@ -158,7 +165,7 @@ def register_endpoints(server, rpc) -> None:
     # -- Job ---------------------------------------------------------------
 
     def job_register(body):
-        job = from_wire(s.Job, body["Job"])
+        job = ensure(s.Job, body["Job"])
         index, eval_id = server.job_register(job,
                                              region=body.get("Region", ""))
         return {"Index": index, "EvalID": eval_id}
@@ -184,14 +191,14 @@ def register_endpoints(server, rpc) -> None:
             prefix=body.get("Prefix", ""), region=body.get("Region", ""),
             min_index=int(body.get("MinQueryIndex", 0) or 0),
             max_wait=float(body.get("MaxQueryTime", 0) or 0))
-        return {"Jobs": [to_wire(j) for j in jobs], "Index": index}
+        return {"Jobs": jobs, "Index": index}
 
     def job_get(body):
         job = server.job_get(
             body["JobID"], region=body.get("Region", ""),
             min_index=int(body.get("MinQueryIndex", 0) or 0),
             max_wait=float(body.get("MaxQueryTime", 0) or 0))
-        return {"Job": to_wire(job) if job is not None else None,
+        return {"Job": job,
                 "Index": server.state.table_index("jobs")}
 
     register("Job.List", job_list)
@@ -210,8 +217,7 @@ def register_endpoints(server, rpc) -> None:
         timeout = min(float(body.get("Timeout", 0.0) or 0.0), 5.0)
         ev, token = server.eval_dequeue(
             body.get("Schedulers") or [], timeout)
-        return {"Eval": to_wire(ev) if ev is not None else None,
-                "Token": token}
+        return {"Eval": ev, "Token": token}
 
     def eval_ack(body):
         server.eval_ack(body["EvalID"], body["Token"])
@@ -222,16 +228,14 @@ def register_endpoints(server, rpc) -> None:
         return {}
 
     def eval_get(body):
-        ev = server.eval_get(body["EvalID"])
-        return {"Eval": to_wire(ev) if ev is not None else None}
+        return {"Eval": server.eval_get(body["EvalID"])}
 
     def eval_list(body):
-        return {"Evals": [to_wire(e) for e in server.eval_list()],
+        return {"Evals": server.eval_list(),
                 "Index": server.state.table_index("evals")}
 
     def eval_allocations(body):
-        allocs = server.eval_allocations(body["EvalID"])
-        return {"Allocs": [to_wire(a) for a in allocs],
+        return {"Allocs": server.eval_allocations(body["EvalID"]),
                 "Index": server.state.table_index("allocs")}
 
     def eval_dequeue_batch(body):
@@ -241,7 +245,7 @@ def register_endpoints(server, rpc) -> None:
         reply = server.eval_dequeue_batch(
             body.get("Schedulers") or [], int(body.get("Max", 1) or 1),
             timeout)
-        return {"Evals": [{"Eval": to_wire(item["eval"]),
+        return {"Evals": [{"Eval": item["eval"],
                            "Token": item["token"],
                            "Attempts": item["attempts"],
                            "PlanFence": item["fence"]}
@@ -249,11 +253,11 @@ def register_endpoints(server, rpc) -> None:
                 "AppliedIndex": reply["applied_index"]}
 
     def eval_update(body):
-        evals = [from_wire(s.Evaluation, e) for e in body["Evals"]]
+        evals = ensure_list(s.Evaluation, body["Evals"])
         return {"Index": server.eval_update(evals)}
 
     def eval_reblock(body):
-        ev = from_wire(s.Evaluation, body["Eval"])
+        ev = ensure(s.Evaluation, body["Eval"])
         return {"Index": server.eval_reblock(ev, body["Token"])}
 
     def eval_pause_nack(body):
@@ -279,7 +283,7 @@ def register_endpoints(server, rpc) -> None:
     # -- Plan (plan_endpoint.go) -------------------------------------------
 
     def plan_submit(body):
-        plan = from_wire(s.Plan, body["Plan"])
+        plan = ensure(s.Plan, body["Plan"])
         # Re-denormalize wire-stripped placements (follower_sched
         # _strip_plan_for_wire ships the job once on the plan).
         if plan.job is not None:
@@ -325,7 +329,7 @@ def register_endpoints(server, rpc) -> None:
                 == sum(len(sl) for sl in plan.alloc_slabs)):
             return {"Result": {"Full": True,
                                "AllocIndex": result.alloc_index}}
-        return {"Result": to_wire(result)}
+        return {"Result": result}
 
     register("Plan.Submit", plan_submit)
 
@@ -349,7 +353,7 @@ def register_endpoints(server, rpc) -> None:
     # -- Alloc -------------------------------------------------------------
 
     def alloc_list(body):
-        return {"Allocs": [to_wire(a) for a in server.alloc_list()],
+        return {"Allocs": server.alloc_list(),
                 "Index": server.state.table_index("allocs")}
 
     register("Alloc.List", alloc_list)

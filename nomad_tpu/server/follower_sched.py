@@ -217,15 +217,18 @@ class LeaderChannel:
         ``{"Full": true}`` marker (the result would only echo the
         plan's own allocations); the PlanResult is rebuilt locally from
         the original plan."""
-        from ..api.codec import from_wire, to_wire
+        from ..api.codec import ensure
 
         t0 = time.monotonic()
         with self._l:
             self._inflight_plans += 1
         try:
+            # RAW dataclass on the wire: struct-codec connections encode
+            # it with the generated flat layout (server/rpc.py); legacy
+            # msgpack connections get the CamelCase tree at the frame.
             reply = self.call(
                 "Plan.Submit",
-                {"Plan": to_wire(self._strip_plan_for_wire(plan))},
+                {"Plan": self._strip_plan_for_wire(plan)},
                 timeout=120.0)
         except Exception:
             with self._l:
@@ -238,9 +241,9 @@ class LeaderChannel:
         with self._l:
             self.forwarded_plans += 1
         data = reply.get("Result") if isinstance(reply, dict) else None
-        if not data:
+        if data is None:
             return None
-        if data.get("Full"):
+        if isinstance(data, dict) and data.get("Full"):
             return s.PlanResult(
                 node_update=plan.node_update,
                 node_allocation=plan.node_allocation,
@@ -248,7 +251,7 @@ class LeaderChannel:
                 node_preemptions=plan.node_preemptions,
                 refresh_index=0,
                 alloc_index=int(data.get("AllocIndex", 0) or 0))
-        return from_wire(s.PlanResult, data)
+        return ensure(s.PlanResult, data)
 
     def inflight(self) -> int:
         with self._l:
@@ -291,7 +294,7 @@ class RemoteBroker:
     def dequeue_batch(self, schedulers: List[str], max_batch: int,
                       timeout: Optional[float] = None,
                       ) -> List[Tuple[s.Evaluation, str]]:
-        from ..api.codec import from_wire
+        from ..api.codec import ensure
 
         wait = float(timeout or 0.0)
         try:
@@ -305,7 +308,7 @@ class RemoteBroker:
         out: List[Tuple[s.Evaluation, str]] = []
         self.last_leader_applied = int(reply.get("AppliedIndex", 0) or 0)
         for item in reply.get("Evals") or []:
-            ev = from_wire(s.Evaluation, item["Eval"])
+            ev = ensure(s.Evaluation, item["Eval"])
             fence = int(item.get("PlanFence", 0) or 0)
             if fence > self._fences.get(ev.job_id, 0):
                 self._fences[ev.job_id] = fence
@@ -460,13 +463,7 @@ class FollowerWorker(Worker):
     # -- leader-write hooks (the Worker surface that must cross the wire) --
 
     def apply_eval_updates(self, evals: List[s.Evaluation]) -> None:
-        from ..api.codec import to_wire
-
-        self.channel.call("Eval.Update",
-                          {"Evals": [to_wire(ev) for ev in evals]})
+        self.channel.call("Eval.Update", {"Evals": list(evals)})
 
     def reblock_eval_update(self, ev: s.Evaluation, token: str) -> None:
-        from ..api.codec import to_wire
-
-        self.channel.call("Eval.Reblock",
-                          {"Eval": to_wire(ev), "Token": token})
+        self.channel.call("Eval.Reblock", {"Eval": ev, "Token": token})

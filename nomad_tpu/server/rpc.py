@@ -15,6 +15,7 @@ channel carries the same framing but is dispatched to the consensus layer
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import socket
 import socketserver
@@ -25,13 +26,22 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import msgpack
 
-from .. import fault
+from .. import codec, fault
 from ..utils import tracing
 from ..utils.telemetry import NULL_TELEMETRY
 
 # Protocol bytes (rpc.go:23-30)
 RPC_NOMAD = 0x01
 RPC_RAFT = 0x02
+# Struct-codec channel (ISSUE 11): same [seq, method, body] envelopes,
+# but frames may carry the generated flat binary layout (codec.MAGIC
+# per-frame tag) instead of reflection msgpack.  Dialers handshake —
+# the server acks with its codec version + schema fingerprint — and
+# negotiate DOWN per connection: an old peer closes on the unknown
+# protocol byte and the dialer redials the legacy channel; a peer on a
+# different schema keeps the connection but sends msgpack frames (every
+# receiver sniffs per frame).
+RPC_NOMAD_CODEC = 0x05
 
 _LEN = struct.Struct("<I")
 
@@ -59,8 +69,53 @@ class NoLeaderError(RPCError):
 # ---------------------------------------------------------------------------
 
 
-def _send_frame(sock: socket.socket, obj: Any) -> None:
-    data = msgpack.packb(obj, use_bin_type=True)
+def _wire_default(v: Any) -> Any:
+    """msgpack ``default`` hook: hot endpoints hand the frame layer RAW
+    dataclasses; on a legacy (msgpack) connection they serialize to the
+    exact CamelCase wire trees old peers already speak."""
+    from ..api.codec import to_wire
+
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        return to_wire(v)
+    if getattr(v, "__lazy_strs__", False):
+        return list(v)
+    raise TypeError(f"unserializable rpc value {type(v).__name__}")
+
+
+def _pack_frame(obj: Any, binary: bool) -> bytes:
+    """One frame payload: the generated struct codec when the
+    connection negotiated it (falling back per frame on schema drift),
+    reflection msgpack otherwise.  Both sides of every connection sniff
+    the per-frame tag, so mixed frames on one stream are fine."""
+    if binary and codec.enabled():
+        try:
+            return codec.encode(obj, "rpc")
+        except codec.CodecError:
+            pass  # fallback counted by codec.encode
+    t0 = time.monotonic()
+    data = msgpack.packb(obj, use_bin_type=True, default=_wire_default)
+    codec.note_msgpack("rpc", "encode", t0, len(data))
+    return data
+
+
+def _unpack_frame(data: bytes) -> Tuple[Any, bool]:
+    """Decode one frame payload, sniffing the per-frame codec tag.
+    Returns (obj, was_binary).  A malformed codec frame surfaces as
+    TransportError like any other desynchronized stream."""
+    if codec.is_frame(data):
+        try:
+            return codec.decode(data, "rpc"), True
+        except codec.CodecError as e:
+            raise TransportError(f"bad codec frame: {e}") from e
+    t0 = time.monotonic()
+    obj = msgpack.unpackb(data, raw=False)
+    codec.note_msgpack("rpc", "decode", t0, len(data))
+    return obj, False
+
+
+def _send_frame(sock: socket.socket, obj: Any,
+                binary: bool = False) -> None:
+    data = _pack_frame(obj, binary)
     act = fault.faultpoint("rpc.send")
     if act is not None:
         if act.kind == "drop":
@@ -107,13 +162,17 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return buf
 
 
-def _recv_frame(sock: socket.socket) -> Any:
+def _recv_frame_tagged(sock: socket.socket) -> Tuple[Any, bool]:
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
     if n > 64 << 20:
         # A ludicrous length prefix means the stream is desynchronized
         # (or hostile): transport-level, the connection must be discarded.
         raise TransportError(f"frame too large: {n}")
-    return msgpack.unpackb(_recv_exact(sock, n), raw=False)
+    return _unpack_frame(_recv_exact(sock, n))
+
+
+def _recv_frame(sock: socket.socket) -> Any:
+    return _recv_frame_tagged(sock)[0]
 
 
 # ---------------------------------------------------------------------------
@@ -178,7 +237,24 @@ class RPCServer:
                         outer._serve_nomad(sock)
                     elif prefix == RPC_RAFT:
                         outer._serve_raft(sock)
+                    elif prefix == RPC_NOMAD_CODEC and codec.enabled():
+                        # Handshake ack: magic + version + schema
+                        # fingerprint.  The dialer compares fingerprints
+                        # and falls back to msgpack FRAMES on mismatch
+                        # (the channel still serves: every frame is
+                        # sniffed).
+                        try:
+                            sock.sendall(bytes((codec.MAGIC,
+                                                codec.VERSION))
+                                         + codec.FINGERPRINT)
+                        except OSError:
+                            return
+                        outer._serve_nomad(sock)
                     else:
+                        # Unknown byte — including the codec channel
+                        # under NOMAD_TPU_CODEC=0 (an old msgpack-only
+                        # build behaves identically): close, and the
+                        # dialer negotiates down to the legacy channel.
                         outer.logger.warning(
                             "rpc: unrecognized protocol byte %#x", prefix)
                 finally:
@@ -230,7 +306,7 @@ class RPCServer:
         session over a pooled yamux stream)."""
         while True:
             try:
-                seq, method, body = _recv_frame(sock)
+                (seq, method, body), req_binary = _recv_frame_tagged(sock)
             except (TransportError, ConnectionError, OSError, ValueError):
                 return
             self.metrics.incr_counter("rpc.request")
@@ -256,7 +332,9 @@ class RPCServer:
                     reply = [seq, f"{type(e).__name__}: {e}", None]
                 self.metrics.measure_since(f"rpc.request.{method}", t0)
             try:
-                _send_frame(sock, reply)
+                # Reply in the codec the request arrived in: the peer
+                # chose it at handshake (or per frame on schema drift).
+                _send_frame(sock, reply, binary=req_binary)
             except (ConnectionError, OSError):
                 return
 
@@ -285,6 +363,12 @@ class RPCServer:
 # ---------------------------------------------------------------------------
 
 
+class _HandshakeRefused(Exception):
+    """The peer closed on the codec protocol byte: an old msgpack-only
+    build (or NOMAD_TPU_CODEC=0).  The pool negotiates the ADDRESS down
+    to the legacy channel and redials."""
+
+
 class _Conn:
     def __init__(self, addr: str, channel: int, timeout: float,
                  tls_context=None):
@@ -295,6 +379,43 @@ class _Conn:
             self.sock = tls_context.wrap_socket(self.sock,
                                                 server_hostname=host)
         self.sock.sendall(bytes([channel]))
+        self.binary = False
+        if channel == RPC_NOMAD_CODEC:
+            # Codec handshake: ack = magic + version + 8-byte schema
+            # fingerprint.  A clean EOF here is the old-peer signature
+            # (it reads the unknown protocol byte and orderly-closes) →
+            # _HandshakeRefused, and the pool pins the ADDRESS to the
+            # legacy channel.  Timeouts and resets are NOT refusals — a
+            # restarting or GIL-stalled codec peer must not get
+            # demoted to msgpack for the process lifetime — they
+            # surface as dial failures and the next dial re-probes.  A
+            # fingerprint/version mismatch keeps the connection but
+            # pins it to msgpack frames: flat layouts are only spoken
+            # between peers PROVEN to share the schema.
+            try:
+                self.sock.settimeout(timeout)
+                ack = _recv_exact(self.sock, 2 + len(codec.FINGERPRINT))
+            except TransportError as e:
+                try:
+                    self.sock.close()
+                except OSError:
+                    pass
+                if "mid-frame" in str(e):
+                    # Partial ack then EOF: the peer was mid-crash, not
+                    # refusing the protocol — don't mark legacy.
+                    raise ConnectionError(
+                        f"codec handshake torn: {e}") from e
+                raise _HandshakeRefused(str(e)) from e
+            except (ConnectionError, OSError) as e:
+                # Reset / timeout: transient transport failure.
+                try:
+                    self.sock.close()
+                except OSError:
+                    pass
+                raise
+            self.binary = (ack[0] == codec.MAGIC
+                           and ack[1] == codec.VERSION
+                           and ack[2:] == codec.FINGERPRINT)
         self.seq = 0
         self.lock = threading.Lock()
 
@@ -303,7 +424,8 @@ class _Conn:
             self.seq += 1
             seq = self.seq
             self.sock.settimeout(timeout)
-            _send_frame(self.sock, [seq, method, body])
+            _send_frame(self.sock, [seq, method, body],
+                        binary=self.binary)
             rseq, err, reply = _recv_frame(self.sock)
         if rseq != seq:
             # Desynchronized stream — the connection is unusable.
@@ -344,6 +466,30 @@ class ConnPool:
         self.tls_context = tls_context
         self._idle: Dict[Tuple[str, int], List[_Conn]] = {}
         self._lock = threading.Lock()
+        # Addresses that refused the codec handshake (old builds /
+        # kill-switched peers): remembered so every later dial goes
+        # straight to the legacy channel — per-connection negotiation,
+        # paid once per address.
+        self._legacy_addrs: set = set()
+
+    def _dial(self, addr: str, channel: int, timeout: float) -> _Conn:
+        if (channel == RPC_NOMAD and codec.enabled()
+                and addr not in self._legacy_addrs):
+            try:
+                return _Conn(addr, RPC_NOMAD_CODEC, timeout,
+                             tls_context=self.tls_context)
+            except _HandshakeRefused as e:
+                # Orderly refusal = old build / kill-switched peer.
+                # Visible: operators should be able to tell a
+                # negotiated-down fleet from a codec one.
+                logging.getLogger("nomad_tpu.rpc").info(
+                    "rpc: %s refused the codec channel (%s); pinning "
+                    "legacy msgpack for this address", addr, e)
+                codec.TELEMETRY.incr_counter("codec.negotiate_down")
+                with self._lock:
+                    self._legacy_addrs.add(addr)
+        return _Conn(addr, channel, timeout,
+                     tls_context=self.tls_context)
 
     def call(self, addr: str, method: str, body: Any,
              channel: int = RPC_NOMAD, timeout: Optional[float] = None) -> Any:
@@ -354,8 +500,7 @@ class ConnPool:
             conn = bucket.pop() if bucket else None
         if conn is None:
             try:
-                conn = _Conn(addr, channel, timeout,
-                             tls_context=self.tls_context)
+                conn = self._dial(addr, channel, timeout)
             except OSError as e:  # includes ssl.SSLError
                 raise DialError(f"rpc to {addr} failed: {e}") from e
         try:
@@ -419,10 +564,9 @@ class RemoteServerRPC:
 
     def __init__(self, servers: List[str], pool: Optional[ConnPool] = None,
                  max_rounds: Optional[int] = None, sleep=time.sleep):
-        from ..api.codec import from_wire, to_wire
+        from ..api.codec import ensure
         from ..utils.backoff import Backoff
-        self._to_wire = to_wire
-        self._from_wire = from_wire
+        self._ensure = ensure
         self.servers = list(servers)
         self.pool = pool or ConnPool()
         self.max_rounds = max_rounds or self.MAX_ROUNDS
@@ -474,7 +618,10 @@ class RemoteServerRPC:
             f"no servers reachable after {self.max_rounds} rounds: {last}")
 
     def node_register(self, node):
-        reply = self._call("Node.Register", {"Node": self._to_wire(node)})
+        # Bodies carry RAW dataclasses: the frame layer encodes them
+        # with the struct codec on negotiated connections and converts
+        # to the CamelCase wire trees for legacy msgpack peers.
+        reply = self._call("Node.Register", {"Node": node})
         return reply["Index"], reply["HeartbeatTTL"]
 
     def node_update_status(self, node_id: str, status: str):
@@ -488,27 +635,25 @@ class RemoteServerRPC:
         reply = self._call("Node.GetClientAllocs",
                            {"NodeID": node_id, "MinQueryIndex": min_index,
                             "MaxQueryTime": max_wait})
-        allocs = [self._from_wire(s.Allocation, a)
+        allocs = [self._ensure(s.Allocation, a)
                   for a in reply["Allocs"] or []]
         return allocs, reply["Index"]
 
     def node_update_allocs(self, allocs):
-        reply = self._call(
-            "Node.UpdateAlloc",
-            {"Allocs": [self._to_wire(a) for a in allocs]})
+        reply = self._call("Node.UpdateAlloc", {"Allocs": list(allocs)})
         return reply["Index"]
 
     def node_get(self, node_id: str):
         from ..structs import structs as s
         reply = self._call("Node.Get", {"NodeID": node_id})
         data = reply.get("Node")
-        return self._from_wire(s.Node, data) if data else None
+        return self._ensure(s.Node, data) if data else None
 
     def alloc_get(self, alloc_id: str):
         from ..structs import structs as s
         reply = self._call("Alloc.Get", {"AllocID": alloc_id})
         data = reply.get("Alloc")
-        return self._from_wire(s.Allocation, data) if data else None
+        return self._ensure(s.Allocation, data) if data else None
 
     def derive_vault_token(self, alloc_id: str, task_names):
         reply = self._call("Node.DeriveVaultToken",

@@ -1012,9 +1012,8 @@ class Server:
         target = region or job.region
         if target and target != self.config.region and (
                 region or target in self.regions()):
-            from ..api.codec import to_wire
             reply = self._forward_region(target, "Job.Register",
-                                         {"Job": to_wire(job)})
+                                         {"Job": job})
             return reply["Index"], reply["EvalID"]
         job = job.copy()
         job.canonicalize()
@@ -1034,8 +1033,7 @@ class Server:
         try:
             _, index = self.raft.apply(MessageType.JOB_REGISTER, {"job": job})
         except NotLeaderError:
-            from ..api.codec import to_wire
-            reply = self._forward("Job.Register", {"Job": to_wire(job)})
+            reply = self._forward("Job.Register", {"Job": job})
             return reply["Index"], reply["EvalID"]
 
         eval_id = ""
@@ -1094,12 +1092,12 @@ class Server:
         semantics run at the OWNING region (min_index/max_wait travel
         with the forward, rpc.go:340 blockingRPC).  Returns (jobs, index)."""
         if region and region != self.config.region:
-            from ..api.codec import from_wire
+            from ..api.codec import ensure
             reply = self._forward_region(
                 region, "Job.List",
                 {"Prefix": prefix, "MinQueryIndex": min_index,
                  "MaxQueryTime": max_wait})
-            return ([from_wire(s.Job, j) for j in reply["Jobs"] or []],
+            return ([ensure(s.Job, j) for j in reply["Jobs"] or []],
                     int(reply.get("Index", 0)))
         self._block_on_table("jobs", min_index, max_wait)
         jobs = (self.state.jobs_by_id_prefix(None, prefix) if prefix
@@ -1127,13 +1125,13 @@ class Server:
                 min_index: int = 0,
                 max_wait: float = 0.0) -> Optional[s.Job]:
         if region and region != self.config.region:
-            from ..api.codec import from_wire
+            from ..api.codec import ensure
             reply = self._forward_region(
                 region, "Job.Get",
                 {"JobID": job_id, "MinQueryIndex": min_index,
                  "MaxQueryTime": max_wait})
             data = reply.get("Job")
-            return from_wire(s.Job, data) if data else None
+            return ensure(s.Job, data) if data else None
         self._block_on_table("jobs", min_index, max_wait)
         return self.state.job_by_id(None, job_id)
 
@@ -1379,8 +1377,7 @@ class Server:
             _, index = self.raft.apply(MessageType.NODE_REGISTER,
                                        {"node": node})
         except NotLeaderError:
-            from ..api.codec import to_wire
-            reply = self._forward("Node.Register", {"Node": to_wire(node)})
+            reply = self._forward("Node.Register", {"Node": node})
             return reply["Index"], reply["HeartbeatTTL"]
         ttl = self.heartbeat.reset_heartbeat_timer(node.id)
         # Transitions create node evals (node_endpoint.go:165).
@@ -1582,10 +1579,8 @@ class Server:
             _, index = self.raft.apply(MessageType.ALLOC_CLIENT_UPDATE,
                                        {"allocs": allocs})
         except NotLeaderError:
-            from ..api.codec import to_wire
             return self._forward(
-                "Node.UpdateAlloc",
-                {"Allocs": [to_wire(a) for a in allocs]})["Index"]
+                "Node.UpdateAlloc", {"Allocs": list(allocs)})["Index"]
         return index
 
     # -- Eval --------------------------------------------------------------

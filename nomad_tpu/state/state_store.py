@@ -1916,12 +1916,12 @@ class StateStore:
                 "vault_accessors": self.vault_accessors_table,
                 "deployments": self.deployments_table,
                 "indexes": self._indexes,
-            })
+            }, subsystem="snapshot")
             allocs_blob = encode_payload({
                 "rows": allocs_out,
                 "jobs": alloc_jobs,
                 "refs": alloc_job_refs,
-            })
+            }, subsystem="snapshot")
 
             # Numeric columns ride along when the mirror is warm so the
             # restored store encodes without a cold column build.
@@ -1991,7 +1991,7 @@ class StateStore:
             # data types from the structs whitelist, not code.
             from ..server.log_codec import encode_payload
 
-            return encode_payload(payload)
+            return encode_payload(payload, subsystem="snapshot")
 
     @classmethod
     def restore(cls, blob: bytes) -> "StateStore":
@@ -2003,7 +2003,7 @@ class StateStore:
             return cls._restore_columnar(blob)
         from ..server.log_codec import decode_payload
 
-        payload = decode_payload(blob)
+        payload = decode_payload(blob, subsystem="snapshot")
         store = cls()
         store.nodes_table = payload["nodes"]
         store.jobs_table = payload["jobs"]
@@ -2051,7 +2051,7 @@ class StateStore:
 
         doc = msgpack.unpackb(blob[len(cls.SNAP2_MAGIC):], raw=False)
         store = cls()
-        t = decode_payload(doc["tables"])
+        t = decode_payload(doc["tables"], subsystem="snapshot")
         store.jobs_table = t["jobs"]
         store.job_versions = t["job_versions"]
         store.job_summary_table = t["job_summary"]
@@ -2115,7 +2115,7 @@ class StateStore:
             nodes_table[ids[i]] = node
 
         # -- standalone alloc rows (eager: the small set) ---------------
-        a = decode_payload(doc["allocs"])
+        a = decode_payload(doc["allocs"], subsystem="snapshot")
         alloc_jobs = a["jobs"]
         store.allocs_table = a["rows"]
         for aid, ref in a["refs"].items():

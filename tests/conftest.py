@@ -22,6 +22,11 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # object walk; a single mismatch trips the breaker and fails the
 # asserting tests).  Respect an explicit override from the environment.
 os.environ.setdefault("NOMAD_TPU_COLUMNAR_GUARD_EVERY", "1")
+# Struct-codec native/python twin differential guard at EVERY call
+# (ISSUE 11): the whole suite bit-compares the C++ string-column pack
+# against the pure-Python twin; one mismatch disables native and fails
+# the asserting tests.
+os.environ.setdefault("NOMAD_TPU_CODEC_GUARD_EVERY", "1")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

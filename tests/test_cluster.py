@@ -460,10 +460,17 @@ class TestWireEndpointSurface:
             reply = pool.call(addr, "Job.Register", {"Job": to_wire(job)})
             assert reply["EvalID"]
 
-            # A remote worker dequeues the eval over the wire…
+            # A remote worker dequeues the eval over the wire…  Since
+            # ISSUE 11 a struct-codec connection delivers TYPED
+            # Evaluations; a legacy msgpack connection still gets the
+            # CamelCase tree — ensure() is the receiver contract.
+            from nomad_tpu.api.codec import ensure
+            from nomad_tpu.structs import structs as s
+
             dq = pool.call(addr, "Eval.Dequeue",
                            {"Schedulers": [job.type], "Timeout": 5.0})
-            assert dq["Eval"] and dq["Eval"]["ID"] == reply["EvalID"]
+            assert dq["Eval"] is not None
+            assert ensure(s.Evaluation, dq["Eval"]).id == reply["EvalID"]
             token = dq["Token"]
             # …acks it…
             pool.call(addr, "Eval.Ack",
@@ -472,7 +479,7 @@ class TestWireEndpointSurface:
                             {"EvalID": reply["EvalID"]})
             assert got["Eval"] is not None
             listed = pool.call(addr, "Eval.List", {})
-            assert any(e["ID"] == reply["EvalID"]
+            assert any(ensure(s.Evaluation, e).id == reply["EvalID"]
                        for e in listed["Evals"])
 
             regions = pool.call(addr, "Region.List", {})

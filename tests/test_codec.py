@@ -1,0 +1,693 @@
+"""Struct codec (ISSUE 11): randomized round-trip parity against the
+reflection-msgpack path, frame rejection semantics, per-connection
+codec negotiation (old peers negotiate down), the NOMAD_TPU_CODEC=0
+kill switch in both directions, and the native/python twin guard.
+"""
+from __future__ import annotations
+
+import os
+import random
+import socket
+import subprocess
+import sys
+import time
+
+import msgpack
+import pytest
+
+from nomad_tpu import codec, mock
+from nomad_tpu.api.codec import ensure, from_wire, to_wire
+from nomad_tpu.codec import CodecError
+from nomad_tpu.codec import native as codec_native
+from nomad_tpu.server.log_codec import decode_payload, encode_payload
+from nomad_tpu.server.rpc import (
+    RPC_NOMAD,
+    ConnPool,
+    RPCServer,
+    TransportError,
+    _Conn,
+    _recv_frame,
+)
+from nomad_tpu.structs import structs as s
+
+pytestmark = pytest.mark.codec
+
+
+# ---------------------------------------------------------------------------
+# random instance builders (None/empty-collection edges included)
+# ---------------------------------------------------------------------------
+
+
+def _rstr(rng, allow_empty=True):
+    choices = ["", "x", "web-frontend", "dc-1", "uniçode-ü",
+               "a" * 200, s.generate_uuid()]
+    v = rng.choice(choices if allow_empty else choices[1:])
+    return v
+
+
+def _rint(rng):
+    return rng.choice([0, 1, -1, 127, 128, -12345, 2**40, -(2**40)])
+
+
+def _rfloat(rng):
+    return rng.choice([0.0, 1.5, -2.25, 1e-9, 3600.0, 1234567.875])
+
+
+def rand_resources(rng, nets=True):
+    r = s.Resources(cpu=_rint(rng), memory_mb=abs(_rint(rng)),
+                    disk_mb=abs(_rint(rng)), iops=_rint(rng))
+    if nets and rng.random() < 0.5:
+        r.networks = [s.NetworkResource(
+            device=_rstr(rng), cidr="10.0.0.0/8", ip="10.0.0.1",
+            mbits=_rint(rng),
+            reserved_ports=[s.Port(_rstr(rng), rng.randrange(1 << 16))
+                            for _ in range(rng.randrange(3))],
+            dynamic_ports=[s.Port("http", 0)] * rng.randrange(2))]
+    return r
+
+
+def rand_node(rng):
+    return s.Node(
+        id=s.generate_uuid(), datacenter=_rstr(rng), name=_rstr(rng),
+        http_addr="127.0.0.1:4646",
+        attributes={_rstr(rng, False): _rstr(rng)
+                    for _ in range(rng.randrange(4))},
+        resources=rand_resources(rng),
+        reserved=rand_resources(rng) if rng.random() < 0.5 else None,
+        links={}, meta={"rack": "r1"} if rng.random() < 0.5 else {},
+        node_class=_rstr(rng), drain=rng.random() < 0.2,
+        status=rng.choice([s.NODE_STATUS_INIT, s.NODE_STATUS_READY]),
+        status_updated_at=_rfloat(rng),
+        create_index=abs(_rint(rng)), modify_index=abs(_rint(rng)))
+
+
+def rand_job(rng):
+    job = mock.job()
+    job.priority = rng.randrange(1, 100)
+    job.payload = rng.choice([b"", b"\x00\xff binary \xc1"])
+    job.meta = {} if rng.random() < 0.5 else {"k": _rstr(rng)}
+    job.periodic = (None if rng.random() < 0.7 else
+                    s.PeriodicConfig(enabled=True, spec="*/5 * * * *"))
+    if rng.random() < 0.3:
+        job.task_groups = []
+    for tg in job.task_groups:
+        tg.constraints = ([] if rng.random() < 0.5 else
+                          [s.Constraint("${attr.kernel.name}", "linux",
+                                        "=")])
+        for t in tg.tasks:
+            t.config = rng.choice([
+                {}, {"command": "/bin/date", "args": ["-u"]},
+                {"nested": {"deep": [1, 2.5, None, True, "s"]}}])
+            t.env = {} if rng.random() < 0.5 else {"PORT": "80"}
+    return job
+
+
+def rand_alloc(rng, with_job=True):
+    a = s.Allocation(
+        id=s.generate_uuid(), eval_id=s.generate_uuid(),
+        name=_rstr(rng), node_id=s.generate_uuid(),
+        job_id=_rstr(rng, False),
+        job=rand_job(rng) if with_job and rng.random() < 0.5 else None,
+        task_group="tg",
+        resources=rand_resources(rng) if rng.random() < 0.5 else None,
+        shared_resources=(rand_resources(rng, nets=False)
+                          if rng.random() < 0.3 else None),
+        task_resources={_rstr(rng, False): rand_resources(rng)
+                        for _ in range(rng.randrange(3))},
+        metrics=(None if rng.random() < 0.5 else s.AllocMetric(
+            nodes_evaluated=_rint(rng),
+            scores={f"{s.generate_uuid()}.binpack": _rfloat(rng)},
+            class_filtered={}, dimension_exhausted={"cpu": 1})),
+        desired_status=s.ALLOC_DESIRED_STATUS_RUN,
+        client_status=rng.choice([s.ALLOC_CLIENT_STATUS_PENDING,
+                                  s.ALLOC_CLIENT_STATUS_RUNNING]),
+        task_states={"t": s.TaskState(events=[
+            s.TaskEvent(type=s.TASK_STARTED, time=_rfloat(rng))])}
+        if rng.random() < 0.4 else {},
+        previous_allocation=("" if rng.random() < 0.7
+                             else s.generate_uuid()),
+        create_index=abs(_rint(rng)), modify_index=abs(_rint(rng)),
+        create_time=_rfloat(rng))
+    return a
+
+
+def rand_eval(rng):
+    return s.Evaluation(
+        id=s.generate_uuid(), priority=rng.randrange(1, 100),
+        type=s.JOB_TYPE_SERVICE, triggered_by=s.EVAL_TRIGGER_JOB_REGISTER,
+        job_id=_rstr(rng, False), job_modify_index=abs(_rint(rng)),
+        node_id="" if rng.random() < 0.5 else s.generate_uuid(),
+        status=s.EVAL_STATUS_PENDING, wait=_rfloat(rng),
+        failed_tg_allocs={} if rng.random() < 0.6 else {
+            "tg": s.AllocMetric(nodes_exhausted=3,
+                                constraint_filtered={"c": 1})},
+        class_eligibility={} if rng.random() < 0.5 else
+        {"class-a": True, "class-b": False},
+        escaped_computed_class=rng.random() < 0.5,
+        queued_allocations={} if rng.random() < 0.5 else {"tg": 4},
+        snapshot_index=abs(_rint(rng)))
+
+
+def rand_slab(rng, lazy=True):
+    n = rng.randrange(1, 12)
+    proto = rand_alloc(rng, with_job=False)
+    proto.id = proto.name = proto.node_id = ""
+    if lazy and rng.random() < 0.5:
+        ids, names = s.LazyUuids(n), s.LazyNames(n, "job.tg")
+    else:
+        ids = [s.generate_uuid() for _ in range(n)]
+        names = [f"job.tg[{i}]" for i in range(n)]
+    return s.AllocSlab(
+        proto=proto, ids=ids, names=names,
+        node_ids=[s.generate_uuid() for _ in range(n)],
+        prev_ids=[] if rng.random() < 0.5 else [""] * n,
+        create_index=abs(_rint(rng)), modify_index=abs(_rint(rng)))
+
+
+def rand_plan(rng):
+    p = s.Plan(
+        eval_id=s.generate_uuid(), eval_token=s.generate_uuid(),
+        snapshot_index=abs(_rint(rng)), priority=rng.randrange(100),
+        all_at_once=rng.random() < 0.5, job=rand_job(rng))
+    for _ in range(rng.randrange(3)):
+        p.append_alloc(rand_alloc(rng, with_job=False))
+    if rng.random() < 0.4:
+        p.alloc_slabs.append(rand_slab(rng))
+    if rng.random() < 0.3:
+        victim = rand_alloc(rng, with_job=False)
+        p.append_preempted_alloc(victim)
+    return p
+
+
+def rand_plan_result(rng):
+    return s.PlanResult(
+        node_update={}, node_allocation={
+            s.generate_uuid(): [rand_alloc(rng, with_job=False)]},
+        alloc_slabs=[rand_slab(rng)] if rng.random() < 0.5 else [],
+        node_preemptions={}, refresh_index=abs(_rint(rng)),
+        alloc_index=abs(_rint(rng)))
+
+
+BUILDERS = [rand_node, rand_job, rand_alloc, rand_eval, rand_slab,
+            rand_plan, rand_plan_result]
+
+
+def _materialize(x):
+    """to_wire comparison basis: lazy columns and dataclass trees both
+    normalize to their wire-dict form."""
+    return to_wire(x)
+
+
+def msgpack_path(obj):
+    """The reflection-msgpack round trip the codec must be bit-equal
+    to: to_wire -> msgpack -> from_wire."""
+    wire = msgpack.unpackb(
+        msgpack.packb(to_wire(obj), use_bin_type=True), raw=False)
+    return from_wire(type(obj), wire)
+
+
+# ---------------------------------------------------------------------------
+# round-trip parity
+# ---------------------------------------------------------------------------
+
+
+class TestRoundTrip:
+    def test_every_hot_type_matches_msgpack_path(self):
+        rng = random.Random(11)
+        for builder in BUILDERS:
+            for _ in range(10):
+                obj = builder(rng)
+                got = codec.decode(codec.encode(obj))
+                assert type(got) is type(obj)
+                assert _materialize(got) == _materialize(msgpack_path(obj)), \
+                    f"{builder.__name__} diverged from the msgpack path"
+
+    def test_none_and_empty_collection_edges(self):
+        ev = s.Evaluation()  # all defaults: empty dicts, zero ints
+        assert _materialize(codec.decode(codec.encode(ev))) \
+            == _materialize(ev)
+        a = s.Allocation()  # every Optional None
+        assert _materialize(codec.decode(codec.encode(a))) \
+            == _materialize(a)
+        p = s.Plan()  # job=None, empty maps
+        got = codec.decode(codec.encode(p))
+        assert got.job is None and got.node_allocation == {}
+        slab = s.AllocSlab()  # proto=None, empty columns
+        got = codec.decode(codec.encode(slab))
+        assert got.proto is None and list(got.ids) == []
+
+    def test_lazy_slab_columns_survive_compact(self):
+        slab = s.AllocSlab(proto=s.Allocation(job_id="j"),
+                           ids=s.LazyUuids(100000),
+                           names=s.LazyNames(100000, "j.tg"),
+                           node_ids=["n1"] * 4, prev_ids=[])
+        blob = codec.encode(slab)
+        # The formulaic columns must ride as generator specs, not 100k
+        # materialized strings (the PR 9/10 log/wire compaction).
+        assert len(blob) < 1000
+        got = codec.decode(blob)
+        assert type(got.ids) is s.LazyUuids and got.ids.n == 100000
+        assert got.ids[7] == slab.ids[7]
+        assert got.names[99999] == slab.names[99999]
+
+    def test_envelopes_round_trip(self):
+        rng = random.Random(5)
+        dq_reply = {"Evals": [{"Eval": rand_eval(rng), "Token": "tok",
+                               "Attempts": 1, "PlanFence": 7}],
+                    "AppliedIndex": 42}
+        got = codec.decode(codec.encode(dq_reply))
+        assert isinstance(got["Evals"][0]["Eval"], s.Evaluation)
+        assert got["AppliedIndex"] == 42
+        submit = {"Plan": rand_plan(rng), "__forwarded__": True}
+        got = codec.decode(codec.encode(submit))
+        assert isinstance(got["Plan"], s.Plan)
+        hb = {"NodeID": "n1", "Status": "ready"}
+        assert codec.decode(codec.encode(hb)) == hb
+
+    @pytest.mark.slow
+    def test_fuzz_sweep(self):
+        for seed in range(24):
+            rng = random.Random(seed)
+            for builder in BUILDERS:
+                for _ in range(25):
+                    obj = builder(rng)
+                    got = codec.decode(codec.encode(obj))
+                    assert _materialize(got) \
+                        == _materialize(msgpack_path(obj))
+
+
+# ---------------------------------------------------------------------------
+# rejection semantics
+# ---------------------------------------------------------------------------
+
+
+class TestFrameRejection:
+    def test_every_truncation_rejected(self):
+        rng = random.Random(3)
+        blob = codec.encode({"plan": rand_plan(rng),
+                             "evals": [rand_eval(rng)]})
+        for k in range(len(blob)):
+            with pytest.raises(CodecError):
+                codec.decode(blob[:k])
+
+    def test_trailing_garbage_rejected(self):
+        blob = codec.encode({"a": 1})
+        with pytest.raises(CodecError, match="trailing"):
+            codec.decode(blob + b"\x00")
+
+    def test_bad_magic_version_fingerprint_and_type_id(self):
+        header = bytes([codec.MAGIC, codec.VERSION]) + codec.FINGERPRINT
+        with pytest.raises(CodecError, match="magic"):
+            codec.decode(b"\x00\x01\x00")
+        with pytest.raises(CodecError, match="version"):
+            codec.decode(bytes([codec.MAGIC, 99]) + codec.FINGERPRINT
+                         + b"\x00")
+        # A frame from a peer on a DIFFERENT struct schema: positional
+        # type ids would shift, so the fingerprint gate must reject it
+        # before any layout is trusted (rolling-upgrade safety for
+        # raft/WAL/snapshot frames that never cross a handshake).
+        drifted = bytes([codec.MAGIC, codec.VERSION]) \
+            + bytes(8) + b"\x00"
+        with pytest.raises(CodecError, match="fingerprint"):
+            codec.decode(drifted)
+        # struct tag with an out-of-registry type id
+        w = bytearray(header) + bytes([9, 0xFF, 0x7F])
+        with pytest.raises(CodecError, match="type id"):
+            codec.decode(bytes(w))
+
+    def test_int_out_of_64bit_range_fails_at_encode(self):
+        """An unbounded int must fail at ENCODE (falling back to the
+        msgpack path, which raises its own OverflowError) — never
+        produce a frame the decoder's varint cap rejects after it was
+        persisted/replicated."""
+        with pytest.raises(CodecError, match="64-bit"):
+            from nomad_tpu.codec.gen import encode_frame
+
+            encode_frame({"i": 1 << 90})
+        # int64 edges still round-trip
+        edge = {"a": (1 << 63) - 1, "b": -(1 << 63)}
+        assert codec.decode(codec.encode(edge)) == edge
+
+    def test_oversized_counts_rejected_without_allocation(self):
+        # list claiming 2^40 elements in a tiny frame
+        w = bytearray([codec.MAGIC, codec.VERSION]) + codec.FINGERPRINT
+        w.append(7)
+        n = 1 << 40
+        while n > 0x7F:
+            w.append(0x80 | (n & 0x7F))
+            n >>= 7
+        w.append(n)
+        with pytest.raises(CodecError):
+            codec.decode(bytes(w))
+
+    def test_bad_codec_frame_on_wire_is_transport_error(self):
+        """A torn codec frame must surface exactly like _recv_frame's
+        msgpack TransportError semantics (ISSUE 11 satellite)."""
+        a, b = socket.socketpair()
+        try:
+            blob = codec.encode({"x": 1})
+            torn = blob[: len(blob) - 1]
+            a.sendall(len(torn).to_bytes(4, "little") + torn)
+            with pytest.raises(TransportError, match="codec frame"):
+                _recv_frame(b)
+        finally:
+            a.close()
+            b.close()
+
+
+# ---------------------------------------------------------------------------
+# log / snapshot integration + kill switch (both directions)
+# ---------------------------------------------------------------------------
+
+
+class TestLogCodecAndKillSwitch:
+    def test_log_payload_codec_frames(self):
+        rng = random.Random(9)
+        payload = {"allocs": [rand_alloc(rng)], "slabs": [rand_slab(rng)],
+                   "job": rand_job(rng), "eval_id": "e1"}
+        blob = encode_payload(payload)
+        assert codec.is_frame(blob)
+        got = decode_payload(blob)
+        assert _materialize(got["job"]) \
+            == _materialize(msgpack_path(payload["job"]))
+        assert isinstance(got["allocs"][0], s.Allocation)
+
+    def test_kill_switch_both_directions(self, monkeypatch):
+        rng = random.Random(13)
+        payload = {"node": rand_node(rng)}
+        codec_blob = encode_payload(payload)
+        assert codec.is_frame(codec_blob)
+        monkeypatch.setenv("NOMAD_TPU_CODEC", "0")
+        codec.reset()
+        try:
+            # Disabled: writes the legacy tagged-msgpack tree…
+            legacy_blob = encode_payload(payload)
+            assert not codec.is_frame(legacy_blob)
+            # …but still DECODES codec frames already on disk/wire.
+            got = decode_payload(codec_blob)
+            assert isinstance(got["node"], s.Node)
+        finally:
+            monkeypatch.delenv("NOMAD_TPU_CODEC")
+            codec.reset()
+        # Re-enabled: legacy blobs written while disabled still decode.
+        got = decode_payload(legacy_blob)
+        assert isinstance(got["node"], s.Node)
+        assert _materialize(got["node"]) == _materialize(
+            decode_payload(codec_blob)["node"])
+
+    def test_filelog_mixed_format_recovery(self, tmp_path, monkeypatch):
+        """Entries appended under either switch position replay
+        together after restart (one WAL, mixed frames)."""
+        from nomad_tpu.server.fsm import FSM, MessageType
+        from nomad_tpu.server.raft import FileLog
+
+        node = mock.node()
+        node.compute_class()
+        job = mock.job()
+        flog = FileLog(FSM(), str(tmp_path))
+        flog.apply(MessageType.NODE_REGISTER, {"node": node})
+        flog.close()
+        monkeypatch.setenv("NOMAD_TPU_CODEC", "0")
+        codec.reset()
+        try:
+            flog2 = FileLog(FSM(), str(tmp_path))
+            assert flog2.fsm.state.node_by_id(None, node.id) is not None
+            flog2.apply(MessageType.JOB_REGISTER, {"job": job})
+            flog2.close()
+        finally:
+            monkeypatch.delenv("NOMAD_TPU_CODEC")
+            codec.reset()
+        flog3 = FileLog(FSM(), str(tmp_path))
+        assert flog3.fsm.state.node_by_id(None, node.id) is not None
+        assert flog3.fsm.state.job_by_id(None, job.id) is not None
+        flog3.close()
+
+    def test_snapshot_sections_ride_codec(self, monkeypatch):
+        from nomad_tpu.state.state_store import StateStore
+
+        store = StateStore()
+        node = mock.node()
+        node.compute_class()
+        store.upsert_node(1, node)
+        store.upsert_job(2, mock.job())
+        blob = store.persist()
+        restored = StateStore.restore(blob)
+        assert restored.node_by_id(None, node.id) is not None
+        # Kill switch: the snapshot written with codec frames must still
+        # restore with the switch off (decode is sniff-based).
+        monkeypatch.setenv("NOMAD_TPU_CODEC", "0")
+        codec.reset()
+        try:
+            restored2 = StateStore.restore(blob)
+            assert restored2.node_by_id(None, node.id) is not None
+            legacy = restored2.persist()
+        finally:
+            monkeypatch.delenv("NOMAD_TPU_CODEC")
+            codec.reset()
+        assert StateStore.restore(legacy).node_by_id(None, node.id) \
+            is not None
+
+
+# ---------------------------------------------------------------------------
+# per-connection negotiation
+# ---------------------------------------------------------------------------
+
+
+def _typed_echo_server():
+    srv = RPCServer()
+    srv.register("Echo", lambda body: body)
+    srv.register("GetEval", lambda body: {"Eval": s.Evaluation(
+        id="e-1", job_id="j-1", wait=1.5)})
+    srv.start()
+    return srv
+
+
+class TestNegotiation:
+    def test_codec_peers_speak_typed_frames(self):
+        srv = _typed_echo_server()
+        pool = ConnPool(timeout=5.0)
+        try:
+            reply = pool.call(srv.address, "GetEval", {})
+            ev = reply["Eval"]
+            assert isinstance(ev, s.Evaluation) and ev.id == "e-1"
+            assert ev.wait == 1.5
+            # ensure() passes typed values through untouched
+            assert ensure(s.Evaluation, ev) is ev
+            assert srv.address not in pool._legacy_addrs
+        finally:
+            pool.close()
+            srv.shutdown()
+
+    def test_old_client_against_new_server(self):
+        """A legacy dialer (0x01 channel, msgpack frames, wire dicts)
+        gets exactly the old CamelCase surface from a codec server."""
+        srv = _typed_echo_server()
+        conn = _Conn(srv.address, RPC_NOMAD, 5.0)
+        try:
+            assert not conn.binary
+            reply = conn.call("GetEval", {}, 5.0)
+            assert reply["Eval"]["ID"] == "e-1"  # wire dict, not typed
+            assert reply["Eval"]["Wait"] == 1.5
+            echoed = conn.call("Echo", {"A": [1, "x"]}, 5.0)
+            assert echoed == {"A": [1, "x"]}
+        finally:
+            conn.close()
+            srv.shutdown()
+
+    def test_old_server_negotiates_down_per_connection(self):
+        """Dialing an old (codec-less) peer: the codec handshake is
+        refused, the pool remembers the address and redials legacy —
+        calls succeed transparently (ISSUE 11 mixed-codec satellite)."""
+        child = subprocess.Popen(
+            [sys.executable, "-c", (
+                "import os, sys\n"
+                "os.environ['NOMAD_TPU_CODEC'] = '0'\n"
+                "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+                "from nomad_tpu.server.rpc import RPCServer\n"
+                "srv = RPCServer()\n"
+                "srv.register('Echo', lambda body: body)\n"
+                "srv.start()\n"
+                "print('READY', srv.address, flush=True)\n"
+                "sys.stdin.read()\n")],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+            env=dict(os.environ, NOMAD_TPU_CODEC="0",
+                     JAX_PLATFORMS="cpu"))
+        try:
+            line = child.stdout.readline()
+            assert line.startswith("READY "), line
+            addr = line.split()[1]
+            pool = ConnPool(timeout=5.0)
+            try:
+                assert pool.call(addr, "Echo", {"X": 1}) == {"X": 1}
+                assert addr in pool._legacy_addrs
+                # Second call: no re-probe, still legacy, still works.
+                assert pool.call(addr, "Echo", {"Y": 2}) == {"Y": 2}
+            finally:
+                pool.close()
+        finally:
+            child.stdin.close()
+            child.wait(timeout=10)
+
+    def test_handshake_timeout_does_not_pin_legacy(self):
+        """A stalled/restarting codec peer is a TRANSIENT failure: the
+        dial errors, but the address must NOT be demoted to msgpack for
+        the process lifetime (only an orderly refusal — the old-build
+        signature — pins legacy)."""
+        import threading
+
+        lst = socket.socket()
+        lst.bind(("127.0.0.1", 0))
+        lst.listen(1)
+        addr = f"127.0.0.1:{lst.getsockname()[1]}"
+        stop = threading.Event()
+
+        def stall():
+            conn, _ = lst.accept()
+            stop.wait(5.0)  # read nothing, send nothing, hold open
+            conn.close()
+
+        t = threading.Thread(target=stall, daemon=True)
+        t.start()
+        pool = ConnPool(timeout=0.3)
+        try:
+            with pytest.raises(Exception):
+                pool.call(addr, "Echo", {})
+            assert addr not in pool._legacy_addrs
+        finally:
+            stop.set()
+            pool.close()
+            lst.close()
+            t.join(timeout=2)
+
+    def test_kill_switch_restores_msgpack_everywhere(self, monkeypatch):
+        monkeypatch.setenv("NOMAD_TPU_CODEC", "0")
+        codec.reset()
+        srv = _typed_echo_server()
+        pool = ConnPool(timeout=5.0)
+        try:
+            reply = pool.call(srv.address, "GetEval", {})
+            # Pure msgpack end to end: wire dict surface.
+            assert reply["Eval"]["ID"] == "e-1"
+            assert ensure(s.Evaluation, reply["Eval"]).id == "e-1"
+        finally:
+            pool.close()
+            srv.shutdown()
+            monkeypatch.delenv("NOMAD_TPU_CODEC")
+            codec.reset()
+
+
+# ---------------------------------------------------------------------------
+# mixed-codec cluster (old msgpack-only peer joins a new-codec cluster)
+# ---------------------------------------------------------------------------
+
+
+class TestMixedCodecCluster:
+    def test_legacy_follower_schedules_against_codec_leader(self):
+        """A real subprocess follower running with NOMAD_TPU_CODEC=0
+        (an 'old build') joins a codec-enabled leader, replicates the
+        FSM, follower-read schedules, and forwards plans — every
+        leader<->follower frame negotiated down per connection."""
+        from nomad_tpu.server import Server, ServerConfig
+
+        cfg = ServerConfig(node_name="codec-leader", enable_rpc=True,
+                           bootstrap_expect=1, num_schedulers=0,
+                           min_heartbeat_ttl=60.0)
+        cfg.force_multi_raft = True
+        leader = Server(cfg)
+        leader.start()
+        child = None
+        try:
+            deadline = time.monotonic() + 10
+            while not leader.is_leader() and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert leader.is_leader()
+            child = subprocess.Popen(
+                [sys.executable, "-m", "nomad_tpu.loadgen",
+                 "--follower-child", "--join",
+                 leader.config.rpc_advertise, "--workers", "1",
+                 "--name", "legacy-follower"],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+                env=dict(os.environ, NOMAD_TPU_CODEC="0",
+                         JAX_PLATFORMS="cpu"))
+            line = ""
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                line = child.stdout.readline()
+                if line:
+                    break
+            assert line.startswith("READY "), line
+            follower_addr = line.split()[1]
+
+            node = mock.node()
+            node.resources.networks = []
+            node.reserved.networks = []
+            node.status = s.NODE_STATUS_READY
+            leader.node_register(node)
+            job = mock.job()
+            for tg in job.task_groups:
+                tg.count = 2
+                for t in tg.tasks:
+                    t.resources.networks = []
+            _, eval_id = leader.job_register(job)
+
+            def eval_complete():
+                ev = leader.state.eval_by_id(None, eval_id)
+                return ev is not None \
+                    and ev.status == s.EVAL_STATUS_COMPLETE
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and not eval_complete():
+                time.sleep(0.05)
+            assert eval_complete(), "legacy follower never scheduled"
+            assert len(leader.state.allocs_by_job(None, job.id)) == 2
+
+            # The placements replicate BACK to the legacy follower and
+            # are readable over its (msgpack-only) wire.
+            got = leader.pool.call(follower_addr, "Job.Get",
+                                   {"JobID": job.id}, timeout=10.0)
+            assert got["Job"] is not None
+            assert ensure(s.Job, got["Job"]).id == job.id
+            assert follower_addr in leader.pool._legacy_addrs
+        finally:
+            if child is not None:
+                child.stdin.close()
+                try:
+                    child.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    child.kill()
+            leader.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# native twin
+# ---------------------------------------------------------------------------
+
+
+class TestNativeTwin:
+    def test_twins_bit_identical_on_corpus(self):
+        rng = random.Random(21)
+        for _ in range(20):
+            strs = [_rstr(rng) for _ in range(rng.randrange(0, 50))] \
+                + [s.generate_uuid() for _ in range(rng.randrange(50))]
+            encoded = [x.encode("utf-8") for x in strs]
+            py = codec_native._py_pack_strs(encoded)
+            assert codec_native.pack_strs(strs) == py
+            got, end = codec_native.unpack_strs(py, 0, len(strs))
+            assert got == strs and end == len(py)
+            twin, twin_end = codec_native._py_split_strs(py, 0, len(strs))
+            assert twin == strs and twin_end == end
+
+    def test_split_rejects_truncation(self):
+        strs = ["abc", "def" * 100]
+        blob = codec_native._py_pack_strs(
+            [x.encode() for x in strs])
+        for k in range(len(blob)):
+            with pytest.raises(CodecError):
+                codec_native.unpack_strs(blob[:k], 0, len(strs))
+
+    def test_guard_counts_runs(self):
+        if codec_native._get_lib() is None:
+            pytest.skip("native codec unavailable")
+        before = codec_native.GUARD_RUNS
+        codec_native.pack_strs(["a", "bb", "ccc"])  # guard_every=1 (conftest)
+        assert codec_native.GUARD_RUNS > before
+        assert codec_native.GUARD_MISMATCHES == 0
