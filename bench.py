@@ -1006,6 +1006,57 @@ def bench_follower_scale(nodes: int = 2000, submissions: int = 160):
     return out
 
 
+def bench_chaos_soak(servers: int = 3):
+    """config_chaos: the robustness gate (ISSUE 12) — the seeded
+    ``chaos_smoke`` kill+partition timeline against a REAL cluster
+    (1 in-process leader + follower-scheduler SUBPROCESSES with
+    persistent raft stores) under offered load, with the continuous
+    safety auditor attached throughout.  ``--check`` hard-gates: ZERO
+    auditor violations (double placement / dup names / overcommit /
+    lost acked eval / index regression / FSM divergence), zero
+    unrecovered faults inside the recovery bound, zero stragglers, and
+    no hot-path method on the msgpack fallback.  The full-scale soak
+    evidence lives in LOADGEN_r05.json."""
+    from dataclasses import replace
+
+    from nomad_tpu.loadgen.harness import run_scenario
+    from nomad_tpu.loadgen.scenario import get_scenario
+
+    sc = replace(get_scenario("chaos_smoke"), num_servers=servers)
+    rep = run_scenario(sc)
+    aud = rep.get("auditor") or {}
+    chaos = rep.get("chaos") or {}
+    integ = rep.get("integrity") or {}
+    rec = chaos.get("recovery_s") or {}
+    out = {
+        "servers": servers,
+        "violations": aud.get("violation_count", -1),
+        "violation_kinds": sorted({v["kind"] for v in
+                                   aud.get("violations") or []}),
+        "fingerprint_matches": (aud.get("checks")
+                                or {}).get("fingerprint_matches", 0),
+        "chaos_events": len(chaos.get("events") or []),
+        "recovered": chaos.get("recovered", 0),
+        "unrecovered": chaos.get("unrecovered", 0),
+        "censored": chaos.get("censored", 0),
+        "recovery_bound_s": chaos.get("recovery_bound_s"),
+        "recovery_p50_s": rec.get("p50"),
+        "recovery_max_s": rec.get("max"),
+        "stragglers": rep["sustained"]["stragglers_after_drain"],
+        "double_placements": (integ.get("overplaced_jobs", 0)
+                              + integ.get("duplicate_alloc_names", 0)
+                              + integ.get("overcommitted_nodes", 0)),
+        "hot_msgpack_methods": (rep.get("codec")
+                                or {}).get("hot_msgpack_methods") or {},
+    }
+    log(f"  chaos-soak: {out['chaos_events']} chaos events on "
+        f"{servers} servers — {out['violations']} auditor violations, "
+        f"{out['recovered']} recovered/{out['unrecovered']} unrecovered "
+        f"(p50 {out['recovery_p50_s']}s), "
+        f"{out['fingerprint_matches']} fingerprint matches")
+    return out
+
+
 def run_config(n_nodes: int, n_jobs: int, count_per_job: int, label: str,
                constrained: bool = False, trials: int = 3,
                keep_state: bool = False, n_dcs: int = 1):
@@ -2167,6 +2218,44 @@ def _check_main(argv) -> int:
     except Exception as exc:
         out["follower_scale_evals_per_s"] = {"error": repr(exc)}
         failures.append(f"follower-scale phase failed: {exc!r}")
+
+    # Cluster chaos gate (ISSUE 12): the seeded kill+partition timeline
+    # under load with the continuous safety auditor attached.  Every
+    # gate here is absolute (no baseline needed): the invariants either
+    # held under abuse or they did not.
+    try:
+        with _deadline(420, "check_chaos_soak"):
+            cso = bench_chaos_soak()
+        out["chaos_soak"] = cso
+        if cso["chaos_events"] < 2:
+            failures.append(
+                f"chaos soak executed only {cso['chaos_events']} chaos "
+                "events — the timeline did not run")
+        if cso["violations"]:
+            failures.append(
+                f"chaos soak recorded {cso['violations']} auditor "
+                f"violations ({', '.join(cso['violation_kinds'])}) — "
+                "safety invariants must hold under kills and partitions")
+        if cso["double_placements"]:
+            failures.append(
+                f"chaos soak final sweep found "
+                f"{cso['double_placements']} integrity defects")
+        if cso["unrecovered"]:
+            failures.append(
+                f"chaos soak: {cso['unrecovered']} fault(s) did not "
+                f"recover to >=80% of pre-fault placed/s within the "
+                f"{cso['recovery_bound_s']}s bound")
+        if cso["stragglers"]:
+            failures.append(
+                f"chaos soak left {cso['stragglers']} stragglers after "
+                "drain")
+        if cso["hot_msgpack_methods"]:
+            failures.append(
+                "hot scheduling methods leaked onto the msgpack "
+                f"fallback: {cso['hot_msgpack_methods']}")
+    except Exception as exc:
+        out["chaos_soak"] = {"error": repr(exc)}
+        failures.append(f"chaos-soak phase failed: {exc!r}")
 
     # FSM snapshot+restore guard (ISSUE 9): the columnar persist+restore
     # wall time must not regress past threshold x baseline.  Measured

@@ -45,6 +45,7 @@ __all__ = [
     "CodecError", "MAGIC", "VERSION", "FINGERPRINT", "enabled",
     "encode", "decode", "is_frame", "stats", "reset",
     "metrics_latest", "merge_metrics", "native",
+    "note_msgpack_method", "msgpack_methods", "hot_msgpack_methods",
 ]
 
 _enabled_cache: Optional[bool] = None
@@ -131,6 +132,36 @@ def note_msgpack(subsystem: str, op: str, t0: float,
     TELEMETRY.add_sample(f"codec.{subsystem}.{op}_seconds", dt)
 
 
+# Per-RPC-method msgpack frame counts (ISSUE 12 satellite): which
+# methods still ride the reflection fallback.  The ROADMAP item 1
+# residual named Status/Serf control frames — this counter is the
+# standing proof they never show up on a hot path (the loadgen report
+# surfaces it per leg; the chaos gate asserts hot prefixes stay at 0).
+_MSGPACK_METHODS: Dict[str, int] = {}
+
+# Wire-method prefixes that constitute the scheduling hot path; a
+# msgpack frame carrying one of these between codec-negotiated peers
+# means the fallback leaked into the hot loop.
+HOT_METHOD_PREFIXES = ("Eval.", "Plan.", "Node.", "Job.", "Alloc.")
+
+
+def note_msgpack_method(method: str) -> None:
+    # Benign-race increment, same trade as the counters above.
+    _MSGPACK_METHODS[method] = _MSGPACK_METHODS.get(method, 0) + 1
+
+
+def msgpack_methods() -> Dict[str, int]:
+    """Cumulative msgpack-framed request counts by wire method."""
+    return dict(_MSGPACK_METHODS)
+
+
+def hot_msgpack_methods() -> Dict[str, int]:
+    """The subset of msgpack-framed methods on the scheduling hot path
+    — empty is the healthy (and gated) state for a codec fleet."""
+    return {m: n for m, n in _MSGPACK_METHODS.items()
+            if m.startswith(HOT_METHOD_PREFIXES)}
+
+
 def stats() -> Dict[str, Dict[str, float]]:
     """Cumulative per-subsystem split; loadgen legs diff two snapshots."""
     return {sub: dict(vals) for sub, vals in _COUNTERS.items()}
@@ -167,5 +198,6 @@ def reset() -> None:
     global _enabled_cache, _COUNTERS
     _enabled_cache = None
     _COUNTERS = _fresh_counters()
+    _MSGPACK_METHODS.clear()
     TELEMETRY.sink = InmemSink(interval=3600.0)
     native.reset_counters()

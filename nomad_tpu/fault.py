@@ -52,18 +52,46 @@ point                  armed at
                        actions: crash, error, delay
 ``ops.kernel_result``  device→host kernel outputs (ops/batch_sched.py);
                        actions: corrupt (hands the site a seeded RNG)
+``net.dial``           connection establishment (server/rpc.py
+                       ConnPool._dial); actions: drop, delay
+``net.send``           per-call outbound traffic (ConnPool.call, covering
+                       the Nomad channel AND the MultiRaft replication
+                       transport); actions: drop, delay, reorder
 =====================  ====================================================
+
+Network chaos plane (ISSUE 12)
+------------------------------
+Connection-level faults live on a SEPARATE global — the :class:`NetPlane`
+— so cluster chaos (partitions) composes with rule scenarios and can be
+driven imperatively mid-run without re-arming::
+
+    fault.net_partition("split-a", [[leader_addr], [follower_addr]])
+    ...  # traffic between the two groups is severed, both directions
+    fault.net_heal("split-a")
+
+Every ConnPool is stamped with its owner's advertised address
+(``pool.local_addr``), so a single process hosting several servers (the
+in-process cluster tests) enforces a partition on BOTH sides; subprocess
+followers arm their own plane via the ``Chaos.SetNet`` control RPC
+(enabled by ``NOMAD_TPU_CHAOS=1``) or the ``NOMAD_TPU_CHAOS_NET`` env
+spec.  Asymmetric loss/delay/reorder are expressed as seeded net RULES
+(src/dst fnmatch patterns, per-rule RNG — same seed, same decision
+sequence), and :func:`flap_windows` derives a deterministic split/heal
+schedule from a seed for flapping-link scenarios.
 """
 from __future__ import annotations
 
 import fnmatch
 import random
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = [
     "FaultAction", "FaultRule", "FaultPlane", "InjectedFault",
     "arm", "disarm", "armed", "faultpoint", "scenario", "trace",
+    "NetPlane", "NetRule", "net", "net_arm", "net_disarm", "net_armed",
+    "netpoint", "net_partition", "net_heal", "flap_windows",
 ]
 
 ACTIONS = ("drop", "delay", "dup", "truncate", "error", "crash",
@@ -253,3 +281,247 @@ class scenario:
 
     def __exit__(self, *exc) -> None:
         disarm()
+
+
+# ---------------------------------------------------------------------------
+# network chaos plane (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+NET_ACTIONS = ("drop", "delay", "reorder")
+
+
+class NetRule:
+    """One connection-level rule: ``src``/``dst`` fnmatch patterns, a
+    ``kind`` (``dial``/``send``/``*``), and an action:
+
+    - ``drop``    — the dial/call fails as an unreachable peer
+    - ``delay``   — sleep ``delay`` seconds before proceeding
+    - ``reorder`` — seeded bounded extra delay in ``[0, max_delay]``;
+      on this strictly-sequential per-connection RPC, reordering
+      manifests across *parallel* connections (a delayed call lands
+      after its younger siblings), which is the observable that matters
+
+    ``prob``/``times`` gate firing exactly like :class:`FaultRule`, with
+    the same private-RNG determinism contract."""
+
+    __slots__ = ("kind", "src", "dst", "action", "prob", "times", "delay",
+                 "max_delay", "fired", "rng", "index")
+
+    def __init__(self, spec: Dict[str, Any], index: int, seed: int):
+        self.kind: str = spec.get("kind", "*")
+        self.src: str = spec.get("src", "*")
+        self.dst: str = spec.get("dst", "*")
+        self.action: str = spec["action"]
+        if self.action not in NET_ACTIONS:
+            raise ValueError(f"unknown net action {self.action!r}")
+        self.prob: float = float(spec.get("prob", 1.0))
+        times = spec.get("times")
+        self.times: Optional[int] = None if times is None else int(times)
+        self.delay: float = float(spec.get("delay", 0.02))
+        self.max_delay: float = float(spec.get("max_delay", 0.1))
+        self.index = index
+        self.rng = random.Random(f"net/{seed}/{index}")
+        self.fired = 0
+
+    def matches(self, kind: str, src: str, dst: str) -> bool:
+        return ((self.kind == "*" or self.kind == kind)
+                and fnmatch.fnmatchcase(src, self.src)
+                and fnmatch.fnmatchcase(dst, self.dst))
+
+
+class _Partition:
+    """One named partition: traffic between addresses matched into
+    DIFFERENT groups is severed.  Group entries are fnmatch patterns;
+    an address matching no group is unaffected.  Optional ``windows``
+    (offsets from the plane's arm anchor, see :func:`flap_windows`)
+    make the split flap on a deterministic schedule."""
+
+    __slots__ = ("name", "groups", "windows", "blocked_count")
+
+    def __init__(self, name: str, groups: List[List[str]],
+                 windows: Optional[List[Tuple[float, float]]] = None):
+        self.name = name
+        self.groups = [list(g) for g in groups]
+        self.windows = ([(float(a), float(b)) for a, b in windows]
+                        if windows else None)
+        self.blocked_count = 0
+
+    def active(self, elapsed: float) -> bool:
+        if self.windows is None:
+            return True
+        return any(a <= elapsed < b for a, b in self.windows)
+
+    def separates(self, src: str, dst: str) -> bool:
+        def group_of(addr: str) -> int:
+            # Most-specific pattern wins, so a ["*"] catch-all group
+            # composes with a named group: an address listed literally
+            # belongs to ITS group, everything else to the wildcard.
+            best, best_spec = -1, -1
+            for i, pats in enumerate(self.groups):
+                for p in pats:
+                    if fnmatch.fnmatchcase(addr, p):
+                        spec = sum(c not in "*?[]" for c in p)
+                        if spec > best_spec:
+                            best, best_spec = i, spec
+            return best
+
+        gs, gd = group_of(src), group_of(dst)
+        return gs >= 0 and gd >= 0 and gs != gd
+
+
+class NetPlane:
+    """Process-wide network chaos state: named partitions (imperative
+    split/heal + deterministic flap windows) and seeded loss/delay
+    rules.  The hot disarmed path never reaches this class — see
+    :func:`netpoint`."""
+
+    def __init__(self, spec: Optional[Dict[str, Any]] = None,
+                 seed: Optional[int] = None):
+        spec = dict(spec or {})
+        self.seed = int(spec.get("seed", 0) if seed is None else seed)
+        self._l = threading.Lock()
+        self._anchor = time.monotonic()
+        self._partitions: Dict[str, _Partition] = {}
+        self.rules = [NetRule(r, i, self.seed)
+                      for i, r in enumerate(spec.get("rules") or [])]
+        self._trace: List[Tuple[str, str, str]] = []
+        for p in spec.get("partitions") or []:
+            self.partition(p["name"], p["groups"], windows=p.get("windows"))
+
+    # -- partitions --------------------------------------------------------
+
+    def partition(self, name: str, groups: List[List[str]],
+                  windows: Optional[List[Tuple[float, float]]] = None,
+                  ) -> None:
+        with self._l:
+            self._partitions[name] = _Partition(name, groups, windows)
+            self._trace.append(("net.partition", name,
+                                "flap" if windows else "split"))
+        note_event_stream("Chaos", "Partition", name,
+                          {"Groups": [list(g) for g in groups],
+                           "Flap": bool(windows)})
+
+    def heal(self, name: Optional[str] = None) -> None:
+        with self._l:
+            names = ([name] if name is not None
+                     else list(self._partitions))
+            for n in names:
+                if self._partitions.pop(n, None) is not None:
+                    self._trace.append(("net.partition", n, "heal"))
+        for n in names:
+            note_event_stream("Chaos", "Heal", n, {})
+
+    def active_partitions(self) -> List[str]:
+        elapsed = time.monotonic() - self._anchor
+        with self._l:
+            return sorted(n for n, p in self._partitions.items()
+                          if p.active(elapsed))
+
+    def blocked(self, src: str, dst: str) -> bool:
+        elapsed = time.monotonic() - self._anchor
+        with self._l:
+            for p in self._partitions.values():
+                if p.active(elapsed) and p.separates(src, dst):
+                    p.blocked_count += 1
+                    return True
+        return False
+
+    # -- the hook ----------------------------------------------------------
+
+    def check(self, kind: str, src: str, dst: str
+              ) -> Optional[Tuple[str, float]]:
+        """Partition verdict first (deterministic), then the first
+        firing rule.  Returns ``(action, delay_seconds)`` or None."""
+        if self.blocked(src, dst):
+            return ("drop", 0.0)
+        for rule in self.rules:
+            if not rule.matches(kind, src, dst):
+                continue
+            with self._l:
+                if rule.times is not None and rule.fired >= rule.times:
+                    continue
+                if rule.prob < 1.0 and rule.rng.random() >= rule.prob:
+                    continue
+                rule.fired += 1
+                delay = (rule.rng.random() * rule.max_delay
+                         if rule.action == "reorder" else rule.delay)
+                self._trace.append((f"net.{kind}", f"rule-{rule.index}",
+                                    rule.action))
+            return (rule.action, delay)
+        return None
+
+    def trace(self) -> List[Tuple[str, str, str]]:
+        with self._l:
+            return list(self._trace)
+
+
+_NET: Optional[NetPlane] = None
+
+
+def net_arm(spec: Optional[Dict[str, Any]] = None,
+            seed: Optional[int] = None) -> NetPlane:
+    global _NET
+    _NET = NetPlane(spec, seed=seed)
+    return _NET
+
+
+def net_disarm() -> None:
+    global _NET
+    _NET = None
+
+
+def net_armed() -> bool:
+    return _NET is not None
+
+
+def net() -> NetPlane:
+    """The process net plane, arming an empty one on first use (the
+    imperative partition/heal path needs no scenario)."""
+    global _NET
+    if _NET is None:
+        _NET = NetPlane()
+    return _NET
+
+
+def netpoint(kind: str, src: str, dst: str
+             ) -> Optional[Tuple[str, float]]:
+    """The hook threaded through ConnPool dial/send.  Disarmed cost:
+    one module-global load + a ``None`` check."""
+    plane = _NET
+    if plane is None:
+        return None
+    return plane.check(kind, src, dst)
+
+
+def net_partition(name: str, groups: List[List[str]],
+                  windows: Optional[List[Tuple[float, float]]] = None,
+                  ) -> NetPlane:
+    plane = net()
+    plane.partition(name, groups, windows=windows)
+    return plane
+
+
+def net_heal(name: Optional[str] = None) -> None:
+    plane = _NET
+    if plane is not None:
+        plane.heal(name)
+
+
+def flap_windows(seed: int, count: int = 4, period: float = 2.0,
+                 duty: float = 0.5, jitter: float = 0.5,
+                 start: float = 0.0) -> List[Tuple[float, float]]:
+    """A deterministic split/heal schedule: ``count`` blocked windows,
+    each roughly ``duty``·``period`` long, spaced ~``period`` apart with
+    seeded jitter.  Same seed → same windows; anchored at the plane's
+    arm time, so two processes arming the same spec at the same moment
+    flap together."""
+    rng = random.Random(f"flap/{seed}")
+    out: List[Tuple[float, float]] = []
+    t = start
+    for _ in range(count):
+        gap = period * (1.0 - duty) * (1.0 + jitter * (rng.random() - 0.5))
+        dur = period * duty * (1.0 + jitter * (rng.random() - 0.5))
+        t += gap
+        out.append((round(t, 4), round(t + dur, 4)))
+        t += dur
+    return out

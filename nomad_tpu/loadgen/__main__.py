@@ -70,7 +70,13 @@ def _follower_child_main(args) -> int:
         node_name=args.name or "lg-follower",
         enable_rpc=True, start_join=[args.join], bootstrap_expect=1,
         num_schedulers=max(0, args.workers), min_heartbeat_ttl=60.0,
-        non_voting=getattr(args, "non_voting", False)),
+        non_voting=getattr(args, "non_voting", False),
+        # Chaos crash-restart (ISSUE 12): a persistent data dir + a
+        # pinned port let a SIGKILLed follower come back as the SAME
+        # raft member, recovering term/vote/log/snapshot from its
+        # store before the leader replays the missing suffix.
+        data_dir=getattr(args, "data_dir", "") or "",
+        rpc_port=int(getattr(args, "port", 0) or 0)),
         logger=logging.getLogger("nomad_tpu.loadgen.follower"))
     if hasattr(srv.metrics.sink, "interval"):
         # One aggregation window for the whole run, like the harness
@@ -126,6 +132,8 @@ def main(argv=None) -> int:
     p.add_argument("--name", default="", help=argparse.SUPPRESS)
     p.add_argument("--non-voting", action="store_true",
                    help=argparse.SUPPRESS)
+    p.add_argument("--data-dir", default="", help=argparse.SUPPRESS)
+    p.add_argument("--port", type=int, default=0, help=argparse.SUPPRESS)
     p.add_argument("--out", default="", help="write the JSON report here")
     p.add_argument("--trace", action="store_true",
                    help="arm the eval-lifecycle tracing plane (slow-tail "

@@ -105,6 +105,126 @@ class _Submission:
         self.rejected = 0
 
 
+class _ChaosScheduler:
+    """Seeded fault timeline for multi-server soak runs (ISSUE 12): a
+    deterministic schedule of follower kills (SIGKILL + restart from
+    the raft store) and split/heal network partitions, interleaved with
+    the offered load.
+
+    Partitions are enforced on BOTH sides: the harness process arms its
+    own net plane (severing the leader's dials/sends — including raft
+    replication — to the target) and drives the follower's plane over
+    the chaos-exempt control pool via ``Chaos.SetNet``, so the
+    follower's dequeue/plan-forward traffic dies too.  Every event is
+    recorded with monotonic timestamps for the recovery-time report."""
+
+    def __init__(self, harness: "LoadHarness", spec: Dict, logger):
+        self.h = harness
+        self.spec = dict(spec or {})
+        self.logger = logger
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.events: List[Dict] = []
+        seed = int(self.spec.get("seed", harness.sc.seed))
+        rng = random.Random(f"chaos/{seed}")
+        kills = int(self.spec.get("kills", 1))
+        partitions = int(self.spec.get("partitions", 2))
+        start = float(self.spec.get("start_offset_s", 6.0))
+        spacing = float(self.spec.get("spacing_s", 9.0))
+        n_followers = max(1, harness.sc.num_servers - 1)
+        # Deterministic interleave: partitions and kills alternate,
+        # jittered spacing, seeded follower choice.
+        kinds = []
+        for i in range(max(kills, partitions)):
+            if i < partitions:
+                kinds.append("partition")
+            if i < kills:
+                kinds.append("kill")
+        self.timeline: List[Dict] = []
+        t = start
+        for k, kind in enumerate(kinds):
+            # Seeded base + ordinal rotation: deterministic, and a
+            # multi-event timeline spreads across followers instead of
+            # the seed happening to abuse one server all run.
+            self.timeline.append({
+                "at_s": round(t, 2), "kind": kind,
+                "target": (rng.randrange(n_followers) + k) % n_followers})
+            t += spacing * (0.8 + 0.4 * rng.random())
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="lg-chaos")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+
+    # -- actions -----------------------------------------------------------
+
+    def _set_follower_net(self, addr: str, body: Dict) -> None:
+        try:
+            self.h._chaos_pool.call(addr, "Chaos.SetNet", body,
+                                    timeout=5.0)
+        except Exception as e:
+            self.logger.warning("chaos: Chaos.SetNet on %s failed: %s",
+                                addr, e)
+
+    def _do_partition(self, ev: Dict) -> None:
+        from .. import fault
+
+        idx = ev["target"] % len(self.h.follower_addrs)
+        addr = self.h.follower_addrs[idx]
+        leader = self.h.server.config.rpc_advertise
+        name = f"chaos-{len(self.events)}"
+        hold = float(self.spec.get("partition_s", 4.0))
+        ev.update(target_addr=addr, name=name, t=time.monotonic())
+        # Split: both sides sever their own outbound traffic.
+        fault.net_partition(name, [[leader], [addr]])
+        self._set_follower_net(addr, {"Partitions": [
+            {"Name": name, "Groups": [[addr], [leader]]}]})
+        self.logger.info("chaos: partition %s <-> %s for %.1fs",
+                         leader, addr, hold)
+        self._stop.wait(hold)
+        fault.net_heal(name)
+        self._set_follower_net(addr, {"Heal": [name]})
+        ev["healed_t"] = time.monotonic()
+
+    def _do_kill(self, ev: Dict) -> None:
+        idx = ev["target"] % len(self.h.follower_addrs)
+        delay = float(self.spec.get("restart_delay_s", 1.0))
+        ev.update(t=time.monotonic())
+        addr = self.h.kill_follower(idx)
+        ev["target_addr"] = addr
+        self.logger.info("chaos: SIGKILLed follower %s; restarting in "
+                         "%.1fs", addr, delay)
+        self._stop.wait(delay)
+        self.h.restart_follower(idx)
+        ev["restarted_t"] = time.monotonic()
+
+    def _run(self) -> None:
+        for ev in self.timeline:
+            due = self.h._start_t + ev["at_s"]
+            while not self._stop.is_set():
+                wait = due - time.monotonic()
+                if wait <= 0:
+                    break
+                self._stop.wait(min(wait, 0.5))
+            if self._stop.is_set():
+                return
+            ev = dict(ev)
+            try:
+                if ev["kind"] == "partition":
+                    self._do_partition(ev)
+                else:
+                    self._do_kill(ev)
+            except Exception as e:
+                ev["error"] = repr(e)
+                self.logger.exception("chaos: %s event failed", ev["kind"])
+            self.events.append(ev)
+
+
 class LoadHarness:
     """One scenario run against one in-process server."""
 
@@ -134,6 +254,17 @@ class LoadHarness:
         # Multi-server mode (ISSUE 10): follower-scheduler subprocesses.
         self._follower_procs: list = []
         self.follower_addrs: List[str] = []
+        # Chaos plane (ISSUE 12): per-follower persistent data dirs (so
+        # a SIGKILLed follower restarts from its raft store), the
+        # chaos-EXEMPT control pool (split/heal/audit must reach a
+        # "partitioned" server the way an out-of-band console would),
+        # the seeded chaos scheduler, and the continuous auditor.
+        self._follower_dirs: List[str] = []
+        self._follower_env: dict = {}
+        self._chaos_root = ""
+        self._chaos_pool = None
+        self._chaos = None
+        self.auditor = None
 
     # -- setup -------------------------------------------------------------
 
@@ -218,6 +349,48 @@ class LoadHarness:
 
     # -- follower-scheduler subprocesses (ISSUE 10) ------------------------
 
+    def _spawn_one_follower(self, i: int, port: int = 0):
+        """Spawn follower ``i`` (fresh or crash-restart).  With a chaos
+        spec every follower gets a PERSISTENT data dir and a fixed port
+        on restart, so a SIGKILLed server comes back as the same raft
+        member and recovers from its own store + snapshot."""
+        import subprocess
+        import sys
+
+        sc = self.sc
+        addr = self.server.config.rpc_advertise
+        workers = (0 if sc.follower_workers < 0
+                   else sc.follower_workers or sc.num_workers)
+        cmd = [sys.executable, "-m", "nomad_tpu.loadgen",
+               "--follower-child", "--join", addr,
+               "--workers", str(workers),
+               "--name", f"lg-follower-{i + 1}"]
+        if not sc.follower_voting:
+            cmd.append("--non-voting")
+        if i < len(self._follower_dirs) and self._follower_dirs[i]:
+            cmd += ["--data-dir", self._follower_dirs[i]]
+        if port:
+            cmd += ["--port", str(port)]
+        return subprocess.Popen(cmd, stdin=subprocess.PIPE,
+                                stdout=subprocess.PIPE, text=True,
+                                env=self._follower_env)
+
+    def _await_ready(self, proc, deadline: float) -> str:
+        import select
+
+        line = ""
+        while time.monotonic() < deadline:
+            ready, _, _ = select.select([proc.stdout], [], [], 0.5)
+            if ready:
+                line = proc.stdout.readline()
+                break
+            if proc.poll() is not None:
+                break
+        if not line.startswith("READY "):
+            raise RuntimeError(
+                f"follower server failed to start (got {line!r})")
+        return line.split()[1]
+
     def _spawn_followers(self) -> None:
         """1 leader + K follower-scheduler servers: each follower is a
         real subprocess (its scheduling CPU runs on its own
@@ -225,42 +398,28 @@ class LoadHarness:
         FSM, and pulls evals via the follower-read path
         (server/follower_sched.py)."""
         import os
-        import select
-        import subprocess
-        import sys
+        import tempfile
 
         sc = self.sc
         addr = self.server.config.rpc_advertise
-        # follower_workers: -1 = pure voters (no follower scheduling —
-        # the cluster_leader_sched comparison leg), 0 = num_workers.
-        workers = (0 if sc.follower_workers < 0
-                   else sc.follower_workers or sc.num_workers)
-        env = dict(os.environ, JAX_PLATFORMS="cpu",
-                   NOMAD_TPU_FOLLOWER_SCHED="1", **RAFT_TUNING)
+        self._follower_env = dict(os.environ, JAX_PLATFORMS="cpu",
+                                  NOMAD_TPU_FOLLOWER_SCHED="1",
+                                  **RAFT_TUNING)
+        if sc.chaos is not None or sc.audit:
+            # Auditor feed: every server's event broker armed; chaos
+            # control endpoints enabled on the children.
+            self._follower_env["NOMAD_TPU_EVENTS"] = "1"
+        if sc.chaos is not None:
+            self._follower_env["NOMAD_TPU_CHAOS"] = "1"
+            self._chaos_root = tempfile.mkdtemp(prefix="nomad-tpu-chaos-")
+            self._follower_dirs = [
+                os.path.join(self._chaos_root, f"follower-{i + 1}")
+                for i in range(sc.num_servers - 1)]
         for i in range(sc.num_servers - 1):
-            cmd = [sys.executable, "-m", "nomad_tpu.loadgen",
-                   "--follower-child", "--join", addr,
-                   "--workers", str(workers),
-                   "--name", f"lg-follower-{i + 1}"]
-            if not sc.follower_voting:
-                cmd.append("--non-voting")
-            self._follower_procs.append(subprocess.Popen(
-                cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-                text=True, env=env))
+            self._follower_procs.append(self._spawn_one_follower(i))
         deadline = time.monotonic() + 60.0
         for proc in self._follower_procs:
-            line = ""
-            while time.monotonic() < deadline:
-                ready, _, _ = select.select([proc.stdout], [], [], 0.5)
-                if ready:
-                    line = proc.stdout.readline()
-                    break
-                if proc.poll() is not None:
-                    break
-            if not line.startswith("READY "):
-                raise RuntimeError(
-                    f"follower server failed to start (got {line!r})")
-            self.follower_addrs.append(line.split()[1])
+            self.follower_addrs.append(self._await_ready(proc, deadline))
         # Membership: voters are promoted through replicated CONFIG
         # entries; non-voting followers attach to the replication
         # fan-out as learners.
@@ -285,10 +444,19 @@ class LoadHarness:
         out = []
         for addr in self.follower_addrs:
             try:
-                m = self.server.pool.call(addr, "Status.Metrics", {},
-                                          timeout=5.0)
-                b = self.server.pool.call(addr, "Status.BrokerStats", {},
-                                          timeout=5.0)
+                def call(method):
+                    # One retry: a chaos kill/restart leaves stale
+                    # pooled connections to the old process; the first
+                    # call discards one, the retry dials fresh.
+                    for attempt in (0, 1):
+                        try:
+                            return self.server.pool.call(addr, method, {},
+                                                         timeout=5.0)
+                        except Exception:
+                            if attempt:
+                                raise
+                m = call("Status.Metrics")
+                b = call("Status.BrokerStats")
             except Exception as e:
                 out.append({"addr": addr, "error": str(e)})
                 continue
@@ -341,49 +509,53 @@ class LoadHarness:
                 proc.wait(timeout=5.0)
         self._follower_procs = []
 
+    # -- chaos plane (ISSUE 12) --------------------------------------------
+
+    def kill_follower(self, idx: int) -> str:
+        """SIGKILL follower ``idx`` — a real process crash, no drain,
+        no flush.  Returns its address."""
+        proc = self._follower_procs[idx]
+        proc.kill()
+        proc.wait(timeout=10.0)
+        return self.follower_addrs[idx]
+
+    def restart_follower(self, idx: int, timeout: float = 60.0) -> str:
+        """Respawn a killed follower at the SAME address with the SAME
+        data dir: it recovers term/vote/log/snapshot from its raft
+        store, rejoins the leader, and replication + follower-read
+        scheduling resume — the crash-restart leg of the chaos plane."""
+        addr = self.follower_addrs[idx]
+        port = int(addr.rsplit(":", 1)[1])
+        proc = self._spawn_one_follower(idx, port=port)
+        self._follower_procs[idx] = proc
+        got = self._await_ready(proc, time.monotonic() + timeout)
+        if got != addr:
+            raise RuntimeError(
+                f"restarted follower came back at {got}, wanted {addr}")
+        # The old process's sockets are corpses: purge them (and the
+        # dial gate) so the next caller dials the new incarnation
+        # instead of draining dead conns one TransportError at a time.
+        for pool in (self.server.pool, self._chaos_pool):
+            if pool is not None:
+                pool.invalidate(addr)
+        if self.auditor is not None:
+            self.auditor.note_restart(addr)
+        return addr
+
     def _collect_integrity(self) -> Dict:
         """Placement-integrity sweep over the leader's final state: the
         follower-read acceptance bar is ZERO double placements — no job
         with more live allocs than its (latest registered) total count,
-        no duplicate alloc names within a job, no overcommitted node."""
-        st = self.server.state
+        no duplicate alloc names within a job, no overcommitted node.
+        One shared predicate with the continuous auditor
+        (loadgen/auditor.integrity_sweep)."""
+        from .auditor import integrity_sweep
+
         with self._l:
             job_ids = {rec.job_id for rec in self.subs.values()}
-        live_by_job: Dict[str, list] = {}
-        usage: Dict[str, Tuple[int, int]] = {}
-        for a in st.allocs(None):
-            if a.terminal_status():
-                continue
-            live_by_job.setdefault(a.job_id, []).append(a)
-            res = a.resources
-            if res is not None:
-                cpu, mem = usage.get(a.node_id, (0, 0))
-                usage[a.node_id] = (cpu + res.cpu, mem + res.memory_mb)
-        checked = overplaced = dup_names = 0
-        for jid in job_ids:
-            job = st.job_by_id(None, jid)
-            if job is None:
-                continue
-            checked += 1
-            allocs = live_by_job.get(jid, [])
-            want = sum(tg.count for tg in job.task_groups)
-            if len(allocs) > want:
-                overplaced += 1
-            if len({a.name for a in allocs}) != len(allocs):
-                dup_names += 1
-        overcommitted = 0
-        for node in st.nodes(None):
-            cpu, mem = usage.get(node.id, (0, 0))
-            res_cpu = node.resources.cpu - (node.reserved.cpu
-                                            if node.reserved else 0)
-            res_mem = node.resources.memory_mb - (
-                node.reserved.memory_mb if node.reserved else 0)
-            if cpu > res_cpu or mem > res_mem:
-                overcommitted += 1
-        return {"jobs_checked": checked,
-                "overplaced_jobs": overplaced,
-                "duplicate_alloc_names": dup_names,
-                "overcommitted_nodes": overcommitted}
+        out = integrity_sweep(self.server.state, job_ids)
+        out.pop("detail", None)
+        return out
 
     def _register_nodes(self) -> List[str]:
         sc = self.sc
@@ -626,30 +798,62 @@ class LoadHarness:
         # here so the report's time-split covers THIS leg only (the
         # compare_* drivers run several legs in one process).
         self._codec_before = codec.stats()
+        self._msgpack_methods_before = codec.msgpack_methods()
         self.server = self._build_server()
         try:
             return self._run_inner()
         finally:
             self._stop.set()
+            if self._chaos is not None:
+                self._chaos.stop()
+            if self.auditor is not None:
+                self.auditor.stop()
+            if self.sc.chaos is not None:
+                from .. import fault
+
+                fault.net_disarm()
             for t in self._threads:
                 t.join(timeout=5.0)
             self._stop_followers()
+            if self._chaos_pool is not None:
+                self._chaos_pool.close()
             self.server.shutdown()
             prior = getattr(self, "_prior_switch_interval", None)
             if prior is not None:
                 import sys as _sys
 
                 _sys.setswitchinterval(prior)
-            wal_dir = getattr(self, "_wal_dir", "")
-            if wal_dir:
-                import shutil
+            for path in ([getattr(self, "_wal_dir", "")]
+                         + ([self._chaos_root] if self._chaos_root else [])):
+                if path:
+                    import shutil
 
-                shutil.rmtree(wal_dir, ignore_errors=True)
+                    shutil.rmtree(path, ignore_errors=True)
 
     def _run_inner(self) -> Dict:
         sc = self.sc
         node_ids = self._register_nodes()
         self._attach_subscribers()
+
+        # Chaos plane + continuous safety auditor (ISSUE 12): the
+        # exempt control pool is the out-of-band console — split/heal
+        # control and fingerprint/event audits must keep reaching a
+        # server its data plane can no longer talk to.
+        if sc.num_servers > 1 and (sc.chaos is not None or sc.audit):
+            from ..server.rpc import ConnPool
+            from .auditor import SafetyAuditor
+
+            self._chaos_pool = ConnPool()
+            self._chaos_pool.chaos_exempt = True
+            # Sweep cadence scales with the run: fingerprints hash the
+            # whole replicated core, so a big soak audits at a coarser
+            # interval than the smoke gate.
+            interval = float((sc.chaos or {}).get("audit_interval_s", 1.0))
+            self.auditor = SafetyAuditor(
+                self.server, self.follower_addrs, pool=self._chaos_pool,
+                interval=interval,
+                logger=self.logger.getChild("auditor"))
+            self.auditor.start()
 
         def spawn(fn, *args, name=""):
             t = threading.Thread(target=fn, args=args, daemon=True,
@@ -672,12 +876,17 @@ class LoadHarness:
         measure_start = self._start_t + sc.warmup_s
         measure_end = measure_start + sc.measure_s
         self._submit_end_t = measure_end
+        if sc.chaos is not None and sc.num_servers > 1:
+            self._chaos = _ChaosScheduler(self, sc.chaos,
+                                          self.logger.getChild("chaos"))
+            self._chaos.start()
         submitters = [spawn(self._submitter, c, name=f"lg-client-{c}")
                       for c in range(sc.num_clients)]
 
         for t in submitters:
             t.join(timeout=sc.warmup_s + sc.measure_s + 30.0)
         submit_done_t = time.monotonic()
+        self._submit_done_t = submit_done_t
 
         # Drain: bounded wait for the backlog to clear.
         drain_deadline = submit_done_t + sc.drain_s
@@ -691,6 +900,17 @@ class LoadHarness:
         report = self._assemble(measure_start, measure_end, drained_t,
                                 fanout)
         report["integrity"] = self._collect_integrity()
+        if self._chaos is not None:
+            # Heal anything still split BEFORE the auditor's converged
+            # cross-check (the check needs the cluster whole again).
+            self._chaos.stop()
+            report["chaos"] = self._chaos_report()
+        if self.auditor is not None:
+            report["auditor"] = self.auditor.finalize()
+            if report["auditor"]["violation_count"]:
+                self.logger.error(
+                    "SAFETY AUDITOR recorded %d violations",
+                    report["auditor"]["violation_count"])
         if self.follower_addrs:
             # Per-server scale-out telemetry, read over the wire while
             # the followers are still up.
@@ -716,6 +936,105 @@ class LoadHarness:
 
     # -- report ------------------------------------------------------------
 
+    def _chaos_report(self) -> Dict:
+        """Per-event recovery times: seconds from fault injection until
+        the 2s-rolling placed/s climbs back to ≥80% of the rate over
+        the 6s before the fault.  An event whose bound window runs past
+        the end of offered load is CENSORED (not observable), never
+        silently counted as recovered."""
+        spec = self._chaos.spec
+        bound = float(spec.get("recovery_bound_s", 30.0))
+        with self._l:
+            placed = list(self.placed_events)
+
+        def rate(t0: float, t1: float) -> float:
+            if t1 <= t0:
+                return 0.0
+            return sum(p for t, p in placed if t0 <= t < t1) / (t1 - t0)
+
+        observable_until = getattr(self, "_submit_done_t", 0.0)
+        events_out: List[Dict] = []
+        recs: List[float] = []
+        unrecovered = censored = 0
+        for ev in self._chaos.events:
+            item = {k: ev.get(k) for k in ("kind", "at_s", "target_addr",
+                                           "error") if ev.get(k) is not None}
+            t_f = ev.get("t")
+            if t_f is None:
+                events_out.append(item)
+                continue
+            for key, label in (("healed_t", "healed_after_s"),
+                               ("restarted_t", "restarted_after_s")):
+                if ev.get(key):
+                    item[label] = round(ev[key] - t_f, 2)
+            pre = rate(t_f - 6.0, t_f)
+            item["pre_rate_placed_per_s"] = round(pre, 1)
+            if pre < 1.0:
+                item["recovery_s"] = None
+                item["note"] = "no meaningful pre-fault load"
+                events_out.append(item)
+                continue
+            # Recovery = time until the rolling rate is back at target
+            # AND STAYS there for the rest of the observed horizon —
+            # the first-crossing definition lies when the fault's bite
+            # lags the injection (a partition takes a beat to starve
+            # the pipeline).  The horizon is clipped to the end of
+            # offered load: a dip the submitters' exit would explain
+            # censors the event instead of counting it unrecovered.
+            target = 0.8 * pre
+            horizon = min(t_f + bound, observable_until + 2.0)
+            samples = []
+            t = t_f
+            while t < horizon:
+                t += 0.25
+                samples.append((t, rate(t - 2.0, t)))
+            if not samples:
+                censored += 1
+                item["recovery_s"] = None
+                item["note"] = "censored: offered load ended at the fault"
+                events_out.append(item)
+                continue
+            item["min_rate_ratio"] = round(
+                min(r for _, r in samples) / pre, 2)
+            below = [t for t, r in samples if r < target]
+            if not below and horizon < t_f + bound:
+                # No dip observed, but the window was clipped: the bite
+                # can lag injection, so an unclipped window is required
+                # before claiming the cluster rode through.
+                censored += 1
+                item["recovery_s"] = None
+                item["note"] = "censored: offered load ended inside the bound"
+            elif not below:
+                # Surviving capacity absorbed it: never dipped past 20%
+                # anywhere in the full bound window.
+                recs.append(0.0)
+                item["recovery_s"] = 0.0
+                item["note"] = "rode through (never below 80% of pre-fault)"
+            elif below[-1] < samples[-1][0]:
+                rec = below[-1] + 0.25 - t_f
+                recs.append(rec)
+                item["recovery_s"] = round(rec, 2)
+            elif horizon < t_f + bound:
+                censored += 1
+                item["recovery_s"] = None
+                item["note"] = "censored: offered load ended inside the bound"
+            else:
+                unrecovered += 1
+                item["recovery_s"] = None
+            events_out.append(item)
+        recs.sort()
+
+        def pct(q: float):
+            return (round(recs[min(len(recs) - 1, int(q * len(recs)))], 2)
+                    if recs else None)
+
+        return {"spec": dict(spec), "events": events_out,
+                "recovered": len(recs), "unrecovered": unrecovered,
+                "censored": censored, "recovery_bound_s": bound,
+                "recovery_s": {"p50": pct(0.50), "p90": pct(0.90),
+                               "p99": pct(0.99),
+                               "max": round(recs[-1], 2) if recs else None}}
+
     def _codec_split(self) -> Dict:
         """Leader-side codec time-split for this leg: per-subsystem
         encode/decode seconds + frame counts, plus the codec-enabled
@@ -724,6 +1043,25 @@ class LoadHarness:
 
         delta = codec.stats_delta(getattr(self, "_codec_before", {}))
         out: Dict = {"enabled": codec.enabled()}
+        # ISSUE 12 satellite: the per-method msgpack-frame profile — the
+        # standing proof the reflection fallback only ever carries
+        # Status/Serf control chatter.  ``hot`` must be empty on a
+        # codec-negotiated cluster; the chaos gate asserts it.
+        before = getattr(self, "_msgpack_methods_before", {})
+        methods = {m: n - before.get(m, 0)
+                   for m, n in codec.msgpack_methods().items()
+                   if n - before.get(m, 0) > 0}
+        if methods:
+            out["msgpack_methods"] = dict(sorted(
+                methods.items(), key=lambda kv: -kv[1])[:12])
+        # The hot-method invariant is scoped to codec fleets: under the
+        # NOMAD_TPU_CODEC=0 kill switch EVERYTHING lawfully rides
+        # msgpack, so the gate (and the renderer's LEAKED banner) must
+        # not fire there.
+        out["hot_msgpack_methods"] = ({
+            m: n for m, n in methods.items()
+            if m.startswith(codec.HOT_METHOD_PREFIXES)}
+            if codec.enabled() else {})
         for sub in ("rpc", "raft", "snapshot"):
             d = delta.get(sub) or {}
             if not (d.get("encodes") or d.get("decodes")):

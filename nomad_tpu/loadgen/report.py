@@ -117,6 +117,12 @@ def _render_single(r: Dict, out: TextIO, indent: str = "") -> None:
               f"{d['encodes']} frames, decode {d['decode_s']}s/"
               f"{d['decodes']} frames, {d['fallbacks']} fallbacks "
               f"({'struct-codec' if cd.get('enabled') else 'msgpack'})")
+    mm = cd.get("msgpack_methods") or {}
+    if mm:
+        hot = cd.get("hot_msgpack_methods") or {}
+        w(f"codec msgpack residue: {sum(mm.values())} frames over "
+          f"{len(mm)} methods ({', '.join(list(mm)[:4])}…) — "
+          f"{'HOT METHODS LEAKED: ' + str(hot) if hot else 'control-plane only'}")
     integ = r.get("integrity") or {}
     if integ:
         w(f"integrity: {integ['jobs_checked']} jobs checked, "
@@ -134,5 +140,32 @@ def _render_single(r: Dict, out: TextIO, indent: str = "") -> None:
           f"(rtt p50={rtt.get('p50')} p99={rtt.get('p99')}ms), "
           f"snapshot lag p95={lag.get('p95')} entries, "
           f"{f['lag_handbacks']} lag handbacks")
+    chaos = r.get("chaos") or {}
+    if chaos:
+        rec = chaos.get("recovery_s") or {}
+        w(f"chaos: {len(chaos.get('events', []))} events "
+          f"({chaos.get('recovered')} recovered, "
+          f"{chaos.get('unrecovered')} unrecovered, "
+          f"{chaos.get('censored')} censored) — recovery p50={rec.get('p50')}s "
+          f"p90={rec.get('p90')}s max={rec.get('max')}s "
+          f"(bound {chaos.get('recovery_bound_s')}s)")
+        for ev in chaos.get("events", []):
+            w(f"  {ev.get('kind'):>9} @ {ev.get('at_s')}s {ev.get('target_addr', '')}"
+              f" pre={ev.get('pre_rate_placed_per_s')}/s"
+              f" recovery={ev.get('recovery_s')}s"
+              + (f" [{ev['note']}]" if ev.get("note") else "")
+              + (f" ERROR {ev['error']}" if ev.get("error") else ""))
+    aud = r.get("auditor") or {}
+    if aud:
+        checks = aud.get("checks") or {}
+        w(f"auditor: {aud.get('violation_count')} violations — "
+          f"{checks.get('sweeps')} sweeps, "
+          f"{checks.get('fingerprint_samples')} fingerprint samples "
+          f"({checks.get('fingerprint_matches')} cross-server matches), "
+          f"{aud.get('acked_checked', 0)} acked evals audited, "
+          f"{checks.get('events_seen')} leader + "
+          f"{checks.get('follower_events_seen')} follower events")
+        for v in (aud.get("violations") or [])[:8]:
+            w(f"  VIOLATION +{v['t']}s {v['kind']}: {v['detail']}")
     for tr in r.get("slow_tail_traces", []):
         w(f"slow tail: {tr['submit_to_running_ms']}ms {tr['trace']}")

@@ -98,6 +98,27 @@ class Scenario:
     # follower reads work) but quorum, and therefore plan-commit
     # latency, stays pinned to the voter set.
     follower_voting: bool = False
+    # Continuous safety auditor (ISSUE 12): leader event-stream +
+    # per-server fingerprint/event polls asserting no double placement,
+    # no dup names, no overcommit, no lost acked eval, monotonic
+    # indexes, and identical committed-prefix FSM digests.  Auto-armed
+    # whenever a chaos spec is present.
+    audit: bool = False
+    # Cluster chaos plane (ISSUE 12): a seeded scheduler interleaves
+    # SIGKILL+restart of follower subprocesses and split/heal network
+    # partitions with the offered load.  Keys (all optional):
+    #   seed              — chaos timeline RNG seed (default: scenario
+    #                       seed)
+    #   kills             — follower crash-restarts (default 1)
+    #   partitions        — split/heal cycles (default 2)
+    #   partition_s       — seconds a split holds (default 4.0)
+    #   restart_delay_s   — crash → respawn gap (default 1.0)
+    #   start_offset_s    — first event offset into the run (default 6)
+    #   spacing_s         — gap between events (default 9.0)
+    #   recovery_bound_s  — placed/s must return to ≥80% of the
+    #                       pre-fault rate within this window (30.0)
+    #   audit_interval_s  — auditor sweep/fingerprint cadence (1.0)
+    chaos: Optional[Dict] = None
     # Determinism.
     seed: int = 42
 
@@ -209,9 +230,64 @@ MULTI_SERVER = Scenario(
     num_servers=3, leader_workers=2, follower_workers=8,
     follower_voting=False, seed=42)
 
+#: Cluster chaos soak (ISSUE 12): 1 leader + 2 follower-scheduler
+#: subprocesses (each with a persistent raft data dir) under sustained
+#: offered load while the seeded chaos scheduler SIGKILLs-and-restarts
+#: a follower and splits/heals leader↔follower partitions.  The
+#: continuous safety auditor runs throughout; the acceptance bar is
+#: ZERO violations — no double placement, no dup names, no overcommit,
+#: no lost acked eval, no FSM-prefix divergence — with recovery-time
+#: percentiles (placed/s back to ≥80% of pre-fault inside the bound)
+#: recorded in LOADGEN_r05.json.  Job mix stays small (count 1-2) so
+#: the auditor's fingerprint sweeps stay cheap against the state size.
+CHAOS_SOAK = Scenario(
+    name="chaos_soak",
+    num_nodes=400, node_cpu=64_000, node_memory_mb=262_144,
+    # Offered load spans the WHOLE measure window (3600 = 60/s × 60s):
+    # recovery is judged against a sustained rate, so load ending
+    # before a fault's bound would censor its recovery measurement.
+    num_clients=4, arrival_rate=60.0, max_submissions=3600,
+    job_mix=[JobShape(weight=6, count=1, cpu=100, memory_mb=128,
+                      priority=50),
+             JobShape(weight=3, count=2, cpu=200, memory_mb=256,
+                      priority=60),
+             JobShape(weight=1, count=4, cpu=200, memory_mb=256,
+                      priority=70)],
+    update_fraction=0.1,
+    warmup_s=2.0, measure_s=60.0, drain_s=120.0,
+    subscribers=16, min_heartbeat_ttl=30.0,
+    num_workers=4, num_servers=3, leader_workers=1, follower_workers=4,
+    follower_voting=False, audit=True,
+    chaos={"seed": 7, "kills": 1, "partitions": 2, "partition_s": 4.0,
+           "restart_delay_s": 1.0, "start_offset_s": 6.0,
+           "spacing_s": 9.0, "recovery_bound_s": 30.0,
+           "audit_interval_s": 2.0},
+    seed=42)
+
+#: Fixed-seed tier-1 chaos gate: one partition cycle + one real
+#: subprocess kill/restart against a 2-server cluster under light
+#: bounded load — small enough for the fast tier, real enough to drive
+#: the whole kill→recover→audit machinery end to end.
+CHAOS_SMOKE = Scenario(
+    name="chaos_smoke",
+    num_nodes=60, node_cpu=64_000, node_memory_mb=262_144,
+    num_clients=2, arrival_rate=40.0, max_submissions=640,
+    job_mix=[JobShape(weight=3, count=1, cpu=100, memory_mb=128,
+                      priority=50),
+             JobShape(weight=1, count=2, cpu=200, memory_mb=256,
+                      priority=60)],
+    warmup_s=1.0, measure_s=16.0, drain_s=60.0,
+    subscribers=8, min_heartbeat_ttl=30.0,
+    num_workers=2, num_servers=2, leader_workers=1, follower_workers=2,
+    follower_voting=False, audit=True,
+    chaos={"seed": 11, "kills": 1, "partitions": 1, "partition_s": 2.5,
+           "restart_delay_s": 0.5, "start_offset_s": 3.0,
+           "spacing_s": 6.0, "recovery_bound_s": 25.0},
+    seed=23)
+
 BUILTIN_SCENARIOS: Dict[str, Scenario] = {
     sc.name: sc for sc in (SMOKE, BASELINE, OVERLOAD_10X, FANOUT_10K,
-                           MULTI_SERVER)}
+                           MULTI_SERVER, CHAOS_SOAK, CHAOS_SMOKE)}
 
 
 def get_scenario(name: str) -> Scenario:

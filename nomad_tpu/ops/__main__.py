@@ -1116,6 +1116,136 @@ def _counter_total(server, key: str) -> int:
     return int((sink.latest().get("CounterTotals") or {}).get(key, 0))
 
 
+def chaos_drill(seed: int = 0, log=print) -> bool:
+    """Cluster chaos drill (ISSUE 12): a 3-voter in-process cluster
+    under the safety auditor — partition a follower (both directions
+    via the net plane), commit writes it cannot see, verify it lags,
+    heal, verify catch-up, and finish with the auditor's converged
+    fingerprint cross-check at ZERO violations."""
+    import os
+    import time
+
+    from .. import fault
+    from ..loadgen.auditor import SafetyAuditor
+    from ..server import Server, ServerConfig
+    from ..server.rpc import ConnPool
+    from ..structs import structs as s
+
+    def check(cond, msg):
+        if not cond:
+            log(f"chaos drill: FAIL — {msg}")
+        return cond
+
+    def wait_until(pred, timeout=30.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return True
+            time.sleep(0.02)
+        return pred()
+
+    def make_job(jid):
+        return s.Job(
+            region="global", id=jid, name=jid, type=s.JOB_TYPE_SERVICE,
+            priority=50, datacenters=["dc1"],
+            task_groups=[s.TaskGroup(
+                name="tg", count=1,
+                ephemeral_disk=s.EphemeralDisk(size_mb=10),
+                tasks=[s.Task(name="t", driver="exec",
+                              config={"command": "/bin/date"},
+                              resources=s.Resources(cpu=100,
+                                                    memory_mb=128),
+                              log_config=s.LogConfig())])])
+
+    # Slowed elections: a partitioned VOTER must not campaign during
+    # the short split (term inflation would turn the drill into an
+    # election-churn test).
+    saved = {k: os.environ.get(k) for k in
+             ("NOMAD_TPU_RAFT_ELECTION_MIN_S",
+              "NOMAD_TPU_RAFT_ELECTION_MAX_S", "NOMAD_TPU_EVENTS")}
+    os.environ["NOMAD_TPU_RAFT_ELECTION_MIN_S"] = "8.0"
+    os.environ["NOMAD_TPU_RAFT_ELECTION_MAX_S"] = "12.0"
+    os.environ["NOMAD_TPU_EVENTS"] = "1"
+    servers = []
+    auditor = None
+    pool = ConnPool()
+    pool.chaos_exempt = True
+    try:
+        first = None
+        for i in range(3):
+            srv = Server(ServerConfig(
+                node_name=f"chaos-s{i + 1}", enable_rpc=True,
+                bootstrap_expect=3, start_join=[first] if first else [],
+                num_schedulers=0, min_heartbeat_ttl=60.0))
+            if first is None:
+                first = srv.config.rpc_advertise
+            servers.append(srv)
+        for srv in servers:
+            srv.start()
+        if not check(wait_until(lambda: any(
+                x.is_leader() and x.raft.is_raft_leader()
+                for x in servers)), "no leader elected"):
+            return False
+        leader = next(x for x in servers if x.is_leader())
+        victim = next(x for x in servers if x is not leader)
+        if not check(wait_until(lambda: all(
+                len(x.raft.peers) == 3 for x in servers)),
+                "voter config did not converge"):
+            return False
+
+        auditor = SafetyAuditor(
+            leader, [x.config.rpc_advertise for x in servers
+                     if x is not leader],
+            pool=pool, interval=0.25)
+        auditor.start()
+        leader.job_register(make_job("chaos-pre"))
+        if not check(wait_until(lambda: victim.state.job_by_id(
+                None, "chaos-pre") is not None),
+                "pre-partition write did not replicate"):
+            return False
+
+        # Split (both directions: every in-process pool is stamped).
+        fault.net_partition("drill", [[leader.config.rpc_advertise],
+                                      [victim.config.rpc_advertise]])
+        leader.job_register(make_job("chaos-during"))
+        time.sleep(0.8)
+        if not check(victim.state.job_by_id(None, "chaos-during") is None,
+                     "partitioned follower saw a write it cannot have"):
+            return False
+        fault.net_heal("drill")
+        if not check(wait_until(lambda: victim.state.job_by_id(
+                None, "chaos-during") is not None, timeout=20.0),
+                "healed follower did not catch up"):
+            return False
+        report = auditor.finalize()
+        trace = fault.net().trace()
+        if not (check(report["violation_count"] == 0,
+                      f"auditor violations: {report['violations']}")
+                and check(report["checks"]["fingerprint_matches"] >= 1,
+                          "no cross-server fingerprint match recorded")
+                and check(("net.partition", "drill", "split") in trace
+                          and ("net.partition", "drill", "heal") in trace,
+                          f"partition trace incomplete: {trace}")):
+            return False
+    finally:
+        if auditor is not None:
+            auditor.stop()
+        fault.net_disarm()
+        pool.close()
+        for srv in servers:
+            srv.shutdown()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    log("chaos drill: OK — partitioned follower blinded then healed and "
+        "caught up, auditor recorded 0 violations with "
+        f"{report['checks']['fingerprint_matches']} cross-server "
+        "fingerprint matches")
+    return True
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="python -m nomad_tpu.ops")
     parser.add_argument("--selfcheck", action="store_true",
@@ -1145,6 +1275,7 @@ def main(argv=None) -> int:
     ok = wal_drill(seed=args.seed) and ok
     ok = fused_drill(seed=args.seed) and ok
     ok = follower_drill(seed=args.seed) and ok
+    ok = chaos_drill(seed=args.seed) and ok
     ok = mesh_drill(seed=args.seed) and ok
     return 0 if ok else 1
 

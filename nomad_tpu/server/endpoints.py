@@ -17,10 +17,13 @@ RPC port in this build.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict
 
+from .. import fault
 from ..api.codec import ensure, ensure_list
 from ..structs import structs as s
+from . import event_broker as event_stream
 from .raft import NotLeaderError
 from .rpc import NoLeaderError
 
@@ -90,11 +93,64 @@ def register_endpoints(server, rpc) -> None:
     def status_broker_stats(body):
         return server.broker_stats()
 
+    def status_fingerprint(body):
+        """Committed-prefix FSM digest (ISSUE 12): the safety auditor
+        polls every server and flags any index that ever maps to two
+        different fingerprints — replicated-state divergence, the bug
+        class raft is supposed to make impossible."""
+        index, fp = server.fsm_fingerprint()
+        return {"Index": index, "Fingerprint": fp,
+                "AppliedIndex": server.raft.applied_index_relaxed()}
+
+    def event_since(body):
+        """Poll-based event-stream tail for RPC-only servers (the
+        auditor's per-follower feed; the HTTP agent's /v1/event/stream
+        remains the streaming surface).  Returns buffered events with
+        index > MinIndex, oldest first, capped at Max."""
+        min_index = int(body.get("MinIndex", 0) or 0)
+        cap = max(1, min(int(body.get("Max", 256) or 256), 2048))
+        broker = server.event_broker
+        events = [ev for ev in broker.buffered() if ev.index > min_index]
+        out = [{"Topic": ev.topic, "Type": ev.type, "Key": ev.key,
+                "Index": ev.index, "Payload": ev.payload or {},
+                "EvalID": ev.eval_id} for ev in events[:cap]]
+        return {"Events": out, "Latest": broker.latest_index(),
+                "Armed": event_stream.armed()}
+
     rpc.register("Status.Ping", status_ping)
     rpc.register("Status.Leader", status_leader)
     rpc.register("Status.Peers", status_peers)
     rpc.register("Status.Metrics", status_metrics)
     rpc.register("Status.BrokerStats", status_broker_stats)
+    rpc.register("Status.Fingerprint", status_fingerprint)
+    rpc.register("Event.Since", event_since)
+
+    # -- Chaos control plane (ISSUE 12, gated) -----------------------------
+    # Registered only under NOMAD_TPU_CHAOS=1: the loadgen harness
+    # spawns follower subprocesses with it so the chaos scheduler can
+    # split/heal the follower's OWN side of a partition over an exempt
+    # control pool — never part of a production server's wire surface.
+
+    if os.environ.get("NOMAD_TPU_CHAOS", "").strip().lower() in (
+            "1", "true", "yes"):
+        def chaos_set_net(body):
+            plane = fault.net()
+            for p in body.get("Partitions") or []:
+                plane.partition(p["Name"], p["Groups"],
+                                windows=p.get("Windows"))
+            for name in body.get("Heal") or []:
+                plane.heal(name)
+            if body.get("HealAll"):
+                plane.heal()
+            return {"Active": plane.active_partitions()}
+
+        def chaos_status(body):
+            plane = fault.net()
+            return {"Active": plane.active_partitions(),
+                    "Trace": [list(t) for t in plane.trace()[-64:]]}
+
+        rpc.register("Chaos.SetNet", chaos_set_net)
+        rpc.register("Chaos.Status", chaos_status)
 
     # -- Serf-lite membership ---------------------------------------------
 

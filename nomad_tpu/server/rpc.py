@@ -310,6 +310,13 @@ class RPCServer:
             except (TransportError, ConnectionError, OSError, ValueError):
                 return
             self.metrics.incr_counter("rpc.request")
+            if not req_binary:
+                # Per-method msgpack-frame accounting (ISSUE 12
+                # satellite): the residual reflection traffic must be
+                # provably Status/Serf control chatter, never a hot
+                # scheduling method — codec.msgpack_methods() is the
+                # profile `bench --check` and the soak report read.
+                codec.note_msgpack_method(method)
             fn = self.methods.get(method)
             if fn is None:
                 # Unknown methods are rejected traffic, not silence.
@@ -457,13 +464,35 @@ class ConnPool:
     (or dials a new one) and returns it afterwards, so a long-poll holding
     one connection cannot starve short calls — the role yamux stream
     multiplexing plays in the reference (pool.go getClient + yamux
-    Session.Open)."""
+    Session.Open).
+
+    Chaos surface (ISSUE 12): every call passes the ``net.send`` fault
+    point and every fresh dial the ``net.dial`` point, carrying
+    ``(local_addr, addr)`` so named partition groups and asymmetric
+    src/dst rules apply — this single seam covers the Nomad channel AND
+    the MultiRaft replication transport (raft replication rides
+    ``pool.call`` too).  ``local_addr`` is stamped by the owning Server
+    with its advertised address; pools without an identity (clients)
+    match only ``*`` patterns.  ``chaos_exempt`` pools bypass the plane
+    entirely — the harness's control/audit channel, which must reach a
+    "partitioned" server the way an out-of-band console would.
+    """
 
     MAX_IDLE_PER_KEY = 4
+    # Per-address dial backoff (redial-storm fix): a dead peer's
+    # dials fail instantly (connection refused), so every retry round —
+    # replicators, elections, RemoteServerRPC walks — used to hammer it
+    # with a fresh socket.  Failures now arm a shared jittered Backoff
+    # per address; while it holds, dials fail fast LOCALLY (DialError,
+    # no socket) and the cap bounds how stale the gate can get.
+    DIAL_BACKOFF_BASE = 0.05
+    DIAL_BACKOFF_MAX = 2.0
 
     def __init__(self, timeout: float = 10.0, tls_context=None):
         self.timeout = timeout
         self.tls_context = tls_context
+        self.local_addr = ""       # stamped by the owning Server
+        self.chaos_exempt = False  # control/audit pools bypass the plane
         self._idle: Dict[Tuple[str, int], List[_Conn]] = {}
         self._lock = threading.Lock()
         # Addresses that refused the codec handshake (old builds /
@@ -471,8 +500,53 @@ class ConnPool:
         # straight to the legacy channel — per-connection negotiation,
         # paid once per address.
         self._legacy_addrs: set = set()
+        # addr -> (Backoff, not_before_monotonic)
+        self._dial_gate: Dict[str, list] = {}
+
+    def _net_check(self, kind: str, addr: str) -> None:
+        """Partition/rule verdict for one dial or call.  Blocked traffic
+        surfaces as DialError: the request was never sent, so every
+        retry path may safely go elsewhere (the same guarantee a real
+        unreachable peer gives)."""
+        if self.chaos_exempt:
+            return
+        act = fault.netpoint(kind, self.local_addr, addr)
+        if act is None:
+            return
+        action, delay = act
+        if action == "drop":
+            raise DialError(
+                f"rpc to {addr} failed: network partitioned (injected)")
+        if delay > 0:
+            time.sleep(delay)
 
     def _dial(self, addr: str, channel: int, timeout: float) -> _Conn:
+        self._net_check("dial", addr)
+        now = time.monotonic()
+        with self._lock:
+            gate = self._dial_gate.get(addr)
+            if gate is not None and now < gate[1]:
+                raise DialError(
+                    f"rpc to {addr} failed: in dial backoff for another "
+                    f"{gate[1] - now:.2f}s after {gate[0].attempt} "
+                    "consecutive dial failures")
+        try:
+            conn = self._dial_raw(addr, channel, timeout)
+        except OSError:
+            from ..utils.backoff import Backoff
+            with self._lock:
+                gate = self._dial_gate.get(addr)
+                if gate is None:
+                    gate = [Backoff(base=self.DIAL_BACKOFF_BASE,
+                                    max_delay=self.DIAL_BACKOFF_MAX), 0.0]
+                    self._dial_gate[addr] = gate
+                gate[1] = time.monotonic() + gate[0].next_delay()
+            raise
+        with self._lock:
+            self._dial_gate.pop(addr, None)
+        return conn
+
+    def _dial_raw(self, addr: str, channel: int, timeout: float) -> _Conn:
         if (channel == RPC_NOMAD and codec.enabled()
                 and addr not in self._legacy_addrs):
             try:
@@ -494,6 +568,7 @@ class ConnPool:
     def call(self, addr: str, method: str, body: Any,
              channel: int = RPC_NOMAD, timeout: Optional[float] = None) -> Any:
         timeout = timeout if timeout is not None else self.timeout
+        self._net_check("send", addr)
         key = (addr, channel)
         with self._lock:
             bucket = self._idle.get(key)
@@ -531,6 +606,24 @@ class ConnPool:
                 bucket.append(conn)
                 return
         conn.close()
+
+    def invalidate(self, addr: str) -> None:
+        """Drop every idle connection to ``addr`` (all channels), clear
+        its dial gate, and un-pin any legacy-msgpack demotion: a peer
+        KNOWN to have restarted leaves only dead sockets in the pool
+        (draining them one TransportError at a time wastes a failed
+        call per conn), and a zero-byte EOF its death raced into the
+        codec handshake must not demote its codec-capable successor to
+        msgpack for the pool's lifetime — the next dial re-probes."""
+        with self._lock:
+            dead = [conn for key, bucket in self._idle.items()
+                    if key[0] == addr for conn in bucket]
+            for key in [k for k in self._idle if k[0] == addr]:
+                del self._idle[key]
+            self._dial_gate.pop(addr, None)
+            self._legacy_addrs.discard(addr)
+        for conn in dead:
+            conn.close()
 
     def close(self) -> None:
         with self._lock:

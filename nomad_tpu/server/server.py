@@ -14,6 +14,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from .. import fault
 from ..structs import structs as s
 from ..utils import tracing
 from ..utils.telemetry import Telemetry
@@ -192,6 +193,24 @@ class Server:
                             if self.config.rpc_bind != "0.0.0.0"
                             else "127.0.0.1")
             self.config.rpc_advertise = f"{adv_host}:{self.rpc.port}"
+            # Chaos identity (ISSUE 12): the pool carries this server's
+            # advertised address so named partition groups and
+            # asymmetric net rules can tell its traffic apart — one
+            # process hosting several servers enforces a partition on
+            # every side it owns.
+            self.pool.local_addr = self.config.rpc_advertise
+        # Subprocess chaos arming: a follower child spawned into a
+        # partition/flap scenario arms its own net plane from the env
+        # (the parent can also drive it live over Chaos.SetNet).
+        chaos_spec = os.environ.get("NOMAD_TPU_CHAOS_NET", "").strip()
+        if chaos_spec and not fault.net_armed():
+            import json as _json
+
+            try:
+                fault.net_arm(_json.loads(chaos_spec))
+            except (ValueError, KeyError) as e:
+                self.logger.warning(
+                    "ignoring malformed NOMAD_TPU_CHAOS_NET: %s", e)
 
         # Consensus (server.go:257 setupRaft): multi-server raft when
         # clustering is configured, else the single-voter WAL / in-memory
@@ -595,6 +614,31 @@ class Server:
     @property
     def state(self):
         return self.fsm.state
+
+    # -- chaos/audit surface (ISSUE 12) ------------------------------------
+
+    def consistent_snapshot(self):
+        """A copy-on-write state snapshot taken at a raft ENTRY
+        boundary: the raft lock serializes with the applier (MultiRaft
+        applies committed chunks under it), so a multi-write apply like
+        APPLY_PLAN_RESULTS can never be observed half-landed.  The
+        snapshot itself is O(1); everything expensive happens on the
+        immutable copy afterwards."""
+        lock = getattr(self.raft, "_l", None)
+        if lock is not None:
+            with lock:
+                return self.state.snapshot()
+        return self.state.snapshot()
+
+    def fsm_fingerprint(self) -> Tuple[int, str]:
+        """(committed-prefix index, state digest) for the safety
+        auditor's cross-server check.  The index label is the
+        snapshot's own latest write index — internally consistent with
+        the hashed content by construction, and equal across servers
+        that applied the same prefix (entries that never touch the
+        store don't bump it on any server)."""
+        snap = self.consistent_snapshot()
+        return snap.latest_index(), snap.fingerprint()
 
     # -- leadership --------------------------------------------------------
 

@@ -521,6 +521,34 @@ class StateStore:
         with self._lock:
             return max(self._indexes.values(), default=0)
 
+    def fingerprint(self) -> str:
+        """Deterministic digest of the REPLICATED core state (nodes,
+        jobs, allocs, evals) — two FSMs that applied the same committed
+        log prefix must return the same hex string (the ISSUE 12 safety
+        auditor's cross-server divergence check).  Only fields that ride
+        the log are hashed: everything here is stamped by a raft apply,
+        never by leader-local clocks or broker bookkeeping.  Call on a
+        consistent snapshot (Server.consistent_snapshot) so a
+        mid-entry read cannot manufacture a false divergence."""
+        import hashlib
+
+        h = hashlib.sha256()
+
+        def w(*parts) -> None:
+            h.update("\x1f".join(str(p) for p in parts).encode())
+            h.update(b"\x1e")
+
+        for n in sorted(self.nodes(None), key=lambda x: x.id):
+            w("node", n.id, n.status, int(n.drain), n.modify_index)
+        for j in sorted(self.jobs(None), key=lambda x: x.id):
+            w("job", j.id, int(j.stop), j.version, j.modify_index)
+        for a in sorted(self.allocs(None), key=lambda x: x.id):
+            w("alloc", a.id, a.name, a.job_id, a.node_id, a.task_group,
+              a.desired_status, a.client_status, a.modify_index)
+        for e in sorted(self.evals(None), key=lambda x: x.id):
+            w("eval", e.id, e.status, e.job_id, e.modify_index)
+        return h.hexdigest()
+
     def _notify(self) -> None:
         with self._cond:
             self._cond.notify_all()

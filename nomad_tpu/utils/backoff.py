@@ -44,8 +44,14 @@ class Backoff:
         self.attempt = 0
 
     def next_delay(self) -> float:
-        """The delay to sleep before the next retry; advances state."""
-        ceiling = min(self.max_delay, self.base * (self.factor ** self.attempt))
+        """The delay to sleep before the next retry; advances state.
+        The exponent is clamped: a long-idle loop that calls this for
+        hours must keep getting the cap, not an OverflowError once
+        ``factor ** attempt`` leaves float range (found by the ISSUE 12
+        chaos soak — the overflow silently killed idle worker threads
+        mid-run)."""
+        ceiling = min(self.max_delay,
+                      self.base * (self.factor ** min(self.attempt, 64)))
         self.attempt += 1
         if self.jitter <= 0:
             return ceiling

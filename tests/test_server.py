@@ -311,9 +311,16 @@ class TestRaftPersistence:
         try:
             srv.node_register(make_node())
             job = make_job(2)
-            srv.job_register(job)
+            _, eval_id = srv.job_register(job)
             assert wait_until(
                 lambda: len(srv.state.allocs_by_job(None, job.id, True)) == 2)
+            # Quiesce before sampling: the worker's eval-complete
+            # EVAL_UPDATE applies AFTER the placements become visible,
+            # and sampling mid-stream made the restart comparison flaky
+            # (replay legitimately recovered one more entry).
+            assert wait_until(
+                lambda: srv.state.eval_by_id(None, eval_id).status
+                == s.EVAL_STATUS_COMPLETE)
             applied = srv.raft.applied_index()
         finally:
             srv.shutdown()
