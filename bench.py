@@ -85,6 +85,36 @@ MESH_DEVICES = 8
 MESH_CHILD_ENV = "NOMAD_TPU_BENCH_MESH_CHILD"
 MESH_SEED = 20260804           # pinned: both engines must tie-break alike
 
+# config_mesh_10m (ISSUE 13): the raised scale ceiling — 10M NODES —
+# same forced-8-device subprocess and bit-identity contract.  Fewer,
+# larger jobs keep the per-(job, node) count matrix (the scan carry
+# that scales J × N) inside memory at this node count; 1M task-groups
+# still drive a full capacity-feedback commit loop.  The phase costs
+# ~10 minutes of build+compile+run wall time, so the trajectory round
+# and --check run it behind NOMAD_TPU_BENCH_MESH10M=1 (the recorded
+# BENCH_r*.json carries the measured point forward either way).
+MESH10M_N_NODES = 10_000_000
+MESH10M_N_JOBS = 10
+MESH10M_COUNT_PER_JOB = 100_000   # 1M task-groups total
+MESH10M_ENV = "NOMAD_TPU_BENCH_MESH10M"
+# Child-budget extension when the 10M phase is armed, and the slice of
+# it RESERVED for that phase while config_mesh (1M) runs first.
+# Measured: the 10M point costs ~620s end-to-end (294s cluster build +
+# 65s compile + 17s run + 37s single-chip reference + encode A/B).
+MESH10M_BUDGET_S = 2200
+MESH10M_RESERVE_S = 800
+
+# config_steady compile-cache ceiling (ISSUE 13): new placement-program
+# signatures minted across the 200-batch stream.  Steady state is ~2
+# (the cold delta-ship shape + the resident-hit shape); headroom for a
+# guard-forced full re-encode shape.
+COMPILE_BUDGET_STEADY = 6
+
+
+def mesh10m_enabled() -> bool:
+    flag = os.environ.get(MESH10M_ENV, "").strip().lower()
+    return flag not in ("", "0", "false", "no")
+
 
 def log(*args):
     print(*args, file=sys.stderr, flush=True)
@@ -835,11 +865,14 @@ def bench_steady(n_nodes: int = E_N_NODES, n_batches: int = 200,
         os.environ["NOMAD_TPU_RESIDENT"] = "1"
         on_jobs, batches = build_batches(n_batches)
         sched = TPUBatchScheduler(h.logger, h.snapshot(), h)
+        from nomad_tpu.ops import kernels as _kernels
+        compiles_before = _kernels.compile_signatures()
         t0 = time.monotonic()
         stats_list = sched.schedule_stream(
             batches, state_source=lambda: h.snapshot())
         on_elapsed = time.monotonic() - t0
         placed_on = total_placed(h, on_jobs)
+        batch_compiles = _kernels.compile_signatures() - compiles_before
 
         sink = InmemSink(interval=3600.0)
         for stt in stats_list:
@@ -885,6 +918,12 @@ def bench_steady(n_nodes: int = E_N_NODES, n_batches: int = 200,
             "ON p50/p95 are per-batch wall latencies inside the pipeline "
             "(they include interleaved neighbor host phases)"),
         "guard_runs": guard_runs, "guard_mismatches": mismatches,
+        # Compile-cache audit (ISSUE 13): NEW placement-program
+        # signatures minted across the whole ON stream — the steady
+        # state must hold a fixed handful of shapes (recompiles are the
+        # silent killer at 10M nodes); --check asserts the ceiling.
+        "batch_compiles": batch_compiles,
+        "compile_budget": COMPILE_BUDGET_STEADY,
         "acceptance_note": (
             "guarded on ABSOLUTE residency-on sustained placed/s (and "
             "guard mismatches == 0); the on/off ratio is context only — "
@@ -1578,6 +1617,8 @@ def _child_main():
     flush()
     if not budget_s:
         budget_s = DEGRADED_BUDGET_S if degraded else TOTAL_BUDGET_S
+    if mesh10m_enabled():
+        budget_s += MESH10M_BUDGET_S  # the opt-in 10M-node mesh point
     budget = _Budget(budget_s)
     # Median-of-3 for EVERY config phase (VERDICT r4 #9): the
     # shared-tenant timing noise applies to all shapes, and the kernel
@@ -1773,14 +1814,37 @@ def _child_main():
     # timeout; a squeeze skips it (the --check guard measures it fresh
     # either way).
     rem_mesh = budget.remaining()
-    if rem_mesh > 120:
-        cm = phase("config_mesh", int(rem_mesh - 15), bench_mesh,
-                   deadline_s=int(rem_mesh - 20))
+    mesh_budget = rem_mesh - (MESH10M_RESERVE_S if mesh10m_enabled()
+                              else 0)
+    if mesh_budget > 120:
+        cm = phase("config_mesh", int(mesh_budget - 15), bench_mesh,
+                   deadline_s=int(mesh_budget - 20))
         if cm is not None:
             detail["config_mesh"] = cm
     else:
         detail["config_mesh"] = {
             "skipped": f"global budget exhausted ({rem_mesh:.0f}s left)"}
+
+    # The raised scale ceiling (ISSUE 13): 10M nodes through the same
+    # forced-8-device subprocess, opt-in — the phase costs ~10 minutes
+    # (see MESH10M_ENV) and the child budget was extended to carry it.
+    if mesh10m_enabled():
+        rem10 = budget.remaining()
+        if rem10 > 240:
+            cm10 = phase("config_mesh_10m", int(rem10 - 15), bench_mesh,
+                         deadline_s=int(rem10 - 20),
+                         scale=(MESH10M_N_NODES, MESH10M_N_JOBS,
+                                MESH10M_COUNT_PER_JOB))
+            if cm10 is not None:
+                detail["config_mesh_10m"] = cm10
+        else:
+            detail["config_mesh_10m"] = {
+                "skipped": f"budget exhausted ({rem10:.0f}s left)"}
+    else:
+        detail["config_mesh_10m"] = {
+            "skipped": f"{MESH10M_ENV} not set (phase costs ~10min); "
+                       "latest recorded point rides the BENCH_r*.json "
+                       "baseline"}
 
     flush()
     # The parent assembles and prints the ONE JSON line (it may merge a
@@ -1858,7 +1922,7 @@ def _extract_baseline_numbers(doc: dict):
     import re
 
     ns = p95 = ce = steady = cf = ctl = ctl_p99 = mesh_rate = None
-    mesh_encode = snap_s = None
+    mesh_encode = snap_s = mesh10m_rate = None
     parsed = doc.get("parsed")
     if isinstance(parsed, dict):
         det = parsed.get("detail") or parsed
@@ -1879,6 +1943,8 @@ def _extract_baseline_numbers(doc: dict):
                        or {}).get("static_encode_columnar_s")
         snap_s = (det.get("config_snapshot") or {}).get(
             "snapshot_restore_s")
+        mesh10m_rate = (det.get("config_mesh_10m")
+                        or {}).get("sustained_placed_per_s")
     tail = doc.get("tail") or ""
     if ns is None:
         m = re.search(r'"config_northstar_10k_x_1m":\s*\{[^{}]*?'
@@ -1927,8 +1993,12 @@ def _extract_baseline_numbers(doc: dict):
                       r'"snapshot_restore_s":\s*([0-9.]+)', tail,
                       re.DOTALL)
         snap_s = float(m.group(1)) if m else None
+    if mesh10m_rate is None:
+        m = re.search(r'"config_mesh_10m":\s*\{[^{}]*?'
+                      r'"sustained_placed_per_s":\s*([0-9.]+)', tail)
+        mesh10m_rate = float(m.group(1)) if m else None
     return (ns, p95, ce, steady, cf, ctl, ctl_p99, mesh_rate,
-            mesh_encode, snap_s)
+            mesh_encode, snap_s, mesh10m_rate)
 
 
 def _latest_bench_baseline():
@@ -1936,7 +2006,7 @@ def _latest_bench_baseline():
     (name, ns_s, p95_ms, config_e_s, steady_placed_per_s,
     northstar_commit_fetch_s, control_evals_per_s,
     control_s2r_p99_ms, mesh_placed_per_s, mesh_encode_s,
-    snapshot_restore_s)."""
+    snapshot_restore_s, mesh10m_placed_per_s)."""
     import glob
 
     here = os.path.dirname(os.path.abspath(__file__))
@@ -1950,7 +2020,7 @@ def _latest_bench_baseline():
         nums = _extract_baseline_numbers(doc)
         if any(v is not None for v in nums):
             return (os.path.basename(path),) + nums
-    return (None,) * 11
+    return (None,) * 12
 
 
 def _loadgen_follower_baseline():
@@ -2004,7 +2074,7 @@ def _check_main(argv) -> int:
 
     (baseline_file, base_ns, base_p95, base_ce, base_steady, base_cf,
      base_ctl, base_ctl_p99, base_mesh, base_mesh_enc,
-     base_snap) = _latest_bench_baseline()
+     base_snap, base_mesh10m) = _latest_bench_baseline()
     out = {"check": "bench-regression", "baseline": baseline_file,
            "threshold": threshold}
     if baseline_file is None:
@@ -2121,9 +2191,48 @@ def _check_main(argv) -> int:
                 failures.append(
                     f"config_steady differential guard reported "
                     f"{sdy['guard_mismatches']} mismatches")
+            # Compile-cache ceiling (ISSUE 13): the whole stream must
+            # hold a fixed handful of placement-program shapes.
+            out["config_steady_batch_compiles"] = {
+                "current": sdy.get("batch_compiles"),
+                "budget": COMPILE_BUDGET_STEADY}
+            if sdy.get("batch_compiles", 0) > COMPILE_BUDGET_STEADY:
+                failures.append(
+                    f"config_steady stream minted "
+                    f"{sdy['batch_compiles']} placement-program "
+                    f"signatures (budget {COMPILE_BUDGET_STEADY}) — "
+                    "a shape leak recompiles at every scale")
         except Exception as exc:
             out["config_steady_placed_per_s"] = {"error": repr(exc)}
             failures.append(f"config_steady phase failed: {exc!r}")
+
+    # Preemption phase (ISSUE 13 satellite): config_preempt went dark in
+    # r06 (the bench recorded an error object and nothing gated on it).
+    # --check measures it fresh and FAILS LOUDLY on any error, plus the
+    # absolute gates: 100% kernel/oracle eviction-set agreement, real
+    # preemption placements, and the never-evict->=-priority invariant.
+    try:
+        with _deadline(240, "check_config_preempt"):
+            pre = bench_preempt()
+        out["config_preempt"] = {
+            "elapsed_s": pre["elapsed_s"],
+            "placed_via_preemption": pre["placed_via_preemption"],
+            "evicted_allocs": pre["evicted_allocs"],
+            "agreement_pct": pre["kernel_oracle_agreement_pct"]}
+        if pre["placed_via_preemption"] <= 0:
+            failures.append("config_preempt placed nothing via "
+                            "preemption — the phase did not exercise "
+                            "the eviction kernel")
+        if pre["kernel_oracle_agreement_pct"] < 100.0:
+            failures.append(
+                f"config_preempt kernel/oracle agreement "
+                f"{pre['kernel_oracle_agreement_pct']}% < 100%")
+        if not pre["no_eviction_of_priority_ge_placing"]:
+            failures.append("config_preempt evicted an alloc at >= the "
+                            "placing priority")
+    except Exception as exc:
+        out["config_preempt"] = {"error": repr(exc)}
+        failures.append(f"config_preempt phase failed: {exc!r}")
 
     # Control-plane throughput guard (ISSUE 7): sustained end-to-end
     # evals/s with M=4 stale-snapshot workers must not fall below
@@ -2333,6 +2442,44 @@ def _check_main(argv) -> int:
         out["config_mesh_placed_per_s"] = {"error": repr(exc)}
         failures.append(f"config_mesh phase failed: {exc!r}")
 
+    # The 10M-node ceiling (ISSUE 13): same contract as config_mesh —
+    # bit-identical to single-chip at the pinned seed (hard gate, no
+    # baseline needed) + sustained placed/s vs the latest recorded
+    # point.  Re-measured behind NOMAD_TPU_BENCH_MESH10M=1 (the phase
+    # costs ~10 minutes); skipped otherwise with the baseline echoed so
+    # the reader sees the recorded point either way.
+    if mesh10m_enabled():
+        try:
+            cm10 = bench_mesh(deadline_s=2400,
+                              scale=(MESH10M_N_NODES, MESH10M_N_JOBS,
+                                     MESH10M_COUNT_PER_JOB))
+            cur10 = float(cm10["sustained_placed_per_s"])
+            out["config_mesh_10m_placed_per_s"] = {
+                "baseline": base_mesh10m, "current": cur10,
+                "ratio": (round(cur10 / base_mesh10m, 3)
+                          if base_mesh10m else None)}
+            out["config_mesh_10m_score_delta_pct"] = {
+                "current": cm10["score_delta_pct"], "budget_pct": 0.0,
+                "bit_identical": cm10["bit_identical_placements"]}
+            if not cm10["bit_identical_placements"]:
+                failures.append(
+                    f"config_mesh_10m placements diverged from the "
+                    f"single-chip path (score delta "
+                    f"{cm10['score_delta_pct']}%) — the mesh path must "
+                    "be exact")
+            if (base_mesh10m is not None
+                    and cur10 < base_mesh10m / threshold):
+                failures.append(
+                    f"config_mesh_10m sustained {cur10:.0f} placed/s is "
+                    f"below baseline {base_mesh10m:.0f}/{threshold}")
+        except Exception as exc:
+            out["config_mesh_10m_placed_per_s"] = {"error": repr(exc)}
+            failures.append(f"config_mesh_10m phase failed: {exc!r}")
+    else:
+        out["config_mesh_10m_placed_per_s"] = {
+            "skipped": f"{MESH10M_ENV} not set (phase costs ~10min)",
+            "baseline": base_mesh10m}
+
     out["failures"] = failures
     out["result"] = "fail" if failures else "ok"
     print(json.dumps(out), flush=True)
@@ -2356,6 +2503,8 @@ def main():
     import tempfile
 
     t_start = time.monotonic()
+    parent_deadline_s = PARENT_DEADLINE_S + (MESH10M_BUDGET_S + 60
+                                             if mesh10m_enabled() else 0)
 
     def elapsed():
         return time.monotonic() - t_start
@@ -2365,18 +2514,18 @@ def main():
     partial2 = ""
     try:
         proc = _spawn_child(partial)
-        rc, killed = _wait_or_kill(proc, PARENT_DEADLINE_S - 20)
+        rc, killed = _wait_or_kill(proc, parent_deadline_s - 20)
         detail = _read_partial(partial)
         probe_history = [{
             "at_s": 0, "stage": "bench-start",
             "platform": detail.get("platform_probe", "not-recorded")}]
         err = None
         if killed:
-            err = (f"bench child killed at {PARENT_DEADLINE_S - 20}s "
+            err = (f"bench child killed at {parent_deadline_s - 20}s "
                    "wall-clock backstop; detail holds completed phases")
             log("bench child exceeded hard deadline; emitting partials")
 
-        remaining = PARENT_DEADLINE_S - elapsed()
+        remaining = parent_deadline_s - elapsed()
         if detail.get("degraded") and remaining > 110:
             # Mid-round recovery probe: cheap, deadline-bounded, and in a
             # throwaway subprocess so a still-wedged chip costs one
@@ -2391,7 +2540,7 @@ def main():
                 fd2, partial2 = tempfile.mkstemp(
                     prefix="nomad_tpu_bench_tpu_", suffix=".json")
                 os.close(fd2)
-                remaining = PARENT_DEADLINE_S - elapsed()
+                remaining = parent_deadline_s - elapsed()
                 proc2 = _spawn_child(partial2, budget_s=remaining - 25,
                                      tpu_retry=True)
                 _, killed2 = _wait_or_kill(proc2, remaining - 10)

@@ -654,10 +654,10 @@ def fused_drill(seed: int = 0, log=print) -> bool:
         q = encode.quantize_resource_rows(cap, base_used)
         if not (check(q is not None, "bench-shape rows did not quantize")
                 and check(resident.check_quant_roundtrip(
-                              cap, q.cap_q, q.scale, what="capacity"),
+                              cap, q.cap_q, q.scale[0], what="capacity"),
                           "exact quantization failed the round-trip bound")
                 and check(np.array_equal(
-                              encode.dequantize_rows(q.used_q, q.scale),
+                              encode.dequantize_rows(q.used_q, q.scale[1]),
                               base_used),
                           "used baseline did not round-trip")):
             return False
@@ -666,7 +666,7 @@ def fused_drill(seed: int = 0, log=print) -> bool:
         corrupt = np.array(q.cap_q)
         corrupt[0, 0] += 1
         if not (check(not resident.check_quant_roundtrip(
-                          cap, corrupt, q.scale, breaker=bad_brk,
+                          cap, corrupt, q.scale[0], breaker=bad_brk,
                           what="capacity"),
                       "corrupted codebook passed the round-trip bound")
                 and check(resident.QUANT_MISMATCHES == 1,
@@ -716,6 +716,176 @@ def fused_drill(seed: int = 0, log=print) -> bool:
         "parity and no overcommit, quantized rows round-tripped exactly "
         "(corruption caught), corrupt fused buffer tripped the breaker "
         "and the oracle carried the batch")
+    return True
+
+
+def residue_drill(seed: int = 0, log=print) -> bool:
+    """Host-residue drill (ISSUE 13): the donated device-resident usage
+    mirror round-trips bit-identical to the host mirror across delta
+    batches (and produces the same placements as the sparse-delta upload
+    path at a pinned seed), the int8 quantization guard catches an
+    out-of-range dimension, and the native packed-result decode agrees
+    with its python twins on a seeded corpus."""
+    import os
+    import random
+
+    import numpy as np
+
+    from .. import mock
+    from ..scheduler import Harness
+    from ..structs import structs as s
+    from . import decode as decode_mod
+    from . import encode, resident
+    from .batch_sched import TPUBatchScheduler
+
+    def check(cond, msg):
+        if not cond:
+            log(f"residue drill: FAIL — {msg}")
+        return cond
+
+    saved = {k: os.environ.get(k) for k in
+             ("NOMAD_TPU_RESIDENT", "NOMAD_TPU_RESIDENT_DEVICE",
+              "NOMAD_TPU_RESIDENT_GUARD_EVERY", "NOMAD_TPU_RNG_SEED",
+              "NOMAD_TPU_DECODE_GUARD_EVERY")}
+    os.environ["NOMAD_TPU_RESIDENT"] = "1"
+    os.environ["NOMAD_TPU_RESIDENT_GUARD_EVERY"] = "1"
+    os.environ["NOMAD_TPU_RNG_SEED"] = str(1234567 + seed)
+    os.environ["NOMAD_TPU_DECODE_GUARD_EVERY"] = "1"
+    resident.reset_counters()
+    decode_mod.reset_counters()
+    try:
+        # 1. Donated round-trip parity: the same 4-batch stream through
+        # the donated device mirror and the sparse-delta upload path
+        # must place identically, and the device mirror must bit-match
+        # the host mirror after every donated apply.
+        def run_stream(device_mirror: bool):
+            os.environ["NOMAD_TPU_RESIDENT_DEVICE"] = (
+                "1" if device_mirror else "0")
+            resident.invalidate()
+            h = Harness()
+            for i in range(8):
+                node = mock.node()
+                # Pinned ids: the two streams build separate harnesses
+                # and their placements compare by node identity.
+                node.id = f"residue-node-{i:02d}"
+                node.name = node.id
+                node.resources.networks = []
+                node.reserved.networks = []
+                node.compute_class()
+                h.state.upsert_node(h.next_index(), node)
+            placements = []
+            for _ in range(4):
+                job = mock.job()
+                for tg in job.task_groups:
+                    for t in tg.tasks:
+                        t.resources.networks = []
+                job.task_groups[0].count = 2
+                h.state.upsert_job(h.next_index(), job)
+                ev = s.Evaluation(
+                    id=s.generate_uuid(), priority=job.priority,
+                    type=job.type,
+                    triggered_by=s.EVAL_TRIGGER_JOB_REGISTER,
+                    job_id=job.id, status=s.EVAL_STATUS_PENDING)
+                TPUBatchScheduler(h.logger, h.snapshot(), h
+                                  ).schedule_batch([ev])
+                placements.append(sorted(
+                    a.node_id for a in
+                    h.state.allocs_by_job(None, job.id, True)))
+            st = resident._STATE
+            dev_ok = True
+            if device_mirror:
+                dev_ok = (st is not None and st.used_dev is not None
+                          and np.array_equal(
+                              np.asarray(st.used_dev).astype(np.int64),
+                              st.used))
+            return placements, dev_ok
+
+        pl_dev, dev_ok = run_stream(True)
+        applies = resident.DEV_APPLIES
+        installs = resident.DEV_INSTALLS
+        pl_delta, _ = run_stream(False)
+        if not (check(installs == 1,
+                      f"expected ONE device-mirror install, got "
+                      f"{installs}")
+                and check(applies >= 3,
+                          f"donated delta applies did not run ({applies})")
+                and check(dev_ok,
+                          "device mirror diverged from the host mirror "
+                          "after donated applies")
+                and check(pl_dev == pl_delta,
+                          "donated-mirror placements differ from the "
+                          "delta-upload path")
+                and check(resident.DEV_GUARD_MISMATCHES == 0
+                          and resident.GUARD_MISMATCHES == 0,
+                          "mirror guards reported mismatches")):
+            return False
+
+        # 2. int8 guard: a scale codebook pushed out of range must fail
+        # the round-trip bound (exact-or-absent discipline).
+        cap = np.tile(np.array([4000, 8192, 102400, 150]), (8, 1))
+        q = encode.quantize_resource_rows(cap, np.zeros_like(cap))
+        if not (check(q is not None and q.cap_tag == "i8",
+                      f"bench-shape capacity did not quantize int8 "
+                      f"({None if q is None else q.cap_tag})")
+                and check(resident.check_quant_roundtrip(
+                              cap, q.cap_q, q.scale[0], what="capacity"),
+                          "exact int8 rows failed the round-trip bound")):
+            return False
+        bad_scale = np.array(q.scale[0])
+        bad_scale[1] <<= 1   # out-of-range dimension: dequant overshoots
+        if not check(not resident.check_quant_roundtrip(
+                         cap, q.cap_q, bad_scale, what="capacity"),
+                     "out-of-range scale dimension passed the guard"):
+            return False
+
+        # 3. Native-decode twin agreement on a seeded COO corpus (guard
+        # pinned at 1 above, so EVERY native call is twin-verified).
+        rng = random.Random(seed)
+        n_specs, n_real = 17, 203
+        rows_l, cols_l, cnt_l = [], [], []
+        for u in range(n_specs):
+            for _ in range(rng.randrange(0, 9)):
+                rows_l.append(u)
+                cols_l.append(rng.randrange(n_real))
+                cnt_l.append(rng.randrange(1, 4))
+        rows = np.array(rows_l, dtype=np.int32)
+        cols = np.array(cols_l, dtype=np.int32)
+        cnts = np.array(cnt_l, dtype=np.int32)
+        scores = np.array([rng.random() * 18 for _ in rows_l],
+                          dtype=np.float32)
+        coll = np.array([rng.randrange(0, 3) for _ in rows_l],
+                        dtype=np.int32)
+        off, exp = decode_mod.expand_coo(rows, cols, cnts, n_specs,
+                                         n_real, int(cnts.sum()))
+        ref_off, ref_exp = decode_mod._expand_twin(rows, cols, cnts,
+                                                   n_specs, n_real)
+        ls = decode_mod.last_scores(rows, cols, scores, coll, n_specs,
+                                    n_real)
+        ref_ls = decode_mod._last_scores_twin(rows, cols, scores, coll,
+                                              n_specs, n_real)
+        if not (check(np.array_equal(off, ref_off)
+                      and np.array_equal(exp, ref_exp),
+                      "native expand diverged from the numpy twin")
+                and check(all(np.array_equal(a, b)
+                              for a, b in zip(ls, ref_ls)),
+                          "native last-scores diverged from the twin")
+                and check(decode_mod.GUARD_MISMATCHES == 0,
+                          "decode guard reported mismatches")):
+            return False
+        native_note = ("native" if decode_mod.NATIVE_CALLS else
+                       "python-twin (toolchain unavailable)")
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        resident.reset_counters()
+        decode_mod.reset_counters()
+    log("residue drill: OK — donated mirror round-tripped bit-identical "
+        "(one install, in-place applies, placements == delta path), "
+        "out-of-range int8 scale caught by the round-trip guard, "
+        f"packed-result decode twins agree ({native_note})")
     return True
 
 
@@ -1274,6 +1444,7 @@ def main(argv=None) -> int:
     ok = codec_drill(seed=args.seed) and ok
     ok = wal_drill(seed=args.seed) and ok
     ok = fused_drill(seed=args.seed) and ok
+    ok = residue_drill(seed=args.seed) and ok
     ok = follower_drill(seed=args.seed) and ok
     ok = chaos_drill(seed=args.seed) and ok
     ok = mesh_drill(seed=args.seed) and ok

@@ -401,6 +401,15 @@ class TPUBatchScheduler:
         if resident.GUARD_MISMATCHES:
             m.set_gauge("batch.resident_guard_mismatches",
                         resident.GUARD_MISMATCHES)
+        if resident.DEV_GUARD_MISMATCHES:
+            m.set_gauge("batch.resident_dev_mismatches",
+                        resident.DEV_GUARD_MISMATCHES)
+        if resident.DEV_APPLIES:
+            m.set_gauge("batch.resident_dev_applies", resident.DEV_APPLIES)
+        # Compile-cache audit (ISSUE 13): distinct placement-program
+        # signatures seen process-wide — an upper bound on XLA compiles;
+        # bench --check asserts a ceiling over the config_steady stream.
+        m.set_gauge("batch.compiles", kernels.compile_signatures())
         if stats.mesh_shards:
             m.incr_counter("batch.mesh_passes", 1)
             m.set_gauge("batch.mesh_shards", stats.mesh_shards)
@@ -1083,6 +1092,25 @@ class TPUBatchScheduler:
             # Slot-record budget exceeded (pathological count skew):
             # degrade to the single-chip program below.
 
+        # Donated device-resident usage mirror (ISSUE 13): when the
+        # resident slot exactly matches this batch's (key, allocs
+        # index), the usage matrix is LOANED to the kernel as a donated
+        # argument instead of riding the dyn buffer as sparse deltas —
+        # the per-batch usage upload disappears and the mirror round-
+        # trips in place (the kernel returns the aliased buffer).
+        # Gated off on the mesh (per-shard mirrors keep the delta path)
+        # and the timing2 diagnostics split.
+        used_dev = None
+        res_key = snap_index = None
+        if (use_resident and self.mesh is None
+                and os.environ.get("NOMAD_TPU_TIMING") != "2"):
+            res_key = cache_key[:2] + (base.n_pad,)
+            snap_index = self.state.table_index("allocs")
+            used_dev = resident.take_device_used(res_key, snap_index,
+                                                 used)
+        if used_dev is not None:
+            del dyn["u_rows"], dyn["u_vals"]
+
         sbuf, meta_s = xfer.pack_host(static)
         dbuf, meta_d = xfer.pack_host(dyn)
         encode_seconds = time.monotonic() - t0
@@ -1098,34 +1126,20 @@ class TPUBatchScheduler:
         while len(_DEVICE_STATIC_CACHE) > 4:
             _DEVICE_STATIC_CACHE.pop(next(iter(_DEVICE_STATIC_CACHE)))
 
-        # Commit-score side-outputs: [U, M] commit-aligned slot buffers
-        # in slot mode (cheap), two [U, N] carries otherwise — beyond
-        # ~16M cells the HBM + compile cost of the matrix form outweighs
-        # score forensics (counts stay exact either way).
-        with_scores = st.u_pad * ct.n_pad <= 16_000_000
+        # Canonical shape-class plan (ISSUE 13 compile-cache audit): ONE
+        # pow2 bucketing for (U, slot record, COO capacity) shared with
+        # the mesh path — see encode.shape_plan for the slot-mode and
+        # score-carry rules (commit-score side-outputs: [U, M] commit-
+        # aligned slot buffers in slot mode, two [U, N] carries
+        # otherwise; slot mode builds the COO payload with one U×M pass
+        # instead of a nonzero over the U×N matrix).
         total_asks = int(sum(sp.count for sp in spec_list))
-        # Slot mode: the kernel records each commit's node index (and,
-        # with scores, its binpack score + collisions) into compact
-        # [U, M] matrices during the scan, so the COO payload is built
-        # with one U×M pass instead of a nonzero over the U×N matrix
-        # (0.5s → ~50ms at the 1024×10048 north-star shape).  The slot
-        # buffers are HBM-only (the link carries COO), so the budget is
-        # an HBM/compile bound, not a link bound.
-        slot_m = 0
-        if ct.n_pad <= 65536:
-            max_count = max((sp.count for sp in spec_list), default=1)
-            m_b = encode.pow2_bucket(max(8, max_count), minimum=8)
-            slot_bytes = 4 + (8 if with_scores else 0)
-            if st.u_pad * m_b * slot_bytes <= (64 << 20):
-                slot_m = m_b
-        # COO capacity: per-(spec, node) pairs on the matrix path, but
-        # per-ALLOC entries on the slot path (a node committed in two
-        # rounds appears twice), so slot mode sizes by total asks alone.
-        max_nnz = encode.pow2_bucket(
-            max(8, total_asks if slot_m
-                else min(total_asks, st.u_pad * ct.n_pad)), minimum=8)
+        max_count = max((sp.count for sp in spec_list), default=1)
+        with_scores, slot_m, max_nnz = encode.shape_plan(
+            st.u_pad, ct.n_pad, ct.n_real, max_count, total_asks)
         fused_buf = fused_meta = fused_overflow = None
         summary_buf = coo_mat = None
+        used_out = None
         if os.environ.get("NOMAD_TPU_TIMING") == "2":
             # Staged sync (diagnostics only): force the schedule program
             # to finish before compaction dispatch so the log splits
@@ -1135,8 +1149,9 @@ class TPUBatchScheduler:
             slot_m = 0
             from .kernels import _device_compact, _device_schedule
             t_s0 = time.monotonic()
-            result, feas = _device_schedule(
-                static_dev, jax.device_put(dbuf), meta_s=meta_s,
+            result, feas, _ = _device_schedule(
+                static_dev, jax.device_put(dbuf),
+                jnp.zeros((1, 4), dtype=jnp.int32), meta_s=meta_s,
                 meta_d=meta_d, u_pad=st.u_pad, n_pad=ct.n_pad,
                 with_networks=with_networks, with_dp=with_dp,
                 with_scores=with_scores)
@@ -1157,18 +1172,25 @@ class TPUBatchScheduler:
             # dispatch emitting ONE packed result buffer, fetched in a
             # single transfer by _fetch_device (the aux overflow source
             # stays device-resident, touched only on window overflow).
-            fused_buf, fused_aux, feas, fused_meta = kernels.fused_pass(
-                static_dev, jax.device_put(dbuf), meta_s=meta_s,
-                meta_d=meta_d, u_pad=st.u_pad, n_pad=ct.n_pad,
-                with_networks=with_networks, with_dp=with_dp,
-                with_scores=with_scores, max_nnz=max_nnz, slot_m=slot_m)
+            fused_buf, fused_aux, feas, fused_meta, used_out = \
+                kernels.fused_pass(
+                    static_dev, jax.device_put(dbuf), used_dev,
+                    meta_s=meta_s, meta_d=meta_d, u_pad=st.u_pad,
+                    n_pad=ct.n_pad, with_networks=with_networks,
+                    with_dp=with_dp, with_scores=with_scores,
+                    max_nnz=max_nnz, slot_m=slot_m)
             fused_overflow = ("slots" if slot_m else "coo", fused_aux)
         else:
-            summary_buf, coo_mat, feas = device_pass(
-                static_dev, jax.device_put(dbuf), meta_s=meta_s,
-                meta_d=meta_d, u_pad=st.u_pad, n_pad=ct.n_pad,
-                with_networks=with_networks, with_dp=with_dp,
-                with_scores=with_scores, max_nnz=max_nnz, slot_m=slot_m)
+            summary_buf, coo_mat, feas, used_out = device_pass(
+                static_dev, jax.device_put(dbuf), used_dev,
+                meta_s=meta_s, meta_d=meta_d, u_pad=st.u_pad,
+                n_pad=ct.n_pad, with_networks=with_networks,
+                with_dp=with_dp, with_scores=with_scores,
+                max_nnz=max_nnz, slot_m=slot_m)
+        if used_out is not None:
+            # The kernel aliased the donated mirror back out — return
+            # the loan so the next batch's delta apply lands in place.
+            resident.give_device_used(res_key, snap_index, used_out)
         # Device pass is dispatched (JAX async); the blocking fetch lives
         # in _fetch_device so a pipelining caller can overlap host work.
         return {
@@ -1190,21 +1212,21 @@ class TPUBatchScheduler:
         its owning shard before anything ships."""
         if self.mesh is None:
             return (resident.check_quant_roundtrip(
-                        ct.capacity, quant.cap_q, quant.scale,
+                        ct.capacity, quant.cap_q, quant.scale[0],
                         breaker=self.breaker, what="capacity")
                     and resident.check_quant_roundtrip(
-                        base.used, quant.used_q, quant.scale,
+                        base.used, quant.used_q, quant.scale[1],
                         breaker=self.breaker, what="used baseline"))
         d = self.mesh.devices.size
         n_l = ct.n_pad // d
         for s_i in range(d):
             sl = slice(s_i * n_l, (s_i + 1) * n_l)
             if not (resident.check_quant_roundtrip(
-                        ct.capacity[sl], quant.cap_q[sl], quant.scale,
+                        ct.capacity[sl], quant.cap_q[sl], quant.scale[0],
                         breaker=self.breaker,
                         what=f"capacity shard {s_i}")
                     and resident.check_quant_roundtrip(
-                        base.used[sl], quant.used_q[sl], quant.scale,
+                        base.used[sl], quant.used_q[sl], quant.scale[1],
                         breaker=self.breaker,
                         what=f"used baseline shard {s_i}")):
                 return False
@@ -1396,23 +1418,22 @@ class TPUBatchScheduler:
         n_l = ct.n_pad // d
         max_count = max((sp.count for sp in spec_list), default=1)
         total_asks = int(sum(sp.count for sp in spec_list))
-        # Slot-mode scores whenever the single-chip path would carry
-        # them (the score-gap gauge this path used to export is gone:
-        # no mesh pass drops scores anymore).  The threshold is taken
-        # at the SINGLE-CHIP pad (128), not the mesh's lcm(128, D)
+        # Canonical shape-class plan shared with the single-chip path
+        # (ISSUE 13 compile-cache audit).  Slot-mode scores whenever the
+        # single-chip path would carry them: the score threshold is
+        # taken at the SINGLE-CHIP pad (128), not the mesh's lcm(128, D)
         # pad-up — otherwise a non-power-of-two mesh could cross the
         # 16M boundary and drop scores exactly where the reference
-        # path still carries them.
-        n_pad_ref = max(128, encode.round_up(ct.n_real, 128))
-        with_scores = st.u_pad * n_pad_ref <= 16_000_000
-        slot_m = encode.pow2_bucket(max(8, max_count), minimum=8)
-        slot_bytes = 4 + (8 if with_scores else 0)
-        if st.u_pad * slot_m * slot_bytes > MESH_SLOT_BUDGET_BYTES:
+        # path still carries them (encode.shape_plan's n_pad_ref rule).
+        with_scores, slot_m, max_nnz = encode.shape_plan(
+            st.u_pad, ct.n_pad, ct.n_real, max_count, total_asks,
+            mesh=True, slot_budget_bytes=MESH_SLOT_BUDGET_BYTES)
+        if not slot_m:
             self.logger.warning(
-                "mesh slot record %d x %d exceeds budget; batch takes "
-                "the single-chip path", st.u_pad, slot_m)
+                "mesh slot record for %d specs x %d max count exceeds "
+                "budget; batch takes the single-chip path",
+                st.u_pad, max_count)
             return None
-        max_nnz = encode.pow2_bucket(max(8, total_asks), minimum=8)
         k_cand = min(n_l, encode.pow2_bucket(max(64, max_count)))
 
         # Per-shard static packs: node-axis arrays sliced to the owning
@@ -1475,18 +1496,29 @@ class TPUBatchScheduler:
             spec_list, ct, unplaced_arr, coo_rows, coo_cols, coo_counts)
         if problem is not None:
             raise KernelIntegrityError(problem)
-        # COO → per-spec placement slots, vectorized: nonzero emits rows
-        # in ascending order, so per-spec extents are searchsorted slices;
-        # slot node-ids come from ONE fancy-index over the interned id
-        # array + np.repeat of the counts — no per-entry python tuples.
+        from . import decode as decode_mod
+
+        # COO → per-spec placement slots: entries arrive grouped by
+        # ascending spec, so per-spec extents are searchsorted slices;
+        # the expansion of counts into per-alloc node indexes (and the
+        # last-commit score dedup below) run in native/decode.cc behind
+        # differential-guarded numpy/python twins — at the north-star
+        # shape these two passes were the largest host residue left
+        # after the fused kernel (ISSUE 13 tentpole item c).
         valid = (coo_rows >= 0) & (coo_cols < ct.n_real)
         vr, vc = coo_rows[valid], coo_cols[valid]
-        vcnt, vsc, vco = coo_counts[valid], coo_scores[valid], coo_coll[valid]
+        vcnt = coo_counts[valid]
         u_lo = np.searchsorted(vr, np.arange(len(spec_list)), side="left")
         u_hi = np.searchsorted(vr, np.arange(len(spec_list)), side="right")
         node_id_arr = np.array(ct.node_ids, dtype=object)
-        rep_ids = node_id_arr[np.repeat(vc, vcnt)]
-        csum = np.concatenate([[0], np.cumsum(vcnt, dtype=np.int64)])
+        total_asks = int(sum(sp.count for sp in spec_list))
+        exp_off, exp_idx = decode_mod.expand_coo(
+            coo_rows, coo_cols, coo_counts, len(spec_list), ct.n_real,
+            total_asks, breaker=self.breaker)
+        if with_scores:
+            s_off, s_col, s_sc, s_co = decode_mod.last_scores(
+                coo_rows, coo_cols, coo_scores, coo_coll,
+                len(spec_list), ct.n_real, breaker=self.breaker)
 
         # used_after is reconstructed host-side from used0 + committed
         # placements × asks — exact (integer adds, same order-free sum the
@@ -1608,7 +1640,8 @@ class TPUBatchScheduler:
         for u, sp in enumerate(spec_list):
             key = (sp.job.id, sp.tg.name)
             lo, hi = int(u_lo[u]), int(u_hi[u])
-            expanded[key] = rep_ids[csum[lo]:csum[hi]].tolist()
+            expanded[key] = node_id_arr[
+                exp_idx[int(exp_off[u]):int(exp_off[u + 1])]].tolist()
             unplaced[key] = int(unplaced_arr[u])
 
             n_unplaced = unplaced[key]
@@ -1639,22 +1672,27 @@ class TPUBatchScheduler:
             # binpack entry (rank.go:139) plus a separate anti-affinity
             # entry when the node had same-job collisions (rank.go:167).
             # Slot-mode COO carries one entry per ALLOC, so a node
-            # committed in multiple rounds appears several times —
-            # dedupe keeping the LAST commit's score (matrix-mode
-            # semantics: commit_scores[u, n] was overwritten per
-            # commit), since score_node ADDS and summed per-commit
-            # scores would break the 0-18 ScoreFit bound.
+            # committed in multiple rounds appears several times — the
+            # decode pass deduped keeping the LAST commit's score
+            # (matrix-mode semantics: commit_scores[u, n] was
+            # overwritten per commit; score_node ADDS, so summed
+            # per-commit scores would break the 0-18 ScoreFit bound).
+            # The dict is built in bulk — one key per committed node —
+            # instead of a score_node call per entry (70k python calls
+            # at the north-star shape).
             if with_scores:
-                last: Dict[int, Tuple[float, int]] = {}
-                for i, sc, co in zip(vc[lo:hi].tolist(), vsc[lo:hi].tolist(),
-                                     vco[lo:hi].tolist()):
-                    last[i] = (sc, co)
-                for i, (sc, co) in last.items():
-                    m.score_node(all_nodes[i], "binpack", sc)
-                    if co > 0:
-                        m.score_node(
-                            all_nodes[i], "job-anti-affinity",
-                            -float(sp.anti_affinity_penalty) * co)
+                s_lo, s_hi = int(s_off[u]), int(s_off[u + 1])
+                if s_hi > s_lo:
+                    ids = node_id_arr[s_col[s_lo:s_hi]].tolist()
+                    m.scores = {
+                        nid + ".binpack": sc for nid, sc in
+                        zip(ids, s_sc[s_lo:s_hi].tolist())}
+                    co_seg = s_co[s_lo:s_hi]
+                    if (co_seg > 0).any():
+                        pen = float(sp.anti_affinity_penalty)
+                        for j in np.nonzero(co_seg > 0)[0].tolist():
+                            m.scores[ids[j] + ".job-anti-affinity"] = \
+                                -pen * int(co_seg[j])
             if n_unplaced > 0:
                 placed_row = np.zeros(ct.n_real, dtype=np.int32)
                 placed_row[vc[lo:hi]] = vcnt[lo:hi]

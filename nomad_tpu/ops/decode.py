@@ -1,0 +1,260 @@
+"""Packed-result-buffer decode: the host half of the single fused fetch
+(ISSUE 13 tentpole item c).
+
+After ``jax.device_get`` returns the packed buffer, two integer passes
+turn the COO placement payload into what finalize needs:
+
+- :func:`expand_coo` — per-alloc node-index runs per spec (the
+  ``np.repeat``/searchsorted pass the plan materialization feeds on);
+- :func:`last_scores` — per-spec last-commit (col, score, collisions)
+  entries (slot-mode COO carries one entry per ALLOC, so a node
+  committed in several rounds appears several times; the AllocMetric
+  keeps the last commit's score — matrix-mode semantics).
+
+At the north-star shape these are ~1M-entry loops — the largest host
+residue left after the fused kernel — so both drop to C
+(``native/decode.cc``, the wal.cc/codec.cc build pattern) behind pure
+numpy/Python twins.  Every ``NOMAD_TPU_DECODE_GUARD_EVERY`` native calls
+(default 64; tests pin 1) the twin runs anyway and the outputs are
+bit-compared: a mismatch disables the native path for the process,
+feeds the PR 2 breaker, and the batch proceeds on the twin's output —
+corruption degrades, never mis-places.  ``NOMAD_TPU_NO_NATIVE=1`` forces
+the twins outright.
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..utils import tracing
+
+logger = logging.getLogger("nomad_tpu.ops.decode")
+
+# Module counters (selfcheck + tests).  NATIVE_CALLS aggregates; the
+# per-function counters drive the guard cadence INDEPENDENTLY — a
+# shared counter with the production call pattern (expand then
+# last_scores once per batch) would park the cadence on one function
+# and never twin-verify the other.
+NATIVE_CALLS = 0
+EXPAND_CALLS = 0
+LAST_CALLS = 0
+TWIN_CALLS = 0
+GUARD_RUNS = 0
+GUARD_MISMATCHES = 0
+
+_NATIVE_DISABLED = False
+_LIB = None
+
+
+def guard_every() -> int:
+    try:
+        return int(os.environ.get("NOMAD_TPU_DECODE_GUARD_EVERY", "64"))
+    except ValueError:
+        return 64
+
+
+def reset_counters() -> None:
+    global NATIVE_CALLS, TWIN_CALLS, GUARD_RUNS, GUARD_MISMATCHES
+    global EXPAND_CALLS, LAST_CALLS, _NATIVE_DISABLED
+    NATIVE_CALLS = TWIN_CALLS = GUARD_RUNS = GUARD_MISMATCHES = 0
+    EXPAND_CALLS = LAST_CALLS = 0
+    _NATIVE_DISABLED = False
+
+
+def _lib():
+    """The decode .so, or None when unavailable/disabled."""
+    global _LIB, _NATIVE_DISABLED
+    if _NATIVE_DISABLED:
+        return None
+    if _LIB is None:
+        from .. import native
+
+        try:
+            lib = native._load("nomaddecode", "decode.cc")
+        except native.NativeUnavailable as exc:
+            logger.info("native decode unavailable (%s); python twins "
+                        "carry the decode path", exc)
+            _NATIVE_DISABLED = True
+            return None
+        c_i32p = ctypes.POINTER(ctypes.c_int32)
+        c_i64p = ctypes.POINTER(ctypes.c_longlong)
+        c_f32p = ctypes.POINTER(ctypes.c_float)
+        lib.ndec_expand.restype = ctypes.c_longlong
+        lib.ndec_expand.argtypes = [
+            c_i32p, c_i32p, c_i32p, ctypes.c_longlong, ctypes.c_int32,
+            ctypes.c_int32, c_i64p, c_i32p, ctypes.c_longlong]
+        lib.ndec_last_scores.restype = ctypes.c_longlong
+        lib.ndec_last_scores.argtypes = [
+            c_i32p, c_i32p, c_f32p, c_i32p, ctypes.c_longlong,
+            ctypes.c_int32, ctypes.c_int32, c_i32p, c_i64p, c_i64p,
+            c_i32p, c_f32p, c_i32p]
+        _LIB = lib
+    return _LIB
+
+
+def _i32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _i64p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong))
+
+
+def _f32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _note_mismatch(what: str, breaker) -> None:
+    global GUARD_MISMATCHES, _NATIVE_DISABLED
+    GUARD_MISMATCHES += 1
+    _NATIVE_DISABLED = True
+    logger.error(
+        "native decode %s diverged from the python twin; disabling the "
+        "native path and feeding the breaker", what)
+    tracing.event("decode.guard_mismatch", what=what)
+    if breaker is not None:
+        breaker.record(False)
+
+
+# -- expand -----------------------------------------------------------------
+
+
+def _expand_twin(rows: np.ndarray, cols: np.ndarray, counts: np.ndarray,
+                 n_specs: int, n_real: int
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Pure-numpy reference: (off [n_specs+1] int64, expanded int32)."""
+    valid = (rows >= 0) & (cols < n_real)
+    vr, vc = rows[valid], cols[valid]
+    vcnt = counts[valid]
+    expanded = np.repeat(vc, vcnt).astype(np.int32, copy=False)
+    per_spec = np.zeros(n_specs + 1, dtype=np.int64)
+    np.add.at(per_spec, vr.astype(np.int64) + 1, vcnt.astype(np.int64))
+    return np.cumsum(per_spec), expanded
+
+
+def expand_coo(rows: np.ndarray, cols: np.ndarray, counts: np.ndarray,
+               n_specs: int, n_real: int, total_cap: int, breaker=None
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-alloc node-index runs per spec from the fetched COO.
+
+    Returns ``(off, expanded)``: spec u's placements are
+    ``expanded[off[u]:off[u+1]]`` (int32 node indexes, entry order).
+    ``total_cap`` bounds the expansion (the batch's total asks)."""
+    global NATIVE_CALLS, EXPAND_CALLS, TWIN_CALLS, GUARD_RUNS
+    rows = np.ascontiguousarray(rows, dtype=np.int32)
+    cols = np.ascontiguousarray(cols, dtype=np.int32)
+    counts = np.ascontiguousarray(counts, dtype=np.int32)
+    lib = _lib()
+    if lib is None:
+        TWIN_CALLS += 1
+        return _expand_twin(rows, cols, counts, n_specs, n_real)
+    off = np.zeros(n_specs + 1, dtype=np.int64)
+    out = np.empty(max(1, total_cap), dtype=np.int32)
+    got = lib.ndec_expand(_i32p(rows), _i32p(cols), _i32p(counts),
+                          len(rows), n_specs, n_real, _i64p(off),
+                          _i32p(out), out.shape[0])
+    if got < 0:
+        # Shape the native path refuses (overflow / out-of-range spec):
+        # the twin is authoritative.
+        TWIN_CALLS += 1
+        return _expand_twin(rows, cols, counts, n_specs, n_real)
+    NATIVE_CALLS += 1
+    EXPAND_CALLS += 1
+    out = out[:got]
+    every = guard_every()
+    if every > 0 and EXPAND_CALLS % every == 0:
+        GUARD_RUNS += 1
+        ref_off, ref_out = _expand_twin(rows, cols, counts, n_specs,
+                                        n_real)
+        if not (np.array_equal(ref_off, off)
+                and np.array_equal(ref_out, out)):
+            _note_mismatch("expand", breaker)
+            return ref_off, ref_out
+        if breaker is not None:
+            breaker.record(True)
+    return off, out
+
+
+# -- last-commit scores -----------------------------------------------------
+
+
+def _last_scores_twin(rows: np.ndarray, cols: np.ndarray,
+                      scores: np.ndarray, coll: np.ndarray,
+                      n_specs: int, n_real: int):
+    """Pure-python reference: per-spec dicts in first-occurrence order,
+    last value wins — exactly the ``last[i] = (sc, co)`` loop this
+    module replaces."""
+    valid = (rows >= 0) & (cols < n_real)
+    vr, vc = rows[valid], cols[valid]
+    vsc, vco = scores[valid], coll[valid]
+    off = np.zeros(n_specs + 1, dtype=np.int64)
+    out_col, out_sc, out_co = [], [], []
+    u_lo = np.searchsorted(vr, np.arange(n_specs), side="left")
+    u_hi = np.searchsorted(vr, np.arange(n_specs), side="right")
+    for u in range(n_specs):
+        last = {}
+        lo, hi = int(u_lo[u]), int(u_hi[u])
+        for i, sc, co in zip(vc[lo:hi].tolist(), vsc[lo:hi].tolist(),
+                             vco[lo:hi].tolist()):
+            last[i] = (sc, co)
+        off[u + 1] = off[u] + len(last)
+        for i, (sc, co) in last.items():
+            out_col.append(i)
+            out_sc.append(sc)
+            out_co.append(co)
+    return (off, np.array(out_col, dtype=np.int32),
+            np.array(out_sc, dtype=np.float32),
+            np.array(out_co, dtype=np.int32))
+
+
+def last_scores(rows: np.ndarray, cols: np.ndarray, scores: np.ndarray,
+                coll: np.ndarray, n_specs: int, n_real: int,
+                breaker=None):
+    """Per-spec last-commit score entries from the fetched COO.
+
+    Returns ``(off, col, score, coll)``: spec u's score entries are the
+    ``[off[u]:off[u+1]]`` slices (node col, binpack score, collision
+    count), one entry per distinct committed node, last commit wins."""
+    global NATIVE_CALLS, LAST_CALLS, TWIN_CALLS, GUARD_RUNS
+    rows = np.ascontiguousarray(rows, dtype=np.int32)
+    cols = np.ascontiguousarray(cols, dtype=np.int32)
+    scores = np.ascontiguousarray(scores, dtype=np.float32)
+    coll = np.ascontiguousarray(coll, dtype=np.int32)
+    lib = _lib()
+    if lib is None:
+        TWIN_CALLS += 1
+        return _last_scores_twin(rows, cols, scores, coll, n_specs,
+                                 n_real)
+    n = len(rows)
+    stamp = np.full(max(1, n_real), -1, dtype=np.int32)
+    pos = np.empty(max(1, n_real), dtype=np.int64)
+    off = np.zeros(n_specs + 1, dtype=np.int64)
+    out_col = np.empty(max(1, n), dtype=np.int32)
+    out_sc = np.empty(max(1, n), dtype=np.float32)
+    out_co = np.empty(max(1, n), dtype=np.int32)
+    got = lib.ndec_last_scores(
+        _i32p(rows), _i32p(cols), _f32p(scores), _i32p(coll), n,
+        n_specs, n_real, _i32p(stamp), _i64p(pos), _i64p(off),
+        _i32p(out_col), _f32p(out_sc), _i32p(out_co))
+    if got < 0:
+        TWIN_CALLS += 1
+        return _last_scores_twin(rows, cols, scores, coll, n_specs,
+                                 n_real)
+    NATIVE_CALLS += 1
+    LAST_CALLS += 1
+    result = (off, out_col[:got], out_sc[:got], out_co[:got])
+    every = guard_every()
+    if every > 0 and LAST_CALLS % every == 0:
+        GUARD_RUNS += 1
+        ref = _last_scores_twin(rows, cols, scores, coll, n_specs,
+                                n_real)
+        if not all(np.array_equal(a, b) for a, b in zip(ref, result)):
+            _note_mismatch("last_scores", breaker)
+            return ref
+        if breaker is not None:
+            breaker.record(True)
+    return result

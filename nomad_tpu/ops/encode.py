@@ -61,7 +61,7 @@ from ..structs.network import (  # noqa: E402
 PORT_WORDS = MAX_VALID_PORT // 32          # uint32 words per node bitmap
 
 
-# -- quantized resource rows (PR 6) -----------------------------------------
+# -- quantized resource rows (PR 6, int8-everywhere in PR 13) ---------------
 #
 # The static cluster upload ships two [n_pad, 4] int32 resource matrices
 # (capacity + reserved-only usage baseline) over a single-digit-MB/s
@@ -74,6 +74,15 @@ PORT_WORDS = MAX_VALID_PORT // 32          # uint32 words per node bitmap
 # (placements must stay bit-identical to the float/int32 oracle — the
 # ≤0.5%-target-0.0% score-delta discipline).  Dequantization on device
 # is one integer multiply fused into the unpack.
+#
+# Each matrix carries its OWN [4] scale row (the codebook ships [2, 4]:
+# row 0 capacity, row 1 used-baseline) and scales are pushed per
+# dimension toward the int8 range first, falling back to the int16 range
+# per dimension when divisibility forbids the extra shifts — so a
+# capacity column divisible by 1024 rides int8 even when the reserved
+# baseline next to it only divides by 4.  A matrix is int8 when ALL its
+# scaled dimensions fit int8, int16 otherwise; the two matrices choose
+# independently.
 
 def quant_enabled() -> bool:
     from ..utils.flags import env_flag
@@ -84,48 +93,78 @@ def quant_enabled() -> bool:
 @dataclass
 class QuantizedRows:
     """Exactly-quantized (capacity, used-baseline) resource rows plus the
-    per-dimension scale codebook.  ``tag`` is the xfer dtype tag the
-    quantized matrices ship as ("i16" or "i8")."""
+    per-matrix, per-dimension scale codebook.  ``cap_tag``/``used_tag``
+    are the xfer dtype tags the quantized matrices ship as ("i16" or
+    "i8"); ``scale`` is [2, 4] int32 (row 0 capacity, row 1 used)."""
 
     cap_q: np.ndarray      # [n_pad, 4] int16/int8
     used_q: np.ndarray     # [n_pad, 4] int16/int8
-    scale: np.ndarray      # [4] int32 — power-of-two per dimension
-    tag: str
+    scale: np.ndarray      # [2, 4] int32 — power-of-two per matrix/dim
+    cap_tag: str
+    used_tag: str
+
+    @property
+    def tag(self) -> str:  # widest of the pair (back-compat summary)
+        return "i8" if self.cap_tag == self.used_tag == "i8" else "i16"
+
+
+def _quant_one(mat: np.ndarray) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Per-dimension exact power-of-two scales for ONE [n, 4] matrix,
+    pushed into the int8 range where divisibility allows, int16
+    otherwise; None when even the int16 range cannot be exact."""
+    scale = np.ones(RES_DIMS, dtype=np.int64)
+    for d in range(RES_DIMS):
+        col = mat[:, d]
+        m = int(col.max(initial=0))
+        s16 = 1
+        while m // s16 > np.iinfo(np.int16).max:
+            s16 <<= 1
+        s8 = s16
+        while m // s8 > np.iinfo(np.int8).max:
+            s8 <<= 1
+        if s8 == 1 or not (col % s8).any():
+            scale[d] = s8
+        elif s16 == 1 or not (col % s16).any():
+            scale[d] = s16
+        else:
+            return None
+    return mat // scale, scale
 
 
 def quantize_resource_rows(capacity: np.ndarray,
                            used: np.ndarray) -> Optional[QuantizedRows]:
     """Quantize the [n, 4] capacity/used matrices to the narrowest exact
     integer representation, or return None when exactness is impossible
-    (a value not divisible by the scale its range requires).  int8 is
-    chosen only when every dimension fits it under the same codebook."""
+    for either matrix (a value not divisible by the scale its range
+    requires).  Scales and dtypes are chosen per matrix."""
     cap = np.asarray(capacity, dtype=np.int64)
     use = np.asarray(used, dtype=np.int64)
     if (cap < 0).any() or (use < 0).any():
         return None
-    scale = np.ones(RES_DIMS, dtype=np.int64)
-    for d in range(RES_DIMS):
-        m = max(int(cap[:, d].max(initial=0)), int(use[:, d].max(initial=0)))
-        s_d = 1
-        while m // s_d > np.iinfo(np.int16).max:
-            s_d <<= 1
-        if s_d > 1 and ((cap[:, d] % s_d).any() or (use[:, d] % s_d).any()):
-            return None
-        scale[d] = s_d
-    cap_s = cap // scale
-    use_s = use // scale
-    if (cap_s.max(initial=0) <= np.iinfo(np.int8).max
-            and use_s.max(initial=0) <= np.iinfo(np.int8).max):
-        dt, tag = np.int8, "i8"
-    else:
-        dt, tag = np.int16, "i16"
-    return QuantizedRows(cap_q=cap_s.astype(dt), used_q=use_s.astype(dt),
-                         scale=scale.astype(np.int32), tag=tag)
+    qc = _quant_one(cap)
+    qu = _quant_one(use)
+    if qc is None or qu is None:
+        return None
+    cap_s, cap_scale = qc
+    use_s, use_scale = qu
+
+    def _pick(m):
+        if m.max(initial=0) <= np.iinfo(np.int8).max:
+            return np.int8, "i8"
+        return np.int16, "i16"
+
+    cap_dt, cap_tag = _pick(cap_s)
+    use_dt, use_tag = _pick(use_s)
+    return QuantizedRows(
+        cap_q=cap_s.astype(cap_dt), used_q=use_s.astype(use_dt),
+        scale=np.stack([cap_scale, use_scale]).astype(np.int32),
+        cap_tag=cap_tag, used_tag=use_tag)
 
 
 def dequantize_rows(q: np.ndarray, scale: np.ndarray) -> np.ndarray:
     """Host-side inverse (the round-trip bound check and tests);
-    the device-side twin is one multiply in kernels._device_schedule."""
+    the device-side twin is one multiply in kernels._device_schedule.
+    ``scale`` is the matrix's own [4] codebook row."""
     return q.astype(np.int64) * np.asarray(scale, dtype=np.int64)
 
 
@@ -148,6 +187,45 @@ def pow2_bucket(x: int, minimum: int = 8) -> int:
     while v < x:
         v <<= 1
     return v
+
+
+def shape_plan(u_pad: int, n_pad: int, n_real: int, max_count: int,
+               total_asks: int, *, mesh: bool = False,
+               slot_budget_bytes: int = 64 << 20
+               ) -> Tuple[bool, int, int]:
+    """THE canonical shape-class plan for a placement dispatch — ONE
+    pow2 bucketing of (score carry, slot record, COO capacity) shared by
+    the single-chip and mesh paths (ISSUE 13 compile-cache audit: two
+    call sites deriving these independently is how silent recompiles are
+    born).  Returns ``(with_scores, slot_m, max_nnz)``.
+
+    - ``with_scores``: the [U, M]/[U, N] commit-score side-outputs are
+      carried while U × N stays under ~16M cells; N is evaluated at the
+      SINGLE-CHIP reference pad (128-multiple of ``n_real``), so a mesh
+      pad-up or mesh→single-chip fallback can never cross the boundary
+      where the reference path still carries scores.
+    - ``slot_m``: the commit-aligned slot record's minor axis (pow2 of
+      the max ask count), or 0 when the record would exceed
+      ``slot_budget_bytes`` (the caller then compacts from the [U, N]
+      matrix — or, on the mesh, falls back to single-chip).  The
+      single-chip path also turns slots off beyond 65536 node rows
+      (matrix nonzero stays cheaper there); the mesh REQUIRES slots.
+    - ``max_nnz``: COO capacity — per-ALLOC entries in slot mode (a node
+      committed in two rounds appears twice), per-(spec, node)
+      aggregates otherwise.
+    """
+    n_pad_ref = max(128, round_up(n_real, 128))
+    with_scores = u_pad * n_pad_ref <= 16_000_000
+    slot_m = 0
+    if mesh or n_pad <= 65536:
+        m_b = pow2_bucket(max(8, max_count), minimum=8)
+        slot_bytes = 4 + (8 if with_scores else 0)
+        if u_pad * m_b * slot_bytes <= slot_budget_bytes:
+            slot_m = m_b
+    max_nnz = pow2_bucket(
+        max(8, total_asks if slot_m
+            else min(total_asks, u_pad * n_pad)), minimum=8)
+    return with_scores, slot_m, max_nnz
 
 
 @dataclass

@@ -43,6 +43,41 @@ from .encode import (
 
 NEG_INF = -1e30
 
+# -- compile-cache audit (ISSUE 13) -----------------------------------------
+#
+# Recompiles are the silent killer at 10M nodes: one stray shape bucket
+# costs tens of seconds of XLA time.  Every distinct static signature the
+# placement programs are invoked with is recorded here — a new signature
+# is (at most) one fresh XLA compile, an old one is a guaranteed cache
+# hit — so `compile_signatures()` is an upper bound on placement-program
+# compiles that bench `--check` can assert a ceiling on (config_steady's
+# 200-batch stream must stay within a fixed handful of shapes).
+_COMPILE_SIGS = set()
+COMPILES = 0
+
+
+def note_signature(kind: str, sig: tuple) -> bool:
+    """Record one program invocation signature; True when it is new
+    (i.e. this call may trigger an XLA compile)."""
+    global COMPILES
+    key = (kind, sig)
+    if key in _COMPILE_SIGS:
+        return False
+    _COMPILE_SIGS.add(key)
+    COMPILES += 1
+    return True
+
+
+def compile_signatures() -> int:
+    return COMPILES
+
+
+def reset_compile_signatures() -> None:
+    """Test/bench helper: zero the audit (does NOT clear jit caches)."""
+    global COMPILES
+    _COMPILE_SIGS.clear()
+    COMPILES = 0
+
 
 def jitter_seed(rng_key: jnp.ndarray) -> jnp.ndarray:
     """One uint32 tie-break seed from a PRNG key (a single scalar draw;
@@ -642,10 +677,12 @@ def summary_layout(u_pad: int, n_pad: int):
 
 @functools.partial(jax.jit, static_argnames=(
     "meta_s", "meta_d", "u_pad", "n_pad", "with_networks", "with_dp",
-    "with_scores", "max_rounds", "slot_m"))
+    "with_scores", "max_rounds", "slot_m", "use_used_dev"),
+    donate_argnums=(2,))
 def _device_schedule(
     static_buf: jnp.ndarray,          # packed uint8, device-cached (xfer)
     dyn_buf: jnp.ndarray,             # packed uint8, per-batch upload
+    used_dev: jnp.ndarray,            # [n_pad, 4] int32 DONATED mirror
     *,
     meta_s,
     meta_d,
@@ -656,6 +693,7 @@ def _device_schedule(
     with_scores: bool,
     max_rounds: int = 256,
     slot_m: int = 0,
+    use_used_dev: bool = False,
 ):
     """Dispatch 1: unpack + feasibility + placement rounds.
 
@@ -664,21 +702,30 @@ def _device_schedule(
     the multi-MB part) is uploaded once per fleet state and cached as a
     device array by the caller; the per-batch dynamic buffer holds the
     U-sized spec tensors plus SPARSE alloc-usage deltas scattered onto
-    the static baselines here."""
+    the static baselines here.
+
+    ``use_used_dev``: the usage matrix arrives as the DONATED
+    device-resident mirror (ops/resident.py keeps it caught up in place
+    via donated scatter-adds) instead of baseline+deltas — no per-batch
+    usage upload, no materialized sum, and the caller gets the aliased
+    array back to return to the resident slot.  With it off the donated
+    slot is a [1, 4] dummy."""
     from . import xfer
 
     d = xfer.unpack_device(static_buf, meta_s)
     d.update(xfer.unpack_device(dyn_buf, meta_d))
     # Quantized resource rows (ops/encode.py quantize_resource_rows):
     # the static buffer carries int16/int8 capacity + used-baseline plus
-    # a per-dimension power-of-two scale codebook; dequantization is one
-    # exact integer multiply, so the placement math below is bit-
-    # identical to the int32 path.  Keyed on the (static) meta, so the
-    # branch specializes at trace time.
+    # a [2, 4] per-matrix, per-dimension power-of-two scale codebook
+    # (row 0 capacity, row 1 used); dequantization is one exact integer
+    # multiply, so the placement math below is bit-identical to the
+    # int32 path.  Keyed on the (static) meta, so the branch specializes
+    # at trace time.
     if "res_scale" in d:
-        scale = d.pop("res_scale")[None, :]
-        d["cap"] = d.pop("cap_q").astype(jnp.int32) * scale
-        d["used_base"] = d.pop("used_base_q").astype(jnp.int32) * scale
+        scale = d.pop("res_scale")
+        d["cap"] = d.pop("cap_q").astype(jnp.int32) * scale[0][None, :]
+        d["used_base"] = (d.pop("used_base_q").astype(jnp.int32)
+                          * scale[1][None, :])
     # Materialize the unpacked arrays before they enter the placement
     # while/scan: without the barrier XLA fuses the slice+bitcast decode
     # of the packed buffer into the loop BODY and re-decodes the whole
@@ -690,17 +737,22 @@ def _device_schedule(
     feas = feasibility_matrix(
         d["attr"], d["elig"], d["dc"], d["c_attr"], d["c_op"], d["c_rhs"],
         d["dc_mask"], d["precomp"])
-    # Alloc usage arrives as sparse (node, 4-dim) deltas over the static
-    # reserved-only baseline; -1 rows are padding.  Padding routes to an
-    # out-of-bounds index under mode="drop" — clipping it to a real row
-    # would put DUPLICATE indices in the scatter, and for the port-word
-    # SET below a padding row's identity write could then race with (and
-    # clobber) a real touched-node write.
-    uvalid = d["u_rows"] >= 0
-    uidx = jnp.where(uvalid, d["u_rows"], jnp.int32(n_pad))
-    used0 = d["used_base"].at[uidx].add(d["u_vals"], mode="drop")
+    if use_used_dev:
+        used0 = used_dev
+    else:
+        # Alloc usage arrives as sparse (node, 4-dim) deltas over the
+        # static reserved-only baseline; -1 rows are padding.  Padding
+        # routes to an out-of-bounds index under mode="drop" — clipping
+        # it to a real row would put DUPLICATE indices in the scatter,
+        # and for the port-word SET below a padding row's identity write
+        # could then race with (and clobber) a real touched-node write.
+        uvalid = d["u_rows"] >= 0
+        uidx = jnp.where(uvalid, d["u_rows"], jnp.int32(n_pad))
+        used0 = d["used_base"].at[uidx].add(d["u_vals"], mode="drop")
     net = None
     if with_networks:
+        assert not use_used_dev, \
+            "device-resident usage mirror is gated to non-network batches"
         bw_used = d["bw_used_base"].at[uidx].add(d["u_bw"], mode="drop")
         dyn_free = d["dyn_free_base"].at[uidx].add(d["u_dyn"], mode="drop")
         # Port bitmaps are REPLACED per touched node (the host re-derives
@@ -722,7 +774,10 @@ def _device_schedule(
         d["penalty"], d["dh"], d["ji"], job_counts, key,
         max_rounds=max_rounds, net=net, dp=dp, with_scores=with_scores,
         slot_m=slot_m)
-    return result, feas
+    # The donated mirror rides back out UNCHANGED so XLA aliases it
+    # input→output: the caller re-installs the very same device buffer
+    # into the resident slot (zero copies across the batch round-trip).
+    return result, feas, used_dev
 
 
 def _slots_coo_gather(slots: jnp.ndarray, slot_scores: jnp.ndarray,
@@ -850,6 +905,7 @@ def _device_compact(result: PlacementResult, feas: jnp.ndarray,
 def device_pass(
     static_buf: jnp.ndarray,
     dyn_buf: jnp.ndarray,
+    used_dev: jnp.ndarray = None,
     *,
     meta_s,
     meta_d,
@@ -874,19 +930,29 @@ def device_pass(
     the XLA optimization time of the big scheduling program from
     compounding with the compaction graph.
 
-    Returns (summary_buf uint8, coo [max_nnz, C], feas);
+    Returns (summary_buf uint8, coo [max_nnz, C], feas, used_out);
     C = 5 with scores (int32: row, col, count, score-bits, collisions),
     else 3 (row, col, count — uint16 when U/N/rounds all fit 16 bits,
     int32 otherwise; read the dtype off the array).  With slot_m > 0 the
     COO is built from the scan's commit-aligned slot record (per-alloc
     entries, counts ≡ 1) instead of a [U, N] nonzero.  feas stays on
-    device for the rare lazy failure-forensics row fetch.
+    device for the rare lazy failure-forensics row fetch.  ``used_dev``
+    (optional): the donated device-resident usage mirror; ``used_out``
+    is the aliased buffer to hand back to the resident slot (None when
+    no mirror was passed).
     """
-    result, feas = _device_schedule(
-        static_buf, dyn_buf, meta_s=meta_s, meta_d=meta_d,
+    use_used_dev = used_dev is not None
+    if used_dev is None:
+        used_dev = jnp.zeros((1, 4), dtype=jnp.int32)
+    note_signature("device_pass", (
+        meta_s, meta_d, u_pad, n_pad, with_networks, with_dp, with_scores,
+        max_nnz, max_rounds, slot_m, use_used_dev))
+    result, feas, used_out = _device_schedule(
+        static_buf, dyn_buf, used_dev, meta_s=meta_s, meta_d=meta_d,
         u_pad=u_pad, n_pad=n_pad,
         with_networks=with_networks, with_dp=with_dp,
-        with_scores=with_scores, max_rounds=max_rounds, slot_m=slot_m)
+        with_scores=with_scores, max_rounds=max_rounds, slot_m=slot_m,
+        use_used_dev=use_used_dev)
     # <= 65536: u16 stores values 0..65535 and row/col/count are all
     # strictly below their pad bound (a 65536-node bucket still has max
     # col 65535 — `< 65536` wrongly fell back to int32 exactly at the
@@ -896,7 +962,7 @@ def device_pass(
     summary, coo = _device_compact(
         result, feas, with_scores=with_scores, max_nnz=max_nnz,
         compact_u16=compact_u16, slot_m=slot_m)
-    return summary, coo, feas
+    return summary, coo, feas, (used_out if use_used_dev else None)
 
 
 # Fused result-buffer COO window: the single transfer carries at most
@@ -936,10 +1002,12 @@ def fused_layout(u_pad: int, *, window_nnz: int, with_scores: bool,
 @functools.partial(jax.jit, static_argnames=(
     "meta_s", "meta_d", "u_pad", "n_pad", "with_networks", "with_dp",
     "with_scores", "max_nnz", "max_rounds", "slot_m", "compact_u16",
-    "window_nnz"))
+    "window_nnz", "use_used_dev"),
+    donate_argnums=(2,))
 def _fused_score_commit(
     static_buf: jnp.ndarray,
     dyn_buf: jnp.ndarray,
+    used_dev: jnp.ndarray,
     *,
     meta_s,
     meta_d,
@@ -953,6 +1021,7 @@ def _fused_score_commit(
     slot_m: int = 0,
     compact_u16: bool = False,
     window_nnz: int = 0,
+    use_used_dev: bool = False,
 ):
     """ONE device dispatch for the whole batch: unpack (+ dequantize) →
     feasibility → lax.scan capacity-feedback placement rounds → COO
@@ -961,12 +1030,14 @@ def _fused_score_commit(
     split (device_pass) remains the fallback behind NOMAD_TPU_FUSED=0
     and the diagnostics paths; placements are bit-identical between the
     two by construction (same _device_schedule, same compaction
-    expressions)."""
-    result, feas = _device_schedule(
-        static_buf, dyn_buf, meta_s=meta_s, meta_d=meta_d,
+    expressions).  ``used_dev`` is the DONATED device-resident usage
+    mirror (a [1, 4] dummy when use_used_dev is off), returned aliased
+    as the last output."""
+    result, feas, used_out = _device_schedule(
+        static_buf, dyn_buf, used_dev, meta_s=meta_s, meta_d=meta_d,
         u_pad=u_pad, n_pad=n_pad, with_networks=with_networks,
         with_dp=with_dp, with_scores=with_scores, max_rounds=max_rounds,
-        slot_m=slot_m)
+        slot_m=slot_m, use_used_dev=use_used_dev)
     from . import xfer
 
     feas_count = jnp.sum(feas, axis=1).astype(jnp.int32)
@@ -991,12 +1062,13 @@ def _fused_score_commit(
         "scalars": jnp.stack([nnz, result.rounds]).astype(jnp.int32),
         "coo": coo_win,
     })
-    return buf, aux, feas
+    return buf, aux, feas, used_out
 
 
 def fused_pass(
     static_buf: jnp.ndarray,
     dyn_buf: jnp.ndarray,
+    used_dev: jnp.ndarray = None,
     *,
     meta_s,
     meta_d,
@@ -1010,26 +1082,36 @@ def fused_pass(
     slot_m: int = 0,
 ):
     """Fused score-and-commit entry: returns (packed result buffer,
-    full COO on device, feas on device, result layout meta).  The
-    caller fetches the packed buffer with ONE jax.device_get and
+    full COO on device, feas on device, result layout meta, used_out).
+    The caller fetches the packed buffer with ONE jax.device_get and
     decodes host-side with xfer.unpack_host(buf, meta).  ``aux`` is the
     device-resident overflow source — the full COO (matrix mode) or the
     raw slot record triple (slot mode) — touched only when nnz
     overflows the payload window; ``feas`` only for the rare lazy
-    failure-forensics rows."""
+    failure-forensics rows.  ``used_dev`` (optional) is the donated
+    device-resident usage mirror; ``used_out`` is the aliased buffer to
+    hand back to ops/resident.py (None when no mirror was passed — the
+    sparse-delta upload path)."""
     compact_u16 = (not with_scores and u_pad <= 65536
                    and n_pad <= 65536 and max_rounds < 65536)
     window_nnz = fused_window(max_nnz, with_scores=with_scores,
                               compact_u16=compact_u16)
-    buf, aux, feas = _fused_score_commit(
-        static_buf, dyn_buf, meta_s=meta_s, meta_d=meta_d,
+    use_used_dev = used_dev is not None
+    if used_dev is None:
+        used_dev = jnp.zeros((1, 4), dtype=jnp.int32)
+    note_signature("fused_pass", (
+        meta_s, meta_d, u_pad, n_pad, with_networks, with_dp, with_scores,
+        max_nnz, max_rounds, slot_m, compact_u16, window_nnz,
+        use_used_dev))
+    buf, aux, feas, used_out = _fused_score_commit(
+        static_buf, dyn_buf, used_dev, meta_s=meta_s, meta_d=meta_d,
         u_pad=u_pad, n_pad=n_pad, with_networks=with_networks,
         with_dp=with_dp, with_scores=with_scores, max_nnz=max_nnz,
         max_rounds=max_rounds, slot_m=slot_m, compact_u16=compact_u16,
-        window_nnz=window_nnz)
+        window_nnz=window_nnz, use_used_dev=use_used_dev)
     meta = fused_layout(u_pad, window_nnz=window_nnz,
                         with_scores=with_scores, compact_u16=compact_u16)
-    return buf, aux, feas, meta
+    return buf, aux, feas, meta, (used_out if use_used_dev else None)
 
 
 @functools.partial(jax.jit, static_argnames=("max_nnz",))

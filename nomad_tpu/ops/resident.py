@@ -69,6 +69,18 @@ def enabled() -> bool:
     return env_flag("NOMAD_TPU_RESIDENT", True)
 
 
+def device_mirror_enabled() -> bool:
+    """NOMAD_TPU_RESIDENT_DEVICE (default ON): keep a DEVICE twin of the
+    usage mirror, caught up in place by donated scatter-adds and passed
+    to the fused kernel as a donated argument — the usage matrix never
+    re-materializes and never crosses the link after install (ISSUE 13:
+    the arxiv 2603.09555 O(1)-state-carry discipline applied to the
+    resident cache).  0 keeps the sparse-delta upload path."""
+    from ..utils.flags import env_flag
+
+    return env_flag("NOMAD_TPU_RESIDENT_DEVICE", True)
+
+
 def guard_every() -> int:
     try:
         return int(os.environ.get("NOMAD_TPU_RESIDENT_GUARD_EVERY", "64"))
@@ -76,11 +88,37 @@ def guard_every() -> int:
         return 64
 
 
+_DELTA_APPLY = None
+
+
+def _delta_apply_fn():
+    """The donated scatter-add that keeps the device mirror caught up:
+    jitted once, donate_argnums=(0,) aliases input to output so the
+    apply is IN PLACE on device (measured 0.014ms vs 96ms for the
+    copying form on a 10M-row mirror).  Delta rows are pow2-bucketed by
+    the caller so the jit cache holds a fixed handful of shapes."""
+    global _DELTA_APPLY
+    if _DELTA_APPLY is None:
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _apply(used_dev, rows, vals):
+            valid = rows >= 0
+            idx = jnp.where(valid, rows, jnp.int32(used_dev.shape[0]))
+            return used_dev.at[idx].add(vals, mode="drop")
+
+        _DELTA_APPLY = _apply
+    return _DELTA_APPLY
+
+
 class ResidentState:
     """One cached (static key → usage matrix) residency slot."""
 
     __slots__ = ("key", "used", "alloc_index", "touched", "hits",
-                 "delta_rows", "since_guard")
+                 "delta_rows", "since_guard", "used_dev")
 
     def __init__(self, key: Tuple, used: np.ndarray, alloc_index: int,
                  touched: set):
@@ -91,6 +129,11 @@ class ResidentState:
         self.hits = 0
         self.delta_rows = 0
         self.since_guard = 0
+        # Device twin of ``used`` (int32): installed lazily by
+        # take_device_used, caught up in place by donated scatter-adds,
+        # LOANED to the kernel (donated) and handed back via
+        # give_device_used — None while out on loan or dropped.
+        self.used_dev = None
 
 
 # Single residency slot (the steady-state workload schedules one cluster
@@ -106,6 +149,12 @@ FULL_REENCODES = 0
 STALENESS_FALLBACKS = 0
 GUARD_RUNS = 0
 GUARD_MISMATCHES = 0
+# Device-mirror counters: donated delta applies, installs (host→device
+# uploads — should stay ~1 per mirror lifetime), and device-vs-host
+# guard mismatches (drift in the donated buffer itself).
+DEV_APPLIES = 0
+DEV_INSTALLS = 0
+DEV_GUARD_MISMATCHES = 0
 # Quantization round-trip guard (PR 6): every quantized static upload is
 # dequantized host-side and bit-compared against the exact rows before
 # the buffer ships — the mirror-drift guard extended to the narrow-dtype
@@ -139,10 +188,56 @@ def reset_counters() -> None:
     """Test helper: zero the module counters and drop the cache."""
     global HITS, FULL_REENCODES, STALENESS_FALLBACKS, GUARD_RUNS
     global GUARD_MISMATCHES, QUANT_CHECKS, QUANT_MISMATCHES
+    global DEV_APPLIES, DEV_INSTALLS, DEV_GUARD_MISMATCHES
     invalidate()
     HITS = FULL_REENCODES = STALENESS_FALLBACKS = 0
     GUARD_RUNS = GUARD_MISMATCHES = 0
     QUANT_CHECKS = QUANT_MISMATCHES = 0
+    DEV_APPLIES = DEV_INSTALLS = DEV_GUARD_MISMATCHES = 0
+
+
+def take_device_used(key: Tuple, snap_index: int, host_used: np.ndarray):
+    """Loan the device usage mirror out for donation into the kernel.
+
+    Returns the [n_pad, 4] int32 device array — installed from
+    ``host_used`` on first use — or None when the resident slot does
+    not exactly match ``(key, snap_index)`` (the caller then ships
+    sparse deltas as before).  The slot's handle is cleared while the
+    loan is out: donation consumes the buffer, so an exception between
+    take and give must leave the slot empty (rebuilt from host on the
+    next take), never holding a dead handle."""
+    global DEV_INSTALLS
+    if not device_mirror_enabled():
+        return None
+    with _LOCK:
+        st = _STATE
+        if (st is None or st.key != key
+                or st.alloc_index != snap_index):
+            return None
+        dev = st.used_dev
+        st.used_dev = None
+    if dev is None:
+        import jax
+
+        from .kernels import note_signature
+
+        dev = jax.device_put(
+            np.ascontiguousarray(host_used, dtype=np.int32))
+        note_signature("resident_install", (host_used.shape,))
+        DEV_INSTALLS += 1
+        tracing.event("resident.device_install", rows=host_used.shape[0])
+    return dev
+
+
+def give_device_used(key: Tuple, snap_index: int, dev) -> None:
+    """Hand the loaned (kernel-aliased) device mirror back.  Dropped
+    when the slot moved on while the loan was out — the mirror is then
+    reinstalled from host at the next take."""
+    with _LOCK:
+        st = _STATE
+        if (st is not None and st.key == key and st.used_dev is None
+                and st.alloc_index == snap_index):
+            st.used_dev = dev
 
 
 def check_quant_roundtrip(exact: np.ndarray, quantized: np.ndarray,
@@ -170,6 +265,39 @@ def check_quant_roundtrip(exact: np.ndarray, quantized: np.ndarray,
     if breaker is not None:
         breaker.record(False)
     return False
+
+
+def _apply_device_deltas(used_dev, dev_rows):
+    """Catch the device mirror up with one donated scatter-add (no-op
+    when the mirror is absent or nothing changed).  Rows are bucketed to
+    powers of two so the jit cache stays a fixed handful of shapes."""
+    global DEV_APPLIES
+    if used_dev is None or not dev_rows:
+        return used_dev
+    from .encode import pow2_bucket
+
+    k_b = pow2_bucket(len(dev_rows))
+    rows = np.full(k_b, -1, dtype=np.int32)
+    vals = np.zeros((k_b, RES_DIMS), dtype=np.int32)
+    for j, (i, vec) in enumerate(dev_rows):
+        rows[j] = i
+        vals[j, 0] = vec[0]
+        vals[j, 1] = vec[1]
+        vals[j, 2] = vec[2]
+        vals[j, 3] = vec[3]
+    DEV_APPLIES += 1
+    from .kernels import note_signature
+
+    note_signature("resident_delta", (used_dev.shape, k_b))
+    try:
+        return _delta_apply_fn()(used_dev, rows, vals)
+    except Exception:
+        # The donated input is consumed even on failure — a dead handle
+        # must not linger in the slot (the next take reinstalls from
+        # host).
+        logger.exception("donated delta apply failed; dropping the "
+                         "device mirror")
+        return None
 
 
 def _publish(etype_reason: str, **payload) -> None:
@@ -292,6 +420,8 @@ def acquire(state, cache_key: Tuple, base, rows_fn,
             if deltas is not None:
                 node_index = base._node_index  # type: ignore[attr-defined]
                 used = st.used
+                dev_rows: List[Tuple[int, Tuple]] = []
+                track_dev = st.used_dev is not None
                 for nid, vec in deltas:
                     i = node_index.get(nid)
                     if i is None:
@@ -301,6 +431,8 @@ def acquire(state, cache_key: Tuple, base, rows_fn,
                     used[i, 2] += vec[2]
                     used[i, 3] += vec[3]
                     st.touched.add(i)
+                    if track_dev:
+                        dev_rows.append((i, vec))
                 st.alloc_index = snap_index
                 st.hits += 1
                 st.delta_rows += len(deltas)
@@ -314,15 +446,51 @@ def acquire(state, cache_key: Tuple, base, rows_fn,
                     row = (sorted(st.touched)[act.rng.randrange(
                         len(st.touched))] if st.touched
                         else act.rng.randrange(used.shape[0]))
-                    used[row, act.rng.randrange(RES_DIMS)] += 1 + \
-                        act.rng.randrange(1000)
+                    dim = act.rng.randrange(RES_DIMS)
+                    bump = 1 + act.rng.randrange(1000)
+                    used[row, dim] += bump
                     st.touched.add(row)
+                    if track_dev:
+                        # The chaos twin of mirror drift perturbs the
+                        # DEVICE copy identically, so host and device
+                        # stay consistent with each other and the
+                        # host-vs-walk guard below catches both.
+                        vec = [0] * RES_DIMS
+                        vec[dim] = bump
+                        dev_rows.append((row, tuple(vec)))
+
+                st.used_dev = _apply_device_deltas(st.used_dev, dev_rows)
 
                 every = guard_every()
                 if every > 0 and st.since_guard >= every:
                     st.since_guard = 0
                     GUARD_RUNS += 1
                     info["guard_ran"] = True
+                    if st.used_dev is not None:
+                        # Device-mirror drift guard: the donated buffer
+                        # must bit-match the host mirror it twins —
+                        # drift here is an aliasing/donation bug (or
+                        # real device corruption), caught independently
+                        # of the host-vs-walk compare below.
+                        dev_host = np.asarray(st.used_dev)
+                        if not np.array_equal(
+                                dev_host.astype(np.int64), used):
+                            global DEV_GUARD_MISMATCHES
+                            DEV_GUARD_MISMATCHES += 1
+                            bad = int((dev_host.astype(np.int64)
+                                       != used).any(axis=1).sum())
+                            logger.error(
+                                "device usage mirror diverged from the "
+                                "host mirror on %d rows; dropping the "
+                                "donated buffer and feeding the breaker",
+                                bad)
+                            tracing.event("resident.device_mismatch",
+                                          rows=bad)
+                            _publish("device_mirror_mismatch", Rows=bad,
+                                     AllocIndex=snap_index)
+                            if breaker is not None:
+                                breaker.record(False)
+                            st.used_dev = None
                     ref_used, ref_touched = _full_usage(base, rows_fn)
                     if not np.array_equal(used, ref_used):
                         GUARD_MISMATCHES += 1

@@ -473,6 +473,9 @@ def sharded_fused_pass(
     key = (_mesh_cache_key(mesh), meta_s, meta_d, u_pad, n_pad,
            with_networks, with_dp, with_scores, slot_m, k_cand,
            max_rounds, window_nnz, compact_u16)
+    from ..ops import kernels as _kernels
+
+    _kernels.note_signature("sharded_fused_pass", key)
     fn = _FUSED_MESH_CACHE.get(key)
     if fn is None:
         fn = _build_fused_mesh_fn(
@@ -514,11 +517,13 @@ def _build_fused_mesh_fn(mesh, *, meta_s, meta_d, u_pad, n_pad,
         ds = xfer.unpack_device(sbuf_l.reshape(-1), meta_s)
         dd = xfer.unpack_device(dyn, meta_d)
         # Quantized resource rows: one exact integer multiply per shard
-        # (the device twin of encode.dequantize_rows).
+        # (the device twin of encode.dequantize_rows; [2, 4] codebook —
+        # row 0 capacity, row 1 used baseline).
         if "res_scale" in ds:
-            scale = ds.pop("res_scale")[None, :]
-            ds["cap"] = ds.pop("cap_q").astype(jnp.int32) * scale
-            ds["used_base"] = ds.pop("used_base_q").astype(jnp.int32) * scale
+            scale = ds.pop("res_scale")
+            ds["cap"] = ds.pop("cap_q").astype(jnp.int32) * scale[0][None, :]
+            ds["used_base"] = (ds.pop("used_base_q").astype(jnp.int32)
+                               * scale[1][None, :])
         # Same materialization barrier as the single-chip program: keep
         # the packed-buffer decode out of the while/scan body.
         ds = dict(zip(ds.keys(),

@@ -27,6 +27,10 @@ os.environ.setdefault("NOMAD_TPU_COLUMNAR_GUARD_EVERY", "1")
 # against the pure-Python twin; one mismatch disables native and fails
 # the asserting tests.
 os.environ.setdefault("NOMAD_TPU_CODEC_GUARD_EVERY", "1")
+# Packed-result decode native/twin differential guard at EVERY call
+# (ISSUE 13): every COO expand / last-commit-score dedup in the suite is
+# bit-compared against the numpy/python twins.
+os.environ.setdefault("NOMAD_TPU_DECODE_GUARD_EVERY", "1")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

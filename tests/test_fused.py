@@ -174,17 +174,38 @@ class TestXferNarrowDtypes:
 # -- quantizer ----------------------------------------------------------------
 
 class TestQuantizeResourceRows:
-    def test_exact_int16_with_scale(self):
+    def test_int8_via_per_dim_scales(self):
+        # ISSUE 13: every dimension here divides down into the int8
+        # range (4000/32, 8192/128, 102400/1024, 150/2), so BOTH
+        # matrices ship int8 under per-matrix, per-dimension scales —
+        # this exact shape used to ride int16 under the shared codebook.
         cap = np.tile(np.array([4000, 8192, 102400, 150]), (16, 1))
         used = np.tile(np.array([120, 512, 0, 0]), (16, 1))
         q = encode.quantize_resource_rows(cap, used)
-        assert q is not None and q.tag == "i16"
-        # disk (102400) needs a scale; the others fit at 1.
-        assert q.scale.tolist() == [1, 1, 4, 1]
+        assert q is not None and q.cap_tag == "i8" and q.used_tag == "i8"
+        assert q.tag == "i8"
+        assert q.scale.shape == (2, 4)
+        assert q.scale[0].tolist() == [32, 128, 1024, 2]
         np.testing.assert_array_equal(
-            encode.dequantize_rows(q.cap_q, q.scale), cap)
+            encode.dequantize_rows(q.cap_q, q.scale[0]), cap)
         np.testing.assert_array_equal(
-            encode.dequantize_rows(q.used_q, q.scale), used)
+            encode.dequantize_rows(q.used_q, q.scale[1]), used)
+
+    def test_int16_when_int8_divisibility_fails(self):
+        # disk (102404) divides by 4 (int16 range) but not by the 1024
+        # the int8 range needs → that dimension stays int16-scaled and
+        # the capacity matrix ships int16; the all-zero used matrix
+        # still rides int8 independently (per-matrix dtypes).
+        cap = np.tile(np.array([4000, 8192, 102404, 150]), (16, 1))
+        used = np.zeros((16, 4), dtype=np.int64)
+        q = encode.quantize_resource_rows(cap, used)
+        assert q is not None and q.cap_tag == "i16" and q.used_tag == "i8"
+        assert q.tag == "i16"
+        assert q.scale[0].tolist() == [32, 128, 4, 2]
+        np.testing.assert_array_equal(
+            encode.dequantize_rows(q.cap_q, q.scale[0]), cap)
+        np.testing.assert_array_equal(
+            encode.dequantize_rows(q.used_q, q.scale[1]), used)
 
     def test_int8_when_ranges_allow(self):
         cap = np.tile(np.array([100, 120, 64, 50]), (4, 1))
@@ -192,7 +213,7 @@ class TestQuantizeResourceRows:
         q = encode.quantize_resource_rows(cap, used)
         assert q is not None and q.tag == "i8"
         np.testing.assert_array_equal(
-            encode.dequantize_rows(q.cap_q, q.scale), cap)
+            encode.dequantize_rows(q.cap_q, q.scale[0]), cap)
 
     def test_non_divisible_refuses(self):
         # 100001 needs scale 4 but is odd — exactness impossible, so the
@@ -207,11 +228,11 @@ class TestQuantizeResourceRows:
         q = encode.quantize_resource_rows(cap, np.zeros_like(cap))
         brk = KernelCircuitBreaker(threshold=0.9, window=8, min_checks=1,
                                    cooldown=3600.0)
-        assert resident.check_quant_roundtrip(cap, q.cap_q, q.scale,
+        assert resident.check_quant_roundtrip(cap, q.cap_q, q.scale[0],
                                               breaker=brk)
         bad = np.array(q.cap_q)
         bad[2, 1] += 3
-        assert not resident.check_quant_roundtrip(cap, bad, q.scale,
+        assert not resident.check_quant_roundtrip(cap, bad, q.scale[0],
                                                   breaker=brk)
         assert resident.QUANT_MISMATCHES == 1
         assert brk.agreement() < 1.0
@@ -414,3 +435,95 @@ class TestFusedCorruption:
         st3, placed3 = batch()
         assert st3.oracle_routed == 0 and st3.fused == 1 and placed3
         assert brk.state == "closed"
+
+
+# -- packed-result decode twins (ISSUE 13) -----------------------------------
+
+class TestNativeDecode:
+    """native/decode.cc vs the numpy/python twins on seeded COO shapes
+    (the conftest pins NOMAD_TPU_DECODE_GUARD_EVERY=1, so every guarded
+    call in the batch path is ALSO twin-verified; these pin the module
+    directly, including twin-only edge shapes)."""
+
+    def _corpus(self, seed, n_specs=13, n_real=97):
+        import random
+        rng = random.Random(seed)
+        rows, cols, cnts, scs, cos = [], [], [], [], []
+        for u in range(n_specs):
+            for _ in range(rng.randrange(0, 7)):
+                rows.append(u)
+                cols.append(rng.randrange(n_real))
+                cnts.append(rng.randrange(1, 5))
+                scs.append(rng.random() * 18.0)
+                cos.append(rng.randrange(0, 3))
+        return (np.array(rows, np.int32), np.array(cols, np.int32),
+                np.array(cnts, np.int32), np.array(scs, np.float32),
+                np.array(cos, np.int32), n_specs, n_real)
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 9])
+    def test_expand_matches_twin(self, seed):
+        from nomad_tpu.ops import decode
+        decode.reset_counters()
+        rows, cols, cnts, _, _, n_specs, n_real = self._corpus(seed)
+        off, exp = decode.expand_coo(rows, cols, cnts, n_specs, n_real,
+                                     int(cnts.sum()))
+        ref_off, ref_exp = decode._expand_twin(rows, cols, cnts,
+                                               n_specs, n_real)
+        np.testing.assert_array_equal(off, ref_off)
+        np.testing.assert_array_equal(exp, ref_exp)
+        assert decode.GUARD_MISMATCHES == 0
+        decode.reset_counters()
+
+    @pytest.mark.parametrize("seed", [0, 3, 7])
+    def test_last_scores_matches_twin(self, seed):
+        from nomad_tpu.ops import decode
+        decode.reset_counters()
+        rows, cols, cnts, scs, cos, n_specs, n_real = self._corpus(seed)
+        out = decode.last_scores(rows, cols, scs, cos, n_specs, n_real)
+        ref = decode._last_scores_twin(rows, cols, scs, cos, n_specs,
+                                       n_real)
+        for a, b in zip(out, ref):
+            np.testing.assert_array_equal(a, b)
+        assert decode.GUARD_MISMATCHES == 0
+        decode.reset_counters()
+
+    def test_empty_and_all_invalid(self):
+        from nomad_tpu.ops import decode
+        rows = np.array([-1, -1], np.int32)
+        cols = np.array([5, 6], np.int32)
+        cnts = np.array([1, 1], np.int32)
+        off, exp = decode.expand_coo(rows, cols, cnts, 4, 10, 2)
+        assert off.tolist() == [0, 0, 0, 0, 0] and len(exp) == 0
+        off2, exp2 = decode.expand_coo(
+            np.zeros(0, np.int32), np.zeros(0, np.int32),
+            np.zeros(0, np.int32), 3, 10, 0)
+        assert off2.tolist() == [0, 0, 0, 0] and len(exp2) == 0
+
+
+# -- compile-cache audit (ISSUE 13) ------------------------------------------
+
+class TestCompileAudit:
+    def test_same_shape_stream_compiles_once(self):
+        """A stream of same-shape batches must add NO new placement-
+        program signatures after the first — the recompile ceiling the
+        bench --check guards at 200 batches rides this counter."""
+        from nomad_tpu.ops import kernels
+
+        h = Harness()
+        for _ in range(8):
+            h.state.upsert_node(h.next_index(), make_node())
+
+        def one_batch():
+            job = make_job(2)
+            h.state.upsert_job(h.next_index(), job)
+            sched = TPUBatchScheduler(h.logger, h.snapshot(), h)
+            sched.schedule_batch([reg_eval(job)])
+
+        one_batch()
+        one_batch()   # resident-hit shape (no u_rows in the dyn pack)
+        base = kernels.compile_signatures()
+        for _ in range(4):
+            one_batch()
+        assert kernels.compile_signatures() == base, (
+            "steady same-shape batches must not mint new program "
+            "signatures")

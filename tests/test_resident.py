@@ -344,3 +344,131 @@ class TestPipelinedStream:
                 assert len(srv.state.allocs_by_job(None, j.id, True)) == 2
         finally:
             srv.shutdown()
+
+
+class TestDonatedDeviceMirror:
+    """ISSUE 13: the donated device-resident usage mirror.
+
+    The mirror is loaned to the fused kernel as a donated jit argument
+    and returned aliased; ops/resident.py catches it up in place with
+    donated scatter-adds.  These tests pin (a) bit-identity of the
+    mirror and of placements against the sparse-delta upload path after
+    N donated applies, and (b) that the PR 5 differential guard +
+    breaker still fire when the mirror is corrupted under the donated
+    regime (fault point ``ops.resident_state``)."""
+
+    def _build(self, n_nodes=8):
+        h = Harness()
+        for i in range(n_nodes):
+            node = make_node()
+            node.id = f"dev-node-{i:02d}"
+            node.name = node.id
+            h.state.upsert_node(h.next_index(), node)
+        return h
+
+    def _stream(self, h, batches, **sched_kwargs):
+        placements = []
+        for _ in range(batches):
+            job = make_job(2)
+            schedule(h, [job], **sched_kwargs)
+            placements.append(sorted(
+                a.node_id for a in h.state.allocs_by_job(None, job.id,
+                                                         True)))
+        return placements
+
+    def test_donated_applies_bit_identical_to_delta_path(self, monkeypatch):
+        monkeypatch.setenv("NOMAD_TPU_RNG_SEED", "424242")
+
+        monkeypatch.setenv("NOMAD_TPU_RESIDENT_DEVICE", "1")
+        h_dev = self._build()
+        pl_dev = self._stream(h_dev, 5)
+        assert resident.DEV_INSTALLS == 1, (
+            "the device mirror must install exactly once and then "
+            "round-trip in place")
+        assert resident.DEV_APPLIES >= 4
+        st = resident._STATE
+        assert st is not None and st.used_dev is not None
+        np.testing.assert_array_equal(
+            np.asarray(st.used_dev).astype(np.int64), st.used)
+        host_mirror = st.used.copy()
+
+        resident.reset_counters()
+        monkeypatch.setenv("NOMAD_TPU_RESIDENT_DEVICE", "0")
+        h_dl = self._build()
+        pl_dl = self._stream(h_dl, 5)
+        assert resident.DEV_INSTALLS == 0 and resident.DEV_APPLIES == 0
+        assert pl_dev == pl_dl
+        np.testing.assert_array_equal(resident._STATE.used, host_mirror)
+
+    def test_take_give_loan_protocol(self, monkeypatch):
+        monkeypatch.setenv("NOMAD_TPU_RESIDENT_DEVICE", "1")
+        h = self._build()
+        self._stream(h, 2)
+        st = resident._STATE
+        assert st is not None and st.used_dev is not None
+        key, idx = st.key, st.alloc_index
+        # A stale (older-index) taker gets nothing and must not steal
+        # the mirror.
+        assert resident.take_device_used(key, idx - 1, st.used) is None
+        assert st.used_dev is not None
+        # The matching taker gets the loan; the slot empties while out.
+        dev = resident.take_device_used(key, idx, st.used)
+        assert dev is not None and st.used_dev is None
+        # Giving back under a moved-on index drops the handle.
+        resident.give_device_used(key, idx - 1, dev)
+        assert st.used_dev is None
+        resident.give_device_used(key, idx, dev)
+        assert st.used_dev is dev
+
+    def test_corrupted_donated_mirror_trips_guard_and_breaker(
+            self, monkeypatch):
+        """The chaos fault perturbs host AND device mirrors identically
+        (mirror drift); the differential guard catches it, feeds the
+        breaker, and invalidates — dropping the donated buffer too."""
+        monkeypatch.setenv("NOMAD_TPU_RESIDENT_DEVICE", "1")
+        brk = KernelCircuitBreaker(threshold=0.9, window=8, min_checks=1,
+                                   cooldown=3600.0)
+        h = self._build()
+        schedule(h, [make_job(2)], breaker=brk)      # cold install
+        schedule(h, [make_job(2)], breaker=brk)      # donated apply
+        assert resident.DEV_APPLIES >= 1
+
+        with fault.scenario({"seed": 5, "faults": [
+                {"point": "ops.resident_state", "action": "corrupt",
+                 "times": 1}]}):
+            job = make_job(2)
+            stats = schedule(h, [job], breaker=brk)
+
+        assert resident.GUARD_MISMATCHES == 1
+        assert brk.state == "open", brk.state
+        assert resident._STATE is None or resident._STATE.used_dev is None
+        assert stats.full_reencodes == 1
+        assert len([a for a in h.state.allocs_by_job(None, job.id, True)
+                    if not a.terminal_status()]) == 2
+
+    def test_device_mirror_drift_guard(self, monkeypatch):
+        """Drift in the DONATED buffer alone (host mirror clean — the
+        aliasing-bug twin) is caught by the device-vs-host compare at
+        guard cadence: breaker fed, donated buffer dropped, host mirror
+        survives."""
+        import jax.numpy as jnp
+
+        monkeypatch.setenv("NOMAD_TPU_RESIDENT_DEVICE", "1")
+        brk = KernelCircuitBreaker(threshold=0.9, window=8, min_checks=1,
+                                   cooldown=3600.0)
+        h = self._build()
+        schedule(h, [make_job(2)], breaker=brk)
+        schedule(h, [make_job(2)], breaker=brk)
+        st = resident._STATE
+        assert st is not None and st.used_dev is not None
+        # Perturb ONLY the device copy.
+        st.used_dev = jnp.asarray(np.asarray(st.used_dev)
+                                  + np.int32(7))
+        job = make_job(2)
+        schedule(h, [job], breaker=brk)
+        assert resident.DEV_GUARD_MISMATCHES == 1
+        assert brk.agreement() < 1.0
+        st = resident._STATE
+        assert st is None or st.used_dev is None or \
+            np.array_equal(np.asarray(st.used_dev).astype(np.int64),
+                           st.used)
