@@ -110,6 +110,27 @@ MESH10M_RESERVE_S = 800
 # guard-forced full re-encode shape.
 COMPILE_BUDGET_STEADY = 6
 
+# config_mesh_steady (ISSUE 14): the mesh twin of config_steady — a
+# WARM sharded 1M-node cluster (one live alloc per node) served a
+# 200-small-batch stream through the donated per-shard usage mirror +
+# double-buffered pipeline in the forced-8-device subprocess.  The
+# steady state ships NO per-batch usage upload (the sharded mirror is
+# caught up in place by shard-routed donated scatter-adds), so the
+# guarded metrics are sustained placed/s, delta-apply seconds,
+# h2d bytes/batch, guard mismatches == 0, and the compile ceiling.
+MESH_STEADY_N_NODES = 1_000_000
+MESH_STEADY_BATCHES = 200
+MESH_STEADY_CHILD_ENV = "NOMAD_TPU_BENCH_MESH_STEADY_CHILD"
+# Child-budget extension + the slice reserved for config_mesh while
+# config_mesh_steady runs first.
+MESH_STEADY_BUDGET_S = 600
+MESH_RESERVE_S = 400
+# Signatures minted across the steady mesh stream: ONE fused program
+# shape (cold and steady batches share the no-upload meta), the mirror
+# install, and a few pow2 buckets of the shard-routed delta apply;
+# headroom for a guard-forced full re-encode shape.
+COMPILE_BUDGET_MESH_STEADY = 8
+
 
 def mesh10m_enabled() -> bool:
     flag = os.environ.get(MESH10M_ENV, "").strip().lower()
@@ -135,6 +156,27 @@ def build_cluster(h, n_nodes, n_dcs: int = 1):
             node.datacenter = f"dc{i % n_dcs}"
         node.computed_class = base.computed_class or "v1:bench"
         h.state.upsert_node(h.next_index(), node)
+
+
+def warm_cluster_slab(h, n_warm: int):
+    """One live alloc on each of the first ``n_warm`` build_cluster
+    nodes via ONE lazy slab (O(1) columnar commit) — the production
+    steady-state usage footprint the mesh phases warm with.  Lives next
+    to build_cluster because it must mint the same ``node-{i:06d}`` id
+    format: a drifted format would silently warm an empty usage
+    footprint while the phases still report headline numbers."""
+    from nomad_tpu.structs import structs as s
+
+    warm_job = make_job(0)
+    h.state.upsert_job(h.next_index(), warm_job)
+    h.state.upsert_slabs(h.next_index(), [s.AllocSlab(
+        proto=s.Allocation(job_id=warm_job.id, job=warm_job,
+                           task_group="web",
+                           resources=s.Resources(cpu=100, memory_mb=128)),
+        ids=s.LazyUuids(n_warm),
+        names=s.LazyNames(n_warm, f"{warm_job.name}.web"),
+        node_ids=[f"node-{i:06d}" for i in range(n_warm)],
+        prev_ids=[])])
 
 
 def make_job(count, constrained=False, datacenters=None):
@@ -881,6 +923,8 @@ def bench_steady(n_nodes: int = E_N_NODES, n_batches: int = 200,
         hits = sum(stt.resident_hits for stt in stats_list)
         delta_rows = sum(stt.delta_rows for stt in stats_list)
         overlap_s = sum(stt.pipeline_overlap_s for stt in stats_list)
+        delta_apply_s = sum(stt.delta_apply_seconds for stt in stats_list)
+        h2d_total = sum(stt.h2d_bytes for stt in stats_list)
         mismatches = resident.GUARD_MISMATCHES
         guard_runs = resident.GUARD_RUNS
     finally:
@@ -914,6 +958,11 @@ def bench_steady(n_nodes: int = E_N_NODES, n_batches: int = 200,
         "batch_p95_ms": round(samp_on["p95"], 2),
         "resident_hits": hits, "delta_rows": delta_rows,
         "pipeline_overlap_s": round(overlap_s, 3),
+        # ISSUE 14 transfer accounting (single-chip leg; the mesh twin
+        # lives in config_mesh_steady): donated delta-apply wall time
+        # and host→device bytes per batch across the ON stream.
+        "delta_apply_s": round(delta_apply_s, 4),
+        "h2d_bytes_per_batch": h2d_total // max(1, n_batches),
         "batch_latency_note": (
             "ON p50/p95 are per-batch wall latencies inside the pipeline "
             "(they include interleaved neighbor host phases)"),
@@ -1173,6 +1222,8 @@ def run_config(n_nodes: int, n_jobs: int, count_per_job: int, label: str,
             "fetch_s": round(stats.fetch_seconds, 3),
             "metrics_s": round(stats.metrics_seconds, 3),
             "finalize_s": round(stats.finalize_seconds, 3),
+            "h2d_bytes": stats.h2d_bytes,
+            "delta_apply_s": round(stats.delta_apply_seconds, 6),
         },
         "commit_fetch_s": round(
             stats.commit_seconds + stats.fetch_seconds, 3),
@@ -1378,6 +1429,57 @@ def _mesh_child_main() -> int:
     score_single = _mesh_scorefit(h, single_pl, ask_by_key)
     delta_pct = (100.0 * (score_single - score_mesh) / score_single
                  if score_single else 0.0)
+
+    # Delta-apply A/B (ISSUE 14): warm the cluster with one live alloc
+    # per node (min(n, 1M) slab rows — O(1) columnar commit), then
+    # measure a steady small batch per mode: the donated per-shard
+    # mirror vs the replicated u_rows/u_vals upload.  The h2d bytes and
+    # delta-apply seconds here ARE the host residue this round removes
+    # from the mesh steady state; BENCH_r*.json carries both sides.
+    from nomad_tpu.ops import resident as _res
+
+    n_warm = min(n_nodes, 1_000_000)
+    warm_cluster_slab(h, n_warm)
+
+    def ab_leg(device_mirror):
+        os.environ["NOMAD_TPU_RESIDENT_DEVICE"] = (
+            "1" if device_mirror else "0")
+        _res.invalidate()
+        stats = None
+        for _ in range(3):   # cold install + 2 steady delta batches
+            job = make_job(8)
+            h.state.upsert_job(h.next_index(), job)
+            sched = TPUBatchScheduler(h.logger, h.snapshot(), h,
+                                      mesh=mesh)
+            stats = sched.schedule_batch([reg_eval(job)])
+        return {
+            "h2d_bytes": stats.h2d_bytes,
+            "delta_apply_s": round(stats.delta_apply_seconds, 6),
+            "encode_s": round(stats.encode_seconds, 3),
+            "commit_s": round(stats.commit_seconds, 3),
+            "total_s": round(stats.total_seconds, 3),
+            "resident_hit": bool(stats.resident_hits),
+        }
+
+    saved_dev = os.environ.get("NOMAD_TPU_RESIDENT_DEVICE")
+    try:
+        ab_donated = ab_leg(True)
+        ab_upload = ab_leg(False)
+    finally:
+        if saved_dev is None:
+            os.environ.pop("NOMAD_TPU_RESIDENT_DEVICE", None)
+        else:
+            os.environ["NOMAD_TPU_RESIDENT_DEVICE"] = saved_dev
+        _res.invalidate()
+    h2d_reduction = (ab_upload["h2d_bytes"]
+                     / max(1, ab_donated["h2d_bytes"]))
+    log(f"config-mesh: steady delta-apply A/B at {n_warm} warm allocs — "
+        f"donated mirror {ab_donated['h2d_bytes']}B h2d / "
+        f"{ab_donated['delta_apply_s']}s apply vs u_rows upload "
+        f"{ab_upload['h2d_bytes']}B h2d ({h2d_reduction:.1f}x fewer "
+        f"bytes; encode {ab_donated['encode_s']}s vs "
+        f"{ab_upload['encode_s']}s)")
+
     out = {
         "nodes": n_nodes, "taskgroups": n_jobs * count,
         "mesh_devices": MESH_DEVICES, "seed": MESH_SEED,
@@ -1405,6 +1507,14 @@ def _mesh_child_main() -> int:
             "fetch_s": round(mesh_stats.fetch_seconds, 3),
             "metrics_s": round(mesh_stats.metrics_seconds, 3),
             "finalize_s": round(mesh_stats.finalize_seconds, 3),
+            "h2d_bytes": mesh_stats.h2d_bytes,
+            "delta_apply_s": round(mesh_stats.delta_apply_seconds, 6),
+        },
+        "delta_apply_ab": {
+            "warm_allocs": n_warm,
+            "donated_mirror": ab_donated,
+            "u_rows_upload": ab_upload,
+            "h2d_reduction_x": round(h2d_reduction, 1),
         },
         "single_chip": {
             "elapsed_s": round(single_s, 3),
@@ -1429,6 +1539,176 @@ def _mesh_child_main() -> int:
     }
     print(json.dumps(out), flush=True)
     return 0 if bit_identical else 1
+
+
+def _mesh_steady_child_main() -> int:
+    """Subprocess body for config_mesh_steady (ISSUE 14): forced
+    8-device virtual CPU mesh, a WARM ``n_nodes``-node cluster with one
+    live alloc per node (slab rows — the production steady-state
+    footprint), served a stream of small eval batches through the
+    sharded fused path with residency + the donated per-shard usage
+    mirror + the double-buffered pipeline all ON.  The steady state
+    must ship NO per-batch usage upload: after the cold install the
+    mirror is caught up in place by shard-routed donated scatter-adds,
+    and the compile-signature ceiling pins the stream to a fixed
+    handful of program shapes (the shared encode.shape_plan bucketing).
+    Prints ONE JSON line."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    os.environ["NOMAD_TPU_RNG_SEED"] = str(MESH_SEED)
+    os.environ["NOMAD_TPU_RESIDENT"] = "1"
+    os.environ["NOMAD_TPU_RESIDENT_DEVICE"] = "1"
+    n_nodes = int(os.environ.get("NOMAD_TPU_BENCH_MESH_STEADY_NODES",
+                                 MESH_STEADY_N_NODES))
+    n_batches = int(os.environ.get("NOMAD_TPU_BENCH_MESH_STEADY_BATCHES",
+                                   MESH_STEADY_BATCHES))
+    evals_per_batch = 4
+    count_per_eval = 5
+
+    from nomad_tpu.ops import kernels as _kernels
+    from nomad_tpu.ops import resident
+    from nomad_tpu.ops.batch_sched import TPUBatchScheduler
+    from nomad_tpu.parallel import make_node_mesh
+    from nomad_tpu.scheduler import Harness
+    from nomad_tpu.utils.telemetry import InmemSink
+
+    devs = jax.devices()
+    assert len(devs) >= MESH_DEVICES, f"need {MESH_DEVICES} devices"
+    mesh = make_node_mesh(devs[:MESH_DEVICES])
+
+    t0 = time.monotonic()
+    h = Harness()
+    build_cluster(h, n_nodes)
+    # Warm usage: one live alloc per node via ONE slab (lazy columns),
+    # so every batch's delta feed rides over a full production-scale
+    # usage footprint — exactly what the replicated u_rows upload used
+    # to re-ship per batch.
+    warm_cluster_slab(h, n_nodes)
+    build_s = time.monotonic() - t0
+    log(f"config-mesh-steady: built {n_nodes} warm nodes (1 alloc/node) "
+        f"in {build_s:.1f}s")
+
+    def new_batch():
+        jobs = [make_job(count_per_eval) for _ in range(evals_per_batch)]
+        for j in jobs:
+            h.state.upsert_job(h.next_index(), j)
+        return jobs, [reg_eval(j) for j in jobs]
+
+    resident.reset_counters()
+    # XLA warm-up + sharded-mirror install (NullPlanner: state
+    # untouched, so the timed stream starts on a warm compile cache AND
+    # a warm mirror — the steady state being measured).
+    _, wevals = new_batch()
+    warm = TPUBatchScheduler(h.logger, h.snapshot(), NullPlanner(),
+                             mesh=mesh)
+    t0 = time.monotonic()
+    warm.schedule_batch(wevals)
+    compile_s = time.monotonic() - t0
+
+    all_jobs, batches = [], []
+    for _ in range(n_batches):
+        jobs, evals = new_batch()
+        all_jobs.extend(jobs)
+        batches.append(evals)
+    sched = TPUBatchScheduler(h.logger, h.snapshot(), h, mesh=mesh)
+    compiles_before = _kernels.compile_signatures()
+    installs_before = resident.DEV_INSTALLS
+    t0 = time.monotonic()
+    stats_list = sched.schedule_stream(
+        batches, state_source=lambda: h.snapshot())
+    elapsed = time.monotonic() - t0
+    placed = total_placed(h, all_jobs)
+    batch_compiles = _kernels.compile_signatures() - compiles_before
+
+    sink = InmemSink(interval=3600.0)
+    for stt in stats_list:
+        sink.add_sample("steady.batch", stt.total_seconds * 1000.0)
+    samp = sink.latest()["Samples"]["steady.batch"]
+    hits = sum(stt.resident_hits for stt in stats_list)
+    delta_rows = sum(stt.delta_rows for stt in stats_list)
+    delta_apply_s = sum(stt.delta_apply_seconds for stt in stats_list)
+    h2d_total = sum(stt.h2d_bytes for stt in stats_list)
+    mesh_batches = sum(1 for stt in stats_list if stt.mesh_shards)
+    rate = placed / elapsed if elapsed else 0.0
+
+    log(f"config-mesh-steady: {n_batches} batches x {evals_per_batch} "
+        f"evals x {count_per_eval} tgs on the warm {n_nodes}-node mesh: "
+        f"{placed} placed in {elapsed:.2f}s → {rate:.0f}/s (p50 "
+        f"{samp['p50']:.1f}ms p95 {samp['p95']:.1f}ms, {hits}/{n_batches}"
+        f" delta hits, {delta_rows} delta rows, donated applies "
+        f"{resident.DEV_APPLIES}, installs "
+        f"{resident.DEV_INSTALLS - installs_before}, h2d "
+        f"{h2d_total // max(1, n_batches)}B/batch, delta-apply "
+        f"{delta_apply_s:.3f}s total, compiles {batch_compiles}, guard "
+        f"{resident.GUARD_RUNS} runs / {resident.GUARD_MISMATCHES} "
+        f"mismatches)")
+    out = {
+        "nodes": n_nodes, "warm_allocs": n_nodes,
+        "mesh_devices": MESH_DEVICES, "seed": MESH_SEED,
+        "batches": n_batches, "evals_per_batch": evals_per_batch,
+        "taskgroups_per_eval": count_per_eval,
+        "placed": placed,
+        "elapsed_s": round(elapsed, 3),
+        "sustained_placed_per_s": round(rate, 1),
+        "batch_p50_ms": round(samp["p50"], 2),
+        "batch_p95_ms": round(samp["p95"], 2),
+        "resident_hits": hits, "delta_rows": delta_rows,
+        "mesh_batches": mesh_batches,
+        "dev_installs": resident.DEV_INSTALLS - installs_before,
+        "dev_applies": resident.DEV_APPLIES,
+        "delta_apply_s": round(delta_apply_s, 4),
+        "h2d_bytes_per_batch": h2d_total // max(1, n_batches),
+        "guard_runs": resident.GUARD_RUNS,
+        "guard_mismatches": resident.GUARD_MISMATCHES,
+        "dev_guard_mismatches": resident.DEV_GUARD_MISMATCHES,
+        "batch_compiles": batch_compiles,
+        "compile_budget": COMPILE_BUDGET_MESH_STEADY,
+        "signature_kinds": _kernels.signature_kinds(),
+        "compile_warmup_s": round(compile_s, 3),
+        "cluster_build_s": round(build_s, 1),
+        "platform": str(jax.devices()[0].platform),
+        "acceptance_note": (
+            "guarded on sustained placed/s vs the latest BENCH_r*.json, "
+            "guard mismatches == 0, every steady batch a mesh pass, and "
+            "the compile ceiling; after the one cold install the stream "
+            "ships no per-batch usage upload (h2d_bytes_per_batch is "
+            "dyn-buffer + shard-routed delta runs only)"),
+    }
+    print(json.dumps(out), flush=True)
+    ok = (resident.GUARD_MISMATCHES == 0 and mesh_batches == n_batches
+          and hits >= n_batches - 1)
+    return 0 if ok else 1
+
+
+def bench_mesh_steady(deadline_s: int = 600, n_batches: int = None,
+                      n_nodes: int = None) -> dict:
+    """config_mesh_steady driver: spawn the forced-8-device subprocess
+    (same recipe as bench_mesh) and parse its one JSON line."""
+    import subprocess
+
+    from nomad_tpu.utils.platform import virtual_mesh_env
+
+    env = virtual_mesh_env(MESH_DEVICES)
+    env[MESH_STEADY_CHILD_ENV] = "1"
+    env.pop(CHILD_ENV, None)
+    if n_batches is not None:
+        env["NOMAD_TPU_BENCH_MESH_STEADY_BATCHES"] = str(n_batches)
+    if n_nodes is not None:
+        env["NOMAD_TPU_BENCH_MESH_STEADY_NODES"] = str(n_nodes)
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)], env=env,
+        timeout=deadline_s, capture_output=True, text=True)
+    for line in (proc.stderr or "").splitlines():
+        log(f"  {line}")
+    lines = [ln for ln in (proc.stdout or "").splitlines() if ln.strip()]
+    if not lines:
+        raise RuntimeError(
+            f"config_mesh_steady child produced no output "
+            f"(rc={proc.returncode})")
+    out = json.loads(lines[-1])
+    out["child_rc"] = proc.returncode
+    return out
 
 
 def bench_snapshot(legacy: bool = True) -> dict:
@@ -1617,6 +1897,10 @@ def _child_main():
     flush()
     if not budget_s:
         budget_s = DEGRADED_BUDGET_S if degraded else TOTAL_BUDGET_S
+    # The mesh family needs real wall time: config_mesh_steady (ISSUE
+    # 14) runs on its own extension so it never starves the classic
+    # phases, and the opt-in 10M point extends further.
+    budget_s += MESH_STEADY_BUDGET_S
     if mesh10m_enabled():
         budget_s += MESH10M_BUDGET_S  # the opt-in 10M-node mesh point
     budget = _Budget(budget_s)
@@ -1807,6 +2091,26 @@ def _child_main():
     if snap_ph is not None:
         detail["config_snapshot"] = snap_ph
 
+    # The mesh steady state (ISSUE 14): a warm sharded 1M-node cluster
+    # served a 200-small-batch stream over the donated per-shard usage
+    # mirror, in its own forced-8-device subprocess.  Runs BEFORE
+    # config_mesh with a reserve so both fit; a squeeze skips it (the
+    # --check guard measures it fresh either way).
+    rem_ms = budget.remaining()
+    steady_budget = int(min(
+        MESH_STEADY_BUDGET_S,
+        rem_ms - MESH_RESERVE_S
+        - (MESH10M_RESERVE_S if mesh10m_enabled() else 0)))
+    if steady_budget > 180:
+        ms = phase("config_mesh_steady", steady_budget,
+                   bench_mesh_steady, deadline_s=steady_budget - 10)
+        if ms is not None:
+            detail["config_mesh_steady"] = ms
+    else:
+        detail["config_mesh_steady"] = {
+            "skipped": f"global budget exhausted ({rem_ms:.0f}s left)"}
+    flush()
+
     # The ROADMAP scale axis (ISSUE 8): 1M nodes x 10M tgs through the
     # fused node-sharded path in its own forced-8-device subprocess.
     # Runs LAST on whatever budget remains — the subprocess is outside
@@ -1922,7 +2226,7 @@ def _extract_baseline_numbers(doc: dict):
     import re
 
     ns = p95 = ce = steady = cf = ctl = ctl_p99 = mesh_rate = None
-    mesh_encode = snap_s = mesh10m_rate = None
+    mesh_encode = snap_s = mesh10m_rate = mesh_steady_rate = None
     parsed = doc.get("parsed")
     if isinstance(parsed, dict):
         det = parsed.get("detail") or parsed
@@ -1945,6 +2249,8 @@ def _extract_baseline_numbers(doc: dict):
             "snapshot_restore_s")
         mesh10m_rate = (det.get("config_mesh_10m")
                         or {}).get("sustained_placed_per_s")
+        mesh_steady_rate = (det.get("config_mesh_steady")
+                            or {}).get("sustained_placed_per_s")
     tail = doc.get("tail") or ""
     if ns is None:
         m = re.search(r'"config_northstar_10k_x_1m":\s*\{[^{}]*?'
@@ -1997,8 +2303,12 @@ def _extract_baseline_numbers(doc: dict):
         m = re.search(r'"config_mesh_10m":\s*\{[^{}]*?'
                       r'"sustained_placed_per_s":\s*([0-9.]+)', tail)
         mesh10m_rate = float(m.group(1)) if m else None
+    if mesh_steady_rate is None:
+        m = re.search(r'"config_mesh_steady":\s*\{[^{}]*?'
+                      r'"sustained_placed_per_s":\s*([0-9.]+)', tail)
+        mesh_steady_rate = float(m.group(1)) if m else None
     return (ns, p95, ce, steady, cf, ctl, ctl_p99, mesh_rate,
-            mesh_encode, snap_s, mesh10m_rate)
+            mesh_encode, snap_s, mesh10m_rate, mesh_steady_rate)
 
 
 def _latest_bench_baseline():
@@ -2006,7 +2316,8 @@ def _latest_bench_baseline():
     (name, ns_s, p95_ms, config_e_s, steady_placed_per_s,
     northstar_commit_fetch_s, control_evals_per_s,
     control_s2r_p99_ms, mesh_placed_per_s, mesh_encode_s,
-    snapshot_restore_s, mesh10m_placed_per_s)."""
+    snapshot_restore_s, mesh10m_placed_per_s,
+    mesh_steady_placed_per_s)."""
     import glob
 
     here = os.path.dirname(os.path.abspath(__file__))
@@ -2020,7 +2331,7 @@ def _latest_bench_baseline():
         nums = _extract_baseline_numbers(doc)
         if any(v is not None for v in nums):
             return (os.path.basename(path),) + nums
-    return (None,) * 12
+    return (None,) * 13
 
 
 def _loadgen_follower_baseline():
@@ -2074,7 +2385,7 @@ def _check_main(argv) -> int:
 
     (baseline_file, base_ns, base_p95, base_ce, base_steady, base_cf,
      base_ctl, base_ctl_p99, base_mesh, base_mesh_enc,
-     base_snap, base_mesh10m) = _latest_bench_baseline()
+     base_snap, base_mesh10m, base_mesh_steady) = _latest_bench_baseline()
     out = {"check": "bench-regression", "baseline": baseline_file,
            "threshold": threshold}
     if baseline_file is None:
@@ -2442,6 +2753,55 @@ def _check_main(argv) -> int:
         out["config_mesh_placed_per_s"] = {"error": repr(exc)}
         failures.append(f"config_mesh phase failed: {exc!r}")
 
+    # Mesh steady state (ISSUE 14): the donated per-shard usage mirror
+    # must hold sustained mesh throughput (vs the latest recorded
+    # point), a zero-mismatch differential guard, every steady batch on
+    # the sharded fused path, and the compile-signature ceiling —
+    # reduced batch count keeps the check fast; sustained rate is
+    # warm-state, so it compares like-for-like with the full run.
+    try:
+        msd = bench_mesh_steady(deadline_s=900, n_batches=60)
+        cur_ms = float(msd["sustained_placed_per_s"])
+        out["config_mesh_steady_placed_per_s"] = {
+            "baseline": base_mesh_steady, "current": cur_ms,
+            "ratio": (round(cur_ms / base_mesh_steady, 3)
+                      if base_mesh_steady else None),
+            "guard_mismatches": msd["guard_mismatches"],
+            "delta_apply_s": msd["delta_apply_s"],
+            "h2d_bytes_per_batch": msd["h2d_bytes_per_batch"]}
+        if (base_mesh_steady is not None
+                and cur_ms < base_mesh_steady / threshold):
+            failures.append(
+                f"config_mesh_steady sustained {cur_ms:.0f} placed/s is "
+                f"below baseline {base_mesh_steady:.0f}/{threshold}")
+        if msd["guard_mismatches"] or msd["dev_guard_mismatches"]:
+            failures.append(
+                f"config_mesh_steady differential guard reported "
+                f"{msd['guard_mismatches']} host + "
+                f"{msd['dev_guard_mismatches']} device mismatches")
+        if msd["mesh_batches"] < msd["batches"]:
+            failures.append(
+                f"config_mesh_steady: only {msd['mesh_batches']}/"
+                f"{msd['batches']} batches ran the sharded fused path")
+        if msd["dev_installs"] > 1:
+            failures.append(
+                f"config_mesh_steady reinstalled the sharded mirror "
+                f"{msd['dev_installs']} times — the steady state must "
+                "round-trip the donated buffer in place")
+        out["config_mesh_steady_batch_compiles"] = {
+            "current": msd.get("batch_compiles"),
+            "budget": COMPILE_BUDGET_MESH_STEADY,
+            "kinds": msd.get("signature_kinds")}
+        if msd.get("batch_compiles", 0) > COMPILE_BUDGET_MESH_STEADY:
+            failures.append(
+                f"config_mesh_steady stream minted "
+                f"{msd['batch_compiles']} placement-program signatures "
+                f"(budget {COMPILE_BUDGET_MESH_STEADY}) — a shape leak "
+                "recompiles at every scale")
+    except Exception as exc:
+        out["config_mesh_steady_placed_per_s"] = {"error": repr(exc)}
+        failures.append(f"config_mesh_steady phase failed: {exc!r}")
+
     # The 10M-node ceiling (ISSUE 13): same contract as config_mesh —
     # bit-identical to single-chip at the pinned seed (hard gate, no
     # baseline needed) + sustained placed/s vs the latest recorded
@@ -2487,6 +2847,8 @@ def _check_main(argv) -> int:
 
 
 def main():
+    if os.environ.get(MESH_STEADY_CHILD_ENV) == "1":
+        sys.exit(_mesh_steady_child_main())
     if os.environ.get(MESH_CHILD_ENV) == "1":
         sys.exit(_mesh_child_main())
     if "--check" in sys.argv[1:]:
@@ -2503,8 +2865,9 @@ def main():
     import tempfile
 
     t_start = time.monotonic()
-    parent_deadline_s = PARENT_DEADLINE_S + (MESH10M_BUDGET_S + 60
-                                             if mesh10m_enabled() else 0)
+    parent_deadline_s = (PARENT_DEADLINE_S + MESH_STEADY_BUDGET_S
+                         + (MESH10M_BUDGET_S + 60
+                            if mesh10m_enabled() else 0))
 
     def elapsed():
         return time.monotonic() - t_start

@@ -890,20 +890,24 @@ def residue_drill(seed: int = 0, log=print) -> bool:
 
 
 def mesh_drill_child(seed: int = 0, log=print, n_devices: int = 8) -> bool:
-    """Node-mesh drill body (requires ``n_devices`` jax devices — the
-    parent ``mesh_drill`` provisions a virtual CPU mesh): sharded cold
-    encode installs the mirror, a second batch applies usage deltas on
-    the owning shards with the differential guard armed at every hit, a
-    corrupted mirror row is attributed to its shard and trips the
-    breaker, and the open breaker routes the next batch through the CPU
-    oracle which still places everything."""
+    """Node-mesh residue drill body (requires ``n_devices`` jax devices
+    — the parent ``mesh_drill`` provisions a virtual CPU mesh): sharded
+    cold encode installs the DONATED per-shard usage mirror (ISSUE 14),
+    N delta batches catch it up in place via shard-routed donated
+    scatter-adds with the differential guard armed at every hit, the
+    device mirror bit-compares against the host walk, ONE corrupted
+    mirror row is attributed to its owning shard id (guard event) and
+    trips the breaker, and the open breaker routes the next batch
+    through the CPU oracle which still places everything."""
     import os
 
     import jax
+    import numpy as np
 
     from .. import fault, mock
     from ..parallel import make_node_mesh
     from ..scheduler import Harness
+    from ..server import event_broker
     from ..structs import structs as s
     from . import resident
     from .batch_sched import TPUBatchScheduler
@@ -920,14 +924,20 @@ def mesh_drill_child(seed: int = 0, log=print, n_devices: int = 8) -> bool:
         return False
     mesh = make_node_mesh(devs[:n_devices])
     saved = {k: os.environ.get(k) for k in
-             ("NOMAD_TPU_RESIDENT", "NOMAD_TPU_RESIDENT_GUARD_EVERY")}
+             ("NOMAD_TPU_RESIDENT", "NOMAD_TPU_RESIDENT_GUARD_EVERY",
+              "NOMAD_TPU_RESIDENT_DEVICE")}
     os.environ["NOMAD_TPU_RESIDENT"] = "1"
     os.environ["NOMAD_TPU_RESIDENT_GUARD_EVERY"] = "1"
+    os.environ["NOMAD_TPU_RESIDENT_DEVICE"] = "1"
     resident.reset_counters()
     brk = KernelCircuitBreaker(threshold=0.9, window=8, min_checks=1,
                                cooldown=3600.0)
+    h = Harness()
+    broker = event_broker.EventBroker(
+        index_source=lambda: h.state.latest_index())
+    event_broker.register(broker)
+    event_broker.clear_recent()
     try:
-        h = Harness()
         for _ in range(16):
             node = mock.node()
             node.resources.networks = []
@@ -960,13 +970,28 @@ def mesh_drill_child(seed: int = 0, log=print, n_devices: int = 8) -> bool:
                       f"({s1!r})")
                 and check(s1.full_reencodes == 1,
                           f"cold batch should full-encode ({s1!r})")
-                and check(p1, "cold mesh batch did not place")):
+                and check(p1, "cold mesh batch did not place")
+                and check(resident.DEV_INSTALLS == 1,
+                          f"sharded mirror should install exactly once "
+                          f"({resident.DEV_INSTALLS})")):
             return False
         s2, p2 = run_batch()
+        st = resident._STATE
         if not (check(s2.resident_hits == 1,
                       f"second batch should take the sharded delta path "
                       f"({s2!r})")
                 and check(p2, "delta batch did not place")
+                and check(resident.DEV_APPLIES >= 1,
+                          "no shard-routed donated delta apply ran")
+                and check(resident.DEV_INSTALLS == 1,
+                          "delta batch reinstalled the mirror instead "
+                          "of applying in place")
+                and check(st is not None and st.used_dev is not None
+                          and np.array_equal(
+                              np.asarray(st.used_dev).astype(np.int64),
+                              st.used),
+                          "sharded device mirror diverged from the "
+                          "host walk")
                 and check(resident.GUARD_RUNS >= 1
                           and resident.GUARD_MISMATCHES == 0,
                           "per-shard guard did not verify the delta "
@@ -976,8 +1001,18 @@ def mesh_drill_child(seed: int = 0, log=print, n_devices: int = 8) -> bool:
                 {"point": "ops.resident_state", "action": "corrupt",
                  "times": 1}]}):
             s3, p3 = run_batch()
+        mismatch_events = [
+            e for e in event_broker.recent()
+            if e.type == "NodeStateDelta"
+            and e.payload.get("Reason") == "guard_mismatch"]
+        bad_shards = (mismatch_events[-1].payload.get("Shards")
+                      if mismatch_events else None)
         if not (check(resident.GUARD_MISMATCHES == 1,
                       "guard missed the injected shard corruption")
+                and check(bad_shards is not None and len(bad_shards) == 1
+                          and 0 <= bad_shards[0] < n_devices,
+                          f"corruption not attributed to its owning "
+                          f"shard id (event Shards={bad_shards})")
                 and check(brk.state == "open",
                           f"breaker {brk.state!r}, expected open")
                 and check(p3, "corrupted-shard batch did not place")):
@@ -989,17 +1024,20 @@ def mesh_drill_child(seed: int = 0, log=print, n_devices: int = 8) -> bool:
                 and check(p4, "oracle-carried batch did not place")):
             return False
     finally:
+        event_broker.unregister(broker)
+        event_broker.clear_recent()
         for k, v in saved.items():
             if v is None:
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
         resident.reset_counters()
-    log(f"mesh drill: OK — {n_devices}-shard fused cold encode placed, "
-        "delta apply landed on the owning shards (guard verified "
-        "bit-identical), injected corruption was attributed to its "
-        "shard and tripped the breaker, and the oracle carried the "
-        "next batch")
+    log(f"mesh drill: OK — {n_devices}-shard fused cold encode installed "
+        "the donated per-shard mirror and placed, shard-routed donated "
+        "applies landed on the owning shards (device mirror bit-matched "
+        f"the host walk, guard verified), injected corruption was "
+        f"attributed to shard {bad_shards[0]} and tripped the breaker, "
+        "and the oracle carried the next batch")
     return True
 
 

@@ -64,14 +64,20 @@ MESH_SLOT_BUDGET_BYTES = 512 << 20
 
 # Static cluster-tensor cache: (nodes index, attr targets, literals,
 # with_networks) → finalized ClusterTensors (see _place_on_device).
-_CLUSTER_CACHE: Dict[Tuple, "encode.ClusterTensors"] = {}
+# Touch-on-hit LRUs (utils/lru.py): bounded like before, but hot
+# entries survive churn and evictions feed the
+# batch.program_cache_evictions gauge.
+from ..utils import lru as lru_mod
+from ..utils.lru import LRU
+
+_CLUSTER_CACHE = LRU(4)
 
 # Device-resident copies of the packed static cluster buffer, keyed by
 # CONTENT digest (not store identity): a rebuilt-but-identical cluster —
 # e.g. bench trials on fresh state stores — skips the multi-MB upload
 # entirely.  The tunneled link runs at single-digit MB/s, so re-shipping
 # the static tensors per batch dominated device time at 50k nodes.
-_DEVICE_STATIC_CACHE: Dict[Tuple, object] = {}
+_DEVICE_STATIC_CACHE = LRU(4)
 
 _cache_configured = False
 
@@ -182,6 +188,30 @@ def _corrupt_outputs(rng, spec_list, unplaced_arr, coo_counts):
     else:
         unplaced_arr[u] = -1
     return unplaced_arr, coo_counts
+
+
+class _TouchedNodeIds:
+    """Lazy view of the node ids whose usage rows the resident/columnar
+    encode touched (row indices into the encode layout).  The only
+    consumers are the preemption dispatch gate (``len`` — any live
+    allocs at all?) and its candidate enumeration (iteration, paid only
+    when preemption actually has unplaced high-priority work) — the old
+    per-batch ``{node_ids[i]: True for i in touched}`` comprehension
+    materialized a million-entry dict per steady batch at 1M warm
+    allocs (ISSUE 14)."""
+
+    __slots__ = ("_node_ids", "_rows")
+
+    def __init__(self, node_ids, rows):
+        self._node_ids = node_ids
+        self._rows = rows
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    def __iter__(self):
+        ids = self._node_ids
+        return (ids[i] for i in self._rows)
 
 
 class _CollectingScheduler(GenericScheduler):
@@ -345,7 +375,9 @@ class TPUBatchScheduler:
                        breaker_state=stats.breaker_state,
                        oracle_routed=stats.oracle_routed,
                        resident_hits=stats.resident_hits,
-                       delta_rows=stats.delta_rows)
+                       delta_rows=stats.delta_rows,
+                       h2d_bytes=stats.h2d_bytes,
+                       delta_apply_s=round(stats.delta_apply_seconds, 6))
                 if self.snapshot_index is not None:
                     sp.set(snapshot_index=self.snapshot_index)
         self._emit_batch_stats(stats)
@@ -378,6 +410,16 @@ class TPUBatchScheduler:
             # the percentile histogram's buckets are ms-calibrated and
             # would quantize MB-scale values into the top bucket.
             m.incr_counter("batch.fetch_bytes", stats.fetch_bytes)
+            # Host→device transfer accounting (ISSUE 14): split
+            # single-chip vs mesh so the sharded-mirror win is
+            # observable in /v1/metrics, not just the bench headline.
+            m.incr_counter("batch.mesh_h2d_bytes" if stats.mesh_shards
+                           else "batch.h2d_bytes", stats.h2d_bytes)
+            if stats.delta_apply_seconds:
+                m.add_sample(
+                    "batch.mesh_delta_apply" if stats.mesh_shards
+                    else "batch.delta_apply",
+                    stats.delta_apply_seconds * 1000.0)
             if stats.fused:
                 m.incr_counter("batch.fused", stats.fused)
             if stats.quantized:
@@ -410,6 +452,12 @@ class TPUBatchScheduler:
         # signatures seen process-wide — an upper bound on XLA compiles;
         # bench --check asserts a ceiling over the config_steady stream.
         m.set_gauge("batch.compiles", kernels.compile_signatures())
+        # Compiled-program / device-buffer cache recycling (ISSUE 14
+        # satellite): nonzero churn at steady state means the LRU caps
+        # are too small for the workload's shape diversity.
+        if lru_mod.EVICTIONS:
+            m.set_gauge("batch.program_cache_evictions",
+                        lru_mod.EVICTIONS)
         if stats.mesh_shards:
             m.incr_counter("batch.mesh_passes", 1)
             m.set_gauge("batch.mesh_shards", stats.mesh_shards)
@@ -710,6 +758,7 @@ class TPUBatchScheduler:
             stats.fused = kstats.get("fused", 0)
             stats.quantized = kstats.get("quantized", 0)
             stats.mesh_shards = kstats.get("mesh_shards", 0)
+            stats.h2d_bytes = kstats.get("h2d_bytes", 0)
             stats.preempt_placed = kstats.get("preempt_placed", 0)
             stats.preempt_evicted = kstats.get("preempt_evicted", 0)
             stats.preempt_checked = kstats.get("preempt_checked", 0)
@@ -737,6 +786,7 @@ class TPUBatchScheduler:
         stats.delta_rows = res_info.get("delta_rows", 0)
         stats.full_reencodes = 1 if res_info.get("full_reencode") else 0
         stats.staleness_fences = 1 if res_info.get("fence") else 0
+        stats.delta_apply_seconds = res_info.get("delta_apply_s", 0.0)
 
     def _route_through_oracle(self, scheds) -> None:
         """Degraded path: process each eval with the CPU GenericScheduler
@@ -877,6 +927,12 @@ class TPUBatchScheduler:
         _fetch_device consumes — the split point the double-buffered
         pipeline overlaps across batches."""
         t0 = time.monotonic()
+        # Host→device transfer accounting (ISSUE 14 satellite): the
+        # resident mirror's own uploads (installs + routed delta
+        # applies) happen inside acquire/take below; sample the module
+        # counter around the dispatch so BatchStats.h2d_bytes carries
+        # the whole per-batch H2D picture.
+        h2d0 = resident.DEV_H2D_BYTES
         # All DCs across the batch: nodes are encoded once.
         all_nodes = [n for n in self.state.nodes(None)]
 
@@ -904,9 +960,7 @@ class TPUBatchScheduler:
             cache_key = (store_uid, table_index("nodes"),
                          tuple(attr_targets), lit_key, with_networks,
                          pad_m)
-            base = _CLUSTER_CACHE.pop(cache_key, None)
-            if base is not None:
-                _CLUSTER_CACHE[cache_key] = base  # LRU touch-on-hit
+            base = _CLUSTER_CACHE.get(cache_key)
         if base is None:
             # Columnar path (ISSUE 9): slice the store's numpy mirrors
             # instead of walking a node object per row; differential
@@ -916,9 +970,7 @@ class TPUBatchScheduler:
                 with_networks=with_networks, node_pad_multiple=pad_m,
                 breaker=self.breaker)
             if cache_key is not None:
-                _CLUSTER_CACHE[cache_key] = base
-                while len(_CLUSTER_CACHE) > 4:
-                    _CLUSTER_CACHE.pop(next(iter(_CLUSTER_CACHE)))
+                _CLUSTER_CACHE.put(cache_key, base)
         node_index = base._node_index  # type: ignore[attr-defined]
 
         # Usage rows: device-resident delta path (ops/resident.py) when
@@ -945,15 +997,15 @@ class TPUBatchScheduler:
             # The preemption pass only needs WHICH nodes may carry live
             # allocs (it re-materializes candidate rows from state);
             # avoid the full row walk the resident path just saved.
-            self._allocs_by_node = {base.node_ids[i]: True for i in touched}
+            self._allocs_by_node = _TouchedNodeIds(base.node_ids, touched)
         else:
             cu = (self._columnar_usage(base)
                   if not with_networks else None)
             if cu is not None:
                 used, touched_set = cu
                 ct = encode.with_usage(base, used)
-                self._allocs_by_node = {base.node_ids[i]: True
-                                        for i in touched_set}
+                self._allocs_by_node = _TouchedNodeIds(base.node_ids,
+                                                       touched_set)
                 touched = sorted(touched_set)
             else:
                 allocs_by_node = self._live_allocs_by_node()
@@ -1082,11 +1134,25 @@ class TPUBatchScheduler:
                        dp_used=st.dp_used)
 
         if self.mesh is not None:
+            # Sharded donated-mirror eligibility (ISSUE 14): when the
+            # resident slot matches this batch exactly, _dispatch_mesh
+            # loans the node-sharded device mirror into the fused
+            # program instead of shipping the replicated u_rows/u_vals
+            # delta upload.  The take itself happens inside, AFTER the
+            # slot-budget check, so a degraded batch never strands a
+            # loan.
+            res_key = snap_index = None
+            if (use_resident
+                    and os.environ.get("NOMAD_TPU_TIMING") != "2"):
+                res_key = cache_key[:2] + (base.n_pad,)
+                snap_index = self.state.table_index("allocs")
             handle = self._dispatch_mesh(
                 spec_list, all_nodes, ct, st, static, dyn,
                 with_networks=with_networks, with_dp=with_dp,
                 quantized=0 if quant is None else 1, t0=t0,
-                resident_info=resident_info)
+                resident_info=resident_info, res_key=res_key,
+                snap_index=snap_index, used_host=used
+                if res_key is not None else None, h2d0=h2d0)
             if handle is not None:
                 return handle
             # Slot-record budget exceeded (pathological count skew):
@@ -1098,8 +1164,10 @@ class TPUBatchScheduler:
         # argument instead of riding the dyn buffer as sparse deltas —
         # the per-batch usage upload disappears and the mirror round-
         # trips in place (the kernel returns the aliased buffer).
-        # Gated off on the mesh (per-shard mirrors keep the delta path)
-        # and the timing2 diagnostics split.
+        # The mesh path has its own sharded twin of this loan inside
+        # _dispatch_mesh (ISSUE 14); this branch is the single-chip
+        # layout only, and the timing2 diagnostics split keeps the
+        # delta upload.
         used_dev = None
         res_key = snap_index = None
         if (use_resident and self.mesh is None
@@ -1119,12 +1187,12 @@ class TPUBatchScheduler:
         import hashlib
         digest = (hashlib.blake2b(sbuf.tobytes(), digest_size=16).hexdigest(),
                   meta_s)
-        static_dev = _DEVICE_STATIC_CACHE.pop(digest, None)
+        static_dev = _DEVICE_STATIC_CACHE.get(digest)
+        static_h2d = 0
         if static_dev is None:
             static_dev = jax.device_put(sbuf)
-        _DEVICE_STATIC_CACHE[digest] = static_dev  # LRU touch-on-hit
-        while len(_DEVICE_STATIC_CACHE) > 4:
-            _DEVICE_STATIC_CACHE.pop(next(iter(_DEVICE_STATIC_CACHE)))
+            static_h2d = sbuf.nbytes
+        _DEVICE_STATIC_CACHE.put(digest, static_dev)
 
         # Canonical shape-class plan (ISSUE 13 compile-cache audit): ONE
         # pow2 bucketing for (U, slot record, COO capacity) shared with
@@ -1203,6 +1271,8 @@ class TPUBatchScheduler:
             "with_scores": with_scores, "max_nnz": max_nnz,
             "encode_seconds": encode_seconds, "t1": t1,
             "resident": resident_info,
+            "h2d_bytes": (dbuf.nbytes + static_h2d
+                          + (resident.DEV_H2D_BYTES - h2d0)),
         }
 
     def _quant_roundtrip_ok(self, ct, base, quant) -> bool:
@@ -1374,6 +1444,7 @@ class TPUBatchScheduler:
         kstats["fused"] = 1 if handle.get("fused_buf") is not None else 0
         kstats["quantized"] = handle.get("quantized", 0)
         kstats["mesh_shards"] = handle.get("mesh_shards", 0)
+        kstats["h2d_bytes"] = handle.get("h2d_bytes", 0)
         kstats["resident"] = handle.get("resident") or {}
         return expanded, unplaced, metrics, kstats
 
@@ -1392,23 +1463,33 @@ class TPUBatchScheduler:
 
     def _dispatch_mesh(self, spec_list, all_nodes, ct, st, static, dyn,
                        *, with_networks, with_dp, quantized, t0,
-                       resident_info):
+                       resident_info, res_key=None, snap_index=None,
+                       used_host=None, h2d0=0):
         """Node-sharded twin of the fused dispatch: the SAME static/dyn
         tensor dicts, but the static pack is split into per-shard
         buffers placed on their owning device (NamedSharding over the
         node axis — a 1M-node cluster never materializes unsharded on
-        any device), the usage-delta scatter-adds land on the owning
-        shard inside the kernel, and the whole batch result — summary,
-        COO placements, slot-mode AllocMetric scores — comes back as the
+        any device), and the whole batch result — summary, COO
+        placements, slot-mode AllocMetric scores — comes back as the
         same single packed buffer `_fetch_device` already decodes.  One
         dispatch, one fetch, per batch; bit-identical placements and
         scores to the single-chip program (k_cand ≥ max count ⇒ the
         per-round global top-k lies inside the gathered local top-k
         candidates — see parallel/sharded.py).
 
+        Usage state (ISSUE 14): when ``res_key`` identifies a matching
+        resident slot, the node-sharded donated usage mirror is LOANED
+        into the fused program (one [n_local, 4] donated buffer per
+        shard, returned aliased and handed back) — the replicated
+        per-batch u_rows/u_vals upload and the on-device global→local
+        row remap both disappear.  Otherwise the sparse deltas ship in
+        the dyn buffer and the kernel scatter-adds them onto the owning
+        shard, exactly as before (cold batches, fences,
+        NOMAD_TPU_RESIDENT_DEVICE=0).
+
         Returns None when the slot record would blow its budget
         (pathological count skew): the caller degrades to the
-        single-chip program."""
+        single-chip program — without ever taking the mirror loan."""
         global MESH_PASSES
         from jax.sharding import NamedSharding, PartitionSpec as P
         from ..parallel import sharded as shmod
@@ -1436,6 +1517,19 @@ class TPUBatchScheduler:
             return None
         k_cand = min(n_l, encode.pow2_bucket(max(64, max_count)))
 
+        # Loan the sharded donated mirror (installs it node-sharded on
+        # first use).  From here to sharded_fused_pass returning, an
+        # exception leaves the slot EMPTY — the next take reinstalls
+        # from host, never a dead handle (the PR 13 loan protocol).
+        used_dev = None
+        if res_key is not None and not with_networks:
+            used_dev = resident.take_device_used(
+                res_key, snap_index, used_host, mesh=mesh)
+        if used_dev is not None:
+            # The mirror carries the live usage: the replicated sparse
+            # delta upload drops out of the dyn buffer entirely.
+            del dyn["u_rows"], dyn["u_vals"]
+
         # Per-shard static packs: node-axis arrays sliced to the owning
         # shard, the [4] scale codebook replicated into each (every
         # shard dequantizes its own rows — the quant round-trip guard in
@@ -1450,20 +1544,27 @@ class TPUBatchScheduler:
         digest = (hashlib.blake2b(sbuf.tobytes(),
                                   digest_size=16).hexdigest(),
                   meta_s, shmod._mesh_cache_key(mesh))
-        static_dev = _DEVICE_STATIC_CACHE.pop(digest, None)
+        static_dev = _DEVICE_STATIC_CACHE.get(digest)
+        static_h2d = 0
         if static_dev is None:
             static_dev = jax.device_put(
                 sbuf, NamedSharding(mesh, P(shmod.NODE_AXIS)))
-        _DEVICE_STATIC_CACHE[digest] = static_dev  # LRU touch-on-hit
-        while len(_DEVICE_STATIC_CACHE) > 4:
-            _DEVICE_STATIC_CACHE.pop(next(iter(_DEVICE_STATIC_CACHE)))
+            static_h2d = sbuf.nbytes
+        _DEVICE_STATIC_CACHE.put(digest, static_dev)
         dyn_dev = jax.device_put(dbuf, NamedSharding(mesh, P()))
 
-        fused_buf, aux, feas, fused_meta = shmod.sharded_fused_pass(
-            mesh, static_dev, dyn_dev, meta_s=meta_s, meta_d=meta_d,
-            u_pad=st.u_pad, n_pad=ct.n_pad, with_networks=with_networks,
-            with_dp=with_dp, with_scores=with_scores, max_nnz=max_nnz,
-            slot_m=slot_m, k_cand=k_cand)
+        fused_buf, aux, feas, fused_meta, used_out = \
+            shmod.sharded_fused_pass(
+                mesh, static_dev, dyn_dev, used_dev, meta_s=meta_s,
+                meta_d=meta_d, u_pad=st.u_pad, n_pad=ct.n_pad,
+                with_networks=with_networks, with_dp=with_dp,
+                with_scores=with_scores, max_nnz=max_nnz,
+                slot_m=slot_m, k_cand=k_cand)
+        if used_out is not None:
+            # The program aliased every shard's donated buffer back out
+            # — return the loan so the next batch's shard-routed delta
+            # apply lands in place.
+            resident.give_device_used(res_key, snap_index, used_out)
         MESH_PASSES += 1
         return {
             "spec_list": spec_list, "all_nodes": all_nodes, "ct": ct,
@@ -1475,6 +1576,8 @@ class TPUBatchScheduler:
             "with_scores": with_scores, "max_nnz": max_nnz,
             "encode_seconds": encode_seconds, "t1": t1,
             "resident": resident_info,
+            "h2d_bytes": (dbuf.nbytes + static_h2d
+                          + (resident.DEV_H2D_BYTES - h2d0)),
         }
 
     def _finalize_device_outputs(self, spec_list, all_nodes, ct, st, feas,
@@ -2278,6 +2381,12 @@ class BatchStats:
         self.dispatch_seconds = 0.0
         self.fetch_seconds = 0.0
         self.fetch_bytes = 0
+        # Host→device transfer accounting (ISSUE 14): bytes this batch
+        # moved up the link (dyn buffer + any static upload + resident
+        # mirror installs/delta uploads) and the wall time of the
+        # donated delta apply that replaced the per-batch usage upload.
+        self.h2d_bytes = 0
+        self.delta_apply_seconds = 0.0
         # Preemption pass counters (batch_sched._preempt_pass): placements
         # won by eviction, allocs evicted, and the kernel-vs-oracle
         # eviction-set agreement tally.
@@ -2330,7 +2439,9 @@ class BatchStats:
             extra += (f" fused={self.fused} quantized={self.quantized} "
                       f"commit={self.commit_seconds:.3f}s "
                       f"fetch={self.fetch_seconds:.3f}s/"
-                      f"{self.fetch_bytes}B")
+                      f"{self.fetch_bytes}B h2d={self.h2d_bytes}B")
+            if self.delta_apply_seconds:
+                extra += f" delta_apply={self.delta_apply_seconds:.4f}s"
         return (f"BatchStats(evals={self.num_evals} specs={self.num_specs} "
                 f"asks={self.num_asks} phase1={self.phase1_seconds:.3f}s "
                 f"phase2={self.phase2_seconds:.3f}s "

@@ -72,6 +72,16 @@ def compile_signatures() -> int:
     return COMPILES
 
 
+def signature_kinds() -> dict:
+    """Distinct recorded signatures per program kind — the debugging
+    view behind the `batch.compiles` gauge: when a bench compile
+    ceiling trips, this names WHICH program family leaked shapes."""
+    out: dict = {}
+    for kind, _sig in _COMPILE_SIGS:
+        out[kind] = out.get(kind, 0) + 1
+    return out
+
+
 def reset_compile_signatures() -> None:
     """Test/bench helper: zero the audit (does NOT clear jit caches)."""
     global COMPILES

@@ -75,7 +75,11 @@ def device_mirror_enabled() -> bool:
     to the fused kernel as a donated argument — the usage matrix never
     re-materializes and never crosses the link after install (ISSUE 13:
     the arxiv 2603.09555 O(1)-state-carry discipline applied to the
-    resident cache).  0 keeps the sparse-delta upload path."""
+    resident cache).  On a node mesh (ISSUE 14) the twin is SHARDED —
+    one donated [n_local, 4] buffer per shard under the mesh's
+    NamedSharding, caught up by shard-routed donated scatter-adds — so
+    the replicated per-batch u_rows/u_vals upload disappears from the
+    mesh steady state too.  0 keeps the sparse-delta upload path."""
     from ..utils.flags import env_flag
 
     return env_flag("NOMAD_TPU_RESIDENT_DEVICE", True)
@@ -89,6 +93,12 @@ def guard_every() -> int:
 
 
 _DELTA_APPLY = None
+# Per-mesh donated shard-routed delta-apply programs, keyed by the mesh
+# device-id tuple (tiny LRU: a process rarely schedules over more than a
+# couple of meshes, but a long-lived multi-region server must not grow
+# compiled entries without bound — evictions feed the
+# batch.program_cache_evictions gauge).
+_DELTA_APPLY_MESH = None
 
 
 def _delta_apply_fn():
@@ -114,11 +124,54 @@ def _delta_apply_fn():
     return _DELTA_APPLY
 
 
+def _delta_apply_mesh_fn(mesh):
+    """The SHARDED twin of the donated scatter-add (ISSUE 14): the
+    mirror is one [n_pad, 4] array sharded over the mesh's node axis —
+    physically one donated [n_local, 4] buffer per device — and the
+    host routes the global delta stream into per-shard
+    ``(local_row, vals)`` runs (encode.route_shard_deltas, O(changed))
+    whose leading axis shards the same way, so each device applies ONLY
+    the rows it owns with no cross-shard traffic and no re-layout.
+    donate_argnums=(0,) aliases every shard's buffer in place, exactly
+    the single-chip loan discipline per shard."""
+    global _DELTA_APPLY_MESH
+    from ..utils.lru import LRU
+
+    if _DELTA_APPLY_MESH is None:
+        _DELTA_APPLY_MESH = LRU(8)
+    key = tuple(d.id for d in mesh.devices.flat)
+    fn = _DELTA_APPLY_MESH.get(key)
+    if fn is None:
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel import sharded as shmod
+
+        @functools.partial(
+            shmod._shard_map, mesh=mesh,
+            in_specs=(P(shmod.NODE_AXIS), P(shmod.NODE_AXIS),
+                      P(shmod.NODE_AXIS)),
+            out_specs=P(shmod.NODE_AXIS))
+        def _apply_shard(used_l, rows_l, vals_l):
+            r = rows_l.reshape(-1)
+            v = vals_l.reshape(-1, RES_DIMS)
+            valid = r >= 0
+            idx = jnp.where(valid, r, jnp.int32(used_l.shape[0]))
+            return used_l.at[idx].add(v, mode="drop")
+
+        fn = jax.jit(_apply_shard, donate_argnums=(0,))
+        _DELTA_APPLY_MESH.put(key, fn)
+    return fn
+
+
 class ResidentState:
     """One cached (static key → usage matrix) residency slot."""
 
     __slots__ = ("key", "used", "alloc_index", "touched", "hits",
-                 "delta_rows", "since_guard", "used_dev")
+                 "delta_rows", "since_guard", "used_dev", "dev_mesh")
 
     def __init__(self, key: Tuple, used: np.ndarray, alloc_index: int,
                  touched: set):
@@ -134,6 +187,13 @@ class ResidentState:
         # LOANED to the kernel (donated) and handed back via
         # give_device_used — None while out on loan or dropped.
         self.used_dev = None
+        # Placement of the device twin: None for the single-chip layout,
+        # the jax Mesh when the buffer is node-sharded (one donated
+        # [n_local, 4] buffer per shard).  A taker asking for a
+        # different placement drops the handle and reinstalls — a
+        # single-chip mirror must never flow into the sharded kernel or
+        # vice versa.
+        self.dev_mesh = None
 
 
 # Single residency slot (the steady-state workload schedules one cluster
@@ -155,6 +215,11 @@ GUARD_MISMATCHES = 0
 DEV_APPLIES = 0
 DEV_INSTALLS = 0
 DEV_GUARD_MISMATCHES = 0
+# Host→device bytes the mirror machinery moved (installs + routed delta
+# uploads): batch_sched samples this around each dispatch so BatchStats
+# h2d_bytes — and the bench time_split — can show the transfer the
+# donated protocol removes from the steady state.
+DEV_H2D_BYTES = 0
 # Quantization round-trip guard (PR 6): every quantized static upload is
 # dequantized host-side and bit-compared against the exact rows before
 # the buffer ships — the mirror-drift guard extended to the narrow-dtype
@@ -188,15 +253,25 @@ def reset_counters() -> None:
     """Test helper: zero the module counters and drop the cache."""
     global HITS, FULL_REENCODES, STALENESS_FALLBACKS, GUARD_RUNS
     global GUARD_MISMATCHES, QUANT_CHECKS, QUANT_MISMATCHES
-    global DEV_APPLIES, DEV_INSTALLS, DEV_GUARD_MISMATCHES
+    global DEV_APPLIES, DEV_INSTALLS, DEV_GUARD_MISMATCHES, DEV_H2D_BYTES
     invalidate()
     HITS = FULL_REENCODES = STALENESS_FALLBACKS = 0
     GUARD_RUNS = GUARD_MISMATCHES = 0
     QUANT_CHECKS = QUANT_MISMATCHES = 0
     DEV_APPLIES = DEV_INSTALLS = DEV_GUARD_MISMATCHES = 0
+    DEV_H2D_BYTES = 0
 
 
-def take_device_used(key: Tuple, snap_index: int, host_used: np.ndarray):
+def _mesh_key(mesh):
+    """Placement identity for the device mirror: None (single-chip) or
+    the mesh's device-id tuple — two separately constructed meshes over
+    the same devices are the same placement."""
+    return (None if mesh is None
+            else tuple(d.id for d in mesh.devices.flat))
+
+
+def take_device_used(key: Tuple, snap_index: int, host_used: np.ndarray,
+                     mesh=None):
     """Loan the device usage mirror out for donation into the kernel.
 
     Returns the [n_pad, 4] int32 device array — installed from
@@ -205,8 +280,15 @@ def take_device_used(key: Tuple, snap_index: int, host_used: np.ndarray):
     sparse deltas as before).  The slot's handle is cleared while the
     loan is out: donation consumes the buffer, so an exception between
     take and give must leave the slot empty (rebuilt from host on the
-    next take), never holding a dead handle."""
-    global DEV_INSTALLS
+    next take), never holding a dead handle.
+
+    ``mesh``: when set, the mirror installs (and must already be)
+    node-sharded over it — physically one donated [n_local, 4] buffer
+    per shard under ``NamedSharding(mesh, P(NODE_AXIS))``.  A held
+    handle whose placement differs from the request is dropped and
+    reinstalled: a single-chip buffer must never flow into the sharded
+    kernel or vice versa."""
+    global DEV_INSTALLS, DEV_H2D_BYTES
     if not device_mirror_enabled():
         return None
     with _LOCK:
@@ -216,16 +298,31 @@ def take_device_used(key: Tuple, snap_index: int, host_used: np.ndarray):
             return None
         dev = st.used_dev
         st.used_dev = None
+        if dev is not None and _mesh_key(st.dev_mesh) != _mesh_key(mesh):
+            dev = None          # placement mismatch: reinstall below
+        st.dev_mesh = mesh
     if dev is None:
         import jax
 
         from .kernels import note_signature
 
-        dev = jax.device_put(
-            np.ascontiguousarray(host_used, dtype=np.int32))
-        note_signature("resident_install", (host_used.shape,))
+        src = np.ascontiguousarray(host_used, dtype=np.int32)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel import sharded as shmod
+
+            dev = jax.device_put(
+                src, NamedSharding(mesh, P(shmod.NODE_AXIS)))
+            shards = mesh.devices.size
+        else:
+            dev = jax.device_put(src)
+            shards = 0
+        note_signature("resident_install", (host_used.shape, shards))
         DEV_INSTALLS += 1
-        tracing.event("resident.device_install", rows=host_used.shape[0])
+        DEV_H2D_BYTES += src.nbytes
+        tracing.event("resident.device_install", rows=host_used.shape[0],
+                      shards=shards)
     return dev
 
 
@@ -267,29 +364,53 @@ def check_quant_roundtrip(exact: np.ndarray, quantized: np.ndarray,
     return False
 
 
-def _apply_device_deltas(used_dev, dev_rows):
+def _apply_device_deltas(used_dev, dev_rows, mesh=None):
     """Catch the device mirror up with one donated scatter-add (no-op
     when the mirror is absent or nothing changed).  Rows are bucketed to
-    powers of two so the jit cache stays a fixed handful of shapes."""
-    global DEV_APPLIES
+    powers of two so the jit cache stays a fixed handful of shapes.
+
+    With ``mesh`` the mirror is node-sharded: the global delta stream is
+    routed into per-shard (local_row, vals) runs host-side
+    (encode.route_shard_deltas — one numpy pass, O(changed)) and applied
+    by the per-shard donated scatter-add, so every shard touches only
+    the rows it owns."""
+    global DEV_APPLIES, DEV_H2D_BYTES
     if used_dev is None or not dev_rows:
         return used_dev
-    from .encode import pow2_bucket
-
-    k_b = pow2_bucket(len(dev_rows))
-    rows = np.full(k_b, -1, dtype=np.int32)
-    vals = np.zeros((k_b, RES_DIMS), dtype=np.int32)
-    for j, (i, vec) in enumerate(dev_rows):
-        rows[j] = i
-        vals[j, 0] = vec[0]
-        vals[j, 1] = vec[1]
-        vals[j, 2] = vec[2]
-        vals[j, 3] = vec[3]
-    DEV_APPLIES += 1
+    from .encode import pow2_bucket, route_shard_deltas
     from .kernels import note_signature
 
-    note_signature("resident_delta", (used_dev.shape, k_b))
     try:
+        if mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel import sharded as shmod
+
+            d = mesh.devices.size
+            n_l = used_dev.shape[0] // d
+            rows, vals = route_shard_deltas(dev_rows, d, n_l,
+                                            dims=RES_DIMS)
+            DEV_APPLIES += 1
+            DEV_H2D_BYTES += rows.nbytes + vals.nbytes
+            note_signature("resident_delta_mesh",
+                           (used_dev.shape, rows.shape[1], d))
+            spec = NamedSharding(mesh, P(shmod.NODE_AXIS))
+            return _delta_apply_mesh_fn(mesh)(
+                used_dev, jax.device_put(rows, spec),
+                jax.device_put(vals, spec))
+        k_b = pow2_bucket(len(dev_rows))
+        rows = np.full(k_b, -1, dtype=np.int32)
+        vals = np.zeros((k_b, RES_DIMS), dtype=np.int32)
+        for j, (i, vec) in enumerate(dev_rows):
+            rows[j] = i
+            vals[j, 0] = vec[0]
+            vals[j, 1] = vec[1]
+            vals[j, 2] = vec[2]
+            vals[j, 3] = vec[3]
+        DEV_APPLIES += 1
+        DEV_H2D_BYTES += rows.nbytes + vals.nbytes
+        note_signature("resident_delta", (used_dev.shape, k_b))
         return _delta_apply_fn()(used_dev, rows, vals)
     except Exception:
         # The donated input is consumed even on failure — a dead handle
@@ -379,7 +500,8 @@ def acquire(state, cache_key: Tuple, base, rows_fn,
     global GUARD_RUNS, GUARD_MISMATCHES
 
     info = {"resident_hit": False, "delta_rows": 0, "full_reencode": False,
-            "fence": False, "guard_ran": False, "guard_mismatch": False}
+            "fence": False, "guard_ran": False, "guard_mismatch": False,
+            "delta_apply_s": 0.0}
     snap_index = state.table_index("allocs")
 
     with _LOCK:
@@ -459,7 +581,13 @@ def acquire(state, cache_key: Tuple, base, rows_fn,
                         vec[dim] = bump
                         dev_rows.append((row, tuple(vec)))
 
-                st.used_dev = _apply_device_deltas(st.used_dev, dev_rows)
+                if track_dev:
+                    import time as _time
+
+                    t_da = _time.monotonic()
+                    st.used_dev = _apply_device_deltas(
+                        st.used_dev, dev_rows, mesh=st.dev_mesh)
+                    info["delta_apply_s"] = _time.monotonic() - t_da
 
                 every = guard_every()
                 if every > 0 and st.since_guard >= every:
@@ -477,17 +605,27 @@ def acquire(state, cache_key: Tuple, base, rows_fn,
                                 dev_host.astype(np.int64), used):
                             global DEV_GUARD_MISMATCHES
                             DEV_GUARD_MISMATCHES += 1
-                            bad = int((dev_host.astype(np.int64)
-                                       != used).any(axis=1).sum())
+                            bad_mask = (dev_host.astype(np.int64)
+                                        != used).any(axis=1)
+                            bad = int(bad_mask.sum())
+                            dev_bad_shards: List[int] = []
+                            if shards > 0:
+                                n_l = max(1, used.shape[0] // shards)
+                                dev_bad_shards = sorted(
+                                    {int(r) // n_l
+                                     for r in np.nonzero(bad_mask)[0]})
                             logger.error(
                                 "device usage mirror diverged from the "
-                                "host mirror on %d rows; dropping the "
+                                "host mirror on %d rows%s; dropping the "
                                 "donated buffer and feeding the breaker",
-                                bad)
+                                bad,
+                                (f" (mesh shards {dev_bad_shards})"
+                                 if dev_bad_shards else ""))
                             tracing.event("resident.device_mismatch",
-                                          rows=bad)
+                                          rows=bad, shards=dev_bad_shards)
                             _publish("device_mirror_mismatch", Rows=bad,
-                                     AllocIndex=snap_index)
+                                     AllocIndex=snap_index,
+                                     Shards=dev_bad_shards)
                             if breaker is not None:
                                 breaker.record(False)
                             st.used_dev = None

@@ -427,8 +427,13 @@ def sharded_placement_rounds(
 
 # Compiled sharded-fused programs keyed by (mesh devices, metas, static
 # shape/flags): the production hot loop must not re-trace per batch the
-# way the legacy eager shard_map side path did.
-_FUSED_MESH_CACHE = {}
+# way the legacy eager shard_map side path did.  Touch-on-hit LRU with
+# eviction accounting (utils/lru.py): a long-lived server seeing many
+# mesh/meta shapes recycles programs instead of growing without bound,
+# and the batch.program_cache_evictions gauge shows it happening.
+from ..utils.lru import LRU as _LRU
+
+_FUSED_MESH_CACHE = _LRU(16)
 
 
 def _mesh_cache_key(mesh) -> Tuple:
@@ -439,6 +444,7 @@ def sharded_fused_pass(
     mesh: Mesh,
     static_shards,          # [D, B] uint8 — NamedSharding P(NODE_AXIS)
     dyn_buf,                # [Bd] uint8 — replicated
+    used_dev=None,          # [n_pad, 4] int32 — DONATED sharded mirror
     *,
     meta_s,                 # PER-SHARD static layout (n_l-row shapes)
     meta_d,
@@ -454,15 +460,27 @@ def sharded_fused_pass(
 ):
     """Fused node-sharded score-and-commit: returns
     ``(packed result buffer, (slots, slot_scores, slot_coll), feas,
-    result layout meta)`` exactly like ops/kernels.fused_pass — the
-    caller's fetch/decode/forensics paths are shared with the
+    result layout meta, used_out)`` exactly like ops/kernels.fused_pass
+    — the caller's fetch/decode/forensics paths are shared with the
     single-chip program.  ``slots``/scores are replicated [U, M]
-    (overflow source); ``feas`` stays node-sharded [U, n_pad]."""
+    (overflow source); ``feas`` stays node-sharded [U, n_pad].
+
+    ``used_dev`` (optional, ISSUE 14): the DONATED node-sharded
+    device-resident usage mirror — one [n_local, 4] buffer per shard
+    under ``NamedSharding(mesh, P(NODE_AXIS))``.  When present the
+    per-batch replicated ``u_rows``/``u_vals`` usage upload AND the
+    on-device global→local row remap disappear: each shard's usage
+    state IS its mirror slice, and the buffer rides back out aliased as
+    ``used_out`` for ops/resident.py's loan protocol (None when no
+    mirror was passed — the sparse-delta path)."""
     from ..ops.kernels import fused_layout, fused_window
 
     d = mesh.devices.size
     assert n_pad % d == 0, f"mesh size {d} must divide node pad {n_pad}"
     assert slot_m > 0, "the fused mesh pass requires a slot record"
+    use_used_dev = used_dev is not None
+    assert not (use_used_dev and with_networks), \
+        "sharded usage mirror is gated to non-network batches"
     k_cand = min(k_cand, n_pad // d)
     compact_u16 = (not with_scores and u_pad <= 65536
                    and n_pad <= 65536 and max_rounds < 65536)
@@ -472,7 +490,7 @@ def sharded_fused_pass(
                         with_scores=with_scores, compact_u16=compact_u16)
     key = (_mesh_cache_key(mesh), meta_s, meta_d, u_pad, n_pad,
            with_networks, with_dp, with_scores, slot_m, k_cand,
-           max_rounds, window_nnz, compact_u16)
+           max_rounds, window_nnz, compact_u16, use_used_dev)
     from ..ops import kernels as _kernels
 
     _kernels.note_signature("sharded_fused_pass", key)
@@ -483,17 +501,22 @@ def sharded_fused_pass(
             with_networks=with_networks, with_dp=with_dp,
             with_scores=with_scores, slot_m=slot_m, k_cand=k_cand,
             max_rounds=max_rounds, window_nnz=window_nnz,
-            compact_u16=compact_u16)
-        _FUSED_MESH_CACHE[key] = fn
-        while len(_FUSED_MESH_CACHE) > 16:
-            _FUSED_MESH_CACHE.pop(next(iter(_FUSED_MESH_CACHE)))
-    buf, slots, sscores, scoll, feas = fn(static_shards, dyn_buf)
-    return buf, (slots, sscores, scoll), feas, meta
+            compact_u16=compact_u16, use_used_dev=use_used_dev)
+        _FUSED_MESH_CACHE.put(key, fn)
+    if not use_used_dev:
+        # Shardable dummy ([1, 4] per device) keeps one program shape;
+        # the aliased output is discarded.
+        used_dev = jnp.zeros((d, 4), dtype=jnp.int32)
+    buf, slots, sscores, scoll, feas, used_out = fn(
+        static_shards, dyn_buf, used_dev)
+    return (buf, (slots, sscores, scoll), feas, meta,
+            (used_out if use_used_dev else None))
 
 
 def _build_fused_mesh_fn(mesh, *, meta_s, meta_d, u_pad, n_pad,
                          with_networks, with_dp, with_scores, slot_m,
-                         k_cand, max_rounds, window_nnz, compact_u16):
+                         k_cand, max_rounds, window_nnz, compact_u16,
+                         use_used_dev=False):
     from ..ops import xfer
     from ..ops.kernels import (
         _score_fit as score_fit,
@@ -509,11 +532,11 @@ def _build_fused_mesh_fn(mesh, *, meta_s, meta_d, u_pad, n_pad,
     @functools.partial(
         _shard_map,
         mesh=mesh,
-        in_specs=(P(NODE_AXIS), P()),
-        out_specs=(P(), P(), P(), P(), P(None, NODE_AXIS)),
+        in_specs=(P(NODE_AXIS), P(), P(NODE_AXIS)),
+        out_specs=(P(), P(), P(), P(), P(None, NODE_AXIS), P(NODE_AXIS)),
         **(_SMAP_CHECK_OFF if _SMAP_LEGACY else {}),
     )
-    def _run(sbuf_l, dyn):
+    def _run(sbuf_l, dyn, used_dev_l):
         ds = xfer.unpack_device(sbuf_l.reshape(-1), meta_s)
         dd = xfer.unpack_device(dyn, meta_d)
         # Quantized resource rows: one exact integer multiply per shard
@@ -533,12 +556,22 @@ def _build_fused_mesh_fn(mesh, *, meta_s, meta_d, u_pad, n_pad,
         shard = lax.axis_index(NODE_AXIS)
         gidx = shard * n_l + jnp.arange(n_l, dtype=jnp.int32)
 
-        # Usage deltas carry GLOBAL node rows; each shard applies only
-        # the rows it owns (the owning-shard scatter-add).
-        lrow = dd["u_rows"] - shard * n_l
-        uvalid = (dd["u_rows"] >= 0) & (lrow >= 0) & (lrow < n_l)
-        uidx = jnp.where(uvalid, lrow, jnp.int32(n_l))
-        used0 = ds["used_base"].at[uidx].add(dd["u_vals"], mode="drop")
+        if use_used_dev:
+            # The shard's usage state IS its slice of the donated
+            # sharded mirror (ops/resident.py keeps it caught up in
+            # place with shard-routed donated scatter-adds): no
+            # per-batch usage upload, no global→local row remap.  The
+            # buffer rides back out unchanged so XLA aliases it
+            # input→output per shard.
+            used0 = used_dev_l
+        else:
+            # Usage deltas carry GLOBAL node rows; each shard applies
+            # only the rows it owns (the owning-shard scatter-add).
+            lrow = dd["u_rows"] - shard * n_l
+            uvalid = (dd["u_rows"] >= 0) & (lrow >= 0) & (lrow < n_l)
+            uidx = jnp.where(uvalid, lrow, jnp.int32(n_l))
+            used0 = ds["used_base"].at[uidx].add(dd["u_vals"],
+                                                 mode="drop")
 
         # Per-(job, node) counts, local scatter of the global sparse set.
         jrow = jnp.clip(dd["jc_rows"], 0, u_pad - 1)
@@ -725,9 +758,12 @@ def _build_fused_mesh_fn(mesh, *, meta_s, meta_d, u_pad, n_pad,
             "scalars": jnp.stack([nnz, rounds]).astype(jnp.int32),
             "coo": coo_win,
         })
-        return buf, slots_full, sscores_full, scoll_full, feas_l
+        return buf, slots_full, sscores_full, scoll_full, feas_l, used_dev_l
 
-    return jax.jit(_run)
+    # The donated mirror (arg 2) aliases input→output per shard; with
+    # the dummy it is neither donated nor meaningful.
+    return jax.jit(_run,
+                   donate_argnums=(2,) if use_used_dev else ())
 
 
 def sharded_schedule_step(

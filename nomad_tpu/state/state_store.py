@@ -259,6 +259,20 @@ class StateStore:
                 if cols is not None:
                     self._col_fold_if_stale(cols)
                     snap._columns = cols.share()
+            # Ready-node memo (scheduler/util.ready_nodes_in_dcs): the
+            # DICT OBJECT is shared between this store and every
+            # snapshot cut from the same node-table state, so the first
+            # reader to pay the O(cluster) ready walk warms ALL of them
+            # — without this, a fresh snapshot per batch re-pays the
+            # walk every time (ISSUE 14: ~1s/batch at 1M nodes in the
+            # mesh steady stream; the base store itself never computes
+            # the memo because scheduling always runs off snapshots).
+            # Any node write pops only the WRITER's reference (_bump):
+            # the writer diverges from the shared memo, every other
+            # holder's frozen table still matches it.  Entries are
+            # (list, dict) tuples the reader copies before returning.
+            snap._ready_nodes_cache = self.__dict__.setdefault(
+                "_ready_nodes_cache", {})
             # Writes to a snapshot (job_plan dry runs, scheduler harness
             # worlds) are hypothetical: they must never publish events.
             snap.event_broker = None
@@ -487,10 +501,17 @@ class StateStore:
             return
         self._pending_slabs = []
         self._pending_by_job = {}
+        self._drain_slabs(pending)
+
+    def _drain_slabs(self, slabs) -> None:
+        """Shared drain body for the full (_materialize_pending) and
+        per-job (_materialize_job_pending) paths: build the by-id table
+        rows and per-node index cells; lazy id columns materialize once
+        and cache back onto the slab."""
         table = self.allocs_table
         by_node = self._allocs_by_node
         get = by_node.get
-        for slab in pending:
+        for slab in slabs:
             ids = slab.ids
             if type(ids) is not list:
                 ids = list(ids)
@@ -500,6 +521,23 @@ class StateStore:
                 by_node[nid] = {aid} if cur is None else (cur, aid)
             for aid in ids:
                 table[aid] = slab
+
+    def _materialize_job_pending(self, job_id: str) -> None:
+        """Per-job partial drain of the deferred slab indexing: build
+        the by-id table rows and per-node index cells for ``job_id``'s
+        pending slabs ONLY, leaving every other slab deferred — the
+        same referenced-only discipline as _node_usage_row's membership
+        check.  A phase-1 ``allocs_by_job`` on a fresh job must not pay
+        an O(cluster) drain of an unrelated warm million-row slab on
+        every snapshot (ISSUE 14: that drain was the dominant host cost
+        of the mesh steady state, ~2s/batch at 1M warm allocs)."""
+        slabs = self._pending_by_job.pop(job_id, None)
+        if not slabs:
+            return
+        gone = {id(sl) for sl in slabs}
+        self._pending_slabs = [sl for sl in self._pending_slabs
+                               if id(sl) not in gone]
+        self._drain_slabs(slabs)
 
     def _get_alloc(self, alloc_id: str) -> Optional[s.Allocation]:
         """allocs_table read with slab materialization + cache-back.
@@ -1164,7 +1202,7 @@ class StateStore:
             ws.add(self, "allocs")
         with self._lock:
             if self._pending_slabs:
-                self._materialize_pending()
+                self._materialize_job_pending(job_id)
             out = [self._get_alloc(aid) for aid in self._idx_get(self._allocs_by_job, job_id)
                    if aid in self.allocs_table]
             if all_allocs:

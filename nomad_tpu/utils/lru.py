@@ -1,0 +1,79 @@
+"""Small LRU cache for compiled-program / device-buffer caches.
+
+The batch scheduler keeps several keyed caches of expensive artifacts —
+compiled sharded-fused programs (`parallel/sharded._FUSED_MESH_CACHE`),
+finalized static cluster tensors, device-resident static buffers, and
+the per-mesh donated delta-apply programs.  A long-lived server that
+sees many mesh/meta shapes must not grow these without limit, and the
+old ad-hoc ``while len > N: pop oldest`` bound was FIFO (a hot entry
+re-fetched every batch could still be evicted by churn).  This class is
+the one touch-on-hit LRU they all share; every eviction increments the
+module counter ``EVICTIONS``, surfaced as the
+``batch.program_cache_evictions`` gauge so operators can see compiled
+programs being recycled (a high rate at steady state means the cap is
+too small for the workload's shape diversity).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Optional
+
+# Process-lifetime eviction count across every LRU instance (telemetry
+# gauge `batch.program_cache_evictions`).
+EVICTIONS = 0
+
+
+class LRU:
+    """Bounded mapping with touch-on-hit recency and eviction counting.
+
+    Not thread-safe by itself — callers that race (batch_sched's module
+    caches are touched from scheduler threads) rely on the GIL for the
+    individual OrderedDict operations, the same contract the dicts it
+    replaces had."""
+
+    __slots__ = ("cap", "_d", "evictions", "on_evict")
+
+    def __init__(self, cap: int,
+                 on_evict: Optional[Callable] = None) -> None:
+        assert cap > 0
+        self.cap = cap
+        self._d: OrderedDict = OrderedDict()
+        self.evictions = 0
+        self.on_evict = on_evict
+
+    def get(self, key, default=None):
+        # Single read first: a concurrent put() may evict key between
+        # any two steps here, so the lookup must be the one op that
+        # decides hit-vs-miss (the recency touch tolerates the race).
+        try:
+            v = self._d[key]
+        except KeyError:
+            return default
+        try:
+            self._d.move_to_end(key)
+        except KeyError:
+            pass
+        return v
+
+    def put(self, key, value) -> None:
+        global EVICTIONS
+        self._d[key] = value
+        self._d.move_to_end(key)
+        while len(self._d) > self.cap:
+            _, old = self._d.popitem(last=False)
+            self.evictions += 1
+            EVICTIONS += 1
+            if self.on_evict is not None:
+                self.on_evict(old)
+
+    def pop(self, key, default=None):
+        return self._d.pop(key, default)
+
+    def clear(self) -> None:
+        self._d.clear()
+
+    def __contains__(self, key) -> bool:
+        return key in self._d
+
+    def __len__(self) -> int:
+        return len(self._d)

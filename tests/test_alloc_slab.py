@@ -223,3 +223,57 @@ def test_persist_restore_materializes_slabs():
     assert all(a.node_id for a in got)
     # Indexes rebuilt.
     assert len(restored.allocs_by_job(None, job.id, True)) == 3
+
+
+def test_allocs_by_job_drains_only_that_jobs_slabs():
+    """ISSUE 14: allocs_by_job materializes ONLY the requested job's
+    pending slabs — an unrelated warm million-row slab stays deferred,
+    so the mesh steady state's phase-1 reconciliation never pays an
+    O(cluster) drain per fresh snapshot."""
+    store, job_a, nodes = _store_with_job()
+    job_b = mock.job()
+    job_b.task_groups[0].count = 2
+    store.upsert_job(5, job_b)
+    job_b = store.job_by_id(None, job_b.id)
+
+    slab_a = _slab(job_a, [n.id for n in nodes], ev_id="ev-a")
+    slab_b = _slab(job_b, [nodes[0].id, nodes[1].id], ev_id="ev-b")
+    store.upsert_slabs(10, [slab_a, slab_b])
+    assert len(store._pending_slabs) == 2
+
+    got = store.allocs_by_job(None, job_b.id, True)
+    assert sorted(a.id for a in got) == sorted(slab_b.ids)
+    # job_a's slab is still deferred; job_b's was drained.
+    assert [sl is slab_a for sl in store._pending_slabs] == [True]
+    assert job_b.id not in store._pending_by_job
+    assert job_a.id in store._pending_by_job
+
+    # A job with NO pending slabs doesn't disturb the deferred set.
+    job_c = mock.job()
+    store.upsert_job(11, job_c)
+    assert store.allocs_by_job(None, job_c.id, True) == []
+    assert [sl is slab_a for sl in store._pending_slabs] == [True]
+
+    # The per-job drain filled the by-node cells for job_b only; a full
+    # reader still sees everything via the global drain.
+    assert sorted(a.id for a in store.allocs_by_job(None, job_a.id, True)) \
+        == sorted(slab_a.ids)
+    assert not store._pending_slabs
+    by_node = {a.id for a in store.allocs_by_node(None, nodes[0].id)}
+    assert slab_a.ids[0] in by_node and slab_b.ids[0] in by_node
+
+
+def test_allocs_by_job_partial_drain_snapshot_independent():
+    """Each snapshot drains its own pending copy: a per-job drain on one
+    snapshot must not leak into the base store or a sibling."""
+    store, job, nodes = _store_with_job()
+    slab = _slab(job, [n.id for n in nodes])
+    store.upsert_slabs(10, [slab])
+
+    snap = store.snapshot()
+    got = snap.allocs_by_job(None, job.id, True)
+    assert sorted(a.id for a in got) == sorted(slab.ids)
+    # The base store's deferred set is untouched by the snapshot's drain.
+    assert len(store._pending_slabs) == 1
+    assert sorted(a.id for a in store.allocs_by_job(None, job.id, True)) \
+        == sorted(slab.ids)

@@ -308,8 +308,12 @@ class TestMeshResident:
     def test_mesh_schedule_stream_pipelined(self, mesh, monkeypatch):
         """The prepare/dispatch/complete split drives the mesh dispatch
         asynchronously: a double-buffered stream of batches places
-        everything with resident delta hits after the cold batch."""
+        everything with resident delta hits after the cold batch — over
+        the DONATED sharded mirror (default on), whose guard-at-every-
+        hit bit-compare proves usage is never optimistic (batch k's
+        placements land in the mirror only after k finalizes)."""
         monkeypatch.setenv("NOMAD_TPU_RESIDENT_GUARD_EVERY", "1")
+        monkeypatch.setenv("NOMAD_TPU_RESIDENT_DEVICE", "1")
         resident.reset_counters()
         h = self._harness(n_nodes=16)
         jobs, batches = [], []
@@ -325,8 +329,171 @@ class TestMeshResident:
         assert all(st.mesh_shards == 8 for st in stats)
         assert sum(st.resident_hits for st in stats) >= 3
         assert resident.GUARD_MISMATCHES == 0
+        assert resident.DEV_INSTALLS == 1, (
+            "the sharded mirror must install once and ride the stream "
+            "in place")
+        assert resident.DEV_APPLIES >= 2
+        assert resident.DEV_GUARD_MISMATCHES == 0
+        st_res = resident._STATE
+        assert st_res is not None and st_res.used_dev is not None
+        np.testing.assert_array_equal(
+            np.asarray(st_res.used_dev).astype(np.int64), st_res.used)
         for job in jobs:
             live = [a for a in h.state.allocs_by_job(None, job.id, True)
                     if not a.terminal_status()]
             assert len(live) == 2
+        resident.reset_counters()
+
+
+class TestMeshDonatedMirror:
+    """ISSUE 14: the donated per-shard usage mirror on the node mesh.
+
+    The [n_pad, 4] usage matrix lives node-sharded on the mesh (one
+    donated [n_local, 4] buffer per shard), is caught up in place by
+    shard-routed donated scatter-adds, and is loaned into
+    ``sharded_fused_pass`` as a donated arg returned aliased — so the
+    replicated per-batch u_rows/u_vals upload disappears.  These pin
+    (a) bit-identity of placements AND of the mirror vs the sparse
+    delta-upload path after N donated applies, (b) the loan protocol
+    under a dispatch exception (slot empties, next batch reinstalls),
+    and (c) the NOMAD_TPU_RESIDENT_DEVICE=0 kill-switch."""
+
+    def _harness(self, n_nodes=12):
+        h = Harness()
+        for i in range(n_nodes):
+            node = make_node()
+            node.id = f"mesh-dev-{i:02d}"
+            node.name = node.id
+            h.state.upsert_node(h.next_index(), node)
+        return h
+
+    def _stream(self, h, mesh, batches=5, brk=None, count=2, rng=None):
+        placements = []
+        for _ in range(batches):
+            job = make_job(count if rng is None
+                           else rng.randint(1, count), rng)
+            h.state.upsert_job(h.next_index(), job)
+            kw = {"breaker": brk} if brk is not None else {}
+            sched = TPUBatchScheduler(h.logger, h.snapshot(), h,
+                                      mesh=mesh, **kw)
+            sched.schedule_batch([reg_eval(job)])
+            placements.append(sorted(
+                (a.node_id, tuple(sorted((a.metrics.scores or {}).items())))
+                for a in h.state.allocs_by_job(None, job.id, True)
+                if not a.terminal_status()))
+        return placements
+
+    def test_donated_applies_bit_identical_to_delta_path(self, mesh,
+                                                         monkeypatch):
+        monkeypatch.setenv("NOMAD_TPU_RNG_SEED", "991")
+        monkeypatch.setenv("NOMAD_TPU_RESIDENT_GUARD_EVERY", "1")
+
+        monkeypatch.setenv("NOMAD_TPU_RESIDENT_DEVICE", "1")
+        resident.reset_counters()
+        h_dev = self._harness()
+        pl_dev = self._stream(h_dev, mesh)
+        assert resident.DEV_INSTALLS == 1, (
+            "the sharded mirror must install exactly once and then "
+            "round-trip in place through the fused mesh program")
+        assert resident.DEV_APPLIES >= 4
+        st = resident._STATE
+        assert st is not None and st.used_dev is not None
+        # Physically sharded: every mesh device holds its slice.
+        assert len(st.used_dev.sharding.device_set) == 8
+        np.testing.assert_array_equal(
+            np.asarray(st.used_dev).astype(np.int64), st.used)
+        host_mirror = st.used.copy()
+
+        resident.reset_counters()
+        monkeypatch.setenv("NOMAD_TPU_RESIDENT_DEVICE", "0")
+        h_dl = self._harness()
+        pl_dl = self._stream(h_dl, mesh)
+        assert resident.DEV_INSTALLS == 0 and resident.DEV_APPLIES == 0
+        assert pl_dev == pl_dl
+        np.testing.assert_array_equal(resident._STATE.used, host_mirror)
+        resident.reset_counters()
+
+    def test_loan_exception_empties_slot_and_reinstalls(self, mesh,
+                                                        monkeypatch):
+        """A dispatch exception between take and give consumes the
+        donated loan: the slot must be EMPTY afterwards (never a dead
+        handle) and the next batch reinstalls from host and places."""
+        import nomad_tpu.parallel.sharded as shmod
+
+        monkeypatch.setenv("NOMAD_TPU_RESIDENT_DEVICE", "1")
+        # Lenient breaker: the injected dispatch failure must feed it
+        # WITHOUT opening it, so the next batch exercises the reinstall
+        # path rather than the oracle route.
+        brk = KernelCircuitBreaker(threshold=0.1, window=32,
+                                   min_checks=16, cooldown=3600.0)
+        resident.reset_counters()
+        h = self._harness()
+        self._stream(h, mesh, batches=2, brk=brk)
+        assert resident.DEV_INSTALLS == 1
+
+        orig = shmod.sharded_fused_pass
+
+        def boom(*a, **k):
+            raise RuntimeError("injected mesh dispatch failure")
+
+        monkeypatch.setattr(shmod, "sharded_fused_pass", boom)
+        job = make_job(2)
+        h.state.upsert_job(h.next_index(), job)
+        sched = TPUBatchScheduler(h.logger, h.snapshot(), h, mesh=mesh,
+                                  breaker=brk)
+        with pytest.raises(RuntimeError):
+            sched.schedule_batch([reg_eval(job)])
+        st = resident._STATE
+        assert st is not None and st.used_dev is None, (
+            "the consumed loan must leave the slot empty")
+
+        monkeypatch.setattr(shmod, "sharded_fused_pass", orig)
+        pl = self._stream(h, mesh, batches=1, brk=brk)
+        assert len(pl[0]) == 2
+        assert resident.DEV_INSTALLS == 2, (
+            "the batch after a consumed loan must reinstall from host")
+        st = resident._STATE
+        assert st is not None and st.used_dev is not None
+        np.testing.assert_array_equal(
+            np.asarray(st.used_dev).astype(np.int64), st.used)
+        resident.reset_counters()
+
+    def test_kill_switch_keeps_delta_upload_path(self, mesh, monkeypatch):
+        monkeypatch.setenv("NOMAD_TPU_RESIDENT_DEVICE", "0")
+        monkeypatch.setenv("NOMAD_TPU_RESIDENT_GUARD_EVERY", "1")
+        resident.reset_counters()
+        h = self._harness()
+        pl = self._stream(h, mesh, batches=3)
+        assert all(len(p) == 2 for p in pl)
+        assert resident.DEV_INSTALLS == 0 and resident.DEV_APPLIES == 0
+        assert resident.HITS >= 2, "delta path must still serve hits"
+        assert resident.GUARD_MISMATCHES == 0
+        resident.reset_counters()
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", range(6))
+    def test_donated_mirror_fuzz_bit_identical(self, mesh, seed,
+                                               monkeypatch):
+        """Slow multi-seed fuzz: randomized fleets + job streams place
+        bit-identically — placements AND AllocMetric scores — between
+        the donated sharded mirror and the delta-upload path, with the
+        guard at every hit proving the mirror never drifts."""
+        monkeypatch.setenv("NOMAD_TPU_RNG_SEED", str(3000 + seed))
+        monkeypatch.setenv("NOMAD_TPU_RESIDENT_GUARD_EVERY", "1")
+        rng = random.Random(7000 + seed)
+        n_nodes = rng.randint(10, 40)
+        n_batches = rng.randint(3, 7)
+        max_count = rng.randint(2, 6)
+
+        out = []
+        for flag in ("1", "0"):
+            monkeypatch.setenv("NOMAD_TPU_RESIDENT_DEVICE", flag)
+            resident.reset_counters()
+            h = self._harness(n_nodes=n_nodes)
+            out.append(self._stream(
+                h, mesh, batches=n_batches, count=max_count,
+                rng=random.Random(5000 + seed)))
+            assert resident.GUARD_MISMATCHES == 0
+            assert resident.DEV_GUARD_MISMATCHES == 0
+        assert out[0] == out[1]
         resident.reset_counters()

@@ -276,3 +276,55 @@ def test_reconcile_job_summaries():
     store.job_summary_table[j.id] = s.JobSummary(job_id=j.id)
     store.reconcile_job_summaries(3)
     assert store.job_summary_by_id(None, j.id).summary["web"].running == 1
+
+
+def test_ready_nodes_memo_shared_across_snapshots():
+    """ISSUE 14: the ready_nodes_in_dcs memo dict is SHARED between a
+    store and every snapshot cut from the same node-table state — one
+    O(cluster) ready walk warms the whole steady stream of per-batch
+    snapshots — and any node write invalidates only the writer's view."""
+    from nomad_tpu.scheduler.util import ready_nodes_in_dcs
+
+    store = StateStore()
+    for i in range(6):
+        n = mock.node()
+        n.id = f"node-{i}"
+        n.datacenter = "dc1"
+        store.upsert_node(i + 1, n)
+
+    s1 = store.snapshot()
+    out1, dcs1 = ready_nodes_in_dcs(s1, ["dc1"])
+    assert len(out1) == 6 and dcs1 == {"dc1": 6}
+    s2 = store.snapshot()
+    # Same shared dict, already warm — and it serves the same answer.
+    assert s2._ready_nodes_cache is s1._ready_nodes_cache
+    assert ("dc1",) in s2._ready_nodes_cache
+    out2, _ = ready_nodes_in_dcs(s2, ["dc1"])
+    assert [n.id for n in out2] == [n.id for n in out1]
+
+    # A node write on the base severs only the base's reference: the
+    # next snapshot recomputes, frozen older snapshots stay warm+correct.
+    n = mock.node()
+    n.id = "node-6"
+    n.datacenter = "dc1"
+    store.upsert_node(50, n)
+    s3 = store.snapshot()
+    assert s3._ready_nodes_cache is not s1._ready_nodes_cache
+    out3, _ = ready_nodes_in_dcs(s3, ["dc1"])
+    assert len(out3) == 7
+    assert len(ready_nodes_in_dcs(s1, ["dc1"])[0]) == 6
+
+    # A hypothetical write on a SNAPSHOT (dry-run world) diverges that
+    # snapshot only; the shared memo still serves its siblings.
+    s4 = store.snapshot()
+    ready_nodes_in_dcs(s4, ["dc1"])
+    extra = mock.node()
+    extra.datacenter = "dc1"
+    s4.upsert_node(60, extra)
+    assert len(ready_nodes_in_dcs(s4, ["dc1"])[0]) == 8
+    assert len(ready_nodes_in_dcs(s3, ["dc1"])[0]) == 7
+
+    # Returned lists are copies — mutating one can't poison the memo.
+    got, _ = ready_nodes_in_dcs(s3, ["dc1"])
+    got.clear()
+    assert len(ready_nodes_in_dcs(s3, ["dc1"])[0]) == 7
