@@ -50,6 +50,8 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).parent))
 
+from nomad_tpu.utils import knobs as _knobs  # noqa: E402 (needs sys.path)
+
 # -- wall-clock discipline (VERDICT r3 weak-2/weak-6) -----------------------
 # The bench must ALWAYS produce its JSON line: a hung TPU backend sits
 # inside C calls that Python signals cannot interrupt, so the phases run in
@@ -133,8 +135,9 @@ COMPILE_BUDGET_MESH_STEADY = 8
 
 
 def mesh10m_enabled() -> bool:
-    flag = os.environ.get(MESH10M_ENV, "").strip().lower()
-    return flag not in ("", "0", "false", "no")
+    from nomad_tpu.utils import knobs
+
+    return knobs.get_bool(MESH10M_ENV)
 
 
 def log(*args):
@@ -859,7 +862,7 @@ def bench_steady(n_nodes: int = E_N_NODES, n_batches: int = 200,
             h.state.upsert_job(h.next_index(), j)
         return jobs, [reg_eval(j) for j in jobs]
 
-    saved_env = os.environ.get("NOMAD_TPU_RESIDENT")
+    saved_env = _knobs.raw("NOMAD_TPU_RESIDENT")
     os.environ["NOMAD_TPU_RESIDENT"] = "1"
     resident.reset_counters()
     try:
@@ -1340,11 +1343,12 @@ def _mesh_child_main() -> int:
 
     jax.config.update("jax_platforms", "cpu")
     os.environ["NOMAD_TPU_RNG_SEED"] = str(MESH_SEED)
-    n_nodes = int(os.environ.get("NOMAD_TPU_BENCH_MESH_NODES",
-                                 MESH_N_NODES))
-    n_jobs = int(os.environ.get("NOMAD_TPU_BENCH_MESH_JOBS", MESH_N_JOBS))
-    count = int(os.environ.get("NOMAD_TPU_BENCH_MESH_COUNT",
-                               MESH_COUNT_PER_JOB))
+    from nomad_tpu.utils import knobs
+
+    n_nodes = knobs.get_int("NOMAD_TPU_BENCH_MESH_NODES", MESH_N_NODES)
+    n_jobs = knobs.get_int("NOMAD_TPU_BENCH_MESH_JOBS", MESH_N_JOBS)
+    count = knobs.get_int("NOMAD_TPU_BENCH_MESH_COUNT",
+                          MESH_COUNT_PER_JOB)
 
     from nomad_tpu.ops.batch_sched import TPUBatchScheduler
     from nomad_tpu.parallel import make_node_mesh
@@ -1376,7 +1380,7 @@ def _mesh_child_main() -> int:
     # pure.  This is the host cost the columnar state store removes
     # from every cold encode at this scale.
     from nomad_tpu.ops import encode as _enc
-    guard_prev = os.environ.get("NOMAD_TPU_COLUMNAR_GUARD_EVERY")
+    guard_prev = _knobs.raw("NOMAD_TPU_COLUMNAR_GUARD_EVERY")
     os.environ["NOMAD_TPU_COLUMNAR_GUARD_EVERY"] = "0"
     try:
         enc_nodes = snap.nodes(None)
@@ -1461,7 +1465,7 @@ def _mesh_child_main() -> int:
             "resident_hit": bool(stats.resident_hits),
         }
 
-    saved_dev = os.environ.get("NOMAD_TPU_RESIDENT_DEVICE")
+    saved_dev = _knobs.raw("NOMAD_TPU_RESIDENT_DEVICE")
     try:
         ab_donated = ab_leg(True)
         ab_upload = ab_leg(False)
@@ -1559,10 +1563,12 @@ def _mesh_steady_child_main() -> int:
     os.environ["NOMAD_TPU_RNG_SEED"] = str(MESH_SEED)
     os.environ["NOMAD_TPU_RESIDENT"] = "1"
     os.environ["NOMAD_TPU_RESIDENT_DEVICE"] = "1"
-    n_nodes = int(os.environ.get("NOMAD_TPU_BENCH_MESH_STEADY_NODES",
-                                 MESH_STEADY_N_NODES))
-    n_batches = int(os.environ.get("NOMAD_TPU_BENCH_MESH_STEADY_BATCHES",
-                                   MESH_STEADY_BATCHES))
+    from nomad_tpu.utils import knobs
+
+    n_nodes = knobs.get_int("NOMAD_TPU_BENCH_MESH_STEADY_NODES",
+                            MESH_STEADY_N_NODES)
+    n_batches = knobs.get_int("NOMAD_TPU_BENCH_MESH_STEADY_BATCHES",
+                              MESH_STEADY_BATCHES)
     evals_per_batch = 4
     count_per_eval = 5
 
@@ -1723,8 +1729,8 @@ def bench_snapshot(legacy: bool = True) -> dict:
     from nomad_tpu.state.state_store import StateStore
     from nomad_tpu.structs import structs as s
 
-    n_nodes = int(os.environ.get("NOMAD_TPU_BENCH_SNAP_NODES", "50000"))
-    n_allocs = int(os.environ.get("NOMAD_TPU_BENCH_SNAP_ALLOCS", "250000"))
+    n_nodes = _knobs.get_int("NOMAD_TPU_BENCH_SNAP_NODES")
+    n_allocs = _knobs.get_int("NOMAD_TPU_BENCH_SNAP_ALLOCS")
 
     def build(n, m):
         st = StateStore()
@@ -1747,7 +1753,7 @@ def bench_snapshot(legacy: bool = True) -> dict:
         return st
 
     def measure(st, flag):
-        prev = os.environ.get("NOMAD_TPU_COLUMNAR")
+        prev = _knobs.raw("NOMAD_TPU_COLUMNAR")
         os.environ["NOMAD_TPU_COLUMNAR"] = flag
         try:
             t = time.monotonic()
@@ -1867,11 +1873,11 @@ class _Budget:
 
 
 def _child_main():
-    partial_path = os.environ.get(PARTIAL_ENV, "")
-    tpu_retry = os.environ.get(TPU_RETRY_ENV) == "1"
+    partial_path = _knobs.get_str(PARTIAL_ENV, "") or ""
+    tpu_retry = _knobs.raw(TPU_RETRY_ENV) == "1"
 
     detail = {}
-    budget_s = float(os.environ.get(BUDGET_ENV, 0) or 0)
+    budget_s = _knobs.get_float(BUDGET_ENV, 0.0)
 
     def flush():
         if not partial_path:
@@ -2373,15 +2379,40 @@ def _check_main(argv) -> int:
     note: thresholds compare like-for-like only when the baseline and
     the check ran on the same backend; the emitted JSON records the
     current platform for the reader."""
-    threshold = 0.0
+    # None (unset) vs 0.0 (explicit strict-zero tolerance) must stay
+    # distinct for BOTH the CLI flag and the env knob — `if not x` /
+    # `or` would coerce an operator's 0 back to the default.
+    threshold = None
     for i, arg in enumerate(argv):
         if arg == "--threshold" and i + 1 < len(argv):
             threshold = float(argv[i + 1])
         elif arg.startswith("--threshold="):
             threshold = float(arg.split("=", 1)[1])
-    if not threshold:
-        threshold = float(os.environ.get(
-            "NOMAD_TPU_BENCH_CHECK_THRESHOLD", 0) or CHECK_THRESHOLD_DEFAULT)
+    if threshold is None:
+        threshold = _knobs.get_float("NOMAD_TPU_BENCH_CHECK_THRESHOLD",
+                                     None)
+    if threshold is None:
+        threshold = CHECK_THRESHOLD_DEFAULT
+
+    # Invariant analysis gate (ISSUE 15): the static pass must be clean
+    # before any perf number is trusted — a lock-discipline or guard-
+    # coverage violation is a correctness regression whatever the
+    # placed/s says.  Hard gate: violations fail --check outright.
+    from nomad_tpu.analysis import run_checks as _run_analysis
+
+    with _deadline(120, "check_analysis"):
+        _active, _suppressed = _run_analysis()
+    if _active:
+        for _v in _active[:20]:
+            log(f"analysis violation: {_v.render()}")
+        print(json.dumps({
+            "check": "bench-regression",
+            "result": f"FAIL: nomad_tpu.analysis found {len(_active)} "
+                      f"unsuppressed violation(s) — run python -m "
+                      f"nomad_tpu.analysis --check",
+        }), flush=True)
+        return 1
+    log(f"analysis gate: clean ({len(_suppressed)} allowlisted)")
 
     (baseline_file, base_ns, base_p95, base_ce, base_steady, base_cf,
      base_ctl, base_ctl_p99, base_mesh, base_mesh_enc,
@@ -2847,13 +2878,13 @@ def _check_main(argv) -> int:
 
 
 def main():
-    if os.environ.get(MESH_STEADY_CHILD_ENV) == "1":
+    if _knobs.raw(MESH_STEADY_CHILD_ENV) == "1":
         sys.exit(_mesh_steady_child_main())
-    if os.environ.get(MESH_CHILD_ENV) == "1":
+    if _knobs.raw(MESH_CHILD_ENV) == "1":
         sys.exit(_mesh_child_main())
     if "--check" in sys.argv[1:]:
         sys.exit(_check_main(sys.argv[1:]))
-    if os.environ.get(CHILD_ENV) == "1":
+    if _knobs.raw(CHILD_ENV) == "1":
         sys.exit(_child_main())
 
     # Parent: phases run in a child with a hard wall-clock backstop; the

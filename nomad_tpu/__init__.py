@@ -23,3 +23,11 @@ Layers (bottom-up, mirroring SURVEY.md §1):
 """
 
 __version__ = "0.1.0"
+
+# Runtime lock-order sanitizer (ISSUE 15): NOMAD_TPU_LOCKCHECK=1 arms
+# utils/lockcheck at package import so subprocess servers (bench
+# children, loadgen followers) inherit the instrumentation from the
+# environment.  Disarmed cost: one registry-checked env read, once.
+from .utils import lockcheck as _lockcheck  # noqa: E402
+
+_lockcheck.maybe_arm_from_env()

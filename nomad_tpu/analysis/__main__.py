@@ -1,0 +1,84 @@
+"""``python -m nomad_tpu.analysis`` — run the invariant lint.
+
+Modes:
+  --check              run all rule families; exit 1 on any
+                       unsuppressed violation (the default)
+  --list               also print suppressed (allowlisted) findings
+  --rule NAME          restrict to one family (repeatable):
+                       lock-discipline / jax-discipline /
+                       guard-coverage / knob-registry
+  --write-knob-table   regenerate the README env-knob table between
+                       the knob-table markers, then exit
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import RULE_FAMILIES, repo_root, run_checks
+
+
+def _write_knob_table(root: str) -> int:
+    import os
+
+    from .guardrules import _load_by_path
+
+    knobs = _load_by_path(root, "nomad_tpu/utils/knobs.py",
+                          "_analysis_knobs_w")
+    readme = os.path.join(root, "README.md")
+    with open(readme, "r", encoding="utf-8") as fh:
+        text = fh.read()
+    table = knobs.render_readme_table()
+    begin, end = knobs.TABLE_BEGIN, knobs.TABLE_END
+    if begin in text and end in text:
+        start = text.index(begin)
+        stop = text.index(end) + len(end)
+        text = text[:start] + table + text[stop:]
+    else:
+        print("README.md has no knob-table markers; add them where "
+              "the table belongs (see utils/knobs.py TABLE_BEGIN)",
+              file=sys.stderr)
+        return 1
+    with open(readme, "w", encoding="utf-8") as fh:
+        fh.write(text)
+    n = sum(1 for _ in knobs.registered())
+    print(f"README knob table regenerated ({n} knobs)")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m nomad_tpu.analysis")
+    # Checking is the only mode; --check is accepted so gate scripts
+    # and docs can spell the intent explicitly.
+    parser.add_argument("--check", action="store_true", default=False)
+    parser.add_argument("--list", action="store_true", default=False)
+    parser.add_argument("--rule", action="append", default=None,
+                        choices=list(RULE_FAMILIES))
+    parser.add_argument("--write-knob-table", action="store_true")
+    parser.add_argument("--root", default=None)
+    args = parser.parse_args(argv)
+
+    root = args.root or repo_root()
+    if args.write_knob_table:
+        return _write_knob_table(root)
+
+    active, suppressed = run_checks(root, rules=args.rule)
+    if args.list and suppressed:
+        print(f"-- {len(suppressed)} allowlisted finding(s) --")
+        for v in suppressed:
+            print("  " + v.render().replace("\n", "\n  "))
+    if active:
+        print(f"-- {len(active)} violation(s) --")
+        for v in active:
+            print(v.render())
+        print(f"\nFAIL: {len(active)} violation(s) "
+              f"({len(suppressed)} allowlisted). Fix them or add a "
+              f"justified entry to nomad_tpu/analysis/allowlist.txt")
+        return 1
+    print(f"analysis: clean ({len(suppressed)} allowlisted finding(s) "
+          f"across the tree)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,215 @@
+"""Rule family 3: guard coverage, checked against ``ops/guards.py``.
+
+The registry (:mod:`nomad_tpu.ops.guards`) declares every fast-path /
+reference-path pair; this rule family verifies the declarations are
+*true of the tree*:
+
+- every ``native/*.cc`` source is claimed by exactly one registry
+  entry (an unclaimed twin is unguarded native code);
+- each entry's module defines the named guard symbol;
+- entries claiming a breaker feed actually contain one (a
+  ``.record(False)`` call or a ``_note_mismatch`` helper);
+- every kill-switch and guard-cadence knob an entry names is declared
+  in ``utils/knobs.py``;
+- a waiver (guard requirement explicitly not met) must carry a
+  written justification.
+
+The registry module is loaded by file path, not import, so the pass
+never drags in jax.
+"""
+from __future__ import annotations
+
+import ast
+import importlib.util
+import os
+from typing import Dict, List, Optional
+
+from . import SourceFile, Violation
+
+RULE = "guard-coverage"
+
+GUARDS_PATH = "nomad_tpu/ops/guards.py"
+KNOBS_PATH = "nomad_tpu/utils/knobs.py"
+NATIVE_DIR = "nomad_tpu/native"
+
+
+def registry_missing(root: str, rel: str, rule: str) -> Optional["Violation"]:
+    """A tree without its registry file is a structural violation, not a
+    crash — --root fixture trees get a diagnostic instead of a
+    FileNotFoundError traceback."""
+    if os.path.exists(os.path.join(root, rel)):
+        return None
+    return Violation(
+        rule=rule, path=rel, line=1, detail="registry-missing",
+        message=f"tree has no {rel} — the registry this rule family "
+                f"checks against is required")
+
+
+def _load_by_path(root: str, rel: str, name: str):
+    import hashlib
+    import sys
+
+    # Cache key carries the resolved path: two runs against different
+    # roots (tests, --root) must not see each other's registries.
+    abspath = os.path.abspath(os.path.join(root, rel))
+    name = (f"{name}_"
+            f"{hashlib.sha256(abspath.encode()).hexdigest()[:12]}")
+    cached = sys.modules.get(name)
+    if cached is not None:
+        return cached
+    spec = importlib.util.spec_from_file_location(name, abspath)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclasses resolves cls.__module__ through sys.modules.
+    sys.modules[name] = mod
+    try:
+        spec.loader.exec_module(mod)
+    except BaseException:
+        sys.modules.pop(name, None)
+        raise
+    return mod
+
+
+def _module_rel_path(dotted: str) -> str:
+    return dotted.replace(".", "/") + ".py"
+
+
+def _module_symbols(sf: SourceFile) -> Dict[str, int]:
+    """Top-level defs/assignments of a module -> line."""
+    out: Dict[str, int] = {}
+    for node in sf.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            out[node.name] = node.lineno
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = node.lineno
+        elif isinstance(node, ast.AnnAssign) and isinstance(
+                node.target, ast.Name):
+            out[node.target.id] = node.lineno
+    return out
+
+
+def _has_breaker_feed(sf: SourceFile) -> bool:
+    src = sf.source
+    return (".record(False)" in src or "_note_mismatch" in src
+            or "breaker.record" in src)
+
+
+def check(root: str, files: List[SourceFile]) -> List[Violation]:
+    violations: List[Violation] = []
+    by_path = {sf.path: sf for sf in files}
+
+    missing = [v for v in (registry_missing(root, GUARDS_PATH, RULE),
+                           registry_missing(root, KNOBS_PATH, RULE))
+               if v is not None]
+    if missing:
+        return missing
+    try:
+        guards = _load_by_path(root, GUARDS_PATH, "_analysis_guards")
+        knobs = _load_by_path(root, KNOBS_PATH, "_analysis_knobs")
+    except Exception as exc:  # registry must at least load
+        violations.append(Violation(
+            rule=RULE, path=GUARDS_PATH, line=1,
+            detail="registry-load",
+            message=f"guard/knob registry failed to load: {exc!r}"))
+        return violations
+    registered_knobs = {k.name for k in knobs.registered()}
+
+    # 1. every .cc claimed, nothing claimed that doesn't exist
+    # (a fixture tree without native/ has nothing to claim; phantom
+    # registry entries still fire below)
+    native_dir = os.path.join(root, NATIVE_DIR)
+    cc_files = sorted(fn for fn in (
+        os.listdir(native_dir) if os.path.isdir(native_dir) else ())
+        if fn.endswith(".cc"))
+    claimed = guards.native_sources()
+    for fn in cc_files:
+        if fn not in claimed:
+            violations.append(Violation(
+                rule=RULE, path=f"{NATIVE_DIR}/{fn}", line=1,
+                detail="unclaimed-native-source",
+                message=f"native source {fn} has no ops/guards.py "
+                        f"registry entry — every native twin needs a "
+                        f"declared guard/breaker/kill-switch pairing"))
+    for fn in claimed:
+        if fn not in cc_files:
+            violations.append(Violation(
+                rule=RULE, path=GUARDS_PATH, line=1,
+                detail=f"phantom-native-source:{fn}",
+                message=f"registry claims native source {fn} which "
+                        f"does not exist in {NATIVE_DIR}/"))
+
+    # 2. per-entry structural checks
+    seen_names = set()
+    for entry in guards.REGISTRY:
+        if entry.name in seen_names:
+            violations.append(Violation(
+                rule=RULE, path=GUARDS_PATH, line=1,
+                detail=f"dup-entry:{entry.name}",
+                message=f"duplicate registry entry {entry.name}"))
+            continue
+        seen_names.add(entry.name)
+
+        mod_rel = _module_rel_path(entry.module)
+        sf = by_path.get(mod_rel)
+        if sf is None:
+            violations.append(Violation(
+                rule=RULE, path=GUARDS_PATH, line=1,
+                detail=f"{entry.name}:missing-module",
+                message=f"registry entry {entry.name} names module "
+                        f"{entry.module} which is not in the tree"))
+            continue
+
+        if entry.guard_symbol is not None:
+            if entry.guard_symbol not in _module_symbols(sf):
+                violations.append(Violation(
+                    rule=RULE, path=mod_rel, line=1,
+                    detail=f"{entry.name}:missing-guard-symbol",
+                    message=f"registry entry {entry.name} names guard "
+                            f"symbol {entry.guard_symbol!r} which "
+                            f"{entry.module} does not define"))
+        elif not entry.waiver.strip():
+            violations.append(Violation(
+                rule=RULE, path=GUARDS_PATH, line=1,
+                detail=f"{entry.name}:unjustified-no-guard",
+                message=f"registry entry {entry.name} has no guard "
+                        f"symbol and no written waiver — every twin "
+                        f"is guarded or carries a justification"))
+
+        if entry.breaker_feed and not _has_breaker_feed(sf):
+            violations.append(Violation(
+                rule=RULE, path=mod_rel, line=1,
+                detail=f"{entry.name}:missing-breaker-feed",
+                message=f"registry entry {entry.name} claims a "
+                        f"breaker feed but {entry.module} contains "
+                        f"no .record(False)/_note_mismatch call"))
+        if not entry.breaker_feed and not entry.waiver.strip():
+            violations.append(Violation(
+                rule=RULE, path=GUARDS_PATH, line=1,
+                detail=f"{entry.name}:unjustified-no-breaker",
+                message=f"registry entry {entry.name} opts out of the "
+                        f"breaker feed without a written waiver"))
+
+        if not entry.kill_switches:
+            violations.append(Violation(
+                rule=RULE, path=GUARDS_PATH, line=1,
+                detail=f"{entry.name}:no-kill-switch",
+                message=f"registry entry {entry.name} declares no env "
+                        f"kill-switch"))
+        for knob_name in entry.kill_switches:
+            if knob_name not in registered_knobs:
+                violations.append(Violation(
+                    rule=RULE, path=GUARDS_PATH, line=1,
+                    detail=f"{entry.name}:unknown-kill:{knob_name}",
+                    message=f"kill-switch {knob_name} is not declared "
+                            f"in utils/knobs.py"))
+        if (entry.guard_every_knob is not None
+                and entry.guard_every_knob not in registered_knobs):
+            violations.append(Violation(
+                rule=RULE, path=GUARDS_PATH, line=1,
+                detail=f"{entry.name}:unknown-cadence:"
+                       f"{entry.guard_every_knob}",
+                message=f"guard-cadence knob {entry.guard_every_knob} "
+                        f"is not declared in utils/knobs.py"))
+    return violations

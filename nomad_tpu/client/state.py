@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Dict, List, Optional
 
 from ..server.log_codec import decode_payload, encode_payload
@@ -24,18 +25,40 @@ class StateDB:
         self.dir = os.path.join(state_dir, "allocs")
         os.makedirs(self.dir, exist_ok=True)
         self._lock = threading.Lock()
+        # Tmp names carry a writer-thread suffix (".tmp<ident>"), so a
+        # crash between write and rename strands one; reap here, before
+        # this process has any writer.  Age-gated: a sibling process
+        # mid-handover may still be between fsync and rename on its own
+        # tmp — deleting that would crash its os.replace — and a live
+        # write is milliseconds old, never minutes.
+        now = time.time()
+        try:
+            for f in os.listdir(self.dir):
+                if ".tmp" not in f:
+                    continue
+                p = os.path.join(self.dir, f)
+                try:
+                    if now - os.path.getmtime(p) > 60.0:
+                        os.unlink(p)
+                except OSError:
+                    pass
+        except OSError:
+            pass
 
     def _path(self, alloc_id: str) -> str:
         return os.path.join(self.dir, alloc_id)
 
     def put_alloc_runner(self, alloc_id: str, state: Dict) -> None:
+        # fsync OUTSIDE the lock (the ISSUE 15 lint's lock-blocking
+        # rule — the PR 9 fsync-under-lock class): each writer builds a
+        # private tmp file and only the atomic rename serializes.
         path = self._path(alloc_id)
-        tmp = path + ".tmp"
+        tmp = f"{path}.tmp{threading.get_ident()}"
+        with open(tmp, "wb") as f:
+            f.write(encode_payload(state))
+            f.flush()
+            os.fsync(f.fileno())
         with self._lock:
-            with open(tmp, "wb") as f:
-                f.write(encode_payload(state))
-                f.flush()
-                os.fsync(f.fileno())
             os.replace(tmp, path)
 
     def get_alloc_runner(self, alloc_id: str) -> Optional[Dict]:
@@ -49,7 +72,7 @@ class StateDB:
 
     def list_alloc_runners(self) -> List[str]:
         try:
-            return [f for f in os.listdir(self.dir) if not f.endswith(".tmp")]
+            return [f for f in os.listdir(self.dir) if ".tmp" not in f]
         except OSError:
             return []
 

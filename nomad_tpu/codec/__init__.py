@@ -55,8 +55,9 @@ def enabled() -> bool:
     """The kill switch (read once, reset() re-reads): default ON."""
     global _enabled_cache
     if _enabled_cache is None:
-        flag = os.environ.get("NOMAD_TPU_CODEC", "").strip().lower()
-        _enabled_cache = flag not in ("0", "false", "no")
+        from ..utils import knobs
+
+        _enabled_cache = knobs.get_bool("NOMAD_TPU_CODEC")
     return _enabled_cache
 
 

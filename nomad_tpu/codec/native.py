@@ -35,10 +35,9 @@ _lib_resolved = False
 
 
 def guard_every() -> int:
-    try:
-        return int(os.environ.get("NOMAD_TPU_CODEC_GUARD_EVERY", "") or 512)
-    except ValueError:
-        return 512
+    from ..utils import knobs
+
+    return knobs.get_int("NOMAD_TPU_CODEC_GUARD_EVERY")
 
 
 def reset_counters() -> None:

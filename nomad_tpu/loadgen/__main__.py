@@ -82,7 +82,9 @@ def _follower_child_main(args) -> int:
         # One aggregation window for the whole run, like the harness
         # leader: the parent collects RTT/lag histograms at teardown.
         srv.metrics.sink.interval = 3600.0
-    if os.environ.get("NOMAD_TPU_LG_PROFILE", "").strip() == "1":
+    from nomad_tpu.utils import knobs
+
+    if knobs.get_bool("NOMAD_TPU_LG_PROFILE"):
         _start_child_sampler()
     srv.start()
     print(f"READY {srv.config.rpc_advertise}", flush=True)

@@ -61,12 +61,14 @@ def _apply_switch_interval():
     import os
     import sys
 
-    val = os.environ.get("NOMAD_TPU_SWITCH_INTERVAL", "").strip()
-    if not val:
+    from ..utils import knobs
+
+    val = knobs.get_float("NOMAD_TPU_SWITCH_INTERVAL")
+    if val is None:
         return None
     prior = sys.getswitchinterval()
     try:
-        sys.setswitchinterval(float(val))
+        sys.setswitchinterval(val)
     except (ValueError, OSError):  # pragma: no cover
         return None
     return prior

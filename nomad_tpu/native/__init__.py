@@ -33,27 +33,78 @@ class NativeUnavailable(Exception):
 
 
 def _disabled() -> bool:
-    flag = os.environ.get("NOMAD_TPU_NO_NATIVE", "").strip().lower()
-    return flag not in ("", "0", "false", "no")
+    from ..utils import knobs
+
+    return knobs.get_bool("NOMAD_TPU_NO_NATIVE")
+
+
+def _sanitized() -> bool:
+    """ASan/UBSan build mode (ISSUE 15): the native components compile
+    with -fsanitize=address,undefined and the twin/fuzz corpora run
+    against them in a sanitizer-preloaded subprocess (see __main__.py
+    and sanitizer_env()).  Never the production mode — the selfcheck
+    corpus leg arms it explicitly."""
+    from ..utils import knobs
+
+    return knobs.get_bool("NOMAD_TPU_NATIVE_ASAN")
+
+
+SANITIZE_FLAGS = ["-fsanitize=address,undefined",
+                  "-fno-sanitize-recover=all",
+                  "-fno-omit-frame-pointer", "-g"]
+
+
+def sanitizer_env() -> dict:
+    """Environment for a child process that loads sanitized .so's into
+    a stock python: the ASan/UBSan runtimes must be first in the link
+    order, which for a ctypes-loaded library means LD_PRELOAD.  Leak
+    checking is off — the interpreter's own allocations would drown
+    the signal; the corpus leg is after buffer/UB bugs in our code."""
+    libs = []
+    for lib in ("libasan.so", "libubsan.so"):
+        try:
+            path = subprocess.run(
+                ["g++", f"-print-file-name={lib}"],
+                capture_output=True, timeout=30,
+                check=True).stdout.decode().strip()
+        except (subprocess.CalledProcessError,
+                subprocess.TimeoutExpired, FileNotFoundError):
+            continue
+        if path and os.path.isabs(path):
+            libs.append(path)
+    env = dict(os.environ)
+    env["NOMAD_TPU_NATIVE_ASAN"] = "1"
+    if libs:
+        env["LD_PRELOAD"] = ":".join(libs)
+    env["ASAN_OPTIONS"] = ("detect_leaks=0:abort_on_error=1:"
+                           + env.get("ASAN_OPTIONS", ""))
+    env["UBSAN_OPTIONS"] = ("halt_on_error=1:"
+                            + env.get("UBSAN_OPTIONS", ""))
+    return env
 
 
 def _build(name: str, source: str) -> str:
     """Compile ``source`` (a .cc in this package) into a cached .so and
     return its path.  Content-addressed: recompiles only when the source
-    changes."""
+    changes; sanitized builds cache under a distinct name."""
+    from ..utils import knobs
+
     src_path = os.path.join(_HERE, source)
     with open(src_path, "rb") as fh:
         digest = hashlib.sha256(fh.read()).hexdigest()[:16]
-    cache_dir = os.environ.get(
-        "NOMAD_TPU_NATIVE_CACHE",
-        os.path.expanduser("~/.cache/nomad_tpu/native"))
+    cache_dir = (knobs.get_str("NOMAD_TPU_NATIVE_CACHE")
+                 or os.path.expanduser("~/.cache/nomad_tpu/native"))
     os.makedirs(cache_dir, exist_ok=True)
-    so_path = os.path.join(cache_dir, f"lib{name}-{digest}.so")
+    sanitized = _sanitized()
+    suffix = "-asan" if sanitized else ""
+    so_path = os.path.join(cache_dir, f"lib{name}-{digest}{suffix}.so")
     if os.path.exists(so_path):
         return so_path
     tmp = so_path + f".tmp{os.getpid()}"
-    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           src_path, "-o", tmp]
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread"]
+    if sanitized:
+        cmd += SANITIZE_FLAGS
+    cmd += [src_path, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
     except (subprocess.CalledProcessError, subprocess.TimeoutExpired,

@@ -1,0 +1,220 @@
+"""Sanitized native corpus runner (ISSUE 15 ASan/UBSan wiring).
+
+Two halves:
+
+- ``python -m nomad_tpu.native --asan-corpus`` (the CHILD): assumes it
+  was launched with ``native.sanitizer_env()`` — ASan/UBSan runtimes
+  LD_PRELOADed and ``NOMAD_TPU_NATIVE_ASAN=1`` so every ``.so`` builds
+  with ``-fsanitize=address,undefined``.  Runs the twin/fuzz corpora
+  for all four native components (wal.cc, codec.cc, decode.cc, ids.cc)
+  with differential guards pinned to every call; any heap-buffer
+  overflow, use-after-free, or UB in the C++ aborts the process, any
+  twin divergence exits 1.  Exit 3 = toolchain unavailable (graceful
+  skip).
+- ``run_sanitized()`` (the PARENT, used by ``ops --selfcheck`` and the
+  tests): spawns the child with the sanitizer environment and maps its
+  exit status to ok/skip/fail.
+
+No jax anywhere on this path — the corpus exercises the C ABI only.
+"""
+from __future__ import annotations
+
+import os
+import random
+import subprocess
+import sys
+import tempfile
+
+
+def _corpus_wal(rng: random.Random) -> str:
+    from . import NativeWAL
+
+    d = tempfile.mkdtemp(prefix="nomad-tpu-asan-wal-")
+    path = os.path.join(d, "wal.crc")
+    records = [bytes(rng.randrange(256) for _ in range(rng.choice(
+        (0, 1, 7, 64, 513, 4096)))) for _ in range(200)]
+    wal = NativeWAL(path, fsync=False)
+    seqs = []
+    for i, rec in enumerate(records):
+        if i % 3 == 0:
+            wal.append(rec)
+        else:
+            seqs.append(wal.write(rec))
+    if seqs:
+        wal.sync_to(seqs[-1])
+    wal.sync()
+    got = list(wal.records())
+    if got != records:
+        return f"wal round-trip diverged ({len(got)}/{len(records)})"
+    wal.close()
+    # Torn tail: append garbage, reopen, durable prefix must survive.
+    with open(path, "ab") as fh:
+        fh.write(b"\x7f\x01garbage-torn-frame")
+    wal2 = NativeWAL(path, fsync=False)
+    got = list(wal2.records())
+    if got != records:
+        return "wal torn-tail recovery lost the durable prefix"
+    wal2.append(b"post-recovery")
+    if list(wal2.records()) != records + [b"post-recovery"]:
+        return "wal append after torn-tail recovery diverged"
+    wal2.reset()
+    if len(wal2) != 0:
+        return "wal reset left entries"
+    wal2.close()
+    return ""
+
+
+def _corpus_codec(rng: random.Random) -> str:
+    from ..codec import native as cnative
+
+    for trial in range(60):
+        n = rng.randrange(0, 40)
+        strs = []
+        for _ in range(n):
+            k = rng.choice((0, 1, 3, 17, 255, 4000))
+            strs.append("".join(chr(rng.randrange(32, 0x2FF))
+                                for _ in range(k)))
+        packed = cnative.pack_strs(strs)
+        ref = cnative._py_pack_strs(
+            [s.encode("utf-8") for s in strs])
+        if packed != ref:
+            return f"codec pack diverged from twin (trial {trial})"
+        blob = b"\xaa" * rng.randrange(0, 9) + packed
+        out, p = cnative.unpack_strs(blob, len(blob) - len(packed), n)
+        if out != strs or p != len(blob):
+            return f"codec unpack diverged (trial {trial})"
+    return ""
+
+
+def _corpus_decode(rng: random.Random) -> str:
+    import numpy as np
+
+    from ..ops import decode
+
+    for trial in range(60):
+        n_specs = rng.randrange(1, 40)
+        n_real = rng.randrange(1, 500)
+        n = rng.randrange(0, 300)
+        rows = np.sort(np.asarray(
+            [rng.randrange(-1, n_specs) for _ in range(n)],
+            dtype=np.int32))
+        cols = np.asarray([rng.randrange(0, max(1, int(n_real * 1.2)))
+                           for _ in range(n)], dtype=np.int32)
+        counts = np.asarray([rng.randrange(0, 5) for _ in range(n)],
+                            dtype=np.int32)
+        total = int(counts[(rows >= 0) & (cols < n_real)].sum())
+        off, out = decode.expand_coo(rows, cols, counts, n_specs,
+                                     n_real, total)
+        r_off, r_out = decode._expand_twin(rows, cols, counts,
+                                           n_specs, n_real)
+        if not (np.array_equal(off, r_off)
+                and np.array_equal(out, r_out)):
+            return f"decode expand diverged (trial {trial})"
+        scores = np.asarray([rng.random() for _ in range(n)],
+                            dtype=np.float32)
+        coll = np.asarray([rng.randrange(0, 3) for _ in range(n)],
+                          dtype=np.int32)
+        got = decode.last_scores(rows, cols, scores, coll, n_specs,
+                                 n_real)
+        ref = decode._last_scores_twin(rows, cols, scores, coll,
+                                       n_specs, n_real)
+        if not all(np.array_equal(a, b) for a, b in zip(ref, got)):
+            return f"decode last_scores diverged (trial {trial})"
+    return ""
+
+
+def _corpus_ids() -> str:
+    from . import generate_uuids
+
+    ids = generate_uuids(5000)
+    if len(set(ids)) != 5000:
+        return "ids corpus produced duplicates"
+    for u in ids[:100]:
+        if len(u) != 36 or u[8] != "-" or u[13] != "-":
+            return f"ids corpus produced malformed uuid {u!r}"
+    return ""
+
+
+def child_main(seed: int = 0) -> int:
+    from . import NativeUnavailable, native_wal_available
+
+    # Guards at EVERY call: the sanitized run is also a twin-parity run.
+    os.environ.setdefault("NOMAD_TPU_CODEC_GUARD_EVERY", "1")
+    os.environ.setdefault("NOMAD_TPU_DECODE_GUARD_EVERY", "1")
+    if not native_wal_available():
+        print("asan-corpus: SKIP — native toolchain unavailable",
+              flush=True)
+        return 3
+    rng = random.Random(f"asan-corpus/{seed}")
+    legs = (("wal", lambda: _corpus_wal(rng)),
+            ("codec", lambda: _corpus_codec(rng)),
+            ("decode", lambda: _corpus_decode(rng)),
+            ("ids", lambda: _corpus_ids()))
+    for name, fn in legs:
+        try:
+            err = fn()
+        except NativeUnavailable:
+            print(f"asan-corpus: SKIP {name} — native unavailable",
+                  flush=True)
+            return 3
+        if err:
+            print(f"asan-corpus: FAIL {name} — {err}", flush=True)
+            return 1
+        print(f"asan-corpus: {name} leg OK", flush=True)
+    print("asan-corpus: OK — all native corpora clean under "
+          "ASan+UBSan", flush=True)
+    return 0
+
+
+def run_sanitized(seed: int = 0, log=print, timeout_s: int = 300
+                  ) -> str:
+    """Parent half: spawn the sanitized child.  Returns "ok", "skip",
+    or an error description."""
+    from . import sanitizer_env
+
+    env = sanitizer_env()
+    # The sanitized cache must not collide with the production one when
+    # the operator pinned a cache dir (the -asan suffix also separates
+    # them; belt and braces for the preload run).
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "nomad_tpu.native", "--asan-corpus",
+             "--seed", str(seed)],
+            env=env, capture_output=True, timeout=timeout_s,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))))
+    except subprocess.TimeoutExpired:
+        return f"sanitized corpus child exceeded {timeout_s}s"
+    tail = proc.stdout.decode(errors="replace").strip().splitlines()
+    for line in tail[-6:]:
+        log(f"  {line}")
+    if proc.returncode == 3:
+        return "skip"
+    if proc.returncode != 0:
+        err_tail = proc.stderr.decode(errors="replace").strip()
+        for line in err_tail.splitlines()[-10:]:
+            log(f"  {line}")
+        return (f"sanitized corpus child rc={proc.returncode} "
+                f"(sanitizer report above)")
+    return "ok"
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    seed = 0
+    if "--seed" in argv:
+        seed = int(argv[argv.index("--seed") + 1])
+    if "--asan-corpus" in argv:
+        return child_main(seed)
+    # Parent convenience entry: build + run sanitized.
+    verdict = run_sanitized(seed)
+    if verdict == "ok":
+        return 0
+    if verdict == "skip":
+        return 0
+    print(verdict, file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,6 +29,7 @@ from __future__ import annotations
 import argparse
 import sys
 
+from ..utils import knobs
 from .preempt import selfcheck
 
 
@@ -1148,7 +1149,7 @@ def codec_drill(seed: int = 0, log=print) -> bool:
 
     # 3. Native/python twin agreement on the seeded column corpus.
     runs_before = cnative.GUARD_RUNS
-    saved = os.environ.get("NOMAD_TPU_CODEC_GUARD_EVERY")
+    saved = knobs.raw("NOMAD_TPU_CODEC_GUARD_EVERY")
     os.environ["NOMAD_TPU_CODEC_GUARD_EVERY"] = "1"
     try:
         for payload in corpus:
@@ -1210,7 +1211,7 @@ def follower_drill(seed: int = 0, log=print) -> bool:
             time.sleep(0.02)
         return pred()
 
-    saved = os.environ.get("NOMAD_TPU_SNAPSHOT_CHUNK")
+    saved = knobs.raw("NOMAD_TPU_SNAPSHOT_CHUNK")
     servers = []
     fresh = None
     try:
@@ -1454,6 +1455,90 @@ def chaos_drill(seed: int = 0, log=print) -> bool:
     return True
 
 
+def analysis_drill(seed: int = 0, log=print) -> bool:
+    """Invariant-analysis drill (ISSUE 15), three legs:
+
+    1. the static pass is CLEAN on the tree (zero unsuppressed
+       violations — the same gate bench --check enforces);
+    2. the runtime lock-order sanitizer catches a seeded inversion
+       (A→B in one thread, B→A in another ⇒ cycle + witness) and is
+       acyclic-silent on the well-ordered control;
+    3. the native twin/fuzz corpora run clean under ASan+UBSan
+       (graceful skip when the toolchain lacks the sanitizer
+       runtimes).
+    """
+    from ..analysis import run_checks
+    from ..native.__main__ import run_sanitized
+    from ..utils import lockcheck
+
+    def check(cond, msg):
+        if not cond:
+            log(f"analysis drill: FAIL — {msg}")
+        return bool(cond)
+
+    ok = True
+    # 1. lint clean.
+    active, suppressed = run_checks()
+    ok = check(not active,
+               f"static pass found {len(active)} unsuppressed "
+               f"violation(s): "
+               + "; ".join(v.key for v in active[:4])) and ok
+
+    # 2. seeded lock-order inversion caught, witness printed.
+    was_armed = lockcheck.armed()
+    if not was_armed:
+        lockcheck.arm()
+    try:
+        lockcheck.reset()
+        a = lockcheck.make_tracked("drill:lock_a")
+        b = lockcheck.make_tracked("drill:lock_b")
+        with a:
+            with b:
+                pass
+        ok = check(lockcheck.find_cycle() is None,
+                   "well-ordered acquisitions reported a cycle") and ok
+        import threading as _threading
+
+        def invert():
+            with b:
+                with a:
+                    pass
+
+        t = _threading.Thread(target=invert, name="drill-invert")
+        t.start()
+        t.join(5)
+        cycle = lockcheck.find_cycle()
+        ok = check(cycle is not None,
+                   "seeded A→B / B→A inversion not detected") and ok
+        if cycle is not None:
+            caught = False
+            try:
+                lockcheck.assert_acyclic()
+            except lockcheck.LockOrderError as exc:
+                caught = ("drill:lock_a" in str(exc)
+                          and "drill:lock_b" in str(exc))
+            ok = check(caught, "witness chain missing the seeded "
+                               "locks") and ok
+    finally:
+        lockcheck.reset()
+        if not was_armed:
+            lockcheck.disarm()
+
+    # 3. sanitized native corpus.
+    verdict = run_sanitized(seed=seed, log=log)
+    if verdict == "skip":
+        log("analysis drill: ASan corpus leg SKIPPED (no sanitizer "
+            "toolchain)")
+    else:
+        ok = check(verdict == "ok", verdict) and ok
+
+    if ok:
+        log("analysis drill: OK — lint clean, seeded inversion caught "
+            "with witness, sanitized native corpus "
+            + ("skipped" if verdict == "skip" else "clean"))
+    return ok
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="python -m nomad_tpu.ops")
     parser.add_argument("--selfcheck", action="store_true",
@@ -1486,6 +1571,7 @@ def main(argv=None) -> int:
     ok = follower_drill(seed=args.seed) and ok
     ok = chaos_drill(seed=args.seed) and ok
     ok = mesh_drill(seed=args.seed) and ok
+    ok = analysis_drill(seed=args.seed) and ok
     return 0 if ok else 1
 
 

@@ -33,7 +33,7 @@ import numpy as np
 
 from .. import fault
 from ..scheduler.generic import GenericScheduler
-from ..utils import tracing
+from ..utils import knobs, tracing
 from ..utils.telemetry import NULL_TELEMETRY
 from ..scheduler.scheduler import register_scheduler
 from ..scheduler.util import AllocTuple, ready_nodes_in_dcs, set_status
@@ -89,9 +89,7 @@ def fused_enabled() -> bool:
     single transfer (kernels.fused_pass).  0/false keeps the two-phase
     schedule/compact split as the fallback; both paths are bit-identical
     by construction (same scan, same compaction expression)."""
-    from ..utils.flags import env_flag
-
-    return env_flag("NOMAD_TPU_FUSED", True)
+    return knobs.get_bool("NOMAD_TPU_FUSED")
 
 
 def _ensure_compile_cache() -> None:
@@ -106,8 +104,7 @@ def _ensure_compile_cache() -> None:
     if _cache_configured:
         return
     _cache_configured = True
-    flag = os.environ.get("NOMAD_TPU_NO_COMPILE_CACHE", "").strip().lower()
-    if flag not in ("", "0", "false", "no"):
+    if knobs.get_bool("NOMAD_TPU_NO_COMPILE_CACHE"):
         return
     if jax.config.jax_compilation_cache_dir is not None:
         return  # the application already configured one
@@ -118,8 +115,8 @@ def _ensure_compile_cache() -> None:
         return
     jax.config.update(
         "jax_compilation_cache_dir",
-        os.environ.get("NOMAD_TPU_COMPILE_CACHE_DIR",
-                       os.path.expanduser("~/.cache/nomad_tpu/xla")))
+        knobs.get_str("NOMAD_TPU_COMPILE_CACHE_DIR")
+        or os.path.expanduser("~/.cache/nomad_tpu/xla"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
@@ -1109,9 +1106,12 @@ class TPUBatchScheduler:
             # NOMAD_TPU_RNG_SEED for deterministic placement reproduction
             # (the fused-vs-two-phase differential tests pin placements
             # bit-identical under a fixed seed).
+            # raw + explicit int(): a malformed pin must fail LOUDLY
+            # at dispatch, not silently fall through to a random seed
+            # the operator believes is deterministic.
             "rng_seed": np.array(
-                [(int(os.environ["NOMAD_TPU_RNG_SEED"])
-                  if os.environ.get("NOMAD_TPU_RNG_SEED")
+                [(int(rng_pin) if (rng_pin := knobs.raw(
+                    "NOMAD_TPU_RNG_SEED"))
                   else int.from_bytes(s.generate_uuid()[:8].encode(),
                                       "big")) & 0x7FFFFFFF],
                 dtype=np.int32),
@@ -1143,7 +1143,7 @@ class TPUBatchScheduler:
             # loan.
             res_key = snap_index = None
             if (use_resident
-                    and os.environ.get("NOMAD_TPU_TIMING") != "2"):
+                    and knobs.get_str("NOMAD_TPU_TIMING") != "2"):
                 res_key = cache_key[:2] + (base.n_pad,)
                 snap_index = self.state.table_index("allocs")
             handle = self._dispatch_mesh(
@@ -1171,7 +1171,7 @@ class TPUBatchScheduler:
         used_dev = None
         res_key = snap_index = None
         if (use_resident and self.mesh is None
-                and os.environ.get("NOMAD_TPU_TIMING") != "2"):
+                and knobs.get_str("NOMAD_TPU_TIMING") != "2"):
             res_key = cache_key[:2] + (base.n_pad,)
             snap_index = self.state.table_index("allocs")
             used_dev = resident.take_device_used(res_key, snap_index,
@@ -1208,7 +1208,7 @@ class TPUBatchScheduler:
         fused_buf = fused_meta = fused_overflow = None
         summary_buf = coo_mat = None
         used_out = None
-        if os.environ.get("NOMAD_TPU_TIMING") == "2":
+        if knobs.get_str("NOMAD_TPU_TIMING") == "2":
             # Staged sync (diagnostics only): force the schedule program
             # to finish before compaction dispatch so the log splits
             # schedule vs compact+fetch.  This branch always produces COO
@@ -1314,7 +1314,7 @@ class TPUBatchScheduler:
         max_nnz = handle["max_nnz"]
 
         t_disp = time.monotonic()
-        dbg = os.environ.get("NOMAD_TPU_TIMING")
+        dbg = knobs.get_str("NOMAD_TPU_TIMING") or None
         fetch_bytes = 0
         if handle.get("fused_buf") is not None:
             # Fused path: the WHOLE batch result — summary + COO

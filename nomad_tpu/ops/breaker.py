@@ -58,10 +58,9 @@ class KernelIntegrityError(Exception):
 
 
 def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
+    from ..utils import knobs
+
+    return knobs.get_float(name, default)
 
 
 class KernelCircuitBreaker:
@@ -78,9 +77,9 @@ class KernelCircuitBreaker:
                               _env_float("NOMAD_TPU_BREAKER_MIN_CHECKS", 8))
         self.cooldown = (cooldown if cooldown is not None else
                          _env_float("NOMAD_TPU_BREAKER_COOLDOWN", 10.0))
-        self.disabled = os.environ.get(
-            "NOMAD_TPU_BREAKER_DISABLE", "").strip().lower() in (
-            "1", "true", "yes")
+        from ..utils import knobs
+
+        self.disabled = knobs.get_bool("NOMAD_TPU_BREAKER_DISABLE")
         self.clock = clock
         self._l = threading.Lock()
         self._state = CLOSED

@@ -51,10 +51,9 @@ _LIB = None
 
 
 def guard_every() -> int:
-    try:
-        return int(os.environ.get("NOMAD_TPU_DECODE_GUARD_EVERY", "64"))
-    except ValueError:
-        return 64
+    from ..utils import knobs
+
+    return knobs.get_int("NOMAD_TPU_DECODE_GUARD_EVERY")
 
 
 def reset_counters() -> None:

@@ -85,9 +85,9 @@ PORT_WORDS = MAX_VALID_PORT // 32          # uint32 words per node bitmap
 # independently.
 
 def quant_enabled() -> bool:
-    from ..utils.flags import env_flag
+    from ..utils import knobs
 
-    return env_flag("NOMAD_TPU_QUANT", True)
+    return knobs.get_bool("NOMAD_TPU_QUANT")
 
 
 @dataclass

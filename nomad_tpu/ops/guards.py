@@ -1,0 +1,157 @@
+"""Central registry of differential-guard coverage.
+
+The repo's standing discipline (ROADMAP item 3): every component with a
+fast path and a reference path — native (C++) twins, columnar numpy
+mirrors, resident device mirrors, quantized encodings — must be
+*paired* with (a) a registered differential guard that bit-compares the
+fast path against the reference, (b) a feed into the PR 2 kernel
+circuit breaker on mismatch, and (c) an env kill-switch that restores
+the reference path.  Until this PR that pairing was enforced only by
+convention and review; this registry makes it *structural*: every pair
+is declared here, and the static analysis pass
+(``nomad_tpu/analysis/guardrules.py``) fails the tree when
+
+- a ``native/*.cc`` source exists with no registry entry,
+- an entry names a guard symbol its module does not define,
+- an entry's kill-switch / guard-cadence knob is not declared in
+  ``utils/knobs.py``,
+- an entry claims a breaker feed its module never makes, or
+- an entry waives a requirement without a written justification.
+
+Entries are data, not behavior — the guards themselves live where they
+always did, next to the paths they protect.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+__all__ = ["GuardEntry", "REGISTRY", "native_sources"]
+
+
+@dataclass(frozen=True)
+class GuardEntry:
+    name: str
+    # "native_twin" | "columnar_mirror" | "device_mirror" | "encoding"
+    kind: str
+    # Module owning the guard machinery (dotted path).
+    module: str
+    # The .cc source this entry claims (native twins only).
+    native_source: Optional[str] = None
+    # Symbol the module must define (the guard cadence accessor or the
+    # guard counter); None only with a waiver.
+    guard_symbol: Optional[str] = None
+    # Cadence knob (None ⇒ the guard runs on every call).
+    guard_every_knob: Optional[str] = None
+    # Env kill-switches restoring the reference path.
+    kill_switches: Tuple[str, ...] = ()
+    # The module feeds breaker.record(False) on mismatch.
+    breaker_feed: bool = True
+    # Waives the guard/breaker requirement — MUST carry a reason.
+    waiver: str = ""
+    # Where the pairing is exercised (docs pointer, not checked).
+    tests: str = ""
+
+
+REGISTRY: List[GuardEntry] = [
+    GuardEntry(
+        name="codec.string_columns",
+        kind="native_twin",
+        module="nomad_tpu.codec.native",
+        native_source="codec.cc",
+        guard_symbol="guard_every",
+        guard_every_knob="NOMAD_TPU_CODEC_GUARD_EVERY",
+        kill_switches=("NOMAD_TPU_NO_NATIVE", "NOMAD_TPU_CODEC"),
+        breaker_feed=True,
+        tests="tests/test_codec.py (twin corpus + truncation)",
+    ),
+    GuardEntry(
+        name="decode.packed_results",
+        kind="native_twin",
+        module="nomad_tpu.ops.decode",
+        native_source="decode.cc",
+        guard_symbol="guard_every",
+        guard_every_knob="NOMAD_TPU_DECODE_GUARD_EVERY",
+        kill_switches=("NOMAD_TPU_NO_NATIVE",),
+        breaker_feed=True,
+        tests="tests/test_resident.py native-decode twins",
+    ),
+    GuardEntry(
+        name="wal.group_commit",
+        kind="native_twin",
+        module="nomad_tpu.server.raft",
+        native_source="wal.cc",
+        guard_symbol=None,
+        kill_switches=("NOMAD_TPU_NO_NATIVE",),
+        breaker_feed=False,
+        waiver=(
+            "durability backend: an online differential guard would "
+            "double every fsync; the pure-Python synced-seq twin is "
+            "pinned equivalent by tests/test_native_wal.py and the "
+            "torn-frame chaos drills instead"),
+        tests="tests/test_native_wal.py, wal selfcheck drill",
+    ),
+    GuardEntry(
+        name="ids.bulk_uuids",
+        kind="native_twin",
+        module="nomad_tpu.structs.funcs",
+        native_source="ids.cc",
+        guard_symbol=None,
+        kill_switches=("NOMAD_TPU_NO_NATIVE",),
+        breaker_feed=False,
+        waiver=(
+            "random output has no deterministic twin to bit-compare; "
+            "format/uniqueness are asserted by the generate_uuid tests "
+            "and every consumer parses the 36-char form"),
+        tests="tests/test_structs_funcs.py",
+    ),
+    GuardEntry(
+        name="columnar.node_table",
+        kind="columnar_mirror",
+        module="nomad_tpu.state.columnar",
+        guard_symbol="guard_every",
+        guard_every_knob="NOMAD_TPU_COLUMNAR_GUARD_EVERY",
+        kill_switches=("NOMAD_TPU_COLUMNAR",),
+        breaker_feed=True,
+        tests="tests/test_columnar.py (conftest pins cadence 1)",
+    ),
+    GuardEntry(
+        name="columnar.usage_matrix",
+        kind="columnar_mirror",
+        module="nomad_tpu.state.columnar",
+        guard_symbol="USAGE_GUARD_RUNS",
+        guard_every_knob="NOMAD_TPU_COLUMNAR_GUARD_EVERY",
+        kill_switches=("NOMAD_TPU_COLUMNAR",),
+        breaker_feed=True,
+        tests="tests/test_columnar.py usage-guard cases",
+    ),
+    GuardEntry(
+        name="resident.device_mirror",
+        kind="device_mirror",
+        module="nomad_tpu.ops.resident",
+        guard_symbol="guard_every",
+        guard_every_knob="NOMAD_TPU_RESIDENT_GUARD_EVERY",
+        kill_switches=("NOMAD_TPU_RESIDENT",
+                       "NOMAD_TPU_RESIDENT_DEVICE"),
+        breaker_feed=True,
+        tests="tests/test_resident.py, tests/test_mesh_sched.py "
+              "(per-shard attribution)",
+    ),
+    GuardEntry(
+        name="encode.quantized_rows",
+        kind="encoding",
+        module="nomad_tpu.ops.resident",
+        guard_symbol="check_quant_roundtrip",
+        guard_every_knob=None,  # every static encode
+        kill_switches=("NOMAD_TPU_QUANT",),
+        breaker_feed=True,
+        tests="tests/test_fused.py quant round-trip cases",
+    ),
+]
+
+
+def native_sources() -> List[str]:
+    """The .cc files the registry claims (guardrules compares this to
+    the actual contents of nomad_tpu/native/)."""
+    return [e.native_source for e in REGISTRY
+            if e.native_source is not None]

@@ -36,7 +36,9 @@ from ..utils.platform import is_tpu_platform  # noqa: F401 (re-export)
 
 
 def pallas_enabled() -> bool:
-    return os.environ.get("NOMAD_TPU_PALLAS", "") in ("1", "true")
+    from ..utils import knobs
+
+    return knobs.get_bool("NOMAD_TPU_PALLAS")
 
 
 def _masked_fit_score(feas_row, used, cap, denom, ask):
@@ -218,6 +220,13 @@ def scored_rows(
         [jnp.asarray(jit_seed, jnp.uint32),
          jnp.uint32(u_offset), jnp.uint32(n_offset),
          jnp.uint32(0)]).reshape(1, 4)
+    # Compile-audit seam (ISSUE 15): pallas programs register their
+    # invocation signature like every other jit entry point, so a
+    # shape leak here shows in batch.compiles too.
+    from .kernels import note_signature
+
+    note_signature("pallas_scored_rows",
+                   (u, n_pad, bool(interpret)))
     out = _scored_rows_impl(
         feas_i8, used.T, capacity.T, denom.T, ask,
         penalty.reshape(-1, 1).astype(jnp.float32),
@@ -250,6 +259,10 @@ def masked_score_matrix(
         denom = jnp.pad(denom, ((0, pad), (0, 0)))
     if interpret is None:
         interpret = not is_tpu_platform(jax.default_backend())
+    from .kernels import note_signature
+
+    note_signature("pallas_masked_score",
+                   (feas.shape[0], n_pad, bool(interpret)))
     out = _masked_score_matrix_impl(
         feas_i8, used.T, capacity.T, denom.T, ask, interpret)
     return out[:, :n]

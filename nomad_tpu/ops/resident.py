@@ -64,9 +64,9 @@ RES_DIMS = 4
 
 
 def enabled() -> bool:
-    from ..utils.flags import env_flag
+    from ..utils import knobs
 
-    return env_flag("NOMAD_TPU_RESIDENT", True)
+    return knobs.get_bool("NOMAD_TPU_RESIDENT")
 
 
 def device_mirror_enabled() -> bool:
@@ -80,16 +80,15 @@ def device_mirror_enabled() -> bool:
     NamedSharding, caught up by shard-routed donated scatter-adds — so
     the replicated per-batch u_rows/u_vals upload disappears from the
     mesh steady state too.  0 keeps the sparse-delta upload path."""
-    from ..utils.flags import env_flag
+    from ..utils import knobs
 
-    return env_flag("NOMAD_TPU_RESIDENT_DEVICE", True)
+    return knobs.get_bool("NOMAD_TPU_RESIDENT_DEVICE")
 
 
 def guard_every() -> int:
-    try:
-        return int(os.environ.get("NOMAD_TPU_RESIDENT_GUARD_EVERY", "64"))
-    except ValueError:
-        return 64
+    from ..utils import knobs
+
+    return knobs.get_int("NOMAD_TPU_RESIDENT_GUARD_EVERY")
 
 
 _DELTA_APPLY = None

@@ -53,8 +53,9 @@ def preemption_score_penalty(n_evicted: int) -> float:
 def preemption_enabled_default() -> bool:
     """Operator default for schedulers constructed without an explicit
     flag: NOMAD_TPU_PREEMPTION=1 (any value except 0/false/no/empty)."""
-    flag = os.environ.get("NOMAD_TPU_PREEMPTION", "").strip().lower()
-    return flag not in ("", "0", "false", "no")
+    from ..utils import knobs
+
+    return knobs.get_bool("NOMAD_TPU_PREEMPTION")
 
 
 def alloc_priority(alloc: s.Allocation, state=None) -> int:

@@ -131,8 +131,9 @@ def register_endpoints(server, rpc) -> None:
     # split/heal the follower's OWN side of a partition over an exempt
     # control pool — never part of a production server's wire surface.
 
-    if os.environ.get("NOMAD_TPU_CHAOS", "").strip().lower() in (
-            "1", "true", "yes"):
+    from ..utils import knobs
+
+    if knobs.get_bool("NOMAD_TPU_CHAOS"):
         def chaos_set_net(body):
             plane = fault.net()
             for p in body.get("Partitions") or []:

@@ -144,11 +144,10 @@ class EventBroker:
     def __init__(self, ring_size: Optional[int] = None, metrics=None,
                  index_source: Optional[Callable[[], int]] = None):
         if ring_size is None:
-            try:
-                ring_size = int(os.environ.get(
-                    "NOMAD_TPU_EVENTS_RING", "") or DEFAULT_RING_SIZE)
-            except ValueError:
-                ring_size = DEFAULT_RING_SIZE
+            from ..utils import knobs
+
+            ring_size = knobs.get_int("NOMAD_TPU_EVENTS_RING",
+                                      DEFAULT_RING_SIZE)
         self.ring_size = max(8, ring_size)
         self.metrics = metrics if metrics is not None else NULL_TELEMETRY
         # Applied-index source for externally-originated events (breaker,

@@ -345,10 +345,9 @@ class RemoteBroker:
     # The wire methods (Eval.PauseNack/ResumeNack) exist for deployments
     # running short deadlines: NOMAD_TPU_REMOTE_NACK_PAUSE=1 re-enables.
     def _remote_pause(self) -> bool:
-        import os
+        from ..utils import knobs
 
-        return os.environ.get("NOMAD_TPU_REMOTE_NACK_PAUSE",
-                              "").strip().lower() in ("1", "true", "yes")
+        return knobs.get_bool("NOMAD_TPU_REMOTE_NACK_PAUSE")
 
     def pause_nack_timeout(self, eval_id: str, token: str) -> None:
         if self._remote_pause():

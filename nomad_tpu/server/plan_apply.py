@@ -51,11 +51,9 @@ VECTORIZE_THRESHOLD = 64
 def _pipeline_depth() -> int:
     """Concurrent in-flight plan commits (1 restores the strictly
     serial applier)."""
-    try:
-        return max(1, int(os.environ.get("NOMAD_TPU_PLAN_PIPELINE", "")
-                          or 8))
-    except ValueError:
-        return 8
+    from ..utils import knobs
+
+    return max(1, knobs.get_int("NOMAD_TPU_PLAN_PIPELINE"))
 
 
 class _InflightOverlay:

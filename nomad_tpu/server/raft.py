@@ -77,17 +77,15 @@ SNAPSHOTS_RETAINED = 2
 
 
 def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
+    from ..utils import knobs
+
+    return knobs.get_int(name, default)
 
 
 def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
+    from ..utils import knobs
+
+    return knobs.get_float(name, default)
 
 
 class RaftLog:

@@ -16,7 +16,7 @@ from typing import Dict, List, Optional, Tuple
 
 from .. import fault
 from ..structs import structs as s
-from ..utils import tracing
+from ..utils import knobs, tracing
 from ..utils.telemetry import Telemetry
 from . import event_broker as event_stream
 from .blocked_evals import BlockedEvals
@@ -66,23 +66,20 @@ class ServerConfig:
     # Eval-broker admission control (ISSUE 7): bounded pending queue +
     # per-job coalescing.  0 = unbounded (historical behavior); the env
     # knobs let operators bound a running deployment without code.
-    broker_max_pending: int = field(default_factory=lambda: int(
-        os.environ.get("NOMAD_TPU_BROKER_MAX_PENDING", "") or 0))
-    broker_coalesce: bool = field(default_factory=lambda: (
-        os.environ.get("NOMAD_TPU_BROKER_COALESCE", "").strip().lower()
-        not in ("0", "false", "no", "off")))
-    broker_bypass_priority: int = field(default_factory=lambda: int(
-        os.environ.get("NOMAD_TPU_BROKER_BYPASS_PRIO", "")
-        or s.JOB_MAX_PRIORITY))
+    broker_max_pending: int = field(default_factory=lambda: knobs.get_int(
+        "NOMAD_TPU_BROKER_MAX_PENDING"))
+    broker_coalesce: bool = field(default_factory=lambda: knobs.get_bool(
+        "NOMAD_TPU_BROKER_COALESCE"))
+    broker_bypass_priority: int = field(default_factory=lambda: knobs.get_int(
+        "NOMAD_TPU_BROKER_BYPASS_PRIO", s.JOB_MAX_PRIORITY))
     # Follower-read scheduling (ISSUE 10): on a multi-raft cluster every
     # server also runs FollowerWorkers that, while the server is a
     # follower, pull evals from the leader's broker over RPC, schedule
     # off the locally replicated FSM, and forward plans to the leader's
     # serialized plan-apply (server/follower_sched.py).  Default on —
     # they idle on single-voter servers and on the leader.
-    follower_scheduling: bool = field(default_factory=lambda: (
-        os.environ.get("NOMAD_TPU_FOLLOWER_SCHED", "").strip().lower()
-        not in ("0", "false", "no", "off")))
+    follower_scheduling: bool = field(default_factory=lambda: knobs.get_bool(
+        "NOMAD_TPU_FOLLOWER_SCHED"))
     # Follower workers per server; 0 → num_schedulers.
     follower_schedulers: int = 0
     # Join as a NON-VOTING member (the reference's non_voting_server):
@@ -95,8 +92,8 @@ class ServerConfig:
     # servers will join it later (the loadgen multi-server scenario).
     force_multi_raft: bool = False
     # Heartbeat TTL jitter fraction (thundering-herd dispersal).
-    heartbeat_ttl_jitter: float = field(default_factory=lambda: float(
-        os.environ.get("NOMAD_TPU_HEARTBEAT_JITTER", "") or 0.1))
+    heartbeat_ttl_jitter: float = field(default_factory=lambda: knobs.get_float(
+        "NOMAD_TPU_HEARTBEAT_JITTER"))
     # Retry cadence for queued (failed) Vault revocations
     # (vault.go:1104 revokeDaemon — 5 minutes there; shorter default so
     # a failed revoke clears quickly and tests can observe it).
@@ -126,9 +123,7 @@ class Server:
         # Opt-in eval-lifecycle tracing (utils/tracing.py): process-wide,
         # off by default; NOMAD_TPU_TRACE=1 arms it at construction so
         # /v1/trace/* works without code changes.
-        if not tracing.enabled() and os.environ.get(
-                "NOMAD_TPU_TRACE", "").strip().lower() in ("1", "true",
-                                                           "yes"):
+        if not tracing.enabled() and knobs.get_bool("NOMAD_TPU_TRACE"):
             tracing.enable()
         # Vault client (nomad/vault.go:234); vault_api injects the fake
         # in tests (vault_testing.go role).
@@ -202,7 +197,7 @@ class Server:
         # Subprocess chaos arming: a follower child spawned into a
         # partition/flap scenario arms its own net plane from the env
         # (the parent can also drive it live over Chaos.SetNet).
-        chaos_spec = os.environ.get("NOMAD_TPU_CHAOS_NET", "").strip()
+        chaos_spec = (knobs.get_str("NOMAD_TPU_CHAOS_NET") or "").strip()
         if chaos_spec and not fault.net_armed():
             import json as _json
 
@@ -250,8 +245,7 @@ class Server:
             index_source=self.raft.applied_index_relaxed)
         self._events_enabled = False
         self._events_lock = threading.Lock()
-        if os.environ.get("NOMAD_TPU_EVENTS", "").strip().lower() in (
-                "1", "true", "yes"):
+        if knobs.get_bool("NOMAD_TPU_EVENTS"):
             self.enable_event_stream()
 
         self.plan_applier = PlanApplier(self.plan_queue, self.raft, self.logger,

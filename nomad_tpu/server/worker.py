@@ -41,19 +41,18 @@ def stale_snapshot_enabled() -> bool:
     applier's per-node re-check, which partially commits and refreshes
     the scheduler.  Default on; NOMAD_TPU_STALE_SNAPSHOT=0 restores the
     snapshot-per-eval path."""
-    return os.environ.get("NOMAD_TPU_STALE_SNAPSHOT", "").strip().lower() \
-        not in ("0", "false", "no", "off")
+    from ..utils import knobs
+
+    return knobs.get_bool("NOMAD_TPU_STALE_SNAPSHOT")
 
 
 def _stale_snapshot_max_lag() -> int:
     """How many raft entries a reused snapshot may lag the applied index
     before the worker refreshes anyway — bounds the conflict rate under
     churn without giving up cross-eval reuse."""
-    try:
-        return int(os.environ.get("NOMAD_TPU_STALE_SNAPSHOT_LAG", "")
-                   or 512)
-    except ValueError:
-        return 512
+    from ..utils import knobs
+
+    return knobs.get_int("NOMAD_TPU_STALE_SNAPSHOT_LAG")
 
 
 class WorkerPlanner:
@@ -476,8 +475,9 @@ def pipeline_enabled() -> bool:
     is built — see ops/batch_sched.schedule_stream for the ordering
     argument.  Off by default: the serial drain is the long-soaked
     path."""
-    return os.environ.get("NOMAD_TPU_PIPELINE", "").strip().lower() in (
-        "1", "true", "yes", "on")
+    from ..utils import knobs
+
+    return knobs.get_bool("NOMAD_TPU_PIPELINE")
 
 
 class BatchWorker(Worker):

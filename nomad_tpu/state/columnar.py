@@ -77,16 +77,15 @@ USAGE_GUARD_MISMATCHES = 0
 
 
 def enabled() -> bool:
-    from ..utils.flags import env_flag
+    from ..utils import knobs
 
-    return env_flag("NOMAD_TPU_COLUMNAR", True)
+    return knobs.get_bool("NOMAD_TPU_COLUMNAR")
 
 
 def guard_every() -> int:
-    try:
-        return int(os.environ.get("NOMAD_TPU_COLUMNAR_GUARD_EVERY", "16"))
-    except ValueError:
-        return 16
+    from ..utils import knobs
+
+    return knobs.get_int("NOMAD_TPU_COLUMNAR_GUARD_EVERY")
 
 
 def bump_epoch() -> None:

@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Set, Tuple
 import numpy as np
 
 from ..structs import structs as s
+from ..utils import knobs as _knobs
 from . import columnar
 
 # Shared immutable empty result for index misses (never mutated).
@@ -37,8 +38,7 @@ _EMPTY_SET: Set[str] = set()
 # cap are trimmed oldest-first and the floor rises, forcing consumers
 # whose cached index fell off to full re-encode.  Counted in alloc rows
 # (a slab entry weighs len(slab)).
-ALLOC_LOG_CAP = int(os.environ.get("NOMAD_TPU_ALLOC_LOG_CAP", "262144")
-                    or 262144)
+ALLOC_LOG_CAP = _knobs.get_int("NOMAD_TPU_ALLOC_LOG_CAP")
 
 # Number of historical job versions retained (reference: structs.go
 # JobTrackedVersions = 6).

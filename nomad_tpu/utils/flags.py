@@ -1,25 +1,17 @@
-"""Env-var kill-switch flags, one parser for every NOMAD_TPU_* knob.
+"""Deprecated shim — boolean knob parsing lives in utils/knobs.py.
 
-The codebase grew several inline copies of the ``.strip().lower() not in
-("0", "false", "no")`` idiom with subtly different empty-string
-semantics.  This is the one place that decides: an UNSET or EMPTY value
-means the default; otherwise anything except 0/false/no is true.
+This module used to hold the one boolean env parser; the ISSUE 15 knob
+registry subsumed it (every NOMAD_TPU_* name must now be declared in
+``utils/knobs.py``, and reads are registry-checked).  ``env_flag``
+remains as a delegate for any straggler import.
 """
 from __future__ import annotations
 
-import os
-
-_FALSY = ("0", "false", "no")
+from . import knobs
 
 
 def env_flag(name: str, default: bool) -> bool:
     """Boolean env knob, re-read on every call (runtime kill-switch —
     flipping the variable takes effect on the next batch, never cached
-    at import)."""
-    raw = os.environ.get(name)
-    if raw is None:
-        return default
-    raw = raw.strip().lower()
-    if raw == "":
-        return default
-    return raw not in _FALSY
+    at import).  Registry-checked: reading an undeclared name raises."""
+    return knobs.get_bool(name, default)

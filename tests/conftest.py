@@ -56,7 +56,7 @@ def _chaos_tracing(request):
         yield
         return
     from nomad_tpu.server import event_broker
-    from nomad_tpu.utils import tracing
+    from nomad_tpu.utils import knobs, lockcheck, tracing
 
     tracing.enable()
     # Arm the cluster event stream for every server the test constructs
@@ -66,12 +66,36 @@ def _chaos_tracing(request):
     prev = os.environ.get("NOMAD_TPU_EVENTS")
     os.environ["NOMAD_TPU_EVENTS"] = "1"
     event_broker.clear_recent()
-    yield
-    if prev is None:
-        os.environ.pop("NOMAD_TPU_EVENTS", None)
-    else:
-        os.environ["NOMAD_TPU_EVENTS"] = prev
-    tracing.disable()
+    # Runtime lock-order sanitizer (ISSUE 15): chaos tests construct
+    # full servers under induced concurrency — every lock they create
+    # is instrumented, and teardown asserts the accumulated acquisition
+    # graph has no cycle (the witness chain prints on failure).  The
+    # env knob lets a run opt out (NOMAD_TPU_LOCKCHECK=0/false/no/off,
+    # the registry's falsy set); an operator arming the whole session
+    # (NOMAD_TPU_LOCKCHECK=1) keeps the sanitizer armed and the env var
+    # intact after teardown.
+    prev_lockcheck = os.environ.get("NOMAD_TPU_LOCKCHECK")
+    lock_sanitize = knobs.get_bool("NOMAD_TPU_LOCKCHECK", True)
+    was_armed = lockcheck.armed()
+    if lock_sanitize:
+        lockcheck.arm()
+        os.environ["NOMAD_TPU_LOCKCHECK"] = "1"
+    try:
+        yield
+        if lock_sanitize:
+            lockcheck.assert_acyclic()
+    finally:
+        if lock_sanitize and not was_armed:
+            lockcheck.disarm()
+        if prev_lockcheck is None:
+            os.environ.pop("NOMAD_TPU_LOCKCHECK", None)
+        else:
+            os.environ["NOMAD_TPU_LOCKCHECK"] = prev_lockcheck
+        if prev is None:
+            os.environ.pop("NOMAD_TPU_EVENTS", None)
+        else:
+            os.environ["NOMAD_TPU_EVENTS"] = prev
+        tracing.disable()
 
 
 def _format_trace(spans):
