@@ -1148,6 +1148,50 @@ def bench_chaos_soak(servers: int = 3):
     return out
 
 
+def bench_multi_tenant():
+    """config_tenancy: the multi-tenant isolation gate (ISSUE 16) — the
+    ``multi_tenant`` scenario offers a zipf tenant population with ONE
+    abusive tenant soaking up half the load against per-tenant pending
+    and live-alloc quotas and DRF fair dequeue.  ``--check`` hard-gates
+    the noisy-neighbor contract: the abuser's completion p99 degrades
+    (>=1.5x the compliant p99) while compliant tenants keep dequeuing;
+    quota pressure surfaces as 429s at the admission front door (and
+    the abuser actually drew some); accepted evals are NEVER lost; and
+    no tenant's committed live-alloc count exceeds its quota in the
+    strict post-drain sweep."""
+    from nomad_tpu.loadgen.harness import run_scenario
+    from nomad_tpu.loadgen.scenario import get_scenario
+
+    rep = run_scenario(get_scenario("multi_tenant"))
+    t = rep.get("tenancy") or {}
+    integ = rep.get("integrity") or {}
+    ab = (t.get("latency_ms") or {}).get("abuser") or {}
+    co = (t.get("latency_ms") or {}).get("compliant") or {}
+    out = {
+        "tenants": t.get("tenants", 0),
+        "objective": t.get("objective"),
+        "abuser_done_p99_ms": ab.get("p99"),
+        "compliant_done_p99_ms": co.get("p99"),
+        "isolation_ratio": (round(ab["p99"] / co["p99"], 2)
+                            if ab.get("p99") and co.get("p99") else None),
+        "accepted": t.get("accepted") or {},
+        "rejects_429": t.get("rejects_429") or {},
+        "dropped": t.get("dropped_after_retries") or {},
+        "lost_accepted": sum((t.get("lost_accepted") or {}).values()),
+        "quota_violations": (t.get("quota_violations", 0)
+                             + integ.get("tenant_quota_violations", 0)),
+        "stragglers": rep["sustained"]["stragglers_after_drain"],
+        "evals_per_s": rep["sustained"]["evals_per_s"],
+    }
+    log(f"  multi-tenant: {out['tenants']} tenants under "
+        f"{out['objective']} — abuser p99 {out['abuser_done_p99_ms']}ms "
+        f"vs compliant {out['compliant_done_p99_ms']}ms "
+        f"(ratio {out['isolation_ratio']}), "
+        f"429s {out['rejects_429']}, {out['lost_accepted']} lost, "
+        f"{out['quota_violations']} quota violations")
+    return out
+
+
 def run_config(n_nodes: int, n_jobs: int, count_per_job: int, label: str,
                constrained: bool = False, trials: int = 3,
                keep_state: bool = False, n_dcs: int = 1):
@@ -2707,6 +2751,36 @@ def _check_main(argv) -> int:
     except Exception as exc:
         out["chaos_soak"] = {"error": repr(exc)}
         failures.append(f"chaos-soak phase failed: {exc!r}")
+
+    # Multi-tenant isolation gate (ISSUE 16): every gate is absolute —
+    # the noisy-neighbor contract either held or it did not.
+    try:
+        with _deadline(300, "check_multi_tenant"):
+            mt = bench_multi_tenant()
+        out["multi_tenant"] = mt
+        if not (mt["rejects_429"].get("abuser") or 0):
+            failures.append(
+                "multi-tenant run saw no abuser quota 429s — the "
+                "per-tenant admission front door did not fire")
+        if mt["lost_accepted"] or mt["stragglers"]:
+            failures.append(
+                f"multi-tenant run lost {mt['lost_accepted']} accepted "
+                f"evals and left {mt['stragglers']} stragglers — "
+                "quota pressure must reject at admission, never drop "
+                "accepted work")
+        if mt["quota_violations"]:
+            failures.append(
+                f"multi-tenant run recorded {mt['quota_violations']} "
+                "committed-state tenant quota violations")
+        if mt["isolation_ratio"] is not None \
+                and mt["isolation_ratio"] < 1.5:
+            failures.append(
+                f"multi-tenant isolation ratio {mt['isolation_ratio']} "
+                "< 1.5 — the abuser's p99 must degrade under DRF while "
+                "compliant tenants hold their SLO")
+    except Exception as exc:
+        out["multi_tenant"] = {"error": repr(exc)}
+        failures.append(f"multi-tenant phase failed: {exc!r}")
 
     # FSM snapshot+restore guard (ISSUE 9): the columnar persist+restore
     # wall time must not regress past threshold x baseline.  Measured

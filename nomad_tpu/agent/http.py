@@ -102,6 +102,8 @@ class HTTPServer:
         r = self._route
         r("/v1/jobs", self.jobs_request)
         r("/v1/job/(?P<rest>.*)", self.job_specific_request)
+        r("/v1/namespaces", self.namespaces_request)
+        r("/v1/namespace/(?P<name>[^/]+)", self.namespace_specific_request)
         r("/v1/nodes", self.nodes_request)
         r("/v1/node/(?P<rest>.*)", self.node_specific_request)
         r("/v1/allocations", self.allocs_request)
@@ -328,11 +330,28 @@ class HTTPServer:
             if payload is None or "Job" not in payload:
                 raise CodedError(400, "JSON body with Job required")
             job = from_wire(s.Job, payload["Job"])
+            self._check_api_rate(job.namespace)
             index, eval_id = self.server.job_register(
                 job, region=query.get("region", ""))
             return {"EvalID": eval_id, "EvalCreateIndex": index,
                     "JobModifyIndex": index}, index
         raise CodedError(405, "Invalid method")
+
+    def _check_api_rate(self, namespace: str) -> None:
+        """Per-tenant token-bucket gate on the submit front door.
+        Tenants without a configured api_rate (including "default") are
+        never throttled; a drained bucket answers 429 + Retry-After
+        before the request ever reaches the server's admission path."""
+        limiter = getattr(self.server, "api_limiter", None)
+        if limiter is None:
+            return
+        ns = namespace or "default"
+        wait = limiter.check(ns)
+        if wait > 0.0:
+            raise CodedError(
+                429, f"tenant {ns!r} API rate limit exceeded; "
+                     f"retry_after={wait:.2f}",
+                {"Retry-After": f"{wait:.2f}"})
 
     @staticmethod
     def _job_stub(j: s.Job) -> dict:
@@ -441,6 +460,7 @@ class HTTPServer:
             job = from_wire(s.Job, payload["Job"])
             if job.id != job_id:
                 raise CodedError(400, "Job ID does not match name")
+            self._check_api_rate(job.namespace)
             index, eval_id = self.server.job_register(
                 job, region=query.get("region", ""))
             return {"EvalID": eval_id, "EvalCreateIndex": index,
@@ -451,6 +471,53 @@ class HTTPServer:
                 job_id, purge=purge, region=query.get("region", ""))
             return {"EvalID": eval_id, "EvalCreateIndex": index,
                     "JobModifyIndex": index}, index
+        raise CodedError(405, "Invalid method")
+
+    # ------------------------------------------------------------------
+    # namespaces (tenancy plane, ROADMAP item 3)
+    # ------------------------------------------------------------------
+
+    def namespaces_request(self, req, query):
+        if req.command == "GET":
+            def run(ws):
+                state = self.server.state
+                rows = state.namespaces(ws)
+                return ([to_wire(n) for n in
+                         sorted(rows, key=lambda n: n.name)],
+                        state.table_index("namespaces"))
+            return self._blocking(query, run)
+        if req.command in ("PUT", "POST"):
+            payload = self._body(req)
+            if payload is None or "Namespace" not in payload:
+                raise CodedError(400, "JSON body with Namespace required")
+            ns = from_wire(s.Namespace, payload["Namespace"])
+            index = self.server.namespace_upsert(ns)
+            return {"Index": index}, index
+        raise CodedError(405, "Invalid method")
+
+    def namespace_specific_request(self, req, query, name: str):
+        if req.command == "GET":
+            try:
+                status = self.server.namespace_status(name)
+            except KeyError as e:
+                raise CodedError(404, str(e))
+            status["Namespace"] = to_wire(status["Namespace"])
+            return status, self.server.state.table_index("namespaces")
+        if req.command in ("PUT", "POST"):
+            payload = self._body(req)
+            if payload is None or "Namespace" not in payload:
+                raise CodedError(400, "JSON body with Namespace required")
+            ns = from_wire(s.Namespace, payload["Namespace"])
+            if ns.name != name:
+                raise CodedError(400, "Namespace name does not match URL")
+            index = self.server.namespace_upsert(ns)
+            return {"Index": index}, index
+        if req.command == "DELETE":
+            try:
+                index = self.server.namespace_delete(name)
+            except KeyError as e:
+                raise CodedError(404, str(e))
+            return {"Index": index}, index
         raise CodedError(405, "Invalid method")
 
     # ------------------------------------------------------------------
@@ -759,13 +826,18 @@ class HTTPServer:
           ``follow=`` ``false`` dumps the buffered backlog and closes
                       (the forensic/CLI no-follow mode); default ``true``
                       keeps streaming, emitting ``{}`` heartbeat lines
-                      while idle.
+                      while idle;
+          ``namespace=`` keep only events attributed to one tenant
+                      (payload ``Namespace`` stamp) — unattributed
+                      events are dropped too, so a tenant-scoped
+                      consumer never sees another tenant's traffic.
         """
         from ..server.event_broker import EventIndexError, parse_topic_filter
 
         if req.command != "GET":
             raise CodedError(405, "Invalid method")
         topics = parse_topic_filter(query.get("topic", ""))
+        ns_filter = query.get("namespace", "")
         index = int(query.get("index", 0) or 0)
         follow = query.get("follow", "true").lower() != "false"
         # No-follow with no explicit index dumps whatever the ring still
@@ -784,6 +856,9 @@ class HTTPServer:
                 while True:
                     ev = sub.next(timeout=10.0 if follow else 0.05)
                     if ev is not None:
+                        if ns_filter and (ev.payload or {}).get(
+                                "Namespace") != ns_filter:
+                            continue
                         yield ev.to_wire_dict()
                         continue
                     if sub.closed:

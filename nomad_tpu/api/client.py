@@ -14,6 +14,7 @@ callers can long-poll, like the reference's WaitIndex loop.
 from __future__ import annotations
 
 import json
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -21,6 +22,7 @@ from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..structs import structs as s
+from ..utils.backoff import Backoff
 from .codec import from_wire, to_wire
 
 
@@ -65,6 +67,7 @@ class NomadAPI:
         self.operator = Operator(self)
         self.status = Status(self)
         self.events = Events(self)
+        self.namespaces = Namespaces(self)
 
     # -- raw transport -----------------------------------------------------
 
@@ -136,6 +139,31 @@ class Jobs:
                  q: Optional[QueryOptions] = None) -> Tuple[dict, QueryMeta]:
         return self.c.put("/v1/jobs", {"Job": to_wire(job)},
                           q or QueryOptions())
+
+    def register_with_retry(self, job: s.Job, retries: int = 5,
+                            q: Optional[QueryOptions] = None,
+                            sleep=time.sleep,
+                            backoff: Optional[Backoff] = None
+                            ) -> Tuple[dict, QueryMeta]:
+        """register() with jittered client-side retry on 429 admission
+        NACKs.  The delay honors the server's Retry-After hint but
+        jitters it (0.5x-1.5x) so a rejected burst doesn't re-arrive as
+        the same burst, and never waits less than the utils/backoff
+        exponential floor.  Non-429 errors (and the final 429) raise
+        unchanged."""
+        bo = backoff or Backoff(base=0.05, max_delay=5.0)
+        for attempt in range(retries + 1):
+            try:
+                return self.register(job, q)
+            except APIError as e:
+                if e.code != 429 or attempt >= retries:
+                    raise
+                delay = bo.next_delay()
+                if e.retry_after > 0:
+                    delay = max(delay,
+                                e.retry_after * (0.5 + bo.rng.random()))
+                sleep(delay)
+        raise AssertionError("unreachable")
 
     def info(self, job_id: str, q: Optional[QueryOptions] = None
              ) -> Tuple[s.Job, QueryMeta]:
@@ -425,6 +453,29 @@ class Events:
             raise APIError(0, f"event stream interrupted: {e}") from e
         finally:
             resp.close()
+
+
+class Namespaces:
+    """Tenancy handle: /v1/namespaces + /v1/namespace/<name>."""
+
+    def __init__(self, c: NomadAPI):
+        self.c = c
+
+    def list(self, q: Optional[QueryOptions] = None
+             ) -> Tuple[List[s.Namespace], QueryMeta]:
+        obj, meta = self.c.get("/v1/namespaces", q)
+        return [from_wire(s.Namespace, n) for n in obj or []], meta
+
+    def register(self, ns: s.Namespace) -> Tuple[dict, QueryMeta]:
+        return self.c.put("/v1/namespaces", {"Namespace": to_wire(ns)})
+
+    def status(self, name: str) -> Tuple[dict, QueryMeta]:
+        """Row + live usage + admission counters; the Namespace value
+        under "Namespace" stays a wire dict (mixed payload)."""
+        return self.c.get(f"/v1/namespace/{name}")
+
+    def deregister(self, name: str) -> Tuple[dict, QueryMeta]:
+        return self.c.delete(f"/v1/namespace/{name}")
 
 
 class System:

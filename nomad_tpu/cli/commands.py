@@ -820,6 +820,83 @@ def cmd_broker_status(args, out) -> int:
         out.write("\nDelivery Attempts\n")
         for k, v in sorted(attempts.items(), key=lambda kv: int(kv[0])):
             out.write(f"  {k} = {v}\n")
+    tenants = stats.get("Tenants") or {}
+    if tenants:
+        out.write(f"\nTenants (objective={stats.get('Objective')})\n")
+        rows = ["Namespace|Pending|Dequeued|Shed|Rejects|Weight|"
+                "DominantShare|VirtualTime"]
+        for name, t in sorted(
+                tenants.items(),
+                key=lambda kv: (-int(kv[1].get("Pending", 0)), kv[0])):
+            rows.append("|".join(str(x) for x in (
+                name, t.get("Pending", 0), t.get("Dequeued", 0),
+                t.get("Shed", 0), t.get("Rejects", 0),
+                t.get("Weight", 1.0), t.get("DominantShare", 0.0),
+                t.get("VirtualTime", 0.0))))
+        out.write(format_list(rows) + "\n")
+        elided = stats.get("TenantsElided") or 0
+        if elided:
+            out.write(f"... and {elided} more tenants elided\n")
+    return 0
+
+
+def cmd_namespace_list(args, out) -> int:
+    """Tenancy surface: /v1/namespaces."""
+    api = _api(args)
+    namespaces, _ = api.namespaces.list()
+    if getattr(args, "json", False):
+        out.write(json.dumps(
+            [to_wire(ns) for ns in namespaces], indent=4, sort_keys=True)
+            + "\n")
+        return 0
+    if not namespaces:
+        out.write("No namespaces registered\n")
+        return 0
+    rows = ["Name|MaxLiveAllocs|MaxPendingEvals|APIRate|Weight|"
+            "Objective|Description"]
+    for ns in namespaces:
+        rows.append("|".join(str(x) for x in (
+            ns.name,
+            ns.max_live_allocs or "unlimited",
+            ns.max_pending_evals or "unlimited",
+            ns.api_rate or "unlimited",
+            ns.dequeue_weight,
+            ns.objective or "(inherit)",
+            ns.description)))
+    out.write(format_list(rows) + "\n")
+    return 0
+
+
+def cmd_namespace_status(args, out) -> int:
+    """Tenancy surface: /v1/namespace/<name> — row + live usage +
+    admission counters."""
+    api = _api(args)
+    try:
+        status, _ = api.namespaces.status(args.name)
+    except APIError as e:
+        out.write(f"Error querying namespace: {e}\n")
+        return 1
+    if getattr(args, "json", False):
+        out.write(json.dumps(status, indent=4, sort_keys=True) + "\n")
+        return 0
+    row = status.get("Namespace") or {}
+    out.write(format_kv([
+        f"Name|{row.get('Name')}",
+        f"Description|{row.get('Description') or '<none>'}",
+        f"Max Live Allocs|{row.get('MaxLiveAllocs') or 'unlimited'}",
+        f"Max Pending Evals|{row.get('MaxPendingEvals') or 'unlimited'}",
+        f"API Rate|{row.get('ApiRate') or 'unlimited'}",
+        f"Dequeue Weight|{row.get('DequeueWeight')}",
+        f"Objective|{row.get('Objective') or '(inherit)'}",
+    ]) + "\n")
+    usage = status.get("Usage") or {}
+    if usage:
+        out.write("\nLive Usage\n")
+        for k in ("CPU", "MemoryMB", "DiskMB", "IOPS", "LiveAllocs"):
+            out.write(f"  {k} = {usage.get(k, 0)}\n")
+    out.write("\nAdmission\n")
+    out.write(f"  ReservedAllocs = {status.get('ReservedAllocs', 0)}\n")
+    out.write(f"  PendingEvals   = {status.get('PendingEvals', 0)}\n")
     return 0
 
 
@@ -1062,6 +1139,11 @@ def build_parser() -> argparse.ArgumentParser:
     add("check", cmd_check)
     add("broker-status", cmd_broker_status, lambda sp:
         sp.add_argument("-json", dest="json", action="store_true"))
+    add("namespace-list", cmd_namespace_list, lambda sp:
+        sp.add_argument("-json", dest="json", action="store_true"))
+    add("namespace-status", cmd_namespace_status, lambda sp: (
+        sp.add_argument("name"),
+        sp.add_argument("-json", dest="json", action="store_true")))
     add("keyring", cmd_keyring, lambda sp: (
         sp.add_argument("-data-dir", dest="data_dir", default=""),
         sp.add_argument("-install", default=""),

@@ -61,10 +61,13 @@ def integrity_sweep(state, job_ids: Optional[Set[str]] = None,
     as overplacement."""
     live_by_job: Dict[str, list] = {}
     usage: Dict[str, Tuple[int, int]] = {}
+    live_by_ns: Dict[str, int] = {}
     for a in state.allocs(None):
         if a.terminal_status():
             continue
         live_by_job.setdefault(a.job_id, []).append(a)
+        ns = a.namespace or "default"
+        live_by_ns[ns] = live_by_ns.get(ns, 0) + 1
         res = a.resources
         if res is not None:
             cpu, mem = usage.get(a.node_id, (0, 0))
@@ -107,11 +110,35 @@ def integrity_sweep(state, job_ids: Optional[Set[str]] = None,
             overcommitted += 1
             detail.append(f"node {node.id}: {cpu}/{res_cpu} cpu "
                           f"{mem}/{res_mem} mem")
+    # Tenant quota invariant (ISSUE 16): no namespace's committed live
+    # allocs may exceed its registered quota.  Live (non-strict) sweeps
+    # excuse a tenant that still has a non-terminal eval — a scale-down
+    # or replacement in flight lawfully overlaps old and new allocs for
+    # a beat; the strict post-drain sweep excuses nothing.
+    tenant_quota = 0
+    pending_ns: Optional[Set[str]] = None
+    for row in state.namespaces(None):
+        if row.max_live_allocs <= 0:
+            continue
+        live = live_by_ns.get(row.name, 0)
+        if live <= row.max_live_allocs:
+            continue
+        if not strict:
+            if pending_ns is None:
+                pending_ns = {e.namespace or "default"
+                              for e in state.evals(None)
+                              if not e.terminal_status()}
+            if row.name in pending_ns:
+                continue
+        tenant_quota += 1
+        detail.append(f"namespace {row.name}: {live} live allocs > "
+                      f"quota {row.max_live_allocs}")
     return {"jobs_checked": checked,
             "overplaced_jobs": overplaced,
             "reconciling_jobs": reconciling,
             "duplicate_alloc_names": dup_names,
             "overcommitted_nodes": overcommitted,
+            "tenant_quota_violations": tenant_quota,
             "detail": detail[:10]}
 
 
@@ -298,7 +325,9 @@ class SafetyAuditor:
         self.counts["sweeps"] += 1
         for key, kind in (("overplaced_jobs", "double_placement"),
                           ("duplicate_alloc_names", "duplicate_alloc_names"),
-                          ("overcommitted_nodes", "node_overcommit")):
+                          ("overcommitted_nodes", "node_overcommit"),
+                          ("tenant_quota_violations",
+                           "tenant_quota_exceeded")):
             if sweep[key]:
                 self._violate(kind,
                               f"{sweep[key]} ({'; '.join(sweep['detail'])})")
@@ -373,7 +402,9 @@ class SafetyAuditor:
                                       strict=True)
         for key, kind in (("overplaced_jobs", "double_placement"),
                           ("duplicate_alloc_names", "duplicate_alloc_names"),
-                          ("overcommitted_nodes", "node_overcommit")):
+                          ("overcommitted_nodes", "node_overcommit"),
+                          ("tenant_quota_violations",
+                           "tenant_quota_exceeded")):
             if final_sweep[key]:
                 self._violate(
                     kind, f"final sweep: {final_sweep[key]} "

@@ -93,10 +93,10 @@ def _percentiles(values: List[float]) -> Dict[str, float]:
 
 class _Submission:
     __slots__ = ("seq", "eval_id", "job_id", "priority", "submit_t",
-                 "running_t", "done_t", "rejected")
+                 "running_t", "done_t", "rejected", "ns")
 
     def __init__(self, seq: int, eval_id: str, job_id: str, priority: int,
-                 submit_t: float):
+                 submit_t: float, ns: str = ""):
         self.seq = seq
         self.eval_id = eval_id
         self.job_id = job_id
@@ -105,6 +105,7 @@ class _Submission:
         self.running_t: Optional[float] = None
         self.done_t: Optional[float] = None
         self.rejected = 0
+        self.ns = ns
 
 
 class _ChaosScheduler:
@@ -249,6 +250,13 @@ class LoadHarness:
         self._early: "OrderedDict[str, list]" = OrderedDict()
         self.dropped = 0                            # gave up after retries
         self.reject_events = 0                      # total 429 NACKs seen
+        # Multi-tenant plane (ISSUE 16): namespace names (abusers
+        # first), the zipf CDF over the compliant tail, and per-tenant
+        # reject/drop tallies keyed by namespace.
+        self._tenants: List[str] = []
+        self._tenant_cdf: List[float] = []
+        self.ns_rejects: Dict[str, int] = {}
+        self.ns_dropped: Dict[str, int] = {}
         self.placed_events: List[Tuple[float, int]] = []
         self._hb_renewals: List[float] = []         # granted TTLs
         self._filter_subs: list = []
@@ -577,6 +585,53 @@ class LoadHarness:
             ids.append(node.id)
         return ids
 
+    # -- multi-tenant plane (ISSUE 16) --------------------------------------
+
+    def _register_tenants(self) -> None:
+        """Pre-register the scenario's namespaces through raft (the
+        production onboarding path) and precompute the zipf CDF the
+        arrival stream draws compliant tenants from.  Abusers come
+        first in the name list so ``_tenant_for`` can split classes by
+        index."""
+        sc = self.sc
+        names = ([f"lg-abuser-{i:02d}" for i in range(sc.abusive_tenants)]
+                 + [f"lg-t-{i:04d}"
+                    for i in range(sc.num_tenants - sc.abusive_tenants)])
+        for name in names:
+            self.server.namespace_upsert(s.Namespace(
+                name=name,
+                max_live_allocs=sc.tenant_max_live_allocs,
+                max_pending_evals=sc.tenant_max_pending_evals,
+                dequeue_weight=sc.tenant_dequeue_weight,
+                objective=sc.tenant_objective))
+        self._tenants = names
+        compliant = max(0, sc.num_tenants - sc.abusive_tenants)
+        cdf, acc = [], 0.0
+        for k in range(compliant):
+            acc += (1.0 / (k + 1) ** sc.tenant_zipf if sc.tenant_zipf
+                    else 1.0)
+            cdf.append(acc)
+        self._tenant_cdf = cdf
+        self.logger.info("loadgen: registered %d tenants (%d abusive)",
+                         len(names), sc.abusive_tenants)
+
+    def _tenant_for(self, seq: int) -> str:
+        """Deterministic tenant of job ``seq``: keyed on the job's own
+        sequence number (not the submitting thread), so a re-register
+        of job n lands in job n's namespace."""
+        import bisect
+
+        sc = self.sc
+        rng = random.Random((sc.seed << 21) ^ seq)
+        if sc.abusive_tenants and rng.random() < sc.abusive_share:
+            return self._tenants[rng.randrange(sc.abusive_tenants)]
+        if not self._tenant_cdf:
+            return self._tenants[0]
+        pick = rng.random() * self._tenant_cdf[-1]
+        idx = bisect.bisect_left(self._tenant_cdf, pick)
+        return self._tenants[sc.abusive_tenants
+                             + min(idx, len(self._tenant_cdf) - 1)]
+
     def _job_for(self, seq: int) -> s.Job:
         """Deterministic job n of the arrival stream: the mix draw keys
         on (scenario seed, n), not on thread interleaving, so two runs
@@ -598,8 +653,11 @@ class LoadHarness:
             # the duplicate-eval stream per-job coalescing exists for.
             target = rng.randrange(max(0, seq - 500), seq)
             job_id = f"lg-{sc.name}-{target:06d}"
+            seq = target
+        namespace = self._tenant_for(seq) if self._tenants else ""
         return s.Job(
             region="global", id=job_id, name=job_id,
+            namespace=namespace,
             type=s.JOB_TYPE_SERVICE, priority=shape.priority,
             datacenters=["dc1"],
             task_groups=[s.TaskGroup(
@@ -637,7 +695,7 @@ class LoadHarness:
                 try:
                     _, eval_id = self.server.job_register(job)
                     rec = _Submission(seq, eval_id, job.id, job.priority,
-                                      submit_t)
+                                      submit_t, ns=job.namespace)
                     rec.rejected = rejected
                     with self._l:
                         self.subs[eval_id] = rec
@@ -648,9 +706,16 @@ class LoadHarness:
                     rejected += 1
                     with self._l:
                         self.reject_events += 1
+                        if job.namespace:
+                            self.ns_rejects[job.namespace] = \
+                                self.ns_rejects.get(job.namespace, 0) + 1
                     if attempt >= sc.submit_retries:
                         with self._l:
                             self.dropped += 1
+                            if job.namespace:
+                                self.ns_dropped[job.namespace] = \
+                                    self.ns_dropped.get(job.namespace,
+                                                        0) + 1
                         break
                     # The server's hint plus client-side full jitter —
                     # the same discipline utils/backoff applies.
@@ -835,6 +900,8 @@ class LoadHarness:
     def _run_inner(self) -> Dict:
         sc = self.sc
         node_ids = self._register_nodes()
+        if sc.num_tenants > 0:
+            self._register_tenants()
         self._attach_subscribers()
 
         # Chaos plane + continuous safety auditor (ISSUE 12): the
@@ -868,7 +935,10 @@ class LoadHarness:
         if self._filter_subs:
             spawn(self._sub_drainer, name="lg-sub-drain")
         if sc.heartbeat:
-            per = max(1, len(node_ids) // max(1, sc.num_clients))
+            # Ceiling split: a truncating divide leaves the remainder
+            # nodes with NO heartbeater, and they get marked down
+            # mid-run (e.g. 300 nodes / 8 clients stranded 4).
+            per = -(-len(node_ids) // max(1, sc.num_clients))
             for c in range(sc.num_clients):
                 chunk = node_ids[c * per:(c + 1) * per]
                 if chunk:
@@ -1079,6 +1149,71 @@ class LoadHarness:
             }
         return out
 
+    def _tenancy_section(self, records, ns_rejects: Dict[str, int],
+                         ns_dropped: Dict[str, int]) -> Dict:
+        """Per-tenant attribution of the run (ISSUE 16): completion-
+        latency percentiles split abuser vs compliant, per-class 429 /
+        drop tallies, the broker's per-tenant counters, and the
+        committed-state quota sweep — the noisy-neighbor isolation
+        numbers the multi_tenant gate asserts on."""
+        sc = self.sc
+        abusers = set(self._tenants[:sc.abusive_tenants])
+
+        def cls(ns: str) -> str:
+            return "abuser" if ns in abusers else "compliant"
+
+        latency: Dict[str, List[float]] = {"abuser": [], "compliant": []}
+        accepted = {"abuser": 0, "compliant": 0}
+        lost = {"abuser": 0, "compliant": 0}
+        for r in records:
+            c = cls(r.ns)
+            accepted[c] += 1
+            if r.done_t is None:
+                lost[c] += 1
+            else:
+                latency[c].append(r.done_t - r.submit_t)
+        rejects = {"abuser": 0, "compliant": 0}
+        for ns, n in ns_rejects.items():
+            rejects[cls(ns)] += n
+        dropped = {"abuser": 0, "compliant": 0}
+        for ns, n in ns_dropped.items():
+            dropped[cls(ns)] += n
+
+        counters = self.server.eval_broker.tenant_counters()
+        broker_dequeued = {"abuser": 0, "compliant": 0}
+        broker_shed = {"abuser": 0, "compliant": 0}
+        for ns, (_pending, deq, shed, _rej) in counters.items():
+            if ns in abusers or ns.startswith("lg-"):
+                broker_dequeued[cls(ns)] += deq
+                broker_shed[cls(ns)] += shed
+
+        # Committed-state quota sweep: the hard bar — no tenant's live
+        # alloc count may exceed its registered quota.
+        usage = self.server.state.namespace_usage()
+        over = []
+        if sc.tenant_max_live_allocs > 0:
+            for ns in self._tenants:
+                live = usage.get(ns, (0, 0, 0, 0, 0))[4]
+                if live > sc.tenant_max_live_allocs:
+                    over.append({"namespace": ns, "live": live,
+                                 "quota": sc.tenant_max_live_allocs})
+        return {
+            "tenants": len(self._tenants),
+            "abusive_tenants": sc.abusive_tenants,
+            "objective": self.server.eval_broker.fairness.objective,
+            "latency_ms": {c: _percentiles(v)
+                           for c, v in latency.items()},
+            "accepted": accepted,
+            "lost_accepted": lost,
+            "rejects_429": rejects,
+            "dropped_after_retries": dropped,
+            "broker_dequeued": broker_dequeued,
+            "broker_shed": broker_shed,
+            "active_tenants_in_broker": len(counters),
+            "quota_violations": len(over),
+            "quota_violation_detail": over[:10],
+        }
+
     def _assemble(self, m_start: float, m_end: float, drained_t: float,
                   fanout: Dict) -> Dict:
         sc = self.sc
@@ -1088,6 +1223,8 @@ class LoadHarness:
             placed_events = list(self.placed_events)
             dropped = self.dropped
             rejects = self.reject_events
+            ns_rejects = dict(self.ns_rejects)
+            ns_dropped = dict(self.ns_dropped)
 
         window = max(1e-9, m_end - m_start)
         completed_in_window = [r for r in records
@@ -1176,6 +1313,9 @@ class LoadHarness:
             # report their own split via Status.Metrics.
             "codec": self._codec_split(),
         }
+        if sc.num_tenants > 0:
+            report["tenancy"] = self._tenancy_section(
+                records, ns_rejects, ns_dropped)
         if tracing.enabled() and slowest:
             report["slow_tail_traces"] = [
                 {"eval_id": r.eval_id,

@@ -128,7 +128,23 @@ def _render_single(r: Dict, out: TextIO, indent: str = "") -> None:
         w(f"integrity: {integ['jobs_checked']} jobs checked, "
           f"overplaced={integ['overplaced_jobs']} "
           f"dup_names={integ['duplicate_alloc_names']} "
-          f"overcommitted_nodes={integ['overcommitted_nodes']}")
+          f"overcommitted_nodes={integ['overcommitted_nodes']}"
+          + (f" tenant_quota={integ['tenant_quota_violations']}"
+             if "tenant_quota_violations" in integ else ""))
+    ten = r.get("tenancy") or {}
+    if ten:
+        w(f"tenancy: {ten['tenants']} tenants "
+          f"({ten['abusive_tenants']} abusive, "
+          f"objective={ten['objective']}), "
+          f"{ten['active_tenants_in_broker']} active in broker, "
+          f"quota violations={ten['quota_violations']}")
+        for c in ("abuser", "compliant"):
+            lat = ten["latency_ms"][c]
+            w(f"  {c}: {ten['accepted'][c]} accepted "
+              f"({ten['lost_accepted'][c]} lost), "
+              f"{ten['rejects_429'][c]} 429s, "
+              f"{ten['dropped_after_retries'][c]} dropped — "
+              f"done ms p50={lat['p50']} p99={lat['p99']}")
     for f in r.get("follower_servers", []):
         if "error" in f:
             w(f"follower {f['addr']}: stats unavailable ({f['error']})")

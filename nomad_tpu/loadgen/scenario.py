@@ -119,6 +119,24 @@ class Scenario:
     #                       pre-fault rate within this window (30.0)
     #   audit_interval_s  — auditor sweep/fingerprint cadence (1.0)
     chaos: Optional[Dict] = None
+    # Multi-tenant serving plane (ISSUE 16).  num_tenants > 0 arms
+    # tenancy: that many namespaces are pre-registered through raft and
+    # every offered job is stamped with one of them.  The first
+    # ``abusive_tenants`` namespaces soak up ``abusive_share`` of ALL
+    # offered submissions (the noisy-neighbor leg); the compliant rest
+    # split the remainder by a zipf draw (``tenant_zipf`` = 0 uniform,
+    # else the skew exponent), so the tenant population looks like a
+    # real fleet: a few busy teams, a long quiet tail.
+    num_tenants: int = 0
+    tenant_zipf: float = 0.0
+    abusive_tenants: int = 0
+    abusive_share: float = 0.0
+    # Quota knobs stamped on every registered namespace (0 = unlimited,
+    # matching the Namespace zero value).
+    tenant_max_live_allocs: int = 0
+    tenant_max_pending_evals: int = 0
+    tenant_dequeue_weight: float = 1.0
+    tenant_objective: str = ""    # "" inherits NOMAD_TPU_TENANCY_OBJECTIVE
     # Determinism.
     seed: int = 42
 
@@ -285,9 +303,34 @@ CHAOS_SMOKE = Scenario(
            "spacing_s": 6.0, "recovery_bound_s": 25.0},
     seed=23)
 
+#: Multi-tenant serving gate (ISSUE 16): ~1k namespaces with per-tenant
+#: pending-eval and live-alloc quotas, a zipf-skewed compliant
+#: population, and ONE abusive tenant soaking up half the offered load.
+#: The acceptance shape: the abuser's own completion p99 degrades (its
+#: subqueue saturates and its overflow is 429'd at the admission front
+#: door) while compliant tenants keep dequeuing promptly under DRF;
+#: accepted evals are never lost; and no tenant's committed live-alloc
+#: count ever exceeds its quota (the strict final sweep asserts it).
+#: submit_retries=1 keeps the open-loop schedule honest — the abuser's
+#: rejected overflow must not stall the submitter threads into a
+#: different experiment.
+MULTI_TENANT = Scenario(
+    name="multi_tenant",
+    num_nodes=300, node_cpu=64_000, node_memory_mb=262_144,
+    num_clients=8, arrival_rate=600.0, max_submissions=3000,
+    job_mix=[JobShape(weight=1, count=1, cpu=50, memory_mb=64,
+                      priority=50)],
+    warmup_s=0.0, measure_s=20.0, drain_s=45.0,
+    subscribers=16, min_heartbeat_ttl=30.0, num_workers=1,
+    submit_retries=1,
+    num_tenants=1000, tenant_zipf=1.1, abusive_tenants=1,
+    abusive_share=0.5, tenant_max_pending_evals=32,
+    tenant_max_live_allocs=800, seed=16)
+
 BUILTIN_SCENARIOS: Dict[str, Scenario] = {
     sc.name: sc for sc in (SMOKE, BASELINE, OVERLOAD_10X, FANOUT_10K,
-                           MULTI_SERVER, CHAOS_SOAK, CHAOS_SMOKE)}
+                           MULTI_SERVER, CHAOS_SOAK, CHAOS_SMOKE,
+                           MULTI_TENANT)}
 
 
 def get_scenario(name: str) -> Scenario:

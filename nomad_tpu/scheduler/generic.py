@@ -277,6 +277,7 @@ class GenericScheduler:
                 alloc = s.Allocation(
                     id=s.generate_uuid(),
                     eval_id=self.eval.id,
+                    namespace=self.job.namespace,
                     name=missing.name,
                     job_id=self.job.id,
                     task_group=missing.task_group.name,

@@ -265,6 +265,27 @@ def register_endpoints(server, rpc) -> None:
     register("Job.Evaluate", job_evaluate)
     register("Job.Dispatch", job_dispatch)
 
+    # -- Namespace (tenancy plane, ROADMAP item 3) -------------------------
+
+    def namespace_upsert(body):
+        ns = ensure(s.Namespace, body["Namespace"])
+        return {"Index": server.namespace_upsert(ns)}
+
+    def namespace_delete(body):
+        return {"Index": server.namespace_delete(body["Name"])}
+
+    def namespace_list(body):
+        return {"Namespaces": server.namespace_list(),
+                "Index": server.state.table_index("namespaces")}
+
+    def namespace_status(body):
+        return server.namespace_status(body["Name"])
+
+    register("Namespace.Upsert", namespace_upsert)
+    register("Namespace.Delete", namespace_delete)
+    register("Namespace.List", namespace_list)
+    register("Namespace.Status", namespace_status)
+
     # -- Eval (worker surface, eval_endpoint.go:64-211) --------------------
 
     def eval_dequeue(body):

@@ -37,10 +37,16 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from ..structs import structs as s
+from ..tenancy.fairness import FairnessState, TenantQueue
 from ..utils import tracing
 from ..utils.telemetry import NULL_TELEMETRY
 
 FAILED_QUEUE = "_failed"
+
+#: Cap on per-tenant rows surfaced by extended_stats(): the stats
+#: endpoint must stay O(1)-ish at 1k+ tenants, so only the busiest
+#: rows ship and the rest are counted as elided.
+STATS_MAX_TENANTS = 256
 
 
 class EvalBrokerError(Exception):
@@ -53,12 +59,16 @@ class BrokerLimitError(EvalBrokerError):
     the HTTP layer maps this to 429 + Retry-After, the RPC layer
     re-types it from the wire error string."""
 
-    def __init__(self, retry_after: float, pending: int, limit: int):
+    def __init__(self, retry_after: float, pending: int, limit: int,
+                 namespace: str = ""):
         self.retry_after = retry_after
         self.pending = pending
         self.limit = limit
+        self.namespace = namespace
+        what = (f"tenant {namespace!r} at quota" if namespace
+                else "eval broker at capacity")
         super().__init__(
-            f"eval broker at capacity ({pending}/{limit} pending); "
+            f"{what} ({pending}/{limit} pending); "
             f"retry_after={retry_after:.2f}")
 
     @staticmethod
@@ -71,7 +81,9 @@ class BrokerLimitError(EvalBrokerError):
         retry = float(m.group(1)) if m else 1.0
         m = re.search(r"\((\d+)/(\d+) pending\)", msg)
         pending, limit = (int(m.group(1)), int(m.group(2))) if m else (0, 0)
-        return BrokerLimitError(retry, pending, limit)
+        m = re.search(r"tenant '([^']*)' at quota", msg)
+        ns = m.group(1) if m else ""
+        return BrokerLimitError(retry, pending, limit, namespace=ns)
 
 
 ERR_NOT_OUTSTANDING = "evaluation is not outstanding"
@@ -144,10 +156,19 @@ class EvalBroker:
         self.evals: Dict[str, int] = {}            # id → delivery attempts
         self.job_evals: Dict[str, str] = {}        # job id → queued eval id
         self.blocked: Dict[str, List[_HeapEntry]] = {}
-        self.ready: Dict[str, List[_HeapEntry]] = {}
+        self.ready: Dict[str, TenantQueue] = {}
         self.unack: Dict[str, _Unack] = {}
         self.requeue: Dict[str, s.Evaluation] = {}  # token → eval
         self.time_wait: Dict[str, threading.Timer] = {}
+
+        # Tenancy plane: shared fairness state (policy/usage/virtual
+        # time) for every TenantQueue above, plus per-tenant pending /
+        # shed / reject accounting for quota admission and the stats
+        # surface.  All mutated under self._l.
+        self.fairness = FairnessState()
+        self._ns_pending: Dict[str, int] = {}
+        self._ns_shed: Dict[str, int] = {}
+        self._ns_rejects: Dict[str, int] = {}
 
         # Saturation counters + the shed hand-off (evals coalesced away;
         # the server's shed reaper cancels them through the log — the
@@ -239,6 +260,8 @@ class EvalBroker:
             return
         elif self._enabled:
             self.evals[ev.id] = 0
+            ns = ev.namespace or "default"
+            self._ns_pending[ns] = self._ns_pending.get(ns, 0) + 1
             # The shared choke point — instrumented here, after the
             # dedup check and only while enabled, so every actual
             # admission (enqueue, enqueue_all via blocked-eval unblock,
@@ -279,7 +302,10 @@ class EvalBroker:
                            self._entry(ev))
             return
 
-        heapq.heappush(self.ready.setdefault(queue, []), self._entry(ev))
+        q = self.ready.get(queue)
+        if q is None:
+            q = self.ready[queue] = TenantQueue(self.fairness)
+        q.push(self._entry(ev))
         self._cond.notify_all()
 
     def _coalesce_deferred(self, ev: s.Evaluation) -> bool:
@@ -319,7 +345,10 @@ class EvalBroker:
         return True  # ev was either shed or installed as the deferred slot
 
     def _shed_locked(self, ev: s.Evaluation) -> None:
-        self.evals.pop(ev.id, None)
+        if self.evals.pop(ev.id, None) is not None:
+            self._ns_pending_dec(ev.namespace or "default")
+        ns = ev.namespace or "default"
+        self._ns_shed[ns] = self._ns_shed.get(ns, 0) + 1
         self.shed_total += 1
         self.metrics.incr_counter("broker.shed")
         self._shed.append(ev)
@@ -341,22 +370,55 @@ class EvalBroker:
         with self._l:
             return len(self.evals)
 
-    def check_admission(self, priority: int = 0) -> None:
+    def ns_pending_count(self, namespace: str) -> int:
+        with self._l:
+            return self._ns_pending.get(namespace or "default", 0)
+
+    def _ns_pending_dec(self, ns: str) -> None:
+        """Caller holds the lock."""
+        left = self._ns_pending.get(ns, 0) - 1
+        if left > 0:
+            self._ns_pending[ns] = left
+        else:
+            self._ns_pending.pop(ns, None)
+
+    def check_admission(self, priority: int = 0, namespace: str = "",
+                        ns_max_pending: int = 0) -> None:
         """Front-door admission check, called by the RPC surface BEFORE
         the eval-creating raft apply.  Raises BrokerLimitError when the
-        broker tracks ``max_pending`` or more evals, unless ``priority``
-        is at or above ``bypass_priority`` (repair/GC traffic must not
-        starve behind user submissions).  Estimated retry_after grows
-        with the overload ratio; callers add jitter via utils/backoff."""
-        if self.max_pending <= 0:
+        broker tracks ``max_pending`` or more evals — or, when the
+        caller resolved a per-tenant pending-eval quota
+        (``ns_max_pending`` > 0), when ``namespace`` alone has that many
+        pending — unless ``priority`` is at or above ``bypass_priority``
+        (repair/GC traffic must not starve behind user submissions).
+        Estimated retry_after grows with the overload ratio; callers
+        add jitter via utils/backoff."""
+        if self.max_pending <= 0 and ns_max_pending <= 0:
             return
+        ns = namespace or "default"
         with self._l:
             if not self._enabled:
                 return
+            if priority >= self.bypass_priority:
+                return
+            ns_pending = self._ns_pending.get(ns, 0)
+            if ns_max_pending > 0 and ns_pending >= ns_max_pending:
+                self.admission_rejects += 1
+                self._ns_rejects[ns] = self._ns_rejects.get(ns, 0) + 1
+                self.metrics.incr_counter("broker.admission_reject")
+                tr = tracing.TRACER
+                if tr is not None:
+                    tr.event("broker.admission_reject", namespace=ns,
+                             pending=ns_pending, limit=ns_max_pending)
+                retry_after = min(
+                    5.0, 0.2 + 0.3 * (ns_pending / ns_max_pending))
+                raise BrokerLimitError(retry_after, ns_pending,
+                                       ns_max_pending, namespace=ns)
             pending = len(self.evals)
-            if pending < self.max_pending or priority >= self.bypass_priority:
+            if self.max_pending <= 0 or pending < self.max_pending:
                 return
             self.admission_rejects += 1
+            self._ns_rejects[ns] = self._ns_rejects.get(ns, 0) + 1
         self.metrics.incr_counter("broker.admission_reject")
         tr = tracing.TRACER
         if tr is not None:
@@ -364,6 +426,56 @@ class EvalBroker:
                      limit=self.max_pending)
         retry_after = min(5.0, 0.2 + 0.3 * (pending / self.max_pending))
         raise BrokerLimitError(retry_after, pending, self.max_pending)
+
+    def note_quota_reject(self, namespace: str) -> None:
+        """Record an admission rejection decided OUTSIDE the broker
+        (the server's alloc-quota ledger) so the per-tenant reject
+        counters and metrics tell one story."""
+        ns = namespace or "default"
+        with self._l:
+            self.admission_rejects += 1
+            self._ns_rejects[ns] = self._ns_rejects.get(ns, 0) + 1
+        self.metrics.incr_counter("broker.admission_reject")
+        tr = tracing.TRACER
+        if tr is not None:
+            tr.event("broker.quota_reject", namespace=ns)
+
+    # -- tenancy wiring ----------------------------------------------------
+
+    def set_namespace_policy(self, name: str, weight: float,
+                             objective: str) -> None:
+        """Install/refresh a tenant's fairness policy (server-side, on
+        namespace upsert) and rescore its queued entries."""
+        with self._l:
+            self.fairness.set_policy(name, weight, objective)
+            for q in self.ready.values():
+                q.note_usage_changed((name,))
+
+    def drop_namespace_policy(self, name: str) -> None:
+        with self._l:
+            self.fairness.drop_policy(name)
+
+    def set_objective(self, objective: str) -> None:
+        """Cluster-wide default fairness objective (the
+        NOMAD_TPU_TENANCY_OBJECTIVE knob)."""
+        with self._l:
+            self.fairness.objective = objective
+
+    def set_cluster_capacity(self, cap: Tuple[int, int, int, int]) -> None:
+        with self._l:
+            self.fairness.set_capacity(cap)
+
+    def note_usage_changed(self, usage: Dict[str, Tuple]) -> None:
+        """Fold the state store's dirty per-tenant usage rows into the
+        fairness scorer — O(changed tenants), the PR 9 usage-fold feed,
+        never a scan of all tenants."""
+        if not usage:
+            return
+        with self._l:
+            for ns, vec in usage.items():
+                self.fairness.set_usage(ns, vec)
+            for q in self.ready.values():
+                q.note_usage_changed(usage)
 
     def _entry(self, ev: s.Evaluation) -> _HeapEntry:
         return _HeapEntry((-ev.priority, ev.create_index, next(self._seq)), ev)
@@ -417,7 +529,7 @@ class EvalBroker:
             heap = self.ready.get(sched)
             if not heap:
                 continue
-            priority = heap[0].eval.priority
+            priority = heap.peek_priority()
             if not eligible or priority > eligible_priority:
                 eligible = [sched]
                 eligible_priority = priority
@@ -429,8 +541,7 @@ class EvalBroker:
         return self._dequeue_for_sched(sched)
 
     def _dequeue_for_sched(self, sched: str) -> Tuple[s.Evaluation, str]:
-        heap = self.ready[sched]
-        ev = heapq.heappop(heap).eval
+        ev = self.ready[sched].pop().eval
         token = s.generate_uuid()
 
         deadline = (time.monotonic() + self.nack_timeout
@@ -504,7 +615,8 @@ class EvalBroker:
                         eval_id=eval_id)
 
                 del self.unack[eval_id]
-                self.evals.pop(eval_id, None)
+                if self.evals.pop(eval_id, None) is not None:
+                    self._ns_pending_dec(unack.eval.namespace or "default")
                 self.job_evals.pop(job_id, None)
 
                 blocked = self.blocked.get(job_id)
@@ -594,6 +706,9 @@ class EvalBroker:
             self.unack = {}
             self.requeue = {}
             self.time_wait = {}
+            # Pending mirrors die with the queues; shed/reject/dequeue
+            # counters are lifetime totals and survive the flush.
+            self._ns_pending = {}
             # Shed evals not yet reaped die with the leadership that shed
             # them — the next leader's restore pass re-evaluates.
             self._shed = []
@@ -633,6 +748,7 @@ class EvalBroker:
             attempts_hist: Dict[int, int] = {}
             for attempts in self.evals.values():
                 attempts_hist[attempts] = attempts_hist.get(attempts, 0) + 1
+            tenants, elided = self._tenant_stats_locked()
             return {
                 "Enabled": self._enabled,
                 "Pending": len(self.evals),
@@ -648,4 +764,46 @@ class EvalBroker:
                 "CoalescedTotal": self.coalesced_total,
                 "AdmissionRejects": self.admission_rejects,
                 "ShedUnreaped": len(self._shed),
+                "Objective": self.fairness.objective,
+                "Tenants": tenants,
+                "TenantsElided": elided,
             }
+
+    def _tenant_stats_locked(self) -> Tuple[Dict[str, Dict], int]:
+        """Per-tenant broker breakdown, busiest (most pending) rows
+        first, capped at STATS_MAX_TENANTS so the endpoint stays cheap
+        at 1k+ tenants.  Caller holds the lock."""
+        fs = self.fairness
+        names = set(self._ns_pending)
+        names.update(fs.dequeued)
+        names.update(self._ns_shed)
+        names.update(self._ns_rejects)
+        ranked = sorted(names,
+                        key=lambda n: (-self._ns_pending.get(n, 0), n))
+        elided = max(0, len(ranked) - STATS_MAX_TENANTS)
+        tenants: Dict[str, Dict] = {}
+        for ns in ranked[:STATS_MAX_TENANTS]:
+            tenants[ns] = {
+                "Pending": self._ns_pending.get(ns, 0),
+                "Dequeued": fs.dequeued.get(ns, 0),
+                "Shed": self._ns_shed.get(ns, 0),
+                "Rejects": self._ns_rejects.get(ns, 0),
+                "Weight": fs.weight(ns),
+                "DominantShare": round(fs.dominant_share(ns), 6),
+                "VirtualTime": round(fs.vt.get(ns, 0.0), 6),
+            }
+        return tenants, elided
+
+    def tenant_counters(self) -> Dict[str, Tuple[int, int, int, int]]:
+        """(pending, dequeued, shed, rejects) per tenant — the metrics
+        loop's cheap snapshot (no score computation)."""
+        with self._l:
+            fs = self.fairness
+            names = set(self._ns_pending)
+            names.update(fs.dequeued)
+            names.update(self._ns_rejects)
+            return {ns: (self._ns_pending.get(ns, 0),
+                         fs.dequeued.get(ns, 0),
+                         self._ns_shed.get(ns, 0),
+                         self._ns_rejects.get(ns, 0))
+                    for ns in names}

@@ -35,6 +35,8 @@ class MessageType(IntEnum):
     APPLY_PLAN_RESULTS = 13
     PERIODIC_LAUNCH_UPSERT = 14
     PERIODIC_LAUNCH_DELETE = 15
+    NAMESPACE_UPSERT = 16
+    NAMESPACE_DELETE = 17
 
 
 class FSM:
@@ -49,6 +51,8 @@ class FSM:
         on_job_register: Optional[Callable[[s.Job], None]] = None,
         on_job_deregister: Optional[Callable[[str], None]] = None,
         on_alloc_terminal: Optional[Callable[[str], None]] = None,
+        on_namespace_update: Optional[
+            Callable[[str, Optional[s.Namespace]], None]] = None,
     ):
         self.state = state or StateStore()
         self.logger = logger or logging.getLogger("nomad_tpu.fsm")
@@ -60,6 +64,10 @@ class FSM:
         # Vault revocation trigger (vault.go RevokeTokens via fsm alloc
         # client updates): called with the alloc id on terminal transition.
         self.on_alloc_terminal = on_alloc_terminal
+        # Tenancy policy push (leader-side): fires with (name, ns) on
+        # upsert and (name, None) on delete, so the broker's fairness
+        # weights and the HTTP rate buckets track the committed rows.
+        self.on_namespace_update = on_namespace_update
         # Cluster event broker (server/event_broker.py): remembered here
         # so restore() can re-attach it to the replacement state store —
         # a snapshot install must not silently disarm the event stream.
@@ -213,6 +221,19 @@ class FSM:
     def _apply_periodic_launch_delete(self, index: int, req: dict):
         self.state.delete_periodic_launch(index, req["job_id"])
 
+    # -- namespaces --------------------------------------------------------
+
+    def _apply_namespace_upsert(self, index: int, req: dict):
+        ns: s.Namespace = req["namespace"]
+        self.state.upsert_namespace(index, ns)
+        if self.on_namespace_update is not None:
+            self.on_namespace_update(ns.name, ns)
+
+    def _apply_namespace_delete(self, index: int, req: dict):
+        self.state.delete_namespace(index, req["name"])
+        if self.on_namespace_update is not None:
+            self.on_namespace_update(req["name"], None)
+
     # -- snapshot / restore ------------------------------------------------
 
     def snapshot(self) -> bytes:
@@ -252,6 +273,8 @@ class FSM:
         MessageType.APPLY_PLAN_RESULTS: _apply_plan_results,
         MessageType.PERIODIC_LAUNCH_UPSERT: _apply_periodic_launch_upsert,
         MessageType.PERIODIC_LAUNCH_DELETE: _apply_periodic_launch_delete,
+        MessageType.NAMESPACE_UPSERT: _apply_namespace_upsert,
+        MessageType.NAMESPACE_DELETE: _apply_namespace_delete,
     }
 
 

@@ -16,12 +16,13 @@ from typing import Dict, List, Optional, Tuple
 
 from .. import fault
 from ..structs import structs as s
+from ..tenancy import QuotaLedger, RateLimiter
 from ..utils import knobs, tracing
 from ..utils.telemetry import Telemetry
 from . import event_broker as event_stream
 from .blocked_evals import BlockedEvals
 from .core_sched import CoreScheduler
-from .eval_broker import EvalBroker
+from .eval_broker import BrokerLimitError, EvalBroker
 from .event_broker import EventBroker
 from .fsm import FSM, MessageType, TimeTable
 from .heartbeat import HeartbeatTimers
@@ -72,6 +73,11 @@ class ServerConfig:
         "NOMAD_TPU_BROKER_COALESCE"))
     broker_bypass_priority: int = field(default_factory=lambda: knobs.get_int(
         "NOMAD_TPU_BROKER_BYPASS_PRIO", s.JOB_MAX_PRIORITY))
+    # Multi-tenant serving plane (ROADMAP item 3): cluster-wide default
+    # fair-dequeue objective (drf | weighted-rr | fifo); a Namespace
+    # row's objective field overrides per tenant.
+    tenancy_objective: str = field(default_factory=lambda: knobs.get_str(
+        "NOMAD_TPU_TENANCY_OBJECTIVE", s.TENANCY_OBJECTIVE_DRF))
     # Follower-read scheduling (ISSUE 10): on a multi-raft cluster every
     # server also runs FollowerWorkers that, while the server is a
     # follower, pull evals from the leader's broker over RPC, schedule
@@ -142,6 +148,16 @@ class Server:
             max_pending=self.config.broker_max_pending,
             coalesce=self.config.broker_coalesce,
             bypass_priority=self.config.broker_bypass_priority)
+        self.eval_broker.set_objective(self.config.tenancy_objective)
+        # Tenancy enforcement (ROADMAP item 3): leader-side alloc-quota
+        # reservation book and the per-tenant API token buckets the HTTP
+        # layer consults.  Both are policy mirrors of committed
+        # Namespace rows, pushed through the FSM namespace hook.
+        self.quota_ledger = QuotaLedger()
+        self.api_limiter = RateLimiter()
+        # Cluster capacity mirror for DRF dominant shares: recomputed by
+        # the metrics loop only when the nodes table index moves.
+        self._capacity_node_index = -1
         self.blocked_evals = BlockedEvals(self.eval_broker)
         self.plan_queue = PlanQueue()
         self.time_table = TimeTable()
@@ -153,6 +169,7 @@ class Server:
             on_job_register=self._fsm_job_registered,
             on_job_deregister=self._fsm_job_deregistered,
             on_alloc_terminal=self._fsm_alloc_terminal,
+            on_namespace_update=self._fsm_namespace_updated,
         )
 
         # RPC listener + connection pool (nomad/server.go:250 setupRPC).
@@ -663,6 +680,7 @@ class Server:
         self.periodic.set_enabled(True)
         self.heartbeat.set_enabled(True)
         self.plan_applier.start()
+        self._restore_tenancy()
         self._restore_evals()
         self._restore_periodic_dispatcher()
         self._start_reapers()
@@ -702,6 +720,28 @@ class Server:
         self.periodic.set_enabled(False)
         self.heartbeat.set_enabled(False)
         self.plan_applier.stop()
+
+    def _restore_tenancy(self) -> None:
+        """Reseed the tenancy plane from restored state on leadership:
+        fairness/rate policy from committed Namespace rows, and a
+        conservative quota-ledger rebuild from every non-terminal
+        eval's job (over-reserving is safe — extra 429s near the limit;
+        under-reserving could let a failover breach quota)."""
+        for ns in self.state.namespaces(None):
+            self._fsm_namespace_updated(ns.name, ns)
+        entries = []
+        seen = set()
+        for ev in self.state.evals(None):
+            if ev.terminal_status() or ev.job_id in seen:
+                continue
+            seen.add(ev.job_id)
+            job = self.state.job_by_id(None, ev.job_id)
+            if job is None:
+                continue
+            count = sum(tg.count for tg in job.task_groups)
+            entries.append((job.id, job.namespace or "default", count))
+        self.quota_ledger.rebuild(entries)
+        self.eval_broker.note_usage_changed(self.state.namespace_usage())
 
     def _restore_evals(self) -> None:
         """Re-enqueue pending and re-block blocked evals from state
@@ -804,8 +844,10 @@ class Server:
         """Periodic gauge emission (server.go:292-305 EmitStats of the
         broker, plan queue, blocked evals, and heartbeat timers; metric
         names per the reference telemetry doc)."""
+        tenant_top = knobs.get_int("NOMAD_TPU_TENANCY_METRICS_TOP", 10)
         while not self._shutdown.is_set():
             try:
+                self._feed_tenancy(tenant_top)
                 b = self.eval_broker.stats()
                 self.metrics.set_gauge("broker.total_ready",
                                        b.get("total_ready", 0))
@@ -866,6 +908,44 @@ class Server:
                 self.logger.exception("metrics emit failed")
             self._shutdown.wait(interval)
 
+    def _feed_tenancy(self, tenant_top: int) -> None:
+        """Per-tick tenancy upkeep, piggybacked on the metrics cadence:
+        drain the state store's dirty per-ns usage fold into the DRF
+        scorer (O(changed tenants)), refresh the cluster-capacity
+        mirror when the nodes table moved, and emit the busiest
+        tenants' ``tenant.*`` gauges (knob-capped — a 1k-tenant fleet
+        must not mint 4k gauge keys)."""
+        dirty = self.state.drain_ns_dirty()
+        if dirty:
+            usage = self.state.namespace_usage()
+            self.eval_broker.note_usage_changed(
+                {ns: usage.get(ns, (0, 0, 0, 0, 0)) for ns in dirty})
+        node_index = self.state.table_index("nodes")
+        if node_index != self._capacity_node_index:
+            self._capacity_node_index = node_index
+            cap = [0, 0, 0, 0]
+            for node in self.state.nodes(None):
+                if node.terminal_status():
+                    continue
+                res = node.resources
+                if res is None:
+                    continue
+                cap[0] += res.cpu
+                cap[1] += res.memory_mb
+                cap[2] += res.disk_mb
+                cap[3] += res.iops
+            self.eval_broker.set_cluster_capacity(tuple(cap))
+        if tenant_top <= 0:
+            return
+        counters = self.eval_broker.tenant_counters()
+        busiest = sorted(counters.items(),
+                         key=lambda kv: (-kv[1][0], kv[0]))[:tenant_top]
+        for ns, (pending, dequeued, shed, rejects) in busiest:
+            self.metrics.set_gauge(f"tenant.pending.{ns}", pending)
+            self.metrics.set_gauge(f"tenant.dequeued.{ns}", dequeued)
+            self.metrics.set_gauge(f"tenant.shed.{ns}", shed)
+            self.metrics.set_gauge(f"tenant.rejects.{ns}", rejects)
+
     def _create_core_eval(self, core_job: str) -> None:
         ev = s.Evaluation(
             id=s.generate_uuid(), priority=s.JOB_MAX_PRIORITY,
@@ -879,6 +959,11 @@ class Server:
         if not self._leader:
             return
         self.time_table.witness(self.raft.applied_index())
+        if ev.terminal_status():
+            # The job's driving eval is done: its placements are live in
+            # the per-ns usage fold (or never will be), so the admission
+            # reservation made for it has served its purpose.
+            self.quota_ledger.release(ev.job_id)
         if ev.should_enqueue():
             self.eval_broker.enqueue(ev)
         elif ev.should_block():
@@ -900,6 +985,21 @@ class Server:
     def _fsm_job_deregistered(self, job_id: str) -> None:
         if self._leader:
             self.periodic.remove(job_id)
+            self.quota_ledger.release(job_id)
+
+    def _fsm_namespace_updated(self, name: str,
+                               ns: Optional[s.Namespace]) -> None:
+        """Committed Namespace row changed: refresh the policy mirrors.
+        Runs on every server (the rate limiter guards each HTTP front
+        door; fairness weights matter only while leading but are cheap
+        to keep warm)."""
+        if ns is None:
+            self.eval_broker.drop_namespace_policy(name)
+            self.api_limiter.drop(name)
+            return
+        self.eval_broker.set_namespace_policy(
+            name, ns.dequeue_weight, ns.objective)
+        self.api_limiter.configure(name, ns.api_rate, float(ns.api_burst))
 
     def _fsm_alloc_terminal(self, alloc_id: str) -> None:
         """Terminal alloc ⇒ revoke its derived Vault tokens
@@ -1037,6 +1137,30 @@ class Server:
 
     # -- Job ---------------------------------------------------------------
 
+    def _check_tenant_admission(self, job: s.Job) -> None:
+        """Per-tenant front-door gate, leader-side, BEFORE the raft
+        write (composes with the global broker cap inside
+        check_admission): the namespace's pending-eval quota, then an
+        atomic check+reserve of its live-alloc quota in the ledger.
+        Rejections raise BrokerLimitError → 429 + Retry-After; a
+        bypass-priority submission (core GC, repair) skips both."""
+        ns = job.namespace or "default"
+        row = self.state.namespace_by_name(None, ns)
+        self.eval_broker.check_admission(
+            job.priority, namespace=ns,
+            ns_max_pending=row.max_pending_evals if row is not None else 0)
+        quota = row.max_live_allocs if row is not None else 0
+        if quota <= 0 or job.priority >= self.eval_broker.bypass_priority:
+            return
+        count = sum(tg.count for tg in job.task_groups)
+        live = self.state.namespace_usage_one(ns)[4]
+        if not self.quota_ledger.check_and_reserve(
+                ns, job.id, count, live, quota):
+            self.eval_broker.note_quota_reject(ns)
+            asked = live + self.quota_ledger.reserved(ns) + count
+            retry_after = min(5.0, 0.2 + 0.3 * (asked / quota))
+            raise BrokerLimitError(retry_after, asked, quota, namespace=ns)
+
     def job_register(self, job: s.Job, region: str = "") -> Tuple[int, str]:
         """(job_endpoint.go:47 Register): validate → log JobRegister → eval
         unless periodic/parameterized.  Returns (modify_index, eval_id).
@@ -1066,7 +1190,7 @@ class Server:
         # enqueue nothing).
         if self._leader and not job.is_periodic() \
                 and not job.is_parameterized():
-            self.eval_broker.check_admission(job.priority)
+            self._check_tenant_admission(job)
 
         try:
             _, index = self.raft.apply(MessageType.JOB_REGISTER, {"job": job})
@@ -1080,6 +1204,7 @@ class Server:
                 id=s.generate_uuid(),
                 priority=job.priority,
                 type=job.type,
+                namespace=job.namespace,
                 triggered_by=s.EVAL_TRIGGER_JOB_REGISTER,
                 job_id=job.id,
                 job_modify_index=index,
@@ -1090,7 +1215,7 @@ class Server:
             tr = tracing.TRACER
             if tr is not None:
                 tr.mark(ev.id, job_id=job.id, submit="job_register",
-                        priority=job.priority)
+                        priority=job.priority, namespace=job.namespace)
             _, eval_index = self.raft.apply(MessageType.EVAL_UPDATE, {"evals": [ev]})
             eval_id = ev.id
         return index, eval_id
@@ -1116,6 +1241,7 @@ class Server:
         if not job.is_periodic() and not job.is_parameterized():
             ev = s.Evaluation(
                 id=s.generate_uuid(), priority=job.priority, type=job.type,
+                namespace=job.namespace,
                 triggered_by=s.EVAL_TRIGGER_JOB_DEREGISTER, job_id=job_id,
                 job_modify_index=index, status=s.EVAL_STATUS_PENDING)
             self.raft.apply(MessageType.EVAL_UPDATE, {"evals": [ev]})
@@ -1245,15 +1371,16 @@ class Server:
         if job.is_parameterized():
             raise ValueError("can't evaluate parameterized job")
         if self._leader:
-            self.eval_broker.check_admission(job.priority)
+            self._check_tenant_admission(job)
         ev = s.Evaluation(
             id=s.generate_uuid(), priority=job.priority, type=job.type,
+            namespace=job.namespace,
             triggered_by=s.EVAL_TRIGGER_JOB_REGISTER, job_id=job.id,
             job_modify_index=job.modify_index, status=s.EVAL_STATUS_PENDING)
         tr = tracing.TRACER
         if tr is not None:
             tr.mark(ev.id, job_id=job.id, submit="job_evaluate",
-                    priority=job.priority)
+                    priority=job.priority, namespace=job.namespace)
         try:
             _, index = self.raft.apply(MessageType.EVAL_UPDATE, {"evals": [ev]})
         except NotLeaderError:
@@ -1302,7 +1429,7 @@ class Server:
         child.meta.update(meta)
         child.status = s.JOB_STATUS_PENDING
         if self._leader:
-            self.eval_broker.check_admission(child.priority)
+            self._check_tenant_admission(child)
         try:
             _, index = self.raft.apply(MessageType.JOB_REGISTER, {"job": child})
         except NotLeaderError:
@@ -1313,6 +1440,7 @@ class Server:
                     reply["EvalID"])
         ev = s.Evaluation(
             id=s.generate_uuid(), priority=child.priority, type=child.type,
+            namespace=child.namespace,
             triggered_by=s.EVAL_TRIGGER_JOB_REGISTER, job_id=child.id,
             job_modify_index=index, status=s.EVAL_STATUS_PENDING)
         self.raft.apply(MessageType.EVAL_UPDATE, {"evals": [ev]})
@@ -1518,6 +1646,7 @@ class Server:
                 continue
             evals.append(s.Evaluation(
                 id=s.generate_uuid(), priority=job.priority, type=job.type,
+                namespace=job.namespace,
                 triggered_by=s.EVAL_TRIGGER_NODE_UPDATE, job_id=job_id,
                 node_id=node_id, node_modify_index=node_index,
                 status=s.EVAL_STATUS_PENDING))
@@ -1526,6 +1655,7 @@ class Server:
                 continue
             evals.append(s.Evaluation(
                 id=s.generate_uuid(), priority=job.priority, type=job.type,
+                namespace=job.namespace,
                 triggered_by=s.EVAL_TRIGGER_NODE_UPDATE, job_id=job.id,
                 node_id=node_id, node_modify_index=node_index,
                 status=s.EVAL_STATUS_PENDING))
@@ -1749,6 +1879,55 @@ class Server:
             self.raft.apply(MessageType.RECONCILE_JOB_SUMMARIES, {})
         except NotLeaderError:
             self._forward("System.ReconcileJobSummaries", {})
+
+    # -- Namespace (tenancy plane) -----------------------------------------
+
+    def namespace_upsert(self, ns: s.Namespace) -> int:
+        """Register/update a tenant through raft (like jobs): validate →
+        log NAMESPACE_UPSERT; policy mirrors refresh via the FSM hook."""
+        ns = ns.copy()
+        problems = ns.validate()
+        if problems:
+            raise ValueError(
+                "namespace validation failed: " + "; ".join(problems))
+        try:
+            _, index = self.raft.apply(MessageType.NAMESPACE_UPSERT,
+                                       {"namespace": ns})
+        except NotLeaderError:
+            reply = self._forward("Namespace.Upsert", {"Namespace": ns})
+            return reply["Index"]
+        return index
+
+    def namespace_delete(self, name: str) -> int:
+        if name == s.DEFAULT_NAMESPACE:
+            raise ValueError("cannot delete the default namespace")
+        if self.state.namespace_by_name(None, name) is None:
+            raise KeyError(f"namespace not found: {name}")
+        try:
+            _, index = self.raft.apply(MessageType.NAMESPACE_DELETE,
+                                       {"name": name})
+        except NotLeaderError:
+            reply = self._forward("Namespace.Delete", {"Name": name})
+            return reply["Index"]
+        return index
+
+    def namespace_list(self) -> List[s.Namespace]:
+        return self.state.namespaces(None)
+
+    def namespace_status(self, name: str) -> Dict:
+        """One tenant's row + live usage + broker counters — the
+        namespace-status CLI/HTTP read."""
+        row = self.state.namespace_by_name(None, name)
+        if row is None:
+            raise KeyError(f"namespace not found: {name}")
+        cpu, mem, disk, iops, live = self.state.namespace_usage_one(name)
+        return {
+            "Namespace": row,
+            "Usage": {"CPU": cpu, "MemoryMB": mem, "DiskMB": disk,
+                      "IOPS": iops, "LiveAllocs": live},
+            "ReservedAllocs": self.quota_ledger.reserved(name),
+            "PendingEvals": self.eval_broker.ns_pending_count(name),
+        }
 
     def broker_stats(self) -> Dict:
         """The /v1/broker/stats saturation surface: broker admission /

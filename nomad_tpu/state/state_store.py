@@ -140,6 +140,7 @@ class StateStore:
         "periodic_launch",
         "vault_accessors",
         "deployment",
+        "namespaces",
     )
 
     def __init__(self) -> None:
@@ -159,7 +160,17 @@ class StateStore:
         self.periodic_launch_table: Dict[str, PeriodicLaunch] = {}
         self.vault_accessors_table: Dict[str, VaultAccessor] = {}
         self.deployments_table: Dict[str, s.Deployment] = {}
+        self.namespaces_table: Dict[str, s.Namespace] = {}
         self._indexes: Dict[str, int] = {}
+        # Per-namespace usage fold (tenancy plane): immutable 5-tuples
+        # (cpu, mem_mb, disk_mb, iops, live_allocs) maintained at the
+        # SAME three sites that feed the usage-delta log, so the fold is
+        # O(changed) per write, never a table walk.  _ns_dirty is the
+        # change feed the broker's fair-dequeue scorer drains (only
+        # touched tenants get re-scored).  Rebuilt from alloc rows on
+        # restore (the fold, like the delta log, is not persisted).
+        self._ns_usage: Dict[str, Tuple[int, int, int, int, int]] = {}
+        self._ns_dirty: Set[str] = set()
         # Secondary indexes (reference: schema.go secondary memdb indexes)
         self._allocs_by_node: Dict[str, Set[str]] = defaultdict(set)
         self._allocs_by_job: Dict[str, Set[str]] = defaultdict(set)
@@ -221,6 +232,12 @@ class StateStore:
             snap.periodic_launch_table = dict(self.periodic_launch_table)
             snap.vault_accessors_table = dict(self.vault_accessors_table)
             snap.deployments_table = dict(self.deployments_table)
+            snap.namespaces_table = dict(self.namespaces_table)
+            # Per-ns usage: values are immutable tuples, shallow copy is
+            # a full fork; a snapshot's hypothetical writes never dirty
+            # the parent's change feed.
+            snap._ns_usage = dict(self._ns_usage)
+            snap._ns_dirty = set(self._ns_dirty)
             snap._indexes = dict(self._indexes)
             # Secondary-index SETS are immutable by contract (mutators go
             # through _idx_add/_idx_discard which REPLACE the set), so a
@@ -724,7 +741,8 @@ class StateStore:
         if eb is not None:
             eb.publish_one(s.TOPIC_JOB, "JobRegistered", job.id, index,
                            {"Type": job.type, "Status": job.status,
-                            "Version": job.version, "Stop": job.stop})
+                            "Version": job.version, "Stop": job.stop,
+                            "Namespace": job.namespace})
         self._notify()
 
     def _upsert_job_version(self, index: int, job: s.Job) -> None:
@@ -899,7 +917,8 @@ class StateStore:
             eb.publish([eb.make_event(
                 s.TOPIC_EVAL, "EvalUpdated", ev.id, index,
                 {"Status": ev.status, "JobID": ev.job_id,
-                 "TriggeredBy": ev.triggered_by, "NodeID": ev.node_id},
+                 "TriggeredBy": ev.triggered_by, "NodeID": ev.node_id,
+                 "Namespace": ev.namespace},
                 eval_id=ev.id) for ev in evals])
         self._notify()
 
@@ -1071,7 +1090,8 @@ class StateStore:
                     {"JobID": alloc.job_id, "NodeID": alloc.node_id,
                      "TaskGroup": alloc.task_group,
                      "DesiredStatus": alloc.desired_status,
-                     "ClientStatus": alloc.client_status},
+                     "ClientStatus": alloc.client_status,
+                     "Namespace": alloc.namespace},
                     eval_id=plan_eval_id or alloc.eval_id))
             # Index only keys that actually changed: _idx_add's copy-on-
             # write set union is O(|index|), so the previously
@@ -1159,6 +1179,7 @@ class StateStore:
         if index and not row.terminal_status():
             c, m, d, i = self._usage_vec(row)
             self._log_usage(index, node_id, (-c, -m, -d, -i))
+            self._ns_fold(row.namespace, -c, -m, -d, -i, -1)
         self._idx_discard(self._allocs_by_node, node_id, alloc_id)
         self._idx_discard(self._allocs_by_job, job_id, alloc_id)
         self._idx_discard(self._allocs_by_eval, eval_id, alloc_id)
@@ -1346,6 +1367,13 @@ class StateStore:
         self._alloc_log_len += 1
         self._alloc_log_weight += len(slab.ids)
         self._log_trim()
+        # Tenant fold: one amortized update per slab, n identical live
+        # rows sharing the proto's usage vector.
+        proto = slab.proto
+        if not proto.terminal_status():
+            n = len(slab.ids)
+            c, m, d, i = self._usage_vec(proto)
+            self._ns_fold(proto.namespace, c * n, m * n, d * n, i * n, n)
 
     def _log_transition(self, index: int, existing: Optional[s.Allocation],
                         updated: s.Allocation) -> None:
@@ -1358,12 +1386,18 @@ class StateStore:
             self._log_usage(index, updated.node_id,
                             (nv[0] - ov[0], nv[1] - ov[1],
                              nv[2] - ov[2], nv[3] - ov[3]))
+            if nv != ov:
+                self._ns_fold(updated.namespace, nv[0] - ov[0],
+                              nv[1] - ov[1], nv[2] - ov[2], nv[3] - ov[3], 0)
             return
         if old_live:
             c, m, d, i = self._usage_vec(existing)
             self._log_usage(index, existing.node_id, (-c, -m, -d, -i))
+            self._ns_fold(existing.namespace, -c, -m, -d, -i, -1)
         if new_live:
-            self._log_usage(index, updated.node_id, self._usage_vec(updated))
+            v = self._usage_vec(updated)
+            self._log_usage(index, updated.node_id, v)
+            self._ns_fold(updated.namespace, v[0], v[1], v[2], v[3], 1)
 
     def allocs_since(self, index: int
                      ) -> Optional[List[Tuple[str, Tuple[int, int, int, int]]]]:
@@ -1519,6 +1553,101 @@ class StateStore:
                 self._bump("deployment", index)
         self._notify()
 
+    # -- namespaces (tenancy plane) -----------------------------------------
+
+    def upsert_namespace(self, index: int, ns: s.Namespace) -> None:
+        """Register/update a tenant (raft NAMESPACE_UPSERT apply)."""
+        with self._lock:
+            ns = ns.copy()
+            existing = self.namespaces_table.get(ns.name)
+            ns.create_index = (existing.create_index
+                               if existing is not None else index)
+            ns.modify_index = index
+            self.namespaces_table[ns.name] = ns
+            self._bump("namespaces", index)
+        eb = self.event_broker
+        if eb is not None:
+            eb.publish_one(s.TOPIC_NAMESPACE, "NamespaceUpserted", ns.name,
+                           index,
+                           {"Namespace": ns.name,
+                            "DequeueWeight": ns.dequeue_weight,
+                            "MaxLiveAllocs": ns.max_live_allocs,
+                            "MaxPendingEvals": ns.max_pending_evals})
+        self._notify()
+
+    def delete_namespace(self, index: int, name: str) -> None:
+        with self._lock:
+            if self.namespaces_table.pop(name, None) is not None:
+                self._bump("namespaces", index)
+        eb = self.event_broker
+        if eb is not None:
+            eb.publish_one(s.TOPIC_NAMESPACE, "NamespaceDeleted", name,
+                           index, {"Namespace": name})
+        self._notify()
+
+    def namespace_by_name(self, ws: Optional[WatchSet],
+                          name: str) -> Optional[s.Namespace]:
+        if ws is not None:
+            ws.add(self, "namespaces")
+        with self._lock:
+            return self.namespaces_table.get(name)
+
+    def namespaces(self, ws: Optional[WatchSet] = None) -> List[s.Namespace]:
+        if ws is not None:
+            ws.add(self, "namespaces")
+        with self._lock:
+            return list(self.namespaces_table.values())
+
+    def namespace_usage(self) -> Dict[str, Tuple[int, int, int, int, int]]:
+        """Per-tenant (cpu, mem_mb, disk_mb, iops, live_allocs) fold —
+        values are immutable tuples, the dict copy is a full fork."""
+        with self._lock:
+            return dict(self._ns_usage)
+
+    def namespace_usage_one(
+            self, name: str) -> Tuple[int, int, int, int, int]:
+        """One tenant's usage row without forking the whole dict — the
+        per-submit quota check's read."""
+        with self._lock:
+            return self._ns_usage.get(name or "default", (0, 0, 0, 0, 0))
+
+    def drain_ns_dirty(self) -> Set[str]:
+        """Namespaces whose usage changed since the last drain — the
+        O(changed) feed behind the broker's DRF re-score."""
+        with self._lock:
+            dirty = self._ns_dirty
+            self._ns_dirty = set()
+            return dirty
+
+    def _ns_fold(self, ns: str, dc: int, dm: int, dd: int, di: int,
+                 dn: int) -> None:
+        """Fold one alloc-write delta into the tenant's usage row.
+        Caller holds the lock."""
+        key = ns or "default"
+        cur = self._ns_usage.get(key)
+        if cur is None:
+            cur = (0, 0, 0, 0, 0)
+        self._ns_usage[key] = (cur[0] + dc, cur[1] + dm, cur[2] + dd,
+                               cur[3] + di, cur[4] + dn)
+        self._ns_dirty.add(key)
+
+    def _rebuild_ns_usage(self) -> None:
+        """Recompute the per-tenant fold from alloc rows (restore path —
+        the fold, like the usage-delta log, is not persisted)."""
+        usage: Dict[str, Tuple[int, int, int, int, int]] = {}
+        vec = self._usage_vec
+        for _nid, row in self.alloc_rows():
+            if row.terminal_status():
+                continue
+            c, m, d, i = vec(row)
+            key = row.namespace or "default"
+            cur = usage.get(key, (0, 0, 0, 0, 0))
+            usage[key] = (cur[0] + c, cur[1] + m, cur[2] + d,
+                          cur[3] + i, cur[4] + 1)
+        with self._lock:
+            self._ns_usage = usage
+            self._ns_dirty = set(usage)
+
     def vault_accessors(self, ws: Optional[WatchSet]) -> List[VaultAccessor]:
         if ws is not None:
             ws.add(self, "vault_accessors")
@@ -1631,7 +1760,8 @@ class StateStore:
                 events.append(self.event_broker.make_event(
                     s.TOPIC_ALLOC, "AllocPlacedBulk", proto.job_id, index,
                     {"JobID": proto.job_id, "TaskGroup": proto.task_group,
-                     "Count": len(ids)}, eval_id=proto.eval_id))
+                     "Count": len(ids), "Namespace": proto.namespace},
+                    eval_id=proto.eval_id))
             self._update_summary_bulk(index, proto, len(ids))
             if proto.job is not None:
                 forced = ("" if proto.terminal_status()
@@ -1997,6 +2127,7 @@ class StateStore:
                 "periodic_launch": self.periodic_launch_table,
                 "vault_accessors": self.vault_accessors_table,
                 "deployments": self.deployments_table,
+                "namespaces": self.namespaces_table,
                 "indexes": self._indexes,
             }, subsystem="snapshot")
             allocs_blob = encode_payload({
@@ -2066,6 +2197,7 @@ class StateStore:
                 "periodic_launch": self.periodic_launch_table,
                 "vault_accessors": self.vault_accessors_table,
                 "deployments": self.deployments_table,
+                "namespaces": self.namespaces_table,
                 "indexes": self._indexes,
             }
             # Whitelisted msgpack trees (server/log_codec), never pickle:
@@ -2103,6 +2235,10 @@ class StateStore:
         store.periodic_launch_table = payload["periodic_launch"]
         store.vault_accessors_table = payload["vault_accessors"]
         store.deployments_table = payload.get("deployments", {})
+        # Pre-tenancy snapshots carry no namespaces table (.get: both
+        # formats restore across versions; jobs/evals/allocs inside them
+        # decode with namespace="default" via the dataclass default).
+        store.namespaces_table = payload.get("namespaces", {})
         store._indexes = payload["indexes"]
         for ev in store.evals_table.values():
             store._evals_by_job[ev.job_id].add(ev.id)
@@ -2117,6 +2253,7 @@ class StateStore:
         # an empty log with the floor at the restored allocs index, so
         # any resident consumer from before the restore full re-encodes.
         store._alloc_log_floor = store._indexes.get("allocs", 0)
+        store._rebuild_ns_usage()
         return store
 
     @classmethod
@@ -2141,6 +2278,7 @@ class StateStore:
         store.periodic_launch_table = t["periodic_launch"]
         store.vault_accessors_table = t["vault_accessors"]
         store.deployments_table = t["deployments"]
+        store.namespaces_table = t.get("namespaces", {})
         store._indexes = t["indexes"]
 
         # -- nodes: SoA -> objects without dataclass __init__ ----------
@@ -2254,6 +2392,7 @@ class StateStore:
                 doc["columns"], ids, cm["dc"], cm["class"],
                 cm["usage_index"])
         store._alloc_log_floor = store._indexes.get("allocs", 0)
+        store._rebuild_ns_usage()
         return store
 
 

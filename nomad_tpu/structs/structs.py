@@ -650,6 +650,7 @@ class Job:
     """A declarative workload specification (structs.go:1189-1560)."""
 
     region: str = "global"
+    namespace: str = "default"
     id: str = ""
     parent_id: str = ""
     name: str = ""
@@ -772,6 +773,8 @@ class Job:
             self.name = self.id
         if not self.region:
             self.region = "global"
+        if not self.namespace:
+            self.namespace = DEFAULT_NAMESPACE
         if not self.datacenters:
             self.datacenters = ["dc1"]
         for tg in self.task_groups:
@@ -935,6 +938,7 @@ class Allocation:
     """A placed task group on a node (structs.go:3820-4070)."""
 
     id: str = ""
+    namespace: str = "default"
     eval_id: str = ""
     name: str = ""
     node_id: str = ""
@@ -1038,6 +1042,7 @@ class Evaluation:
     """A scheduling work item: 'job X needs reconciling' (structs.go:4244-4475)."""
 
     id: str = ""
+    namespace: str = "default"
     priority: int = JOB_DEFAULT_PRIORITY
     type: str = JOB_TYPE_SERVICE
     triggered_by: str = ""
@@ -1106,6 +1111,7 @@ class Evaluation:
         """Follow-up eval for a rolling update (structs.go:4440)."""
         return Evaluation(
             id=generate_uuid(),
+            namespace=self.namespace,
             priority=self.priority,
             type=self.type,
             triggered_by=EVAL_TRIGGER_ROLLING_UPDATE,
@@ -1122,6 +1128,7 @@ class Evaluation:
         (structs.go:4494 CreateBlockedEval)."""
         return Evaluation(
             id=generate_uuid(),
+            namespace=self.namespace,
             priority=self.priority,
             type=self.type,
             triggered_by=self.triggered_by,
@@ -1137,6 +1144,7 @@ class Evaluation:
         """Follow-up after hitting the delivery limit (structs.go:4460)."""
         return Evaluation(
             id=generate_uuid(),
+            namespace=self.namespace,
             priority=self.priority,
             type=self.type,
             triggered_by="failed-follow-up",
@@ -1242,6 +1250,76 @@ class DeploymentStatusUpdate:
     deployment_id: str = ""
     status: str = ""
     status_description: str = ""
+
+
+# ---------------------------------------------------------------------------
+# Namespace (multi-tenant serving plane)
+# ---------------------------------------------------------------------------
+
+#: The implicit tenant every pre-tenancy job/eval/alloc belongs to.
+#: Wire frames and snapshots written before the field existed decode to
+#: this via the dataclass default, so mixed-version clusters agree.
+DEFAULT_NAMESPACE = "default"
+
+#: Per-namespace fairness objectives for the broker's tenant dequeue
+#: (Gavel-style pluggable policy; "" on a Namespace inherits the
+#: cluster-wide NOMAD_TPU_TENANCY_OBJECTIVE knob).
+TENANCY_OBJECTIVE_DRF = "drf"
+TENANCY_OBJECTIVE_WRR = "weighted-rr"
+TENANCY_OBJECTIVE_FIFO = "fifo"
+TENANCY_OBJECTIVES = (TENANCY_OBJECTIVE_DRF, TENANCY_OBJECTIVE_WRR,
+                      TENANCY_OBJECTIVE_FIFO)
+
+
+@dataclass
+class Namespace:
+    """A tenant: quota + fairness configuration, registered through raft
+    like jobs and persisted in both snapshot formats.  All quota fields
+    use 0 = unlimited so the implicit "default" namespace (and any
+    namespace created with bare defaults) never throttles anything —
+    pre-tenancy behavior is the zero value."""
+
+    name: str = ""
+    description: str = ""
+    #: Max nodes-worth of dominant-resource usage (fractional ok):
+    #: a tenant whose dominant share exceeds quota_node_units/cluster
+    #: nodes is over quota for admission purposes.
+    quota_node_units: float = 0.0
+    #: Max live (non-terminal) allocations in committed state.
+    max_live_allocs: int = 0
+    #: Max evals pending in the broker (admission front door).
+    max_pending_evals: int = 0
+    #: Token-bucket API submit rate (requests/second) in agent/http.
+    api_rate: float = 0.0
+    #: Bucket depth; 0 derives a burst of max(1, 2*api_rate).
+    api_burst: int = 0
+    #: Fair-dequeue weight: a weight-2 tenant is charged half as much
+    #: virtual time / dominant share as a weight-1 tenant.
+    dequeue_weight: float = 1.0
+    #: Per-tenant fairness objective override ("" inherits the global
+    #: knob): drf | weighted-rr | fifo.
+    objective: str = ""
+    create_index: int = 0
+    modify_index: int = 0
+
+    def copy(self) -> "Namespace":
+        return _fast_copy(self)
+
+    def validate(self) -> List[str]:
+        problems: List[str] = []
+        if not self.name:
+            problems.append("namespace name is empty")
+        if self.dequeue_weight <= 0:
+            problems.append("namespace dequeue_weight must be positive")
+        if self.objective and self.objective not in TENANCY_OBJECTIVES:
+            problems.append(
+                f"namespace objective '{self.objective}' is invalid "
+                f"(want one of {', '.join(TENANCY_OBJECTIVES)})")
+        if (self.quota_node_units < 0 or self.max_live_allocs < 0
+                or self.max_pending_evals < 0 or self.api_rate < 0
+                or self.api_burst < 0):
+            problems.append("namespace quota fields must be >= 0")
+        return problems
 
 
 class _LazyStrs:
@@ -1635,9 +1713,11 @@ TOPIC_DEPLOYMENT = "Deployment"
 TOPIC_PLAN = "Plan"
 TOPIC_BREAKER = "Breaker"
 TOPIC_FAULT = "Fault"
+TOPIC_NAMESPACE = "Namespace"
 
 EVENT_TOPICS = (TOPIC_NODE, TOPIC_JOB, TOPIC_EVAL, TOPIC_ALLOC,
-                TOPIC_DEPLOYMENT, TOPIC_PLAN, TOPIC_BREAKER, TOPIC_FAULT)
+                TOPIC_DEPLOYMENT, TOPIC_PLAN, TOPIC_BREAKER, TOPIC_FAULT,
+                TOPIC_NAMESPACE)
 
 
 @dataclass

@@ -1,0 +1,22 @@
+"""Multi-tenant serving plane (ROADMAP item 3).
+
+Namespaces are registered through raft like jobs (structs.Namespace,
+MessageType.NAMESPACE_UPSERT) and enforced at three host-side choke
+points, none of which touch the device path:
+
+- ``quota.QuotaLedger``   — admission-time alloc-count quota (checked
+  BEFORE the raft write; rejections ride the existing BrokerLimitError
+  429 + Retry-After machinery).
+- ``quota.RateLimiter``   — per-tenant token-bucket API rate limit in
+  agent/http.
+- ``fairness.TenantQueue`` — weighted fair dequeue in the eval broker:
+  per-tenant subqueues drained by dominant-resource fairness (Gavel,
+  arxiv 2008.09213), O(log tenants) per dequeue, priority tiers and
+  the preemption plane composing unchanged above it.
+"""
+
+from .fairness import FairnessState, TenantQueue
+from .quota import QuotaLedger, RateLimiter, TokenBucket
+
+__all__ = ["FairnessState", "TenantQueue", "QuotaLedger", "RateLimiter",
+           "TokenBucket"]

@@ -232,6 +232,15 @@ _knob("NOMAD_TPU_LOCKCHECK", "bool", False,
       "instrumented locks record acquisition order, teardown asserts "
       "acyclicity and prints the witness cycle")
 
+# -- multi-tenant serving plane ---------------------------------------------
+_knob("NOMAD_TPU_TENANCY_OBJECTIVE", "str", "drf",
+      "Cluster-wide default fair-dequeue objective "
+      "(drf | weighted-rr | fifo); a Namespace row's objective field "
+      "overrides per tenant")
+_knob("NOMAD_TPU_TENANCY_METRICS_TOP", "int", 10,
+      "How many busiest tenants get per-tenant tenant.* gauges each "
+      "metrics tick (0 disables)")
+
 # -- loadgen / bench --------------------------------------------------------
 _knob("NOMAD_TPU_SWITCH_INTERVAL", "float", None,
       "sys.setswitchinterval override applied for loadgen "
