@@ -1192,6 +1192,61 @@ def bench_multi_tenant():
     return out
 
 
+def bench_multi_region():
+    """config_federation: the region-federation gate (ISSUE 17) — the
+    ``multi_region`` scenario drives two WAN-joined single-voter regions
+    with region-homed clients, a 25% cross-region submit mix, and a full
+    region blackout + heal mid-run.  ``--check`` hard-gates the
+    partition contract: no job ever double-places across regions, no
+    acked eval is lost, the blacked-out region recovers (a cross-region
+    probe registers AND places) within the bound after heal, and a down
+    region degrades to typed retryable NoPathToRegion NACKs — the run
+    must see some (the blackout overlapped live traffic) yet drop
+    nothing (the retry_after hint made them survivable)."""
+    from nomad_tpu.loadgen.federation import run_multi_region
+    from nomad_tpu.loadgen.scenario import get_scenario
+
+    rep = run_multi_region(get_scenario("multi_region"))
+    fed = rep.get("federation") or {}
+    aud = rep.get("auditor") or {}
+    final = aud.get("final_sweep") or {}
+    bo = fed.get("blackout") or {}
+    tax = fed.get("forward_tax_ms") or {}
+    out = {
+        "regions": len(fed.get("regions") or []),
+        "cross_submitted": fed.get("cross_submitted", 0),
+        "cross_completed": fed.get("cross_completed", 0),
+        "forward_tax_p99_ms": (tax.get("cross") or {}).get("p99"),
+        "local_submit_p99_ms": (tax.get("local") or {}).get("p99"),
+        "no_path_events": rep["offered"]["no_path_events"],
+        "no_path_drops": rep["offered"]["no_path_drops"],
+        "dropped": rep["offered"]["dropped_after_retries"],
+        "cross_region_double_placed": final.get(
+            "cross_region_double_placed", 0),
+        "violations": aud.get("violation_count", 0),
+        "violation_kinds": sorted({v["kind"] for v
+                                   in aud.get("violations") or []}),
+        "lost_acked": aud.get("lost_acked", 0),
+        "blackout_recovered": bool(bo.get("recovered")),
+        "blackout_recovery_s": bo.get("placed_after_heal_s"),
+        "recovery_bound_s": bo.get("recovery_bound_s"),
+        "aggregator_events": (fed.get("aggregator") or {}).get("Events", 0),
+        "aggregator_dark_skips": (fed.get("aggregator") or {}).get(
+            "Unreachable", 0),
+        "stragglers": rep["sustained"]["stragglers_after_drain"],
+        "evals_per_s": rep["sustained"]["evals_per_s"],
+    }
+    log(f"  multi-region: {out['regions']} regions, "
+        f"{out['cross_submitted']} cross submits "
+        f"(tax p99 {out['forward_tax_p99_ms']}ms vs local "
+        f"{out['local_submit_p99_ms']}ms), "
+        f"{out['no_path_events']} NoPath NACKs "
+        f"({out['no_path_drops']} gave up), blackout "
+        f"{'recovered in ' + str(out['blackout_recovery_s']) + 's' if out['blackout_recovered'] else 'NOT RECOVERED'}, "
+        f"{out['violations']} violations, {out['lost_acked']} lost acked")
+    return out
+
+
 def run_config(n_nodes: int, n_jobs: int, count_per_job: int, label: str,
                constrained: bool = False, trials: int = 3,
                keep_state: bool = False, n_dcs: int = 1):
@@ -2781,6 +2836,45 @@ def _check_main(argv) -> int:
     except Exception as exc:
         out["multi_tenant"] = {"error": repr(exc)}
         failures.append(f"multi-tenant phase failed: {exc!r}")
+
+    # Region-federation gate (ISSUE 17): all absolute — partition
+    # tolerance either held across the blackout + heal or it did not.
+    try:
+        with _deadline(300, "check_multi_region"):
+            mr = bench_multi_region()
+        out["multi_region"] = mr
+        if mr["cross_region_double_placed"]:
+            failures.append(
+                f"multi-region final sweep found "
+                f"{mr['cross_region_double_placed']} job(s) with live "
+                "allocs in more than one region — a job must only ever "
+                "place in its owning region")
+        if mr["violations"]:
+            failures.append(
+                f"multi-region run recorded {mr['violations']} federated "
+                f"auditor violations ({', '.join(mr['violation_kinds'])})")
+        if mr["lost_acked"]:
+            failures.append(
+                f"multi-region run lost {mr['lost_acked']} acked evals — "
+                "completion signaled to a client must survive partitions")
+        if not mr["blackout_recovered"]:
+            failures.append(
+                "multi-region blackout did not recover: a cross-region "
+                "probe must register AND place in the healed region "
+                f"within the {mr['recovery_bound_s']}s bound")
+        if not mr["no_path_events"]:
+            failures.append(
+                "multi-region run saw no NoPathToRegion NACKs — the "
+                "blackout never intersected cross-region traffic, so the "
+                "degraded-mode path went unexercised")
+        if mr["dropped"] or mr["stragglers"]:
+            failures.append(
+                f"multi-region run dropped {mr['dropped']} submissions "
+                f"and left {mr['stragglers']} stragglers — a down region "
+                "must degrade to retryable errors, not lost work")
+    except Exception as exc:
+        out["multi_region"] = {"error": repr(exc)}
+        failures.append(f"multi-region phase failed: {exc!r}")
 
     # FSM snapshot+restore guard (ISSUE 9): the columnar persist+restore
     # wall time must not regress past threshold x baseline.  Measured

@@ -25,6 +25,7 @@ from urllib.parse import parse_qs, urlparse
 from ..api.codec import from_wire, to_wire
 from ..jobspec.parse import parse_duration
 from ..server.eval_broker import BrokerLimitError
+from ..server.rpc import NoPathToRegion
 from ..state.state_store import WatchSet
 from ..structs import structs as s
 
@@ -164,6 +165,14 @@ class HTTPServer:
                 # Admission NACK: 429 + Retry-After so well-behaved
                 # clients back off (jittered client-side) instead of
                 # retrying into the saturated broker.
+                self._reply_error(req, 429, str(e),
+                                  {"Retry-After": f"{e.retry_after:.2f}"})
+                return
+            except NoPathToRegion as e:
+                # Federation degradation contract: a down region is a
+                # retryable 429 with a Retry-After hint, never a hang or
+                # an opaque 500 — callers can distinguish "region
+                # unreachable" from "no leader" by the typed body.
                 self._reply_error(req, 429, str(e),
                                   {"Retry-After": f"{e.retry_after:.2f}"})
                 return
@@ -478,7 +487,16 @@ class HTTPServer:
     # ------------------------------------------------------------------
 
     def namespaces_request(self, req, query):
+        # Namespaces are region-scoped: ?region= routes reads and writes
+        # over the federation like jobs (each region's raft owns its
+        # tenant rows and enforces their quotas locally).
+        region = query.get("region", "")
         if req.command == "GET":
+            if region and region != self.agent.config.region:
+                rows = self.server.namespace_list(region=region)
+                return ([to_wire(n) for n in
+                         sorted(rows, key=lambda n: n.name)], None)
+
             def run(ws):
                 state = self.server.state
                 rows = state.namespaces(ws)
@@ -491,17 +509,19 @@ class HTTPServer:
             if payload is None or "Namespace" not in payload:
                 raise CodedError(400, "JSON body with Namespace required")
             ns = from_wire(s.Namespace, payload["Namespace"])
-            index = self.server.namespace_upsert(ns)
+            index = self.server.namespace_upsert(ns, region=region)
             return {"Index": index}, index
         raise CodedError(405, "Invalid method")
 
     def namespace_specific_request(self, req, query, name: str):
+        region = query.get("region", "")
         if req.command == "GET":
             try:
-                status = self.server.namespace_status(name)
+                status = self.server.namespace_status(name, region=region)
             except KeyError as e:
                 raise CodedError(404, str(e))
-            status["Namespace"] = to_wire(status["Namespace"])
+            if not isinstance(status["Namespace"], dict):
+                status["Namespace"] = to_wire(status["Namespace"])
             return status, self.server.state.table_index("namespaces")
         if req.command in ("PUT", "POST"):
             payload = self._body(req)
@@ -510,11 +530,11 @@ class HTTPServer:
             ns = from_wire(s.Namespace, payload["Namespace"])
             if ns.name != name:
                 raise CodedError(400, "Namespace name does not match URL")
-            index = self.server.namespace_upsert(ns)
+            index = self.server.namespace_upsert(ns, region=region)
             return {"Index": index}, index
         if req.command == "DELETE":
             try:
-                index = self.server.namespace_delete(name)
+                index = self.server.namespace_delete(name, region=region)
             except KeyError as e:
                 raise CodedError(404, str(e))
             return {"Index": index}, index
@@ -1056,8 +1076,17 @@ class HTTPServer:
                 "Error": "; ".join(problems) if problems else ""}, None
 
     def regions_request(self, req, query):
+        """Plain region-name list by default (the reference's
+        /v1/regions shape); ``?detail`` adds server count + leader
+        address per region."""
+        detail = query.get("detail") not in (None, "", "0", "false")
         if self.agent.server is not None:
+            if detail:
+                return self.agent.server.region_info(), None
             return self.agent.server.regions(), None
+        if detail:
+            return [{"Name": self.agent.config.region, "Servers": 0,
+                     "Leader": ""}], None
         return [self.agent.config.region], None
 
     def status_leader_request(self, req, query):

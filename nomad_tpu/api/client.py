@@ -68,6 +68,7 @@ class NomadAPI:
         self.status = Status(self)
         self.events = Events(self)
         self.namespaces = Namespaces(self)
+        self.regions = Regions(self)
 
     # -- raw transport -----------------------------------------------------
 
@@ -476,6 +477,26 @@ class Namespaces:
 
     def deregister(self, name: str) -> Tuple[dict, QueryMeta]:
         return self.c.delete(f"/v1/namespace/{name}")
+
+
+class Regions:
+    """Federation handle: /v1/regions (api/regions.go)."""
+
+    def __init__(self, c: NomadAPI):
+        self.c = c
+
+    def names(self) -> List[str]:
+        """Plain sorted region-name list (api/regions.go List)."""
+        obj, _ = self.c.get("/v1/regions")
+        return obj or []
+
+    def list(self) -> List[dict]:
+        """Detail rows: [{"Name", "Servers", "Leader"}, ...] — region
+        name, alive server count, best-known leader address ("" when
+        that region is currently unreachable)."""
+        obj, _ = self.c.get("/v1/regions",
+                            QueryOptions(params={"detail": "1"}))
+        return obj or []
 
 
 class System:

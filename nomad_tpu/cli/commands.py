@@ -776,6 +776,23 @@ def cmd_server_members(args, out) -> int:
     return 0
 
 
+def cmd_regions(args, out) -> int:
+    """Federated-region inventory (reference: command/regions.go, plus
+    the detail columns our /v1/regions?detail surface adds): region
+    name, alive server count, best-known leader address."""
+    api = _api(args)
+    rows_in = api.regions.list()
+    if getattr(args, "json", False):
+        out.write(json.dumps(rows_in, indent=4, sort_keys=True) + "\n")
+        return 0
+    rows = ["Name|Servers|Leader"]
+    for r in rows_in:
+        rows.append(f"{r.get('Name', '')}|{r.get('Servers', 0)}|"
+                    f"{r.get('Leader', '') or '(none)'}")
+    out.write(format_list(rows) + "\n")
+    return 0
+
+
 def cmd_agent_info(args, out) -> int:
     """command/agent_info.go."""
     api = _api(args)
@@ -1121,6 +1138,8 @@ def build_parser() -> argparse.ArgumentParser:
     add("server-members", cmd_server_members, lambda sp: (
         sp.add_argument("-detailed", action="store_true"),
         sp.add_argument("-json", dest="json", action="store_true")))
+    add("regions", cmd_regions, lambda sp:
+        sp.add_argument("-json", dest="json", action="store_true"))
     add("server-join", cmd_server_join, lambda sp: sp.add_argument(
         "addresses", nargs="+"))
     add("server-force-leave", cmd_server_force_leave, lambda sp:

@@ -92,6 +92,7 @@ __all__ = [
     "arm", "disarm", "armed", "faultpoint", "scenario", "trace",
     "NetPlane", "NetRule", "net", "net_arm", "net_disarm", "net_armed",
     "netpoint", "net_partition", "net_heal", "flap_windows",
+    "net_sever_regions", "net_dcn_delay",
 ]
 
 ACTIONS = ("drop", "delay", "dup", "truncate", "error", "crash",
@@ -450,6 +451,16 @@ class NetPlane:
             return (rule.action, delay)
         return None
 
+    def add_rules(self, specs: List[Dict[str, Any]]) -> None:
+        """Append loss/delay rules to an armed plane (region-federation
+        DCN shaping composes with partitions armed earlier).  Indexes
+        continue from the existing rules so every rule keeps a private,
+        seed-deterministic RNG stream."""
+        with self._l:
+            base = len(self.rules)
+            self.rules.extend(NetRule(r, base + i, self.seed)
+                              for i, r in enumerate(specs))
+
     def trace(self) -> List[Tuple[str, str, str]]:
         with self._l:
             return list(self._trace)
@@ -505,6 +516,50 @@ def net_heal(name: Optional[str] = None) -> None:
     plane = _NET
     if plane is not None:
         plane.heal(name)
+
+
+def net_sever_regions(region_addrs: Dict[str, List[str]],
+                      isolate: Optional[str] = None,
+                      name: str = "region-sever",
+                      windows: Optional[List[Tuple[float, float]]] = None,
+                      ) -> NetPlane:
+    """Region-severing partition groups over the DCN (ISSUE 17).
+
+    ``region_addrs`` maps region name → that region's server addresses.
+    Default: one partition group per region, severing ALL inter-region
+    traffic while leaving intra-region (ICI) traffic — and identity-less
+    client pools, which match no literal group — untouched.  With
+    ``isolate=<region>``, that one region is blacked out from everything
+    else (its addresses in one group, ``"*"`` in the other), modeling a
+    full region blackout including its clients.  Pass ``windows`` (e.g.
+    :func:`flap_windows`) for a deterministic DCN flap schedule; heal
+    with ``net_heal(name)``."""
+    if isolate is not None:
+        if isolate not in region_addrs:
+            raise ValueError(f"unknown region {isolate!r}")
+        groups = [list(region_addrs[isolate]), ["*"]]
+    else:
+        groups = [list(addrs) for _, addrs in sorted(region_addrs.items())]
+    return net_partition(name, groups, windows=windows)
+
+
+def net_dcn_delay(region_addrs: Dict[str, List[str]], delay: float = 0.02,
+                  prob: float = 1.0, kind: str = "send") -> NetPlane:
+    """Deterministic DCN latency: one ``delay`` rule per cross-region
+    (src, dst) server pair, leaving intra-region traffic at ICI speed.
+    Composes with :func:`net_sever_regions` on the same plane."""
+    specs: List[Dict[str, Any]] = []
+    regions = sorted(region_addrs.items())
+    for r_src, srcs in regions:
+        for r_dst, dsts in regions:
+            if r_src == r_dst:
+                continue
+            specs.extend({"kind": kind, "src": s, "dst": d,
+                          "action": "delay", "prob": prob, "delay": delay}
+                         for s in srcs for d in dsts)
+    plane = net()
+    plane.add_rules(specs)
+    return plane
 
 
 def flap_windows(seed: int, count: int = 4, period: float = 2.0,

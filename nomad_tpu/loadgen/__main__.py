@@ -184,6 +184,10 @@ def main(argv=None) -> int:
         from .harness import compare_servers
 
         report = compare_servers(sc)
+    elif sc.num_regions > 1:
+        from .federation import run_multi_region
+
+        report = run_multi_region(sc)
     else:
         report = run_scenario(sc)
 

@@ -137,6 +137,23 @@ class Scenario:
     tenant_max_pending_evals: int = 0
     tenant_dequeue_weight: float = 1.0
     tenant_objective: str = ""    # "" inherits NOMAD_TPU_TENANCY_OBJECTIVE
+    # Region federation (ISSUE 17).  num_regions > 1 arms the federated
+    # harness (loadgen/federation.py): that many in-process single-voter
+    # regions WAN-joined into one federation, clients spread round-robin
+    # across home regions, and ``cross_region_fraction`` of submissions
+    # targeting a FOREIGN region (the rpc.go:263 forwardRegion path —
+    # each one's wall time feeds the cross-region forward-tax
+    # percentiles).  ``num_nodes`` is the TOTAL fleet, split evenly.
+    num_regions: int = 1
+    cross_region_fraction: float = 0.0
+    # Full region blackout + heal leg.  Keys (all optional):
+    #   region            — blacked-out region name (default: the last)
+    #   at_s              — offset into the run (default 4.0)
+    #   duration_s        — how long the region stays dark (default 3.0)
+    #   recovery_bound_s  — after heal, a cross-region probe into the
+    #                       region must register AND place within this
+    #                       bound or the run reports unrecovered (30.0)
+    region_blackout: Optional[Dict] = None
     # Determinism.
     seed: int = 42
 
@@ -327,10 +344,38 @@ MULTI_TENANT = Scenario(
     abusive_share=0.5, tenant_max_pending_evals=32,
     tenant_max_live_allocs=800, seed=16)
 
+#: Region federation gate (ISSUE 17): two single-voter regions
+#: WAN-joined, clients split across home regions, a quarter of all
+#: submissions targeting the OTHER region (the measured cross-region
+#: forward tax), and a full blackout of one region mid-run.  The
+#: partition-tolerance contract under test: cross-region submissions
+#: into the dark region degrade to typed retryable NoPathToRegion
+#: errors (never a hang, never a lost acked eval), the dark region
+#: keeps serving its OWN clients throughout, and after heal a probe
+#: submission registers and places inside the recovery bound.  The
+#: federated auditor sweeps continuously: no job may ever hold live
+#: allocs in two regions, and each region's own integrity + FSM-digest
+#: invariants hold through partition and heal.
+MULTI_REGION = Scenario(
+    name="multi_region",
+    num_nodes=80, node_cpu=64_000, node_memory_mb=262_144,
+    num_clients=4, arrival_rate=40.0, max_submissions=480,
+    job_mix=[JobShape(weight=3, count=1, cpu=100, memory_mb=128,
+                      priority=50),
+             JobShape(weight=1, count=2, cpu=200, memory_mb=256,
+                      priority=60)],
+    warmup_s=1.0, measure_s=12.0, drain_s=45.0,
+    subscribers=0, min_heartbeat_ttl=30.0, num_workers=1,
+    submit_retries=6, audit=True,
+    num_regions=2, cross_region_fraction=0.25,
+    region_blackout={"at_s": 4.0, "duration_s": 3.0,
+                     "recovery_bound_s": 30.0},
+    seed=17)
+
 BUILTIN_SCENARIOS: Dict[str, Scenario] = {
     sc.name: sc for sc in (SMOKE, BASELINE, OVERLOAD_10X, FANOUT_10K,
                            MULTI_SERVER, CHAOS_SOAK, CHAOS_SMOKE,
-                           MULTI_TENANT)}
+                           MULTI_TENANT, MULTI_REGION)}
 
 
 def get_scenario(name: str) -> Scenario:

@@ -269,17 +269,21 @@ def register_endpoints(server, rpc) -> None:
 
     def namespace_upsert(body):
         ns = ensure(s.Namespace, body["Namespace"])
-        return {"Index": server.namespace_upsert(ns)}
+        return {"Index": server.namespace_upsert(
+            ns, region=body.get("Region", ""))}
 
     def namespace_delete(body):
-        return {"Index": server.namespace_delete(body["Name"])}
+        return {"Index": server.namespace_delete(
+            body["Name"], region=body.get("Region", ""))}
 
     def namespace_list(body):
-        return {"Namespaces": server.namespace_list(),
-                "Index": server.state.table_index("namespaces")}
+        return {"Namespaces": server.namespace_list(
+            region=body.get("Region", "")),
+            "Index": server.state.table_index("namespaces")}
 
     def namespace_status(body):
-        return server.namespace_status(body["Name"])
+        return server.namespace_status(
+            body["Name"], region=body.get("Region", ""))
 
     register("Namespace.Upsert", namespace_upsert)
     register("Namespace.Delete", namespace_delete)
@@ -414,7 +418,10 @@ def register_endpoints(server, rpc) -> None:
     # -- Region / Operator -------------------------------------------------
 
     def region_list(body):
-        return {"Regions": server.regions()}
+        reply = {"Regions": server.regions()}
+        if body.get("Detail"):
+            reply["Detail"] = server.region_info()
+        return reply
 
     def operator_raft_config(body):
         return server.raft_configuration()

@@ -64,6 +64,41 @@ class NoLeaderError(RPCError):
     pass
 
 
+class NoPathToRegion(RPCError):
+    """Cross-region forwarding exhausted its bounded dial rounds: every
+    known server of the target region was unreachable at DIAL time (so
+    nothing was ever sent and nothing can have double-applied).  Typed
+    so callers can tell "region unreachable" from "no leader": it
+    carries the target ``region`` and a ``retry_after`` hint, the HTTP
+    layer maps it to 429 + Retry-After, and the RPC layer re-types it
+    from the wire error string — a down region degrades to a retryable
+    error, never a hang."""
+
+    def __init__(self, region: str, retry_after: float, rounds: int = 0,
+                 detail: str = ""):
+        self.region = region
+        self.retry_after = retry_after
+        self.rounds = rounds
+        super().__init__(
+            f"no path to region '{region}' after {rounds} dial rounds"
+            + (f" ({detail})" if detail else "")
+            + f"; retry_after={retry_after:.2f}")
+
+    @staticmethod
+    def from_message(msg: str) -> "NoPathToRegion":
+        """Rebuild from the wire error string (the server encodes
+        errors as '<TypeName>: <message>')."""
+        import re
+
+        m = re.search(r"region '([^']*)'", msg)
+        region = m.group(1) if m else ""
+        m = re.search(r"retry_after=([0-9.]+)", msg)
+        retry = float(m.group(1)) if m else 1.0
+        m = re.search(r"after (\d+) dial rounds", msg)
+        rounds = int(m.group(1)) if m else 0
+        return NoPathToRegion(region, retry, rounds=rounds)
+
+
 # ---------------------------------------------------------------------------
 # framing
 # ---------------------------------------------------------------------------
@@ -447,6 +482,11 @@ class _Conn:
                 from .eval_broker import BrokerLimitError
 
                 raise BrokerLimitError.from_message(err)
+            if isinstance(err, str) and err.startswith("NoPathToRegion"):
+                # A remote server's cross-region forward exhausted its
+                # dial rounds — re-type so the caller sees the target
+                # region and retry_after hint rather than a bare string.
+                raise NoPathToRegion.from_message(err)
             raise RPCError(err)
         return reply
 

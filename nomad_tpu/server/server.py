@@ -7,6 +7,7 @@ nomad/*_endpoint.go).
 from __future__ import annotations
 
 import logging
+import math
 import os
 import sys
 import threading
@@ -114,6 +115,29 @@ class ServerConfig:
     tls: Optional[TLSConfig] = None
 
 
+def _job_usage_vec(job: s.Job) -> Tuple[int, int, int, int]:
+    """A job's total resource ask on the alloc_usage_vec basis
+    (cpu, memory_mb, disk_mb, iops): per-taskgroup task sums × count.
+    The node-units admission gate prices a submission with this before
+    any alloc exists to fold into the per-ns usage."""
+    cpu = mem = disk = iops = 0
+    for tg in job.task_groups:
+        c = m = d = i = 0
+        for task in tg.tasks:
+            r = task.resources
+            if r is None:
+                continue
+            c += r.cpu
+            m += r.memory_mb
+            d += r.disk_mb
+            i += r.iops
+        cpu += c * tg.count
+        mem += m * tg.count
+        disk += d * tg.count
+        iops += i * tg.count
+    return (cpu, mem, disk, iops)
+
+
 class Server:
     """A single control-plane server (nomad/server.go:78 Server)."""
 
@@ -154,10 +178,16 @@ class Server:
         # layer consults.  Both are policy mirrors of committed
         # Namespace rows, pushed through the FSM namespace hook.
         self.quota_ledger = QuotaLedger()
+        # Node-units reservation book (the quota_node_units field):
+        # same ledger mechanics with fractional counts — a tenant's
+        # dominant-resource share of the cluster, scaled to nodes-worth.
+        self.node_units_ledger = QuotaLedger()
         self.api_limiter = RateLimiter()
-        # Cluster capacity mirror for DRF dominant shares: recomputed by
-        # the metrics loop only when the nodes table index moves.
+        # Cluster capacity mirror for DRF dominant shares and node-units
+        # admission: recomputed only when the nodes table index moves.
         self._capacity_node_index = -1
+        self._cluster_capacity: Tuple[int, int, int, int] = (0, 0, 0, 0)
+        self._cluster_nodes = 0
         self.blocked_evals = BlockedEvals(self.eval_broker)
         self.plan_queue = PlanQueue()
         self.time_table = TimeTable()
@@ -730,7 +760,10 @@ class Server:
         for ns in self.state.namespaces(None):
             self._fsm_namespace_updated(ns.name, ns)
         entries = []
+        unit_entries = []
         seen = set()
+        self._refresh_capacity()
+        cap, nodes = self._cluster_capacity, self._cluster_nodes
         for ev in self.state.evals(None):
             if ev.terminal_status() or ev.job_id in seen:
                 continue
@@ -738,9 +771,15 @@ class Server:
             job = self.state.job_by_id(None, ev.job_id)
             if job is None:
                 continue
+            ns = job.namespace or "default"
             count = sum(tg.count for tg in job.task_groups)
-            entries.append((job.id, job.namespace or "default", count))
+            entries.append((job.id, ns, count))
+            if nodes > 0:
+                unit_entries.append(
+                    (job.id, ns,
+                     self._node_units(_job_usage_vec(job), cap, nodes)))
         self.quota_ledger.rebuild(entries)
+        self.node_units_ledger.rebuild(unit_entries)
         self.eval_broker.note_usage_changed(self.state.namespace_usage())
 
     def _restore_evals(self) -> None:
@@ -920,31 +959,61 @@ class Server:
             usage = self.state.namespace_usage()
             self.eval_broker.note_usage_changed(
                 {ns: usage.get(ns, (0, 0, 0, 0, 0)) for ns in dirty})
-        node_index = self.state.table_index("nodes")
-        if node_index != self._capacity_node_index:
-            self._capacity_node_index = node_index
-            cap = [0, 0, 0, 0]
-            for node in self.state.nodes(None):
-                if node.terminal_status():
-                    continue
-                res = node.resources
-                if res is None:
-                    continue
-                cap[0] += res.cpu
-                cap[1] += res.memory_mb
-                cap[2] += res.disk_mb
-                cap[3] += res.iops
-            self.eval_broker.set_cluster_capacity(tuple(cap))
+        self._refresh_capacity()
         if tenant_top <= 0:
             return
         counters = self.eval_broker.tenant_counters()
         busiest = sorted(counters.items(),
                          key=lambda kv: (-kv[1][0], kv[0]))[:tenant_top]
+        cap, nodes = self._cluster_capacity, self._cluster_nodes
         for ns, (pending, dequeued, shed, rejects) in busiest:
             self.metrics.set_gauge(f"tenant.pending.{ns}", pending)
             self.metrics.set_gauge(f"tenant.dequeued.{ns}", dequeued)
             self.metrics.set_gauge(f"tenant.shed.{ns}", shed)
             self.metrics.set_gauge(f"tenant.rejects.{ns}", rejects)
+            if nodes > 0:
+                self.metrics.set_gauge(
+                    f"tenant.node_units.{ns}",
+                    self._node_units(
+                        self.state.namespace_usage_one(ns)[:4], cap, nodes))
+
+    def _refresh_capacity(self) -> None:
+        """Keep the cluster-capacity mirror current: recompute the
+        4-vector total + non-terminal node count only when the nodes
+        table index moved (O(1) otherwise), and push it into the
+        broker's DRF scorer.  Shared by the metrics tick and the
+        node-units admission gate."""
+        node_index = self.state.table_index("nodes")
+        if node_index == self._capacity_node_index:
+            return
+        self._capacity_node_index = node_index
+        cap = [0, 0, 0, 0]
+        nodes = 0
+        for node in self.state.nodes(None):
+            if node.terminal_status():
+                continue
+            nodes += 1
+            res = node.resources
+            if res is None:
+                continue
+            cap[0] += res.cpu
+            cap[1] += res.memory_mb
+            cap[2] += res.disk_mb
+            cap[3] += res.iops
+        self._cluster_capacity = tuple(cap)
+        self._cluster_nodes = nodes
+        self.eval_broker.set_cluster_capacity(self._cluster_capacity)
+
+    @staticmethod
+    def _node_units(usage: Tuple[int, int, int, int],
+                    cap: Tuple[int, int, int, int], nodes: int) -> float:
+        """Nodes-worth of dominant-resource usage (the quota_node_units
+        basis, structs.Namespace): max over dimensions of usage/capacity,
+        scaled by the node count — 'this tenant occupies X nodes' even
+        when its footprint is spread thin across many."""
+        share = max((u / c) for u, c in zip(usage, cap) if c > 0) \
+            if any(cap) else 0.0
+        return share * nodes
 
     def _create_core_eval(self, core_job: str) -> None:
         ev = s.Evaluation(
@@ -962,8 +1031,9 @@ class Server:
         if ev.terminal_status():
             # The job's driving eval is done: its placements are live in
             # the per-ns usage fold (or never will be), so the admission
-            # reservation made for it has served its purpose.
+            # reservations made for it have served their purpose.
             self.quota_ledger.release(ev.job_id)
+            self.node_units_ledger.release(ev.job_id)
         if ev.should_enqueue():
             self.eval_broker.enqueue(ev)
         elif ev.should_block():
@@ -986,6 +1056,7 @@ class Server:
         if self._leader:
             self.periodic.remove(job_id)
             self.quota_ledger.release(job_id)
+            self.node_units_ledger.release(job_id)
 
     def _fsm_namespace_updated(self, name: str,
                                ns: Optional[s.Namespace]) -> None:
@@ -1086,12 +1157,62 @@ class Server:
                 out.add(r)
         return sorted(out)
 
+    def region_info(self) -> List[Dict]:
+        """Per-region detail rows for the /v1/regions?detail surface:
+        name, alive server count, and best-known leader address.  The
+        home region answers from local raft state; remote leaders are a
+        best-effort bounded Status.Leader probe against one alive member
+        ("" when the region is unreachable — this endpoint must never
+        hang on a dark region)."""
+        by_region: Dict[str, List[Dict]] = {}
+        for m in list(self.members()) + [self._self_member()]:
+            r = m.get("Region", "")
+            if r and m.get("Status", "alive") == "alive":
+                rows = by_region.setdefault(r, [])
+                if not any(x.get("Name") == m.get("Name") for x in rows):
+                    rows.append(m)
+        out = []
+        probe_timeout = knobs.get_float("NOMAD_TPU_REGION_PROBE_TIMEOUT")
+        for region in sorted(by_region):
+            members = by_region[region]
+            leader = ""
+            if region == self.config.region:
+                leader = self.leader_address()
+            elif self.pool is not None:
+                for m in members:
+                    try:
+                        reply = self.pool.call(
+                            m["Addr"], "Status.Leader", {},
+                            timeout=probe_timeout)
+                        # Status.Leader replies with the bare address
+                        # string (status_endpoint.go), not a dict.
+                        leader = (reply if isinstance(reply, str)
+                                  else (reply or {}).get("Leader", ""))
+                        break
+                    except Exception:
+                        continue
+            out.append({"Name": region, "Servers": len(members),
+                        "Leader": leader})
+        return out
+
     def _forward_region(self, region: str, wire_method: str, body: Dict):
         """Route a request to any alive server of another region
         (nomad/rpc.go:263 forwardRegion over the WAN member table).  Does
         NOT consume the one leader-forward hop: the remote server may
-        still forward to its own region's leader."""
-        from .rpc import DialError
+        still forward to its own region's leader.
+
+        Partition tolerance contract: a down region degrades to a typed
+        ``NoPathToRegion`` carrying a retry_after hint — never a hang and
+        never a silent generic error.  The walk makes a bounded number of
+        rounds over the region's known servers with the shared jittered
+        Backoff between rounds; within a round only DIAL failures rotate
+        (the request was never sent, so trying the next server cannot
+        double-apply).  The dials ride ``self.pool``, so the per-address
+        dial-backoff gate armed by raft replication and leader forwarding
+        is shared with the federation path: a region that just went dark
+        fails fast locally instead of re-paying connect timeouts."""
+        from .rpc import DialError, NoPathToRegion
+        from ..utils.backoff import Backoff
 
         if getattr(self._fwd_ctx, "region_hop", False):
             # This request already took its region hop; stale member
@@ -1107,17 +1228,25 @@ class Server:
         body = dict(body)
         body["Region"] = region
         body["__region_hop__"] = True
+        rounds = max(1, knobs.get_int("NOMAD_TPU_REGION_DIAL_ROUNDS"))
+        bo = Backoff(base=0.05, max_delay=2.0)
         last: Optional[Exception] = None
-        for m in candidates:
-            try:
-                return self.pool.call(m["Addr"], wire_method, body)
-            except DialError as e:
-                # Only DIAL failures rotate — the request was never sent.
-                # A post-send transport error may have applied remotely;
-                # retrying could double-apply a write, and application
-                # errors must propagate as-is.
-                last = e
-        raise ValueError(f"no path to region {region!r}: {last}")
+        for round_no in range(rounds):
+            if round_no and self._shutdown.wait(bo.next_delay()):
+                break
+            for m in candidates:
+                try:
+                    return self.pool.call(m["Addr"], wire_method, body)
+                except DialError as e:
+                    # Only DIAL failures rotate — the request was never
+                    # sent.  A post-send transport error may have applied
+                    # remotely; retrying could double-apply a write, and
+                    # application errors must propagate as-is.
+                    last = e
+        retry_after = min(knobs.get_float("NOMAD_TPU_REGION_RETRY_AFTER_CAP"),
+                          0.5 + 0.5 * rounds)
+        raise NoPathToRegion(region, retry_after, rounds=rounds,
+                             detail=str(last) if last else "")
 
     def _forward(self, wire_method: str, body: Dict):
         """Re-issue a write that hit NotLeaderError as a wire RPC to the
@@ -1149,17 +1278,43 @@ class Server:
         self.eval_broker.check_admission(
             job.priority, namespace=ns,
             ns_max_pending=row.max_pending_evals if row is not None else 0)
-        quota = row.max_live_allocs if row is not None else 0
-        if quota <= 0 or job.priority >= self.eval_broker.bypass_priority:
+        if row is None or job.priority >= self.eval_broker.bypass_priority:
             return
         count = sum(tg.count for tg in job.task_groups)
-        live = self.state.namespace_usage_one(ns)[4]
-        if not self.quota_ledger.check_and_reserve(
-                ns, job.id, count, live, quota):
-            self.eval_broker.note_quota_reject(ns)
-            asked = live + self.quota_ledger.reserved(ns) + count
-            retry_after = min(5.0, 0.2 + 0.3 * (asked / quota))
-            raise BrokerLimitError(retry_after, asked, quota, namespace=ns)
+        quota = row.max_live_allocs
+        if quota > 0:
+            live = self.state.namespace_usage_one(ns)[4]
+            if not self.quota_ledger.check_and_reserve(
+                    ns, job.id, count, live, quota):
+                self.eval_broker.note_quota_reject(ns)
+                asked = live + self.quota_ledger.reserved(ns) + count
+                retry_after = min(5.0, 0.2 + 0.3 * (asked / quota))
+                raise BrokerLimitError(retry_after, asked, quota,
+                                       namespace=ns)
+        units_quota = row.quota_node_units
+        if units_quota > 0:
+            # Node-units gate (ROADMAP item 3's open item): the tenant's
+            # dominant-resource share of the cluster, in nodes-worth,
+            # must stay under quota_node_units counting this job's ask.
+            self._refresh_capacity()
+            cap, nodes = self._cluster_capacity, self._cluster_nodes
+            if nodes > 0:
+                used = self._node_units(
+                    self.state.namespace_usage_one(ns)[:4], cap, nodes)
+                ask = self._node_units(_job_usage_vec(job), cap, nodes)
+                if not self.node_units_ledger.check_and_reserve(
+                        ns, job.id, ask, used, units_quota):
+                    # Roll back the alloc-count reservation made above:
+                    # this registration is rejected, so nothing will
+                    # ever release it otherwise.
+                    self.quota_ledger.release(job.id)
+                    self.eval_broker.note_quota_reject(ns)
+                    asked = used + self.node_units_ledger.reserved(ns) + ask
+                    retry_after = min(
+                        5.0, 0.2 + 0.3 * (asked / units_quota))
+                    raise BrokerLimitError(
+                        retry_after, math.ceil(asked),
+                        math.ceil(units_quota), namespace=ns)
 
     def job_register(self, job: s.Job, region: str = "") -> Tuple[int, str]:
         """(job_endpoint.go:47 Register): validate → log JobRegister → eval
@@ -1882,9 +2037,16 @@ class Server:
 
     # -- Namespace (tenancy plane) -----------------------------------------
 
-    def namespace_upsert(self, ns: s.Namespace) -> int:
+    def namespace_upsert(self, ns: s.Namespace, region: str = "") -> int:
         """Register/update a tenant through raft (like jobs): validate →
-        log NAMESPACE_UPSERT; policy mirrors refresh via the FSM hook."""
+        log NAMESPACE_UPSERT; policy mirrors refresh via the FSM hook.
+        Namespaces are REGION-SCOPED (each region's raft owns its tenant
+        rows and enforces their quotas locally): an explicit ``region``
+        routes over the federation, like jobs."""
+        if region and region != self.config.region:
+            reply = self._forward_region(region, "Namespace.Upsert",
+                                         {"Namespace": ns})
+            return reply["Index"]
         ns = ns.copy()
         problems = ns.validate()
         if problems:
@@ -1898,7 +2060,11 @@ class Server:
             return reply["Index"]
         return index
 
-    def namespace_delete(self, name: str) -> int:
+    def namespace_delete(self, name: str, region: str = "") -> int:
+        if region and region != self.config.region:
+            reply = self._forward_region(region, "Namespace.Delete",
+                                         {"Name": name})
+            return reply["Index"]
         if name == s.DEFAULT_NAMESPACE:
             raise ValueError("cannot delete the default namespace")
         if self.state.namespace_by_name(None, name) is None:
@@ -1911,21 +2077,32 @@ class Server:
             return reply["Index"]
         return index
 
-    def namespace_list(self) -> List[s.Namespace]:
+    def namespace_list(self, region: str = "") -> List[s.Namespace]:
+        if region and region != self.config.region:
+            reply = self._forward_region(region, "Namespace.List", {})
+            return reply["Namespaces"]
         return self.state.namespaces(None)
 
-    def namespace_status(self, name: str) -> Dict:
+    def namespace_status(self, name: str, region: str = "") -> Dict:
         """One tenant's row + live usage + broker counters — the
         namespace-status CLI/HTTP read."""
+        if region and region != self.config.region:
+            return self._forward_region(region, "Namespace.Status",
+                                        {"Name": name})
         row = self.state.namespace_by_name(None, name)
         if row is None:
             raise KeyError(f"namespace not found: {name}")
         cpu, mem, disk, iops, live = self.state.namespace_usage_one(name)
+        self._refresh_capacity()
+        cap, nodes = self._cluster_capacity, self._cluster_nodes
         return {
             "Namespace": row,
             "Usage": {"CPU": cpu, "MemoryMB": mem, "DiskMB": disk,
-                      "IOPS": iops, "LiveAllocs": live},
+                      "IOPS": iops, "LiveAllocs": live,
+                      "NodeUnits": self._node_units(
+                          (cpu, mem, disk, iops), cap, nodes)},
             "ReservedAllocs": self.quota_ledger.reserved(name),
+            "ReservedNodeUnits": self.node_units_ledger.reserved(name),
             "PendingEvals": self.eval_broker.ns_pending_count(name),
         }
 

@@ -241,6 +241,16 @@ _knob("NOMAD_TPU_TENANCY_METRICS_TOP", "int", 10,
       "How many busiest tenants get per-tenant tenant.* gauges each "
       "metrics tick (0 disables)")
 
+# -- region federation ------------------------------------------------------
+_knob("NOMAD_TPU_REGION_DIAL_ROUNDS", "int", 2,
+      "Cross-region forwarding: how many full passes over the target "
+      "region's known servers before giving up with NoPathToRegion")
+_knob("NOMAD_TPU_REGION_RETRY_AFTER_CAP", "float", 5.0,
+      "Cap on the retry_after hint carried by NoPathToRegion (seconds)")
+_knob("NOMAD_TPU_REGION_PROBE_TIMEOUT", "float", 1.0,
+      "Timeout for best-effort cross-region leader probes in the "
+      "/v1/regions detail surface (seconds)")
+
 # -- loadgen / bench --------------------------------------------------------
 _knob("NOMAD_TPU_SWITCH_INTERVAL", "float", None,
       "sys.setswitchinterval override applied for loadgen "

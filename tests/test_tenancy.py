@@ -369,6 +369,89 @@ class TestBrokerTenantAdmission:
 
 
 # ---------------------------------------------------------------------------
+# node-units quota (quota_node_units admission enforcement, ISSUE 17)
+# ---------------------------------------------------------------------------
+
+
+class _GaugeRecorder:
+    """Telemetry stand-in: records set_gauge, swallows everything else."""
+
+    def __init__(self):
+        self.gauges = {}
+
+    def set_gauge(self, key, value):
+        self.gauges[key] = value
+
+    def __getattr__(self, name):
+        return lambda *a, **k: None
+
+
+class TestNodeUnitsQuota:
+    def _server(self):
+        from nomad_tpu.server.server import Server, ServerConfig
+
+        srv = Server(ServerConfig(num_schedulers=0))
+        srv.start()
+        for _ in range(2):
+            srv.node_register(mock.node())
+        return srv
+
+    def _job(self, ns, count):
+        j = mock.job()
+        j.namespace = ns
+        j.task_groups[0].count = count
+        return j
+
+    def test_over_quota_ask_rejected_with_429(self):
+        """2 mock nodes = (8000 cpu, 16384 mb); a 10-count web job asks
+        5000 cpu → dominant share 0.625 → 1.25 nodes-worth, over a
+        1.0-unit quota.  A 4-count job (0.5 units) fits; reservations
+        accumulate until a third submission would breach."""
+        srv = self._server()
+        try:
+            srv.namespace_upsert(s.Namespace(name="units",
+                                             quota_node_units=1.0))
+            with pytest.raises(BrokerLimitError) as ei:
+                srv.job_register(self._job("units", 10))
+            assert ei.value.namespace == "units"
+            assert ei.value.retry_after > 0
+            # The rejected registration must not leak reservations in
+            # EITHER ledger.
+            assert srv.node_units_ledger.reserved("units") == 0
+            assert srv.quota_ledger.reserved("units") == 0
+
+            srv.job_register(self._job("units", 4))   # 0.5 units
+            held = self._job("units", 4)
+            srv.job_register(held)                    # 1.0 units total
+            assert srv.node_units_ledger.reserved("units") == \
+                pytest.approx(1.0)
+            with pytest.raises(BrokerLimitError):
+                srv.job_register(self._job("units", 4))
+            # Deregister frees its node-units reservation, making room.
+            srv.job_deregister(held.id)
+            assert srv.node_units_ledger.reserved("units") == \
+                pytest.approx(0.5)
+            srv.job_register(self._job("units", 4))
+            # Another tenant with no node-units quota is untouched.
+            srv.job_register(self._job("other", 10))
+        finally:
+            srv.shutdown()
+
+    def test_node_units_gauge_emitted(self):
+        srv = self._server()
+        try:
+            srv.namespace_upsert(s.Namespace(name="units",
+                                             quota_node_units=5.0))
+            srv.job_register(self._job("units", 4))
+            rec = _GaugeRecorder()
+            srv.metrics = rec
+            srv._feed_tenancy(tenant_top=5)
+            assert "tenant.node_units.units" in rec.gauges
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
 # SDK: jittered retry honoring Retry-After
 # ---------------------------------------------------------------------------
 
