@@ -1035,6 +1035,72 @@ def bench_control_plane(nodes: int = 800, submissions: int = 800):
     return out
 
 
+def bench_host_attribution(nodes: int = 800, submissions: int = 600):
+    """config_control shape run twice — disarmed, then with the
+    continuous profiler + GIL probe armed — measuring (a) what fraction
+    of non-idle thread-samples the subsystem classifier attributes (the
+    >=80% coverage gate) and (b) the armed profiler's cost on sustained
+    evals/s (the <3% overhead gate).  A third MINI leg arms the
+    lockcheck contention ledger purely to report the top lock waits —
+    the sanitizer's lock-patching cost is its own (PR 15) concern and
+    deliberately stays out of the profiler's overhead comparison.
+    Host-only (no device time)."""
+    from dataclasses import replace
+
+    from nomad_tpu.loadgen.harness import run_scenario
+    from nomad_tpu.loadgen.scenario import get_scenario
+    from nomad_tpu.utils import contprof, lockcheck
+
+    sc = replace(get_scenario("baseline"), num_nodes=nodes,
+                 max_submissions=submissions, subscribers=32,
+                 drain_s=45.0)
+    base = run_scenario(sc)
+    base_rate = float(base["sustained"]["evals_per_s"])
+
+    contprof.enable(hz=50)
+    try:
+        armed = run_scenario(sc)
+    finally:
+        contprof.disable()
+    armed_rate = float(armed["sustained"]["evals_per_s"])
+    ha = armed.get("host_attribution") or {}
+
+    # Contention-ledger reporting leg (small shape, not perf-gated).
+    top_locks = []
+    if not lockcheck.armed():
+        lockcheck.arm()
+        try:
+            mini = replace(sc, num_nodes=200, max_submissions=200,
+                           subscribers=8, drain_s=15.0)
+            contprof.enable(hz=50)
+            try:
+                ledger = run_scenario(mini)
+            finally:
+                contprof.disable()
+            top_locks = [lk["name"] for lk in
+                         (ledger.get("host_attribution") or {})
+                         .get("top_locks", [])]
+        finally:
+            lockcheck.disarm()
+    out = {
+        "nodes": nodes, "submissions": submissions,
+        "disarmed_evals_per_s": round(base_rate, 2),
+        "armed_evals_per_s": round(armed_rate, 2),
+        "overhead_pct": (round((1.0 - armed_rate / base_rate) * 100.0, 2)
+                         if base_rate else None),
+        "non_idle_coverage": ha.get("non_idle_coverage"),
+        "thread_samples": ha.get("thread_samples"),
+        "top_subsystems": ha.get("top_subsystems"),
+        "top_locks": top_locks,
+        "gil_pressure_ms": ha.get("gil_pressure_ms"),
+    }
+    log(f"  host-attribution: disarmed {out['disarmed_evals_per_s']} "
+        f"evals/s, armed {out['armed_evals_per_s']} evals/s "
+        f"({out['overhead_pct']}% overhead), coverage "
+        f"{out['non_idle_coverage']}, {out['thread_samples']} samples")
+    return out
+
+
 def _codec_s_per_eval(split: dict, _rate: float, completed: int):
     """Leader codec seconds (rpc+raft encode+decode) per completed eval
     — the per-entry serialization tax the struct codec exists to cut."""
@@ -2711,6 +2777,34 @@ def _check_main(argv) -> int:
     except Exception as exc:
         out["control_plane_evals_per_s"] = {"error": repr(exc)}
         failures.append(f"control-plane phase failed: {exc!r}")
+
+    # Host-attribution gate (ISSUE 19): both gates are absolute (no
+    # baseline needed) — the continuous profiler must attribute >=80%
+    # of non-idle samples to a real subsystem at the config_control
+    # shape, and arming the whole plane (sampler + GIL probe + lock
+    # ledger) must cost <3% of the disarmed leg's sustained evals/s.
+    try:
+        with _deadline(420, "check_host_attribution"):
+            hat = bench_host_attribution()
+        out["host_attribution"] = hat
+        cov = hat.get("non_idle_coverage")
+        if cov is None or cov < 0.80:
+            failures.append(
+                f"host-attribution coverage {cov} < 0.80 — the "
+                "subsystem classifier is leaving non-idle samples in "
+                "'other'")
+        if (hat["disarmed_evals_per_s"]
+                and hat["armed_evals_per_s"]
+                < hat["disarmed_evals_per_s"] * 0.97):
+            failures.append(
+                f"armed host-attribution plane cost "
+                f"{hat['overhead_pct']}% of sustained evals/s "
+                f"({hat['armed_evals_per_s']} vs "
+                f"{hat['disarmed_evals_per_s']} disarmed) — budget is "
+                "<3%")
+    except Exception as exc:
+        out["host_attribution"] = {"error": repr(exc)}
+        failures.append(f"host-attribution phase failed: {exc!r}")
 
     # Follower-read scale-out guard (ISSUE 10): 1 leader + 2 follower-
     # scheduler subprocesses vs one server at the same offered load.

@@ -137,6 +137,8 @@ class HTTPServer:
         r("/v1/event/stream", self.event_stream_request)
         r("/v1/traces", self.traces_request)
         r("/v1/trace/eval/(?P<id>[^/]+)", self.trace_eval_request)
+        r("/v1/profile/continuous", self.profile_continuous_request)
+        r("/v1/debug/blackbox", self.debug_blackbox_request)
         r("/v1/kv/(?P<key>.*)", self.kv_request)
         # Debug/profiling surface, gated by enable_debug — the reference
         # mounts net/http/pprof the same way (command/agent/http.go:173).
@@ -804,6 +806,8 @@ class HTTPServer:
         and sample summaries with p50/p95/p99 quantiles)."""
         from .. import codec
 
+        from ..utils import contprof
+
         if query.get("format") == "prometheus":
             from ..utils.telemetry import render_prometheus
 
@@ -813,12 +817,15 @@ class HTTPServer:
             # Struct-codec histograms (codec.{rpc,raft,snapshot}.
             # {encode,decode}_seconds) account process-globally in the
             # codec package; merge them into this server's rendering
-            # (ISSUE 11 observability contract).
+            # (ISSUE 11 observability contract).  The host-attribution
+            # plane merges the same way: nomad.cpu.* shares and
+            # nomad.lock.*.wait_seconds histograms (ISSUE 19).
             return TextResponse(render_prometheus(
-                codec.merge_metrics(sink.latest()))), None
+                contprof.merge_metrics(
+                    codec.merge_metrics(sink.latest())))), None
         data = self.server.metrics.sink.data()
         if isinstance(data, list) and data:
-            codec.merge_metrics(data[-1])
+            contprof.merge_metrics(codec.merge_metrics(data[-1]))
         return data, None
 
     def broker_stats_request(self, req, query):
@@ -918,10 +925,51 @@ class HTTPServer:
             raise CodedError(
                 404, "tracing disabled (set NOMAD_TPU_TRACE=1 or call "
                      "tracing.enable())")
-        spans = tracing.trace_for_eval(id)
+        # The tracer is per-process: a follower-scheduled eval's spans
+        # live on the scheduling follower.  Fan out to peers over
+        # Status.TraceEval before 404ing (ISSUE 19; best-effort, dark
+        # followers skipped).
+        spans, source = self.server.trace_for_eval_fanout(id)
         if not spans:
-            raise CodedError(404, f"no trace recorded for eval {id!r}")
-        return {"EvalID": id, "Spans": spans}, None
+            raise CodedError(404, f"no trace recorded for eval {id!r} "
+                                  "on any reachable server")
+        return {"EvalID": id, "Spans": spans, "Source": source}, None
+
+    def profile_continuous_request(self, req, query):
+        """Rolling host-attribution window from the continuous profiler
+        (/v1/profile/continuous?seconds=N): per-subsystem CPU shares,
+        non-idle attribution coverage, GIL-pressure percentiles, and the
+        top contended locks.  Ungated like /v1/metrics — the sampler
+        only runs when armed (NOMAD_TPU_CONTPROF=1), and a disarmed
+        plane reads as {"Enabled": false} rather than 404 so pollers
+        can tell 'off' from 'down'."""
+        from ..utils import contprof
+
+        if req.command != "GET":
+            raise CodedError(405, "Invalid method")
+        seconds = float(query.get("seconds", "60") or 60)
+        return contprof.window(seconds), None
+
+    def debug_blackbox_request(self, req, query):
+        """Operator-forced flight-recorder capture (/v1/debug/blackbox):
+        assembles a full incident bundle NOW — spans, event tail,
+        metrics, profile window, thread dump, knob/breaker state — and,
+        when the recorder is armed, also writes it to the bundle
+        directory (response carries the path).  Debug-gated like the
+        pprof surface; forced captures bypass the auto-capture rate
+        limits by design."""
+        self._require_debug()
+        from ..utils import blackbox
+
+        reason = query.get("reason", "operator.request")
+        path = blackbox.capture(reason, {"Via": "http"}, force=True)
+        if path is not None:
+            with open(path, "r", encoding="utf-8") as fh:
+                bundle = json.load(fh)
+        else:  # recorder disarmed: assemble in memory, nothing on disk
+            bundle = blackbox.assemble_bundle(reason, {"Via": "http"})
+        bundle["Path"] = path
+        return bundle, None
 
     # -- debug / profiling (pprof equivalent) --------------------------
 

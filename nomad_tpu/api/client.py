@@ -325,6 +325,20 @@ class AgentAPI:
         obj, _ = self.c.put("/v1/agent/keyring/remove", {"Key": key})
         return obj
 
+    def profile_continuous(self, seconds: float = 60.0) -> dict:
+        """Rolling host-attribution window (/v1/profile/continuous):
+        CPU shares per subsystem, coverage, GIL pressure, top locks."""
+        q = QueryOptions(params={"seconds": str(seconds)})
+        obj, _ = self.c.get("/v1/profile/continuous", q)
+        return obj
+
+    def debug_bundle(self, reason: str = "operator.request") -> dict:
+        """Force a flight-recorder capture (/v1/debug/blackbox) and
+        return the bundle (requires enable_debug on the agent)."""
+        q = QueryOptions(params={"reason": reason})
+        obj, _ = self.c.get("/v1/debug/blackbox", q)
+        return obj
+
     def client_stats(self) -> dict:
         obj, _ = self.c.get("/v1/client/stats")
         return obj

@@ -857,6 +857,39 @@ def cmd_broker_status(args, out) -> int:
     return 0
 
 
+def cmd_debug(args, out) -> int:
+    """Flight-recorder capture (/v1/debug/blackbox): pull one incident
+    bundle — span timeline, event tail, metrics, continuous-profile
+    window, thread dump, knob/breaker state — from a live agent and
+    write it to disk.  The agent must run with enable_debug (the pprof
+    gate)."""
+    api = _api(args)
+    reason = getattr(args, "reason", "") or "operator.cli"
+    bundle = api.agent.debug_bundle(reason)
+    dest = getattr(args, "output", "") or ""
+    if not dest:
+        stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+        dest = f"nomad-debug-{stamp}.json"
+    with open(dest, "w", encoding="utf-8") as fh:
+        json.dump(bundle, fh, indent=1)
+    prof = bundle.get("Profile") or {}
+    shares = prof.get("Shares") or {}
+    top = ", ".join(f"{k}={v:.2f}" for k, v in list(shares.items())[:4]
+                    if k != "idle") or "n/a"
+    out.write(format_kv([
+        f"Bundle|{dest}",
+        f"Reason|{bundle.get('Reason')}",
+        f"Agent Path|{bundle.get('Path') or 'not persisted (disarmed)'}",
+        f"Spans|{len(bundle.get('Spans') or [])}",
+        f"Events|{len(bundle.get('Events') or [])}",
+        f"Profiler|{'armed' if prof.get('Enabled') else 'disarmed'}",
+        f"Top CPU|{top}",
+        f"Breaker|{(bundle.get('Breaker') or {}).get('State', 'n/a')}",
+        f"Servers|{len(bundle.get('Servers') or [])}",
+    ]) + "\n")
+    return 0
+
+
 def cmd_namespace_list(args, out) -> int:
     """Tenancy surface: /v1/namespaces."""
     api = _api(args)
@@ -1158,6 +1191,12 @@ def build_parser() -> argparse.ArgumentParser:
     add("check", cmd_check)
     add("broker-status", cmd_broker_status, lambda sp:
         sp.add_argument("-json", dest="json", action="store_true"))
+    add("debug", cmd_debug, lambda sp: (
+        sp.add_argument("-reason", default="operator.cli",
+                        help="reason stamped into the bundle"),
+        sp.add_argument("-output", default="",
+                        help="bundle destination (default: "
+                             "./nomad-debug-<stamp>.json)")))
     add("namespace-list", cmd_namespace_list, lambda sp:
         sp.add_argument("-json", dest="json", action="store_true"))
     add("namespace-status", cmd_namespace_status, lambda sp: (

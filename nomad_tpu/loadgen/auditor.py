@@ -40,6 +40,7 @@ import time
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..structs import structs as s
+from ..utils import blackbox
 
 # Terminal eval states an acked eval may lawfully rest in.
 _TERMINAL = (s.EVAL_STATUS_COMPLETE, s.EVAL_STATUS_FAILED,
@@ -220,6 +221,7 @@ class FederatedAuditor:
              "detail": detail}
         with self._l:
             self.violations.append(v)
+        blackbox.note_trigger("auditor.violation", v)
         self.logger.error("FED AUDIT VIOLATION %s: %s", kind, detail)
 
     def _note_fingerprint(self, region: str, index: int, fp: str) -> None:
@@ -371,6 +373,7 @@ class SafetyAuditor:
              "detail": detail}
         with self._l:
             self.violations.append(v)
+        blackbox.note_trigger("auditor.violation", v)
         self.logger.error("AUDIT VIOLATION %s: %s", kind, detail)
 
     # -- leader event stream -----------------------------------------------

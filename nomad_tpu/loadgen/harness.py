@@ -34,7 +34,7 @@ from typing import Dict, List, Optional, Tuple
 from ..server import Server, ServerConfig
 from ..server.eval_broker import BrokerLimitError
 from ..structs import structs as s
-from ..utils import tracing
+from ..utils import contprof, lockcheck, tracing
 from .scenario import JobShape, Scenario
 
 # Raft timing for multi-server measurement clusters: elections slowed to
@@ -866,6 +866,12 @@ class LoadHarness:
         # compare_* drivers run several legs in one process).
         self._codec_before = codec.stats()
         self._msgpack_methods_before = codec.msgpack_methods()
+        # Host-attribution accounting is process-cumulative too: zero
+        # the profiler's counters and the contention ledger so the
+        # host_attribution section covers THIS leg only.
+        if contprof.enabled():
+            contprof.reset()
+            lockcheck.reset_waits()
         self.server = self._build_server()
         try:
             return self._run_inner()
@@ -1316,6 +1322,13 @@ class LoadHarness:
         if sc.num_tenants > 0:
             report["tenancy"] = self._tenancy_section(
                 records, ns_rejects, ns_dropped)
+        # ISSUE 19: where did host CPU go this leg?  Per-subsystem
+        # attribution shares + top contended locks + GIL pressure from
+        # the continuous profiler (present only when armed; run()
+        # resets the cumulative counters at leg start).
+        attribution = contprof.host_attribution(top_locks=5)
+        if attribution is not None:
+            report["host_attribution"] = attribution
         if tracing.enabled() and slowest:
             report["slow_tail_traces"] = [
                 {"eval_id": r.eval_id,

@@ -211,6 +211,19 @@ def _render_single(r: Dict, out: TextIO, indent: str = "") -> None:
               f"{ten['rejects_429'][c]} 429s, "
               f"{ten['dropped_after_retries'][c]} dropped — "
               f"done ms p50={lat['p50']} p99={lat['p99']}")
+    ha = r.get("host_attribution") or {}
+    if ha:
+        top = ", ".join(f"{k}={v:.0%}" for k, v in
+                        (ha.get("top_subsystems") or []))
+        gil = ha.get("gil_pressure_ms") or {}
+        w(f"host attribution: {ha.get('thread_samples')} thread-samples "
+          f"@ {ha.get('hz')}Hz, coverage={ha.get('non_idle_coverage'):.0%}"
+          f" — {top}")
+        w(f"  gil pressure ms: p50={gil.get('p50')} p99={gil.get('p99')} "
+          f"(n={gil.get('count')})")
+        for lk in (ha.get("top_locks") or [])[:5]:
+            w(f"  lock {lk['name']}: {lk['count']} waits, "
+              f"{lk['wait_s_sum']}s total, p99={lk['p99_ms']}ms")
     for f in r.get("follower_servers", []):
         if "error" in f:
             w(f"follower {f['addr']}: stats unavailable ({f['error']})")

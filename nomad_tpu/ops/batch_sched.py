@@ -295,7 +295,7 @@ class _PreparedBatch:
         self.specs = {}
         self.spec_list = []
         self.stats = BatchStats()
-        self.t0 = time.monotonic()
+        self.t0 = time.perf_counter()
         self.handle = None      # _dispatch_device output (device in flight)
         self.probe = False      # this batch is the breaker's half-open probe
         self.routed = False     # breaker-open: already oracle-processed
@@ -512,9 +512,9 @@ class TPUBatchScheduler:
             for evals in batches:
                 if state_source is not None:
                     self.state = state_source()
-                t_prep = time.monotonic()
+                t_prep = time.perf_counter()
                 prep = self._prepare_batch(evals)
-                overlap = (time.monotonic() - t_prep
+                overlap = (time.perf_counter() - t_prep
                            if pending is not None else 0.0)
                 if pending is not None:
                     out.append(self._finish_stream(pending))
@@ -544,7 +544,7 @@ class TPUBatchScheduler:
         stats = self._complete_prepared(prep)
         tr = tracing.TRACER
         if tr is not None:
-            tr.record("batch.schedule", prep.t0, time.monotonic(),
+            tr.record("batch.schedule", prep.t0, time.perf_counter(),
                       num_evals=stats.num_evals, num_specs=stats.num_specs,
                       resident_hits=stats.resident_hits,
                       pipeline_overlap_s=round(stats.pipeline_overlap_s, 4),
@@ -557,7 +557,7 @@ class TPUBatchScheduler:
         stats = prep.stats
 
         # Phase 1: host reconciliation per eval (shared oracle code).
-        t_phase1 = time.monotonic()
+        t_phase1 = time.perf_counter()
         dc_cache: Dict[Tuple[str, ...], Dict[str, int]] = {}
         scheds: List[Tuple[s.Evaluation, _CollectingScheduler]] = []
         for ev in evals:
@@ -578,13 +578,13 @@ class TPUBatchScheduler:
                 sched.stack.set_job(sched.job)
             sched._compute_job_allocs()
             scheds.append((ev, sched))
-        stats.phase1_seconds = time.monotonic() - t_phase1
+        stats.phase1_seconds = time.perf_counter() - t_phase1
         tr = tracing.TRACER
         if tr is not None:
             tr.record("batch.phase1", t_phase1,
                       t_phase1 + stats.phase1_seconds,
                       num_evals=len(evals))
-        t_phase2 = time.monotonic()
+        t_phase2 = time.perf_counter()
 
         # Phase 2: dedup placement asks into specs.
         specs: Dict[Tuple[str, str], encode.PlacementSpec] = {}
@@ -624,7 +624,7 @@ class TPUBatchScheduler:
         spec_list = sorted(specs.values(), key=lambda sp: -sp.priority)
         stats.num_specs = len(spec_list)
         stats.num_asks = sum(sp.count for sp in spec_list)
-        stats.phase2_seconds = time.monotonic() - t_phase2
+        stats.phase2_seconds = time.perf_counter() - t_phase2
         if tr is not None:
             tr.record("batch.phase2", t_phase2,
                       t_phase2 + stats.phase2_seconds,
@@ -681,7 +681,7 @@ class TPUBatchScheduler:
         tr = tracing.TRACER
 
         if prep.routed:
-            stats.total_seconds = time.monotonic() - prep.t0
+            stats.total_seconds = time.perf_counter() - prep.t0
             stats.num_evals = len(evals)
             return stats
 
@@ -718,7 +718,7 @@ class TPUBatchScheduler:
                               breaker_state=stats.breaker_state,
                               num_evals=len(scheds), detail=str(e))
                 self._route_through_oracle(scheds)
-                stats.total_seconds = time.monotonic() - prep.t0
+                stats.total_seconds = time.perf_counter() - prep.t0
                 stats.num_evals = len(evals)
                 return stats
             except Exception:
@@ -763,17 +763,17 @@ class TPUBatchScheduler:
             self._apply_resident_stats(stats, kstats.get("resident") or {})
 
         # Phase 3: materialize allocs into each eval's plan and submit.
-        t_final = time.monotonic()
+        t_final = time.perf_counter()
         net_index_cache: Dict[str, "NetworkIndex"] = {}
         for ev, sched in scheds:
             self._finalize(ev, sched, prep.specs, expanded, unplaced,
                            per_spec_metrics, net_index_cache)
-        stats.finalize_seconds = time.monotonic() - t_final
+        stats.finalize_seconds = time.perf_counter() - t_final
         if tr is not None:
             tr.record("batch.finalize", t_final,
                       t_final + stats.finalize_seconds)
 
-        stats.total_seconds = time.monotonic() - prep.t0
+        stats.total_seconds = time.perf_counter() - prep.t0
         stats.num_evals = len(evals)
         return stats
 
@@ -923,7 +923,7 @@ class TPUBatchScheduler:
         not including) the blocking fetch.  Returns the in-flight handle
         _fetch_device consumes — the split point the double-buffered
         pipeline overlaps across batches."""
-        t0 = time.monotonic()
+        t0 = time.perf_counter()
         # Host→device transfer accounting (ISSUE 14 satellite): the
         # resident mirror's own uploads (installs + routed delta
         # applies) happen inside acquire/take below; sample the module
@@ -1181,8 +1181,8 @@ class TPUBatchScheduler:
 
         sbuf, meta_s = xfer.pack_host(static)
         dbuf, meta_d = xfer.pack_host(dyn)
-        encode_seconds = time.monotonic() - t0
-        t1 = time.monotonic()
+        encode_seconds = time.perf_counter() - t0
+        t1 = time.perf_counter()
 
         import hashlib
         digest = (hashlib.blake2b(sbuf.tobytes(), digest_size=16).hexdigest(),
@@ -1216,7 +1216,7 @@ class TPUBatchScheduler:
             # below would misread COO triplets as a slot matrix.
             slot_m = 0
             from .kernels import _device_compact, _device_schedule
-            t_s0 = time.monotonic()
+            t_s0 = time.perf_counter()
             result, feas, _ = _device_schedule(
                 static_dev, jax.device_put(dbuf),
                 jnp.zeros((1, 4), dtype=jnp.int32), meta_s=meta_s,
@@ -1225,8 +1225,8 @@ class TPUBatchScheduler:
                 with_scores=with_scores)
             jax.device_get(result.unplaced)
             logger.warning("timing2: schedule %.3fs",
-                           time.monotonic() - t_s0)
-            t_s1 = time.monotonic()
+                           time.perf_counter() - t_s0)
+            t_s1 = time.perf_counter()
             compact_u16 = (not with_scores and st.u_pad <= 65536
                            and ct.n_pad <= 65536)
             summary_buf, coo_mat = _device_compact(
@@ -1234,7 +1234,7 @@ class TPUBatchScheduler:
                 compact_u16=compact_u16)
             jax.device_get(summary_buf[:4])
             logger.warning("timing2: compact %.3fs",
-                           time.monotonic() - t_s1)
+                           time.perf_counter() - t_s1)
         elif fused_enabled():
             # Tentpole path: score + commit + compaction as ONE device
             # dispatch emitting ONE packed result buffer, fetched in a
@@ -1313,7 +1313,7 @@ class TPUBatchScheduler:
         with_scores = handle["with_scores"]
         max_nnz = handle["max_nnz"]
 
-        t_disp = time.monotonic()
+        t_disp = time.perf_counter()
         dbg = knobs.get_str("NOMAD_TPU_TIMING") or None
         fetch_bytes = 0
         if handle.get("fused_buf") is not None:
@@ -1361,7 +1361,7 @@ class TPUBatchScheduler:
                                         * coo.dtype.itemsize)
             if dbg:
                 logger.warning("timing: fused fetch %.3fs (%d B)",
-                               time.monotonic() - t_disp, fetch_bytes)
+                               time.perf_counter() - t_disp, fetch_bytes)
         else:
             ncols = 5 if with_scores else 3
             # dtype truth comes from the device array itself (uint16 when
@@ -1385,13 +1385,13 @@ class TPUBatchScheduler:
                                + np.asarray(coo_full).nbytes)
                 if dbg:
                     logger.warning("timing: summary+coo fetch %.3fs",
-                                   time.monotonic() - t_disp)
+                                   time.perf_counter() - t_disp)
             else:
                 with tracing.span("batch.fetch"):
                     sraw = np.asarray(jax.device_get(summary_buf))
                     summary = xfer.unpack_host(
                         sraw, summary_layout(st.u_pad, ct.n_pad))
-                    t_sum = time.monotonic()
+                    t_sum = time.perf_counter()
                     nnz = int(summary["scalars"][0])
                     if nnz:
                         nnz_b = min(max_nnz,
@@ -1407,7 +1407,7 @@ class TPUBatchScheduler:
                     logger.warning(
                         "timing: summary fetch (compute wait) %.3fs; coo "
                         "fetch %.3fs (%d entries x %d cols x %d B)",
-                        t_sum - t_disp, time.monotonic() - t_sum, nnz,
+                        t_sum - t_disp, time.perf_counter() - t_sum, nnz,
                         ncols, isz)
         # Wall time of the whole score-and-commit dispatch: upload +
         # device compute + the result transfer (t1 marks the post-encode
@@ -1415,8 +1415,8 @@ class TPUBatchScheduler:
         # host-side gap between that point and the start of the blocking
         # fetch — the async-dispatch overhead; device compute itself
         # drains inside the blocking fetch.
-        commit_seconds = time.monotonic() - handle["t1"]
-        fetch_seconds = time.monotonic() - t_disp
+        commit_seconds = time.perf_counter() - handle["t1"]
+        fetch_seconds = time.perf_counter() - t_disp
         dispatch_seconds = max(0.0, commit_seconds - fetch_seconds)
         rounds = int(summary["scalars"][1])
         unplaced_arr = summary["unplaced"]
@@ -1537,8 +1537,8 @@ class TPUBatchScheduler:
         sbuf, meta_s = xfer.pack_host_sharded(
             static, d, replicate=("res_scale",))         # [D, B]
         dbuf, meta_d = xfer.pack_host(dyn)
-        encode_seconds = time.monotonic() - t0
-        t1 = time.monotonic()
+        encode_seconds = time.perf_counter() - t0
+        t1 = time.perf_counter()
 
         import hashlib
         digest = (hashlib.blake2b(sbuf.tobytes(),
@@ -1704,12 +1704,12 @@ class TPUBatchScheduler:
                     np.array(need_rows, dtype=np.int32))]
             if preempt_ctx is not None:
                 gets["preempt"] = preempt_ctx["dev"]
-            t_fx = time.monotonic()
+            t_fx = time.perf_counter()
             with tracing.span("batch.fetch_forensics",
                               feas_rows=len(need_rows),
                               preempt=int(preempt_ctx is not None)):
                 fetched = jax.device_get(gets)
-            kstats_fetch_s = time.monotonic() - t_fx
+            kstats_fetch_s = time.perf_counter() - t_fx
             if need_rows:
                 rows_np = np.asarray(fetched["feas_rows"])
                 kstats_fetch_b += rows_np.nbytes
@@ -1719,8 +1719,8 @@ class TPUBatchScheduler:
                 kstats_fetch_b += sum(
                     np.asarray(a).nbytes
                     for a in jax.tree_util.tree_leaves(fetched["preempt"]))
-        device_seconds = time.monotonic() - t1
-        t_metrics = time.monotonic()
+        device_seconds = time.perf_counter() - t1
+        t_metrics = time.perf_counter()
 
         # Preemption commit (host greedy pass over the fetched eviction
         # sets; mutates unplaced_arr/used_after so the failure forensics
@@ -1810,7 +1810,7 @@ class TPUBatchScheduler:
         kstats = {
             "device_seconds": device_seconds,
             "encode_seconds": encode_seconds,
-            "metrics_seconds": time.monotonic() - t_metrics,
+            "metrics_seconds": time.perf_counter() - t_metrics,
             "rounds": rounds,
             "fetch_seconds": kstats_fetch_s,
             "fetch_bytes": kstats_fetch_b,

@@ -32,7 +32,7 @@ from collections import deque
 from typing import Callable, Optional
 
 from .. import fault
-from ..utils import tracing
+from ..utils import blackbox, tracing
 
 logger = logging.getLogger("nomad_tpu.ops.breaker")
 
@@ -113,6 +113,9 @@ class KernelCircuitBreaker:
                 _stream_transition(CLOSED, OPEN,
                                    Agreement=round(ratio, 4),
                                    Trips=self.trips)
+                blackbox.note_trigger(
+                    "breaker.open", {"Agreement": round(ratio, 4),
+                                     "Trips": self.trips})
                 logger.warning(
                     "kernel circuit breaker OPEN: agreement %.2f < %.2f "
                     "over %d checks; routing evals through the CPU oracle "
@@ -168,6 +171,8 @@ class KernelCircuitBreaker:
                 self._tripped_at = self.clock()
                 tracing.event("breaker.transition", frm=HALF_OPEN, to=OPEN)
                 _stream_transition(HALF_OPEN, OPEN)
+                blackbox.note_trigger(
+                    "breaker.reopen", {"Trips": self.trips})
                 logger.warning("kernel circuit breaker RE-OPEN: probe batch "
                                "disagreed; staying on the CPU oracle")
 

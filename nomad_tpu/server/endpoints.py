@@ -23,6 +23,7 @@ from typing import Any, Dict
 from .. import fault
 from ..api.codec import ensure, ensure_list
 from ..structs import structs as s
+from ..utils import contprof, tracing
 from . import event_broker as event_stream
 from .raft import NotLeaderError
 from .rpc import NoLeaderError
@@ -88,7 +89,14 @@ def register_endpoints(server, rpc) -> None:
 
         sink = server.metrics.sink
         latest = sink.latest() if hasattr(sink, "latest") else {}
-        return codec.merge_metrics(latest)
+        return contprof.merge_metrics(codec.merge_metrics(latest))
+
+    def status_trace_eval(body):
+        """Local-tracer span lookup for the trace fan-out (ISSUE 19):
+        the leader's /v1/trace/eval/<id> asks peers for spans it does
+        not hold (follower-scheduled evals trace on the follower).
+        Deliberately NO recursive fan-out — one hop, local spans only."""
+        return {"Spans": tracing.trace_for_eval(body.get("EvalID", ""))}
 
     def status_broker_stats(body):
         return server.broker_stats()
@@ -121,6 +129,7 @@ def register_endpoints(server, rpc) -> None:
     rpc.register("Status.Leader", status_leader)
     rpc.register("Status.Peers", status_peers)
     rpc.register("Status.Metrics", status_metrics)
+    rpc.register("Status.TraceEval", status_trace_eval)
     rpc.register("Status.BrokerStats", status_broker_stats)
     rpc.register("Status.Fingerprint", status_fingerprint)
     rpc.register("Event.Since", event_since)

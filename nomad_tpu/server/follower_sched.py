@@ -219,7 +219,7 @@ class LeaderChannel:
         the original plan."""
         from ..api.codec import ensure
 
-        t0 = time.monotonic()
+        t0 = time.perf_counter()
         with self._l:
             self._inflight_plans += 1
         try:

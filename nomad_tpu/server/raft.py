@@ -180,7 +180,7 @@ class RaftLog:
         A durability failure poisons the log (fsync failure is fatal —
         the reference panics): the entry was never applied, no retry
         can double-apply, and every queued/later apply fails too."""
-        t0 = time.monotonic()
+        t0 = time.perf_counter()
         with self._l:
             if not self._leader:
                 raise NotLeaderError("not the leader")
@@ -240,7 +240,7 @@ class RaftLog:
         # one load + comparison, no getattr/dict/timestamp.
         tr = tracing.TRACER
         if tr is not None:
-            tr.record("raft.apply", t0, time.monotonic(), index=index,
+            tr.record("raft.apply", t0, time.perf_counter(), index=index,
                       msg_type=getattr(msg_type, "name", str(msg_type)))
         return result, index
 
@@ -600,7 +600,7 @@ class FileLog(RaftLog):
         ``seq`` is durable.  Concurrent callers coalesce into one fsync
         — natively via wal.cc's group commit, in the fallback via the
         same written/synced-seq single-syncer dance in Python."""
-        t0 = time.monotonic()
+        t0 = time.perf_counter()
         try:
             self._do_sync_persist(seq)
         finally:
@@ -744,7 +744,7 @@ class FileLog(RaftLog):
         only for the sequencer drain, an O(1) copy-on-write state
         snapshot, and the segment roll; the serialization and the
         fsyncs run outside it."""
-        t0 = time.monotonic()
+        t0 = time.perf_counter()
         with self._snap_serial:
             # Quiesce-at-index loop: the sequencer drain must run
             # WITHOUT the log lock — a live server's FSM-apply hooks
@@ -1822,7 +1822,7 @@ class MultiRaft(RaftLog):
 
     def apply(self, msg_type: MessageType, payload: dict):
         from .log_codec import encode_payload
-        t0 = time.monotonic()
+        t0 = time.perf_counter()
         # Encode OUTSIDE the raft lock: concurrent appliers pay their
         # own codec time instead of convoying every append behind it
         # (an entry is pure data; index assignment below still orders
@@ -1850,6 +1850,6 @@ class MultiRaft(RaftLog):
         self.metrics.measure_since("raft.apply", t0)
         tr = tracing.TRACER
         if tr is not None:
-            tr.record("raft.apply", t0, time.monotonic(), index=index,
+            tr.record("raft.apply", t0, time.perf_counter(), index=index,
                       msg_type=getattr(msg_type, "name", str(msg_type)))
         return result, index

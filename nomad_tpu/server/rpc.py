@@ -358,7 +358,7 @@ class RPCServer:
                 self.metrics.incr_counter("rpc.request_error")
                 reply = [seq, f"rpc: can't find method {method}", None]
             else:
-                t0 = time.monotonic()
+                t0 = time.perf_counter()
                 # Branch before building the span attrs: the disarmed
                 # per-request path pays one load + comparison only.
                 tr = tracing.TRACER

@@ -18,7 +18,7 @@ from typing import Dict, List, Optional, Tuple
 from .. import fault
 from ..structs import structs as s
 from ..tenancy import QuotaLedger, RateLimiter
-from ..utils import knobs, tracing
+from ..utils import blackbox, contprof, knobs, tracing
 from ..utils.telemetry import Telemetry
 from . import event_broker as event_stream
 from .blocked_evals import BlockedEvals
@@ -155,6 +155,12 @@ class Server:
         # /v1/trace/* works without code changes.
         if not tracing.enabled() and knobs.get_bool("NOMAD_TPU_TRACE"):
             tracing.enable()
+        # Same construction-time arming for the host-attribution
+        # profiler and the incident flight recorder — both are
+        # process-wide, None-when-disarmed planes like the tracer.
+        contprof.maybe_arm_from_env()
+        blackbox.maybe_arm_from_env()
+        blackbox.register_server(self)
         # Vault client (nomad/vault.go:234); vault_api injects the fake
         # in tests (vault_testing.go role).
         self.vault = ServerVaultClient(self.config.vault or VaultConfig(),
@@ -429,6 +435,7 @@ class Server:
     def shutdown(self) -> None:
         self._shutdown.set()
         self._leader = False
+        blackbox.unregister_server(self)
         event_stream.unregister(self.event_broker)
         self.event_broker.close()
         for worker in self.workers:
@@ -943,9 +950,39 @@ class Server:
                         brk_mod.STATE_CODE.get(brk_mod.BREAKER.state, 0))
                     self.metrics.set_gauge("breaker.trips",
                                            brk_mod.BREAKER.trips)
+                prof = contprof.PROFILER
+                if prof is not None:
+                    for sub, share in prof.shares(30.0).items():
+                        self.metrics.set_gauge(f"cpu.{sub}", share)
+                    gil = prof.gil_pressure_ms()
+                    self.metrics.set_gauge("runtime.gil_delay_p50_ms",
+                                           gil["p50"])
+                    self.metrics.set_gauge("runtime.gil_delay_p99_ms",
+                                           gil["p99"])
+                self._watch_plan_slo()
             except Exception:  # never kill the emitter
                 self.logger.exception("metrics emit failed")
             self._shutdown.wait(interval)
+
+    def _watch_plan_slo(self) -> None:
+        """Plan-apply p99 SLO watch: when NOMAD_TPU_BLACKBOX_SLO_PLAN_P99_MS
+        is set (>0) and the current interval's plan.apply p99 breaches
+        it, auto-capture a flight-recorder bundle.  note_trigger's
+        per-reason rate limit keeps a sustained breach from flooding."""
+        slo_ms = knobs.get_float("NOMAD_TPU_BLACKBOX_SLO_PLAN_P99_MS", 0.0)
+        if not slo_ms or slo_ms <= 0 or not blackbox.enabled():
+            return
+        latest = self.metrics.sink.latest()
+        summ = latest.get("Samples", {}).get("nomad.plan.apply")
+        if not summ or not summ.get("count"):
+            return
+        p99 = summ.get("p99", 0.0)
+        if p99 > slo_ms:
+            blackbox.note_trigger(
+                "slo.plan_apply_p99",
+                {"P99Ms": round(p99, 3), "SloMs": slo_ms,
+                 "Count": summ.get("count", 0),
+                 "Node": self.config.node_name})
 
     def _feed_tenancy(self, tenant_top: int) -> None:
         """Per-tick tenancy upkeep, piggybacked on the metrics cadence:
@@ -1626,6 +1663,35 @@ class Server:
         if isinstance(self.raft, MultiRaft):
             return list(self.raft.peers)
         return [self.config.rpc_advertise]
+
+    def trace_for_eval_fanout(self, eval_id: str,
+                              timeout: float = 1.0) -> Tuple[List, str]:
+        """Spans for an eval, checking the local tracer first and then
+        fanning out to peer servers over Status.TraceEval (the tracer is
+        per-process: a follower-scheduled eval's spans live only on the
+        scheduling follower, which 404'd leader-side trace links before
+        this).  Best-effort and bounded: a dark follower is skipped, the
+        first peer with spans wins.  Returns (spans, source_addr) — an
+        empty list with source "" when nobody has the trace."""
+        spans = tracing.trace_for_eval(eval_id)
+        if spans:
+            return spans, self.config.rpc_advertise
+        if self.pool is None:
+            return [], ""
+        me = self.config.rpc_advertise
+        for addr in self.peer_addresses():
+            if addr == me:
+                continue
+            try:
+                reply = self.pool.call(addr, "Status.TraceEval",
+                                       {"EvalID": eval_id},
+                                       timeout=timeout)
+            except Exception:
+                continue  # dark follower: skip, keep fanning out
+            got = (reply or {}).get("Spans") or []
+            if got:
+                return got, addr
+        return [], ""
 
     def operator_raft_remove_peer(self, address: str) -> None:
         """Remove a (possibly dead) server from the raft voter set

@@ -677,7 +677,7 @@ class BatchWorker(Worker):
     def _pipeline_prepare(self, batch) -> Optional[_BatchCtx]:
         from ..ops.batch_sched import TPUBatchScheduler
 
-        t0 = time.monotonic()
+        t0 = tracing.now()
         tr = tracing.TRACER
         attempts = {} if tr is None else {
             ev.id: self.broker.delivery_attempts(ev.id)
@@ -722,11 +722,11 @@ class BatchWorker(Worker):
         # spirit as the serial measure() but not directly comparable to
         # it under sustained overlap.
         self.metrics.add_sample("worker.invoke_scheduler.batch",
-                                (time.monotonic() - ctx.t0) * 1000.0)
+                                (tracing.now() - ctx.t0) * 1000.0)
         if tr is not None:
             # Retroactive span (the pipelined phases interleave batches,
             # so a nested context-managed span would mis-stack).
-            tr.record("worker.process_batch", ctx.t0, time.monotonic(),
+            tr.record("worker.process_batch", ctx.t0, tracing.now(),
                       num_evals=len(ctx.batch), pipelined=True,
                       fused=stats.fused, fetch_bytes=stats.fetch_bytes,
                       **tracing.eval_id_attrs(

@@ -1,0 +1,298 @@
+"""Incident flight recorder (``NOMAD_TPU_BLACKBOX=1``).
+
+When an incident fires — the kernel circuit breaker opens, the safety
+auditor records a violation, the lock-order sanitizer finds a cycle, or
+the plan-apply p99 breaches its SLO — the forensic window is *now*: the
+span ring, the event tail, and the profiler window all age out within
+minutes.  The flight recorder freezes that window to disk as one JSON
+bundle:
+
+- recent span timeline (``tracing.recent``) and event-ring tail
+  (``event_broker.recent``);
+- a metrics snapshot + per-region/tenant broker stats from every
+  registered server in the process;
+- the continuous-profile window and contention ledger
+  (``contprof.window``), plus an all-thread stack dump
+  (``profiling.thread_dump``);
+- knob values and breaker state.
+
+Auto-captures are **bounded and deduplicated**: a per-reason minimum
+interval (``NOMAD_TPU_BLACKBOX_MIN_INTERVAL_S``), a short global floor,
+and a process-lifetime cap (``NOMAD_TPU_BLACKBOX_MAX_BUNDLES``) keep a
+crash-looping trigger from filling the disk.  Operator-forced captures
+(``nomad-tpu debug``, ``/v1/debug/blackbox``) bypass the limits, and
+:func:`assemble_bundle` works even while disarmed so the on-demand
+surfaces never depend on arming.
+
+Capture runs on a spawned daemon thread: triggers fire from inside the
+breaker's and auditor's critical sections, and bundle assembly takes
+broker/sink locks — running it inline would deadlock or add lock-graph
+edges.  The synchronous part of :func:`note_trigger` is only the
+admission check under a raw (untracked) lock.
+
+Disarmed (the default) the module global ``_STATE`` is ``None`` and
+every trigger site costs one global load + branch — the ``fault.py``
+discipline shared by the tracing and profiling planes.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from . import knobs, lockcheck, tracing
+from .lockcheck import _REAL_LOCK as _RAW_LOCK
+
+__all__ = [
+    "FlightRecorder", "enable", "disable", "enabled",
+    "maybe_arm_from_env", "note_trigger", "capture", "assemble_bundle",
+    "register_server", "unregister_server", "bundles",
+]
+
+GLOBAL_FLOOR_S = 1.0      # min seconds between ANY two auto-captures
+SPAN_TAIL = 400           # spans bundled from the tracing ring
+EVENT_TAIL = 200          # events bundled from the process event tail
+PROFILE_WINDOW_S = 60.0   # continuous-profile window per bundle
+
+# Servers registered for state capture (server __init__/shutdown).
+_SERVERS: List[Any] = []
+_SERVERS_L = _RAW_LOCK()
+
+
+def register_server(server: Any) -> None:
+    with _SERVERS_L:
+        if server not in _SERVERS:
+            _SERVERS.append(server)
+
+
+def unregister_server(server: Any) -> None:
+    with _SERVERS_L:
+        try:
+            _SERVERS.remove(server)
+        except ValueError:
+            pass
+
+
+def _jsonable(obj: Any) -> Any:
+    """Best-effort conversion to JSON-serializable data; the recorder
+    must never lose a bundle to one odd payload value."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        return [_jsonable(v) for v in obj]
+    return repr(obj)
+
+
+def _event_dicts(events: List[Any]) -> List[Dict[str, Any]]:
+    out = []
+    for ev in events:
+        out.append({
+            "Topic": getattr(ev, "topic", ""),
+            "Type": getattr(ev, "type", ""),
+            "Key": getattr(ev, "key", ""),
+            "Index": getattr(ev, "index", 0),
+            "Payload": _jsonable(getattr(ev, "payload", {})),
+            "EvalID": getattr(ev, "eval_id", ""),
+            "SpanID": getattr(ev, "span_id", 0),
+        })
+    return out
+
+
+def assemble_bundle(reason: str, detail: Optional[Dict] = None
+                    ) -> Dict[str, Any]:
+    """Build the in-memory bundle.  Works disarmed — the HTTP/CLI
+    on-demand surfaces call this directly; the armed recorder adds the
+    rate limiting and the write-to-disk around it."""
+    bundle: Dict[str, Any] = {
+        "Reason": reason,
+        "Detail": _jsonable(detail or {}),
+        "Wall": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "UnixTime": round(time.time(), 3),
+        "Pid": os.getpid(),
+        "Knobs": {k.name: knobs.raw(k.name) for k in knobs.registered()
+                  if knobs.raw(k.name) is not None},
+    }
+    bundle["Spans"] = tracing.recent(SPAN_TAIL)
+    # Server-package and ops-package reads go through sys.modules: the
+    # utils layer must not import them (cycle), and ops drags in jax.
+    ebm = sys.modules.get("nomad_tpu.server.event_broker")
+    bundle["Events"] = _event_dicts(ebm.recent(EVENT_TAIL)) \
+        if ebm is not None else []
+    from . import contprof, profiling
+    bundle["Profile"] = contprof.window(PROFILE_WINDOW_S)
+    bundle["Locks"] = {
+        "Waits": lockcheck.wait_stats(top=10),
+        "Edges": len(lockcheck.edges()),
+        "BlockingCalls": len(lockcheck.blocking_calls()),
+    }
+    bundle["Threads"] = profiling.thread_dump()
+    brk = sys.modules.get("nomad_tpu.ops.breaker")
+    if brk is not None:
+        bundle["Breaker"] = {"State": brk.BREAKER.state,
+                             "Trips": brk.BREAKER.trips}
+    with _SERVERS_L:
+        servers = list(_SERVERS)
+    out_servers = []
+    for srv in servers:
+        try:
+            out_servers.append({
+                "Name": getattr(getattr(srv, "config", None),
+                                "node_name", "?"),
+                "Stats": _jsonable(srv.stats()),
+                "BrokerStats": _jsonable(srv.broker_stats()),
+                "Metrics": _jsonable(srv.metrics.sink.latest()),
+            })
+        except Exception:  # a shutting-down server must not kill capture
+            continue
+    bundle["Servers"] = out_servers
+    return bundle
+
+
+class FlightRecorder:
+    """Rate-limited incident capture to a bundle directory."""
+
+    def __init__(self, directory: Optional[str] = None,
+                 min_interval_s: Optional[float] = None,
+                 max_bundles: Optional[int] = None):
+        if directory is None:
+            directory = knobs.get_str("NOMAD_TPU_BLACKBOX_DIR") or \
+                os.path.join(tempfile.gettempdir(), "nomad_tpu_blackbox")
+        self.directory = directory
+        if min_interval_s is None:
+            min_interval_s = knobs.get_float(
+                "NOMAD_TPU_BLACKBOX_MIN_INTERVAL_S", 30.0)
+        self.min_interval_s = max(0.0, float(min_interval_s or 0.0))
+        if max_bundles is None:
+            max_bundles = knobs.get_int("NOMAD_TPU_BLACKBOX_MAX_BUNDLES",
+                                        32)
+        self.max_bundles = max(1, int(max_bundles or 32))
+        self._l = _RAW_LOCK()  # admission only — never held in capture
+        self._last_by_reason: Dict[str, float] = {}
+        self._last_any = 0.0
+        self._auto_count = 0
+        self._seq = 0
+        self.captured: List[str] = []  # bundle paths, oldest first
+
+    def _admit(self, reason: str) -> bool:
+        """Auto-capture admission: per-reason min interval, global
+        floor, lifetime cap.  Cheap and synchronous — this is the only
+        part that runs on the trigger's thread."""
+        now = time.perf_counter()
+        with self._l:
+            if self._auto_count >= self.max_bundles:
+                return False
+            last = self._last_by_reason.get(reason)
+            if last is not None and now - last < self.min_interval_s:
+                return False
+            if self._last_any and now - self._last_any < GLOBAL_FLOOR_S:
+                return False
+            self._last_by_reason[reason] = now
+            self._last_any = now
+            self._auto_count += 1
+            return True
+
+    def _bundle_path(self, reason: str) -> str:
+        with self._l:
+            self._seq += 1
+            seq = self._seq
+        safe = "".join(c if c.isalnum() or c in "._-" else "_"
+                       for c in reason)
+        stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+        return os.path.join(self.directory,
+                            f"blackbox_{stamp}_{seq:03d}_{safe}.json")
+
+    def capture(self, reason: str, detail: Optional[Dict] = None,
+                force: bool = False) -> Optional[str]:
+        """Assemble + write one bundle; returns its path.  ``force``
+        (operator-initiated) bypasses rate limiting and the cap."""
+        if not force and not self._admit(reason):
+            return None
+        try:
+            bundle = assemble_bundle(reason, detail)
+            os.makedirs(self.directory, exist_ok=True)
+            path = self._bundle_path(reason)
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(bundle, fh, indent=1, default=repr)
+            os.replace(tmp, path)
+        except Exception:  # pragma: no cover — recorder never raises
+            return None
+        with self._l:
+            self.captured.append(path)
+        ebm = sys.modules.get("nomad_tpu.server.event_broker")
+        if ebm is not None:
+            ebm.note_external("Blackbox", "BundleCaptured", reason,
+                              {"Path": path})
+        return path
+
+
+# ---------------------------------------------------------------------------
+# process-wide arming (fault.py discipline: None ⇒ disarmed)
+# ---------------------------------------------------------------------------
+
+_STATE: Optional[FlightRecorder] = None
+
+
+def enable(directory: Optional[str] = None,
+           min_interval_s: Optional[float] = None,
+           max_bundles: Optional[int] = None) -> FlightRecorder:
+    global _STATE
+    if _STATE is None:
+        _STATE = FlightRecorder(directory, min_interval_s, max_bundles)
+    return _STATE
+
+
+def disable() -> None:
+    global _STATE
+    _STATE = None
+
+
+def enabled() -> bool:
+    return _STATE is not None
+
+
+def maybe_arm_from_env() -> bool:
+    """Arm when NOMAD_TPU_BLACKBOX=1 — called at server construction so
+    bench children and loadgen followers inherit the recorder."""
+    if _STATE is None and knobs.get_bool("NOMAD_TPU_BLACKBOX"):
+        enable()
+        return True
+    return False
+
+
+def bundles() -> List[str]:
+    st = _STATE
+    return list(st.captured) if st is not None else []
+
+
+def note_trigger(reason: str, detail: Optional[Dict] = None) -> None:
+    """Incident hook for the breaker / auditor / sanitizer / SLO watch.
+    One global load + branch while disarmed; when armed, the admission
+    check runs synchronously and the capture itself on a daemon thread
+    (trigger sites hold their subsystem's locks)."""
+    st = _STATE
+    if st is None:
+        return
+    if not st._admit(reason):
+        return
+    snap = _jsonable(detail or {})
+    t = threading.Thread(
+        target=lambda: st.capture(reason, snap, force=True),
+        name="blackbox-capture", daemon=True)
+    t.start()
+
+
+def capture(reason: str, detail: Optional[Dict] = None,
+            force: bool = True) -> Optional[str]:
+    """Synchronous capture through the armed recorder (CLI/HTTP path);
+    returns the bundle path, or None when disarmed or suppressed."""
+    st = _STATE
+    if st is None:
+        return None
+    return st.capture(reason, detail, force=force)

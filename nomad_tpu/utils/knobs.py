@@ -231,6 +231,37 @@ _knob("NOMAD_TPU_LOCKCHECK", "bool", False,
       "Arm the runtime lock-order sanitizer (utils/lockcheck.py): "
       "instrumented locks record acquisition order, teardown asserts "
       "acyclicity and prints the witness cycle")
+_knob("NOMAD_TPU_CONTPROF", "bool", False,
+      "Arm the continuous host-attribution profiler "
+      "(utils/contprof.py) at server construction: a low-Hz sampler "
+      "classifies every thread's stack into subsystem CPU-share "
+      "gauges (nomad.cpu.<subsystem>)")
+_knob("NOMAD_TPU_CONTPROF_HZ", "float", 10.0,
+      "Continuous-profiler sampling rate in Hz (clamped to 1-100)")
+_knob("NOMAD_TPU_CONTPROF_RING", "int", 120,
+      "Continuous-profiler ring: how many 5s aggregation windows are "
+      "retained for the /v1/profile/continuous surface")
+_knob("NOMAD_TPU_CONTPROF_GIL_MS", "float", 5.0,
+      "GIL-pressure probe requested sleep in milliseconds (the probe "
+      "measures scheduling-delay jitter against it; 0 disables the "
+      "probe thread)")
+_knob("NOMAD_TPU_BLACKBOX", "bool", False,
+      "Arm the incident flight recorder (utils/blackbox.py) at "
+      "server construction: breaker opens, auditor violations, lock "
+      "cycles and plan-apply SLO breaches capture a JSON bundle")
+_knob("NOMAD_TPU_BLACKBOX_DIR", "str", None,
+      "Flight-recorder bundle directory",
+      default_label="<tmpdir>/nomad_tpu_blackbox")
+_knob("NOMAD_TPU_BLACKBOX_MIN_INTERVAL_S", "float", 30.0,
+      "Flight recorder: minimum seconds between two auto-captures "
+      "for the same trigger reason (dedup/rate limit)")
+_knob("NOMAD_TPU_BLACKBOX_MAX_BUNDLES", "int", 32,
+      "Flight recorder: hard cap on auto-captured bundles per "
+      "process (operator-forced captures are exempt)")
+_knob("NOMAD_TPU_BLACKBOX_SLO_PLAN_P99_MS", "float", 0.0,
+      "Plan-apply p99 SLO in milliseconds watched by the metrics "
+      "emitter; a breach auto-captures a flight-recorder bundle "
+      "(0 disables the watch)")
 
 # -- multi-tenant serving plane ---------------------------------------------
 _knob("NOMAD_TPU_TENANCY_OBJECTIVE", "str", "drf",

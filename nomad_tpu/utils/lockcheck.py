@@ -26,6 +26,14 @@ Locks created by foreign code (stdlib, jax) get the real primitive:
 the constructor patch inspects the caller and only wraps construction
 reached from a ``nomad_tpu`` source file, so the graph never carries
 noise edges from library internals.
+
+Contention ledger (ISSUE 19): while armed, every tracked acquisition
+also measures how long the acquire blocked (two ``perf_counter`` reads
+around the inner acquire — always cheap) into a process-wide per-name
+wait ledger.  :func:`wait_stats` ranks the contended locks; the
+continuous profiler (``utils/contprof.py``) exports them as
+``nomad.lock.<name>.wait_seconds`` histograms and the loadgen report's
+``host_attribution`` section names the top five per leg.
 """
 from __future__ import annotations
 
@@ -33,12 +41,13 @@ import os
 import sys
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional, Set, Tuple
 
 __all__ = [
     "LockOrderError", "arm", "disarm", "armed", "maybe_arm_from_env",
     "assert_acyclic", "find_cycle", "cycle_in_edges", "edges",
-    "blocking_calls", "reset",
+    "blocking_calls", "reset", "wait_stats", "reset_waits",
     "held_tracked", "TrackedLock", "make_tracked",
 ]
 
@@ -77,6 +86,103 @@ _REAL_FSYNC = os.fsync
 _PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 MAX_BLOCKING_RECORDS = 1024
+
+# -- contention ledger --------------------------------------------------------
+# Per-name wait aggregates shared by every TrackedLock instance created
+# at the same source line (two servers in one process contend the same
+# code path).  The registry and each aggregate use RAW locks so the
+# ledger itself never grows graph edges.
+
+WAIT_RING = 512
+MAX_WAIT_NAMES = 4096
+
+
+class _WaitStats:
+    """Wait-time aggregate for one lock name: count/sum/max plus a
+    bounded ring of raw waits for exact small-N percentiles."""
+
+    __slots__ = ("name", "count", "total_s", "max_s", "ring", "_l")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+        self.ring: deque = deque(maxlen=WAIT_RING)
+        self._l = _REAL_LOCK()
+
+    def add(self, wait_s: float) -> None:
+        with self._l:
+            self.count += 1
+            self.total_s += wait_s
+            if wait_s > self.max_s:
+                self.max_s = wait_s
+            self.ring.append(wait_s)
+
+    def clear(self) -> None:
+        with self._l:
+            self.count = 0
+            self.total_s = 0.0
+            self.max_s = 0.0
+            self.ring.clear()
+
+    def summary(self) -> Dict:
+        with self._l:
+            vals = sorted(self.ring)
+            count, total, mx = self.count, self.total_s, self.max_s
+
+        def pct(q: float) -> float:
+            if not vals:
+                return 0.0
+            return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+        return {
+            "name": self.name,
+            "count": count,
+            "wait_s_sum": round(total, 6),
+            "wait_s_max": round(mx, 6),
+            "p50_ms": round(pct(0.50) * 1000.0, 4),
+            "p95_ms": round(pct(0.95) * 1000.0, 4),
+            "p99_ms": round(pct(0.99) * 1000.0, 4),
+        }
+
+
+_WAITS: Dict[str, _WaitStats] = {}
+_WAITS_L = _REAL_LOCK()
+
+
+def _wait_stats_for(name: str) -> _WaitStats:
+    with _WAITS_L:
+        ws = _WAITS.get(name)
+        if ws is None:
+            if len(_WAITS) >= MAX_WAIT_NAMES:
+                name = "<overflow>"
+                ws = _WAITS.get(name)
+                if ws is None:
+                    ws = _WAITS[name] = _WaitStats(name)
+            else:
+                ws = _WAITS[name] = _WaitStats(name)
+        return ws
+
+
+def wait_stats(top: Optional[int] = None) -> List[Dict]:
+    """Contended-lock ranking: per-name wait summaries sorted by total
+    blocked seconds, the names with zero recorded waits elided."""
+    with _WAITS_L:
+        stats = list(_WAITS.values())
+    out = [ws.summary() for ws in stats]
+    out = [o for o in out if o["count"]]
+    out.sort(key=lambda o: (-o["wait_s_sum"], o["name"]))
+    return out[:top] if top else out
+
+
+def reset_waits() -> None:
+    """Zero the ledger in place (per-leg snapshots).  Aggregates are
+    cleared, not dropped: live TrackedLocks hold direct references."""
+    with _WAITS_L:
+        stats = list(_WAITS.values())
+    for ws in stats:
+        ws.clear()
 
 
 _SELF_FILE = os.path.abspath(__file__).rstrip("co")  # .py for .pyc
@@ -125,7 +231,8 @@ class TrackedLock:
     After :func:`disarm`, live wrappers keep working at one global load
     per operation (``_STATE is None`` short-circuit)."""
 
-    __slots__ = ("_inner", "name", "_rlock", "_count", "_owner_stack")
+    __slots__ = ("_inner", "name", "_rlock", "_count", "_owner_stack",
+                 "_wait")
 
     def __init__(self, inner, name: str, rlock: bool):
         self._inner = inner
@@ -133,6 +240,7 @@ class TrackedLock:
         self._rlock = rlock
         self._count = 0  # recursion depth, tracking thread only
         self._owner_stack = None  # held-stack list the entry lives on
+        self._wait = None  # per-name _WaitStats, resolved lazily
 
     # -- tracking ----------------------------------------------------------
 
@@ -194,8 +302,16 @@ class TrackedLock:
     # -- the lock protocol -------------------------------------------------
 
     def acquire(self, blocking: bool = True, timeout: float = -1):
+        if _STATE is None:
+            return self._inner.acquire(blocking, timeout)
+        t0 = time.perf_counter()
         got = self._inner.acquire(blocking, timeout)
-        if got and _STATE is not None:
+        if got:
+            wait = time.perf_counter() - t0
+            ws = self._wait
+            if ws is None:
+                ws = self._wait = _wait_stats_for(self.name)
+            ws.add(wait)
             self._note_acquired(_caller_site())
         return got
 
@@ -458,4 +574,10 @@ def assert_acyclic() -> None:
     if cycle is not None:
         msg = witness(cycle)
         print(msg, file=sys.stderr)
+        # A runtime lock-order cycle is a flight-recorder incident:
+        # capture the forensic bundle before the assertion unwinds the
+        # process state.  Late import — blackbox reads this module's
+        # ledger back.
+        from . import blackbox
+        blackbox.note_trigger("lockcheck.cycle", {"witness": msg})
         raise LockOrderError(msg)

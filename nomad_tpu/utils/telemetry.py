@@ -244,9 +244,12 @@ class Telemetry:
         self.sink.add_sample(self._key(key), value)
 
     def measure_since(self, key: str, start: float) -> None:
-        """Record elapsed milliseconds (metrics.MeasureSince)."""
+        """Record elapsed milliseconds (metrics.MeasureSince).  ``start``
+        must come from ``time.perf_counter()`` — the same clock the
+        tracing plane uses, so a timestamp can feed both a sample and a
+        retroactive span."""
         self.sink.add_sample(self._key(key),
-                             (time.monotonic() - start) * 1000.0)
+                             (time.perf_counter() - start) * 1000.0)
 
     class _Timer:
         def __init__(self, t: "Telemetry", key: str):
@@ -254,7 +257,7 @@ class Telemetry:
             self.key = key
 
         def __enter__(self):
-            self.start = time.monotonic()
+            self.start = time.perf_counter()
             return self
 
         def __exit__(self, *exc):

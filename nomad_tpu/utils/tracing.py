@@ -4,7 +4,7 @@ applier → raft), queryable per eval.
 
 Why not logs: at batch scale, "where did eval X spend its time" is a
 join across six subsystems on four threads.  Spans carry ids, parents,
-monotonic timestamps, and attrs; everything touching one evaluation
+``perf_counter`` timestamps, and attrs; everything touching one evaluation
 tags ``eval_id`` (batch spans tag ``eval_ids``), so the whole lifecycle
 — enqueue → dequeue → batch phases → plan submit → apply — comes back
 from one index lookup (``/v1/trace/eval/<id>`` in agent/http.py).
@@ -38,10 +38,18 @@ from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional
 
 __all__ = [
-    "Span", "Tracer", "TRACER", "NOOP",
+    "Span", "Tracer", "TRACER", "NOOP", "now",
     "enable", "disable", "enabled", "span", "event", "record",
     "trace_for_eval", "recent", "note_fault", "mark", "close_mark",
 ]
+
+#: The span clock.  ``time.perf_counter()``: monotonic like
+#: ``time.monotonic()`` (immune to NTP steps) but highest-resolution,
+#: so sub-millisecond phase spans don't quantize.  Callers feeding
+#: already-measured timestamps into :func:`record` must use THIS clock
+#: (``tracing.now()``) — mixing bases corrupts span ordering and the
+#: wall-clock backdating.
+now = time.perf_counter
 
 # Bounded-store defaults: the recency ring holds ~4k completed spans;
 # independently, the eval index (LRU over the last ~1k distinct eval
@@ -64,8 +72,9 @@ MAX_MARKS = 4096
 
 class Span:
     """One completed (or in-flight) operation.  ``start``/``end`` are
-    ``time.monotonic()`` — comparable across threads, immune to wall
-    clock steps; ``wall`` is the wall-clock start for humans."""
+    ``tracing.now()`` (``time.perf_counter()``) — comparable across
+    threads, immune to wall clock steps; ``wall`` is the wall-clock
+    start kept only as the epoch anchor for humans."""
 
     __slots__ = ("span_id", "parent_id", "name", "start", "end", "wall",
                  "attrs")
@@ -164,8 +173,8 @@ class Tracer:
         self._by_eval: "OrderedDict[str, _EvalBucket]" = OrderedDict()
         self.max_evals = max(1, max_evals)
         self._local = threading.local()
-        # eval_id → (monotonic submit time, attrs): the open end of a
-        # cross-thread umbrella span (mark/close_mark).
+        # eval_id → (tracing.now() submit time, attrs): the open end of
+        # a cross-thread umbrella span (mark/close_mark).
         self._marks: "OrderedDict[str, tuple]" = OrderedDict()
 
     # -- thread-local span stack ------------------------------------------
@@ -185,7 +194,7 @@ class Tracer:
             stk.pop()
         elif sp in stk:  # defensive: mis-nested exit
             stk.remove(sp)
-        sp.end = time.monotonic()
+        sp.end = now()
         self._record(sp)
 
     def current(self) -> Optional[Span]:
@@ -212,8 +221,7 @@ class Tracer:
                 pevs = parent.attrs.get("eval_ids")
                 if pevs is not None:
                     attrs["eval_ids"] = pevs
-        return Span(next(self._seq), parent_id, name, time.monotonic(),
-                    attrs)
+        return Span(next(self._seq), parent_id, name, now(), attrs)
 
     def span(self, name: str, **attrs: Any) -> _ActiveSpan:
         return _ActiveSpan(self, self._new_span(name, attrs))
@@ -228,7 +236,8 @@ class Tracer:
     def record(self, name: str, start: float, end: float,
                **attrs: Any) -> Span:
         """Retroactively record a completed span from already-measured
-        monotonic timestamps (the batch scheduler's phase timers)."""
+        ``tracing.now()`` timestamps (the batch scheduler's phase
+        timers)."""
         sp = self._new_span(name, attrs)
         # Backdate the wall clock along with the monotonic start — it was
         # stamped at creation (i.e. the phase's END), not at `start`.
@@ -241,11 +250,11 @@ class Tracer:
     # -- cross-thread umbrella marks ---------------------------------------
 
     def mark(self, eval_id: str, **attrs: Any) -> None:
-        """Open an umbrella: remember WHEN (monotonic) this eval was
-        submitted, so whichever thread later closes it can record one
-        span covering the whole client-visible lifecycle."""
+        """Open an umbrella: remember WHEN (``tracing.now()``) this eval
+        was submitted, so whichever thread later closes it can record
+        one span covering the whole client-visible lifecycle."""
         with self._l:
-            self._marks[eval_id] = (time.monotonic(), attrs)
+            self._marks[eval_id] = (now(), attrs)
             self._marks.move_to_end(eval_id)
             while len(self._marks) > MAX_MARKS:
                 self._marks.popitem(last=False)
@@ -264,7 +273,7 @@ class Tracer:
         merged = dict(mark_attrs)
         merged.update(attrs)
         merged["eval_id"] = eval_id
-        self.record(name, start, time.monotonic(), **merged)
+        self.record(name, start, now(), **merged)
 
     # -- storage / query ---------------------------------------------------
 

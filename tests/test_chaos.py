@@ -615,15 +615,22 @@ class TestKernelCorruptionBreaker:
             sched.schedule_batch([ev])
         assert brk.state == "open"
 
-    def test_breaker_trips_through_real_batch_worker(self, monkeypatch):
+    def test_breaker_trips_through_real_batch_worker(self, monkeypatch,
+                                                     tmp_path):
         """End-to-end through Server + BatchWorker: a corrupted kernel
         batch trips the process-wide breaker; later jobs complete via the
-        oracle while open; the breaker probes closed after cooldown."""
+        oracle while open; the breaker probes closed after cooldown. With
+        the flight recorder armed, the trip auto-captures exactly one
+        rate-limited bundle."""
+        import json
+
         from nomad_tpu.ops import breaker as breaker_mod
+        from nomad_tpu.utils import blackbox
 
         monkeypatch.setenv("NOMAD_TPU_BREAKER_MIN_CHECKS", "1")
         monkeypatch.setenv("NOMAD_TPU_BREAKER_COOLDOWN", "0.5")
         breaker_mod.reset_for_tests()
+        blackbox.enable(directory=str(tmp_path), min_interval_s=300.0)
         srv = Server(ServerConfig(num_schedulers=1,
                                   use_tpu_batch_worker=True, batch_size=8))
         srv.start()
@@ -654,7 +661,27 @@ class TestKernelCorruptionBreaker:
                 if not a.terminal_status()]) == 2, timeout=60.0)
             assert wait_until(
                 lambda: breaker_mod.BREAKER.state == "closed", timeout=30.0)
+            # The trip auto-captured a flight-recorder bundle (capture is
+            # async on a daemon thread; wait for it to land on disk).
+            assert wait_until(lambda: len(blackbox.bundles()) >= 1,
+                              timeout=10.0)
+            assert len(blackbox.bundles()) == 1, blackbox.bundles()
+            with open(blackbox.bundles()[0], encoding="utf-8") as fh:
+                bundle = json.load(fh)
+            assert bundle["Reason"] == "breaker.open"
+            assert bundle["Detail"]["Trips"] >= 1
+            for key in ("Spans", "Events", "Profile", "Locks", "Threads",
+                        "Servers", "Breaker", "Knobs"):
+                assert key in bundle, key
+            assert any(sv["Name"] == srv.config.node_name
+                       for sv in bundle["Servers"])
+            # A second trigger for the same reason inside the min
+            # interval is suppressed by the limiter.
+            blackbox.note_trigger("breaker.open", {"Trips": 99})
+            time.sleep(0.3)
+            assert len(blackbox.bundles()) == 1
         finally:
+            blackbox.disable()
             srv.shutdown()
             monkeypatch.delenv("NOMAD_TPU_BREAKER_MIN_CHECKS")
             monkeypatch.delenv("NOMAD_TPU_BREAKER_COOLDOWN")

@@ -376,3 +376,33 @@ class TestMoreJsonAndDetailedFlags:
         assert code == 0
         members = json_mod.loads(out)
         assert members and members[0]["Name"]
+
+
+class TestDebugCommand:
+    def test_debug_writes_bundle_file(self, agent, addr, tmp_path):
+        import json as json_mod
+
+        # /v1/debug/* is gated; dev config leaves it off.
+        agent.config.enable_debug = True
+        try:
+            dest = str(tmp_path / "bundle.json")
+            code, out = run_cli(["debug", "-address", addr,
+                                 "-reason", "cli.smoke", "-output", dest])
+            assert code == 0, out
+            assert dest in out and "cli.smoke" in out
+            with open(dest, encoding="utf-8") as fh:
+                bundle = json_mod.loads(fh.read())
+            assert bundle["Reason"] == "cli.smoke"
+            for key in ("Spans", "Events", "Profile", "Locks", "Threads",
+                        "Servers"):
+                assert key in bundle, key
+            assert any(sv["Name"] == agent.server.config.node_name
+                       for sv in bundle["Servers"])
+        finally:
+            agent.config.enable_debug = False
+
+    def test_debug_gated_without_enable_debug(self, agent, addr):
+        assert not agent.config.enable_debug
+        code, out = run_cli(["debug", "-address", addr])
+        assert code == 1
+        assert "Error" in out
